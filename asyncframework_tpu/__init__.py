@@ -1,0 +1,32 @@
+"""asyncframework-tpu: a TPU-native bounded-staleness asynchronous optimization framework.
+
+A brand-new framework with the capabilities of the ASYNC engine (a Spark 2.3.2
+fork implementing asynchronous parameter-server optimization -- ASGD and ASAGA
+with bounded staleness, IPDPS 2020, arXiv:1907.08526), re-designed for TPU:
+
+- workers are JAX devices (or logical device slots); data shards live in HBM
+- per-shard mini-batch gradients are jitted XLA computations dispatched
+  asynchronously from a host-side executor pool
+- the driver is a pair of host threads: a submitter (cohort selection, model
+  publication) and an updater (tau-filtered parameter-server updates) sharing
+  an AsyncContext (result queue + worker-state table + logical clock)
+- synchronous data-parallelism runs as a single fused jit with `psum` over a
+  `jax.sharding.Mesh`
+
+Reference parity map: see ARCHITECTURE.md (every component of the reference's
+SURVEY.md section-2 inventory is mapped to a module here).
+"""
+
+from asyncframework_tpu.version import __version__
+
+from asyncframework_tpu.context import AsyncContext, WorkerState, PartialResult
+from asyncframework_tpu.conf import AsyncConf, ConfigEntry
+
+__all__ = [
+    "__version__",
+    "AsyncContext",
+    "WorkerState",
+    "PartialResult",
+    "AsyncConf",
+    "ConfigEntry",
+]

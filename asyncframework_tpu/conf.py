@@ -1,0 +1,126 @@
+"""Typed configuration system.
+
+Parity: the reference has two layers -- a string k/v ``SparkConf`` and a typed
+``ConfigEntry``/``ConfigBuilder`` registry (``core/.../internal/config/
+package.scala:26``) with precedence CLI > conf file > defaults.  This module
+provides both: :class:`ConfigEntry` (typed, documented, defaulted, registered)
+and :class:`AsyncConf` (k/v store with env-var and dict overlays).
+
+The ASYNC knobs themselves (the 13 positional driver args of
+``SparkASGDThread.scala:28-48``) are registered here as first-class entries so
+solvers can be configured programmatically, from CLI, or from files.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+_REGISTRY: Dict[str, "ConfigEntry"] = {}
+
+
+@dataclass(frozen=True)
+class ConfigEntry(Generic[T]):
+    """A typed, registered configuration key."""
+
+    key: str
+    default: T
+    value_type: Callable[[str], T]
+    doc: str = ""
+
+    def __post_init__(self):
+        _REGISTRY[self.key] = self
+
+    def from_string(self, s: str) -> T:
+        if self.value_type is bool:
+            return s.strip().lower() in ("1", "true", "yes", "on")  # type: ignore
+        return self.value_type(s)
+
+
+def registry() -> Dict[str, ConfigEntry]:
+    return dict(_REGISTRY)
+
+
+class AsyncConf:
+    """String/typed k/v configuration with precedence: explicit set > env
+    (``ASYNCTPU_<KEY_UPPER_WITH_UNDERSCORES>``) > registered default."""
+
+    ENV_PREFIX = "ASYNCTPU_"
+
+    def __init__(self, initial: Optional[Dict[str, Any]] = None):
+        self._store: Dict[str, Any] = {}
+        if initial:
+            self._store.update(initial)
+
+    def set(self, key: str, value: Any) -> "AsyncConf":
+        self._store[key] = value
+        return self
+
+    def set_all(self, kv: Dict[str, Any]) -> "AsyncConf":
+        self._store.update(kv)
+        return self
+
+    def contains(self, key: str) -> bool:
+        return key in self._store or self._env_name(key) in os.environ
+
+    def _env_name(self, key: str) -> str:
+        return self.ENV_PREFIX + key.upper().replace(".", "_")
+
+    def get(self, entry_or_key, default: Any = None) -> Any:
+        if isinstance(entry_or_key, ConfigEntry):
+            entry = entry_or_key
+            if entry.key in self._store:
+                v = self._store[entry.key]
+                return entry.from_string(v) if isinstance(v, str) else v
+            env = os.environ.get(self._env_name(entry.key))
+            if env is not None:
+                return entry.from_string(env)
+            return entry.default
+        key = entry_or_key
+        entry = _REGISTRY.get(key)
+        if key in self._store:
+            v = self._store[key]
+            if entry is not None and isinstance(v, str):
+                return entry.from_string(v)
+            return v
+        env = os.environ.get(self._env_name(key))
+        if env is not None:
+            return entry.from_string(env) if entry is not None else env
+        if entry is not None:
+            return entry.default
+        return default
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: e.default for k, e in _REGISTRY.items()}
+        d.update(self._store)
+        return d
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"AsyncConf({self._store!r})"
+
+
+# --------------------------------------------------------------------------
+# Registered entries: engine knobs + the reference's 13 driver args.
+# --------------------------------------------------------------------------
+NUM_WORKERS = ConfigEntry("async.num.workers", 8, int, "Logical workers (device slots).")
+NUM_ITERATIONS = ConfigEntry("async.num.iterations", 1000, int, "Total accepted updates.")
+STEP_SIZE = ConfigEntry("async.step.size", 0.1, float, "Base step size gamma.")
+TAW = ConfigEntry("async.taw", 2**31 - 1, int, "Staleness bound tau.")
+BATCH_RATE = ConfigEntry("async.batch.rate", 0.1, float, "Per-round Bernoulli sample rate b.")
+BUCKET_RATIO = ConfigEntry("async.bucket.ratio", 0.5, float, "Cohort availability threshold.")
+PRINTER_FREQ = ConfigEntry("async.printer.freq", 100, int, "Trajectory snapshot period.")
+DELAY_COEFF = ConfigEntry("async.delay.coeff", 0.0, float,
+                          "Straggler delay intensity; -1 = cloud long-tail model.")
+SEED = ConfigEntry("async.seed", 42, int, "Root PRNG seed.")
+MODE = ConfigEntry("async.mode", 1, int, "1 = async (non-blocking jobs), 0 = sync.")
+MODEL_VERSIONS = ConfigEntry("async.broadcast.versions", 4, int,
+                             "Model versions kept live in the versioned store.")
+QUEUE_DRAIN_MAX = ConfigEntry("async.updater.drain.max", 0, int,
+                              "Max results drained per updater wake (0 = all).")
+HEARTBEAT_INTERVAL_S = ConfigEntry("async.heartbeat.interval", 0.5, float,
+                                   "Executor heartbeat period, seconds.")
+HEARTBEAT_TIMEOUT_S = ConfigEntry("async.heartbeat.timeout", 5.0, float,
+                                  "Executor declared dead after this silence.")

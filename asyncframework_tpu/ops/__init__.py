@@ -1,0 +1,1 @@
+from asyncframework_tpu.ops import blas, gradients, sampling, collectives  # noqa: F401

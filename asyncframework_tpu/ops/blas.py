@@ -1,0 +1,65 @@
+"""Level-1 BLAS surface used by the driver-side updater hot loop.
+
+Parity: ``mllib/.../BLASUtil.scala:6-19`` re-exports mllib's private
+``BLAS.{axpy,dot,scal}`` as ``axpyOp``/``dotOp``/``scalOp`` returning the
+mutated vector; those bottom out in netlib JNI (the reference's native math
+substrate, ``mllib-local/.../BLAS.scala:20-35``).
+
+On the TPU build the *worker* math is XLA (see :mod:`ops.gradients`); the
+*updater* runs on the host against a small dense ``w`` (<= ~47k dims for the
+reference workloads), where numpy's C loops are the right tool.  These helpers
+mutate in place exactly like the reference ops so the updater is a true
+in-place axpy loop, and also accept jax arrays (returning new arrays, since
+jax values are immutable) so the same solver code can run fully on-device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _axpy_numpy(a: float, x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    if a == 1.0:
+        np.add(y, x, out=y)
+    else:
+        # y += a*x without an extra temporary beyond the scaled buffer
+        y += np.multiply(x, a)
+    return y
+
+
+def axpy_op(a: float, x, y):
+    """Parity alias for ``BLASUtil.axpyOp`` -- y := a*x + y, returned.
+
+    Mutates ``y`` in place when it is a writable numpy buffer (the updater's
+    host-owned ``w``); falls back to out-of-place for read-only views -- e.g.
+    ``np.asarray(jax_array)`` exposes the device-to-host buffer read-only.
+    """
+    if isinstance(y, np.ndarray):
+        if not y.flags.writeable:
+            return y + np.multiply(x, a)
+        return _axpy_numpy(float(a), np.asarray(x), y)
+    return y + a * x
+
+
+def dot_op(x, y) -> float:
+    """Parity alias for ``BLASUtil.dotOp`` -- always a Python float (forces a
+    device sync on jax inputs, like the reference's blocking driver-side dot)."""
+    if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+        return float(np.dot(np.asarray(x), np.asarray(y)))
+    import jax.numpy as jnp
+
+    return float(jnp.dot(x, y))
+
+
+def scal_op(a: float, x):
+    """Parity alias for ``BLASUtil.scalOp`` -- x := a*x, returned.
+
+    In place for writable numpy buffers, out-of-place otherwise (device
+    results surfaced via ``np.asarray`` are read-only views).
+    """
+    if isinstance(x, np.ndarray):
+        if not x.flags.writeable:
+            return x * a
+        x *= a
+        return x
+    return x * a

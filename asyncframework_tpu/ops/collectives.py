@@ -1,0 +1,74 @@
+"""Reduction / aggregation collectives.
+
+The reference's collectives are all *driver-mediated*: ``reduce`` /
+``aggregate`` ship per-partition results to the driver which folds them
+(``rdd/RDD.scala:1227-1261``), and ``treeReduce`` / ``treeAggregate``
+(``rdd/RDD.scala:1181-1205,1358+``) add intermediate combine rounds to keep
+the driver from being the bottleneck.  That design exists because the driver
+is the only reduction point a TCP cluster has.
+
+On TPU the mesh *is* the reduction network: ``jax.lax.psum`` over an ICI axis
+is a hardware all-reduce.  This module provides
+
+- :func:`psum_over_mesh` -- the SPMD all-reduce used by the synchronous
+  solvers (replaces ``treeAggregate``);
+- :func:`tree_combine` -- a host-side pairwise tree fold used by the async
+  driver when it *chooses* to combine several queued partial results in one
+  updater wake (parity with treeReduce's combine topology, depth log2);
+- :func:`shard_sum_matvec` -- a shard_map'd X^T(mask*r) with psum, the one-jit
+  data-parallel gradient used by ``minibatch_sgd`` and the dryrun path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def psum_over_mesh(x: jax.Array, axis_name: str = "dp") -> jax.Array:
+    """All-reduce sum over a mesh axis (call inside shard_map/pjit)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def tree_combine(items: Sequence[Any], op: Callable[[Any, Any], Any]) -> Any:
+    """Pairwise tree fold on the host: log2(n) depth, parity with treeReduce's
+    combine topology.  ``op`` must be commutative+associative (reference
+    requirement for ``reduce``)."""
+    items = list(items)
+    if not items:
+        raise ValueError("tree_combine over empty sequence")
+    while len(items) > 1:
+        nxt: List[Any] = []
+        for i in range(0, len(items) - 1, 2):
+            nxt.append(op(items[i], items[i + 1]))
+        if len(items) % 2 == 1:
+            nxt.append(items[-1])
+        items = nxt
+    return items[0]
+
+
+def data_parallel_grad_fn(grad_sum_fn: Callable, mesh: Mesh, axis: str = "dp"):
+    """Build a one-jit SPMD data-parallel summed-gradient function.
+
+    ``grad_sum_fn(X, y, w, mask) -> g`` is a per-shard summed gradient (e.g.
+    :func:`ops.gradients.least_squares_grad_sum`).  Returns a function over
+    globally-sharded ``X (n, d)``, ``y (n,)``, ``mask (n,)`` (sharded on the
+    batch dim) and replicated ``w (d,)`` computing the *global* gradient sum
+    via an ICI psum -- the TPU-native ``treeAggregate``.
+    """
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(None), P(axis)),
+        out_specs=P(None),
+    )
+    def _sharded(X, y, w, mask):
+        g = grad_sum_fn(X, y, w, mask)
+        return jax.lax.psum(g, axis)
+
+    return jax.jit(_sharded)

@@ -1,0 +1,117 @@
+"""Loss gradients as jitted XLA computations (the worker hot path).
+
+Parity targets (semantics only; the implementation is batched XLA, not
+per-sample JNI BLAS):
+
+- Least-squares ``gradfun`` of the ASYNC drivers
+  (``ASYNCsamples/.../SparkASGDThread.scala:420-435``):
+  per sample, ``grad = (x . w - y) * x``; a partition's task result is the
+  *sum* of sampled per-sample gradients (the drivers' ``comOp`` is vector add).
+- MLlib ``LeastSquaresGradient`` / ``LogisticGradient``
+  (``mllib/.../optimization/Gradient.scala:285,166``).
+- ASAGA per-sample scalar form (``SparkASAGAThread.scala:500-515``): for least
+  squares the gradient is ``scalar * x`` with ``scalar = x . w - y``, so the
+  history table stores one scalar per sample.
+
+TPU mapping: a whole shard's sampled mini-batch gradient is two matmuls --
+``r = X @ w - y`` then ``g = X^T @ (mask * r)`` -- which XLA fuses and tiles
+onto the MXU.  Sampling is a Bernoulli *mask* (static shapes; no dynamic
+gather), so a "sampled subset" costs one elementwise multiply instead of a
+shape-changing filter.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def least_squares_residual(X: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
+    """Per-sample scalar ``x_i . w - y_i`` (the ASAGA 'scalar' form)."""
+    return X @ w - y
+
+
+@jax.jit
+def least_squares_grad_sum(
+    X: jax.Array, y: jax.Array, w: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Sum over masked samples of ``(x_i . w - y_i) x_i``.
+
+    ``mask`` is {0,1} (or weights) of shape ``(n,)``; equivalent to the
+    reference's sample-then-map-then-reduce with vector-add comOp.
+    """
+    r = X @ w - y
+    return X.T @ (mask * r)
+
+
+@jax.jit
+def least_squares_loss(X: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
+    """Mean squared error over the shard: sum_i (x_i.w - y_i)^2 (unnormalized).
+
+    The drivers print ``sum_i (x_i.w - y_i)^2 / N`` per trajectory snapshot
+    (``SparkASGDThread.scala:386-401``); normalization by N happens at the
+    caller, which knows the global N.
+    """
+    r = X @ w - y
+    return jnp.sum(r * r)
+
+
+@jax.jit
+def logistic_grad_sum(
+    X: jax.Array, y: jax.Array, w: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Sum over masked samples of the logistic-loss gradient.
+
+    Parity: ``LogisticGradient`` (binary case) -- labels in {0,1};
+    ``grad_i = (sigmoid(x_i.w) - y_i) x_i``.
+    """
+    margin = X @ w
+    p = jax.nn.sigmoid(margin)
+    return X.T @ (mask * (p - y))
+
+
+@jax.jit
+def logistic_loss(X: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
+    """Unnormalized logistic loss, numerically stable log1p(exp(.)) form."""
+    margin = X @ w
+    # log(1+e^m) - y*m, stable for both signs of margin
+    return jnp.sum(jnp.logaddexp(0.0, margin) - y * margin)
+
+
+@jax.jit
+def saga_shard_step(
+    X: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    alpha: jax.Array,
+    mask: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """One ASAGA worker computation over a shard.
+
+    Returns ``(g, diff)`` where ``diff_i = x_i.w - y_i`` are the *candidate*
+    new history scalars and
+    ``g = sum_i mask_i * (diff_i - alpha_i) * x_i``
+    is the history-corrected gradient contribution (parity with the worker map
+    in ``SparkASAGAThread.scala:369-380``: ``gradfun`` minus
+    ``scalar_hist * x`` summed by ``ASYNCaggregate``'s vector-add).
+
+    The history ``alpha`` slice stays in device HBM; committing
+    ``alpha[i] <- diff_i`` for masked i is a separate op
+    (:func:`saga_commit_history`) issued by the updater only for *accepted*
+    (non-stale) results -- the reference's driver-side ScalarMap merge.
+    """
+    diff = X @ w - y
+    g = X.T @ (mask * (diff - alpha))
+    return g, diff
+
+
+@jax.jit
+def saga_commit_history(
+    alpha: jax.Array, diff: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """alpha[i] <- diff[i] where mask_i else unchanged (accepted update)."""
+    return jnp.where(mask > 0, diff, alpha)

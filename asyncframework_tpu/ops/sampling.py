@@ -1,0 +1,53 @@
+"""Deterministic distributed sampling.
+
+Parity: the reference samples mini-batches two ways --
+- ``RDD.sample(false, b, seed + k + 1)`` per round (ASGD,
+  ``SparkASGDThread.scala:311``): per-element Bernoulli(b) with a
+  round-indexed seed;
+- seeded re-sampling on workers: ``new Random(cTime)`` walked over the
+  partition's rows in global index order (ASAGA,
+  ``SparkASAGAThread.scala:365-369``), so the driver can reproduce exactly
+  which global indices each worker drew.
+
+TPU-native equivalent: stateless ``jax.random`` keys.  A round's mask for one
+worker is a pure function of ``(root_seed, round_token, worker_id)`` -- both
+driver and worker can derive it independently (the property the reference gets
+from sharing ``cTime``), and it is reproducible across runs, unlike the
+reference's wall-clock seed.  Masks keep shapes static for XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def round_key(root_seed: int, round_token: int) -> jax.Array:
+    """Key shared by all workers of one round (parity: ``Random(cTime)``)."""
+    return jax.random.fold_in(jax.random.PRNGKey(root_seed), round_token)
+
+
+def worker_key(root_seed: int, round_token: int, worker_id: int) -> jax.Array:
+    """Per-(round, worker) key -- the driver can re-derive any worker's draw."""
+    return jax.random.fold_in(round_key(root_seed, round_token), worker_id)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def bernoulli_mask(key: jax.Array, n: int, rate: float) -> jax.Array:
+    """float {0,1} mask of shape (n,): per-element Bernoulli(rate).
+
+    Parity: ``sample(false, b, seed)`` / ``r.nextDouble() < b`` filters, with
+    masking instead of filtering to keep static shapes.
+    """
+    return jax.random.bernoulli(key, rate, (n,)).astype(jnp.float32)
+
+
+def host_mask(root_seed: int, round_token: int, worker_id: int, n: int, rate: float):
+    """Driver-side reproduction of a worker's mask as numpy (ASAGA parity:
+    the driver pre-computing ``sampledMap`` from the shared seed)."""
+    import numpy as np
+
+    m = bernoulli_mask(worker_key(root_seed, round_token, worker_id), n, rate)
+    return np.asarray(m)

@@ -1,0 +1,50 @@
+"""Test harness: force an 8-device virtual CPU platform before jax imports.
+
+Parity with the reference's test strategy (SURVEY.md section 4): the analog of
+Spark's single-JVM ``local-cluster[n,cores,mem]`` is a single-process JAX
+runtime with ``--xla_force_host_platform_device_count=8`` -- real shardings,
+real (emulated) collectives, no real pod.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize imports jax at interpreter start (to register the
+# axon TPU plugin), so JAX_PLATFORMS from the env is already latched -- force
+# the CPU platform through the config API as well (backends are not yet
+# initialized when conftest runs, so this takes effect).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def tiny_problem():
+    """Small well-conditioned least-squares problem shared by solver tests."""
+    rs = np.random.default_rng(0)
+    n, d = 512, 16
+    X = rs.normal(size=(n, d)).astype(np.float32)
+    w_true = rs.normal(size=(d,)).astype(np.float32)
+    y = (X @ w_true + 0.01 * rs.normal(size=(n,))).astype(np.float32)
+    return X, y, w_true

@@ -1,0 +1,119 @@
+"""AsyncContext / WorkerState / PartialResult unit tests (pure logic).
+
+Covers the semantics of the reference's ASYNCcontext/workerState/RDDPartialRes
+(queue, logical clock, staleness bookkeeping, availability aggregates).
+"""
+
+import threading
+import time
+
+import pytest
+
+from asyncframework_tpu.context import AsyncContext, PartialResult, WorkerState
+
+
+def test_partial_result_fields():
+    r = PartialResult(data=[1, 2], staleness=3, batch_size=10, worker_id=7)
+    assert r.get_task_result() == [1, 2]
+    assert r.get_staleness() == 3
+    assert r.get_batch_size() == 10
+    assert r.get_worker_id() == 7
+
+
+def test_clock_semantics():
+    ac = AsyncContext()
+    assert ac.get_current_time() == 0
+    ac.add_to_current_time(1)
+    ac.add_to_current_time(2)
+    assert ac.get_current_time() == 3
+    ac.set_current_time(10)
+    assert ac.get_current_time() == 10
+    ac.set_last_time(10)
+    assert ac.is_old()
+    ac.add_to_current_time(1)
+    assert not ac.is_old()
+
+
+def test_queue_collect_order_and_size():
+    ac = AsyncContext()
+    for i in range(5):
+        ac.put(PartialResult(i, 0, 1, i))
+    assert ac.size() == 5
+    assert ac.has_next()
+    assert ac.collect() == 0
+    got = ac.collect_all()
+    assert got.data == 1 and got.worker_id == 1
+    rest = [r.data for r in ac.drain()]
+    assert rest == [2, 3, 4]
+    assert not ac.has_next()
+
+
+def test_merge_result_staleness_and_clock():
+    ac = AsyncContext()
+    ac.mark_busy([0, 1])
+    ts = ac.get_current_time()  # 0
+    # worker 0 finishes first: staleness 0, clock -> 1
+    r0 = ac.merge_result(0, "g0", submit_clock=ts, elapsed_ms=10.0, batch_size=4)
+    assert r0.staleness == 0
+    assert ac.get_current_time() == 1
+    # worker 1 finishes after one other gradient arrived: staleness 1
+    r1 = ac.merge_result(1, "g1", submit_clock=ts, elapsed_ms=30.0, batch_size=4)
+    assert r1.staleness == 1
+    assert ac.get_current_time() == 2
+    s0, s1 = ac.get_state(0), ac.get_state(1)
+    assert s0.available and s1.available
+    assert s0.num_tasks == 1
+    assert s0.average_task_time == pytest.approx(10.0)
+    # second task for worker 0: avg = elapsed/(num_tasks+1)
+    ac.mark_busy([0])
+    assert not ac.get_state(0).available
+    ac.merge_result(0, "g0b", submit_clock=2, elapsed_ms=30.0, batch_size=4)
+    assert ac.get_state(0).num_tasks == 2
+    assert ac.get_state(0).average_task_time == pytest.approx(15.0)
+
+
+def test_availability_aggregates():
+    ac = AsyncContext()
+    assert ac.max_staleness() == -1  # reference returns -1 on empty table
+    ac.mark_busy([0, 1, 2, 3])
+    assert ac.available_workers() == 0
+    ac.merge_result(1, None, 0, 1.0, 1)
+    ac.mark_available(3)
+    assert ac.available_workers() == 2
+    ws = ac.get_state(1)
+    assert ws.get_available_workers() == 2  # delegate API parity
+    ac.merge_result(0, None, 0, 1.0, 1)  # staleness = clock(1) - 0 = 1
+    assert ac.max_staleness() == 1
+    assert ws.get_max_staleness() == 1
+
+
+def test_mark_available_does_not_bump_clock():
+    ac = AsyncContext()
+    ac.mark_busy([0])
+    ac.mark_available(0)  # empty-result path
+    assert ac.get_current_time() == 0
+    assert ac.available_workers() == 1
+
+
+def test_concurrent_producers_single_consumer():
+    """Producer/consumer stress: N producers stream, one consumer drains."""
+    ac = AsyncContext()
+    n_workers, per = 8, 50
+
+    def produce(wid):
+        for i in range(per):
+            ac.merge_result(wid, (wid, i), submit_clock=0, elapsed_ms=1.0, batch_size=1)
+
+    threads = [threading.Thread(target=produce, args=(w,)) for w in range(n_workers)]
+    for t in threads:
+        t.start()
+    seen = 0
+    deadline = time.time() + 10
+    while seen < n_workers * per and time.time() < deadline:
+        ac.collect_all(timeout=5)
+        seen += 1
+    for t in threads:
+        t.join()
+    assert seen == n_workers * per
+    assert ac.get_current_time() == n_workers * per
+    assert ac.available_workers() == n_workers
