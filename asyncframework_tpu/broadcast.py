@@ -1,0 +1,104 @@
+"""Versioned model distribution: the broadcast layer.
+
+Parity (studied, not copied):
+- ``broadcast/TorrentBroadcast.scala:57`` -- each round the driver broadcasts
+  a fresh model snapshot to every worker (the parameter-server "push").
+- ``broadcast/Broadcast.scala:74-80`` + ``broadcast/ASYNCbroadcast.scala:12-46``
+  -- broadcast handles carry a *version id* that can be re-pointed so a worker
+  can read an **older** model version (the stale-read experiment mechanism).
+
+TPU mapping: "broadcast" is ``jax.device_put`` of the host-resident ``w`` to
+each participating device -- a DMA into HBM, asynchronous by default, fanned
+out over PCIe/ICI by the runtime (no torrent protocol needed; the
+interconnect is the torrent).  A version is an integer; the store keeps the
+last ``max_live_versions`` snapshots per device (HBM ring buffer), so
+
+- ``store.publish(w)``                       = ``sc.broadcast(w)``
+- ``store.value(device)``                    = ``bc.value`` (latest)
+- ``store.value(device, version=v)``         = ``ASYNCbroadcast.value(index)``
+- eviction of old versions                   = ``Broadcast.destroy``
+
+The updater owns the host ``w``; workers only ever see published snapshots
+(single-writer discipline replacing the reference's benign torn-read races).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+class VersionedModelStore:
+    def __init__(self, max_live_versions: int = 4):
+        if max_live_versions < 1:
+            raise ValueError("max_live_versions must be >= 1")
+        self._max_live = max_live_versions
+        self._lock = threading.Lock()
+        self._next_version = 0
+        # version -> (host snapshot, {device -> device buffer})
+        self._versions: "OrderedDict[int, tuple]" = OrderedDict()
+
+    # ---------------------------------------------------------------- publish
+    def publish(self, w: np.ndarray, devices=None) -> int:
+        """Snapshot ``w`` as a new version and start its device transfers.
+
+        ``device_put`` is asynchronous: the host thread returns while DMAs
+        proceed; a worker touching the buffer later blocks only if its copy
+        has not landed yet.
+        """
+        host = np.array(w, copy=True)  # snapshot: updater keeps mutating w
+        with self._lock:
+            v = self._next_version
+            self._next_version += 1
+            buffers: Dict = {}
+            if devices:
+                seen = set()
+                for dev in devices:
+                    if dev is not None and dev not in seen:
+                        seen.add(dev)
+                        buffers[dev] = jax.device_put(host, dev)
+            self._versions[v] = (host, buffers)
+            while len(self._versions) > self._max_live:
+                self._versions.popitem(last=False)  # evict oldest
+            return v
+
+    # ------------------------------------------------------------------ reads
+    def latest_version(self) -> int:
+        with self._lock:
+            if not self._versions:
+                raise KeyError("no version published yet")
+            return next(reversed(self._versions))
+
+    def value(self, device=None, version: Optional[int] = None):
+        """Device buffer (or host snapshot when device is None) of a version.
+
+        ``version=None`` reads the latest (``bc.value``); an explicit older
+        version is the ``ASYNCbroadcast.value(index)`` stale read.  Raises
+        ``KeyError`` for evicted/unknown versions.
+        """
+        with self._lock:
+            v = version if version is not None else (
+                next(reversed(self._versions)) if self._versions else None
+            )
+            if v is None or v not in self._versions:
+                raise KeyError(f"model version {v} not live")
+            host, buffers = self._versions[v]
+            if device is None:
+                return host
+            buf = buffers.get(device)
+        if buf is not None:
+            return buf
+        # lazy fan-out: first read from a device not in the publish set
+        buf = jax.device_put(host, device)
+        with self._lock:
+            if v in self._versions:
+                self._versions[v][1][device] = buf
+        return buf
+
+    def live_versions(self):
+        with self._lock:
+            return list(self._versions.keys())

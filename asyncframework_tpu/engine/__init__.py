@@ -1,0 +1,18 @@
+from asyncframework_tpu.engine.job import Job, JobWaiter, TaskSpec
+from asyncframework_tpu.engine.executor import DeviceExecutor, ExecutorPool, TaskMetrics
+from asyncframework_tpu.engine.scheduler import JobScheduler
+from asyncframework_tpu.engine.barrier import partial_barrier
+from asyncframework_tpu.engine.straggler import DelayModel, build_cloud_stragglers
+
+__all__ = [
+    "Job",
+    "JobWaiter",
+    "TaskSpec",
+    "DeviceExecutor",
+    "ExecutorPool",
+    "TaskMetrics",
+    "JobScheduler",
+    "partial_barrier",
+    "DelayModel",
+    "build_cloud_stragglers",
+]

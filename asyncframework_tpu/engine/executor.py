@@ -1,0 +1,216 @@
+"""Device executors: the worker side of the engine.
+
+Parity: ``executor/Executor.scala:53`` (``TaskRunner.run`` 290: run task,
+report status) + ``executor/CoarseGrainedExecutorBackend.scala:40``
+(``LaunchTask`` inbox) + per-task ``TaskMetrics``
+(``executor/TaskMetrics.scala:45``) + executor heartbeats (``Executor.scala:814``).
+
+TPU mapping: an executor is a daemon thread bound to one *logical worker*.
+Each worker owns a jax device slot -- on an 8-device mesh that is one chip per
+worker; on a single chip, workers share the device and the XLA stream
+serializes their compute while the host threads still overlap dispatch,
+transfers, and the driver loop (this mirrors the reference's ``local[8]``
+mode, where 8 executor threads share one machine).
+
+Failure semantics: a task closure raising is reported to the scheduler
+(status FAILED -> retry/resubmit policy there); an executor can also be
+``kill()``-ed to simulate worker loss -- its heartbeat stops and the
+:class:`HeartbeatMonitor` (engine/heartbeat.py) declares it dead, triggering
+task resubmission on a replacement. That is the Spark executor-loss /
+``DistributedSuite`` story in one process.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from asyncframework_tpu.engine.job import TaskSpec
+from asyncframework_tpu.utils.clock import Clock, SystemClock
+
+
+@dataclass
+class TaskMetrics:
+    """Per-task observability record (TaskMetrics parity, trimmed to what a
+    host-dispatched XLA task actually has)."""
+
+    job_id: int
+    worker_id: int
+    attempt: int
+    launch_ms: float
+    finish_ms: float = 0.0
+    run_ms: float = 0.0
+    injected_delay_ms: float = 0.0
+    succeeded: bool = False
+    error: Optional[str] = None
+
+
+class DeviceExecutor:
+    """One worker: a daemon thread draining an inbox of :class:`TaskSpec`.
+
+    ``status_update(executor, task, result, exc)`` is invoked on this thread
+    when a task finishes (Spark's ``statusUpdate`` RPC, minus the RPC).
+    """
+
+    def __init__(
+        self,
+        worker_id: int,
+        status_update: Callable[["DeviceExecutor", TaskSpec, Any, Optional[BaseException]], None],
+        device=None,
+        clock: Optional[Clock] = None,
+    ):
+        self.worker_id = worker_id
+        self.device = device
+        self._status_update = status_update
+        self._clock = clock or SystemClock()
+        self._inbox: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
+        self._alive = True
+        self._killed = False
+        self.shutdown_requested = False
+        self.busy = False
+        self.busy_since_ms = 0.0
+        self.last_heartbeat_ms = self._clock.now_ms()
+        self.metrics: List[TaskMetrics] = []
+        self._thread = threading.Thread(
+            target=self._run, name=f"executor-{worker_id}", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------ API
+    def launch_task(self, task: TaskSpec) -> None:
+        if not self._alive:
+            raise RuntimeError(f"executor {self.worker_id} is not alive")
+        self._inbox.put(task)
+
+    def kill(self) -> None:
+        """Simulate worker loss: stop heartbeating and stop taking work."""
+        self._killed = True
+        self._alive = False
+        self._inbox.put(None)
+
+    def shutdown(self) -> None:
+        """Graceful stop: NOT a failure -- the heartbeat monitor must not
+        declare this executor lost (unlike :meth:`kill`)."""
+        self.shutdown_requested = True
+        self._alive = False
+        self._inbox.put(None)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout=timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._alive
+
+    def pending_tasks(self) -> int:
+        return self._inbox.qsize()
+
+    # ------------------------------------------------------------ main loop
+    def _run(self) -> None:
+        while True:
+            try:
+                task = self._inbox.get(timeout=0.1)
+            except queue.Empty:
+                if not self._alive:
+                    return
+                self.last_heartbeat_ms = self._clock.now_ms()
+                continue
+            if task is None or self._killed:
+                return
+            self.last_heartbeat_ms = self._clock.now_ms()
+            self.busy = True
+            self.busy_since_ms = self.last_heartbeat_ms
+            m = TaskMetrics(
+                job_id=task.job_id,
+                worker_id=self.worker_id,
+                attempt=task.attempt,
+                launch_ms=self._clock.now_ms(),
+            )
+            try:
+                result = task.fn()
+                m.succeeded = True
+                exc: Optional[BaseException] = None
+            except BaseException as e:  # noqa: BLE001 - report, don't die
+                result = None
+                exc = e
+                m.error = repr(e)
+            m.finish_ms = self._clock.now_ms()
+            m.run_ms = m.finish_ms - m.launch_ms
+            self.metrics.append(m)
+            self.busy = False
+            self.last_heartbeat_ms = self._clock.now_ms()
+            if self._killed:
+                return  # killed mid-task: never report (the monitor handles it)
+            self._status_update(self, task, result, exc)
+
+
+class ExecutorPool:
+    """Creates and tracks executors; supports replacement after failure.
+
+    Parity: the standalone ``Master``/``Worker`` pair's role of (re)launching
+    executors (``deploy/master/Master.scala``), collapsed to in-process
+    thread management -- the TPU build has no separate OS processes to manage,
+    the pod is a fixed resource.
+    """
+
+    def __init__(
+        self,
+        num_workers: int,
+        status_update,
+        devices: Optional[List] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.closed = False
+        self._clock = clock or SystemClock()
+        self._status_update = status_update
+        if devices is not None and len(devices) > 0:
+            device_of = lambda wid: devices[wid % len(devices)]  # noqa: E731
+        else:
+            device_of = lambda wid: None  # noqa: E731
+        self._device_of = device_of
+        self._lock = threading.Lock()
+        self.executors: Dict[int, DeviceExecutor] = {
+            wid: DeviceExecutor(wid, status_update, device_of(wid), self._clock)
+            for wid in range(num_workers)
+        }
+
+    def get(self, worker_id: int) -> DeviceExecutor:
+        with self._lock:
+            return self.executors[worker_id]
+
+    def replace(self, worker_id: int) -> DeviceExecutor:
+        """Start a fresh executor for a dead worker (elastic recovery)."""
+        with self._lock:
+            if self.closed:
+                raise RuntimeError("pool is shut down; cannot replace executor")
+            old = self.executors.get(worker_id)
+            if old is not None and old.alive:
+                old.shutdown()
+            ex = DeviceExecutor(
+                worker_id, self._status_update, self._device_of(worker_id), self._clock
+            )
+            self.executors[worker_id] = ex
+            return ex
+
+    def kill(self, worker_id: int) -> None:
+        with self._lock:
+            self.executors[worker_id].kill()
+
+    def alive_ids(self) -> List[int]:
+        with self._lock:
+            return [wid for wid, ex in self.executors.items() if ex.alive]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self.closed = True
+            for ex in self.executors.values():
+                ex.shutdown()
+
+    def all_metrics(self) -> List[TaskMetrics]:
+        with self._lock:
+            out: List[TaskMetrics] = []
+            for ex in self.executors.values():
+                out.extend(ex.metrics)
+            return out

@@ -1,0 +1,81 @@
+"""Executor liveness monitoring and failure detection.
+
+Parity: ``core/.../HeartbeatReceiver.scala:59`` (driver-side liveness via
+periodic executor heartbeats; silent executors are declared dead and their
+tasks resubmitted) + standalone Master/Worker heartbeats.  Executors here
+touch ``last_heartbeat_ms`` whenever their loop wakes; the monitor thread
+compares against a timeout and notifies the scheduler (``on_executor_lost``),
+which replaces the executor and resubmits in-flight tasks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from asyncframework_tpu.engine.executor import ExecutorPool
+from asyncframework_tpu.utils.clock import Clock, SystemClock
+
+
+class HeartbeatMonitor:
+    def __init__(
+        self,
+        pool: ExecutorPool,
+        on_executor_lost: Callable[[int], None],
+        timeout_ms: float = 5000.0,
+        check_interval_s: float = 0.5,
+        task_timeout_ms: Optional[float] = None,
+        clock: Optional[Clock] = None,
+    ):
+        """``timeout_ms`` applies to *idle* silence (a dead thread).  A worker
+        legitimately goes silent while running a long task (first XLA compile
+        is tens of seconds), so busy executors are only timed out when
+        ``task_timeout_ms`` is set (hung-task detection, off by default --
+        slow tasks are the *straggler* story, handled by cohort selection,
+        not by killing workers)."""
+        self._pool = pool
+        self._on_lost = on_executor_lost
+        self._timeout_ms = timeout_ms
+        self._task_timeout_ms = task_timeout_ms
+        self._interval = check_interval_s
+        self._clock = clock or SystemClock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="heartbeat-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def check_once(self) -> list:
+        """One scan; returns the worker ids declared lost (test-friendly)."""
+        if self._pool.closed:
+            return []
+        now = self._clock.now_ms()
+        lost = []
+        for wid, ex in list(self._pool.executors.items()):
+            if ex.shutdown_requested:
+                continue  # graceful stop, not a failure
+            if not ex.alive:
+                lost.append(wid)
+            elif ex.busy:
+                if (
+                    self._task_timeout_ms is not None
+                    and now - ex.busy_since_ms > self._task_timeout_ms
+                ):
+                    lost.append(wid)
+            elif now - ex.last_heartbeat_ms > self._timeout_ms:
+                lost.append(wid)
+        for wid in lost:
+            self._on_lost(wid)
+        return lost
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.check_once()

@@ -1,0 +1,106 @@
+"""Jobs, tasks, and the completion future.
+
+Parity: ``core/.../scheduler/JobWaiter.scala:30`` -- per-task
+``taskSucceeded(index, result)`` invoking the job's ``resultHandler`` and a
+completion future resolved when all tasks finish; ``ActiveJob`` /
+``ResultTask`` carry (job id, partition/worker id, function).
+
+TPU mapping: a "task" is a host closure that launches a jitted computation on
+one worker's device (plus any injected delay); the "cluster" it runs on is the
+in-process :class:`ExecutorPool`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_job_ids = itertools.count()
+
+
+@dataclass
+class TaskSpec:
+    """One unit of work bound to a logical worker."""
+
+    job_id: int
+    worker_id: int
+    fn: Callable[[], Any]
+    attempt: int = 0
+
+
+class JobWaiter:
+    """Completion future for a job; streams per-task results to a handler.
+
+    ``result_handler(worker_id, result)`` runs on the completing executor's
+    thread (parity: Spark's handler runs on the DAG event loop) -- handlers
+    must therefore be thread-safe; in this framework the canonical handler is
+    ``AsyncContext.merge_result`` which is.
+    """
+
+    def __init__(
+        self,
+        job_id: int,
+        worker_ids: List[int],
+        result_handler: Callable[[int, Any], None],
+    ):
+        self.job_id = job_id
+        self._expected = set(worker_ids)
+        self._finished: set = set()
+        self._failed: Optional[BaseException] = None
+        self._handler = result_handler
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+
+    def task_succeeded(self, worker_id: int, result: Any) -> None:
+        self._handler(worker_id, result)
+        with self._lock:
+            self._finished.add(worker_id)
+            if self._finished >= self._expected:
+                self._done.set()
+
+    def job_failed(self, exc: BaseException) -> None:
+        with self._lock:
+            self._failed = exc
+            self._done.set()
+
+    def await_result(self, timeout: Optional[float] = None) -> None:
+        """Block until every task has merged (mode-0 / first-iteration path).
+
+        Raises the job's failure if any task exhausted its retries.
+        """
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(f"job {self.job_id} did not complete in {timeout}s")
+        if self._failed is not None:
+            raise self._failed
+
+    @property
+    def completed(self) -> bool:
+        return self._done.is_set() and self._failed is None
+
+    @property
+    def failed(self) -> Optional[BaseException]:
+        return self._failed
+
+
+@dataclass
+class Job:
+    """An active job: one task per cohort worker."""
+
+    job_id: int
+    tasks: Dict[int, TaskSpec]
+    waiter: JobWaiter
+
+    @staticmethod
+    def create(
+        worker_fns: Dict[int, Callable[[], Any]],
+        result_handler: Callable[[int, Any], None],
+    ) -> "Job":
+        job_id = next(_job_ids)
+        tasks = {
+            wid: TaskSpec(job_id=job_id, worker_id=wid, fn=fn)
+            for wid, fn in worker_fns.items()
+        }
+        waiter = JobWaiter(job_id, list(worker_fns), result_handler)
+        return Job(job_id, tasks, waiter)

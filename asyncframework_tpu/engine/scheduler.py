@@ -1,0 +1,178 @@
+"""The job scheduler: non-blocking submission is the core ASYNC mechanism.
+
+Parity (the heart of the reference delta):
+- ``DAGScheduler.scala:139-145`` -- ``mode`` (0 sync / 1 async) and
+  ``first_iter`` flags, set from user code via ``SparkContext.set_mode``
+  (``SparkContext.scala:89-101``).
+- ``DAGScheduler.scala:641-663`` -- ``runJob`` blocks on the waiter when
+  ``mode==0 || first_iter``, and returns immediately after submission when
+  ``mode==1``; per-task results flow through the result handler either way.
+- Task retry on failure: ``TaskSetManager`` resubmits a failed task up to
+  ``maxTaskFailures`` then aborts the job.
+
+Design deltas: ``mode`` is per-scheduler state settable per submission (not a
+process-global), and the first-iteration block is an explicit, documented
+warm-up (it is what populates XLA's compile cache here, exactly analogous to
+the reference warming its block/broadcast caches).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from asyncframework_tpu.engine.executor import DeviceExecutor, ExecutorPool
+from asyncframework_tpu.engine.job import Job, JobWaiter, TaskSpec
+from asyncframework_tpu.utils.clock import Clock, SystemClock
+
+SYNC = 0
+ASYNC = 1
+
+
+class JobScheduler:
+    """Submits per-worker tasks to an :class:`ExecutorPool`; owns retry policy.
+
+    One scheduler per training context.  Thread-safe: submissions come from
+    the driver thread; status updates arrive on executor threads.
+    """
+
+    def __init__(
+        self,
+        num_workers: int,
+        devices: Optional[List] = None,
+        max_task_failures: int = 4,
+        clock: Optional[Clock] = None,
+        pool: Optional[ExecutorPool] = None,
+    ):
+        self.num_workers = num_workers
+        self.max_task_failures = max_task_failures
+        self._clock = clock or SystemClock()
+        self._mode = SYNC
+        self._first_iter = True
+        self._lock = threading.Lock()
+        self._active_jobs: Dict[int, Job] = {}
+        # in-flight task registry for resubmission on executor death:
+        # worker_id -> list of TaskSpec currently launched there
+        self._inflight: Dict[int, List[TaskSpec]] = {}
+        self.pool = pool or ExecutorPool(
+            num_workers, self._status_update, devices=devices, clock=self._clock
+        )
+
+    # ------------------------------------------------------------------ mode
+    def set_mode(self, mode: int) -> None:
+        """Parity: ``SparkContext.set_mode`` -> ``dagScheduler.set_mode``."""
+        if mode not in (SYNC, ASYNC):
+            raise ValueError(f"mode must be {SYNC} or {ASYNC}, got {mode}")
+        self._mode = mode
+
+    def get_mode(self) -> int:
+        return self._mode
+
+    # ---------------------------------------------------------------- submit
+    def run_job(
+        self,
+        worker_fns: Dict[int, Callable[[], Any]],
+        result_handler: Callable[[int, Any], None],
+        timeout: Optional[float] = None,
+    ) -> JobWaiter:
+        """Submit one task per cohort worker.
+
+        Blocking iff ``mode==SYNC`` or this is the scheduler's first job
+        (``DAGScheduler.scala:641-663`` semantics).  Returns the waiter either
+        way so sync callers can inspect it and async callers can ignore it.
+        """
+        job = Job.create(worker_fns, result_handler)
+        with self._lock:
+            self._active_jobs[job.job_id] = job
+        for wid, task in job.tasks.items():
+            self._launch(wid, task)
+        block = self._mode == SYNC or self._first_iter
+        self._first_iter = False
+        if block:
+            job.waiter.await_result(timeout=timeout)
+            with self._lock:
+                self._active_jobs.pop(job.job_id, None)
+        return job.waiter
+
+    def _launch(self, worker_id: int, task: TaskSpec) -> None:
+        with self._lock:
+            ex = self.pool.executors[worker_id]
+            if not ex.alive:
+                ex = self.pool.replace(worker_id)
+            self._inflight.setdefault(worker_id, []).append(task)
+        ex.launch_task(task)
+
+    # -------------------------------------------------------- status updates
+    def _status_update(
+        self,
+        executor: DeviceExecutor,
+        task: TaskSpec,
+        result: Any,
+        exc: Optional[BaseException],
+    ) -> None:
+        """Runs on the executor thread (Spark's ``statusUpdate`` path)."""
+        with self._lock:
+            lst = self._inflight.get(task.worker_id, [])
+            if task in lst:
+                lst.remove(task)
+            job = self._active_jobs.get(task.job_id)
+        if job is None:
+            return  # job already finished/aborted (e.g. sync caller gone)
+        if exc is None:
+            job.waiter.task_succeeded(task.worker_id, result)
+            if job.waiter.completed:
+                with self._lock:
+                    self._active_jobs.pop(task.job_id, None)
+        else:
+            self._retry_or_abort(job, task, exc)
+
+    def _retry_or_abort(self, job: Job, task: TaskSpec, exc: BaseException) -> None:
+        if task.attempt + 1 >= self.max_task_failures:
+            job.waiter.job_failed(
+                RuntimeError(
+                    f"task for worker {task.worker_id} in job {job.job_id} failed "
+                    f"{task.attempt + 1} times; aborting job"
+                )
+            )
+            with self._lock:
+                self._active_jobs.pop(job.job_id, None)
+            return
+        retry = TaskSpec(
+            job_id=task.job_id,
+            worker_id=task.worker_id,
+            fn=task.fn,
+            attempt=task.attempt + 1,
+        )
+        self._launch(task.worker_id, retry)
+
+    # ------------------------------------------------------- failure recovery
+    def on_executor_lost(self, worker_id: int) -> None:
+        """Resubmit every in-flight task of a dead worker on a replacement.
+
+        Parity: ``DAGScheduler`` resubmitting tasks on executor loss; invoked
+        by the heartbeat monitor (engine/heartbeat.py).
+        """
+        with self._lock:
+            lost = self._inflight.pop(worker_id, [])
+        self.pool.replace(worker_id)
+        for task in lost:
+            retry = TaskSpec(
+                job_id=task.job_id,
+                worker_id=task.worker_id,
+                fn=task.fn,
+                attempt=task.attempt + 1,
+            )
+            if retry.attempt >= self.max_task_failures:
+                with self._lock:
+                    job = self._active_jobs.pop(task.job_id, None)
+                if job is not None:
+                    job.waiter.job_failed(
+                        RuntimeError(
+                            f"worker {worker_id} lost with task at max attempts"
+                        )
+                    )
+            else:
+                self._launch(worker_id, retry)
+
+    def shutdown(self) -> None:
+        self.pool.shutdown()

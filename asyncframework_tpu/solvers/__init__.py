@@ -1,0 +1,6 @@
+from asyncframework_tpu.solvers.base import SolverConfig, TrainResult
+from asyncframework_tpu.solvers.asgd import ASGD
+from asyncframework_tpu.solvers.asaga import ASAGA
+from asyncframework_tpu.solvers.minibatch_sgd import MiniBatchSGD
+
+__all__ = ["SolverConfig", "TrainResult", "ASGD", "ASAGA", "MiniBatchSGD"]

@@ -1,0 +1,354 @@
+"""ASGD: asynchronous (and synchronous) stochastic gradient descent.
+
+The TPU-native re-design of the reference's flagship drivers:
+
+- async mode ~ ``SparkASGDThread.scala`` -- two driver threads (submitter +
+  updater) around an :class:`AsyncContext`; per-worker gradients stream in and
+  are applied under a staleness bound ``taw``; cohorts are selected by a
+  partial barrier over worker availability; stragglers can be injected after a
+  calibration phase.
+- sync mode ~ ``SparkASGDSync.scala`` -- the same non-blocking submission
+  machinery, but each round drains exactly ``num_workers`` results and applies
+  one accumulated update (the "barrier in the driver").
+
+TPU-first hot path: every array the algorithm touches stays in device HBM.
+Worker tasks are one fused jit (mask + gradient) on the worker's device; the
+updater's accept path is one fused jit (scaled axpy + on-device iteration
+counter); the model and snapshots are immutable device handles (old handle ==
+old model version -- the versioned-broadcast capability with zero copies).
+The host moves only handles and Python ints, so per-update cost is two
+dispatches, not two transfers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncframework_tpu.context import AsyncContext
+from asyncframework_tpu.data.sharded import ShardedDataset
+from asyncframework_tpu.engine.barrier import bucket_predicate, partial_barrier
+from asyncframework_tpu.engine.scheduler import ASYNC, JobScheduler
+from asyncframework_tpu.engine.straggler import DelayModel
+from asyncframework_tpu.ops import steps
+from asyncframework_tpu.solvers.base import (
+    DelayCalibrator,
+    SolverConfig,
+    TrainResult,
+    WaitingTimeTable,
+)
+
+
+class ASGD:
+    def __init__(
+        self,
+        X: np.ndarray,
+        y: np.ndarray,
+        config: SolverConfig,
+        devices: Optional[list] = None,
+    ):
+        self.cfg = config
+        self.devices = list(devices) if devices is not None else jax.devices()
+        self.ds = ShardedDataset(X, y, config.num_workers, self.devices)
+        self.driver_device = self.devices[0]
+        self._step = steps.make_asgd_worker_step(config.batch_rate, config.loss)
+        self._apply = steps.make_asgd_apply(
+            config.gamma, config.batch_rate, self.ds.n, config.num_workers
+        )
+        self._sync_apply = steps.make_sync_apply(
+            config.gamma, config.batch_rate, self.ds.n
+        )
+        self._eval = steps.make_trajectory_loss_eval(config.loss)
+
+    # ------------------------------------------------------------------ async
+    def run(self) -> TrainResult:
+        """Asynchronous mode (SparkASGDThread parity)."""
+        cfg = self.cfg
+        nw = cfg.num_workers
+        ctx: AsyncContext = AsyncContext()
+        sched = JobScheduler(num_workers=nw, devices=self.devices)
+        sched.set_mode(ASYNC)
+        delay_model = DelayModel(cfg.coeff, nw, cfg.seed)
+        calibrator = DelayCalibrator(cfg.effective_calibration_iters())
+        waiting = WaitingTimeTable()
+
+        d = self.ds.d
+        w = jax.device_put(jnp.zeros(d, jnp.float32), self.driver_device)
+        k_dev = jax.device_put(jnp.float32(0.0), self.driver_device)
+        # per-worker device-resident PRNG chains
+        worker_keys: Dict[int, jax.Array] = {
+            wid: jax.device_put(
+                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid),
+                self._shard_device(wid),
+            )
+            for wid in range(nw)
+        }
+        key_lock = threading.Lock()
+
+        state = {
+            "w": w,
+            "k_dev": k_dev,
+            "k": 0,
+            "accepted": 0,
+            "dropped": 0,
+            "rounds": 0,
+        }
+        state_lock = threading.Lock()
+        stop = threading.Event()
+        start_wall = time.monotonic()
+        snapshots: List[Tuple[float, jax.Array]] = [(0.0, w)]
+
+        def now_ms() -> float:
+            return (time.monotonic() - start_wall) * 1e3
+
+        # ---------------------------------------------------- updater thread
+        def updater():
+            while not stop.is_set():
+                with state_lock:
+                    if state["k"] >= cfg.num_iterations:
+                        break
+                try:
+                    res = ctx.collect_all(timeout=cfg.collect_timeout_s)
+                except queue.Empty:
+                    continue
+                g = res.data
+                task_ms = waiting.on_finish(res.worker_id, now_ms())
+                with state_lock:
+                    k = state["k"]
+                    if res.staleness <= cfg.taw:
+                        if g.device != self.driver_device:
+                            g = jax.device_put(g, self.driver_device)
+                        state["w"], state["k_dev"] = self._apply(
+                            state["w"], g, state["k_dev"]
+                        )
+                        state["k"] = k + 1
+                        state["accepted"] += 1
+                        calibrator.record(k, task_ms)
+                        if k % cfg.printer_freq == 0:
+                            snapshots.append((now_ms(), state["w"]))
+                    else:
+                        state["dropped"] += 1
+                if calibrator.maybe_finalize(state["k"]):
+                    delay_model.calibrate(calibrator.avg_delay_ms)
+            stop.set()
+
+        upd = threading.Thread(target=updater, name="ps-updater", daemon=True)
+        upd.start()
+
+        # ---------------------------------------------------- submitter loop
+        from collections import deque
+
+        waiters: deque = deque(maxlen=4 * nw)  # recent jobs, failure check
+        deadline = time.monotonic() + cfg.run_timeout_s
+        try:
+            while not stop.is_set() and time.monotonic() < deadline:
+                failed = next((x.failed for x in waiters if x.failed), None)
+                if failed is not None:
+                    raise RuntimeError("async job aborted") from failed
+                with state_lock:
+                    if state["k"] >= cfg.num_iterations:
+                        break
+                # cold workers (no STAT entry) always selected; warm workers
+                # only when the availability threshold is met (the reference's
+                # wait loop + ASYNCbarrier combination)
+                cohort = partial_barrier(
+                    ctx, nw, bucket_predicate(ctx, nw, cfg.bucket_ratio)
+                )
+                if not cohort:
+                    time.sleep(0.001)
+                    continue
+                with state_lock:
+                    w_pub = state["w"]  # immutable handle = model version
+                ts = ctx.get_current_time()
+                ctx.set_last_time(ts)
+                ctx.mark_busy(cohort)
+                waiting.on_submit(cohort, now_ms())
+                with key_lock:
+                    keys = {wid: worker_keys[wid] for wid in cohort}
+                fns = {
+                    wid: self._make_task(wid, w_pub, keys[wid], delay_model)
+                    for wid in cohort
+                }
+                waiter = sched.run_job(
+                    fns, self._handler(ctx, ts, now_ms, worker_keys, key_lock)
+                )
+                waiters.append(waiter)
+                with state_lock:
+                    state["rounds"] += 1
+        finally:
+            stop.set()
+            upd.join(timeout=10)
+            sched.shutdown()
+
+        elapsed = time.monotonic() - start_wall
+        with state_lock:
+            final_w = np.asarray(state["w"])
+            snapshots.append((elapsed * 1e3, state["w"]))
+        traj = self._evaluate_trajectory(snapshots)
+        return TrainResult(
+            final_w=final_w,
+            trajectory=traj,
+            elapsed_s=elapsed,
+            accepted=state["accepted"],
+            dropped=state["dropped"],
+            rounds=state["rounds"],
+            max_staleness=ctx.max_staleness(),
+            avg_delay_ms=calibrator.avg_delay_ms,
+            updates_per_sec=state["accepted"] / elapsed if elapsed > 0 else 0.0,
+            waiting_time_ms=waiting.snapshot(),
+        )
+
+    # ------------------------------------------------------------------ sync
+    def run_sync(self) -> TrainResult:
+        """SparkASGDSync parity: submit to all, drain all, one update/round."""
+        cfg = self.cfg
+        nw = cfg.num_workers
+        ctx: AsyncContext = AsyncContext()
+        sched = JobScheduler(num_workers=nw, devices=self.devices)
+        sched.set_mode(ASYNC)  # non-blocking submit + driver-side drain
+        delay_model = DelayModel(cfg.coeff, nw, cfg.seed)
+        calibrator = DelayCalibrator(100)  # sync calibrates over first 100 rounds
+        waiting = WaitingTimeTable()
+
+        w = jax.device_put(jnp.zeros(self.ds.d, jnp.float32), self.driver_device)
+        k_dev = jax.device_put(jnp.float32(0.0), self.driver_device)
+        worker_keys = {
+            wid: jax.device_put(
+                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid),
+                self._shard_device(wid),
+            )
+            for wid in range(nw)
+        }
+        start_wall = time.monotonic()
+        snapshots: List[Tuple[float, jax.Array]] = [(0.0, w)]
+
+        def now_ms():
+            return (time.monotonic() - start_wall) * 1e3
+
+        rounds = 0
+        try:
+            for k in range(cfg.num_iterations):
+                cohort = list(range(nw))
+                ts = ctx.get_current_time()
+                ctx.mark_busy(cohort)
+                waiting.on_submit(cohort, now_ms())
+                key_lock = threading.Lock()
+                fns = {
+                    wid: self._make_task(wid, w, worker_keys[wid], delay_model)
+                    for wid in cohort
+                }
+                waiter = sched.run_job(
+                    fns, self._handler(ctx, ts, now_ms, worker_keys, key_lock)
+                )
+                acc = None
+                for _ in range(nw):
+                    res = self._collect_checked(ctx, waiter, cfg.run_timeout_s)
+                    g = res.data
+                    task_ms = waiting.on_finish(res.worker_id, now_ms())
+                    calibrator.record(k, task_ms)
+                    if g.device != self.driver_device:
+                        g = jax.device_put(g, self.driver_device)
+                    acc = g if acc is None else steps.add_grads(acc, g)
+                w, k_dev = self._sync_apply(w, acc, k_dev)
+                rounds += 1
+                if k % cfg.printer_freq == 0:
+                    snapshots.append((now_ms(), w))
+                if calibrator.maybe_finalize(k):
+                    delay_model.calibrate(calibrator.avg_delay_ms)
+        finally:
+            sched.shutdown()
+
+        elapsed = time.monotonic() - start_wall
+        snapshots.append((elapsed * 1e3, w))
+        traj = self._evaluate_trajectory(snapshots)
+        return TrainResult(
+            final_w=np.asarray(w),
+            trajectory=traj,
+            elapsed_s=elapsed,
+            accepted=rounds * nw,
+            rounds=rounds,
+            max_staleness=ctx.max_staleness(),
+            avg_delay_ms=calibrator.avg_delay_ms,
+            updates_per_sec=rounds / elapsed if elapsed > 0 else 0.0,
+            waiting_time_ms=waiting.snapshot(),
+        )
+
+    # ---------------------------------------------------------------- helpers
+    @staticmethod
+    def _collect_checked(ctx: AsyncContext, waiter, timeout_s: float):
+        """Blocking collect that surfaces a job abort instead of hanging."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if waiter.failed is not None:
+                raise RuntimeError("job aborted during drain") from waiter.failed
+            try:
+                return ctx.collect_all(timeout=0.1)
+            except queue.Empty:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("sync drain timed out")
+
+    def _shard_device(self, wid: int):
+        return self.devices[wid % len(self.devices)]
+
+    def _make_task(self, wid: int, w_pub, key, delay_model: DelayModel):
+        shard = self.ds.shard(wid)
+        delay_ms = delay_model.delay_ms(wid)
+        dev = self._shard_device(wid)
+        step = self._step
+
+        def fn():
+            if delay_ms > 0:
+                time.sleep(delay_ms / 1e3)
+            w_local = w_pub
+            if w_local.device != dev:
+                w_local = jax.device_put(w_local, dev)
+            g, new_key = step(shard.X, shard.y, w_local, key)
+            g.block_until_ready()  # completion only; data stays in HBM
+            return g, new_key
+
+        return fn
+
+    def _handler(
+        self, ctx: AsyncContext, submit_clock: int, now_ms, worker_keys, key_lock
+    ):
+        submit_wall = now_ms()
+        par_recs = int(self.cfg.batch_rate * self.ds.n / self.cfg.num_workers)
+
+        def handler(wid: int, result):
+            g, new_key = result
+            # The key slot MUST advance before merge_result flips the worker
+            # available -- otherwise the spinning submitter can re-dispatch
+            # this worker with its previous key and replay the same mask.
+            with key_lock:
+                worker_keys[wid] = new_key
+            ctx.merge_result(
+                wid,
+                g,
+                submit_clock=submit_clock,
+                elapsed_ms=now_ms() - submit_wall,
+                batch_size=par_recs,
+            )
+
+        return handler
+
+    def _evaluate_trajectory(
+        self, snapshots: List[Tuple[float, jax.Array]]
+    ) -> List[Tuple[float, float]]:
+        """One-pass objective evaluation for all snapshots (optVars parity):
+        stack snapshots into (S, d); per shard one matmul gives (S,) losses."""
+        W = jnp.stack([h for (_t, h) in snapshots])
+        totals = np.zeros(len(snapshots), np.float64)
+        for wid in range(self.cfg.num_workers):
+            shard = self.ds.shard(wid)
+            Wd = W
+            if Wd.device != self._shard_device(wid):
+                Wd = jax.device_put(W, self._shard_device(wid))
+            totals += np.asarray(self._eval(shard.X, shard.y, Wd), np.float64)
+        totals /= self.ds.n
+        return [(t, float(l)) for (t, _), l in zip(snapshots, totals)]

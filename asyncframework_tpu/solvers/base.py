@@ -1,0 +1,136 @@
+"""Common solver configuration and result types.
+
+``SolverConfig`` carries the reference drivers' 13 positional knobs
+(``SparkASGDThread.scala:28-48``: path/file/d/N are data-loading concerns
+handled by the data layer; the remaining 9 algorithmic knobs appear here
+under their long names) plus TPU-build extensions (loss kind, device update
+mode, calibration override).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class SolverConfig:
+    num_workers: int = 8          # [num partitions]
+    num_iterations: int = 1000    # [num iterations] (accepted updates / rounds)
+    gamma: float = 0.1            # [step size]
+    taw: int = 2**31 - 1          # [taw] staleness bound
+    batch_rate: float = 0.1       # [batch rate] Bernoulli b
+    bucket_ratio: float = 0.5     # [bucket ratio] cohort threshold
+    printer_freq: int = 100       # [printer freq] trajectory snapshot period
+    coeff: float = 0.0            # [coeff] delay intensity; -1 = cloud mode
+    seed: int = 42                # [seed]
+    loss: str = "least_squares"
+    # TPU-build extensions
+    calibration_iters: Optional[int] = None  # default 100 * num_workers
+    collect_timeout_s: float = 0.05
+    run_timeout_s: float = 600.0
+
+    def effective_calibration_iters(self) -> int:
+        if self.calibration_iters is not None:
+            return self.calibration_iters
+        return 100 * self.num_workers
+
+    @property
+    def bucket_threshold(self) -> int:
+        return math.floor(self.num_workers * self.bucket_ratio)
+
+
+@dataclass
+class TrainResult:
+    """What a driver run produces (the reference prints these; we return them).
+
+    ``trajectory`` is the optVars analog evaluated post-hoc in one pass:
+    ``(wall_ms_since_start, objective)`` where objective is the mean loss over
+    the full dataset.
+    """
+
+    final_w: np.ndarray
+    trajectory: List[Tuple[float, float]]
+    elapsed_s: float
+    accepted: int = 0
+    dropped: int = 0
+    rounds: int = 0
+    max_staleness: int = 0
+    avg_delay_ms: float = 0.0
+    updates_per_sec: float = 0.0
+    waiting_time_ms: Dict[int, float] = field(default_factory=dict)
+    extras: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def final_objective(self) -> float:
+        return self.trajectory[-1][1] if self.trajectory else float("nan")
+
+
+class WaitingTimeTable:
+    """Per-worker idle-gap bookkeeping.
+
+    Parity: ``WaitingTime`` / ``SubmitJobTime`` / ``FinishTimeTable``
+    (``SparkASGDThread.scala:112-115,328-335``): at submit, a worker's waiting
+    time grows by (submit wall time - its last finish wall time).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submit_ms: Dict[int, float] = {}
+        self.finish_ms: Dict[int, float] = {}
+        self.waiting_ms: Dict[int, float] = {}
+
+    def on_submit(self, worker_ids, now_ms: float) -> None:
+        with self._lock:
+            for wid in worker_ids:
+                gap = now_ms - self.finish_ms.get(wid, now_ms)
+                self.waiting_ms[wid] = self.waiting_ms.get(wid, 0.0) + gap
+                self.submit_ms[wid] = now_ms
+
+    def on_finish(self, worker_id: int, now_ms: float) -> float:
+        """Record finish; returns (finish - submit) for delay calibration."""
+        with self._lock:
+            dt = now_ms - self.submit_ms.get(worker_id, now_ms)
+            self.finish_ms[worker_id] = now_ms
+            return dt
+
+    def snapshot(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self.waiting_ms)
+
+
+class DelayCalibrator:
+    """Average-delay measurement over the warm-up phase.
+
+    Parity: ``culTime``/``culCount`` accumulation while ``k < 100*numPart``
+    and the one-shot ``avgDelay = culTime/culCount``
+    (``SparkASGDThread.scala:174-183,244-249``).
+    """
+
+    def __init__(self, calibration_iters: int):
+        self._iters = calibration_iters
+        self._cul_time = 0.0
+        self._cul_count = 0
+        self._lock = threading.Lock()
+        self.avg_delay_ms = 0.0
+        self.calibrated = False
+
+    def record(self, k: int, task_ms: float) -> None:
+        with self._lock:
+            if k < self._iters:
+                self._cul_time += task_ms
+                self._cul_count += 1
+
+    def maybe_finalize(self, k: int) -> bool:
+        """Returns True the single time calibration completes."""
+        with self._lock:
+            if not self.calibrated and k > self._iters and self._cul_count > 0:
+                self.avg_delay_ms = self._cul_time / self._cul_count
+                self.calibrated = True
+                return True
+            return False

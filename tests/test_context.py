@@ -64,12 +64,12 @@ def test_merge_result_staleness_and_clock():
     assert s0.available and s1.available
     assert s0.num_tasks == 1
     assert s0.average_task_time == pytest.approx(10.0)
-    # second task for worker 0: avg = elapsed/(num_tasks+1)
+    # second task for worker 0: running mean of task latencies
     ac.mark_busy([0])
     assert not ac.get_state(0).available
     ac.merge_result(0, "g0b", submit_clock=2, elapsed_ms=30.0, batch_size=4)
     assert ac.get_state(0).num_tasks == 2
-    assert ac.get_state(0).average_task_time == pytest.approx(15.0)
+    assert ac.get_state(0).average_task_time == pytest.approx(20.0)
 
 
 def test_availability_aggregates():

@@ -1,0 +1,126 @@
+"""Data layer + versioned model store tests."""
+
+import io
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.broadcast import VersionedModelStore
+from asyncframework_tpu.data import (
+    ShardedDataset,
+    load_libsvm,
+    make_classification,
+    make_regression,
+    parse_libsvm_lines,
+)
+
+LIBSVM_FIXTURE = """\
+1.0 1:0.5 3:1.5
+-1.0 2:2.0
+0.5 1:1.0 2:-1.0 3:0.25
+"""
+
+
+class TestLibSVM:
+    def test_parse_lines(self):
+        X, y = parse_libsvm_lines(io.StringIO(LIBSVM_FIXTURE))
+        np.testing.assert_allclose(y, [1.0, -1.0, 0.5])
+        expected = np.array(
+            [[0.5, 0.0, 1.5], [0.0, 2.0, 0.0], [1.0, -1.0, 0.25]], np.float32
+        )
+        np.testing.assert_allclose(X, expected)
+
+    def test_parse_with_fixed_num_features(self):
+        X, _ = parse_libsvm_lines(io.StringIO(LIBSVM_FIXTURE), num_features=5)
+        assert X.shape == (3, 5)
+
+    def test_load_file(self, tmp_path):
+        p = tmp_path / "tiny.libsvm"
+        p.write_text(LIBSVM_FIXTURE)
+        X, y = load_libsvm(str(p), num_features=3, use_native=False)
+        assert X.shape == (3, 3) and y.shape == (3,)
+
+    def test_blank_lines_and_comments_skipped(self):
+        X, y = parse_libsvm_lines(io.StringIO("\n# c\n1.0 1:2.0\n"))
+        assert X.shape == (1, 1) and y[0] == 1.0
+
+
+class TestSynthetic:
+    def test_regression_shapes_and_determinism(self):
+        X1, y1, w1 = make_regression(100, 8, seed=7)
+        X2, y2, w2 = make_regression(100, 8, seed=7)
+        np.testing.assert_array_equal(X1, X2)
+        np.testing.assert_array_equal(y1, y2)
+        assert X1.shape == (100, 8) and y1.shape == (100,) and w1.shape == (8,)
+
+    def test_classification_labels_binary(self):
+        _, y, _ = make_classification(200, 4)
+        assert set(np.unique(y)) <= {0.0, 1.0}
+
+
+class TestShardedDataset:
+    def test_balanced_partitioning_and_cum(self, devices8):
+        X, y, _ = make_regression(103, 4)
+        ds = ShardedDataset(X, y, num_workers=8, devices=devices8)
+        sizes = ds.partition_sizes()
+        assert sum(sizes.values()) == 103
+        assert max(sizes.values()) - min(sizes.values()) <= 1
+        # partitionCumList parity: cum[p] is the global index of shard p row 0
+        assert ds.partition_cum[0] == 0 and ds.partition_cum[-1] == 103
+        for w in range(8):
+            assert ds.shard(w).start == ds.partition_cum[w]
+            assert ds.shard(w).size == sizes[w]
+
+    def test_shard_content_matches_rows(self, devices8):
+        X, y, _ = make_regression(64, 4)
+        ds = ShardedDataset(X, y, num_workers=8, devices=devices8)
+        s = ds.shard(3)
+        np.testing.assert_allclose(np.asarray(s.X), X[s.start : s.start + s.size])
+        np.testing.assert_allclose(np.asarray(s.y), y[s.start : s.start + s.size])
+
+    def test_shards_land_on_distinct_devices(self, devices8):
+        X, y, _ = make_regression(64, 4)
+        ds = ShardedDataset(X, y, num_workers=8, devices=devices8)
+        placed = {list(ds.shard(w).X.devices())[0] for w in range(8)}
+        assert len(placed) == 8
+
+    def test_validation_errors(self, devices8):
+        X, y, _ = make_regression(10, 2)
+        with pytest.raises(ValueError, match="rows"):
+            ShardedDataset(X, y[:5], 2, devices=devices8)
+        with pytest.raises(ValueError, match="num_workers"):
+            ShardedDataset(X, y, 11, devices=devices8)
+
+
+class TestVersionedModelStore:
+    def test_publish_snapshot_isolation(self):
+        store = VersionedModelStore()
+        w = np.zeros(4, np.float32)
+        v0 = store.publish(w)
+        w += 1.0  # updater keeps mutating its host w
+        np.testing.assert_allclose(store.value(version=v0), np.zeros(4))
+
+    def test_stale_read_and_eviction(self):
+        store = VersionedModelStore(max_live_versions=2)
+        versions = [store.publish(np.full(2, float(i))) for i in range(4)]
+        assert store.live_versions() == versions[2:]
+        np.testing.assert_allclose(store.value(version=versions[2]), [2.0, 2.0])
+        with pytest.raises(KeyError):
+            store.value(version=versions[0])  # evicted
+        assert store.latest_version() == versions[3]
+
+    def test_device_fanout_and_lazy_read(self, devices8):
+        store = VersionedModelStore()
+        w = np.arange(4, dtype=np.float32)
+        store.publish(w, devices=devices8[:2])
+        buf = store.value(device=devices8[1])
+        assert list(buf.devices())[0] == devices8[1]
+        np.testing.assert_allclose(np.asarray(buf), w)
+        lazy = store.value(device=devices8[5])  # not in publish set
+        assert list(lazy.devices())[0] == devices8[5]
+        np.testing.assert_allclose(np.asarray(lazy), w)
+
+    def test_empty_store_raises(self):
+        store = VersionedModelStore()
+        with pytest.raises(KeyError):
+            store.latest_version()

@@ -1,0 +1,249 @@
+"""Engine tests: scheduler modes, retries, executor loss, barrier, stragglers.
+
+Modeled on the reference's scheduler test strategy (SURVEY.md section 4):
+pure-logic tests driving the scheduler with fake task closures -- no devices,
+no XLA -- plus failure-injection paths (DAGSchedulerSuite / DistributedSuite
+analogs, in-process).
+"""
+
+import threading
+import time
+
+import pytest
+
+from asyncframework_tpu.context import AsyncContext
+from asyncframework_tpu.engine import (
+    DelayModel,
+    JobScheduler,
+    build_cloud_stragglers,
+    partial_barrier,
+)
+from asyncframework_tpu.engine.barrier import bucket_predicate
+from asyncframework_tpu.engine.heartbeat import HeartbeatMonitor
+from asyncframework_tpu.engine.scheduler import ASYNC, SYNC
+
+
+def collector():
+    results = []
+    lock = threading.Lock()
+
+    def handler(wid, res):
+        with lock:
+            results.append((wid, res))
+
+    return results, handler
+
+
+class TestSchedulerModes:
+    def test_sync_mode_blocks_until_all_results(self):
+        sched = JobScheduler(num_workers=4)
+        try:
+            results, handler = collector()
+            sched.set_mode(SYNC)
+            waiter = sched.run_job({w: (lambda w=w: w * 10) for w in range(4)}, handler)
+            # sync: on return, everything has merged
+            assert waiter.completed
+            assert sorted(results) == [(0, 0), (1, 10), (2, 20), (3, 30)]
+        finally:
+            sched.shutdown()
+
+    def test_async_mode_returns_immediately(self):
+        sched = JobScheduler(num_workers=2)
+        try:
+            results, handler = collector()
+            gate = threading.Event()
+
+            def slow(w):
+                gate.wait(5)
+                return w
+
+            sched.set_mode(ASYNC)
+            # first job always blocks (warm-up parity) -- use a fast one
+            sched.run_job({0: lambda: 0, 1: lambda: 1}, handler)
+            results.clear()
+            t0 = time.monotonic()
+            waiter = sched.run_job({w: (lambda w=w: slow(w)) for w in range(2)}, handler)
+            submit_elapsed = time.monotonic() - t0
+            assert submit_elapsed < 1.0  # returned before tasks finished
+            assert not waiter.completed
+            gate.set()
+            waiter.await_result(timeout=5)
+            assert sorted(results) == [(0, 0), (1, 1)]
+        finally:
+            sched.shutdown()
+
+    def test_first_iteration_blocks_even_in_async_mode(self):
+        sched = JobScheduler(num_workers=2)
+        try:
+            results, handler = collector()
+            sched.set_mode(ASYNC)
+            waiter = sched.run_job({0: lambda: "a", 1: lambda: "b"}, handler)
+            # DAGScheduler.scala:641-663 -- first iteration always blocks
+            assert waiter.completed
+            assert len(results) == 2
+        finally:
+            sched.shutdown()
+
+    def test_results_stream_per_worker_not_at_barrier(self):
+        """Per-partition streaming: a fast worker's result is merged while a
+        slow worker is still running (the whole point of ASYNCreduce)."""
+        sched = JobScheduler(num_workers=2)
+        try:
+            results, handler = collector()
+            sched.run_job({0: lambda: 0, 1: lambda: 1}, handler)  # warm-up
+            results.clear()
+            slow_gate = threading.Event()
+            sched.set_mode(ASYNC)
+            waiter = sched.run_job(
+                {0: lambda: "fast", 1: lambda: (slow_gate.wait(5), "slow")[1]}, handler
+            )
+            deadline = time.monotonic() + 5
+            while not results and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert results == [(0, "fast")]  # fast merged, slow still out
+            slow_gate.set()
+            waiter.await_result(timeout=5)
+            assert sorted(results) == [(0, "fast"), (1, "slow")]
+        finally:
+            sched.shutdown()
+
+
+class TestRetryAndFailure:
+    def test_flaky_task_retried_until_success(self):
+        sched = JobScheduler(num_workers=1, max_task_failures=4)
+        try:
+            results, handler = collector()
+            attempts = {"n": 0}
+
+            def flaky():
+                attempts["n"] += 1
+                if attempts["n"] < 3:
+                    raise RuntimeError("transient")
+                return "ok"
+
+            waiter = sched.run_job({0: flaky}, handler)  # sync first iter
+            assert waiter.completed
+            assert attempts["n"] == 3
+            assert results == [(0, "ok")]
+        finally:
+            sched.shutdown()
+
+    def test_permanent_failure_aborts_job(self):
+        sched = JobScheduler(num_workers=1, max_task_failures=3)
+        try:
+            def always_fail():
+                raise ValueError("boom")
+
+            with pytest.raises(RuntimeError, match="failed 3 times"):
+                sched.run_job({0: always_fail}, lambda w, r: None)
+        finally:
+            sched.shutdown()
+
+    def test_executor_loss_resubmits_inflight_tasks(self):
+        """DistributedSuite analog: kill a worker mid-task; the monitor
+        declares it lost, the scheduler replaces it and the job completes."""
+        sched = JobScheduler(num_workers=2)
+        try:
+            results, handler = collector()
+            sched.run_job({0: lambda: 0, 1: lambda: 1}, handler)  # warm-up
+            results.clear()
+            sched.set_mode(ASYNC)
+            release = threading.Event()
+            ran_on = []
+
+            def task0():
+                ran_on.append(threading.current_thread().name)
+                if not release.is_set():
+                    # first attempt hangs until killed; retry returns fast
+                    time.sleep(30)
+                return "recovered"
+
+            waiter = sched.run_job({0: task0, 1: lambda: "fine"}, handler)
+            monitor = HeartbeatMonitor(
+                sched.pool, sched.on_executor_lost, timeout_ms=1e9
+            )
+            deadline = time.monotonic() + 5
+            while len(ran_on) < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            release.set()
+            sched.pool.kill(0)  # worker dies mid-task
+            lost = monitor.check_once()
+            assert 0 in lost
+            waiter.await_result(timeout=10)
+            assert ("1", "fine") not in results  # sanity: tuple shape is (wid, res)
+            assert sorted(results) == [(0, "recovered"), (1, "fine")]
+            assert len(ran_on) == 2  # original + resubmitted attempt
+        finally:
+            sched.shutdown()
+
+    def test_heartbeat_busy_executor_not_declared_dead(self):
+        sched = JobScheduler(num_workers=1)
+        try:
+            gate = threading.Event()
+            sched.run_job({0: lambda: "warm"}, lambda w, r: None)
+            sched.set_mode(ASYNC)
+            sched.run_job({0: lambda: gate.wait(5) or "x"}, lambda w, r: None)
+            time.sleep(0.1)  # let the executor pick the task up
+            monitor = HeartbeatMonitor(
+                sched.pool, sched.on_executor_lost, timeout_ms=0.0
+            )
+            assert monitor.check_once() == []  # busy != dead despite 0 timeout
+            gate.set()
+        finally:
+            sched.shutdown()
+
+
+class TestBarrier:
+    def test_unseen_workers_always_selected(self):
+        ctx = AsyncContext()
+        cohort = partial_barrier(ctx, 4, lambda ws: False)
+        assert cohort == [0, 1, 2, 3]
+
+    def test_busy_workers_excluded(self):
+        ctx = AsyncContext()
+        for w in range(4):
+            ctx.merge_result(w, None, 0, 1.0, 1)  # all available
+        ctx.mark_busy([1, 3])
+        cohort = partial_barrier(ctx, 4, lambda ws: True)
+        assert cohort == [0, 2]
+
+    def test_bucket_predicate_thresholds(self):
+        ctx = AsyncContext()
+        for w in range(4):
+            ctx.merge_result(w, None, 0, 1.0, 1)
+        ctx.mark_busy([0, 1, 2])  # 1 of 4 available
+        pred = bucket_predicate(ctx, 4, bucket_ratio=0.5)  # needs >= 2
+        assert partial_barrier(ctx, 4, pred) == []
+        ctx.mark_available(0)  # 2 of 4 available
+        assert partial_barrier(ctx, 4, pred) == [0, 3]
+
+
+class TestStraggler:
+    def test_cloud_cohort_reference_pattern(self):
+        # numPart=32: length=8, normal=6, longtail=2 -> ids c*4
+        normal, long_tail = build_cloud_stragglers(32)
+        assert long_tail == [0, 4]
+        assert normal == [8, 12, 16, 20, 24, 28]
+
+    def test_no_delay_before_calibration(self):
+        m = DelayModel(coeff=1.0, num_workers=8)
+        assert m.delay_ms(0) == 0.0
+        m.calibrate(100.0)
+        assert m.delay_ms(0) == 100.0
+        assert m.delay_ms(1) == 0.0
+
+    def test_cloud_mode_delay_ranges(self):
+        m = DelayModel(coeff=-1, num_workers=32, seed=1)
+        m.calibrate(100.0)
+        for _ in range(20):
+            lt = m.delay_ms(0)  # long-tail worker
+            assert lt == 0 or 250 <= lt <= 1000
+            nm = m.delay_ms(8)  # normal straggler
+            assert nm == 0 or 150 <= nm <= 250
+        assert m.delay_ms(3) == 0.0  # non-straggler
+
+    def test_disabled_model(self):
+        m = DelayModel(coeff=0.0, num_workers=8)
+        m.calibrate(100.0)
+        assert not m.enabled
+        assert m.delay_ms(0) == 0.0

@@ -1,0 +1,241 @@
+"""Solver tests on the 8-device virtual CPU mesh.
+
+Parity with the reference's algorithm test strategy
+(``GradientDescentSuite``): loss decreases, exact semantics of the update
+rules, plus async-specific properties (staleness bounds, history-table
+consistency, straggler injection effects).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from asyncframework_tpu.data import make_classification, make_regression
+from asyncframework_tpu.parallel import make_mesh
+from asyncframework_tpu.solvers import ASAGA, ASGD, MiniBatchSGD, SolverConfig
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return make_regression(2048, 32, seed=3)
+
+
+def small_cfg(**kw):
+    defaults = dict(
+        num_workers=8,
+        num_iterations=300,
+        gamma=1.0,
+        taw=2**31 - 1,
+        batch_rate=0.3,
+        bucket_ratio=0.5,
+        printer_freq=50,
+        coeff=0.0,
+        seed=42,
+        calibration_iters=10,
+        run_timeout_s=120.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+class TestASGDAsync:
+    def test_converges_and_bookkeeps(self, devices8, problem):
+        X, y, _ = problem
+        res = ASGD(X, y, small_cfg(), devices=devices8).run()
+        first, last = res.trajectory[0][1], res.trajectory[-1][1]
+        # threshold is loose: async trajectories vary with thread timing
+        assert last < first * 0.5, res.trajectory
+        assert res.accepted == 300
+        assert res.rounds > 0
+        assert res.updates_per_sec > 0
+        # trajectory times monotonically nondecreasing
+        times = [t for t, _ in res.trajectory]
+        assert all(b >= a for a, b in zip(times, times[1:]))
+
+    def test_taw_zero_drops_stale(self, devices8, problem):
+        X, y, _ = problem
+        res = ASGD(X, y, small_cfg(num_iterations=100, taw=0), devices=devices8).run()
+        # with 8 concurrent workers and tau=0, some results must be stale
+        assert res.accepted == 100
+        assert res.dropped > 0
+
+    def test_infinite_taw_drops_nothing(self, devices8, problem):
+        X, y, _ = problem
+        res = ASGD(X, y, small_cfg(num_iterations=100), devices=devices8).run()
+        assert res.dropped == 0
+
+    def test_logistic_loss_mode(self, devices8):
+        X, y, _ = make_classification(2048, 16, seed=5)
+        res = ASGD(
+            X, y, small_cfg(loss="logistic", gamma=2.0, num_iterations=200),
+            devices=devices8,
+        ).run()
+        assert res.trajectory[-1][1] < res.trajectory[0][1]
+
+    def test_failing_worker_aborts_run(self, devices8, problem):
+        """A deterministically-failing task must surface as an error, not a
+        silent stall until run_timeout (job-abort propagation)."""
+        X, y, _ = problem
+        solver = ASGD(
+            X, y, small_cfg(num_iterations=500, run_timeout_s=30), devices=devices8
+        )
+        calls = {"n": 0}
+        orig = solver._step
+
+        def flaky_step(Xs, ys, w, key):
+            calls["n"] += 1
+            if calls["n"] > 20:
+                raise RuntimeError("injected device failure")
+            return orig(Xs, ys, w, key)
+
+        solver._step = flaky_step
+        with pytest.raises(RuntimeError):
+            solver.run()
+
+    def test_straggler_injection_slows_worker0(self, devices8, problem):
+        X, y, _ = problem
+        cfg = small_cfg(
+            num_iterations=200, coeff=3.0, calibration_iters=40, printer_freq=1000
+        )
+        res = ASGD(X, y, cfg, devices=devices8).run()
+        assert res.avg_delay_ms > 0  # calibration happened
+        assert res.accepted == 200
+
+
+class TestASGDSync:
+    def test_sync_converges(self, devices8, problem):
+        X, y, _ = problem
+        res = ASGD(
+            X, y, small_cfg(num_iterations=60, gamma=2.0), devices=devices8
+        ).run_sync()
+        assert res.rounds == 60
+        assert res.trajectory[-1][1] < res.trajectory[0][1] * 0.2
+        assert res.max_staleness <= 8  # full drain keeps staleness ~= nw
+
+    def test_sync_deterministic(self, devices8, problem):
+        X, y, _ = problem
+        cfg = small_cfg(num_iterations=20, gamma=1.0, coeff=0.0)
+        r1 = ASGD(X, y, cfg, devices=devices8).run_sync()
+        r2 = ASGD(X, y, cfg, devices=devices8).run_sync()
+        np.testing.assert_allclose(r1.final_w, r2.final_w, rtol=1e-5)
+
+
+class TestASAGA:
+    def test_async_converges(self, devices8, problem):
+        X, y, _ = problem
+        cfg = small_cfg(num_iterations=800, gamma=0.02, batch_rate=0.2)
+        res = ASAGA(X, y, cfg, devices=devices8).run()
+        assert res.accepted == 800
+        assert res.trajectory[-1][1] < res.trajectory[0][1] * 0.3
+
+    def test_sync_converges(self, devices8, problem):
+        X, y, _ = problem
+        cfg = small_cfg(num_iterations=60, gamma=0.5)
+        res = ASAGA(X, y, cfg, devices=devices8).run_sync()
+        assert res.rounds == 60
+        assert res.trajectory[-1][1] < res.trajectory[0][1] * 0.5
+
+    def test_rejects_non_least_squares(self, problem):
+        X, y, _ = problem
+        with pytest.raises(ValueError, match="least_squares"):
+            ASAGA(X, y, small_cfg(loss="logistic"))
+
+    def test_alpha_bar_tracks_table_mean_exactly(self, devices8, problem):
+        """The invariant our commit protocol guarantees (and the reference's
+        does not, under dispatch overlap): alpha_bar == (1/N) sum_i
+        alpha_i * x_i at all times -- checked after a heavily-overlapped run."""
+        X, y, _ = problem
+        res = ASAGA(
+            X, y, small_cfg(num_iterations=500, gamma=0.02, batch_rate=0.2,
+                            bucket_ratio=0.25),
+            devices=devices8,
+        ).run()
+        n = X.shape[0]
+        expected = np.zeros(X.shape[1], np.float64)
+        for wid, alpha_slice in res.extras["alpha"].items():
+            lo = wid * (n // 8)
+            Xp = X[lo : lo + alpha_slice.shape[0]]
+            expected += Xp.T.astype(np.float64) @ alpha_slice.astype(np.float64)
+        expected /= n
+        np.testing.assert_allclose(
+            res.extras["alpha_bar"], expected, rtol=1e-3, atol=1e-4
+        )
+
+
+class TestMiniBatchSGD:
+    def test_full_batch_matches_exact_gd(self, devices8, problem):
+        X, y, _ = problem
+        mesh = make_mesh(8, devices=devices8)
+        sgd = MiniBatchSGD(gamma=2.0, batch_rate=1.0, num_iterations=5, seed=0)
+        w, losses, snaps = sgd.run(X, y, mesh=mesh)
+        # replicate by hand: full-batch GD with lr = gamma/sqrt(i+1)/n
+        n = X.shape[0]
+        wr = np.zeros(X.shape[1], np.float32)
+        for i in range(5):
+            g = X.T @ (X @ wr - y)
+            wr = wr - 2.0 / np.sqrt(i + 1.0) * g / n
+        np.testing.assert_allclose(w, wr, rtol=2e-3, atol=2e-4)
+
+    def test_loss_history_decreasing(self, devices8, problem):
+        X, y, _ = problem
+        mesh = make_mesh(8, devices=devices8)
+        sgd = MiniBatchSGD(gamma=1.0, batch_rate=0.5, num_iterations=40)
+        _, losses, _ = sgd.run(X, y, mesh=mesh)
+        assert losses[-1] < losses[0]
+        assert len(losses) == 40
+
+    def test_padding_rows_do_not_change_result(self, devices8):
+        # n=1000 not divisible by 8 -> 24 pad rows; count must exclude them
+        X, y, _ = make_regression(1000, 8, seed=9)
+        mesh = make_mesh(8, devices=devices8)
+        sgd = MiniBatchSGD(gamma=1.0, batch_rate=1.0, num_iterations=3, seed=1)
+        w, _, _ = sgd.run(X, y, mesh=mesh)
+        n = X.shape[0]
+        wr = np.zeros(8, np.float32)
+        for i in range(3):
+            g = X.T @ (X @ wr - y)
+            wr = wr - 1.0 / np.sqrt(i + 1.0) * g / n
+        np.testing.assert_allclose(w, wr, rtol=2e-3, atol=2e-4)
+
+    def test_l2_updater(self, devices8, problem):
+        X, y, _ = problem
+        mesh = make_mesh(8, devices=devices8)
+        sgd = MiniBatchSGD(
+            gamma=1.0, batch_rate=1.0, num_iterations=10, updater="l2",
+            reg_param=0.1,
+        )
+        w, losses, _ = sgd.run(X, y, mesh=mesh)
+        # L2 shrinks weights vs simple
+        w_simple, _, _ = MiniBatchSGD(
+            gamma=1.0, batch_rate=1.0, num_iterations=10
+        ).run(X, y, mesh=mesh)
+        assert np.linalg.norm(w) < np.linalg.norm(w_simple)
+
+    def test_l1_updater_sparsifies(self, devices8, problem):
+        X, y, _ = problem
+        mesh = make_mesh(8, devices=devices8)
+        w, _, _ = MiniBatchSGD(
+            gamma=1.0, batch_rate=1.0, num_iterations=20, updater="l1",
+            reg_param=0.5,
+        ).run(X, y, mesh=mesh)
+        assert np.mean(np.abs(w) < 1e-6) > 0.1  # some exact zeros
+
+    def test_snapshots_warray_parity(self, devices8, problem):
+        X, y, _ = problem
+        mesh = make_mesh(8, devices=devices8)
+        sgd = MiniBatchSGD(
+            gamma=1.0, batch_rate=0.5, num_iterations=25, snapshot_every=10
+        )
+        _, _, snaps = sgd.run(X, y, mesh=mesh)
+        assert [s[0] for s in snaps] == [0, 10, 20]
+
+    def test_convergence_tol_stops_early(self, devices8, problem):
+        X, y, _ = problem
+        mesh = make_mesh(8, devices=devices8)
+        sgd = MiniBatchSGD(
+            gamma=0.01, batch_rate=1.0, num_iterations=100, convergence_tol=0.5
+        )
+        _, losses, _ = sgd.run(X, y, mesh=mesh)
+        assert len(losses) < 100
