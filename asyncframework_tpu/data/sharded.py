@@ -27,6 +27,16 @@ import jax
 import numpy as np
 
 
+def balanced_sizes(n: int, num_workers: int) -> List[int]:
+    """Contiguous balanced split, sizes differ by <=1 (repartition parity)."""
+    if num_workers < 1 or num_workers > n:
+        raise ValueError(f"num_workers={num_workers} invalid for n={n}")
+    return [
+        n // num_workers + (1 if i < n % num_workers else 0)
+        for i in range(num_workers)
+    ]
+
+
 @dataclass
 class Shard:
     worker_id: int
@@ -39,6 +49,59 @@ class Shard:
 class ShardedDataset:
     """Immutable row-sharded (X, y) resident on devices."""
 
+    @classmethod
+    def generate_on_device(
+        cls,
+        n: int,
+        d: int,
+        num_workers: int,
+        devices: Optional[Sequence] = None,
+        seed: int = 42,
+        noise: float = 0.01,
+    ) -> "ShardedDataset":
+        """Synthesize a planted least-squares problem directly in HBM.
+
+        Zero host->device traffic: each shard's rows are drawn by a jitted
+        PRNG on its own device (essential when the host link is slow -- and
+        the TPU generates gigabytes/s anyway).  ``_host_X/_host_y`` stay None;
+        host-side accessors raise.
+        """
+        import functools
+
+        import jax.numpy as jnp
+
+        obj = cls.__new__(cls)
+        sizes = balanced_sizes(n, num_workers)
+        obj.n, obj.d, obj.num_workers = n, d, num_workers
+        devs = list(devices) if devices is not None else jax.devices()
+        cum = np.concatenate([[0], np.cumsum(sizes)])
+        obj.partition_cum = [int(c) for c in cum]
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def gen_shard(key, w_true, size):
+            kx, kn = jax.random.split(key)
+            Xp = jax.random.normal(kx, (size, d), jnp.float32) / jnp.sqrt(d)
+            yp = Xp @ w_true + noise * jax.random.normal(kn, (size,), jnp.float32)
+            return Xp, yp
+
+        # Domain-separate the data stream from the solvers' per-worker mask
+        # chains (which are fold_in(PRNGKey(seed), wid)): sharing the seed
+        # must not make sample masks a function of the bits that drew the data.
+        root = jax.random.fold_in(jax.random.PRNGKey(seed), 0x44415441)  # "DATA"
+        w_true = jax.random.normal(jax.random.fold_in(root, 2**30), (d,), jnp.float32)
+        obj.shards = {}
+        for w in range(num_workers):
+            dev = devs[w % len(devs)]
+            key = jax.device_put(jax.random.fold_in(root, w), dev)
+            Xp, yp = gen_shard(key, jax.device_put(w_true, dev), sizes[w])
+            obj.shards[w] = Shard(
+                worker_id=w, X=Xp, y=yp,
+                start=obj.partition_cum[w], size=sizes[w],
+            )
+        obj._host_X = None
+        obj._host_y = None
+        return obj
+
     def __init__(
         self,
         X: np.ndarray,
@@ -49,15 +112,11 @@ class ShardedDataset:
         n = X.shape[0]
         if y.shape[0] != n:
             raise ValueError(f"X has {n} rows but y has {y.shape[0]}")
-        if num_workers < 1 or num_workers > n:
-            raise ValueError(f"num_workers={num_workers} invalid for n={n}")
+        sizes = balanced_sizes(n, num_workers)
         self.n = n
         self.d = X.shape[1]
         self.num_workers = num_workers
         devs = list(devices) if devices is not None else jax.devices()
-        # balanced contiguous split, sizes differ by <=1 (repartition parity)
-        sizes = [n // num_workers + (1 if i < n % num_workers else 0)
-                 for i in range(num_workers)]
         cum = np.concatenate([[0], np.cumsum(sizes)])
         self.partition_cum: List[int] = [int(c) for c in cum]
         self.shards: Dict[int, Shard] = {}
@@ -84,6 +143,11 @@ class ShardedDataset:
 
     def global_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
         """Host copies, for the SPMD sync path / evaluation."""
+        if self._host_X is None:
+            raise ValueError(
+                "dataset was generated on device; no host copy exists "
+                "(use the per-shard device arrays instead)"
+            )
         return self._host_X, self._host_y
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -45,17 +45,20 @@ from asyncframework_tpu.solvers.base import (
     SolverConfig,
     TrainResult,
     WaitingTimeTable,
+    resolve_dataset,
 )
 
 
 class ASAGA:
     def __init__(
         self,
-        X: np.ndarray,
-        y: np.ndarray,
+        X,
+        y: Optional[np.ndarray],
         config: SolverConfig,
         devices: Optional[list] = None,
     ):
+        """``X`` may be a host array (sharded here) or a pre-built
+        :class:`ShardedDataset` (e.g. generated on device), with ``y=None``."""
         if config.loss != "least_squares":
             raise ValueError(
                 "ASAGA's scalar history compression requires least_squares "
@@ -63,7 +66,7 @@ class ASAGA:
             )
         self.cfg = config
         self.devices = list(devices) if devices is not None else jax.devices()
-        self.ds = ShardedDataset(X, y, config.num_workers, self.devices)
+        self.ds = resolve_dataset(X, y, config.num_workers, self.devices)
         self.driver_device = self.devices[0]
         self._step = steps.make_saga_worker_step(config.batch_rate)
         self._apply = steps.make_saga_apply(

@@ -42,20 +42,23 @@ from asyncframework_tpu.solvers.base import (
     SolverConfig,
     TrainResult,
     WaitingTimeTable,
+    resolve_dataset,
 )
 
 
 class ASGD:
     def __init__(
         self,
-        X: np.ndarray,
-        y: np.ndarray,
+        X,
+        y: Optional[np.ndarray],
         config: SolverConfig,
         devices: Optional[list] = None,
     ):
+        """``X`` may be a host array (sharded here) or a pre-built
+        :class:`ShardedDataset` (e.g. generated on device), with ``y=None``."""
         self.cfg = config
         self.devices = list(devices) if devices is not None else jax.devices()
-        self.ds = ShardedDataset(X, y, config.num_workers, self.devices)
+        self.ds = resolve_dataset(X, y, config.num_workers, self.devices)
         self.driver_device = self.devices[0]
         self._step = steps.make_asgd_worker_step(config.batch_rate, config.loss)
         self._apply = steps.make_asgd_apply(

@@ -17,6 +17,35 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from asyncframework_tpu.data.sharded import ShardedDataset
+
+
+def resolve_dataset(X, y, num_workers: int, devices) -> ShardedDataset:
+    """Accept either host arrays (sharded here) or a pre-built
+    :class:`ShardedDataset`; validate consistency with the solver's setup."""
+    if isinstance(X, ShardedDataset):
+        if y is not None:
+            raise ValueError(
+                "y must be None when passing a pre-built ShardedDataset "
+                "(its labels are already resident on device)"
+            )
+        if X.num_workers != num_workers:
+            raise ValueError(
+                f"dataset is sharded for {X.num_workers} workers but the "
+                f"solver is configured for {num_workers}"
+            )
+        for wid in range(num_workers):
+            expect = devices[wid % len(devices)]
+            actual = X.shard(wid).X.device
+            if actual != expect:
+                raise ValueError(
+                    f"shard {wid} lives on {actual} but the solver will "
+                    f"dispatch worker {wid} to {expect}; rebuild the dataset "
+                    f"with the solver's device list"
+                )
+        return X
+    return ShardedDataset(X, y, num_workers, devices)
+
 
 @dataclass
 class SolverConfig:

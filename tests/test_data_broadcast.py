@@ -92,6 +92,36 @@ class TestShardedDataset:
             ShardedDataset(X, y, 11, devices=devices8)
 
 
+class TestDeviceGeneratedDataset:
+    def test_generate_on_device_shapes(self, devices8):
+        ds = ShardedDataset.generate_on_device(1001, 16, 8, devices=devices8, seed=1)
+        assert sum(ds.partition_sizes().values()) == 1001
+        assert ds.partition_cum[-1] == 1001
+        with pytest.raises(ValueError, match="generated on device"):
+            ds.global_arrays()
+
+    def test_generate_validates_num_workers(self, devices8):
+        with pytest.raises(ValueError, match="num_workers"):
+            ShardedDataset.generate_on_device(4, 8, 0, devices=devices8)
+        with pytest.raises(ValueError, match="num_workers"):
+            ShardedDataset.generate_on_device(4, 8, 8, devices=devices8)
+
+    def test_solver_accepts_prebuilt_and_validates(self, devices8):
+        from asyncframework_tpu.solvers import ASGD, SolverConfig
+        from asyncframework_tpu.solvers.base import resolve_dataset
+
+        ds = ShardedDataset.generate_on_device(256, 8, 8, devices=devices8)
+        cfg = SolverConfig(num_workers=4)
+        with pytest.raises(ValueError, match="workers"):
+            ASGD(ds, None, cfg, devices=devices8)
+        with pytest.raises(ValueError, match="y must be None"):
+            resolve_dataset(ds, np.zeros(256), 8, devices8)
+        # mismatched device order is rejected at construction time
+        shuffled = list(devices8[1:]) + [devices8[0]]
+        with pytest.raises(ValueError, match="rebuild the dataset"):
+            resolve_dataset(ds, None, 8, shuffled)
+
+
 class TestVersionedModelStore:
     def test_publish_snapshot_isolation(self):
         store = VersionedModelStore()
