@@ -1,6 +1,7 @@
 from asyncframework_tpu.data.libsvm import load_libsvm, parse_libsvm_lines
 from asyncframework_tpu.data.synthetic import make_regression, make_classification
 from asyncframework_tpu.data.sharded import ShardedDataset
+from asyncframework_tpu.data.dataset import DistributedDataset
 
 __all__ = [
     "load_libsvm",
@@ -8,4 +9,5 @@ __all__ = [
     "make_regression",
     "make_classification",
     "ShardedDataset",
+    "DistributedDataset",
 ]

@@ -12,21 +12,26 @@ a returned value (no global mutable state) that the solver passes to
 from __future__ import annotations
 
 import math
-from typing import Callable, List
+from typing import Callable, List, Sequence, Union
 
 from asyncframework_tpu.context import AsyncContext, WorkerState
 
 
 def partial_barrier(
     ctx: AsyncContext,
-    num_workers: int,
+    workers: Union[int, Sequence[int]],
     predicate: Callable[[WorkerState], bool],
 ) -> List[int]:
     """Return the cohort: workers whose state passes ``predicate`` AND are
-    available, plus workers never seen (no STAT entry)."""
+    available, plus workers never seen (no STAT entry).
+
+    ``workers`` is either a worker count (ids ``0..n-1``) or an explicit id
+    sequence (for datasets with non-contiguous partition ids).
+    """
+    ids = range(workers) if isinstance(workers, int) else workers
     cohort: List[int] = []
     states = ctx.states()
-    for wid in range(num_workers):
+    for wid in ids:
         ws = states.get(wid)
         if ws is None:
             cohort.append(wid)

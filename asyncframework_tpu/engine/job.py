@@ -52,6 +52,9 @@ class JobWaiter:
         self._handler = result_handler
         self._lock = threading.Lock()
         self._done = threading.Event()
+        self._failure_cbs: List[Callable[[BaseException], None]] = []
+        if not self._expected:
+            self._done.set()  # zero-task job is trivially complete
 
     def task_succeeded(self, worker_id: int, result: Any) -> None:
         self._handler(worker_id, result)
@@ -64,6 +67,22 @@ class JobWaiter:
         with self._lock:
             self._failed = exc
             self._done.set()
+            cbs = list(self._failure_cbs)
+        for cb in cbs:
+            cb(exc)
+
+    def on_failure(self, cb: Callable[[BaseException], None]) -> None:
+        """Register a callback invoked (once) if the job aborts.
+
+        Fires immediately when the job has already failed -- async submitters
+        use this to release resources (e.g. un-busy a cohort) without polling.
+        """
+        with self._lock:
+            if self._failed is None:
+                self._failure_cbs.append(cb)
+                return
+            exc = self._failed
+        cb(exc)
 
     def await_result(self, timeout: Optional[float] = None) -> None:
         """Block until every task has merged (mode-0 / first-iteration path).

@@ -58,6 +58,10 @@ class JobScheduler:
             num_workers, self._status_update, devices=devices, clock=self._clock
         )
 
+    @property
+    def clock(self) -> Clock:
+        return self._clock
+
     # ------------------------------------------------------------------ mode
     def set_mode(self, mode: int) -> None:
         """Parity: ``SparkContext.set_mode`` -> ``dagScheduler.set_mode``."""
