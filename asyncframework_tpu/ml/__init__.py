@@ -1,0 +1,49 @@
+"""ML library layer (L6): optimization primitives and models.
+
+Parity: the slice of MLlib the reference's experiments stand on
+(``mllib/.../optimization/`` -- ``GradientDescent.scala``, ``LBFGS.scala``,
+``Gradient.scala``, ``Updater.scala`` -- plus the model wrappers in
+``mllib/.../regression/`` and ``mllib/.../classification/`` and KMeans
+clustering), re-designed as jitted SPMD programs over a device mesh instead
+of per-iteration cluster jobs.
+"""
+
+from asyncframework_tpu.ml.gradient import (
+    Gradient,
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+)
+from asyncframework_tpu.ml.updater import (
+    L1Updater,
+    SimpleUpdater,
+    SquaredL2Updater,
+    Updater,
+)
+from asyncframework_tpu.ml.optimization import LBFGS, GradientDescent
+from asyncframework_tpu.ml.models import (
+    LinearModel,
+    LinearRegression,
+    LinearSVM,
+    LogisticRegression,
+)
+from asyncframework_tpu.ml.clustering import KMeans, KMeansModel
+
+__all__ = [
+    "Gradient",
+    "LeastSquaresGradient",
+    "LogisticGradient",
+    "HingeGradient",
+    "Updater",
+    "SimpleUpdater",
+    "SquaredL2Updater",
+    "L1Updater",
+    "GradientDescent",
+    "LBFGS",
+    "LinearModel",
+    "LinearRegression",
+    "LogisticRegression",
+    "LinearSVM",
+    "KMeans",
+    "KMeansModel",
+]

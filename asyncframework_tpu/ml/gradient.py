@@ -1,0 +1,75 @@
+"""Loss-gradient families for linear models.
+
+Parity: ``mllib/.../optimization/Gradient.scala`` --
+``LeastSquaresGradient`` (:285), ``LogisticGradient`` binary case (:166),
+``HingeGradient`` (SVM).  The reference computes per-sample ``(grad, loss)``
+pairs that a ``treeAggregate`` sums; on TPU a whole masked batch is two
+matmuls on the MXU, so the unit here is a *batch*: ``local(X, y, w, mask)``
+returns the summed gradient and summed loss over ``mask``-selected rows.
+All methods are pure and jax-traceable (usable inside ``jit``/``shard_map``).
+
+Label conventions match MLlib: logistic and hinge take labels in {0, 1}
+(hinge internally rescales to {-1, +1} exactly as ``HingeGradient`` does).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Gradient:
+    """Batched (summed) loss/gradient over masked rows."""
+
+    def local(
+        self, X: jax.Array, y: jax.Array, w: jax.Array, mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Returns ``(grad_sum, loss_sum)`` over rows where ``mask`` is 1."""
+        raise NotImplementedError
+
+    def loss(self, X: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
+        """Summed loss over all rows (evaluation path)."""
+        ones = jnp.ones(X.shape[0], X.dtype)
+        return self.local(X, y, w, ones)[1]
+
+
+class LeastSquaresGradient(Gradient):
+    """loss_i = (x_i.w - y_i)^2 / 2;  grad_i = (x_i.w - y_i) x_i."""
+
+    def local(self, X, y, w, mask):
+        r = X @ w - y
+        g = X.T @ (mask * r)
+        return g, 0.5 * jnp.sum(mask * r * r)
+
+
+class LogisticGradient(Gradient):
+    """Binary logistic loss, labels in {0,1}.
+
+    loss_i = log(1 + e^{x_i.w}) - y_i (x_i.w);
+    grad_i = (sigmoid(x_i.w) - y_i) x_i.
+    """
+
+    def local(self, X, y, w, mask):
+        m = X @ w
+        p = jax.nn.sigmoid(m)
+        g = X.T @ (mask * (p - y))
+        loss = jnp.sum(mask * (jnp.logaddexp(0.0, m) - y * m))
+        return g, loss
+
+
+class HingeGradient(Gradient):
+    """SVM hinge loss, labels in {0,1} rescaled to s = 2y-1.
+
+    If ``1 - s (x_i.w) > 0``: loss_i = that margin, grad_i = -s x_i; else 0.
+    """
+
+    def local(self, X, y, w, mask):
+        s = 2.0 * y - 1.0
+        m = X @ w
+        viol = 1.0 - s * m
+        active = (viol > 0).astype(X.dtype) * mask
+        g = X.T @ (-s * active)
+        loss = jnp.sum(jnp.maximum(viol, 0.0) * mask)
+        return g, loss

@@ -1,0 +1,158 @@
+"""Linear model wrappers over the optimizers.
+
+Parity: ``mllib/.../regression/LinearRegression.scala`` (the fork touches it
+at :178-183 to surface the weight trajectory), ``classification/
+LogisticRegressionWithSGD`` and ``SVMWithSGD`` via
+``GeneralizedLinearAlgorithm.scala:318-320`` -- train = run the optimizer on
+the (optionally intercept-augmented) design matrix, wrap weights in a typed
+model with ``predict``.
+
+The fork's `LinearRegression` delta -- exposing ``optimizer.getAllWeights``
+so the baseline driver can compute loss-vs-time post hoc -- is
+:attr:`LinearModel.weight_history` here.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from asyncframework_tpu.ml.gradient import (
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+)
+from asyncframework_tpu.ml.optimization import GradientDescent
+from asyncframework_tpu.ml.updater import (
+    L1Updater,
+    SimpleUpdater,
+    SquaredL2Updater,
+)
+
+
+def _augment(X: np.ndarray, fit_intercept: bool) -> np.ndarray:
+    if not fit_intercept:
+        return X
+    return np.concatenate([X, np.ones((X.shape[0], 1), X.dtype)], axis=1)
+
+
+class LinearModel:
+    """weights + intercept + the training loss/weight trajectories."""
+
+    def __init__(
+        self,
+        weights: np.ndarray,
+        intercept: float,
+        loss_history: np.ndarray,
+        weight_history: List[Tuple[float, np.ndarray]],
+    ):
+        self.weights = weights
+        self.intercept = intercept
+        self.loss_history = loss_history
+        self.weight_history = weight_history
+
+    def margin(self, X: np.ndarray) -> np.ndarray:
+        return X @ self.weights + self.intercept
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return self.margin(X)
+
+
+class _SGDEstimator:
+    _gradient_cls = LeastSquaresGradient
+    _default_updater = SimpleUpdater
+
+    def __init__(
+        self,
+        step_size: float = 1.0,
+        num_iterations: int = 100,
+        reg_param: float = 0.0,
+        mini_batch_fraction: float = 1.0,
+        fit_intercept: bool = False,
+        updater: str = "default",
+        convergence_tol: float = 0.0,
+        seed: int = 42,
+        snapshot_every: int = 100,
+    ):
+        upd = {
+            "default": self._default_updater(),
+            "simple": SimpleUpdater(),
+            "l1": L1Updater(),
+            "l2": SquaredL2Updater(),
+        }[updater]
+        self.fit_intercept = fit_intercept
+        self.optimizer = GradientDescent(
+            gradient=self._gradient_cls(),
+            updater=upd,
+            step_size=step_size,
+            num_iterations=num_iterations,
+            reg_param=reg_param,
+            mini_batch_fraction=mini_batch_fraction,
+            convergence_tol=convergence_tol,
+            seed=seed,
+            snapshot_every=snapshot_every,
+        )
+
+    def _make_model(self, w_aug: np.ndarray, losses: np.ndarray) -> LinearModel:
+        if self.fit_intercept:
+            w, b = w_aug[:-1], float(w_aug[-1])
+        else:
+            w, b = w_aug, 0.0
+        return self._model_cls(
+            w, b, losses, self.optimizer.get_all_weights()
+        )
+
+    _model_cls = LinearModel
+
+    def fit(
+        self,
+        X: np.ndarray,
+        y: np.ndarray,
+        w0: Optional[np.ndarray] = None,
+        mesh: Optional[Mesh] = None,
+    ):
+        Xa = _augment(np.asarray(X, np.float32), self.fit_intercept)
+        if w0 is not None and self.fit_intercept:
+            w0 = np.concatenate([w0, [0.0]]).astype(np.float32)
+        w_aug, losses = self.optimizer.optimize(
+            Xa, np.asarray(y, np.float32), w0=w0, mesh=mesh
+        )
+        return self._make_model(w_aug, losses)
+
+
+class LinearRegression(_SGDEstimator):
+    """``LinearRegressionWithSGD`` analog (least squares, simple updater)."""
+
+    _gradient_cls = LeastSquaresGradient
+    _default_updater = SimpleUpdater
+
+
+class LogisticRegressionModel(LinearModel):
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        return 1.0 / (1.0 + np.exp(-self.margin(X)))
+
+    def predict(self, X: np.ndarray, threshold: float = 0.5) -> np.ndarray:
+        return (self.predict_proba(X) >= threshold).astype(np.int32)
+
+
+class LogisticRegression(_SGDEstimator):
+    """``LogisticRegressionWithSGD`` analog (labels in {0,1})."""
+
+    _gradient_cls = LogisticGradient
+    _default_updater = SimpleUpdater
+    _model_cls = LogisticRegressionModel
+
+
+class SVMModel(LinearModel):
+    def predict(self, X: np.ndarray, threshold: float = 0.0) -> np.ndarray:
+        return (self.margin(X) >= threshold).astype(np.int32)
+
+
+class LinearSVM(_SGDEstimator):
+    """``SVMWithSGD`` analog (hinge loss, L2 updater by default)."""
+
+    _gradient_cls = HingeGradient
+    _default_updater = SquaredL2Updater
+    _model_cls = SVMModel

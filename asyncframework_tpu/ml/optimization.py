@@ -1,0 +1,329 @@
+"""Optimizers: mini-batch gradient descent and L-BFGS.
+
+Parity:
+- ``GradientDescent.runMiniBatchSGD`` (``mllib/.../GradientDescent.scala:197-295``):
+  per 1-indexed iteration, Bernoulli-sample fraction ``b``, aggregate
+  ``(grad_sum, loss_sum, count)``, record ``loss_sum/count + reg_val(prev)``
+  in the stochastic loss history, update via the pluggable ``Updater``;
+  convergence tolerance on the weight-vector delta
+  (``GradientDescent.scala:300-310``: ``||w_t - w_{t-1}|| < tol * max(||w_t||, 1)``).
+- The fork's trajectory delta: ``Warray: ListBuffer[(wallclock, weights)]``
+  appended every 100 iterations (``GradientDescent.scala:156,255-259``) and
+  surfaced through ``Optimizer.getAllWeights`` (``Optimizer.scala:39-40``) --
+  here :meth:`GradientDescent.get_all_weights`, recorded every
+  ``snapshot_every`` iterations.
+- ``LBFGS.scala:42`` (breeze L-BFGS over a full-batch ``CostFun``): here a
+  host-driven two-loop-recursion L-BFGS whose full-batch value+gradient is one
+  jitted SPMD computation per evaluation.
+
+TPU re-design: the reference launches one cluster job per iteration/evaluation;
+here the SGD loop is a single compiled ``shard_map`` + ``lax.scan`` program
+(data stays in HBM, `psum` over ICI per step), and L-BFGS's direction/line
+search bookkeeping (tiny, O(m*d) on host) wraps a jitted loss/grad kernel.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from asyncframework_tpu.ml.gradient import Gradient, LeastSquaresGradient
+from asyncframework_tpu.ml.updater import SimpleUpdater, Updater
+from asyncframework_tpu.parallel.mesh import make_mesh, pad_and_shard
+
+
+class GradientDescent:
+    """Mini-batch SGD with pluggable :class:`Gradient` / :class:`Updater`.
+
+    The whole optimization loop compiles to one XLA program; the stochastic
+    loss history and weight snapshots come back as stacked scan outputs.
+    """
+
+    def __init__(
+        self,
+        gradient: Optional[Gradient] = None,
+        updater: Optional[Updater] = None,
+        step_size: float = 1.0,
+        num_iterations: int = 100,
+        reg_param: float = 0.0,
+        mini_batch_fraction: float = 1.0,
+        convergence_tol: float = 0.0,
+        seed: int = 42,
+        snapshot_every: int = 100,
+    ):
+        self.gradient = gradient or LeastSquaresGradient()
+        self.updater = updater or SimpleUpdater()
+        self.step_size = step_size
+        self.num_iterations = num_iterations
+        self.reg_param = reg_param
+        self.mini_batch_fraction = mini_batch_fraction
+        self.convergence_tol = convergence_tol
+        self.seed = seed
+        self.snapshot_every = snapshot_every
+        self._weight_history: List[Tuple[float, np.ndarray]] = []
+        self._train_cache: dict = {}
+
+    def _build(self, mesh: Mesh, want_full: bool, axis: str = "dp"):
+        grad, upd = self.gradient, self.updater
+        b = self.mini_batch_fraction
+        step_size, reg = self.step_size, self.reg_param
+        T = self.num_iterations
+        every = self.snapshot_every
+        # snapshots at iterations every, 2*every, ... plus always the final
+        # iterate (Warray cadence: GradientDescent.scala:255-259 appends
+        # every 100 iterations)
+        n_snaps = max(T // every, 1)
+
+        def body(carry, it, X, y, valid):
+            w, key, prev_reg_val, snaps = carry
+            key, sub = jax.random.split(key)
+            sub = jax.random.fold_in(sub, jax.lax.axis_index(axis))
+            mask = jax.random.bernoulli(sub, b, (X.shape[0],)).astype(X.dtype)
+            mask = mask * valid
+            local_g, local_loss = grad.local(X, y, w, mask)
+            g, loss_sum, count = jax.lax.psum(
+                (local_g, local_loss, jnp.sum(mask)), axis
+            )
+            count = jnp.maximum(count, 1.0)
+            # MLlib records loss BEFORE this iteration's update, with the
+            # regularization value produced by the PREVIOUS update
+            # (GradientDescent.scala:271-274).
+            stoch_loss = loss_sum / count + prev_reg_val
+            w2, reg_val = upd.apply(w, g / count, step_size, it, reg)
+            # write w2 into its snapshot slot when it is a multiple of
+            # ``every`` (bounded buffer instead of the full (T, d) stack)
+            it_i = it.astype(jnp.int32)
+            slot = jnp.clip(it_i // every - 1, 0, n_snaps - 1)
+            take = (it_i % every == 0).astype(w2.dtype)
+            row = jax.lax.dynamic_slice_in_dim(snaps, slot, 1, axis=0)
+            new_row = take * w2[None, :] + (1.0 - take) * row
+            snaps = jax.lax.dynamic_update_slice_in_dim(
+                snaps, new_row, slot, axis=0
+            )
+            out = (stoch_loss, w2) if want_full else (stoch_loss,)
+            return (w2, key, reg_val, snaps), out
+
+        out_specs = (
+            (P(None), P(None), P(None), P(None))
+            if want_full
+            else (P(None), P(None), P(None))
+        )
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis), P(axis), P(None), P(None)),
+            out_specs=out_specs,
+        )
+        def train(X, y, valid, w0, key0):
+            # MLlib seeds the loss history's regularization term from the
+            # initial weights: updater.compute(w0, 0, 0, 1, reg)._2
+            # (GradientDescent.scala:251-253).
+            _, reg0 = upd.apply(
+                w0, jnp.zeros_like(w0), 0.0, jnp.asarray(1.0, w0.dtype), reg
+            )
+            snaps0 = jnp.zeros((n_snaps, w0.shape[0]), w0.dtype)
+
+            def scan_body(carry, it):
+                return body(carry, it, X, y, valid)
+
+            (wT, _, _, snaps), outs = jax.lax.scan(
+                scan_body,
+                (w0, key0, reg0, snaps0),
+                jnp.arange(1, T + 1, dtype=jnp.float32),
+            )
+            if want_full:
+                losses, ws = outs
+                return wT, losses, snaps, ws
+            (losses,) = outs
+            return wT, losses, snaps
+
+        return jax.jit(train)
+
+    def _get_train(self, mesh: Mesh, shape, want_full: bool):
+        """Cache compiled programs per (mesh, data shape, output mode) --
+        jit's cache is keyed on function identity, so rebuilding the closure
+        per call would recompile every fit."""
+        key = (
+            tuple(d.id for d in mesh.devices.flat),
+            mesh.axis_names,
+            shape,
+            want_full,
+        )
+        if key not in self._train_cache:
+            self._train_cache[key] = self._build(mesh, want_full)
+        return self._train_cache[key]
+
+    def optimize(
+        self,
+        X: np.ndarray,
+        y: np.ndarray,
+        w0: Optional[np.ndarray] = None,
+        mesh: Optional[Mesh] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns ``(w_final, stochastic_loss_history)``."""
+        mesh = mesh or make_mesh()
+        Xs, ys, vs, _n = pad_and_shard(mesh, X, y)
+        w0 = np.zeros(X.shape[1], np.float32) if w0 is None else np.asarray(w0)
+        # convergence_tol needs the per-iteration iterates to find the
+        # stopping point; otherwise only the bounded snapshot buffer is
+        # materialized (full (T, d) stacks don't scale to wide models)
+        want_full = self.convergence_tol > 0
+        t0 = time.monotonic()
+        train = self._get_train(mesh, Xs.shape, want_full)
+        out = train(
+            Xs, ys, vs, jnp.asarray(w0, jnp.float32),
+            jax.random.PRNGKey(self.seed),
+        )
+        wT, losses, snaps = out[0], np.asarray(out[1]), np.asarray(out[2])
+        wT = np.asarray(wT)
+        # Warray parity: (wall-clock ms, weights) at iterations every,
+        # 2*every, ..., plus the final iterate.  The scan ran as one device
+        # program, so timestamps are reconstructed proportionally over the
+        # measured run (the reference stamps real per-iteration wall clock;
+        # ours bounds the same curve).
+        elapsed_ms = (time.monotonic() - t0) * 1e3
+        T, every = self.num_iterations, self.snapshot_every
+        snap_iters = list(range(every, T + 1, every))
+        self._weight_history = [
+            (elapsed_ms * it / T, snaps[i])
+            for i, it in enumerate(snap_iters)
+        ]
+        if T % every != 0 or not snap_iters:
+            self._weight_history.append((elapsed_ms, wT))
+        if want_full:
+            ws = np.asarray(out[3])
+            prev = w0
+            for i in range(len(ws)):
+                diff = np.linalg.norm(ws[i] - prev)
+                if diff < self.convergence_tol * max(np.linalg.norm(ws[i]), 1.0):
+                    return ws[i], losses[: i + 1]
+                prev = ws[i]
+        return wT, losses
+
+    def get_all_weights(self) -> List[Tuple[float, np.ndarray]]:
+        """The fork's ``Optimizer.getAllWeights`` trajectory accessor."""
+        return list(self._weight_history)
+
+
+class LBFGS:
+    """Limited-memory BFGS over the full-batch regularized objective.
+
+    Parity: ``LBFGS.scala:42`` + its breeze ``CostFun`` -- objective is
+    ``mean loss + reg_val(w)`` with L2 regularization handled analytically.
+    The two-loop recursion and Armijo backtracking run on host (O(m d) math);
+    each objective/gradient evaluation is one jitted SPMD computation.
+    """
+
+    def __init__(
+        self,
+        gradient: Optional[Gradient] = None,
+        num_corrections: int = 10,
+        convergence_tol: float = 1e-6,
+        max_iterations: int = 100,
+        reg_param: float = 0.0,
+    ):
+        self.gradient = gradient or LeastSquaresGradient()
+        self.m = num_corrections
+        self.tol = convergence_tol
+        self.max_iterations = max_iterations
+        self.reg_param = reg_param
+        self._weight_history: List[Tuple[float, np.ndarray]] = []
+        self.loss_history: List[float] = []
+
+    def optimize(
+        self,
+        X: np.ndarray,
+        y: np.ndarray,
+        w0: Optional[np.ndarray] = None,
+        mesh: Optional[Mesh] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        mesh = mesh or make_mesh()
+        Xs, ys, vs, n = pad_and_shard(mesh, X, y)
+        grad, reg = self.gradient, self.reg_param
+        self._weight_history = []
+        self.loss_history = []
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P("dp", None), P("dp"), P("dp"), P(None)),
+            out_specs=(P(), P(None)),
+        )
+        def value_grad(Xl, yl, vl, w):
+            g, loss = grad.local(Xl, yl, w, vl)
+            g, loss = jax.lax.psum((g, loss), "dp")
+            return loss, g
+
+        value_grad = jax.jit(value_grad)
+
+        def f_g(w: np.ndarray) -> Tuple[float, np.ndarray]:
+            loss, g = value_grad(Xs, ys, vs, jnp.asarray(w, jnp.float32))
+            f = float(loss) / n + 0.5 * reg * float(w @ w)
+            return f, np.asarray(g) / n + reg * w
+
+        w = (np.zeros(X.shape[1], np.float32) if w0 is None
+             else np.asarray(w0, np.float32))
+        t0 = time.monotonic()
+        f, g = f_g(w)
+        s_list: List[np.ndarray] = []
+        y_list: List[np.ndarray] = []
+        self.loss_history = [f]
+        for _ in range(self.max_iterations):
+            # two-loop recursion
+            q = g.copy()
+            alphas = []
+            for s, yk in zip(reversed(s_list), reversed(y_list)):
+                a = (s @ q) / (yk @ s)
+                q -= a * yk
+                alphas.append(a)
+            if y_list:
+                yk, s = y_list[-1], s_list[-1]
+                q *= (s @ yk) / (yk @ yk)
+            for (s, yk), a in zip(zip(s_list, y_list), reversed(alphas)):
+                beta = (yk @ q) / (yk @ s)
+                q += (a - beta) * s
+            d = -q
+            if g @ d > 0:  # safeguard: fall back to steepest descent
+                d = -g
+            # Armijo backtracking
+            t = 1.0
+            gd = g @ d
+            for _ls in range(30):
+                f_new, g_new = f_g(w + t * d)
+                if f_new <= f + 1e-4 * t * gd:
+                    break
+                t *= 0.5
+            s = t * d
+            yk = g_new - g
+            if np.linalg.norm(s) < self.tol * max(np.linalg.norm(w), 1.0):
+                w, f, g = w + s, f_new, g_new
+                self.loss_history.append(f)
+                break
+            if yk @ s > 1e-10:  # curvature condition, keep pair
+                s_list.append(s)
+                y_list.append(yk)
+                if len(s_list) > self.m:
+                    s_list.pop(0)
+                    y_list.pop(0)
+            w, f, g = w + s, f_new, g_new
+            self.loss_history.append(f)
+            self._weight_history.append(
+                ((time.monotonic() - t0) * 1e3, w.copy())
+            )
+            if len(self.loss_history) >= 2:
+                prev, cur = self.loss_history[-2], self.loss_history[-1]
+                if abs(prev - cur) / max(abs(prev), abs(cur), 1e-12) < self.tol:
+                    break
+        return w, np.asarray(self.loss_history)
+
+    def get_all_weights(self) -> List[Tuple[float, np.ndarray]]:
+        """Real trajectory (the reference's ``LBFGS.getAllWeights`` is a stub
+        -- ``LBFGS.scala:45-49``; we return the actual iterates)."""
+        return list(self._weight_history)

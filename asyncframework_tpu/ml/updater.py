@@ -1,0 +1,59 @@
+"""Parameter updaters (step + regularization).
+
+Parity: ``mllib/.../optimization/Updater.scala`` -- ``SimpleUpdater`` (:41),
+``L1Updater`` soft-thresholding (:70), ``SquaredL2Updater`` (:140).  Exact
+semantics preserved: the per-iteration learning rate is
+``step_size / sqrt(iter)`` with ``iter`` 1-indexed, applied to the *average*
+gradient; the returned regularization value is computed on the *new* weights.
+All methods are pure and jax-traceable.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Updater:
+    def apply(
+        self,
+        w: jax.Array,
+        avg_grad: jax.Array,
+        step_size: float,
+        it: jax.Array,
+        reg_param: float,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Returns ``(w_new, reg_val)``; ``it`` is the 1-indexed iteration."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _lr(step_size, it):
+        return step_size / jnp.sqrt(it)
+
+
+class SimpleUpdater(Updater):
+    def apply(self, w, avg_grad, step_size, it, reg_param):
+        w2 = w - self._lr(step_size, it) * avg_grad
+        return w2, jnp.asarray(0.0, w.dtype)
+
+
+class SquaredL2Updater(Updater):
+    """w' = w (1 - lr * reg) - lr * grad;  reg_val = reg/2 ||w'||^2."""
+
+    def apply(self, w, avg_grad, step_size, it, reg_param):
+        lr = self._lr(step_size, it)
+        w2 = w * (1.0 - lr * reg_param) - lr * avg_grad
+        return w2, 0.5 * reg_param * jnp.sum(w2 * w2)
+
+
+class L1Updater(Updater):
+    """Soft-threshold at ``lr * reg``;  reg_val = reg ||w'||_1."""
+
+    def apply(self, w, avg_grad, step_size, it, reg_param):
+        lr = self._lr(step_size, it)
+        raw = w - lr * avg_grad
+        shrink = lr * reg_param
+        w2 = jnp.sign(raw) * jnp.maximum(jnp.abs(raw) - shrink, 0.0)
+        return w2, reg_param * jnp.sum(jnp.abs(w2))

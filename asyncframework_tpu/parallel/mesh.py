@@ -58,3 +58,25 @@ def shard_batch(mesh: Mesh, *arrays, axis: str = "dp"):
     sh = batch_sharding(mesh, axis)
     out = tuple(jax.device_put(a, sh) for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+def pad_and_shard(mesh: Mesh, *arrays, axis: str = "dp"):
+    """Pad rows to a multiple of the mesh size (static shapes for XLA) and
+    shard on the batch axis.
+
+    Returns ``(*sharded_arrays, valid_sharded, n)`` where ``valid`` is a
+    float mask that is 0 on padding rows and ``n`` the original row count.
+    All arrays are padded along axis 0 with zeros.
+    """
+    n_dev = mesh.devices.size
+    n = arrays[0].shape[0]
+    pad = (-n) % n_dev
+    valid = np.ones(n, np.float32)
+    if pad:
+        arrays = tuple(
+            np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+            for a in arrays
+        )
+        valid = np.concatenate([valid, np.zeros(pad, np.float32)])
+    sharded = shard_batch(mesh, *arrays, valid, axis=axis)
+    return (*sharded, n)

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from asyncframework_tpu.parallel.mesh import make_mesh, shard_batch
+from asyncframework_tpu.parallel.mesh import make_mesh, pad_and_shard
 
 
 class MiniBatchSGD:
@@ -131,17 +131,9 @@ class MiniBatchSGD:
         """Returns (w_final, loss_history, snapshots) where snapshots is the
         Warray analog: [(iteration, w)] every ``snapshot_every`` steps."""
         mesh = mesh or make_mesh()
-        n_dev = mesh.devices.size
         n = X.shape[0]
-        pad = (-n) % n_dev
-        valid = np.ones(n, X.dtype)
-        if pad:
-            # static shapes for XLA: pad rows, excluded via the validity mask
-            X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)])
-            y = np.concatenate([y, np.zeros(pad, y.dtype)])
-            valid = np.concatenate([valid, np.zeros(pad, X.dtype)])
         train = self._build(mesh, n_global=n)
-        Xs, ys, vs = shard_batch(mesh, X, y, valid)
+        Xs, ys, vs, _n = pad_and_shard(mesh, X, y)
         w0 = np.zeros(X.shape[1], np.float32) if w0 is None else w0
         key0 = jax.random.PRNGKey(self.seed)
         wT, losses, ws = train(Xs, ys, vs, jnp.asarray(w0), key0)
