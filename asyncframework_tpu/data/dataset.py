@@ -432,6 +432,12 @@ class DistributedDataset(Generic[E]):
             waiter = self.scheduler.run_job(
                 {wid: make_task(wid) for wid in wids}, handler
             )
+        except BaseException:
+            # the scheduler's first job blocks (warm-up) and re-raises task
+            # failures synchronously -- release the cohort before propagating
+            for w in wids:
+                ctx.mark_available(w)
+            raise
         finally:
             self.scheduler.set_mode(mode)
         # If the job aborts (a task exhausted retries), release the whole

@@ -1,0 +1,55 @@
+"""Observability: event bus, event logging/replay, metrics registry+sinks.
+
+Parity (SURVEY.md section 5): the reference's observability spine is
+(a) ``SparkListener`` events on ``LiveListenerBus``
+(``scheduler/LiveListenerBus.scala:44``), (b) ``EventLoggingListener`` JSON
+event logs replayed by the history server
+(``scheduler/EventLoggingListener.scala:55``,
+``deploy/history/FsHistoryProvider.scala``), and (c) the Dropwizard
+``MetricsSystem`` with pluggable sinks (``metrics/MetricsSystem.scala:70``).
+This package is the TPU build's equivalent of all three, sized to what a
+host-orchestrated XLA runtime actually emits.
+"""
+
+from asyncframework_tpu.metrics.bus import (
+    Event,
+    GradientMerged,
+    JobEnd,
+    JobStart,
+    Listener,
+    ListenerBus,
+    ModelSnapshot,
+    RoundSubmitted,
+    TaskEnd,
+    WorkerLost,
+)
+from asyncframework_tpu.metrics.eventlog import EventLogReader, EventLogWriter
+from asyncframework_tpu.metrics.system import (
+    Counter,
+    CsvSink,
+    Gauge,
+    Histogram,
+    JsonlSink,
+    MetricsSystem,
+)
+
+__all__ = [
+    "Event",
+    "JobStart",
+    "JobEnd",
+    "TaskEnd",
+    "RoundSubmitted",
+    "GradientMerged",
+    "ModelSnapshot",
+    "WorkerLost",
+    "Listener",
+    "ListenerBus",
+    "EventLogWriter",
+    "EventLogReader",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsSystem",
+    "CsvSink",
+    "JsonlSink",
+]

@@ -1,0 +1,144 @@
+"""JSONL event logging and replay (history-server analog).
+
+Parity: ``EventLoggingListener`` (``scheduler/EventLoggingListener.scala:55``)
+writes one JSON object per line per event; the history server's
+``FsHistoryProvider`` replays the file to rebuild application state.  Here
+:class:`EventLogWriter` is a bus listener streaming events to a JSONL file and
+:class:`EventLogReader` replays a file back into typed events and summary
+statistics (the ``AppStatusStore`` role, trimmed to this framework's event
+vocabulary: rounds, merges, staleness distribution, worker health).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Union
+
+from asyncframework_tpu.metrics.bus import EVENT_TYPES, Event, Listener
+
+
+class EventLogWriter(Listener):
+    """Streams every bus event to a JSONL file; one line per event."""
+
+    def __init__(self, path: Union[str, Path]):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = self.path.open("w", buffering=1)  # line-buffered
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def on_event(self, event: Event) -> None:
+        rec = {"event": type(event).__name__, **asdict(event)}
+        line = json.dumps(rec, separators=(",", ":"), default=_jsonable)
+        with self._lock:
+            if not self._closed:
+                self._f.write(line + "\n")
+
+    # per-type hooks all route to on_event for the writer
+    def __getattr__(self, name: str):
+        if name.startswith("on_"):
+            return self.on_event
+        raise AttributeError(name)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._f.close()
+
+
+def _jsonable(o):
+    if isinstance(o, (tuple, set)):
+        return list(o)
+    return str(o)
+
+
+class EventLogReader:
+    """Replays a JSONL event log into typed events + summary statistics."""
+
+    def __init__(self, path: Union[str, Path]):
+        self.path = Path(path)
+
+    def replay(self) -> Iterator[Event]:
+        with self.path.open() as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                name = rec.pop("event", None)
+                cls = EVENT_TYPES.get(name)
+                if cls is None:
+                    continue  # unknown event type: forward-compat skip
+                fields = {
+                    k: (tuple(v) if isinstance(v, list) else v)
+                    for k, v in rec.items()
+                }
+                try:
+                    yield cls(**fields)
+                except TypeError:
+                    continue  # schema drift: skip unreadable record
+
+    def summary(self) -> Dict[str, object]:
+        """History-server style aggregate view of one run's log."""
+        from asyncframework_tpu.metrics.bus import (
+            GradientMerged,
+            JobEnd,
+            ModelSnapshot,
+            RoundSubmitted,
+            TaskEnd,
+            WorkerLost,
+        )
+
+        n_rounds = 0
+        merges = 0
+        accepted = 0
+        staleness: List[int] = []
+        task_ms: List[float] = []
+        failures = 0
+        lost: List[int] = []
+        trajectory: List[tuple] = []
+        for ev in self.replay():
+            if isinstance(ev, RoundSubmitted):
+                n_rounds += 1
+            elif isinstance(ev, GradientMerged):
+                merges += 1
+                accepted += int(ev.accepted)
+                staleness.append(ev.staleness)
+            elif isinstance(ev, TaskEnd):
+                task_ms.append(ev.run_ms)
+                failures += int(not ev.succeeded)
+            elif isinstance(ev, JobEnd):
+                failures += int(not ev.succeeded)
+            elif isinstance(ev, WorkerLost):
+                lost.append(ev.worker_id)
+            elif isinstance(ev, ModelSnapshot):
+                trajectory.append((ev.time_ms, ev.objective))
+        out: Dict[str, object] = {
+            "rounds": n_rounds,
+            "merges": merges,
+            "accepted": accepted,
+            "dropped_stale": merges - accepted,
+            "workers_lost": lost,
+            "task_failures": failures,
+            "trajectory": trajectory,
+        }
+        if staleness:
+            s = sorted(staleness)
+            out["staleness"] = {
+                "max": s[-1],
+                "mean": sum(s) / len(s),
+                "p50": s[len(s) // 2],
+                "p95": s[min(len(s) - 1, int(0.95 * len(s)))],
+            }
+        if task_ms:
+            t = sorted(task_ms)
+            out["task_ms"] = {
+                "mean": sum(t) / len(t),
+                "p50": t[len(t) // 2],
+                "max": t[-1],
+            }
+        return out

@@ -87,15 +87,22 @@ class GradientDescent:
             mask = jax.random.bernoulli(sub, b, (X.shape[0],)).astype(X.dtype)
             mask = mask * valid
             local_g, local_loss = grad.local(X, y, w, mask)
-            g, loss_sum, count = jax.lax.psum(
+            g, loss_sum, count_raw = jax.lax.psum(
                 (local_g, local_loss, jnp.sum(mask)), axis
             )
-            count = jnp.maximum(count, 1.0)
+            count = jnp.maximum(count_raw, 1.0)
             # MLlib records loss BEFORE this iteration's update, with the
             # regularization value produced by the PREVIOUS update
             # (GradientDescent.scala:271-274).
             stoch_loss = loss_sum / count + prev_reg_val
-            w2, reg_val = upd.apply(w, g / count, step_size, it, reg)
+            w_upd, reg_upd = upd.apply(w, g / count, step_size, it, reg)
+            # MLlib skips the whole iteration when the Bernoulli draw selects
+            # zero rows (no update, no loss-history entry) -- `took` lets the
+            # host drop the phantom entry; the weights must not shrink on
+            # no data (L1/L2 would otherwise decay from sampling noise).
+            took = count_raw > 0.0
+            w2 = jnp.where(took, w_upd, w)
+            reg_val = jnp.where(took, reg_upd, prev_reg_val)
             # write w2 into its snapshot slot when it is a multiple of
             # ``every`` (bounded buffer instead of the full (T, d) stack)
             it_i = it.astype(jnp.int32)
@@ -106,13 +113,15 @@ class GradientDescent:
             snaps = jax.lax.dynamic_update_slice_in_dim(
                 snaps, new_row, slot, axis=0
             )
-            out = (stoch_loss, w2) if want_full else (stoch_loss,)
+            out = (
+                (stoch_loss, took, w2) if want_full else (stoch_loss, took)
+            )
             return (w2, key, reg_val, snaps), out
 
         out_specs = (
-            (P(None), P(None), P(None), P(None))
+            (P(None), P(None), P(None), P(None), P(None))
             if want_full
-            else (P(None), P(None), P(None))
+            else (P(None), P(None), P(None), P(None))
         )
 
         @partial(
@@ -139,10 +148,10 @@ class GradientDescent:
                 jnp.arange(1, T + 1, dtype=jnp.float32),
             )
             if want_full:
-                losses, ws = outs
-                return wT, losses, snaps, ws
-            (losses,) = outs
-            return wT, losses, snaps
+                losses, took, ws = outs
+                return wT, losses, took, snaps, ws
+            losses, took = outs
+            return wT, losses, took, snaps
 
         return jax.jit(train)
 
@@ -181,31 +190,43 @@ class GradientDescent:
             Xs, ys, vs, jnp.asarray(w0, jnp.float32),
             jax.random.PRNGKey(self.seed),
         )
-        wT, losses, snaps = out[0], np.asarray(out[1]), np.asarray(out[2])
-        wT = np.asarray(wT)
-        # Warray parity: (wall-clock ms, weights) at iterations every,
-        # 2*every, ..., plus the final iterate.  The scan ran as one device
-        # program, so timestamps are reconstructed proportionally over the
-        # measured run (the reference stamps real per-iteration wall clock;
-        # ours bounds the same curve).
+        wT = np.asarray(out[0])
+        losses, took = np.asarray(out[1]), np.asarray(out[2])
+        snaps = np.asarray(out[3])
         elapsed_ms = (time.monotonic() - t0) * 1e3
         T, every = self.num_iterations, self.snapshot_every
-        snap_iters = list(range(every, T + 1, every))
-        self._weight_history = [
-            (elapsed_ms * it / T, snaps[i])
-            for i, it in enumerate(snap_iters)
-        ]
-        if T % every != 0 or not snap_iters:
-            self._weight_history.append((elapsed_ms, wT))
+
+        def build_history(upto_iter: int, w_last: np.ndarray):
+            """Warray parity: (wall-clock ms, weights) at iterations every,
+            2*every, ... <= upto_iter, plus the final iterate.  The scan ran
+            as one device program, so timestamps are reconstructed
+            proportionally over the measured run (the reference stamps real
+            per-iteration wall clock; ours bounds the same curve)."""
+            iters = list(range(every, upto_iter + 1, every))
+            hist = [
+                (elapsed_ms * it / T, snaps[i]) for i, it in enumerate(iters)
+            ]
+            if upto_iter % every != 0 or not iters:
+                hist.append((elapsed_ms * upto_iter / T, w_last))
+            return hist
+
         if want_full:
-            ws = np.asarray(out[3])
+            ws = np.asarray(out[4])
             prev = w0
             for i in range(len(ws)):
+                if not took[i]:
+                    continue  # skipped iteration (zero-row sample)
                 diff = np.linalg.norm(ws[i] - prev)
                 if diff < self.convergence_tol * max(np.linalg.norm(ws[i]), 1.0):
-                    return ws[i], losses[: i + 1]
+                    # truncate the trajectory at the convergence point so
+                    # get_all_weights agrees with the returned model
+                    self._weight_history = build_history(i + 1, ws[i])
+                    return ws[i], losses[: i + 1][took[: i + 1]]
                 prev = ws[i]
-        return wT, losses
+        self._weight_history = build_history(T, wT)
+        # drop phantom entries for iterations whose sample drew zero rows
+        # (MLlib appends no history entry for those)
+        return wT, losses[took]
 
     def get_all_weights(self) -> List[Tuple[float, np.ndarray]]:
         """The fork's ``Optimizer.getAllWeights`` trajectory accessor."""
@@ -236,19 +257,15 @@ class LBFGS:
         self.reg_param = reg_param
         self._weight_history: List[Tuple[float, np.ndarray]] = []
         self.loss_history: List[float] = []
+        self._vg_cache: dict = {}
 
-    def optimize(
-        self,
-        X: np.ndarray,
-        y: np.ndarray,
-        w0: Optional[np.ndarray] = None,
-        mesh: Optional[Mesh] = None,
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        mesh = mesh or make_mesh()
-        Xs, ys, vs, n = pad_and_shard(mesh, X, y)
-        grad, reg = self.gradient, self.reg_param
-        self._weight_history = []
-        self.loss_history = []
+    def _get_value_grad(self, mesh: Mesh, shape):
+        """Per-(mesh, shape) compiled full-batch value+gradient (rebuilding
+        the closure per call would recompile on every fit)."""
+        key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names, shape)
+        if key in self._vg_cache:
+            return self._vg_cache[key]
+        grad = self.gradient
 
         @partial(
             jax.shard_map,
@@ -261,7 +278,23 @@ class LBFGS:
             g, loss = jax.lax.psum((g, loss), "dp")
             return loss, g
 
-        value_grad = jax.jit(value_grad)
+        compiled = jax.jit(value_grad)
+        self._vg_cache[key] = compiled
+        return compiled
+
+    def optimize(
+        self,
+        X: np.ndarray,
+        y: np.ndarray,
+        w0: Optional[np.ndarray] = None,
+        mesh: Optional[Mesh] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        mesh = mesh or make_mesh()
+        Xs, ys, vs, n = pad_and_shard(mesh, X, y)
+        reg = self.reg_param
+        self._weight_history = []
+        self.loss_history = []
+        value_grad = self._get_value_grad(mesh, Xs.shape)
 
         def f_g(w: np.ndarray) -> Tuple[float, np.ndarray]:
             loss, g = value_grad(Xs, ys, vs, jnp.asarray(w, jnp.float32))

@@ -19,6 +19,16 @@ class Clock:
     def sleep(self, seconds: float) -> None:
         raise NotImplementedError
 
+    def wait_for(self, event: "threading.Event", seconds: float) -> bool:
+        """Sleep up to ``seconds`` but wake early when ``event`` is set.
+
+        Returns True iff the event was set.  Periodic loops must use this
+        instead of :meth:`sleep` so shutdown can interrupt them (a ManualClock
+        ``sleep`` blocks until virtual time advances, which at shutdown it
+        never does).
+        """
+        raise NotImplementedError
+
 
 class SystemClock(Clock):
     def now_ms(self) -> float:
@@ -26,6 +36,9 @@ class SystemClock(Clock):
 
     def sleep(self, seconds: float) -> None:
         time.sleep(seconds)
+
+    def wait_for(self, event: "threading.Event", seconds: float) -> bool:
+        return event.wait(timeout=seconds)
 
 
 class ManualClock(Clock):
@@ -50,3 +63,13 @@ class ManualClock(Clock):
             deadline = self._ms + seconds * 1000.0
             while self._ms < deadline:
                 self._cond.wait(timeout=1.0)
+
+    def wait_for(self, event, seconds: float) -> bool:
+        """Virtual-time sleep that also wakes (promptly) on ``event``."""
+        with self._cond:
+            deadline = self._ms + seconds * 1000.0
+            while self._ms < deadline:
+                if event.is_set():
+                    return True
+                self._cond.wait(timeout=0.05)
+        return event.is_set()

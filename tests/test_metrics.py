@@ -1,0 +1,232 @@
+"""Metrics subsystem tests: bus fan-out, event-log write/replay, registry."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from asyncframework_tpu.metrics import (
+    CsvSink,
+    EventLogReader,
+    EventLogWriter,
+    GradientMerged,
+    JobEnd,
+    JobStart,
+    JsonlSink,
+    Listener,
+    ListenerBus,
+    MetricsSystem,
+    ModelSnapshot,
+    RoundSubmitted,
+    TaskEnd,
+    WorkerLost,
+)
+from asyncframework_tpu.utils.clock import ManualClock
+
+
+class Recorder(Listener):
+    def __init__(self):
+        self.events = []
+        self.merges = []
+
+    def on_event(self, event):
+        self.events.append(event)
+
+    def on_gradient_merged(self, event):
+        self.merges.append(event)
+        self.events.append(event)
+
+
+def test_bus_sync_delivery_and_typed_hooks():
+    bus = ListenerBus()
+    rec = Recorder()
+    bus.add_listener(rec)
+    bus.post(JobStart(time_ms=1.0, job_id=0, worker_ids=(0, 1)))
+    bus.post(GradientMerged(time_ms=2.0, worker_id=1, staleness=3,
+                            accepted=True, iteration=7))
+    assert len(rec.events) == 2
+    assert len(rec.merges) == 1  # typed hook got the merge
+    assert rec.merges[0].staleness == 3
+
+
+def test_bus_async_dispatch_and_stop():
+    bus = ListenerBus()
+    rec = Recorder()
+    bus.add_listener(rec)
+    bus.start()
+    for i in range(100):
+        bus.post(TaskEnd(time_ms=float(i), job_id=0, worker_id=i % 4,
+                         attempt=0, run_ms=1.0, succeeded=True))
+    bus.stop()
+    assert len(rec.events) == 100
+    assert bus.dropped_events == 0
+
+
+def test_bus_drops_when_full_without_blocking():
+    bus = ListenerBus(capacity=4)
+    slow_release = threading.Event()
+
+    class Slow(Listener):
+        def on_event(self, event):
+            slow_release.wait(timeout=5.0)
+
+    bus.add_listener(Slow())
+    bus.start()
+    for i in range(50):
+        bus.post(JobEnd(time_ms=float(i), job_id=i, succeeded=True))
+    assert bus.dropped_events > 0  # full queue dropped, post never blocked
+    slow_release.set()
+    bus.stop()
+
+
+def test_bad_listener_does_not_kill_bus():
+    bus = ListenerBus()
+
+    class Bad(Listener):
+        def on_event(self, event):
+            raise RuntimeError("boom")
+
+    rec = Recorder()
+    bus.add_listener(Bad())
+    bus.add_listener(rec)
+    bus.post(JobEnd(time_ms=0.0, job_id=1, succeeded=True))
+    assert len(rec.events) == 1
+
+
+def test_eventlog_roundtrip(tmp_path):
+    log = tmp_path / "run" / "events.jsonl"
+    writer = EventLogWriter(log)
+    bus = ListenerBus()
+    bus.add_listener(writer)
+    events = [
+        RoundSubmitted(time_ms=1.0, round_idx=0, cohort=(0, 1, 2),
+                       model_version=1),
+        GradientMerged(time_ms=2.0, worker_id=0, staleness=0, accepted=True,
+                       iteration=1, batch_size=64),
+        GradientMerged(time_ms=3.0, worker_id=1, staleness=5, accepted=False,
+                       iteration=1, batch_size=64),
+        TaskEnd(time_ms=4.0, job_id=0, worker_id=2, attempt=0, run_ms=12.5,
+                succeeded=True),
+        WorkerLost(time_ms=5.0, worker_id=3, reason="heartbeat timeout"),
+        ModelSnapshot(time_ms=6.0, iteration=1, objective=0.5),
+    ]
+    for ev in events:
+        bus.post(ev)
+    writer.close()
+
+    replayed = list(EventLogReader(log).replay())
+    assert replayed == events  # exact typed round-trip (tuples restored)
+
+
+def test_eventlog_summary(tmp_path):
+    log = tmp_path / "events.jsonl"
+    writer = EventLogWriter(log)
+    writer.on_event(RoundSubmitted(time_ms=0.0, round_idx=0, cohort=(0, 1),
+                                   model_version=1))
+    for i in range(10):
+        writer.on_event(GradientMerged(
+            time_ms=float(i), worker_id=i % 2, staleness=i % 4,
+            accepted=(i % 4) <= 2, iteration=i))
+    writer.on_event(ModelSnapshot(time_ms=10.0, iteration=10, objective=0.25))
+    writer.close()
+    s = EventLogReader(log).summary()
+    assert s["rounds"] == 1
+    assert s["merges"] == 10
+    assert s["accepted"] == 8
+    assert s["dropped_stale"] == 2
+    assert s["staleness"]["max"] == 3
+    assert s["trajectory"] == [(10.0, 0.25)]
+
+
+def test_eventlog_skips_unknown_and_corrupt(tmp_path):
+    log = tmp_path / "events.jsonl"
+    log.write_text(
+        '{"event":"JobEnd","time_ms":1.0,"job_id":0,"succeeded":true}\n'
+        '{"event":"FutureEventType","time_ms":2.0,"x":1}\n'
+        '{"event":"JobEnd","time_ms":3.0,"bad_field":true}\n'
+        "\n"
+        '{"event":"JobEnd","time_ms":4.0,"job_id":1,"succeeded":false}\n'
+    )
+    replayed = list(EventLogReader(log).replay())
+    assert [e.job_id for e in replayed] == [0, 1]
+
+
+def test_metrics_registry_and_collect():
+    ms = MetricsSystem()
+    c = ms.counter("updates.accepted")
+    g = ms.gauge("queue.depth")
+    h = ms.histogram("staleness")
+    c.inc(5)
+    g.set(3.0)
+    for v in range(100):
+        h.update(float(v % 10))
+    ms.register_source("engine", lambda: {"workers": 8})
+    out = ms.collect()
+    assert out["updates.accepted"] == 5
+    assert out["queue.depth"] == 3.0
+    assert out["staleness"]["count"] == 100
+    assert out["staleness"]["max"] == 9.0
+    assert out["engine"] == {"workers": 8}
+    # same name returns same instrument; wrong type raises
+    assert ms.counter("updates.accepted") is c
+    with pytest.raises(TypeError):
+        ms.gauge("updates.accepted")
+
+
+def test_metrics_source_error_isolated():
+    ms = MetricsSystem()
+
+    def bad():
+        raise ValueError("nope")
+
+    ms.register_source("bad", bad)
+    out = ms.collect()
+    assert "error" in str(out["bad"])
+
+
+def test_sinks_csv_jsonl(tmp_path):
+    ms = MetricsSystem()
+    ms.counter("a").inc(1)
+    ms.gauge("b.c").set(2.5)
+    csv_path = tmp_path / "m.csv"
+    jsonl_path = tmp_path / "m.jsonl"
+    ms.add_sink(CsvSink(csv_path))
+    ms.add_sink(JsonlSink(jsonl_path))
+    ms.report()
+    ms.counter("a").inc(1)
+    ms.report()
+    ms.stop()
+    lines = csv_path.read_text().strip().splitlines()
+    assert lines[0].startswith("time_ms")
+    assert "a" in lines[0]
+    assert len(lines) == 3  # header + 2 reports
+    import json
+
+    recs = [json.loads(l) for l in jsonl_path.read_text().splitlines()]
+    assert recs[0]["a"] == 1 and recs[1]["a"] == 2
+
+
+def test_polling_loop_with_manual_clock():
+    clock = ManualClock()
+    ms = MetricsSystem(clock=clock)
+    ms.counter("ticks").inc()
+    seen = []
+
+    class Capture:
+        def report(self, t, values):
+            seen.append((t, dict(values)))
+
+        def close(self):
+            pass
+
+    ms.add_sink(Capture())
+    ms.start(period_s=1.0)
+    for _ in range(3):
+        time.sleep(0.05)  # let the loop reach clock.sleep
+        clock.advance(1000.0)
+    deadline = time.monotonic() + 5
+    while len(seen) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    ms.stop()
+    assert len(seen) >= 3
