@@ -21,6 +21,11 @@ from asyncframework_tpu.version import __version__
 
 from asyncframework_tpu.context import AsyncContext, WorkerState, PartialResult
 from asyncframework_tpu.conf import AsyncConf, ConfigEntry
+from asyncframework_tpu.checkpoint import (
+    CheckpointManager,
+    load_checkpoint,
+    save_checkpoint,
+)
 
 __all__ = [
     "__version__",
@@ -29,4 +34,7 @@ __all__ = [
     "PartialResult",
     "AsyncConf",
     "ConfigEntry",
+    "CheckpointManager",
+    "load_checkpoint",
+    "save_checkpoint",
 ]

@@ -1,0 +1,209 @@
+"""Checkpoint / resume of training state: a first-class feature here.
+
+The reference has **no** checkpoint/resume for its training loop -- model
+snapshots go to an in-memory list only (``SparkASGDThread.scala:192-195``);
+its engine-level checkpointing (``rdd/RDD.scala:1773`` ``ReliableCheckpointRDD``)
+persists *datasets*, not solver state.  SURVEY.md section 5 calls out real
+model checkpointing as a capability the TPU build must add.
+
+What a solver checkpoint holds (everything needed for bit-faithful resume):
+``w`` (the model), the accepted-update counter ``k``, the logical clock, every
+worker's PRNG key chain, and -- for ASAGA -- the per-worker gradient-history
+slices plus ``alpha_bar``.
+
+Design:
+- State is a nested dict whose leaves are arrays (numpy or jax; jax arrays are
+  fetched to host on save) or plain scalars/strings.  Nesting is flattened to
+  ``a/b/c`` path keys into one ``.npz`` plus a JSON manifest recording the
+  tree structure and leaf kinds, so restore rebuilds the exact structure.
+- Writes are atomic: serialize into ``<dir>/.tmp-<step>-<pid>`` then
+  ``os.replace`` onto ``<dir>/ckpt-<step>`` -- a reader (or a crash) never
+  observes a partial checkpoint.
+- ``max_to_keep`` garbage-collects old steps after a successful save.
+
+Integer dict keys (worker ids) survive a round trip: they are stored as
+strings in the path encoding and re-created as ``int`` on restore when the
+manifest marks the mapping as int-keyed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from pathlib import Path
+from typing import Any, Dict, List, Mapping, Optional
+
+import numpy as np
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+_SEP = "/"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _flatten(prefix: str, node: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    """Flatten ``node`` into ``arrays``; return the manifest subtree."""
+    if isinstance(node, Mapping):
+        keys = list(node.keys())
+        int_keyed = all(isinstance(k, (int, np.integer)) for k in keys) and keys
+        sub = {}
+        for k in keys:
+            ks = str(int(k)) if int_keyed else str(k)
+            if _SEP in ks:
+                raise ValueError(f"checkpoint keys may not contain '{_SEP}': {k!r}")
+            path = f"{prefix}{_SEP}{ks}" if prefix else ks
+            sub[ks] = _flatten(path, node[k], arrays)
+        return {"kind": "dict", "int_keys": bool(int_keyed), "children": sub}
+    if isinstance(node, (list, tuple)):
+        sub = []
+        for i, v in enumerate(node):
+            path = f"{prefix}{_SEP}{i}" if prefix else str(i)
+            sub.append(_flatten(path, v, arrays))
+        return {"kind": "tuple" if isinstance(node, tuple) else "list",
+                "children": sub}
+    if node is None:
+        return {"kind": "none"}
+    if isinstance(node, bool):
+        return {"kind": "bool", "value": bool(node)}
+    if isinstance(node, (int, np.integer)):
+        return {"kind": "int", "value": int(node)}
+    if isinstance(node, (float, np.floating)):
+        return {"kind": "float", "value": float(node)}
+    if isinstance(node, str):
+        return {"kind": "str", "value": node}
+    # Array leaf: numpy or jax (anything np.asarray can fetch to host).
+    arrays[prefix] = np.asarray(node)
+    return {"kind": "array", "path": prefix}
+
+
+def _unflatten(entry: Dict[str, Any], arrays: Mapping[str, np.ndarray]) -> Any:
+    kind = entry["kind"]
+    if kind == "dict":
+        out = {}
+        for ks, sub in entry["children"].items():
+            key = int(ks) if entry.get("int_keys") else ks
+            out[key] = _unflatten(sub, arrays)
+        return out
+    if kind in ("list", "tuple"):
+        vals = [_unflatten(sub, arrays) for sub in entry["children"]]
+        return tuple(vals) if kind == "tuple" else vals
+    if kind == "none":
+        return None
+    if kind in ("bool", "int", "float", "str"):
+        return entry["value"]
+    if kind == "array":
+        return arrays[entry["path"]]
+    raise ValueError(f"unknown manifest kind {kind!r}")
+
+
+def save_checkpoint(path, state: Mapping[str, Any]) -> None:
+    """Serialize ``state`` into directory ``path`` (created; not atomic --
+    use :class:`CheckpointManager` for atomic step-numbered checkpoints)."""
+    p = Path(path)
+    p.mkdir(parents=True, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    manifest = _flatten("", dict(state), arrays)
+    # npz keys may not be empty; arrays dict keys are full paths (non-empty).
+    np.savez(p / "state.npz", **arrays)
+    (p / "manifest.json").write_text(json.dumps(manifest))
+
+
+def load_checkpoint(path) -> Dict[str, Any]:
+    p = Path(path)
+    manifest = json.loads((p / "manifest.json").read_text())
+    with np.load(p / "state.npz") as npz:
+        arrays = {k: npz[k] for k in npz.files}
+    return _unflatten(manifest, arrays)
+
+
+class CheckpointManager:
+    """Step-numbered atomic checkpoints under one directory.
+
+    ``save`` writes to a temp dir then atomically renames to ``ckpt-<step>``;
+    ``restore`` loads a given (default: latest) step; old steps beyond
+    ``max_to_keep`` are deleted after each successful save.
+    """
+
+    def __init__(self, directory, max_to_keep: int = 3):
+        if max_to_keep < 1:
+            raise ValueError("max_to_keep must be >= 1")
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+
+    # ------------------------------------------------------------------ query
+    def all_steps(self) -> List[int]:
+        steps = []
+        for child in self.directory.iterdir():
+            m = _CKPT_RE.match(child.name)
+            if m and child.is_dir():
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def step_path(self, step: int) -> Path:
+        return self.directory / f"ckpt-{step}"
+
+    # ------------------------------------------------------------------- save
+    def save(self, step: int, state: Mapping[str, Any]) -> Path:
+        if step < 0:
+            raise ValueError("step must be >= 0")
+        final = self.step_path(step)
+        tmp = self.directory / f".tmp-{step}-{os.getpid()}"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        save_checkpoint(tmp, state)
+        if final.exists():  # overwrite same-step checkpoint
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        self._gc()
+        return final
+
+    def restore(self, step: Optional[int] = None) -> Dict[str, Any]:
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}"
+                )
+        path = self.step_path(step)
+        if not path.is_dir():
+            raise FileNotFoundError(f"no checkpoint at step {step}: {path}")
+        return load_checkpoint(path)
+
+    def restore_latest_or_none(self) -> Optional[Dict[str, Any]]:
+        if self.latest_step() is None:
+            return None
+        return self.restore()
+
+    # --------------------------------------------------------------------- gc
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        for step in steps[: max(0, len(steps) - self.max_to_keep)]:
+            shutil.rmtree(self.step_path(step), ignore_errors=True)
+        # sweep orphaned temp dirs from *crashed* writers only: a live pid may
+        # be a concurrent writer mid-save whose dir must not be yanked
+        for child in self.directory.iterdir():
+            if child.name.startswith(".tmp-") and child.is_dir():
+                try:
+                    pid = int(child.name.rsplit("-", 1)[1])
+                except ValueError:
+                    pid = -1
+                if pid == os.getpid():
+                    continue
+                if pid > 0 and _pid_alive(pid):
+                    continue
+                shutil.rmtree(child, ignore_errors=True)

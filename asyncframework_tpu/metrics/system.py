@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import threading
+from collections import deque
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Union
 
@@ -52,7 +53,7 @@ class Histogram:
     """Bounded reservoir histogram (keeps the most recent ``capacity``)."""
 
     def __init__(self, capacity: int = 4096) -> None:
-        self._vals: List[float] = []
+        self._vals: "deque[float]" = deque(maxlen=capacity)
         self._capacity = capacity
         self._lock = threading.Lock()
         self.count = 0
@@ -60,9 +61,7 @@ class Histogram:
     def update(self, v: float) -> None:
         with self._lock:
             self.count += 1
-            self._vals.append(v)
-            if len(self._vals) > self._capacity:
-                self._vals.pop(0)
+            self._vals.append(v)  # deque(maxlen) evicts the oldest in O(1)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -221,7 +220,12 @@ class MetricsSystem:
         with self._lock:
             sinks = list(self._sinks)
         for sink in sinks:
-            sink.report(t, values)
+            try:
+                sink.report(t, values)
+            except Exception:  # noqa: BLE001 - one sink must not kill the rest
+                # mirrors the source-collection shield above; a dead sink
+                # must not terminate the polling thread
+                pass
         return values
 
     def start(self, period_s: float = 10.0) -> None:

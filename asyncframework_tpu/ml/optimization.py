@@ -262,7 +262,12 @@ class LBFGS:
     def _get_value_grad(self, mesh: Mesh, shape):
         """Per-(mesh, shape) compiled full-batch value+gradient (rebuilding
         the closure per call would recompile on every fit)."""
-        key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names, shape)
+        key = (
+            tuple(d.id for d in mesh.devices.flat),
+            mesh.axis_names,
+            shape,
+            id(self.gradient),  # compiled program closes over the gradient
+        )
         if key in self._vg_cache:
             return self._vg_cache[key]
         grad = self.gradient

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncframework_tpu.checkpoint import CheckpointManager
 from asyncframework_tpu.context import AsyncContext
 from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.engine.barrier import bucket_predicate, partial_barrier
@@ -46,6 +47,7 @@ from asyncframework_tpu.solvers.base import (
     TrainResult,
     WaitingTimeTable,
     resolve_dataset,
+    validate_resume,
 )
 
 
@@ -87,26 +89,55 @@ class ASAGA:
         waiting = WaitingTimeTable()
 
         d = self.ds.d
-        w = jax.device_put(jnp.zeros(d, jnp.float32), self.driver_device)
-        alpha_bar = jax.device_put(jnp.zeros(d, jnp.float32), self.driver_device)
-        # the history table: one slice per worker, resident in its HBM
-        alpha: Dict[int, jax.Array] = {
-            wid: jax.device_put(
-                jnp.zeros(self.ds.shard(wid).size, jnp.float32),
-                self._shard_device(wid),
+        mgr = (
+            CheckpointManager(cfg.checkpoint_dir, cfg.checkpoint_keep)
+            if cfg.checkpoint_dir
+            else None
+        )
+        ck = mgr.restore_latest_or_none() if mgr else None
+        if ck is not None:
+            # Resume: model, running history mean, the full per-worker history
+            # table, the accepted counter, logical clock, and PRNG chains.
+            validate_resume(
+                ck.get("meta", {}),
+                solver="asaga", num_workers=nw, d=d, n=self.ds.n,
             )
-            for wid in range(nw)
-        }
-        worker_keys: Dict[int, jax.Array] = {
-            wid: jax.device_put(
-                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid),
-                self._shard_device(wid),
+            k0 = int(ck["k"])
+            ctx.set_current_time(int(ck["clock"]))
+            w = jax.device_put(jnp.asarray(ck["w"]), self.driver_device)
+            alpha_bar = jax.device_put(
+                jnp.asarray(ck["alpha_bar"]), self.driver_device
             )
-            for wid in range(nw)
-        }
+            alpha: Dict[int, jax.Array] = {
+                wid: jax.device_put(jnp.asarray(a), self._shard_device(wid))
+                for wid, a in ck["alpha"].items()
+            }
+            worker_keys: Dict[int, jax.Array] = {
+                wid: jax.device_put(jnp.asarray(key), self._shard_device(wid))
+                for wid, key in ck["worker_keys"].items()
+            }
+        else:
+            k0 = 0
+            w = jax.device_put(jnp.zeros(d, jnp.float32), self.driver_device)
+            alpha_bar = jax.device_put(jnp.zeros(d, jnp.float32), self.driver_device)
+            # the history table: one slice per worker, resident in its HBM
+            alpha = {
+                wid: jax.device_put(
+                    jnp.zeros(self.ds.shard(wid).size, jnp.float32),
+                    self._shard_device(wid),
+                )
+                for wid in range(nw)
+            }
+            worker_keys = {
+                wid: jax.device_put(
+                    jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid),
+                    self._shard_device(wid),
+                )
+                for wid in range(nw)
+            }
         hot_lock = threading.Lock()  # guards alpha/worker_keys handle slots
 
-        state = {"w": w, "ab": alpha_bar, "k": 0, "accepted": 0, "dropped": 0,
+        state = {"w": w, "ab": alpha_bar, "k": k0, "accepted": 0, "dropped": 0,
                  "rounds": 0}
         state_lock = threading.Lock()
         stop = threading.Event()
@@ -115,6 +146,24 @@ class ASAGA:
 
         def now_ms():
             return (time.monotonic() - start_wall) * 1e3
+
+        def save_checkpoint(save_k: int, save_w, save_ab) -> None:
+            with hot_lock:
+                keys_h = {wid: np.asarray(kv) for wid, kv in worker_keys.items()}
+                alpha_h = {wid: np.asarray(a) for wid, a in alpha.items()}
+            mgr.save(
+                save_k,
+                {
+                    "w": np.asarray(save_w),
+                    "alpha_bar": np.asarray(save_ab),
+                    "alpha": alpha_h,
+                    "k": save_k,
+                    "clock": ctx.get_current_time(),
+                    "worker_keys": keys_h,
+                    "meta": {"solver": "asaga", "num_workers": nw,
+                             "d": d, "n": self.ds.n},
+                },
+            )
 
         def updater():
             while not stop.is_set():
@@ -127,6 +176,7 @@ class ASAGA:
                     continue
                 g, diff, mask = res.data
                 task_ms = waiting.on_finish(res.worker_id, now_ms())
+                do_save = False
                 with state_lock:
                     k = state["k"]
                     # ASAGA acceptance quirk: k - staleness <= taw
@@ -151,8 +201,18 @@ class ASAGA:
                         calibrator.record(k, task_ms)
                         if k % cfg.printer_freq == 0:
                             snapshots.append((now_ms(), state["w"]))
+                        do_save = (
+                            mgr is not None
+                            and cfg.checkpoint_freq > 0
+                            and state["k"] % cfg.checkpoint_freq == 0
+                        )
+                        save_k, save_w, save_ab = (
+                            state["k"], state["w"], state["ab"]
+                        )
                     else:
                         state["dropped"] += 1
+                if do_save:
+                    save_checkpoint(save_k, save_w, save_ab)
                 if calibrator.maybe_finalize(state["k"]):
                     delay_model.calibrate(calibrator.avg_delay_ms)
             stop.set()
@@ -209,6 +269,9 @@ class ASAGA:
         with state_lock:
             final_w = np.asarray(state["w"])
             snapshots.append((elapsed * 1e3, state["w"]))
+            final_k, final_w_dev, final_ab = state["k"], state["w"], state["ab"]
+        if mgr is not None:
+            save_checkpoint(final_k, final_w_dev, final_ab)
         traj = self._evaluate_trajectory(snapshots)
         return TrainResult(
             final_w=final_w,

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncframework_tpu.checkpoint import CheckpointManager
 from asyncframework_tpu.context import AsyncContext
 from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.engine.barrier import bucket_predicate, partial_barrier
@@ -43,6 +44,7 @@ from asyncframework_tpu.solvers.base import (
     TrainResult,
     WaitingTimeTable,
     resolve_dataset,
+    validate_resume,
 )
 
 
@@ -82,22 +84,45 @@ class ASGD:
         waiting = WaitingTimeTable()
 
         d = self.ds.d
-        w = jax.device_put(jnp.zeros(d, jnp.float32), self.driver_device)
-        k_dev = jax.device_put(jnp.float32(0.0), self.driver_device)
-        # per-worker device-resident PRNG chains
-        worker_keys: Dict[int, jax.Array] = {
-            wid: jax.device_put(
-                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid),
-                self._shard_device(wid),
+        mgr = (
+            CheckpointManager(cfg.checkpoint_dir, cfg.checkpoint_keep)
+            if cfg.checkpoint_dir
+            else None
+        )
+        ck = mgr.restore_latest_or_none() if mgr else None
+        if ck is not None:
+            # Resume: model, accepted-update counter, logical clock, and every
+            # worker's PRNG chain come back exactly where they stopped.
+            validate_resume(
+                ck.get("meta", {}),
+                solver="asgd", num_workers=nw, d=d, n=self.ds.n,
             )
-            for wid in range(nw)
-        }
+            k0 = int(ck["k"])
+            ctx.set_current_time(int(ck["clock"]))
+            w = jax.device_put(jnp.asarray(ck["w"]), self.driver_device)
+            k_dev = jax.device_put(jnp.float32(k0), self.driver_device)
+            worker_keys: Dict[int, jax.Array] = {
+                wid: jax.device_put(jnp.asarray(key), self._shard_device(wid))
+                for wid, key in ck["worker_keys"].items()
+            }
+        else:
+            k0 = 0
+            w = jax.device_put(jnp.zeros(d, jnp.float32), self.driver_device)
+            k_dev = jax.device_put(jnp.float32(0.0), self.driver_device)
+            # per-worker device-resident PRNG chains
+            worker_keys = {
+                wid: jax.device_put(
+                    jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid),
+                    self._shard_device(wid),
+                )
+                for wid in range(nw)
+            }
         key_lock = threading.Lock()
 
         state = {
             "w": w,
             "k_dev": k_dev,
-            "k": 0,
+            "k": k0,
             "accepted": 0,
             "dropped": 0,
             "rounds": 0,
@@ -111,6 +136,21 @@ class ASGD:
             return (time.monotonic() - start_wall) * 1e3
 
         # ---------------------------------------------------- updater thread
+        def save_checkpoint(save_k: int, save_w) -> None:
+            with key_lock:
+                keys_h = {wid: np.asarray(kv) for wid, kv in worker_keys.items()}
+            mgr.save(
+                save_k,
+                {
+                    "w": np.asarray(save_w),
+                    "k": save_k,
+                    "clock": ctx.get_current_time(),
+                    "worker_keys": keys_h,
+                    "meta": {"solver": "asgd", "num_workers": nw,
+                             "d": d, "n": self.ds.n},
+                },
+            )
+
         def updater():
             while not stop.is_set():
                 with state_lock:
@@ -122,6 +162,7 @@ class ASGD:
                     continue
                 g = res.data
                 task_ms = waiting.on_finish(res.worker_id, now_ms())
+                do_save = False
                 with state_lock:
                     k = state["k"]
                     if res.staleness <= cfg.taw:
@@ -135,8 +176,16 @@ class ASGD:
                         calibrator.record(k, task_ms)
                         if k % cfg.printer_freq == 0:
                             snapshots.append((now_ms(), state["w"]))
+                        do_save = (
+                            mgr is not None
+                            and cfg.checkpoint_freq > 0
+                            and state["k"] % cfg.checkpoint_freq == 0
+                        )
+                        save_k, save_w = state["k"], state["w"]
                     else:
                         state["dropped"] += 1
+                if do_save:
+                    save_checkpoint(save_k, save_w)
                 if calibrator.maybe_finalize(state["k"]):
                     delay_model.calibrate(calibrator.avg_delay_ms)
             stop.set()
@@ -193,6 +242,9 @@ class ASGD:
         with state_lock:
             final_w = np.asarray(state["w"])
             snapshots.append((elapsed * 1e3, state["w"]))
+            final_k, final_w_dev = state["k"], state["w"]
+        if mgr is not None:
+            save_checkpoint(final_k, final_w_dev)
         traj = self._evaluate_trajectory(snapshots)
         return TrainResult(
             final_w=final_w,

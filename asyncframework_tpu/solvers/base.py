@@ -47,6 +47,22 @@ def resolve_dataset(X, y, num_workers: int, devices) -> ShardedDataset:
     return ShardedDataset(X, y, num_workers, devices)
 
 
+def validate_resume(meta: Dict, **expect) -> None:
+    """Fail fast when a checkpoint does not match the resuming run.
+
+    A checkpoint written under a different worker count / dataset shape /
+    solver would otherwise crash deep in the training loop (missing worker
+    ids, wrong history-slice sizes) or silently resume the wrong model.
+    """
+    for key, want in expect.items():
+        got = meta.get(key)
+        if got != want:
+            raise ValueError(
+                f"checkpoint incompatible with this run: {key}={got!r} "
+                f"in checkpoint but {want!r} configured"
+            )
+
+
 @dataclass
 class SolverConfig:
     num_workers: int = 8          # [num partitions]
@@ -63,6 +79,10 @@ class SolverConfig:
     calibration_iters: Optional[int] = None  # default 100 * num_workers
     collect_timeout_s: float = 0.05
     run_timeout_s: float = 600.0
+    # checkpoint/resume (SURVEY.md section 5: a capability the reference lacks)
+    checkpoint_dir: Optional[str] = None  # None = checkpointing off
+    checkpoint_freq: int = 0              # accepted updates between saves; 0 = off
+    checkpoint_keep: int = 3
 
     def effective_calibration_iters(self) -> int:
         if self.calibration_iters is not None:
