@@ -14,11 +14,14 @@ slices plus ``alpha_bar``.
 Design:
 - State is a nested dict whose leaves are arrays (numpy or jax; jax arrays are
   fetched to host on save) or plain scalars/strings.  Nesting is flattened to
-  ``a/b/c`` path keys into one ``.npz`` plus a JSON manifest recording the
-  tree structure and leaf kinds, so restore rebuilds the exact structure.
-- Writes are atomic: serialize into ``<dir>/.tmp-<step>-<pid>`` then
-  ``os.replace`` onto ``<dir>/ckpt-<step>`` -- a reader (or a crash) never
-  observes a partial checkpoint.
+  ``a/b/c`` path keys; everything -- flattened arrays plus a JSON manifest
+  recording tree structure and leaf kinds -- goes into ONE ``.npz`` file, so
+  restore rebuilds the exact structure.
+- A checkpoint being a single file makes every write atomic, including
+  same-step overwrite: serialize to ``.tmp-<step>-<pid>.npz`` then
+  ``os.replace`` onto ``ckpt-<step>.npz`` (rename is atomic even over an
+  existing file) -- a reader or a crash never observes a partial or missing
+  checkpoint at any point.
 - ``max_to_keep`` garbage-collects old steps after a successful save.
 
 Integer dict keys (worker ids) survive a round trip: they are stored as
@@ -31,14 +34,16 @@ from __future__ import annotations
 import json
 import os
 import re
-import shutil
 from pathlib import Path
 from typing import Any, Dict, List, Mapping, Optional
 
 import numpy as np
 
-_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+_CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+_TMP_RE = re.compile(r"^\.tmp-\d+-(\d+)\.npz$")
 _SEP = "/"
+_MANIFEST_KEY = "__manifest__"
+_ARR_PREFIX = "arr:"  # namespaces array keys away from the manifest entry
 
 
 def _pid_alive(pid: int) -> bool:
@@ -107,31 +112,42 @@ def _unflatten(entry: Dict[str, Any], arrays: Mapping[str, np.ndarray]) -> Any:
 
 
 def save_checkpoint(path, state: Mapping[str, Any]) -> None:
-    """Serialize ``state`` into directory ``path`` (created; not atomic --
-    use :class:`CheckpointManager` for atomic step-numbered checkpoints)."""
+    """Serialize ``state`` into the single file ``path`` (parent created).
+
+    Not atomic on its own -- use :class:`CheckpointManager` for atomic
+    step-numbered checkpoints."""
     p = Path(path)
-    p.mkdir(parents=True, exist_ok=True)
+    p.parent.mkdir(parents=True, exist_ok=True)
     arrays: Dict[str, np.ndarray] = {}
     manifest = _flatten("", dict(state), arrays)
-    # npz keys may not be empty; arrays dict keys are full paths (non-empty).
-    np.savez(p / "state.npz", **arrays)
-    (p / "manifest.json").write_text(json.dumps(manifest))
+    payload = {_ARR_PREFIX + k: v for k, v in arrays.items()}
+    payload[_MANIFEST_KEY] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8
+    )
+    # np.savez appends .npz when missing; write via an open handle to keep
+    # the exact path
+    with open(p, "wb") as f:
+        np.savez(f, **payload)
 
 
 def load_checkpoint(path) -> Dict[str, Any]:
-    p = Path(path)
-    manifest = json.loads((p / "manifest.json").read_text())
-    with np.load(p / "state.npz") as npz:
-        arrays = {k: npz[k] for k in npz.files}
+    with np.load(Path(path)) as npz:
+        manifest = json.loads(bytes(npz[_MANIFEST_KEY]).decode())
+        arrays = {
+            k[len(_ARR_PREFIX):]: npz[k]
+            for k in npz.files
+            if k.startswith(_ARR_PREFIX)
+        }
     return _unflatten(manifest, arrays)
 
 
 class CheckpointManager:
     """Step-numbered atomic checkpoints under one directory.
 
-    ``save`` writes to a temp dir then atomically renames to ``ckpt-<step>``;
-    ``restore`` loads a given (default: latest) step; old steps beyond
-    ``max_to_keep`` are deleted after each successful save.
+    ``save`` writes ``.tmp-<step>-<pid>.npz`` then atomically renames onto
+    ``ckpt-<step>.npz`` (overwrite included -- there is a valid checkpoint at
+    the step at every instant); ``restore`` loads a given (default: latest)
+    step; old steps beyond ``max_to_keep`` are deleted after each save.
     """
 
     def __init__(self, directory, max_to_keep: int = 3):
@@ -146,7 +162,7 @@ class CheckpointManager:
         steps = []
         for child in self.directory.iterdir():
             m = _CKPT_RE.match(child.name)
-            if m and child.is_dir():
+            if m and child.is_file():
                 steps.append(int(m.group(1)))
         return sorted(steps)
 
@@ -155,20 +171,16 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def step_path(self, step: int) -> Path:
-        return self.directory / f"ckpt-{step}"
+        return self.directory / f"ckpt-{step}.npz"
 
     # ------------------------------------------------------------------- save
     def save(self, step: int, state: Mapping[str, Any]) -> Path:
         if step < 0:
             raise ValueError("step must be >= 0")
         final = self.step_path(step)
-        tmp = self.directory / f".tmp-{step}-{os.getpid()}"
-        if tmp.exists():
-            shutil.rmtree(tmp)
+        tmp = self.directory / f".tmp-{step}-{os.getpid()}.npz"
         save_checkpoint(tmp, state)
-        if final.exists():  # overwrite same-step checkpoint
-            shutil.rmtree(final)
-        os.replace(tmp, final)
+        os.replace(tmp, final)  # atomic, even over an existing same-step file
         self._gc()
         return final
 
@@ -180,7 +192,7 @@ class CheckpointManager:
                     f"no checkpoints under {self.directory}"
                 )
         path = self.step_path(step)
-        if not path.is_dir():
+        if not path.is_file():
             raise FileNotFoundError(f"no checkpoint at step {step}: {path}")
         return load_checkpoint(path)
 
@@ -193,17 +205,20 @@ class CheckpointManager:
     def _gc(self) -> None:
         steps = self.all_steps()
         for step in steps[: max(0, len(steps) - self.max_to_keep)]:
-            shutil.rmtree(self.step_path(step), ignore_errors=True)
-        # sweep orphaned temp dirs from *crashed* writers only: a live pid may
-        # be a concurrent writer mid-save whose dir must not be yanked
+            try:
+                self.step_path(step).unlink()
+            except FileNotFoundError:
+                pass
+        # sweep temp files from *crashed* writers only: a live pid may be a
+        # concurrent writer mid-save whose file must not be yanked
         for child in self.directory.iterdir():
-            if child.name.startswith(".tmp-") and child.is_dir():
-                try:
-                    pid = int(child.name.rsplit("-", 1)[1])
-                except ValueError:
-                    pid = -1
-                if pid == os.getpid():
-                    continue
-                if pid > 0 and _pid_alive(pid):
-                    continue
-                shutil.rmtree(child, ignore_errors=True)
+            m = _TMP_RE.match(child.name)
+            if m is None:
+                continue
+            pid = int(m.group(1))
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            try:
+                child.unlink()
+            except FileNotFoundError:
+                pass

@@ -3,6 +3,14 @@ from asyncframework_tpu.engine.executor import DeviceExecutor, ExecutorPool, Tas
 from asyncframework_tpu.engine.scheduler import JobScheduler
 from asyncframework_tpu.engine.barrier import partial_barrier
 from asyncframework_tpu.engine.straggler import DelayModel, build_cloud_stragglers
+from asyncframework_tpu.engine.blacklist import BlacklistTracker
+from asyncframework_tpu.engine.speculation import SpeculationMonitor, find_speculatable
+from asyncframework_tpu.engine.recovery import (
+    ReassignmentPlan,
+    ShardRecovery,
+    plan_reassignment,
+)
+from asyncframework_tpu.engine.heartbeat import HeartbeatMonitor
 
 __all__ = [
     "Job",
@@ -15,4 +23,11 @@ __all__ = [
     "partial_barrier",
     "DelayModel",
     "build_cloud_stragglers",
+    "BlacklistTracker",
+    "SpeculationMonitor",
+    "find_speculatable",
+    "ReassignmentPlan",
+    "ShardRecovery",
+    "plan_reassignment",
+    "HeartbeatMonitor",
 ]

@@ -175,10 +175,34 @@ class ExecutorPool:
             wid: DeviceExecutor(wid, status_update, device_of(wid), self._clock)
             for wid in range(num_workers)
         }
+        self._spares: List[DeviceExecutor] = []
 
     def get(self, worker_id: int) -> DeviceExecutor:
         with self._lock:
             return self.executors[worker_id]
+
+    # ----------------------------------------------------- speculative spares
+    def spawn_spare(self, worker_id: int) -> DeviceExecutor:
+        """Extra executor bound to the same device slot, for a speculative
+        copy; not registered under the worker id (the primary keeps it)."""
+        with self._lock:
+            if self.closed:
+                raise RuntimeError("pool is shut down; cannot spawn spare")
+            ex = DeviceExecutor(
+                worker_id, self._status_update, self._device_of(worker_id), self._clock
+            )
+            self._spares.append(ex)
+            return ex
+
+    def is_spare(self, ex: DeviceExecutor) -> bool:
+        with self._lock:
+            return any(s is ex for s in self._spares)
+
+    def discard_spare(self, ex: DeviceExecutor) -> None:
+        """One-shot spares are shut down and dropped after their task."""
+        with self._lock:
+            self._spares = [s for s in self._spares if s is not ex]
+        ex.shutdown()
 
     def replace(self, worker_id: int) -> DeviceExecutor:
         """Start a fresh executor for a dead worker (elastic recovery)."""
@@ -207,6 +231,9 @@ class ExecutorPool:
             self.closed = True
             for ex in self.executors.values():
                 ex.shutdown()
+            for ex in self._spares:
+                ex.shutdown()
+            self._spares = []
 
     def all_metrics(self) -> List[TaskMetrics]:
         with self._lock:

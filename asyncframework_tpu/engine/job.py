@@ -22,12 +22,20 @@ _job_ids = itertools.count()
 
 @dataclass
 class TaskSpec:
-    """One unit of work bound to a logical worker."""
+    """One unit of work bound to a logical worker.
+
+    ``speculative`` marks a duplicate copy launched by the speculation
+    monitor: its success merges normally (first completion wins) but its
+    *failure* is dropped -- the healthy primary is still running and must not
+    be retried or counted against the job's attempt budget.  The flag also
+    keeps the copy from comparing equal to the primary's in-flight entry.
+    """
 
     job_id: int
     worker_id: int
     fn: Callable[[], Any]
     attempt: int = 0
+    speculative: bool = False
 
 
 class JobWaiter:
@@ -47,7 +55,8 @@ class JobWaiter:
     ):
         self.job_id = job_id
         self._expected = set(worker_ids)
-        self._finished: set = set()
+        self._claimed: set = set()   # first completion claims the worker slot
+        self._handled: set = set()   # handler has fully run for the worker
         self._failed: Optional[BaseException] = None
         self._handler = result_handler
         self._lock = threading.Lock()
@@ -57,10 +66,17 @@ class JobWaiter:
             self._done.set()  # zero-task job is trivially complete
 
     def task_succeeded(self, worker_id: int, result: Any) -> None:
+        with self._lock:
+            if worker_id in self._claimed:
+                return  # duplicate completion (speculative copy lost the race)
+            self._claimed.add(worker_id)
+        # Handler runs outside the lock but BEFORE the worker counts toward
+        # completion: await_result must never release while a claimed
+        # result is still being merged.
         self._handler(worker_id, result)
         with self._lock:
-            self._finished.add(worker_id)
-            if self._finished >= self._expected:
+            self._handled.add(worker_id)
+            if self._handled >= self._expected:
                 self._done.set()
 
     def job_failed(self, exc: BaseException) -> None:

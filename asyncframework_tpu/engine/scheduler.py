@@ -19,8 +19,9 @@ the reference warming its block/broadcast caches).
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from asyncframework_tpu.engine.blacklist import BlacklistTracker
 from asyncframework_tpu.engine.executor import DeviceExecutor, ExecutorPool
 from asyncframework_tpu.engine.job import Job, JobWaiter, TaskSpec
 from asyncframework_tpu.utils.clock import Clock, SystemClock
@@ -43,6 +44,7 @@ class JobScheduler:
         max_task_failures: int = 4,
         clock: Optional[Clock] = None,
         pool: Optional[ExecutorPool] = None,
+        blacklist: Optional[BlacklistTracker] = None,
     ):
         self.num_workers = num_workers
         self.max_task_failures = max_task_failures
@@ -54,6 +56,10 @@ class JobScheduler:
         # in-flight task registry for resubmission on executor death:
         # worker_id -> list of TaskSpec currently launched there
         self._inflight: Dict[int, List[TaskSpec]] = {}
+        # speculation bookkeeping: launch stamps + finished durations per job
+        self._launch_ms: Dict[Tuple[int, int], float] = {}
+        self._finished_ms: Dict[int, List[float]] = {}
+        self.blacklist = blacklist
         self.pool = pool or ExecutorPool(
             num_workers, self._status_update, devices=devices, clock=self._clock
         )
@@ -103,7 +109,18 @@ class JobScheduler:
             ex = self.pool.executors[worker_id]
             if not ex.alive:
                 ex = self.pool.replace(worker_id)
+            elif (
+                self.blacklist is not None
+                and self.blacklist.is_blacklisted(worker_id)
+            ):
+                # blacklisted slot: swap in a fresh executor before offering
+                # it more work (the TPU analog of scheduling elsewhere); the
+                # swap heals the slot, so clear the entry -- without this,
+                # every launch in the timeout window would churn executors
+                ex = self.pool.replace(worker_id)
+                self.blacklist.clear(worker_id)
             self._inflight.setdefault(worker_id, []).append(task)
+            self._launch_ms[(task.job_id, worker_id)] = self._clock.now_ms()
         ex.launch_task(task)
 
     # -------------------------------------------------------- status updates
@@ -116,10 +133,22 @@ class JobScheduler:
     ) -> None:
         """Runs on the executor thread (Spark's ``statusUpdate`` path)."""
         with self._lock:
-            lst = self._inflight.get(task.worker_id, [])
-            if task in lst:
-                lst.remove(task)
+            if not task.speculative:
+                lst = self._inflight.get(task.worker_id, [])
+                if task in lst:
+                    lst.remove(task)
+                start = self._launch_ms.pop((task.job_id, task.worker_id), None)
+                if start is not None and exc is None:
+                    self._finished_ms.setdefault(task.job_id, []).append(
+                        self._clock.now_ms() - start
+                    )
             job = self._active_jobs.get(task.job_id)
+        if self.pool.is_spare(executor):
+            self.pool.discard_spare(executor)  # one speculative copy, one task
+        if task.speculative and exc is not None:
+            return  # copy failed; the healthy primary is still running
+        if exc is not None and self.blacklist is not None:
+            self.blacklist.record_failure(task.worker_id)
         if job is None:
             return  # job already finished/aborted (e.g. sync caller gone)
         if exc is None:
@@ -127,6 +156,7 @@ class JobScheduler:
             if job.waiter.completed:
                 with self._lock:
                     self._active_jobs.pop(task.job_id, None)
+                    self._finished_ms.pop(task.job_id, None)
         else:
             self._retry_or_abort(job, task, exc)
 
@@ -148,6 +178,48 @@ class JobScheduler:
             attempt=task.attempt + 1,
         )
         self._launch(task.worker_id, retry)
+
+    # ------------------------------------------------------------ speculation
+    def speculation_snapshot(self) -> Dict[int, Tuple[List[float], Dict[int, float]]]:
+        """Per active job: (finished task durations, running task elapsed).
+
+        Consumed by :class:`~asyncframework_tpu.engine.speculation.SpeculationMonitor`.
+        """
+        now = self._clock.now_ms()
+        with self._lock:
+            out: Dict[int, Tuple[List[float], Dict[int, float]]] = {}
+            for job_id in self._active_jobs:
+                finished = list(self._finished_ms.get(job_id, []))
+                running = {
+                    wid: now - t
+                    for (jid, wid), t in self._launch_ms.items()
+                    if jid == job_id
+                }
+                out[job_id] = (finished, running)
+            return out
+
+    def speculative_launch(self, job_id: int, worker_id: int) -> bool:
+        """Launch a copy of a running task on a spare executor (same device
+        slot, fresh host thread).  First completion wins -- the
+        :class:`JobWaiter` drops the loser.  Returns False when the task
+        already finished (nothing to speculate)."""
+        with self._lock:
+            job = self._active_jobs.get(job_id)
+            if job is None:
+                return False
+            orig = next(
+                (t for t in self._inflight.get(worker_id, []) if t.job_id == job_id),
+                None,
+            )
+            if orig is None:
+                return False
+        copy = TaskSpec(
+            job_id=job_id, worker_id=worker_id, fn=orig.fn,
+            attempt=orig.attempt, speculative=True,
+        )
+        spare = self.pool.spawn_spare(worker_id)
+        spare.launch_task(copy)
+        return True
 
     # ------------------------------------------------------- failure recovery
     def on_executor_lost(self, worker_id: int) -> None:

@@ -85,20 +85,20 @@ class TestManager:
     def test_no_partial_checkpoint_visible(self, tmp_path):
         """Only ckpt-* dirs count; stale temp dirs are not restorable state."""
         mgr = CheckpointManager(tmp_path)
-        (tmp_path / ".tmp-99-99999999").mkdir()  # pid guaranteed dead
+        (tmp_path / ".tmp-99-99999999.npz").touch()  # pid guaranteed dead
         assert mgr.latest_step() is None
         mgr.save(1, {"k": 1})
-        # a crashed foreign writer's orphan temp dir was swept by gc
-        assert not (tmp_path / ".tmp-99-99999999").exists()
+        # a crashed foreign writer's orphan temp file was swept by gc
+        assert not (tmp_path / ".tmp-99-99999999.npz").exists()
         assert mgr.all_steps() == [1]
 
-    def test_live_writer_tmp_dir_not_swept(self, tmp_path):
+    def test_live_writer_tmp_file_not_swept(self, tmp_path):
         """A concurrent *live* process's in-progress save must survive gc."""
         import os
 
         mgr = CheckpointManager(tmp_path)
-        live = tmp_path / f".tmp-7-{os.getppid()}"
-        live.mkdir()
+        live = tmp_path / f".tmp-7-{os.getppid()}.npz"
+        live.touch()
         mgr.save(1, {"k": 1})
         assert live.exists()
 

@@ -1,0 +1,269 @@
+"""Fault-tolerance layer: blacklist, speculation, shard recovery.
+
+Parity targets (SURVEY.md section 5): ``BlacklistTracker.scala`` windowed
+failure counting with timed expiry, ``TaskSetManager.checkSpeculatableTasks``
+quantile/multiplier policy, and the executor-loss -> recompute-elsewhere
+story (lineage recomputation becomes explicit shard re-placement here).
+All policy logic is tested with a ManualClock / pure inputs (the
+``DAGSchedulerSuite`` zero-threads style), then integrated against the real
+thread-backed engine.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.engine import (
+    BlacklistTracker,
+    ExecutorPool,
+    JobScheduler,
+    ShardRecovery,
+    SpeculationMonitor,
+    find_speculatable,
+    plan_reassignment,
+)
+from asyncframework_tpu.engine.scheduler import ASYNC
+from asyncframework_tpu.data.sharded import ShardedDataset
+from asyncframework_tpu.utils.clock import ManualClock
+
+
+class TestBlacklistTracker:
+    def test_blacklists_after_max_failures(self):
+        clock = ManualClock()
+        bl = BlacklistTracker(max_failures=2, timeout_ms=1000, clock=clock)
+        bl.record_failure(3)
+        assert not bl.is_blacklisted(3)
+        bl.record_failure(3)
+        assert bl.is_blacklisted(3)
+        assert bl.blacklisted_workers() == [3]
+        assert not bl.is_blacklisted(0)
+
+    def test_expires_after_timeout(self):
+        clock = ManualClock()
+        bl = BlacklistTracker(max_failures=1, timeout_ms=500, clock=clock)
+        bl.record_failure(1)
+        assert bl.is_blacklisted(1)
+        clock.advance(501)
+        assert not bl.is_blacklisted(1)
+
+    def test_window_prunes_old_failures(self):
+        clock = ManualClock()
+        bl = BlacklistTracker(
+            max_failures=2, timeout_ms=10_000, window_ms=100, clock=clock
+        )
+        bl.record_failure(5)
+        clock.advance(200)  # first failure falls out of the window
+        bl.record_failure(5)
+        assert not bl.is_blacklisted(5)
+        assert bl.failure_count(5) == 1
+
+    def test_scheduler_replaces_blacklisted_executor(self):
+        """After a worker is blacklisted, the next launch gets a fresh
+        executor for that slot (the reference's schedule-elsewhere analog)."""
+        bl = BlacklistTracker(max_failures=2, timeout_ms=60_000)
+        sched = JobScheduler(num_workers=2, max_task_failures=10, blacklist=bl)
+        sched.set_mode(ASYNC)
+        try:
+            calls = {"n": 0}
+
+            def flaky():
+                calls["n"] += 1
+                if calls["n"] <= 2:
+                    raise RuntimeError("boom")
+                return "ok"
+
+            before = sched.pool.executors[0]
+            results = []
+            waiter = sched.run_job({0: flaky}, lambda wid, r: results.append(r))
+            waiter.await_result(timeout=30)
+            assert results == ["ok"]
+            # retries rotated the slot onto a replacement executor, and the
+            # swap healed the slot (entry cleared -- no executor churn after)
+            assert sched.pool.executors[0] is not before
+            assert not bl.is_blacklisted(0)
+            assert bl.failure_count(0) == 0
+        finally:
+            sched.shutdown()
+
+
+class TestFindSpeculatable:
+    def test_below_quantile_no_speculation(self):
+        assert find_speculatable([100.0], {1: 10_000.0}, quantile=0.75) == []
+
+    def test_slow_tail_selected(self):
+        finished = [100.0, 110.0, 90.0, 105.0, 95.0, 100.0]
+        running = {6: 500.0, 7: 120.0}
+        out = find_speculatable(finished, running, quantile=0.5, multiplier=1.5)
+        assert out == [6]
+
+    def test_min_time_floor(self):
+        # median is tiny; min_time_ms keeps short tasks from speculating
+        out = find_speculatable([1.0, 1.0, 1.0], {3: 20.0}, quantile=0.5,
+                                multiplier=1.5, min_time_ms=100.0)
+        assert out == []
+
+    def test_no_finished_no_speculation(self):
+        assert find_speculatable([], {0: 1e9}) == []
+
+
+class TestSpeculationIntegration:
+    def test_speculative_copy_rescues_stuck_task(self):
+        """7 fast tasks + 1 stuck task; the monitor launches a copy on a
+        spare executor, the copy finishes, the job completes while the
+        original is still blocked; the original's late result is dropped."""
+        release = threading.Event()
+        first_call = {"done": False}
+        lock = threading.Lock()
+
+        def make_fn(wid):
+            if wid != 7:
+                return lambda: wid
+            def stuck():
+                with lock:
+                    first = not first_call["done"]
+                    first_call["done"] = True
+                if first:
+                    release.wait(timeout=30)  # primary: blocked
+                return wid                     # speculative copy: instant
+            return stuck
+
+        sched = JobScheduler(num_workers=8)
+        sched.set_mode(ASYNC)
+        monitor = SpeculationMonitor(
+            sched, quantile=0.75, multiplier=1.5, min_time_ms=10.0
+        )
+        results = []
+        res_lock = threading.Lock()
+
+        def handler(wid, r):
+            with res_lock:
+                results.append((wid, r))
+
+        try:
+            # first job always blocks (warm-up parity); make it trivial
+            sched.run_job({0: lambda: None}, lambda w, r: None)
+            waiter = sched.run_job({w: make_fn(w) for w in range(8)}, handler)
+            deadline = time.monotonic() + 30
+            launched = []
+            while not launched and time.monotonic() < deadline:
+                time.sleep(0.05)
+                launched = monitor.check_once()
+            assert launched, "monitor never found the stuck task"
+            waiter.await_result(timeout=30)
+            with res_lock:
+                assert sorted(r for _, r in results) == list(range(8))
+            # releasing the primary must not double-merge worker 7
+            release.set()
+            time.sleep(0.3)
+            with res_lock:
+                assert len(results) == 8
+            assert monitor.speculated_count() == 1
+        finally:
+            release.set()
+            sched.shutdown()
+
+    def test_failed_speculative_copy_is_dropped(self):
+        """A crashing copy must not retry/abort the healthy primary's job."""
+        release = threading.Event()
+        calls = {"n": 0}
+        lock = threading.Lock()
+
+        def task():
+            with lock:
+                calls["n"] += 1
+                first = calls["n"] == 1
+            if first:
+                release.wait(timeout=30)  # primary: slow but healthy
+                return "primary"
+            raise RuntimeError("speculative copy crashes")
+
+        sched = JobScheduler(num_workers=2, max_task_failures=1)
+        sched.set_mode(ASYNC)
+        monitor = SpeculationMonitor(sched, quantile=0.5, min_time_ms=1.0)
+        results = []
+        try:
+            sched.run_job({0: lambda: None}, lambda w, r: None)  # warm-up
+            waiter = sched.run_job(
+                {0: task, 1: lambda: "fast"}, lambda w, r: results.append(r)
+            )
+            deadline = time.monotonic() + 30
+            while not monitor.check_once():
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            time.sleep(0.2)  # let the copy crash and be (dropped) reported
+            assert waiter.failed is None, "copy failure aborted the job"
+            release.set()
+            waiter.await_result(timeout=30)
+            assert sorted(results) == ["fast", "primary"]
+        finally:
+            release.set()
+            sched.shutdown()
+
+    def test_one_copy_per_task(self):
+        release = threading.Event()
+
+        def stuck():
+            release.wait(timeout=30)
+            return 0
+
+        sched = JobScheduler(num_workers=2)
+        sched.set_mode(ASYNC)
+        monitor = SpeculationMonitor(sched, quantile=0.5, min_time_ms=1.0)
+        try:
+            sched.run_job({0: lambda: None}, lambda w, r: None)  # warm-up
+            waiter = sched.run_job({0: stuck, 1: lambda: 1}, lambda w, r: None)
+            deadline = time.monotonic() + 30
+            while not monitor.check_once():
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            # further scans must not launch more copies for the same task
+            assert monitor.check_once() == []
+            assert monitor.speculated_count() == 1
+            release.set()
+            waiter.await_result(timeout=30)
+        finally:
+            release.set()
+            sched.shutdown()
+
+
+class TestShardRecovery:
+    def test_plan_balanced_and_deterministic(self):
+        plan = plan_reassignment(range(8), dead=[2, 5, 6])
+        assert set(plan.moves) == {2, 5, 6}
+        assert all(t not in {2, 5, 6} for t in plan.moves.values())
+        # least-loaded round robin: three distinct survivors adopt
+        assert len(set(plan.moves.values())) == 3
+        assert plan == plan_reassignment(range(8), dead=[6, 2, 5])
+
+    def test_no_survivors_raises(self):
+        with pytest.raises(RuntimeError):
+            plan_reassignment(range(2), dead=[0, 1])
+
+    def test_move_shard_relocates_data(self, devices8):
+        rs = np.random.default_rng(0)
+        X = rs.normal(size=(64, 4)).astype(np.float32)
+        y = rs.normal(size=(64,)).astype(np.float32)
+        ds = ShardedDataset(X, y, num_workers=8, devices=devices8)
+        rec = ShardRecovery(ds, devices8)
+        lo, hi = ds.partition_cum[3], ds.partition_cum[4]
+
+        moved = rec.move_shard(3, 0)
+        assert moved.X.device == devices8[0]
+        np.testing.assert_array_equal(np.asarray(moved.X), X[lo:hi])
+        assert rec.owner(3) == 0
+        # worker 0 now computes its own shard plus the adopted one
+        assert [s.worker_id for s in rec.assignments(0)] == [0, 3]
+        assert rec.assignments(3) == []
+
+    def test_apply_plan(self, devices8):
+        ds = ShardedDataset.generate_on_device(64, 4, 8, devices=devices8)
+        rec = ShardRecovery(ds, devices8)
+        plan = plan_reassignment(range(8), dead=[1, 4])
+        rec.apply(plan)
+        for sid, owner in plan.moves.items():
+            assert rec.owner(sid) == owner
+            assert rec.shard(sid).X.device == devices8[owner % 8]
+        total = sum(len(rec.assignments(w)) for w in range(8))
+        assert total == 8  # every shard still owned exactly once
