@@ -1,0 +1,264 @@
+"""The submit CLI: drop-in experiment recipes with the reference's arguments.
+
+Parity: each reference driver takes 13 positional args
+(``SparkASGDThread.scala:28-48``; example submit in ``README.md:46``)::
+
+    <path> <file> <d> <N> <numPart> <numIter> <gamma> <taw> <batchRate>
+    <bucketRatio> <printerFreq> <coeff> <seed>
+
+Here the same recipe is::
+
+    python -m asyncframework_tpu.cli SparkASGDThread \
+        /data mnist8m.scale 784 8100000 64 16000 1.5625e-3 20000000 \
+        0.01 0.7 200 -1 42
+
+Driver names accept both the reference class names (``SparkASGDThread``,
+``SparkASGDSync``, ``SparkASAGAThread``, ``SparkASAGASync``,
+``SparkSGDMLLIB``) and short forms (``asgd``, ``asgd-sync``, ``asaga``,
+``asaga-sync``, ``sgd-mllib``).  ``--conf key=value`` overlays any registered
+:class:`~asyncframework_tpu.conf.ConfigEntry` (CLI > conf file > env >
+default precedence, like ``spark-submit --conf``).
+
+Data: ``<path>/<file>`` is a LibSVM file loaded with ``d`` features; the
+special path ``synthetic`` generates an ``N x d`` planted least-squares
+problem directly in device HBM instead (no reference analog -- Spark always
+reads files -- but indispensable on a TPU host with no dataset mounted).
+
+Output: the loss trajectory is printed as ``(ms, objective)`` pairs exactly
+like the drivers' final loop (``SparkASGDThread.scala:386-401``), followed by
+one JSON summary line (machine-readable; consumed by bench harnesses).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+from asyncframework_tpu.conf import AsyncConf, registry
+
+# registered ConfigEntry key -> SolverConfig field, for --conf overlays
+CONF_TO_FIELD: Dict[str, str] = {
+    "async.num.workers": "num_workers",
+    "async.num.iterations": "num_iterations",
+    "async.step.size": "gamma",
+    "async.taw": "taw",
+    "async.batch.rate": "batch_rate",
+    "async.bucket.ratio": "bucket_ratio",
+    "async.printer.freq": "printer_freq",
+    "async.delay.coeff": "coeff",
+    "async.seed": "seed",
+}
+
+DRIVER_ALIASES: Dict[str, str] = {
+    "sparkasgdthread": "asgd",
+    "asgd": "asgd",
+    "sparkasgdsync": "asgd-sync",
+    "asgd-sync": "asgd-sync",
+    "sparkasagathread": "asaga",
+    "asaga": "asaga",
+    "sparkasagasync": "asaga-sync",
+    "asaga-sync": "asaga-sync",
+    "sparksgdmllib": "sgd-mllib",
+    "sgd-mllib": "sgd-mllib",
+}
+
+POSITIONAL = [
+    ("path", str, "data directory, or 'synthetic'"),
+    ("file", str, "LibSVM file name (ignored for synthetic)"),
+    ("d", int, "number of features (columns)"),
+    ("N", int, "number of rows"),
+    ("num_partitions", int, "number of workers/partitions"),
+    ("num_iterations", int, "iterations (accepted updates)"),
+    ("gamma", float, "step size"),
+    ("taw", int, "staleness bound tau"),
+    ("batch_rate", float, "Bernoulli batch rate b"),
+    ("bucket_ratio", float, "cohort availability threshold"),
+    ("printer_freq", int, "trajectory snapshot period"),
+    ("coeff", float, "delay intensity (-1 = cloud long-tail)"),
+    ("seed", int, "root PRNG seed"),
+]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="async-submit",
+        description=__doc__.split("\n\n")[0],
+    )
+    p.add_argument("driver", help="driver class (SparkASGDThread/asgd, ...)")
+    for name, typ, doc in POSITIONAL:
+        p.add_argument(name, type=typ, help=doc)
+    p.add_argument("--conf", action="append", default=[], metavar="K=V",
+                   help="config overlay (repeatable)")
+    p.add_argument("--loss", default="least_squares",
+                   choices=["least_squares", "logistic"])
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-freq", type=int, default=0)
+    p.add_argument("--output", default=None,
+                   help="write the trajectory as CSV to this path")
+    p.add_argument("--devices", type=int, default=None,
+                   help="use only the first N jax devices")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the per-snapshot trajectory lines")
+    return p
+
+
+def parse_conf_overlays(pairs: List[str]) -> AsyncConf:
+    conf = AsyncConf()
+    known = registry()
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--conf expects key=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        k = k.strip()
+        if k not in known:
+            raise SystemExit(
+                f"--conf: unknown key {k!r}; registered keys: "
+                + ", ".join(sorted(known))
+            )
+        conf.set(k, v.strip())
+    return conf
+
+
+def load_data(args, devices, need_host: bool = False):
+    """Resolve (X, y) or a device-resident ShardedDataset per the recipe.
+
+    ``need_host=True`` (the SPMD mllib baseline) forces host arrays even for
+    synthetic data -- it shards the *global* arrays over the mesh itself.
+    """
+    from asyncframework_tpu.data.sharded import ShardedDataset
+
+    if args.path == "synthetic":
+        if need_host:
+            import numpy as np
+
+            rs = np.random.default_rng(args.seed)
+            X = (rs.normal(size=(args.N, args.d)) / np.sqrt(args.d)).astype(
+                np.float32
+            )
+            w_true = rs.normal(size=(args.d,)).astype(np.float32)
+            y = (X @ w_true + 0.01 * rs.normal(size=(args.N,))).astype(
+                np.float32
+            )
+            return X, y
+        ds = ShardedDataset.generate_on_device(
+            args.N, args.d, args.num_partitions, devices=devices,
+            seed=args.seed,
+        )
+        return ds, None
+    path = os.path.join(args.path, args.file)
+    if not os.path.exists(path):
+        raise SystemExit(f"no such data file: {path}")
+    from asyncframework_tpu.data.libsvm import load_libsvm
+
+    X, y = load_libsvm(path, num_features=args.d)
+    if args.N and X.shape[0] > args.N:
+        X, y = X[: args.N], y[: args.N]
+    return X, y
+
+
+def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
+    import jax
+
+    from asyncframework_tpu.solvers import ASAGA, ASGD, MiniBatchSGD, SolverConfig
+
+    driver = DRIVER_ALIASES.get(args.driver.lower())
+    if driver is None:
+        raise SystemExit(
+            f"unknown driver {args.driver!r}; one of "
+            f"{sorted(set(DRIVER_ALIASES.values()))} (or reference class names)"
+        )
+    devices = jax.devices()
+    if args.devices is not None:
+        devices = devices[: args.devices]
+
+    if args.checkpoint_dir and (driver.endswith("-sync") or driver == "sgd-mllib"):
+        raise SystemExit(
+            "--checkpoint-dir is supported by the async drivers only "
+            "(asgd, asaga); sync and sgd-mllib runs do not checkpoint"
+        )
+
+    cfg = SolverConfig(
+        num_workers=args.num_partitions,
+        num_iterations=args.num_iterations,
+        gamma=args.gamma,
+        taw=args.taw,
+        batch_rate=args.batch_rate,
+        bucket_ratio=args.bucket_ratio,
+        printer_freq=args.printer_freq,
+        coeff=args.coeff,
+        seed=args.seed,
+        loss=args.loss,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_freq=args.checkpoint_freq,
+    )
+    # conf overlays beat recipe args for every registered solver knob
+    for key, field in CONF_TO_FIELD.items():
+        if conf.contains(key):
+            setattr(cfg, field, conf.get(key))
+
+    X, y = load_data(args, devices, need_host=(driver == "sgd-mllib"))
+    t0 = time.monotonic()
+    if driver == "sgd-mllib":
+        from asyncframework_tpu.parallel import make_mesh
+
+        Xh, yh = (X, y) if y is not None else X.global_arrays()
+        n_mesh = len(devices)
+        sgd = MiniBatchSGD(  # reads cfg so --conf overlays apply here too
+            gamma=cfg.gamma, batch_rate=cfg.batch_rate,
+            num_iterations=cfg.num_iterations, loss=cfg.loss,
+            seed=cfg.seed, snapshot_every=cfg.printer_freq,
+        )
+        mesh = make_mesh(n_mesh, devices=devices)
+        w, losses, snaps = sgd.run(Xh, yh, mesh=mesh)
+        elapsed = time.monotonic() - t0
+        trajectory = [(float(i), float(l)) for i, l in enumerate(losses)]
+        summary = {
+            "driver": driver,
+            "final_objective": float(losses[-1]) if len(losses) else None,
+            "iterations": len(losses),
+            "elapsed_s": elapsed,
+            "snapshots": len(snaps),
+        }
+    else:
+        solver_cls = ASGD if driver.startswith("asgd") else ASAGA
+        solver = solver_cls(X, y, cfg, devices=devices)
+        res = solver.run_sync() if driver.endswith("-sync") else solver.run()
+        trajectory = res.trajectory
+        summary = {
+            "driver": driver,
+            "final_objective": res.final_objective,
+            "accepted": res.accepted,
+            "dropped": res.dropped,
+            "rounds": res.rounds,
+            "max_staleness": res.max_staleness,
+            "avg_delay_ms": res.avg_delay_ms,
+            "updates_per_sec": res.updates_per_sec,
+            "elapsed_s": res.elapsed_s,
+        }
+    summary["trajectory"] = trajectory
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    conf = parse_conf_overlays(args.conf)
+    summary = run_driver(args, conf)
+    trajectory = summary.pop("trajectory")
+    if not args.quiet:
+        for t_ms, obj in trajectory:
+            print(f"({t_ms:.1f},{obj:.8g})")
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write("ms,objective\n")
+            for t_ms, obj in trajectory:
+                f.write(f"{t_ms:.3f},{obj:.10g}\n")
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

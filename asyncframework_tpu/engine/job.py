@@ -65,6 +65,12 @@ class JobWaiter:
         if not self._expected:
             self._done.set()  # zero-task job is trivially complete
 
+    def is_claimed(self, worker_id: int) -> bool:
+        """True when some completion (primary or speculative) already claimed
+        this worker's slot -- a late failure of the other copy is then moot."""
+        with self._lock:
+            return worker_id in self._claimed
+
     def task_succeeded(self, worker_id: int, result: Any) -> None:
         with self._lock:
             if worker_id in self._claimed:

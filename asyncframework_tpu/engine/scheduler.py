@@ -133,16 +133,18 @@ class JobScheduler:
     ) -> None:
         """Runs on the executor thread (Spark's ``statusUpdate`` path)."""
         with self._lock:
+            job = self._active_jobs.get(task.job_id)
             if not task.speculative:
                 lst = self._inflight.get(task.worker_id, [])
                 if task in lst:
                     lst.remove(task)
                 start = self._launch_ms.pop((task.job_id, task.worker_id), None)
-                if start is not None and exc is None:
+                # record only while the job is live: a losing primary landing
+                # after completion must not resurrect the entry (leak)
+                if start is not None and exc is None and job is not None:
                     self._finished_ms.setdefault(task.job_id, []).append(
                         self._clock.now_ms() - start
                     )
-            job = self._active_jobs.get(task.job_id)
         if self.pool.is_spare(executor):
             self.pool.discard_spare(executor)  # one speculative copy, one task
         if task.speculative and exc is not None:
@@ -151,6 +153,10 @@ class JobScheduler:
             self.blacklist.record_failure(task.worker_id)
         if job is None:
             return  # job already finished/aborted (e.g. sync caller gone)
+        if exc is not None and job.waiter.is_claimed(task.worker_id):
+            # primary failed after its speculative copy already delivered the
+            # result: nothing to retry, and certainly nothing to abort
+            return
         if exc is None:
             job.waiter.task_succeeded(task.worker_id, result)
             if job.waiter.completed:
@@ -170,6 +176,7 @@ class JobScheduler:
             )
             with self._lock:
                 self._active_jobs.pop(job.job_id, None)
+                self._finished_ms.pop(job.job_id, None)
             return
         retry = TaskSpec(
             job_id=task.job_id,
@@ -241,6 +248,7 @@ class JobScheduler:
             if retry.attempt >= self.max_task_failures:
                 with self._lock:
                     job = self._active_jobs.pop(task.job_id, None)
+                    self._finished_ms.pop(task.job_id, None)
                 if job is not None:
                     job.waiter.job_failed(
                         RuntimeError(

@@ -34,7 +34,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from asyncframework_tpu.checkpoint import CheckpointManager
 from asyncframework_tpu.context import AsyncContext
 from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.engine.barrier import bucket_predicate, partial_barrier
@@ -43,11 +42,11 @@ from asyncframework_tpu.engine.straggler import DelayModel
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers.base import (
     DelayCalibrator,
+    SolverCheckpointer,
     SolverConfig,
     TrainResult,
     WaitingTimeTable,
     resolve_dataset,
-    validate_resume,
 )
 
 
@@ -89,19 +88,11 @@ class ASAGA:
         waiting = WaitingTimeTable()
 
         d = self.ds.d
-        mgr = (
-            CheckpointManager(cfg.checkpoint_dir, cfg.checkpoint_keep)
-            if cfg.checkpoint_dir
-            else None
-        )
-        ck = mgr.restore_latest_or_none() if mgr else None
+        ckpt = SolverCheckpointer(cfg, "asaga", d, self.ds.n)
+        ck = ckpt.restore()
         if ck is not None:
             # Resume: model, running history mean, the full per-worker history
             # table, the accepted counter, logical clock, and PRNG chains.
-            validate_resume(
-                ck.get("meta", {}),
-                solver="asaga", num_workers=nw, d=d, n=self.ds.n,
-            )
             k0 = int(ck["k"])
             ctx.set_current_time(int(ck["clock"]))
             w = jax.device_put(jnp.asarray(ck["w"]), self.driver_device)
@@ -151,18 +142,13 @@ class ASAGA:
             with hot_lock:
                 keys_h = {wid: np.asarray(kv) for wid, kv in worker_keys.items()}
                 alpha_h = {wid: np.asarray(a) for wid, a in alpha.items()}
-            mgr.save(
+            ckpt.save(
                 save_k,
-                {
-                    "w": np.asarray(save_w),
-                    "alpha_bar": np.asarray(save_ab),
-                    "alpha": alpha_h,
-                    "k": save_k,
-                    "clock": ctx.get_current_time(),
-                    "worker_keys": keys_h,
-                    "meta": {"solver": "asaga", "num_workers": nw,
-                             "d": d, "n": self.ds.n},
-                },
+                w=np.asarray(save_w),
+                alpha_bar=np.asarray(save_ab),
+                alpha=alpha_h,
+                clock=ctx.get_current_time(),
+                worker_keys=keys_h,
             )
 
         def updater():
@@ -201,11 +187,7 @@ class ASAGA:
                         calibrator.record(k, task_ms)
                         if k % cfg.printer_freq == 0:
                             snapshots.append((now_ms(), state["w"]))
-                        do_save = (
-                            mgr is not None
-                            and cfg.checkpoint_freq > 0
-                            and state["k"] % cfg.checkpoint_freq == 0
-                        )
+                        do_save = ckpt.should_save(state["k"])
                         save_k, save_w, save_ab = (
                             state["k"], state["w"], state["ab"]
                         )
@@ -270,7 +252,7 @@ class ASAGA:
             final_w = np.asarray(state["w"])
             snapshots.append((elapsed * 1e3, state["w"]))
             final_k, final_w_dev, final_ab = state["k"], state["w"], state["ab"]
-        if mgr is not None:
+        if ckpt.enabled:
             save_checkpoint(final_k, final_w_dev, final_ab)
         traj = self._evaluate_trajectory(snapshots)
         return TrainResult(

@@ -31,7 +31,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from asyncframework_tpu.checkpoint import CheckpointManager
 from asyncframework_tpu.context import AsyncContext
 from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.engine.barrier import bucket_predicate, partial_barrier
@@ -40,11 +39,11 @@ from asyncframework_tpu.engine.straggler import DelayModel
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers.base import (
     DelayCalibrator,
+    SolverCheckpointer,
     SolverConfig,
     TrainResult,
     WaitingTimeTable,
     resolve_dataset,
-    validate_resume,
 )
 
 
@@ -84,19 +83,11 @@ class ASGD:
         waiting = WaitingTimeTable()
 
         d = self.ds.d
-        mgr = (
-            CheckpointManager(cfg.checkpoint_dir, cfg.checkpoint_keep)
-            if cfg.checkpoint_dir
-            else None
-        )
-        ck = mgr.restore_latest_or_none() if mgr else None
+        ckpt = SolverCheckpointer(cfg, "asgd", d, self.ds.n)
+        ck = ckpt.restore()
         if ck is not None:
             # Resume: model, accepted-update counter, logical clock, and every
             # worker's PRNG chain come back exactly where they stopped.
-            validate_resume(
-                ck.get("meta", {}),
-                solver="asgd", num_workers=nw, d=d, n=self.ds.n,
-            )
             k0 = int(ck["k"])
             ctx.set_current_time(int(ck["clock"]))
             w = jax.device_put(jnp.asarray(ck["w"]), self.driver_device)
@@ -139,16 +130,11 @@ class ASGD:
         def save_checkpoint(save_k: int, save_w) -> None:
             with key_lock:
                 keys_h = {wid: np.asarray(kv) for wid, kv in worker_keys.items()}
-            mgr.save(
+            ckpt.save(
                 save_k,
-                {
-                    "w": np.asarray(save_w),
-                    "k": save_k,
-                    "clock": ctx.get_current_time(),
-                    "worker_keys": keys_h,
-                    "meta": {"solver": "asgd", "num_workers": nw,
-                             "d": d, "n": self.ds.n},
-                },
+                w=np.asarray(save_w),
+                clock=ctx.get_current_time(),
+                worker_keys=keys_h,
             )
 
         def updater():
@@ -176,11 +162,7 @@ class ASGD:
                         calibrator.record(k, task_ms)
                         if k % cfg.printer_freq == 0:
                             snapshots.append((now_ms(), state["w"]))
-                        do_save = (
-                            mgr is not None
-                            and cfg.checkpoint_freq > 0
-                            and state["k"] % cfg.checkpoint_freq == 0
-                        )
+                        do_save = ckpt.should_save(state["k"])
                         save_k, save_w = state["k"], state["w"]
                     else:
                         state["dropped"] += 1
@@ -243,7 +225,7 @@ class ASGD:
             final_w = np.asarray(state["w"])
             snapshots.append((elapsed * 1e3, state["w"]))
             final_k, final_w_dev = state["k"], state["w"]
-        if mgr is not None:
+        if ckpt.enabled:
             save_checkpoint(final_k, final_w_dev)
         traj = self._evaluate_trajectory(snapshots)
         return TrainResult(

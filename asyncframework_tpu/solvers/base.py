@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from asyncframework_tpu.checkpoint import CheckpointManager
 from asyncframework_tpu.data.sharded import ShardedDataset
 
 
@@ -45,6 +46,49 @@ def resolve_dataset(X, y, num_workers: int, devices) -> ShardedDataset:
                 )
         return X
     return ShardedDataset(X, y, num_workers, devices)
+
+
+class SolverCheckpointer:
+    """Shared checkpoint plumbing for the async solvers.
+
+    Owns the manager, the compatibility metadata, the save-cadence decision,
+    and the restore-with-validation step, so ASGD and ASAGA differ only in
+    *which* state fields they save (ASAGA adds the history table).
+    """
+
+    def __init__(self, cfg: "SolverConfig", solver: str, d: int, n: int):
+        self.cfg = cfg
+        self.meta = {
+            "solver": solver, "num_workers": cfg.num_workers, "d": d, "n": n
+        }
+        self.mgr = (
+            CheckpointManager(cfg.checkpoint_dir, cfg.checkpoint_keep)
+            if cfg.checkpoint_dir
+            else None
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.mgr is not None
+
+    def restore(self) -> Optional[Dict]:
+        """Latest checkpoint, validated against this run; None = cold start."""
+        if self.mgr is None:
+            return None
+        ck = self.mgr.restore_latest_or_none()
+        if ck is not None:
+            validate_resume(ck.get("meta", {}), **self.meta)
+        return ck
+
+    def should_save(self, k: int) -> bool:
+        return (
+            self.mgr is not None
+            and self.cfg.checkpoint_freq > 0
+            and k % self.cfg.checkpoint_freq == 0
+        )
+
+    def save(self, k: int, **state) -> None:
+        self.mgr.save(k, {**state, "k": k, "meta": self.meta})
 
 
 def validate_resume(meta: Dict, **expect) -> None:
