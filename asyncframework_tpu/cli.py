@@ -123,30 +123,25 @@ def parse_conf_overlays(pairs: List[str]) -> AsyncConf:
     return conf
 
 
-def load_data(args, devices, need_host: bool = False):
+def load_data(args, cfg, devices, need_host: bool = False):
     """Resolve (X, y) or a device-resident ShardedDataset per the recipe.
 
-    ``need_host=True`` (the SPMD mllib baseline) forces host arrays even for
-    synthetic data -- it shards the *global* arrays over the mesh itself.
+    Sharding follows the post-overlay ``cfg`` (worker count / seed may have
+    been changed by ``--conf``).  ``need_host=True`` (the SPMD mllib
+    baseline) forces host arrays even for synthetic data -- it shards the
+    *global* arrays over the mesh itself.
     """
     from asyncframework_tpu.data.sharded import ShardedDataset
 
     if args.path == "synthetic":
         if need_host:
-            import numpy as np
+            from asyncframework_tpu.data import make_regression
 
-            rs = np.random.default_rng(args.seed)
-            X = (rs.normal(size=(args.N, args.d)) / np.sqrt(args.d)).astype(
-                np.float32
-            )
-            w_true = rs.normal(size=(args.d,)).astype(np.float32)
-            y = (X @ w_true + 0.01 * rs.normal(size=(args.N,))).astype(
-                np.float32
-            )
+            X, y, _ = make_regression(args.N, args.d, seed=cfg.seed)
             return X, y
         ds = ShardedDataset.generate_on_device(
-            args.N, args.d, args.num_partitions, devices=devices,
-            seed=args.seed,
+            args.N, args.d, cfg.num_workers, devices=devices,
+            seed=cfg.seed,
         )
         return ds, None
     path = os.path.join(args.path, args.file)
@@ -200,7 +195,7 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         if conf.contains(key):
             setattr(cfg, field, conf.get(key))
 
-    X, y = load_data(args, devices, need_host=(driver == "sgd-mllib"))
+    X, y = load_data(args, cfg, devices, need_host=(driver == "sgd-mllib"))
     t0 = time.monotonic()
     if driver == "sgd-mllib":
         from asyncframework_tpu.parallel import make_mesh
@@ -215,7 +210,13 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         mesh = make_mesh(n_mesh, devices=devices)
         w, losses, snaps = sgd.run(Xh, yh, mesh=mesh)
         elapsed = time.monotonic() - t0
-        trajectory = [(float(i), float(l)) for i, l in enumerate(losses)]
+        # the whole run is one fused scan, so per-iteration wall time is
+        # uniform: spread elapsed evenly to keep the (ms, objective) output
+        # contract comparable with the async drivers' trajectories
+        per_iter_ms = elapsed * 1e3 / max(len(losses), 1)
+        trajectory = [
+            ((i + 1) * per_iter_ms, float(l)) for i, l in enumerate(losses)
+        ]
         summary = {
             "driver": driver,
             "final_objective": float(losses[-1]) if len(losses) else None,

@@ -28,12 +28,18 @@ _NATIVE = None
 
 
 def _native_lib():
-    """Load the optional C++ parser; None when not built."""
+    """Load (building on demand) the C++ parser; None when unavailable."""
     global _NATIVE
     if _NATIVE is not None:
         return _NATIVE or None
+    try:
+        from asyncframework_tpu.native_build import ensure_built
+
+        built = ensure_built("libsvm_parser")
+    except Exception:
+        built = None
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    candidates = [
+    candidates = ([built] if built else []) + [
         os.path.join(here, "..", "native", "libsvm_parser.so"),
         os.path.join(here, "native", "libsvm_parser.so"),
     ]

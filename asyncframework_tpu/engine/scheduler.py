@@ -239,6 +239,10 @@ class JobScheduler:
             lost = self._inflight.pop(worker_id, [])
         self.pool.replace(worker_id)
         for task in lost:
+            with self._lock:
+                active = self._active_jobs.get(task.job_id)
+            if active is not None and active.waiter.is_claimed(task.worker_id):
+                continue  # a speculative copy already delivered this result
             retry = TaskSpec(
                 job_id=task.job_id,
                 worker_id=task.worker_id,

@@ -1,0 +1,74 @@
+"""Build helper for the native C++ components.
+
+``python -m asyncframework_tpu.native_build`` compiles ``native/*.cc`` into
+shared libraries next to their sources (the ctypes loaders look there).
+Library code calls :func:`ensure_built` lazily and degrades to the
+pure-Python fallbacks when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+
+SOURCES = ("libsvm_parser", "kvstore")
+
+
+def native_dir() -> str:
+    return _NATIVE_DIR
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_NATIVE_DIR, f"{name}.so")
+
+
+def is_built(name: str) -> bool:
+    so = lib_path(name)
+    src = os.path.join(_NATIVE_DIR, f"{name}.cc")
+    return os.path.exists(so) and (
+        not os.path.exists(src)
+        or os.path.getmtime(so) >= os.path.getmtime(src)
+    )
+
+
+def ensure_built(name: str, quiet: bool = True) -> Optional[str]:
+    """Build ``name``.so if stale/missing; returns its path or None when the
+    build is impossible (no source tree, no compiler)."""
+    if is_built(name):
+        return lib_path(name)
+    src = os.path.join(_NATIVE_DIR, f"{name}.cc")
+    if not os.path.exists(src):
+        return None
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall",
+           "-o", lib_path(name), src]
+    try:
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, cwd=_NATIVE_DIR, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if res.returncode != 0:
+        if not quiet:
+            sys.stderr.write(res.stderr)
+        return None
+    return lib_path(name)
+
+
+def main() -> int:
+    ok = True
+    for name in SOURCES:
+        path = ensure_built(name, quiet=False)
+        print(f"{name}: {'built -> ' + path if path else 'FAILED'}")
+        ok = ok and path is not None
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

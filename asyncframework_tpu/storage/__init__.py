@@ -1,0 +1,3 @@
+from asyncframework_tpu.storage.kvstore import KVStore, string_hash_code
+
+__all__ = ["KVStore", "string_hash_code"]

@@ -1,0 +1,219 @@
+// Append-only log-structured key/value store (C ABI, loaded via ctypes).
+//
+// TPU-native equivalent of the reference's LevelDB-backed kvstore
+// (common/kvstore, leveldbjni in pom.xml:468) that holds app-status/history
+// state.  Same design point -- a small embedded persistent KV used by
+// observability, not the data path -- implemented as the simplest durable
+// structure: an append-only record log with an in-memory hash index, plus
+// compaction.  The Python fallback (storage/kvstore.py) speaks the identical
+// file format, so stores are interchangeable between the two readers.
+//
+// File format (little-endian):
+//   magic "AKV1" (4 bytes)
+//   records: [u32 keylen][u32 vallen][key][val]
+//            vallen == 0xFFFFFFFF marks a tombstone (no val bytes follow).
+// A torn final record (crash mid-append) is detected by length checks and
+// ignored on open.
+//
+// Exported C API (all lengths in bytes, handles are opaque pointers):
+//   kv_open(path)                         -> handle or NULL
+//   kv_put(h, key, klen, val, vlen)       -> 0 ok / -1 io error
+//   kv_get_len(h, key, klen)              -> vlen, or -1 when absent
+//   kv_get(h, key, klen, out, cap)        -> vlen copied, -1 absent, -2 cap
+//   kv_delete(h, key, klen)               -> 0 ok (tombstone appended)
+//   kv_count(h)                           -> live keys
+//   kv_compact(h)                         -> 0 ok (rewrites live set)
+//   kv_close(h)
+//   kv_keys_size(h) / kv_keys_fill(h, out, cap) -> iterate key blob
+//                     (keys serialized as [u32 klen][key]...)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <unistd.h>  // truncate(2) for torn-tail recovery
+
+namespace {
+
+constexpr uint32_t kTombstone = 0xFFFFFFFFu;
+constexpr char kMagic[4] = {'A', 'K', 'V', '1'};
+
+struct Store {
+  std::string path;
+  FILE* f = nullptr;  // append handle
+  std::unordered_map<std::string, std::string> live;
+};
+
+// Replays the log.  On a torn final record (crash mid-append) the file is
+// truncated at the record boundary -- appending after garbage would make
+// the NEXT open misparse everything from the torn point on.
+bool load(Store* s) {
+  FILE* f = fopen(s->path.c_str(), "rb");
+  if (!f) return true;  // fresh store
+  char magic[4];
+  if (fread(magic, 1, 4, f) != 4 || memcmp(magic, kMagic, 4) != 0) {
+    fclose(f);
+    return false;
+  }
+  std::vector<char> key, val;
+  long clean_end = ftell(f);  // last byte of a fully-parsed record
+  for (;;) {
+    uint32_t kl, vl;
+    if (fread(&kl, 4, 1, f) != 1) break;
+    if (fread(&vl, 4, 1, f) != 1) break;
+    key.resize(kl);
+    if (kl && fread(key.data(), 1, kl, f) != kl) break;  // torn record
+    std::string k(key.data(), kl);
+    if (vl == kTombstone) {
+      s->live.erase(k);
+      clean_end = ftell(f);
+      continue;
+    }
+    val.resize(vl);
+    if (vl && fread(val.data(), 1, vl, f) != vl) break;  // torn record
+    s->live[k] = std::string(val.data(), vl);
+    clean_end = ftell(f);
+  }
+  fseek(f, 0, SEEK_END);
+  long file_end = ftell(f);
+  fclose(f);
+  if (file_end > clean_end) truncate(s->path.c_str(), clean_end);
+  return true;
+}
+
+int append(Store* s, const char* key, uint32_t kl, const char* val,
+           uint32_t vl) {
+  if (fwrite(&kl, 4, 1, s->f) != 1) return -1;
+  if (fwrite(&vl, 4, 1, s->f) != 1) return -1;
+  if (kl && fwrite(key, 1, kl, s->f) != kl) return -1;
+  if (vl != kTombstone && vl && fwrite(val, 1, vl, s->f) != vl) return -1;
+  fflush(s->f);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+  Store* s = new Store();
+  s->path = path;
+  if (!load(s)) {
+    delete s;
+    return nullptr;
+  }
+  FILE* probe = fopen(path, "rb");
+  bool fresh = (probe == nullptr);
+  if (probe) fclose(probe);
+  s->f = fopen(path, "ab");
+  if (!s->f) {
+    delete s;
+    return nullptr;
+  }
+  if (fresh) {
+    fwrite(kMagic, 1, 4, s->f);
+    fflush(s->f);
+  }
+  return s;
+}
+
+int kv_put(void* h, const char* key, uint32_t klen, const char* val,
+           uint32_t vlen) {
+  Store* s = (Store*)h;
+  if (vlen == kTombstone) return -1;  // reserved
+  if (append(s, key, klen, val, vlen) != 0) return -1;
+  s->live[std::string(key, klen)] = std::string(val, vlen);
+  return 0;
+}
+
+long long kv_get_len(void* h, const char* key, uint32_t klen) {
+  Store* s = (Store*)h;
+  auto it = s->live.find(std::string(key, klen));
+  if (it == s->live.end()) return -1;
+  return (long long)it->second.size();
+}
+
+long long kv_get(void* h, const char* key, uint32_t klen, char* out,
+                 long long cap) {
+  Store* s = (Store*)h;
+  auto it = s->live.find(std::string(key, klen));
+  if (it == s->live.end()) return -1;
+  if ((long long)it->second.size() > cap) return -2;
+  memcpy(out, it->second.data(), it->second.size());
+  return (long long)it->second.size();
+}
+
+int kv_delete(void* h, const char* key, uint32_t klen) {
+  Store* s = (Store*)h;
+  if (append(s, key, klen, nullptr, kTombstone) != 0) return -1;
+  s->live.erase(std::string(key, klen));
+  return 0;
+}
+
+long long kv_count(void* h) { return (long long)((Store*)h)->live.size(); }
+
+int kv_compact(void* h) {
+  Store* s = (Store*)h;
+  std::string tmp = s->path + ".compact";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  fwrite(kMagic, 1, 4, f);
+  for (auto& kv : s->live) {
+    uint32_t kl = (uint32_t)kv.first.size();
+    uint32_t vl = (uint32_t)kv.second.size();
+    fwrite(&kl, 4, 1, f);
+    fwrite(&vl, 4, 1, f);
+    fwrite(kv.first.data(), 1, kl, f);
+    fwrite(kv.second.data(), 1, vl, f);
+  }
+  fclose(f);
+  fclose(s->f);
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+    s->f = fopen(s->path.c_str(), "ab");
+    return -1;
+  }
+  s->f = fopen(s->path.c_str(), "ab");
+  return s->f ? 0 : -1;
+}
+
+long long kv_keys_size(void* h) {
+  Store* s = (Store*)h;
+  long long n = 0;
+  for (auto& kv : s->live) n += 4 + (long long)kv.first.size();
+  return n;
+}
+
+long long kv_keys_fill(void* h, char* out, long long cap) {
+  Store* s = (Store*)h;
+  long long off = 0;
+  for (auto& kv : s->live) {
+    uint32_t kl = (uint32_t)kv.first.size();
+    if (off + 4 + kl > cap) return -2;
+    memcpy(out + off, &kl, 4);
+    off += 4;
+    memcpy(out + off, kv.first.data(), kl);
+    off += kl;
+  }
+  return off;
+}
+
+void kv_close(void* h) {
+  Store* s = (Store*)h;
+  if (s->f) fclose(s->f);
+  delete s;
+}
+
+// Java String.hashCode-compatible hash (s[0]*31^(n-1) + ... + s[n-1], i32
+// overflow); parity with the reference's only in-tree C function
+// (R/pkg/src-native/string_hash_code.c) which exists so R-side hashing
+// matches the JVM's partitioner.
+int string_hash_code(const char* s, long long n) {
+  int32_t hv = 0;
+  for (long long i = 0; i < n; ++i) hv = hv * 31 + (int32_t)(unsigned char)s[i];
+  return hv;
+}
+
+}  // extern "C"

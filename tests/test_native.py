@@ -1,0 +1,185 @@
+"""Native C++ component tests: libsvm parser, kvstore, hashing.
+
+The native pieces mirror the reference's JNI substrate (SURVEY.md section
+2.6): netlib BLAS -> XLA (tested elsewhere), leveldbjni -> kvstore.cc,
+Hadoop-native text ingest -> libsvm_parser.cc, string_hash_code.c ->
+string_hash_code.  Every native path has a pure-Python fallback speaking the
+same format; these tests cross-check the two against each other.
+"""
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.data.libsvm import (
+    _native_lib,
+    load_libsvm,
+    parse_libsvm_lines,
+)
+from asyncframework_tpu.native_build import ensure_built
+from asyncframework_tpu.storage.kvstore import KVStore, string_hash_code
+
+NATIVE_OK = ensure_built("kvstore") is not None and ensure_built(
+    "libsvm_parser"
+) is not None
+needs_native = pytest.mark.skipif(not NATIVE_OK, reason="no C++ toolchain")
+
+
+def write_libsvm(path, X, y):
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            feats = " ".join(
+                f"{j + 1}:{X[i, j]:.6g}" for j in range(X.shape[1]) if X[i, j] != 0
+            )
+            f.write(f"{y[i]:.6g} {feats}\n")
+
+
+class TestLibsvmParser:
+    @pytest.fixture()
+    def dataset(self, tmp_path, rng):
+        X = rng.normal(size=(64, 12)).astype(np.float32)
+        X[rng.random(size=X.shape) < 0.5] = 0.0  # sparsity
+        y = rng.normal(size=(64,)).astype(np.float32)
+        p = tmp_path / "data.libsvm"
+        write_libsvm(p, X, y)
+        return p, X, y
+
+    def test_python_parser_round_trip(self, dataset):
+        p, X, y = dataset
+        with open(p) as f:
+            X2, y2 = parse_libsvm_lines(f, num_features=12)
+        np.testing.assert_allclose(X2, X, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(y2, y, rtol=1e-4, atol=1e-5)
+
+    @needs_native
+    def test_native_matches_python(self, dataset):
+        p, X, y = dataset
+        assert _native_lib() is not None
+        Xn, yn = load_libsvm(str(p), num_features=12, use_native=True)
+        Xp, yp = load_libsvm(str(p), num_features=12, use_native=False)
+        np.testing.assert_allclose(Xn, Xp, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(yn, yp, rtol=1e-5, atol=1e-6)
+
+    @needs_native
+    def test_native_handles_comments_blanks_exponents(self, tmp_path):
+        p = tmp_path / "messy.libsvm"
+        p.write_text(
+            "# header comment\n"
+            "\n"
+            "1.5 1:2.5e-3 3:-4E2\n"
+            "   \n"
+            "-2 2:0.125\n"
+        )
+        X, y = load_libsvm(str(p), num_features=3, use_native=True)
+        assert X.shape == (2, 3)
+        np.testing.assert_allclose(y, [1.5, -2.0])
+        np.testing.assert_allclose(X[0], [2.5e-3, 0.0, -400.0], rtol=1e-6)
+        np.testing.assert_allclose(X[1], [0.0, 0.125, 0.0])
+
+    @needs_native
+    def test_native_rejects_out_of_range_index(self, tmp_path):
+        p = tmp_path / "bad.libsvm"
+        p.write_text("1 5:1.0\n")
+        with pytest.raises(ValueError, match="-3"):
+            load_libsvm(str(p), num_features=3, use_native=True)
+
+
+class TestKVStore:
+    @pytest.mark.parametrize(
+        "backend", ["python", pytest.param("native", marks=needs_native)]
+    )
+    def test_basic_ops_and_reopen(self, tmp_path, backend):
+        path = tmp_path / "app.kv"
+        with KVStore(path, backend=backend) as kv:
+            assert kv.backend == backend
+            kv.put("a", b"1")
+            kv.put(b"b", "two")
+            kv.put("a", b"updated")
+            kv.delete("missing")
+            assert kv.get("a") == b"updated"
+            assert kv.get("b") == b"two"
+            assert len(kv) == 2
+            kv.delete("b")
+            assert "b" not in kv and len(kv) == 1
+        # reopen: log replay reconstructs the live set
+        with KVStore(path, backend=backend) as kv:
+            assert kv.get("a") == b"updated"
+            assert len(kv) == 1
+
+    @pytest.mark.parametrize(
+        "backend", ["python", pytest.param("native", marks=needs_native)]
+    )
+    def test_compact_drops_dead_records(self, tmp_path, backend):
+        path = tmp_path / "app.kv"
+        with KVStore(path, backend=backend) as kv:
+            for i in range(50):
+                kv.put(f"k{i}", b"x" * 100)
+            for i in range(40):
+                kv.delete(f"k{i}")
+            before = path.stat().st_size
+            kv.compact()
+            after = path.stat().st_size
+            assert after < before
+            assert len(kv) == 10
+        with KVStore(path, backend=backend) as kv:
+            assert sorted(kv.keys()) == sorted(
+                f"k{i}".encode() for i in range(40, 50)
+            )
+
+    @needs_native
+    @pytest.mark.parametrize("writer,reader", [("python", "native"),
+                                               ("native", "python")])
+    def test_cross_backend_interop(self, tmp_path, writer, reader):
+        """Both implementations speak the identical AKV1 format."""
+        path = tmp_path / "x.kv"
+        with KVStore(path, backend=writer) as kv:
+            kv.put("shared", b"payload")
+            kv.put_obj("obj", {"a": [1, 2], "b": "s"})
+            kv.put("gone", b"bye")
+            kv.delete("gone")
+        with KVStore(path, backend=reader) as kv:
+            assert kv.backend == reader
+            assert kv.get("shared") == b"payload"
+            assert kv.get_obj("obj") == {"a": [1, 2], "b": "s"}
+            assert "gone" not in kv
+
+    @pytest.mark.parametrize(
+        "backend", ["python", pytest.param("native", marks=needs_native)]
+    )
+    def test_torn_final_record_truncated(self, tmp_path, backend):
+        """A crash-torn tail is cut off on open, so post-crash appends land
+        on a record boundary and later reopens parse cleanly."""
+        path = tmp_path / "torn.kv"
+        with KVStore(path, backend=backend) as kv:
+            kv.put("good", b"v")
+        with open(path, "ab") as f:
+            f.write(b"\x05\x00\x00\x00\x10\x00\x00\x00ab")  # truncated record
+        with KVStore(path, backend=backend) as kv:
+            assert kv.get("good") == b"v"
+            assert len(kv) == 1
+            kv.put("after", b"crash")
+        # the other implementation must also read the repaired log
+        other = "python" if backend == "native" else "python"
+        with KVStore(path, backend=other) as kv:
+            assert kv.get("good") == b"v"
+            assert kv.get("after") == b"crash"
+            assert len(kv) == 2
+
+
+class TestStringHashCode:
+    def test_matches_java_semantics(self):
+        # java "abc".hashCode() == 96354; "".hashCode() == 0
+        assert string_hash_code("abc") == 96354
+        assert string_hash_code("") == 0
+        # int32 wraparound (java allows negatives)
+        assert string_hash_code("asyncframework-tpu" * 10) < 2**31
+
+    @needs_native
+    def test_native_matches_python(self):
+        import ctypes
+
+        from asyncframework_tpu.storage.kvstore import _native_lib as kvlib
+
+        lib = kvlib()
+        for s in ("", "abc", "framework", "x" * 1000, "\xe9\xa0"):
+            b = s.encode()
+            assert lib.string_hash_code(b, len(b)) == string_hash_code(s)
