@@ -242,6 +242,11 @@ class JobScheduler:
             with self._lock:
                 active = self._active_jobs.get(task.job_id)
             if active is not None and active.waiter.is_claimed(task.worker_id):
+                with self._lock:
+                    # nothing will relaunch or report this task: drop its
+                    # launch stamp or speculation_snapshot sees a phantom
+                    # forever-running task
+                    self._launch_ms.pop((task.job_id, task.worker_id), None)
                 continue  # a speculative copy already delivered this result
             retry = TaskSpec(
                 job_id=task.job_id,

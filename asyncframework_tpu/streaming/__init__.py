@@ -1,0 +1,5 @@
+from asyncframework_tpu.streaming.dstream import DStream
+from asyncframework_tpu.streaming.context import StreamingContext
+from asyncframework_tpu.streaming.wal import WriteAheadLog
+
+__all__ = ["DStream", "StreamingContext", "WriteAheadLog"]

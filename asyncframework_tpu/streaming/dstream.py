@@ -1,0 +1,203 @@
+"""Discretized streams: a lazy per-batch transform graph.
+
+Parity: ``streaming/.../dstream/DStream.scala:62`` -- a DStream is a graph of
+per-interval computations over parent streams; transformations are lazy,
+output operations (``foreachRDD``/``print``) register the stream with the
+context; windowing re-uses parent batches across overlapping windows.
+
+TPU re-design: a "batch" here is an array (numpy or jax) or any Python
+value; ``map_batch`` functions are typically jitted XLA callables so the
+per-interval work is one device dispatch (the reference's per-batch Spark
+job).  Structural simplifications: generation is pull-based with per-time
+memoization (the reference's ``getOrCompute`` cache) driven by the context's
+job generator; there is no lineage/persistence tier because batches are
+either consumed in-interval or retained by an explicit window.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+EMPTY = object()  # sentinel: no batch this interval
+
+
+class DStream:
+    """One node in the stream graph.  Subclasses define :meth:`compute`."""
+
+    def __init__(self, ssc, parents: Optional[List["DStream"]] = None):
+        self.ssc = ssc
+        self.parents = parents or []
+        self._cache: "OrderedDict[int, Any]" = OrderedDict()
+        self._cache_keep = 1  # raised by windowed children
+        self._lock = threading.Lock()
+        ssc._register(self)
+
+    # ------------------------------------------------------------- generation
+    def compute(self, time_ms: int) -> Any:
+        raise NotImplementedError
+
+    def get_or_compute(self, time_ms: int) -> Any:
+        """Per-interval memoized compute (``DStream.getOrCompute`` parity);
+        lets overlapping windows share one evaluation of the parent."""
+        with self._lock:
+            if time_ms in self._cache:
+                return self._cache[time_ms]
+        value = self.compute(time_ms)
+        with self._lock:
+            self._cache[time_ms] = value
+            while len(self._cache) > self._cache_keep:
+                self._cache.popitem(last=False)
+        return value
+
+    def _retain(self, n: int) -> None:
+        """A child needs the last ``n`` intervals of this stream."""
+        self._cache_keep = max(self._cache_keep, n)
+
+    # ---------------------------------------------------------- transformations
+    def map_batch(self, fn: Callable[[Any], Any]) -> "DStream":
+        """Apply ``fn`` to each interval's batch (jit-friendly: one call per
+        interval, not per element)."""
+        return _Transformed(self.ssc, self, lambda t, b: fn(b))
+
+    def transform(self, fn: Callable[[int, Any], Any]) -> "DStream":
+        """Like :meth:`map_batch` with the batch time as first argument."""
+        return _Transformed(self.ssc, self, fn)
+
+    def filter_batch(self, pred: Callable[[Any], bool]) -> "DStream":
+        """Drop intervals whose batch fails ``pred``."""
+        return _Transformed(
+            self.ssc, self, lambda t, b: b if pred(b) else EMPTY
+        )
+
+    def window(self, length: int, slide: int = 1) -> "DStream":
+        """Concatenate the batches of the last ``length`` intervals, emitted
+        every ``slide`` intervals (counted in batch intervals, like the
+        reference's duration-multiples)."""
+        return _Windowed(self.ssc, self, length, slide)
+
+    def reduce_by_window(
+        self, fn: Callable[[Any, Any], Any], length: int, slide: int = 1
+    ) -> "DStream":
+        win = self.window(length, slide)
+        def red(t, batches):
+            if batches is EMPTY or not batches:
+                return EMPTY
+            acc = batches[0]
+            for b in batches[1:]:
+                acc = fn(acc, b)
+            return acc
+        return _Transformed(self.ssc, win, red)
+
+    def count(self) -> "DStream":
+        def cnt(t, b):
+            if b is EMPTY:
+                return 0
+            try:
+                return len(b)
+            except TypeError:
+                return 1
+        return _Transformed(self.ssc, self, cnt)
+
+    def union(self, other: "DStream") -> "DStream":
+        return _Union(self.ssc, [self, other])
+
+    # ---------------------------------------------------------------- outputs
+    def foreach_batch(self, fn: Callable[[int, Any], None]) -> "DStream":
+        """Register an output operation (``foreachRDD`` parity): ``fn(time_ms,
+        batch)`` runs for every non-empty interval.  Returns self."""
+        self.ssc._register_output(self, fn)
+        return self
+
+
+class _Transformed(DStream):
+    def __init__(self, ssc, parent: DStream, fn: Callable[[int, Any], Any]):
+        super().__init__(ssc, [parent])
+        self._fn = fn
+
+    def compute(self, time_ms: int) -> Any:
+        b = self.parents[0].get_or_compute(time_ms)
+        if b is EMPTY:
+            return EMPTY
+        return self._fn(time_ms, b)
+
+
+class _Windowed(DStream):
+    """Emits the list of the last ``length`` non-empty parent batches."""
+
+    def __init__(self, ssc, parent: DStream, length: int, slide: int):
+        if length < 1 or slide < 1:
+            raise ValueError("window length and slide must be >= 1")
+        super().__init__(ssc, [parent])
+        self.length = length
+        self.slide = slide
+        parent._retain(length)
+
+    def compute(self, time_ms: int) -> Any:
+        interval = self.ssc.batch_interval_ms
+        idx = time_ms // interval
+        if idx % self.slide != 0:
+            return EMPTY
+        batches = []
+        for i in range(self.length - 1, -1, -1):
+            t = time_ms - i * interval
+            if t <= 0:
+                continue  # before the first interval (generation is 1-based)
+            b = self.parents[0].get_or_compute(t)
+            if b is not EMPTY:
+                batches.append(b)
+        return batches if batches else EMPTY
+
+
+class _Union(DStream):
+    def __init__(self, ssc, parents: List[DStream]):
+        super().__init__(ssc, parents)
+
+    def compute(self, time_ms: int) -> Any:
+        out = []
+        for p in self.parents:
+            b = p.get_or_compute(time_ms)
+            if b is not EMPTY:
+                out.append(b)
+        if not out:
+            return EMPTY
+        return out[0] if len(out) == 1 else _concat(out)
+
+
+def _concat(batches: List[Any]) -> Any:
+    """Concatenate heterogeneous batches: arrays stack, lists extend."""
+    first = batches[0]
+    if hasattr(first, "shape"):
+        import numpy as np
+
+        return np.concatenate([np.asarray(b) for b in batches])
+    out: List[Any] = []
+    for b in batches:
+        out.extend(b)
+    return out
+
+
+class QueueStream(DStream):
+    """Input stream fed from an in-memory queue of batches (the reference's
+    ``queueStream`` test utility, the canonical deterministic source)."""
+
+    def __init__(self, ssc, batches: Optional[List[Any]] = None,
+                 wal: Optional["object"] = None):
+        super().__init__(ssc)
+        self._pending: List[Any] = list(batches or [])
+        self._qlock = threading.Lock()
+        self._wal = wal
+
+    def push(self, batch: Any) -> None:
+        with self._qlock:
+            self._pending.append(batch)
+
+    def compute(self, time_ms: int) -> Any:
+        with self._qlock:
+            if not self._pending:
+                return EMPTY
+            batch = self._pending.pop(0)
+        if self._wal is not None:
+            self._wal.append(time_ms, batch)
+        return batch

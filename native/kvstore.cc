@@ -86,6 +86,7 @@ bool load(Store* s) {
 
 int append(Store* s, const char* key, uint32_t kl, const char* val,
            uint32_t vl) {
+  if (!s->f) return -1;  // failed compact reopen: store is read-only now
   if (fwrite(&kl, 4, 1, s->f) != 1) return -1;
   if (fwrite(&vl, 4, 1, s->f) != 1) return -1;
   if (kl && fwrite(key, 1, kl, s->f) != kl) return -1;
@@ -160,18 +161,25 @@ int kv_compact(void* h) {
   std::string tmp = s->path + ".compact";
   FILE* f = fopen(tmp.c_str(), "wb");
   if (!f) return -1;
-  fwrite(kMagic, 1, 4, f);
-  for (auto& kv : s->live) {
-    uint32_t kl = (uint32_t)kv.first.size();
-    uint32_t vl = (uint32_t)kv.second.size();
-    fwrite(&kl, 4, 1, f);
-    fwrite(&vl, 4, 1, f);
-    fwrite(kv.first.data(), 1, kl, f);
-    fwrite(kv.second.data(), 1, vl, f);
+  // every write checked: a short write (ENOSPC) must NOT be renamed over
+  // the intact log -- that would silently drop keys on the next open
+  bool ok = fwrite(kMagic, 1, 4, f) == 4;
+  for (auto it = s->live.begin(); ok && it != s->live.end(); ++it) {
+    uint32_t kl = (uint32_t)it->first.size();
+    uint32_t vl = (uint32_t)it->second.size();
+    ok = fwrite(&kl, 4, 1, f) == 1 && fwrite(&vl, 4, 1, f) == 1 &&
+         (kl == 0 || fwrite(it->first.data(), 1, kl, f) == kl) &&
+         (vl == 0 || fwrite(it->second.data(), 1, vl, f) == vl);
   }
-  fclose(f);
+  ok = (fclose(f) == 0) && ok;
+  if (!ok) {
+    remove(tmp.c_str());
+    return -1;
+  }
   fclose(s->f);
+  s->f = nullptr;
   if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+    remove(tmp.c_str());
     s->f = fopen(s->path.c_str(), "ab");
     return -1;
   }

@@ -65,7 +65,9 @@ static double scan_float(const char** pp, const char* end, bool* ok) {
     int ex = 0;
     bool eany = false;
     while (p < end && *p >= '0' && *p <= '9') {
-      ex = ex * 10 + (*p - '0');
+      // clamp: anything past float range over/underflows anyway, and an
+      // unchecked accumulator would overflow int on hostile input
+      if (ex < 10000) ex = ex * 10 + (*p - '0');
       eany = true;
       ++p;
     }
