@@ -1,0 +1,5 @@
+from asyncframework_tpu.graph.graph import Graph
+from asyncframework_tpu.graph.pregel import pregel
+from asyncframework_tpu.graph.algorithms import connected_components, pagerank
+
+__all__ = ["Graph", "pregel", "pagerank", "connected_components"]

@@ -29,20 +29,14 @@ class StreamingContext:
             raise ValueError("batch_interval_ms must be >= 1")
         self.batch_interval_ms = int(batch_interval_ms)
         self.clock = clock or SystemClock()
-        self._streams: List[DStream] = []
         self._outputs: List[Tuple[DStream, Callable[[int, Any], None]]] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._started = False
-        self._stopped = False
-        self._last_time: Optional[int] = None
         self._processed_batches = 0
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ registration
-    def _register(self, ds: DStream) -> None:
-        self._streams.append(ds)
-
     def _register_output(self, ds: DStream, fn) -> None:
         if self._started:
             raise RuntimeError("cannot add outputs after start()")
@@ -72,7 +66,6 @@ class StreamingContext:
                 fn(time_ms, batch)
                 fired += 1
         with self._lock:
-            self._last_time = time_ms
             self._processed_batches += 1
         return fired
 
@@ -109,7 +102,6 @@ class StreamingContext:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
-        self._stopped = True
 
     def await_intervals(self, n: int, timeout_s: float = 10.0) -> None:
         """Block until ``n`` intervals have been processed (test helper)."""

@@ -32,7 +32,6 @@ class DStream:
         self._cache: "OrderedDict[int, Any]" = OrderedDict()
         self._cache_keep = 1  # raised by windowed children
         self._lock = threading.Lock()
-        ssc._register(self)
 
     # ------------------------------------------------------------- generation
     def compute(self, time_ms: int) -> Any:
@@ -40,16 +39,22 @@ class DStream:
 
     def get_or_compute(self, time_ms: int) -> Any:
         """Per-interval memoized compute (``DStream.getOrCompute`` parity);
-        lets overlapping windows share one evaluation of the parent."""
+        lets overlapping windows share one evaluation of the parent.
+
+        The lock is held ACROSS compute: check-then-compute without it would
+        let two threads evaluate the same interval twice (a QueueStream
+        source would pop two batches for one tick).  Safe because the stream
+        graph is a DAG and each node locks only itself while recursing into
+        parents.
+        """
         with self._lock:
             if time_ms in self._cache:
                 return self._cache[time_ms]
-        value = self.compute(time_ms)
-        with self._lock:
+            value = self.compute(time_ms)
             self._cache[time_ms] = value
             while len(self._cache) > self._cache_keep:
                 self._cache.popitem(last=False)
-        return value
+            return value
 
     def _retain(self, n: int) -> None:
         """A child needs the last ``n`` intervals of this stream."""

@@ -34,7 +34,8 @@
 #include <unordered_map>
 #include <vector>
 
-#include <unistd.h>  // truncate(2) for torn-tail recovery
+#include <fcntl.h>   // open(2) for directory fsync
+#include <unistd.h>  // truncate(2), fsync(2)
 
 namespace {
 
@@ -171,6 +172,10 @@ int kv_compact(void* h) {
          (kl == 0 || fwrite(it->first.data(), 1, kl, f) == kl) &&
          (vl == 0 || fwrite(it->second.data(), 1, vl, f) == vl);
   }
+  // durability: the temp file must be ON DISK before rename commits it --
+  // otherwise power loss after the rename can leave a truncated .compact
+  // as the only copy of the store
+  if (ok) ok = (fflush(f) == 0) && (fsync(fileno(f)) == 0);
   ok = (fclose(f) == 0) && ok;
   if (!ok) {
     remove(tmp.c_str());
@@ -182,6 +187,15 @@ int kv_compact(void* h) {
     remove(tmp.c_str());
     s->f = fopen(s->path.c_str(), "ab");
     return -1;
+  }
+  // best-effort directory fsync so the rename itself is durable
+  std::string dir = s->path;
+  size_t slash = dir.find_last_of('/');
+  dir = (slash == std::string::npos) ? std::string(".") : dir.substr(0, slash);
+  int dfd = open(dir.c_str(), O_RDONLY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    close(dfd);
   }
   s->f = fopen(s->path.c_str(), "ab");
   return s->f ? 0 : -1;
