@@ -4,3 +4,8 @@ from asyncframework_tpu.parallel.mesh import (  # noqa: F401
     replicated_sharding,
     shard_batch,
 )
+from asyncframework_tpu.parallel.ring import (  # noqa: F401
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,191 @@
+"""Long-context attention: ring attention + all-to-all sequence parallelism.
+
+Net-new TPU-first scope.  The reference scales *rows of data*, never sequence
+length (SURVEY.md section 2.2: no sequence/context parallelism anywhere in
+the fork) -- but a TPU framework must treat long context as first-class, so
+this module provides the two canonical strategies over a sequence-sharded
+mesh axis:
+
+- :func:`ring_attention` -- blockwise (flash-style) online-softmax attention
+  where K/V blocks rotate around the ``sp`` ring via ``lax.ppermute``.  Each
+  device holds ``T/P`` of the sequence; peak memory is O(T/P * T/P) per step
+  instead of O(T^2), and the K/V transfer for step ``s+1`` overlaps the
+  compute of step ``s`` (XLA schedules the ppermute DMA concurrently over
+  ICI).  Exact (not approximate): the online max/denominator accumulation
+  reproduces full softmax attention to float tolerance.
+- :func:`ulysses_attention` -- the all-to-all alternative: switch from
+  sequence-sharding to head-sharding (``all_to_all`` over ``sp``), run each
+  head group's *full-sequence* attention locally, switch back.  Two
+  all-to-alls per call; needs ``num_heads % P == 0``.
+
+Both are ``shard_map``-ped over a ``Mesh`` axis and differentiable (JAX
+differentiates through the loop and the collectives), and both reduce to
+:func:`reference_attention` on a 1-device mesh.
+
+Conventions: ``q, k, v`` are ``(batch, seq, heads, head_dim)``, sharded on
+``seq`` over the mesh axis; causal masking uses global positions.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30  # mask fill / softmax-max init: finite so (-inf) - (-inf) never NaNs
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Single-device full softmax attention (the correctness oracle)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _block_accumulate(q, k, v, m, l, o, mask):
+    """One flash step: fold a K/V block into the running (max, denom, out).
+
+    ``q``: (B, Tq, H, D); ``k``/``v``: (B, Tk, H, D); ``m``/``l``: (B, H, Tq)
+    float32; ``o``: (B, Tq, H, D) float32; ``mask``: (Tq, Tk) or None.
+    Accumulation is float32 regardless of input dtype (flash-attention
+    practice: bf16 inputs, fp32 running state -- the per-step corr rescale
+    compounds rounding otherwise).
+    """
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])           # (B, H, Tq, Tk) f32
+    corr = jnp.exp(m - m_new)                   # (B, H, Tq) f32
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False
+):
+    """Exact attention over a sequence-sharded mesh axis via a K/V ring.
+
+    Device ``p`` starts with its own K/V block and at ring step ``s`` holds
+    the block originally on device ``(p - s) mod P`` (ppermute sends each
+    block to the next device).  Causal masking uses global positions, so
+    fully-masked future blocks contribute nothing (their probabilities
+    underflow to zero against the running max).
+    """
+    n_dev = mesh.shape[axis]
+    if q.shape[1] % n_dev:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by mesh axis size {n_dev}"
+        )
+    if q.shape[1] != k.shape[1]:
+        # the block-position causal mask assumes aligned q/k positions;
+        # cross-attention-style tq != tk would be silently wrong
+        raise ValueError(
+            f"ring_attention requires equal q/k seq lens, got {q.shape[1]} "
+            f"vs {k.shape[1]}"
+        )
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None),
+    )
+    def ring(ql, kl, vl):
+        p_idx = jax.lax.axis_index(axis)
+        P_sz = jax.lax.axis_size(axis)
+        b, tq, h, d = ql.shape
+        t_local = kl.shape[1]
+        # pcast to varying: the accumulators become device-varying on the sp
+        # axis (the loop body's outputs are, via axis_index), so carry types
+        # match.  Accumulators are f32 (see _block_accumulate).
+        def varying(x):
+            return jax.lax.pcast(x, (axis,), to="varying")
+
+        m0 = varying(jnp.full((b, h, tq), _NEG, jnp.float32))
+        l0 = varying(jnp.zeros((b, h, tq), jnp.float32))
+        o0 = varying(jnp.zeros(ql.shape, jnp.float32))
+        q_pos = p_idx * tq + jnp.arange(tq)
+
+        def step(s, carry):
+            kb, vb, m, l, o = carry
+            if causal:
+                k_block = (p_idx - s) % P_sz
+                k_pos = k_block * t_local + jnp.arange(t_local)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                # a block strictly in the future (k_block > p_idx) is fully
+                # masked: skip its einsums entirely -- halves causal FLOPs
+                m, l, o = jax.lax.cond(
+                    k_block > p_idx,
+                    lambda m, l, o: (m, l, o),
+                    lambda m, l, o: _block_accumulate(
+                        ql, kb, vb, m, l, o, mask
+                    ),
+                    m, l, o,
+                )
+            else:
+                m, l, o = _block_accumulate(ql, kb, vb, m, l, o, None)
+            perm = [(j, (j + 1) % P_sz) for j in range(P_sz)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            return kb, vb, m, l, o
+
+        _, _, m, l, o = jax.lax.fori_loop(0, P_sz, step, (kl, vl, m0, l0, o0))
+        out = o / l.transpose(0, 2, 1)[..., None]
+        return out.astype(ql.dtype)
+
+    return ring(q, k, v)
+
+
+def ulysses_attention(
+    q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False
+):
+    """All-to-all sequence parallelism (Ulysses-style): reshard seq->heads,
+    attend over the full sequence per local head group, reshard back."""
+    n_dev = mesh.shape[axis]
+    h = q.shape[2]
+    if h % n_dev:
+        raise ValueError(f"heads {h} not divisible by mesh axis size {n_dev}")
+    if q.shape[1] % n_dev:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by mesh axis size {n_dev}"
+        )
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None),
+    )
+    def ulysses(ql, kl, vl):
+        # (B, T/P, H, D) --all_to_all--> (B, T, H/P, D)
+        def seq_to_heads(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        qh, kh, vh = seq_to_heads(ql), seq_to_heads(kl), seq_to_heads(vl)
+        oh = reference_attention(qh, kh, vh, causal=causal)
+        return heads_to_seq(oh)
+
+    return ulysses(q, k, v)
