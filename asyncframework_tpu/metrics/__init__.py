@@ -24,6 +24,7 @@ from asyncframework_tpu.metrics.bus import (
     WorkerLost,
 )
 from asyncframework_tpu.metrics.eventlog import EventLogReader, EventLogWriter
+from asyncframework_tpu.metrics.report import render_report
 from asyncframework_tpu.metrics.system import (
     Counter,
     CsvSink,
@@ -52,4 +53,5 @@ __all__ = [
     "MetricsSystem",
     "CsvSink",
     "JsonlSink",
+    "render_report",
 ]

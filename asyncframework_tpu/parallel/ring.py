@@ -122,15 +122,14 @@ def ring_attention(
         o0 = varying(jnp.zeros(ql.shape, jnp.float32))
         q_pos = p_idx * tq + jnp.arange(tq)
 
-        def step(s, carry):
-            kb, vb, m, l, o = carry
+        def accumulate(s, kb, vb, m, l, o):
             if causal:
                 k_block = (p_idx - s) % P_sz
                 k_pos = k_block * t_local + jnp.arange(t_local)
                 mask = q_pos[:, None] >= k_pos[None, :]
                 # a block strictly in the future (k_block > p_idx) is fully
                 # masked: skip its einsums entirely -- halves causal FLOPs
-                m, l, o = jax.lax.cond(
+                return jax.lax.cond(
                     k_block > p_idx,
                     lambda m, l, o: (m, l, o),
                     lambda m, l, o: _block_accumulate(
@@ -138,14 +137,23 @@ def ring_attention(
                     ),
                     m, l, o,
                 )
-            else:
-                m, l, o = _block_accumulate(ql, kb, vb, m, l, o, None)
+            return _block_accumulate(ql, kb, vb, m, l, o, None)
+
+        def step(s, carry):
+            kb, vb, m, l, o = carry
+            m, l, o = accumulate(s, kb, vb, m, l, o)
             perm = [(j, (j + 1) % P_sz) for j in range(P_sz)]
             kb = jax.lax.ppermute(kb, axis, perm)
             vb = jax.lax.ppermute(vb, axis, perm)
             return kb, vb, m, l, o
 
-        _, _, m, l, o = jax.lax.fori_loop(0, P_sz, step, (kl, vl, m0, l0, o0))
+        # P-1 rotate-and-accumulate steps, then the final block WITHOUT the
+        # trailing ppermute (its output would be discarded -- one wasted
+        # rotation of the K and V shards over ICI per call otherwise)
+        kb, vb, m, l, o = jax.lax.fori_loop(
+            0, P_sz - 1, step, (kl, vl, m0, l0, o0)
+        )
+        m, l, o = accumulate(P_sz - 1, kb, vb, m, l, o)
         out = o / l.transpose(0, 2, 1)[..., None]
         return out.astype(ql.dtype)
 
@@ -161,9 +169,17 @@ def ulysses_attention(
     h = q.shape[2]
     if h % n_dev:
         raise ValueError(f"heads {h} not divisible by mesh axis size {n_dev}")
-    if q.shape[1] % n_dev:
+    for name, t in (("q", q.shape[1]), ("k", k.shape[1])):
+        if t % n_dev:
+            raise ValueError(
+                f"{name} seq len {t} not divisible by mesh axis size {n_dev}"
+            )
+    if causal and q.shape[1] != k.shape[1]:
+        # reference aligns the causal mask bottom-right for tq != tk; the
+        # resharded local attention here would mask with absolute positions
         raise ValueError(
-            f"seq len {q.shape[1]} not divisible by mesh axis size {n_dev}"
+            f"causal ulysses_attention requires equal q/k seq lens, got "
+            f"{q.shape[1]} vs {k.shape[1]}"
         )
 
     @functools.partial(

@@ -1,0 +1,115 @@
+"""HBM budget planning helpers.
+
+Parity: the reference's ``UnifiedMemoryManager`` (``memory/
+UnifiedMemoryManager.scala:47``) arbitrates execution vs storage memory and
+decides spill; on TPU the XLA allocator owns HBM, so the useful capability
+is *planning*: will this dataset + model + history table fit per device, and
+how many workers per device keep it that way.  Used by the data layer before
+committing shards to HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+#: conservative default per-chip budget when the runtime reports nothing
+DEFAULT_HBM_BYTES = 16 * 1024**3
+
+
+def nbytes(shape: Sequence[int], dtype=np.float32) -> int:
+    return int(np.prod(shape)) * np.dtype(dtype).itemsize
+
+
+def device_hbm_bytes(device=None) -> int:
+    """Best-effort total HBM of a device; falls back to a conservative
+    default (CPU/interpret backends report nothing useful)."""
+    dev = device or jax.devices()[0]
+    stats = {}
+    try:
+        stats = dev.memory_stats() or {}
+    except (AttributeError, NotImplementedError, jax.errors.JaxRuntimeError):
+        pass
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else DEFAULT_HBM_BYTES
+
+
+def device_hbm_in_use(device=None) -> Optional[int]:
+    dev = device or jax.devices()[0]
+    try:
+        stats = dev.memory_stats() or {}
+    except (AttributeError, NotImplementedError, jax.errors.JaxRuntimeError):
+        return None
+    used = stats.get("bytes_in_use")
+    return int(used) if used is not None else None
+
+
+@dataclass(frozen=True)
+class ShardPlan:
+    """Outcome of :func:`plan_dataset`: per-device residency estimate."""
+
+    bytes_per_device: int
+    budget_bytes: int
+    fits: bool
+    utilization: float
+
+    def require_fits(self) -> "ShardPlan":
+        if not self.fits:
+            raise MemoryError(
+                f"planned shard residency {self.bytes_per_device / 1e9:.2f} GB "
+                f"exceeds the {self.budget_bytes / 1e9:.2f} GB device budget"
+            )
+        return self
+
+
+def plan_dataset(
+    n: int,
+    d: int,
+    num_workers: int,
+    num_devices: int,
+    dtype=np.float32,
+    with_labels: bool = True,
+    history_table: bool = False,
+    model_versions: int = 2,
+    budget_bytes: Optional[int] = None,
+    headroom: float = 0.85,
+) -> ShardPlan:
+    """Estimate per-device HBM residency for a sharded training setup.
+
+    Accounts for: the data shards living on the device (workers sharing a
+    device stack their shards), labels, the ASAGA history slice (one f32 per
+    sample) when ``history_table``, and ``model_versions`` live copies of
+    ``w`` (the versioned broadcast ring).  ``headroom`` reserves a fraction
+    of the budget for XLA workspace/fusion temporaries.
+    """
+    if num_devices < 1 or num_workers < 1:
+        raise ValueError("num_workers and num_devices must be >= 1")
+    budget = budget_bytes if budget_bytes is not None else device_hbm_bytes()
+    workers_per_device = -(-num_workers // num_devices)  # ceil
+    rows_per_worker = -(-n // num_workers)
+    per_worker = nbytes((rows_per_worker, d), dtype)
+    if with_labels:
+        per_worker += nbytes((rows_per_worker,), dtype)
+    if history_table:
+        per_worker += nbytes((rows_per_worker,), np.float32)
+    total = workers_per_device * per_worker
+    total += model_versions * nbytes((d,), np.float32)
+    usable = int(budget * headroom)
+    return ShardPlan(
+        bytes_per_device=int(total),
+        budget_bytes=usable,
+        fits=total <= usable,
+        utilization=total / usable if usable else float("inf"),
+    )
+
+
+def fmt_bytes(b: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(b) < 1024 or unit == "TiB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{b} B"
+        b /= 1024
+    return f"{b:.1f} TiB"  # pragma: no cover
