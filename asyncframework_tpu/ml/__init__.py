@@ -28,8 +28,19 @@ from asyncframework_tpu.ml.models import (
     LogisticRegression,
 )
 from asyncframework_tpu.ml.clustering import KMeans, KMeansModel
+from asyncframework_tpu.ml.recommendation import ALS, ALSModel
+from asyncframework_tpu.ml.feature import MinMaxScaler, Normalizer, StandardScaler
+from asyncframework_tpu.ml.stat import ColStats, col_stats, corr
 
 __all__ = [
+    "ALS",
+    "ALSModel",
+    "StandardScaler",
+    "MinMaxScaler",
+    "Normalizer",
+    "ColStats",
+    "col_stats",
+    "corr",
     "Gradient",
     "LeastSquaresGradient",
     "LogisticGradient",

@@ -1,0 +1,98 @@
+"""Feature transforms.
+
+Parity: MLlib ``feature/`` -- ``StandardScaler`` (fit column mean/std over a
+distributed dataset, then transform), ``Normalizer`` (row p-norm scaling),
+``MinMaxScaler``.  The fit statistics come from one jitted pass (optionally
+``psum``-reduced over a mesh for sharded data -- see ``ml/stat.py`` which
+these reuse); transform is elementwise XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncframework_tpu.ml.stat import col_stats
+
+
+class StandardScaler:
+    """(x - mean) / std per column; either part optional (MLlib flags)."""
+
+    def __init__(self, with_mean: bool = True, with_std: bool = True):
+        self.with_mean = with_mean
+        self.with_std = with_std
+        self.mean_: Optional[np.ndarray] = None
+        self.std_: Optional[np.ndarray] = None
+
+    def fit(self, X) -> "StandardScaler":
+        s = col_stats(X)
+        self.mean_ = np.asarray(s.mean)
+        # MLlib uses the corrected sample std
+        self.std_ = np.sqrt(np.asarray(s.variance))
+        return self
+
+    def transform(self, X):
+        if self.mean_ is None:
+            raise RuntimeError("fit() before transform()")
+        X = jnp.asarray(X, jnp.float32)
+        if self.with_mean:
+            X = X - self.mean_
+        if self.with_std:
+            X = X / jnp.where(self.std_ > 0, self.std_, 1.0)
+        return X
+
+    def fit_transform(self, X):
+        return self.fit(X).transform(X)
+
+
+class MinMaxScaler:
+    """Scale columns to [lo, hi] from fitted per-column min/max."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0):
+        if hi <= lo:
+            raise ValueError("hi must exceed lo")
+        self.lo = lo
+        self.hi = hi
+        self.min_: Optional[np.ndarray] = None
+        self.max_: Optional[np.ndarray] = None
+
+    def fit(self, X) -> "MinMaxScaler":
+        s = col_stats(X)
+        self.min_ = np.asarray(s.min)
+        self.max_ = np.asarray(s.max)
+        return self
+
+    def transform(self, X):
+        if self.min_ is None:
+            raise RuntimeError("fit() before transform()")
+        X = jnp.asarray(X, jnp.float32)
+        rng = self.max_ - self.min_
+        unit = (X - self.min_) / jnp.where(rng > 0, rng, 1.0)
+        # constant columns land mid-range, like MLlib
+        unit = jnp.where(rng > 0, unit, 0.5)
+        return unit * (self.hi - self.lo) + self.lo
+
+    def fit_transform(self, X):
+        return self.fit(X).transform(X)
+
+
+class Normalizer:
+    """Scale each row to unit p-norm (p in {1, 2, inf}); zero rows pass."""
+
+    def __init__(self, p: float = 2.0):
+        if p not in (1.0, 2.0, float("inf")):
+            raise ValueError("p must be 1, 2, or inf")
+        self.p = p
+
+    def transform(self, X):
+        X = jnp.asarray(X, jnp.float32)
+        if self.p == 1.0:
+            n = jnp.sum(jnp.abs(X), axis=1, keepdims=True)
+        elif self.p == 2.0:
+            n = jnp.sqrt(jnp.sum(X * X, axis=1, keepdims=True))
+        else:
+            n = jnp.max(jnp.abs(X), axis=1, keepdims=True)
+        return X / jnp.where(n > 0, n, 1.0)

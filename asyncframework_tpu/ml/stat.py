@@ -1,0 +1,129 @@
+"""Column statistics and correlation.
+
+Parity: MLlib ``stat/`` -- ``Statistics.colStats`` returning a
+``MultivariateStatisticalSummary`` (mean, variance, count, numNonzeros,
+max, min) and ``Statistics.corr`` (Pearson / Spearman).  One jitted pass
+computes every summary moment; the same pass runs ``psum``-reduced over a
+mesh axis for sharded data (the reference tree-aggregates per partition).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ColStats:
+    """MultivariateStatisticalSummary parity (corrected sample variance)."""
+
+    count: int
+    mean: np.ndarray
+    variance: np.ndarray
+    num_nonzeros: np.ndarray
+    max: np.ndarray
+    min: np.ndarray
+
+
+@jax.jit
+def _moments(X):
+    n = X.shape[0]
+    s1 = X.sum(axis=0)
+    s2 = (X * X).sum(axis=0)
+    nnz = (X != 0).sum(axis=0)
+    return n, s1, s2, nnz, X.max(axis=0), X.min(axis=0)
+
+
+def col_stats(X, mesh: Optional[Mesh] = None, axis: str = "dp") -> ColStats:
+    """Column summary of ``X`` (n, d); with ``mesh``, X is sharded on rows
+    over ``axis`` and the moments are psum-combined over ICI."""
+    X = jnp.asarray(X, jnp.float32)
+    if mesh is None:
+        n, s1, s2, nnz, mx, mn = _moments(X)
+    else:
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=P(axis, None),
+            out_specs=(P(), P(None), P(None), P(None), P(None), P(None)),
+        )
+        def dist(Xl):
+            nl = Xl.shape[0]
+            out = (
+                jnp.asarray(nl, jnp.int32),
+                Xl.sum(axis=0),
+                (Xl * Xl).sum(axis=0),
+                (Xl != 0).sum(axis=0),
+                Xl.max(axis=0),
+                Xl.min(axis=0),
+            )
+            n = jax.lax.psum(out[0], axis)
+            s1 = jax.lax.psum(out[1], axis)
+            s2 = jax.lax.psum(out[2], axis)
+            nnz = jax.lax.psum(out[3], axis)
+            mx = jax.lax.pmax(out[4], axis)
+            mn = jax.lax.pmin(out[5], axis)
+            return n, s1, s2, nnz, mx, mn
+
+        n, s1, s2, nnz, mx, mn = dist(X)
+    n = int(n)
+    mean = np.asarray(s1) / n
+    # corrected sample variance from the moments
+    var = (np.asarray(s2) - n * mean**2) / max(n - 1, 1)
+    return ColStats(
+        count=n,
+        mean=mean,
+        variance=np.maximum(var, 0.0),
+        num_nonzeros=np.asarray(nnz),
+        max=np.asarray(mx),
+        min=np.asarray(mn),
+    )
+
+
+@jax.jit
+def _pearson(X):
+    Xc = X - X.mean(axis=0)
+    cov = Xc.T @ Xc
+    sd = jnp.sqrt(jnp.diag(cov))
+    denom = jnp.outer(sd, sd)
+    return jnp.where(denom > 0, cov / denom, 0.0)
+
+
+def _average_ranks(col: np.ndarray) -> np.ndarray:
+    """Average ranks with tie handling (Spearman's requirement)."""
+    order = np.argsort(col, kind="stable")
+    ranks = np.empty(len(col), np.float64)
+    sorted_vals = col[order]
+    i = 0
+    while i < len(col):
+        j = i
+        while j + 1 < len(col) and sorted_vals[j + 1] == sorted_vals[i]:
+            j += 1
+        ranks[order[i:j + 1]] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    return ranks
+
+
+def corr(X, method: str = "pearson") -> np.ndarray:
+    """(d, d) correlation matrix of the columns of ``X``.
+
+    Pearson runs fully on device (one centered gram matrix); Spearman ranks
+    on the host (tie-averaged ranks are data-dependent control flow) and
+    then reuses the device Pearson on the ranks, mirroring how the
+    reference computes Spearman as Pearson-of-ranks.
+    """
+    if method == "pearson":
+        return np.asarray(_pearson(jnp.asarray(X, jnp.float32)))
+    if method == "spearman":
+        Xh = np.asarray(X)
+        R = np.column_stack(
+            [_average_ranks(Xh[:, j]) for j in range(Xh.shape[1])]
+        )
+        return np.asarray(_pearson(jnp.asarray(R, jnp.float32)))
+    raise ValueError(f"unknown correlation method {method!r}")

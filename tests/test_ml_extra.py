@@ -1,0 +1,145 @@
+"""ML library breadth tests: ALS, feature transforms, statistics.
+
+Parity targets: MLlib's ALS recommendation, ``feature/`` scalers, and
+``Statistics.colStats``/``corr`` (SURVEY.md section 2.5); numerical ground
+truth comes from dense NumPy equivalents.
+"""
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.ml import (
+    ALS,
+    MinMaxScaler,
+    Normalizer,
+    StandardScaler,
+    col_stats,
+    corr,
+)
+from asyncframework_tpu.parallel import make_mesh
+
+
+class TestALS:
+    @pytest.fixture()
+    def planted(self, rng):
+        """Low-rank planted ratings with 60% observed entries."""
+        n_u, n_i, k = 40, 30, 4
+        U = rng.normal(size=(n_u, k)).astype(np.float32)
+        V = rng.normal(size=(n_i, k)).astype(np.float32)
+        R = U @ V.T
+        mask = (rng.random((n_u, n_i)) < 0.6).astype(np.float32)
+        return R, mask
+
+    def test_reconstructs_observed_entries(self, planted):
+        R, mask = planted
+        model = ALS(rank=4, reg=0.01, num_iterations=15).fit(R, mask)
+        assert model.rmse(R, mask) < 0.05
+        # and generalizes to HELD-OUT entries (low-rank structure recovered)
+        holdout = 1.0 - mask
+        assert model.rmse(R, holdout) < 0.5
+
+    def test_rank_and_shapes(self, planted):
+        R, mask = planted
+        m = ALS(rank=3, num_iterations=2).fit(R, mask)
+        assert m.user_factors.shape == (40, 3)
+        assert m.item_factors.shape == (30, 3)
+        pred = m.predict([0, 1], [5, 7])
+        assert pred.shape == (2,)
+
+    def test_default_mask_is_nonzero(self, rng):
+        R = np.zeros((8, 6), np.float32)
+        R[0, 0], R[3, 4] = 2.0, -1.0
+        m = ALS(rank=2, num_iterations=3).fit(R)
+        assert np.isfinite(m.predict_all()).all()
+
+    def test_seed_determinism(self, planted):
+        R, mask = planted
+        a = ALS(rank=4, num_iterations=3, seed=1).fit(R, mask)
+        b = ALS(rank=4, num_iterations=3, seed=1).fit(R, mask)
+        np.testing.assert_array_equal(a.user_factors, b.user_factors)
+
+    def test_reg_shrinks_factors(self, planted):
+        R, mask = planted
+        small = ALS(rank=4, reg=0.01, num_iterations=5).fit(R, mask)
+        big = ALS(rank=4, reg=100.0, num_iterations=5).fit(R, mask)
+        assert (
+            np.linalg.norm(big.user_factors)
+            < np.linalg.norm(small.user_factors)
+        )
+
+
+class TestFeature:
+    def test_standard_scaler_matches_numpy(self, rng):
+        X = rng.normal(loc=3.0, scale=2.0, size=(200, 5)).astype(np.float32)
+        Z = np.asarray(StandardScaler().fit_transform(X))
+        np.testing.assert_allclose(Z.mean(axis=0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(Z.std(axis=0, ddof=1), 1.0, atol=1e-4)
+
+    def test_standard_scaler_constant_column_safe(self):
+        X = np.ones((10, 2), np.float32)
+        Z = np.asarray(StandardScaler().fit_transform(X))
+        assert np.isfinite(Z).all()
+
+    def test_minmax_scaler(self, rng):
+        X = rng.normal(size=(50, 3)).astype(np.float32)
+        Z = np.asarray(MinMaxScaler(0.0, 1.0).fit_transform(X))
+        np.testing.assert_allclose(Z.min(axis=0), 0.0, atol=1e-6)
+        np.testing.assert_allclose(Z.max(axis=0), 1.0, atol=1e-6)
+
+    def test_normalizer_l2(self, rng):
+        X = rng.normal(size=(20, 4)).astype(np.float32)
+        X[3] = 0.0  # zero row passes through
+        Z = np.asarray(Normalizer(2.0).transform(X))
+        norms = np.linalg.norm(Z, axis=1)
+        np.testing.assert_allclose(np.delete(norms, 3), 1.0, atol=1e-5)
+        np.testing.assert_array_equal(Z[3], 0.0)
+
+
+class TestStats:
+    def test_col_stats_matches_numpy(self, rng):
+        X = rng.normal(size=(128, 4)).astype(np.float32)
+        X[X < -1.2] = 0.0
+        s = col_stats(X)
+        assert s.count == 128
+        np.testing.assert_allclose(s.mean, X.mean(axis=0), atol=1e-5)
+        np.testing.assert_allclose(
+            s.variance, X.var(axis=0, ddof=1), rtol=1e-4
+        )
+        np.testing.assert_array_equal(s.num_nonzeros, (X != 0).sum(axis=0))
+        np.testing.assert_allclose(s.max, X.max(axis=0))
+        np.testing.assert_allclose(s.min, X.min(axis=0))
+
+    def test_col_stats_sharded_equals_local(self, rng, devices8):
+        X = rng.normal(size=(160, 6)).astype(np.float32)
+        mesh = make_mesh(8, devices=devices8)
+        local = col_stats(X)
+        dist = col_stats(X, mesh=mesh)
+        assert dist.count == local.count
+        np.testing.assert_allclose(dist.mean, local.mean, atol=1e-5)
+        np.testing.assert_allclose(dist.variance, local.variance, rtol=1e-4)
+        np.testing.assert_array_equal(dist.num_nonzeros, local.num_nonzeros)
+
+    def test_pearson_matches_numpy(self, rng):
+        X = rng.normal(size=(300, 4)).astype(np.float32)
+        X[:, 2] = 2.0 * X[:, 0] + 0.01 * rng.normal(size=300)
+        C = corr(X, "pearson")
+        np.testing.assert_allclose(C, np.corrcoef(X.T), atol=1e-4)
+        assert C[0, 2] > 0.99
+
+    def test_spearman_rank_invariance(self, rng):
+        x = rng.normal(size=200).astype(np.float32)
+        X = np.column_stack([x, np.exp(x)])  # monotone transform
+        C = corr(X, "spearman")
+        assert C[0, 1] == pytest.approx(1.0, abs=1e-5)
+
+    def test_spearman_handles_ties(self):
+        X = np.column_stack([
+            np.array([1, 1, 2, 2, 3, 3], np.float32),
+            np.array([2, 2, 4, 4, 6, 6], np.float32),
+        ])
+        C = corr(X, "spearman")
+        assert C[0, 1] == pytest.approx(1.0, abs=1e-6)
+
+    def test_unknown_method(self):
+        with pytest.raises(ValueError):
+            corr(np.zeros((4, 2)), "kendall")
