@@ -171,5 +171,5 @@ def render_report(
     parts.append("</body></html>")
     doc = "".join(parts)
     if out_path is not None:
-        Path(out_path).write_text(doc)
+        Path(out_path).write_text(doc, encoding="utf-8")
     return doc

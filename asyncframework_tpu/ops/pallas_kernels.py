@@ -82,6 +82,8 @@ def fused_masked_grad(
     """
     X = jnp.asarray(X, jnp.float32)
     n, d = X.shape
+    if n == 0:
+        return jnp.zeros(d, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
     m = (
         jnp.ones(n, jnp.float32)

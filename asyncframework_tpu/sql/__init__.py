@@ -1,0 +1,4 @@
+from asyncframework_tpu.sql.expressions import Column, col, lit
+from asyncframework_tpu.sql.frame import ColumnarFrame
+
+__all__ = ["ColumnarFrame", "Column", "col", "lit"]

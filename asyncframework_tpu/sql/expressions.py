@@ -1,0 +1,126 @@
+"""Column expressions: a small lazy expression tree over device arrays.
+
+Parity: Spark SQL's ``Column`` DSL (``sql/core/.../Column.scala`` /
+catalyst expression trees).  The reference compiles expression trees to JVM
+bytecode (whole-stage codegen); here the SAME role -- turn a tree of
+column refs, literals, arithmetic, comparisons, and boolean logic into one
+fused kernel -- is filled by tracing the tree into a jitted XLA computation,
+which is the TPU's whole-stage codegen.  No SQL parser: the experiments the
+reference ships never issue SQL text, and the DSL is the capability layer
+Spark's own DataFrame API sits on.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+
+
+class Column:
+    """A lazy expression evaluated against a dict of named arrays."""
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any], name: str):
+        self._fn = fn
+        self.name = name
+
+    def __call__(self, columns: Dict[str, Any]):
+        return self._fn(columns)
+
+    def alias(self, name: str) -> "Column":
+        return Column(self._fn, name)
+
+    # ------------------------------------------------------------- operators
+    def _binop(self, other, op, sym: str, reflect: bool = False) -> "Column":
+        other_c = other if isinstance(other, Column) else lit(other)
+        a, b = (other_c, self) if reflect else (self, other_c)
+
+        def fn(cols):
+            return op(a(cols), b(cols))
+
+        return Column(fn, f"({a.name} {sym} {b.name})")
+
+    def __add__(self, o):
+        return self._binop(o, operator.add, "+")
+
+    def __radd__(self, o):
+        return self._binop(o, operator.add, "+", reflect=True)
+
+    def __sub__(self, o):
+        return self._binop(o, operator.sub, "-")
+
+    def __rsub__(self, o):
+        return self._binop(o, operator.sub, "-", reflect=True)
+
+    def __mul__(self, o):
+        return self._binop(o, operator.mul, "*")
+
+    def __rmul__(self, o):
+        return self._binop(o, operator.mul, "*", reflect=True)
+
+    def __truediv__(self, o):
+        return self._binop(o, operator.truediv, "/")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, operator.truediv, "/", reflect=True)
+
+    def __mod__(self, o):
+        return self._binop(o, operator.mod, "%")
+
+    def __neg__(self):
+        return Column(lambda cols: -self(cols), f"(-{self.name})")
+
+    # comparisons produce boolean columns
+    def __eq__(self, o):  # type: ignore[override]
+        return self._binop(o, operator.eq, "==")
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._binop(o, operator.ne, "!=")
+
+    def __lt__(self, o):
+        return self._binop(o, operator.lt, "<")
+
+    def __le__(self, o):
+        return self._binop(o, operator.le, "<=")
+
+    def __gt__(self, o):
+        return self._binop(o, operator.gt, ">")
+
+    def __ge__(self, o):
+        return self._binop(o, operator.ge, ">=")
+
+    # boolean logic (use & | ~ like Spark/pandas)
+    def __and__(self, o):
+        return self._binop(o, jnp.logical_and, "AND")
+
+    def __or__(self, o):
+        return self._binop(o, jnp.logical_or, "OR")
+
+    def __invert__(self):
+        return Column(
+            lambda cols: jnp.logical_not(self(cols)), f"(NOT {self.name})"
+        )
+
+    __hash__ = object.__hash__  # __eq__ is overridden for the DSL
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Column<{self.name}>"
+
+
+def col(name: str) -> Column:
+    """Reference a frame column by name."""
+
+    def fn(cols):
+        if name not in cols:
+            raise KeyError(
+                f"no column {name!r}; have {sorted(cols)}"
+            )
+        return cols[name]
+
+    return Column(fn, name)
+
+
+def lit(value) -> Column:
+    """A literal broadcast against the frame's rows."""
+    return Column(lambda cols: value, repr(value))

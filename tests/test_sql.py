@@ -1,0 +1,150 @@
+"""Columnar frame tests: the Spark SQL DataFrame capability analog.
+
+Parity targets (SURVEY.md section 2.5, ``Dataset.scala:166`` surface):
+select/filter/withColumn expression fusion, groupBy-agg, sort, equi-joins
+(inner + left, duplicate keys), collected row semantics.  Ground truth is
+hand-computed or plain NumPy.
+"""
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.sql import ColumnarFrame, col, lit
+
+
+@pytest.fixture()
+def sales():
+    return ColumnarFrame({
+        "region": np.array(["west", "east", "west", "south", "east", "west"]),
+        "units": np.array([10, 3, 7, 1, 9, 2], np.int32),
+        "price": np.array([1.5, 2.0, 1.0, 4.0, 0.5, 3.0], np.float32),
+    })
+
+
+class TestBasics:
+    def test_construction_validates(self):
+        with pytest.raises(ValueError, match="rows"):
+            ColumnarFrame({"a": np.arange(3), "b": np.arange(4)})
+        with pytest.raises(ValueError, match="1-d"):
+            ColumnarFrame({"a": np.zeros((2, 2))})
+
+    def test_select_and_expressions(self, sales):
+        out = sales.select(
+            "region", (col("units") * col("price")).alias("revenue")
+        )
+        assert out.columns == ["region", "revenue"]
+        np.testing.assert_allclose(
+            np.asarray(out["revenue"]), [15, 6, 7, 4, 4.5, 6]
+        )
+
+    def test_with_column_and_literals(self, sales):
+        out = sales.with_column("discounted", col("price") * lit(0.9))
+        np.testing.assert_allclose(
+            np.asarray(out["discounted"]),
+            np.asarray(sales["price"]) * 0.9,
+            rtol=1e-6,
+        )
+        # original frame untouched (immutability)
+        assert "discounted" not in sales.columns
+
+    def test_missing_column_raises(self, sales):
+        with pytest.raises(KeyError, match="nope"):
+            sales.select(col("nope") + 1)
+
+
+class TestFilterSort:
+    def test_filter_predicates_compose(self, sales):
+        out = sales.filter((col("units") > 2) & (col("price") < 2.0))
+        assert out.collect() == [("west", 10, 1.5), ("west", 7, 1.0),
+                                 ("east", 9, 0.5)]
+
+    def test_filter_keeps_host_key_columns_aligned(self, sales):
+        out = sales.filter(col("units") >= 9)
+        assert list(out["region"]) == ["west", "east"]
+
+    def test_sort(self, sales):
+        out = sales.sort("units", ascending=False)
+        assert list(np.asarray(out["units"])) == [10, 9, 7, 3, 2, 1]
+
+    def test_negation(self, sales):
+        out = sales.filter(~(col("region") == lit("west")))
+        assert len(out) == 3
+
+
+class TestGroupBy:
+    def test_agg_sum_mean_min_max(self, sales):
+        out = (
+            sales.groupby("region")
+            .agg(total=("units", "sum"), avg_price=("price", "mean"),
+                 lo=("price", "min"), hi=("price", "max"))
+            .sort("region")
+        )
+        # np.unique sorts keys: east, south, west
+        assert list(out["region"]) == ["east", "south", "west"]
+        np.testing.assert_allclose(np.asarray(out["total"]), [12, 1, 19])
+        np.testing.assert_allclose(np.asarray(out["avg_price"]),
+                                   [1.25, 4.0, 5.5 / 3], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["lo"]), [0.5, 4.0, 1.0])
+        np.testing.assert_allclose(np.asarray(out["hi"]), [2.0, 4.0, 3.0])
+
+    def test_count(self, sales):
+        out = sales.groupby("region").count().sort("region")
+        assert list(np.asarray(out["count"])) == [2, 1, 3]
+
+    def test_whole_frame_agg(self, sales):
+        out = sales.agg(n=("units", "count"), s=("units", "sum"))
+        assert out == {"n": 6, "s": 32}
+
+    def test_unknown_agg(self, sales):
+        with pytest.raises(ValueError, match="unknown aggregate"):
+            sales.groupby("region").agg(x=("units", "median"))
+
+
+class TestJoin:
+    def test_inner_join_with_duplicate_right_keys(self):
+        left = ColumnarFrame({
+            "k": np.array([1, 2, 3], np.int32),
+            "l": np.array([10.0, 20.0, 30.0], np.float32),
+        })
+        right = ColumnarFrame({
+            "k": np.array([2, 2, 4], np.int32),
+            "r": np.array([5.0, 6.0, 7.0], np.float32),
+        })
+        out = left.join(right, on="k")
+        # k=2 matches twice; k=1,3 drop
+        rows = sorted(out.collect())
+        assert rows == [(2, 20.0, 5.0), (2, 20.0, 6.0)]
+
+    def test_left_join_fills_nan(self):
+        left = ColumnarFrame({
+            "k": np.array([1, 2], np.int32),
+            "l": np.array([1.0, 2.0], np.float32),
+        })
+        right = ColumnarFrame({
+            "k": np.array([2], np.int32),
+            "r": np.array([9.0], np.float32),
+        })
+        out = left.join(right, on="k", how="left").sort("k")
+        r = np.asarray(out["r"])
+        assert np.isnan(r[0]) and r[1] == 9.0
+
+    def test_join_on_string_keys(self, sales):
+        lookup = ColumnarFrame({
+            "region": np.array(["west", "east"]),
+            "manager": np.array(["ada", "bob"]),
+        })
+        out = sales.join(lookup, on="region")
+        assert len(out) == 5  # south has no match
+        managers = set(out["manager"])
+        assert managers == {"ada", "bob"}
+
+    def test_name_collision_suffixes(self):
+        left = ColumnarFrame({"k": np.array([1]), "v": np.array([1.0])})
+        right = ColumnarFrame({"k": np.array([1]), "v": np.array([2.0])})
+        out = left.join(right, on="k")
+        assert set(out.columns) == {"k", "v", "v_right"}
+
+    def test_bad_how(self):
+        f = ColumnarFrame({"k": np.array([1])})
+        with pytest.raises(ValueError, match="how"):
+            f.join(f, on="k", how="outer")
