@@ -11,6 +11,7 @@ vocabulary: rounds, merges, staleness distribution, worker health).
 
 from __future__ import annotations
 
+import gzip
 import json
 import threading
 from dataclasses import asdict
@@ -20,13 +21,23 @@ from typing import Dict, Iterator, List, Optional, Union
 from asyncframework_tpu.metrics.bus import EVENT_TYPES, Event, Listener
 
 
+def _open_log(path: Path, mode: str):
+    """``.gz`` paths route through the zlib codec (the reference compresses
+    event logs with its native lz4/zstd codecs --
+    ``io/CompressionCodec.scala``; CPython's zlib is the native codec here)."""
+    if path.suffix == ".gz":
+        return gzip.open(path, mode + "t", encoding="utf-8")
+    return path.open(mode, buffering=1 if "w" in mode else -1)
+
+
 class EventLogWriter(Listener):
-    """Streams every bus event to a JSONL file; one line per event."""
+    """Streams every bus event to a JSONL file (``.gz`` = compressed);
+    one line per event."""
 
     def __init__(self, path: Union[str, Path]):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._f = self.path.open("w", buffering=1)  # line-buffered
+        self._f = _open_log(self.path, "w")
         self._lock = threading.Lock()
         self._closed = False
 
@@ -36,6 +47,9 @@ class EventLogWriter(Listener):
         with self._lock:
             if not self._closed:
                 self._f.write(line + "\n")
+                # flush per event: the log is a crash-forensics artifact, and
+                # the gzip stream would otherwise buffer everything to close()
+                self._f.flush()
 
     # per-type hooks all route to on_event for the writer
     def __getattr__(self, name: str):
@@ -62,9 +76,19 @@ class EventLogReader:
     def __init__(self, path: Union[str, Path]):
         self.path = Path(path)
 
+    @staticmethod
+    def _lines(f) -> Iterator[str]:
+        """Line iteration tolerating a crash-torn tail: a writer that died
+        before close() leaves a gzip stream without its end marker; every
+        fully-flushed line before the tear still replays."""
+        try:
+            yield from f
+        except EOFError:
+            return
+
     def replay(self) -> Iterator[Event]:
-        with self.path.open() as f:
-            for line in f:
+        with _open_log(self.path, "r") as f:
+            for line in self._lines(f):
                 line = line.strip()
                 if not line:
                     continue

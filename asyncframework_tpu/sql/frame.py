@@ -188,27 +188,46 @@ class ColumnarFrame:
         )
         right_pos = np.repeat(start, rep_counts) + offs
         has_match = np.repeat(matched, rep_counts)
-        right_idx = np.where(
-            has_match, r_order[np.minimum(right_pos, len(rk) - 1)], 0
-        )
+        if len(rk):
+            right_idx = np.where(
+                has_match, r_order[np.minimum(right_pos, len(rk) - 1)], 0
+            )
+        else:
+            # empty right frame: every surviving row (left join) is a miss
+            right_idx = np.zeros(total, np.intp)
 
         out: Dict[str, object] = {}
         left_taken = self._take(left_idx)
         for name in self.columns:
             out[name] = left_taken._cols[name]
-        right_taken = other._take(right_idx)
         for name in other.columns:
             if name == on:
                 continue
             out_name = name if name not in out else f"{name}_right"
-            v = right_taken._cols[name]
+            src = other._cols[name]
+            if len(rk):
+                if isinstance(src, jnp.ndarray):
+                    v = jnp.take(src, jnp.asarray(right_idx), axis=0)
+                else:
+                    v = np.asarray(src)[right_idx]
+            else:  # no rows to gather from: build fill directly
+                v = (
+                    jnp.zeros((total,), src.dtype)
+                    if isinstance(src, jnp.ndarray)
+                    else np.zeros(total, np.asarray(src).dtype)
+                )
             if how == "left":
+                # mask unmatched rows in EVERY right column: floats get NaN,
+                # other device dtypes 0, host (string/object) columns the
+                # dtype's zero ('' for strings) -- never row-0's real data
                 if isinstance(v, jnp.ndarray) and jnp.issubdtype(
                     v.dtype, jnp.floating
                 ):
                     v = jnp.where(jnp.asarray(has_match), v, jnp.nan)
                 elif isinstance(v, jnp.ndarray):
                     v = jnp.where(jnp.asarray(has_match), v, 0)
+                else:
+                    v = np.where(has_match, v, np.zeros_like(v))
             out[out_name] = v
         return ColumnarFrame(out)
 

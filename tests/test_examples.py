@@ -1,0 +1,59 @@
+"""Smoke tests: every example's main() runs end-to-end with tiny sizes.
+
+Parity with the reference shipping runnable ``examples/`` alongside the
+framework; keeping them executed in CI prevents doc rot.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+sys.path.insert(0, str(EXAMPLES))
+
+
+def test_asgd_async_example():
+    import asgd_async
+
+    res = asgd_async.main(n=2048, d=16, iters=150)
+    assert res.accepted == 150
+    assert np.isfinite(res.final_objective)
+
+
+def test_asaga_history_example():
+    import asaga_history
+
+    res = asaga_history.main(n=2048, d=16, iters=120)
+    assert res.accepted == 120
+
+
+def test_streaming_example():
+    import streaming_pipeline
+
+    out = streaming_pipeline.main(n_batches=4, batch=32, d=8)
+    assert len(out) == 4
+
+
+def test_graph_example():
+    import graph_pagerank
+
+    r, cc = graph_pagerank.main(n=200, e=800)
+    assert r.sum() == pytest.approx(1.0, abs=1e-3)
+    assert cc.shape == (200,)
+
+
+def test_ring_attention_example():
+    import ring_attention_demo
+
+    out = ring_attention_demo.main(t=64, h=4, d=8)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_sql_example():
+    import sql_pipeline
+
+    report = sql_pipeline.main(n=500)
+    assert set(report.columns) >= {"region", "revenue", "manager"}
+    assert len(report) == 3

@@ -119,6 +119,38 @@ def test_eventlog_roundtrip(tmp_path):
     assert replayed == events  # exact typed round-trip (tuples restored)
 
 
+def test_eventlog_gzip_roundtrip(tmp_path):
+    """.gz paths compress through the zlib codec and replay identically."""
+    import gzip
+
+    log = tmp_path / "events.jsonl.gz"
+    writer = EventLogWriter(log)
+    events = [
+        ModelSnapshot(time_ms=float(i), iteration=i, objective=1.0 / (i + 1))
+        for i in range(50)
+    ]
+    for ev in events:
+        writer.on_event(ev)
+    writer.close()
+    with gzip.open(log) as f:  # actually gzip-framed on disk
+        assert len(f.read().splitlines()) == 50
+    assert list(EventLogReader(log).replay()) == events
+
+
+def test_eventlog_gzip_survives_crash_without_close(tmp_path):
+    """Per-event flush + torn-tail-tolerant replay: a writer that dies
+    before close() (the crash-forensics case) loses nothing flushed."""
+    log = tmp_path / "crash.jsonl.gz"
+    writer = EventLogWriter(log)
+    events = [ModelSnapshot(time_ms=float(i), iteration=i, objective=1.0)
+              for i in range(40)]
+    for ev in events:
+        writer.on_event(ev)
+    # no close(): simulated crash -- the gzip end-of-stream marker is absent
+    assert list(EventLogReader(log).replay()) == events
+    writer.close()
+
+
 def test_eventlog_summary(tmp_path):
     log = tmp_path / "events.jsonl"
     writer = EventLogWriter(log)

@@ -148,3 +148,27 @@ class TestJoin:
         f = ColumnarFrame({"k": np.array([1])})
         with pytest.raises(ValueError, match="how"):
             f.join(f, on="k", how="outer")
+
+    def test_left_join_masks_host_columns(self):
+        """Unmatched rows must not leak the right frame's row-0 strings."""
+        left = ColumnarFrame({"k": np.array([1, 2], np.int32)})
+        right = ColumnarFrame({
+            "k": np.array([2], np.int32),
+            "name": np.array(["bob"]),
+        })
+        out = left.join(right, on="k", how="left").sort("k")
+        assert list(out["name"]) == ["", "bob"]
+
+    def test_left_join_empty_right(self):
+        left = ColumnarFrame({
+            "k": np.array([1, 2], np.int32),
+            "l": np.array([1.0, 2.0], np.float32),
+        })
+        right = ColumnarFrame({
+            "k": np.array([], np.int32),
+            "r": np.array([], np.float32),
+        })
+        out = left.join(right, on="k", how="left")
+        assert len(out) == 2
+        assert np.isnan(np.asarray(out["r"])).all()
+        assert left.join(right, on="k").count() == 0  # inner: no rows
