@@ -193,8 +193,49 @@ class DistributedDataset(Generic[E]):
     def map(self, f: Callable[[E], U]) -> "DistributedDataset[U]":
         return self.map_partitions(lambda xs: [f(x) for x in xs])
 
+    def flat_map(self, f: Callable[[E], Iterable[U]]) -> "DistributedDataset[U]":
+        """``RDD.flatMap`` parity: one-to-many element expansion."""
+        return self.map_partitions(
+            lambda xs: [y for x in xs for y in f(x)]
+        )
+
     def filter(self, pred: Callable[[E], bool]) -> "DistributedDataset[E]":
         return self.map_partitions(lambda xs: [x for x in xs if pred(x)])
+
+    def union(self, other: "DistributedDataset[E]") -> "DistributedDataset[E]":
+        """``RDD.union`` parity: partition-wise concatenation (both datasets
+        are worker-pinned, so partition ``wid`` unions with partition
+        ``wid``; a partition present in only one side passes through)."""
+        if other.scheduler is not self.scheduler:
+            raise ValueError("union requires datasets on the same scheduler")
+        parts: Dict[int, Callable[[], Iterable[E]]] = {}
+        for wid in sorted(set(self._parts) | set(other._parts)):
+            def compute(w=wid):
+                out: List[E] = []
+                if w in self._parts:
+                    out.extend(self._compute(w))
+                if w in other._parts:
+                    out.extend(other._compute(w))
+                return out
+
+            parts[wid] = compute
+        return DistributedDataset(self.scheduler, parts)
+
+    def distinct(self) -> "DistributedDataset[E]":
+        """``RDD.distinct`` parity.  The reference shuffles by key so each
+        value lands on one partition; worker-pinned partitions have no
+        shuffle, so dedup is two-phase: per-partition local dedup in the
+        tasks, then a driver-side global pass that keeps each value's first
+        (lowest-partition) occurrence and re-pins survivors in place."""
+        local = self._run_sync(
+            lambda wid: (lambda w=wid: list(dict.fromkeys(self._compute(w))))
+        )
+        seen: set = set()
+        payloads: Dict[int, List[E]] = {}
+        for wid in sorted(local):
+            keep = [x for x in local[wid] if not (x in seen or seen.add(x))]
+            payloads[wid] = keep
+        return DistributedDataset.from_partitions(self.scheduler, payloads)
 
     def sample(self, fraction: float, seed: int) -> "DistributedDataset[E]":
         """Per-partition Bernoulli sampling, deterministic in (seed, wid).
@@ -277,6 +318,40 @@ class DistributedDataset(Generic[E]):
         for wid in self.partition_ids():
             out.extend(per[wid])
         return out
+
+    def take(self, n: int) -> List[E]:
+        """First ``n`` elements in partition order.
+
+        ``RDD.take``-style incremental scan, collapsed to two rounds: probe
+        the first partition alone (the common small-n case touches nothing
+        else), then -- only if short -- compute every remaining partition in
+        ONE parallel job instead of a sequential per-partition walk.
+        """
+        if n <= 0:
+            return []
+        ids = self.partition_ids()
+        if not ids:
+            return []
+        first = self._run_job_dict(
+            {ids[0]: (lambda w=ids[0]: self._compute(w))}
+        )[ids[0]]
+        out: List[E] = list(first[:n])
+        if len(out) >= n or len(ids) == 1:
+            return out
+        rest = self._run_job_dict(
+            {wid: (lambda w=wid: self._compute(w)) for wid in ids[1:]}
+        )
+        for wid in ids[1:]:
+            out.extend(rest[wid][: n - len(out)])
+            if len(out) >= n:
+                break
+        return out
+
+    def first(self) -> E:
+        got = self.take(1)
+        if not got:
+            raise ValueError("first() on an empty dataset")
+        return got[0]
 
     def count(self) -> int:
         per = self._run_sync(lambda wid: (lambda w=wid: len(self._compute(w))))

@@ -11,8 +11,20 @@ from asyncframework_tpu.engine.recovery import (
     plan_reassignment,
 )
 from asyncframework_tpu.engine.heartbeat import HeartbeatMonitor
+from asyncframework_tpu.engine.accumulator import (
+    Accumulator,
+    CollectionAccumulator,
+    DoubleAccumulator,
+    LongAccumulator,
+    MaxAccumulator,
+)
 
 __all__ = [
+    "Accumulator",
+    "LongAccumulator",
+    "DoubleAccumulator",
+    "CollectionAccumulator",
+    "MaxAccumulator",
     "Job",
     "JobWaiter",
     "TaskSpec",

@@ -1,0 +1,158 @@
+"""Probabilistic sketches: Count-Min and Bloom filter.
+
+Parity: ``common/sketch`` (Java ``CountMinSketch`` / ``BloomFilter``, used by
+SQL stat functions and join planning).  Both are mergeable -- the distributed
+usage pattern is per-partition sketches combined on the driver, which is how
+``DistributedDataset.aggregate`` consumes them here.
+
+Vectorized NumPy throughout: updates take whole arrays (one hash broadcast
+per row batch), not per-item loops.  Hashing is double hashing over two
+xxhash-style integer mixes, ``h_i(x) = h1(x) + i * h2(x)`` -- the standard
+Kirsch-Mitzenmacher construction the reference's Bloom filter also uses.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Union
+
+import numpy as np
+
+_M1 = np.uint64(0xFF51AFD7ED558CCD)
+_M2 = np.uint64(0xC4CEB9FE1A85EC53)
+
+
+def _mix64(x: np.ndarray, seed: int) -> np.ndarray:
+    """splitmix64-style avalanche over uint64 arrays."""
+    with np.errstate(over="ignore"):
+        h = x.astype(np.uint64) + np.uint64(seed) * np.uint64(
+            0x9E3779B97F4A7C15
+        )
+        h ^= h >> np.uint64(33)
+        h *= _M1
+        h ^= h >> np.uint64(33)
+        h *= _M2
+        h ^= h >> np.uint64(33)
+    return h
+
+
+def _to_u64(items) -> np.ndarray:
+    """Hash item arrays (or scalars) to 1-d uint64: ints pass through,
+    floats via bit pattern, strings/bytes via an FNV-1a polynomial hash;
+    object arrays dispatch per element by type."""
+    a = np.atleast_1d(np.asarray(items))
+    if a.dtype.kind in "iu":
+        return a.astype(np.uint64)
+    if a.dtype.kind == "f":
+        return a.astype(np.float64).view(np.uint64)
+    if a.dtype.kind in ("U", "S", "O"):
+        out = np.empty(a.shape[0], np.uint64)
+        with np.errstate(over="ignore"):
+            for i, s in enumerate(a):
+                if isinstance(s, (int, np.integer)):
+                    out[i] = np.uint64(int(s) & 0xFFFFFFFFFFFFFFFF)
+                    continue
+                if isinstance(s, (float, np.floating)):
+                    out[i] = np.asarray(float(s)).view(np.uint64)
+                    continue
+                if isinstance(s, str):
+                    b = s.encode()
+                elif isinstance(s, (bytes, np.bytes_)):
+                    b = bytes(s)
+                else:
+                    raise TypeError(f"unhashable item type {type(s)}")
+                h = np.uint64(1469598103934665603)
+                for byte in b:
+                    h = (h ^ np.uint64(byte)) * np.uint64(1099511628211)
+                out[i] = h
+        return out
+    raise TypeError(f"unhashable dtype {a.dtype}")
+
+
+class CountMinSketch:
+    """Approximate frequency counting: overestimates, never underestimates.
+
+    ``depth`` rows of ``width`` counters; estimate = min over rows.
+    """
+
+    def __init__(self, depth: int = 5, width: int = 1 << 12, seed: int = 42):
+        if depth < 1 or width < 1:
+            raise ValueError("depth and width must be >= 1")
+        self.depth = depth
+        self.width = width
+        self.seed = seed
+        self.table = np.zeros((depth, width), np.int64)
+        self.total = 0
+
+    def _slots(self, items) -> np.ndarray:
+        keys = _to_u64(items)
+        h1 = _mix64(keys, self.seed)
+        h2 = _mix64(keys, self.seed + 1) | np.uint64(1)
+        rows = np.arange(self.depth, dtype=np.uint64)[:, None]
+        with np.errstate(over="ignore"):
+            return ((h1[None, :] + rows * h2[None, :])
+                    % np.uint64(self.width)).astype(np.intp)
+
+    def add(self, items, counts: Union[int, np.ndarray] = 1) -> None:
+        slots = self._slots(items)  # (depth, n)
+        counts = np.broadcast_to(np.asarray(counts, np.int64), slots.shape[1:])
+        for r in range(self.depth):
+            np.add.at(self.table[r], slots[r], counts)
+        self.total += int(counts.sum())
+
+    def estimate(self, items) -> np.ndarray:
+        slots = self._slots(items)
+        ests = np.stack([self.table[r][slots[r]] for r in range(self.depth)])
+        return ests.min(axis=0)
+
+    def merge(self, other: "CountMinSketch") -> "CountMinSketch":
+        if (self.depth, self.width, self.seed) != (
+            other.depth, other.width, other.seed
+        ):
+            raise ValueError("can only merge identically-configured sketches")
+        self.table += other.table
+        self.total += other.total
+        return self
+
+
+class BloomFilter:
+    """Approximate membership: no false negatives, tunable false positives."""
+
+    def __init__(self, capacity: int = 10_000, fpp: float = 0.03,
+                 seed: int = 42):
+        if not 0 < fpp < 1:
+            raise ValueError("fpp must be in (0, 1)")
+        # standard sizing: m = -n ln p / ln2^2, k = m/n ln2
+        m = int(np.ceil(-capacity * np.log(fpp) / (np.log(2) ** 2)))
+        self.num_bits = max(64, m)
+        self.num_hashes = max(1, int(round(m / capacity * np.log(2))))
+        self.seed = seed
+        self.bits = np.zeros((self.num_bits + 63) // 64, np.uint64)
+
+    def _positions(self, items) -> np.ndarray:
+        keys = _to_u64(items)
+        h1 = _mix64(keys, self.seed)
+        h2 = _mix64(keys, self.seed + 1) | np.uint64(1)
+        ks = np.arange(self.num_hashes, dtype=np.uint64)[:, None]
+        with np.errstate(over="ignore"):
+            return ((h1[None, :] + ks * h2[None, :])
+                    % np.uint64(self.num_bits)).astype(np.intp)
+
+    def add(self, items) -> None:
+        pos = self._positions(items).ravel()
+        np.bitwise_or.at(
+            self.bits, pos >> 6, np.uint64(1) << (pos & 63).astype(np.uint64)
+        )
+
+    def might_contain(self, items) -> np.ndarray:
+        pos = self._positions(items)  # (k, n)
+        word = self.bits[pos >> 6]
+        bit = (word >> (pos & 63).astype(np.uint64)) & np.uint64(1)
+        return bit.all(axis=0)
+
+    def merge(self, other: "BloomFilter") -> "BloomFilter":
+        if (self.num_bits, self.num_hashes, self.seed) != (
+            other.num_bits, other.num_hashes, other.seed
+        ):
+            raise ValueError("can only merge identically-configured filters")
+        self.bits |= other.bits
+        return self

@@ -1,0 +1,211 @@
+"""Sketches, accumulators, and the new dataset ops.
+
+Parity: ``common/sketch`` (CountMinSketch/BloomFilter with merge),
+``AccumulatorV2`` (Long/Double/Collection), and ``RDD``
+flatMap/union/distinct/take/first.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.data import DistributedDataset
+from asyncframework_tpu.engine import (
+    CollectionAccumulator,
+    DoubleAccumulator,
+    JobScheduler,
+    LongAccumulator,
+    MaxAccumulator,
+)
+from asyncframework_tpu.utils.sketch import BloomFilter, CountMinSketch
+
+
+class TestCountMinSketch:
+    def test_never_underestimates_and_is_close(self, rng):
+        items = rng.integers(0, 200, size=20_000)
+        cms = CountMinSketch(depth=5, width=1 << 12)
+        cms.add(items)
+        true = np.bincount(items, minlength=200)
+        est = cms.estimate(np.arange(200))
+        assert (est >= true).all()          # CMS invariant
+        assert (est - true).mean() < 5      # tight at this width
+        assert cms.total == 20_000
+
+    def test_weighted_adds(self):
+        cms = CountMinSketch()
+        cms.add(np.array([7, 8]), counts=np.array([10, 3]))
+        assert cms.estimate(np.array([7]))[0] >= 10
+
+    def test_merge_equals_union(self, rng):
+        a, b = CountMinSketch(seed=1), CountMinSketch(seed=1)
+        xs, ys = rng.integers(0, 50, 1000), rng.integers(0, 50, 1000)
+        a.add(xs)
+        b.add(ys)
+        both = CountMinSketch(seed=1)
+        both.add(np.concatenate([xs, ys]))
+        a.merge(b)
+        np.testing.assert_array_equal(
+            a.estimate(np.arange(50)), both.estimate(np.arange(50))
+        )
+
+    def test_merge_config_mismatch(self):
+        with pytest.raises(ValueError):
+            CountMinSketch(depth=3).merge(CountMinSketch(depth=5))
+
+    def test_string_items(self):
+        cms = CountMinSketch()
+        cms.add(np.array(["alpha", "beta", "alpha"]))
+        assert cms.estimate(np.array(["alpha"]))[0] >= 2
+        assert cms.estimate(np.array(["gamma"]))[0] >= 0
+
+
+class TestBloomFilter:
+    def test_no_false_negatives(self, rng):
+        bf = BloomFilter(capacity=5000, fpp=0.03)
+        members = rng.integers(0, 10**9, 5000)
+        bf.add(members)
+        assert bf.might_contain(members).all()
+
+    def test_false_positive_rate_in_band(self, rng):
+        bf = BloomFilter(capacity=5000, fpp=0.03, seed=3)
+        bf.add(np.arange(5000))
+        probes = np.arange(10_000, 60_000)
+        fpr = bf.might_contain(probes).mean()
+        assert fpr < 0.06  # ~2x design headroom
+
+    def test_merge(self):
+        a, b = BloomFilter(1000, seed=2), BloomFilter(1000, seed=2)
+        a.add(np.arange(0, 100))
+        b.add(np.arange(100, 200))
+        a.merge(b)
+        assert a.might_contain(np.arange(200)).all()
+
+    def test_float_and_string_items(self):
+        bf = BloomFilter(100)
+        bf.add(np.array([1.5, 2.5]))
+        bf.add(np.array(["x"]))
+        assert bf.might_contain(np.array([1.5]))[0]
+        assert bf.might_contain(np.array(["x"]))[0]
+
+    def test_scalar_and_object_array_items(self):
+        """Scalars and mixed object arrays hash by value, not via bytes()."""
+        cms = CountMinSketch()
+        cms.add(5)                 # bare scalar
+        cms.add("five")
+        assert cms.estimate(5)[0] >= 1
+        bf = BloomFilter(100)
+        bf.add(np.array([10**9, -3, 2.5, "s"], dtype=object))
+        assert bf.might_contain(np.array([10**9, -3], dtype=object)).all()
+        with pytest.raises(TypeError):
+            bf.add(np.array([object()], dtype=object))
+
+
+class TestAccumulators:
+    def test_long_sum_count_avg(self):
+        acc = LongAccumulator("steps")
+        for i in range(10):
+            acc.add(i)
+        assert acc.value == 45 and acc.count == 10 and acc.avg == 4.5
+        acc.reset()
+        assert acc.value == 0 and acc.count == 0
+
+    def test_merge(self):
+        a, b = LongAccumulator(), LongAccumulator()
+        a.add(5)
+        b.add(7)
+        b.add(1)
+        a.merge(b)
+        assert a.value == 13 and a.count == 3
+
+    def test_self_merge_does_not_deadlock(self):
+        a = LongAccumulator()
+        a.add(4)
+        a.merge(a)  # doubles, must not hang
+        assert a.value == 8
+        d = DoubleAccumulator()
+        d.add(1.5)
+        d.merge(d)
+        assert d.value == 3.0
+
+    def test_collection_and_max(self):
+        c = CollectionAccumulator()
+        c.add("x")
+        c.add(["y", "z"])
+        assert c.value == ["x", "y", "z"]
+        m = MaxAccumulator()
+        m.add(3.0)
+        m.add(-1.0)
+        assert m.value == 3.0
+
+    def test_thread_safety_under_concurrent_adds(self):
+        acc = DoubleAccumulator()
+
+        def worker():
+            for _ in range(5000):
+                acc.add(1.0)
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert acc.value == 40_000.0
+
+    def test_tasks_update_accumulator(self):
+        """The Spark usage: tasks add, the driver reads after the job."""
+        sched = JobScheduler(num_workers=4)
+        acc = LongAccumulator("rows")
+        try:
+            ds = DistributedDataset.from_list(sched, list(range(40)))
+            ds.map(lambda x: (acc.add(1), x)[1]).collect()
+            assert acc.value == 40
+        finally:
+            sched.shutdown()
+
+
+class TestDatasetOps:
+    @pytest.fixture()
+    def sched(self):
+        s = JobScheduler(num_workers=4)
+        yield s
+        s.shutdown()
+
+    def test_flat_map(self, sched):
+        ds = DistributedDataset.from_list(sched, [1, 2, 3])
+        assert sorted(ds.flat_map(lambda x: [x, 10 * x]).collect()) == [
+            1, 2, 3, 10, 20, 30
+        ]
+
+    def test_union(self, sched):
+        a = DistributedDataset.from_list(sched, [1, 2, 3, 4])
+        b = DistributedDataset.from_list(sched, [5, 6])
+        assert sorted(a.union(b).collect()) == [1, 2, 3, 4, 5, 6]
+
+    def test_union_requires_same_scheduler(self, sched):
+        other = JobScheduler(num_workers=4)
+        try:
+            a = DistributedDataset.from_list(sched, [1])
+            b = DistributedDataset.from_list(other, [2])
+            with pytest.raises(ValueError, match="same scheduler"):
+                a.union(b)
+        finally:
+            other.shutdown()
+
+    def test_distinct_keeps_first_occurrence_order(self, sched):
+        ds = DistributedDataset.from_list(sched, [3, 1, 3, 2, 1, 2, 3, 3])
+        out = ds.distinct().collect()
+        assert sorted(out) == [1, 2, 3]
+        assert len(out) == 3
+
+    def test_take_and_first(self, sched):
+        ds = DistributedDataset.from_list(sched, list(range(20)))
+        assert ds.take(5) == [0, 1, 2, 3, 4]
+        assert ds.take(0) == []
+        assert ds.take(100) == list(range(20))
+        assert ds.first() == 0
+
+    def test_first_empty_raises(self, sched):
+        ds = DistributedDataset.from_list(sched, [1]).filter(lambda x: False)
+        with pytest.raises(ValueError, match="empty"):
+            ds.first()
