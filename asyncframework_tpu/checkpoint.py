@@ -180,7 +180,20 @@ class CheckpointManager:
         final = self.step_path(step)
         tmp = self.directory / f".tmp-{step}-{os.getpid()}.npz"
         save_checkpoint(tmp, state)
+        # fsync data before the rename and the directory after it, so a power
+        # loss can never leave a truncated ckpt-<step>.npz behind the atomic
+        # name swap (same discipline as native/kvstore.cc kv_compact)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         os.replace(tmp, final)  # atomic, even over an existing same-step file
+        dfd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._gc()
         return final
 

@@ -103,6 +103,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use only the first N jax devices")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the per-snapshot trajectory lines")
+    p.add_argument("--event-log", default=None,
+                   help="write a JSONL event log (.gz = compressed) of the run")
+    p.add_argument("--report", default=None,
+                   help="render an HTML run report to this path "
+                        "(requires --event-log)")
+    p.add_argument("--metrics-csv", default=None,
+                   help="periodic metrics samples as CSV")
+    p.add_argument("--speculation", action="store_true",
+                   help="launch speculative copies of straggling tasks")
+    p.add_argument("--stale-read", type=int, default=None, metavar="OFFSET",
+                   help="ASYNCbroadcast experiment: workers read model "
+                        "version (latest - OFFSET) from the versioned store")
+    p.add_argument("--no-heartbeat", action="store_true",
+                   help="disable executor liveness monitoring")
     return p
 
 
@@ -176,6 +190,13 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
             "(asgd, asaga); sync and sgd-mllib runs do not checkpoint"
         )
 
+    if args.report and not args.event_log:
+        raise SystemExit("--report requires --event-log (it renders the log)")
+    if args.stale_read is not None and (
+        driver.endswith("-sync") or driver == "sgd-mllib"
+    ):
+        raise SystemExit("--stale-read applies to the async drivers only")
+
     cfg = SolverConfig(
         num_workers=args.num_partitions,
         num_iterations=args.num_iterations,
@@ -189,6 +210,11 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         loss=args.loss,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_freq=args.checkpoint_freq,
+        event_log=args.event_log,
+        metrics_csv=args.metrics_csv,
+        speculation=args.speculation,
+        stale_read_offset=args.stale_read,
+        heartbeat=not args.no_heartbeat,
     )
     # conf overlays beat recipe args for every registered solver knob
     for key, field in CONF_TO_FIELD.items():
@@ -224,6 +250,12 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
             "elapsed_s": elapsed,
             "snapshots": len(snaps),
         }
+        if args.event_log:
+            # the fused-scan baseline has no per-task events; log the
+            # trajectory so the report/history tooling still works on it
+            from asyncframework_tpu.solvers.instrumentation import log_trajectory
+
+            log_trajectory(args.event_log, trajectory, cfg.printer_freq)
     else:
         solver_cls = ASGD if driver.startswith("asgd") else ASAGA
         solver = solver_cls(X, y, cfg, devices=devices)
@@ -240,6 +272,15 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
             "updates_per_sec": res.updates_per_sec,
             "elapsed_s": res.elapsed_s,
         }
+        for key in ("workers_lost", "shards_moved", "speculated"):
+            if key in res.extras:
+                summary[key] = res.extras[key]
+    if args.report:
+        from asyncframework_tpu.metrics.report import render_report
+
+        render_report(args.event_log, args.report,
+                      title=f"async-submit {driver} run")
+        summary["report"] = args.report
     summary["trajectory"] = trajectory
     return summary
 

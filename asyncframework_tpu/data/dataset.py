@@ -45,6 +45,7 @@ from typing import (
 import numpy as np
 
 from asyncframework_tpu.context import AsyncContext, WorkerState
+from asyncframework_tpu.data.pairs import PairOpsMixin
 from asyncframework_tpu.engine.barrier import partial_barrier
 from asyncframework_tpu.engine.job import JobWaiter
 from asyncframework_tpu.engine.scheduler import ASYNC, SYNC, JobScheduler
@@ -53,7 +54,7 @@ E = TypeVar("E")
 U = TypeVar("U")
 
 
-class DistributedDataset(Generic[E]):
+class DistributedDataset(PairOpsMixin, Generic[E]):
     """A partitioned collection whose partitions compute on engine workers.
 
     Construction is cheap and lazy; partition payloads materialize only when

@@ -63,6 +63,7 @@ class SpeculationMonitor:
         min_time_ms: float = 100.0,
         check_interval_s: float = 0.1,
         clock: Optional[Clock] = None,
+        on_launch=None,
     ):
         self._sched = scheduler
         self.quantile = quantile
@@ -74,6 +75,7 @@ class SpeculationMonitor:
         self._thread: Optional[threading.Thread] = None
         self._speculated: Set[Tuple[int, int]] = set()
         self._lock = threading.Lock()
+        self._on_launch = on_launch  # callback(job_id, worker_id) per copy
 
     def check_once(self) -> List[Tuple[int, int]]:
         """One scan; returns the (job_id, worker_id) copies launched."""
@@ -88,6 +90,11 @@ class SpeculationMonitor:
                     self._speculated.add((job_id, wid))
                 if self._sched.speculative_launch(job_id, wid):
                     launched.append((job_id, wid))
+                    if self._on_launch is not None:
+                        try:
+                            self._on_launch(job_id, wid)
+                        except Exception:  # noqa: BLE001 - observer must not kill scan
+                            pass
         return launched
 
     def speculated_count(self) -> int:

@@ -75,11 +75,28 @@ class WorkerLost(Event):
     reason: str
 
 
+@dataclass(frozen=True)
+class ShardMoved(Event):
+    """Elastic recovery re-homed a data shard (engine/recovery.py)."""
+
+    shard_id: int
+    new_owner: int
+    device: str
+
+
+@dataclass(frozen=True)
+class SpeculativeLaunch(Event):
+    """A speculative task copy was launched (engine/speculation.py)."""
+
+    job_id: int
+    worker_id: int
+
+
 EVENT_TYPES: Dict[str, Type[Event]] = {
     cls.__name__: cls
     for cls in (
         JobStart, JobEnd, TaskEnd, RoundSubmitted, GradientMerged,
-        ModelSnapshot, WorkerLost,
+        ModelSnapshot, WorkerLost, ShardMoved, SpeculativeLaunch,
     )
 }
 

@@ -266,10 +266,13 @@ class LBFGS:
             tuple(d.id for d in mesh.devices.flat),
             mesh.axis_names,
             shape,
-            id(self.gradient),  # compiled program closes over the gradient
         )
-        if key in self._vg_cache:
-            return self._vg_cache[key]
+        hit = self._vg_cache.get(key)
+        # the compiled program closes over the gradient object; keep a strong
+        # reference in the entry and verify identity on lookup (a bare id()
+        # key could collide after the original object is garbage-collected)
+        if hit is not None and hit[0] is self.gradient:
+            return hit[1]
         grad = self.gradient
 
         @partial(
@@ -284,7 +287,7 @@ class LBFGS:
             return loss, g
 
         compiled = jax.jit(value_grad)
-        self._vg_cache[key] = compiled
+        self._vg_cache[key] = (grad, compiled)
         return compiled
 
     def optimize(

@@ -109,9 +109,15 @@ def saga_shard_step(
     return g, diff
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(1,))
 def saga_commit_history(
     alpha: jax.Array, diff: jax.Array, mask: jax.Array
 ) -> jax.Array:
-    """alpha[i] <- diff[i] where mask_i else unchanged (accepted update)."""
+    """alpha[i] <- diff[i] where mask_i else unchanged (accepted update).
+
+    ``diff`` (the worker's candidate scalars) is donated -- it is dead after
+    the commit, and the new table slice is written into its buffer.  ``alpha``
+    is NOT donated: an in-flight worker task dispatched before this commit may
+    still hold the old slice's handle (routine under async overlap).
+    """
     return jnp.where(mask > 0, diff, alpha)

@@ -30,6 +30,7 @@ Parity notes per builder:
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Tuple
 
 import jax
@@ -63,10 +64,17 @@ def make_asgd_worker_step(batch_rate: float, loss: str = "least_squares"):
 
 
 def make_asgd_apply(gamma: float, batch_rate: float, n: int, num_workers: int):
-    """jit (w, g, k) -> (w', k+1).  ``k`` is a device f32 scalar."""
+    """jit (w, g, k) -> (w', k+1).  ``k`` is a device f32 scalar.
+
+    Buffer donation: ``g`` and ``k`` are donated -- XLA writes ``w'`` into the
+    dead gradient's buffer, so the accept path allocates nothing at steady
+    state.  ``w`` itself is NOT donated: an old ``w`` handle IS an old model
+    version (in-flight workers and trajectory snapshots hold them), and
+    donating it would invalidate every retained version.
+    """
     par_recs = batch_rate * n / num_workers
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def apply(w, g, k):
         lr = gamma / jnp.sqrt(k / num_workers + 1.0)
         return w - (lr / par_recs) * g, k + 1.0
@@ -75,9 +83,13 @@ def make_asgd_apply(gamma: float, batch_rate: float, n: int, num_workers: int):
 
 
 def make_sync_apply(gamma: float, batch_rate: float, n: int):
-    """jit (w, acc_g, k) -> (w', k+1) -- full-drain synchronous update."""
+    """jit (w, acc_g, k) -> (w', k+1) -- full-drain synchronous update.
 
-    @jax.jit
+    ``acc_g`` and ``k`` are donated (dead after the round); ``w`` is kept
+    alive for snapshots -- see :func:`make_asgd_apply`.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def apply(w, acc_g, k):
         lr = gamma / jnp.sqrt(k + 1.0)
         return w - (lr / (batch_rate * n)) * acc_g, k + 1.0
@@ -103,17 +115,31 @@ def make_saga_worker_step(batch_rate: float):
     return step
 
 
-def make_saga_apply(gamma: float, batch_rate: float, n: int, num_workers: int):
+def make_saga_apply(
+    gamma: float,
+    batch_rate: float,
+    n: int,
+    num_workers: int,
+    donate_g: bool = True,
+):
     """jit (w, alpha_bar, g, delta) -> (w', alpha_bar').
 
     ``w' = w - gamma*g/parRecs - gamma*alpha_bar``;
     ``alpha_bar' = alpha_bar + delta/N`` (``SparkASAGAThread.scala:210-213``
     uses ``delta == g``; see :func:`make_saga_table_delta` for why the TPU
     build distinguishes them).
+
+    Donation: ``alpha_bar`` is always donated (its old value is never
+    retained).  ``g`` is donated only when ``donate_g`` -- the sync drain
+    passes the SAME accumulator buffer as both ``g`` and ``delta``, and a
+    buffer may not be donated while also read through another argument, so
+    the sync instance sets ``donate_g=False``.  ``w`` is never donated (old
+    handles are live model versions).
     """
     par_recs = batch_rate * n / num_workers
+    donate = (1, 2) if donate_g else (1,)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=donate)
     def apply(w, alpha_bar, g, delta):
         w2 = w - (gamma / par_recs) * g - gamma * alpha_bar
         ab2 = alpha_bar + delta / n
@@ -144,9 +170,13 @@ def make_saga_table_delta():
     return delta
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def add_grads(a, b):
-    """Associative combine for the sync drain (comOp parity: vector add)."""
+    """Associative combine for the sync drain (comOp parity: vector add).
+
+    The running accumulator ``a`` is donated: the drain's ``acc`` is dead the
+    moment the next partial arrives, so the sum is built in one buffer.
+    """
     return a + b
 
 

@@ -37,7 +37,9 @@ import numpy as np
 from asyncframework_tpu.context import AsyncContext
 from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.engine.barrier import bucket_predicate, partial_barrier
+from asyncframework_tpu.engine.recovery import ShardRecovery
 from asyncframework_tpu.engine.scheduler import ASYNC, JobScheduler
+from asyncframework_tpu.engine.speculation import SpeculationMonitor
 from asyncframework_tpu.engine.straggler import DelayModel
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers.base import (
@@ -47,6 +49,10 @@ from asyncframework_tpu.solvers.base import (
     TrainResult,
     WaitingTimeTable,
     resolve_dataset,
+)
+from asyncframework_tpu.solvers.instrumentation import (
+    FaultTolerantRun,
+    RunInstruments,
 )
 
 
@@ -75,6 +81,7 @@ class ASAGA:
         )
         self._table_delta = steps.make_saga_table_delta()
         self._eval = steps.make_trajectory_loss_eval("least_squares")
+        self._recovery = ShardRecovery(self.ds, self.devices)
 
     # ------------------------------------------------------------------ async
     def run(self) -> TrainResult:
@@ -83,9 +90,12 @@ class ASAGA:
         ctx: AsyncContext = AsyncContext()
         sched = JobScheduler(num_workers=nw, devices=self.devices)
         sched.set_mode(ASYNC)
+        self.scheduler = sched  # exposed for fault-injection tests/tools
         delay_model = DelayModel(cfg.coeff, nw, cfg.seed)
         calibrator = DelayCalibrator(cfg.effective_calibration_iters())
         waiting = WaitingTimeTable()
+        inst = RunInstruments(cfg, nw)
+        inst.register_queue_depth(ctx.size)
 
         d = self.ds.d
         ckpt = SolverCheckpointer(cfg, "asaga", d, self.ds.n)
@@ -128,6 +138,34 @@ class ASAGA:
             }
         hot_lock = threading.Lock()  # guards alpha/worker_keys handle slots
 
+        def on_shard_moved(shard_id, moved):
+            # the history slice and PRNG chain follow the shard's new home
+            with hot_lock:
+                alpha[shard_id] = jax.device_put(alpha[shard_id], moved.X.device)
+                worker_keys[shard_id] = jax.device_put(
+                    worker_keys[shard_id], moved.X.device
+                )
+
+        ft = None
+        if cfg.heartbeat:
+            ft = FaultTolerantRun(
+                sched, self._recovery, inst, nw,
+                heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+                check_interval_s=cfg.heartbeat_interval_s,
+                max_slot_failures=cfg.max_slot_failures,
+                on_moved=on_shard_moved,
+            )
+            ft.start()
+        spec = None
+        if cfg.speculation:
+            spec = SpeculationMonitor(
+                sched, quantile=cfg.speculation_quantile,
+                multiplier=cfg.speculation_multiplier,
+                min_time_ms=cfg.speculation_min_ms,
+                on_launch=inst.on_speculative_launch,
+            )
+            spec.start()
+
         state = {"w": w, "ab": alpha_bar, "k": k0, "accepted": 0, "dropped": 0,
                  "rounds": 0}
         state_lock = threading.Lock()
@@ -166,10 +204,17 @@ class ASAGA:
                 with state_lock:
                     k = state["k"]
                     # ASAGA acceptance quirk: k - staleness <= taw
-                    if k - res.staleness <= cfg.taw:
-                        shard = self.ds.shard(res.worker_id)
+                    accepted = k - res.staleness <= cfg.taw
+                    if accepted:
+                        shard = self._recovery.shard(res.worker_id)
                         with hot_lock:
                             alpha_cur = alpha[res.worker_id]
+                            # a shard re-homed while this result was in
+                            # flight leaves diff/mask on the old device;
+                            # normalize onto the slice's current home
+                            if diff.device != alpha_cur.device:
+                                diff = jax.device_put(diff, alpha_cur.device)
+                                mask = jax.device_put(mask, alpha_cur.device)
                             # exact table delta (see make_saga_table_delta)
                             delta = self._table_delta(shard.X, diff, mask, alpha_cur)
                             alpha[res.worker_id] = steps.saga_commit_history(
@@ -193,6 +238,10 @@ class ASAGA:
                         )
                     else:
                         state["dropped"] += 1
+                inst.on_gradient_merged(
+                    res.worker_id, res.staleness, accepted, k,
+                    batch_size=res.batch_size, task_ms=task_ms,
+                )
                 if do_save:
                     save_checkpoint(save_k, save_w, save_ab)
                 if calibrator.maybe_finalize(state["k"]):
@@ -242,9 +291,14 @@ class ASAGA:
                 waiters.append(waiter)
                 with state_lock:
                     state["rounds"] += 1
+                inst.on_round_submitted(state["rounds"], cohort, state["k"])
         finally:
             stop.set()
             upd.join(timeout=10)
+            if ft is not None:
+                ft.stop()
+            if spec is not None:
+                spec.stop()
             sched.shutdown()
 
         elapsed = time.monotonic() - start_wall
@@ -255,6 +309,10 @@ class ASAGA:
         if ckpt.enabled:
             save_checkpoint(final_k, final_w_dev, final_ab)
         traj = self._evaluate_trajectory(snapshots)
+        run_extras = inst.extras()
+        if spec is not None:
+            run_extras["speculated"] = spec.speculated_count()
+        inst.close(traj, cfg.printer_freq)
         return TrainResult(
             final_w=final_w,
             trajectory=traj,
@@ -269,6 +327,7 @@ class ASAGA:
             extras={
                 "alpha": {wid: np.asarray(a) for wid, a in alpha.items()},
                 "alpha_bar": np.asarray(state["ab"]),
+                **run_extras,
             },
         )
 
@@ -281,11 +340,15 @@ class ASAGA:
         ctx: AsyncContext = AsyncContext()
         sched = JobScheduler(num_workers=nw, devices=self.devices)
         sched.set_mode(ASYNC)
+        self.scheduler = sched  # exposed for fault-injection tests/tools
         delay_model = DelayModel(cfg.coeff, nw, cfg.seed)
         calibrator = DelayCalibrator(100)
         waiting = WaitingTimeTable()
+        inst = RunInstruments(cfg, nw)
+        inst.register_queue_depth(ctx.size)
         sync_apply = steps.make_saga_apply(
-            cfg.gamma, cfg.batch_rate, self.ds.n, 1  # parRecs = b*N
+            cfg.gamma, cfg.batch_rate, self.ds.n, 1,  # parRecs = b*N
+            donate_g=False,  # the drain passes acc as both g and delta
         )
 
         w = jax.device_put(jnp.zeros(self.ds.d, jnp.float32), self.driver_device)
@@ -304,6 +367,36 @@ class ASAGA:
             )
             for wid in range(nw)
         }
+        hot_lock = threading.Lock()  # guards alpha/worker_keys handle slots
+
+        def on_shard_moved(shard_id, moved):
+            # the history slice and PRNG chain follow the shard's new home
+            # (same discipline as the async path)
+            with hot_lock:
+                alpha[shard_id] = jax.device_put(alpha[shard_id], moved.X.device)
+                worker_keys[shard_id] = jax.device_put(
+                    worker_keys[shard_id], moved.X.device
+                )
+
+        ft = None
+        if cfg.heartbeat:
+            ft = FaultTolerantRun(
+                sched, self._recovery, inst, nw,
+                heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+                check_interval_s=cfg.heartbeat_interval_s,
+                max_slot_failures=cfg.max_slot_failures,
+                on_moved=on_shard_moved,
+            )
+            ft.start()
+        spec = None
+        if cfg.speculation:
+            spec = SpeculationMonitor(
+                sched, quantile=cfg.speculation_quantile,
+                multiplier=cfg.speculation_multiplier,
+                min_time_ms=cfg.speculation_min_ms,
+                on_launch=inst.on_speculative_launch,
+            )
+            spec.start()
         start_wall = time.monotonic()
         snapshots: List[Tuple[float, jax.Array]] = [(0.0, w)]
 
@@ -317,25 +410,41 @@ class ASAGA:
                 ts = ctx.get_current_time()
                 ctx.mark_busy(cohort)
                 waiting.on_submit(cohort, now_ms())
-                key_lock = threading.Lock()
+                with hot_lock:
+                    captured = {
+                        wid: (worker_keys[wid], alpha[wid]) for wid in cohort
+                    }
                 fns = {
                     wid: self._make_task(
-                        wid, w, worker_keys[wid], alpha[wid], delay_model
+                        wid, w, captured[wid][0], captured[wid][1], delay_model
                     )
                     for wid in cohort
                 }
                 waiter = sched.run_job(
-                    fns, self._handler(ctx, ts, now_ms, worker_keys, key_lock)
+                    fns, self._handler(ctx, ts, now_ms, worker_keys, hot_lock)
                 )
+                inst.on_round_submitted(k, cohort, model_version=k)
                 acc = None
                 for _ in range(nw):
                     res = self._collect_checked(ctx, waiter, cfg.run_timeout_s)
                     g, diff, mask = res.data
                     task_ms = waiting.on_finish(res.worker_id, now_ms())
                     calibrator.record(k, task_ms)
-                    alpha[res.worker_id] = steps.saga_commit_history(
-                        alpha[res.worker_id], diff, mask
+                    inst.on_gradient_merged(
+                        res.worker_id, res.staleness, True, k,
+                        batch_size=res.batch_size, task_ms=task_ms,
                     )
+                    with hot_lock:
+                        alpha_cur = alpha[res.worker_id]
+                        # a shard re-homed mid-round leaves this result's
+                        # diff/mask on the old device; commit on the slice's
+                        # current home
+                        if diff.device != alpha_cur.device:
+                            diff = jax.device_put(diff, alpha_cur.device)
+                            mask = jax.device_put(mask, alpha_cur.device)
+                        alpha[res.worker_id] = steps.saga_commit_history(
+                            alpha_cur, diff, mask
+                        )
                     if g.device != self.driver_device:
                         g = jax.device_put(g, self.driver_device)
                     acc = g if acc is None else steps.add_grads(acc, g)
@@ -347,11 +456,19 @@ class ASAGA:
                 if calibrator.maybe_finalize(k):
                     delay_model.calibrate(calibrator.avg_delay_ms)
         finally:
+            if ft is not None:
+                ft.stop()
+            if spec is not None:
+                spec.stop()
             sched.shutdown()
 
         elapsed = time.monotonic() - start_wall
         snapshots.append((elapsed * 1e3, w))
         traj = self._evaluate_trajectory(snapshots)
+        extras = inst.extras()
+        if spec is not None:
+            extras["speculated"] = spec.speculated_count()
+        inst.close(traj, cfg.printer_freq)
         return TrainResult(
             final_w=np.asarray(w),
             trajectory=traj,
@@ -362,6 +479,7 @@ class ASAGA:
             avg_delay_ms=calibrator.avg_delay_ms,
             updates_per_sec=rounds / elapsed if elapsed > 0 else 0.0,
             waiting_time_ms=waiting.snapshot(),
+            extras=extras,
         )
 
     # ---------------------------------------------------------------- helpers
@@ -369,18 +487,30 @@ class ASAGA:
         return self.devices[wid % len(self.devices)]
 
     def _make_task(self, wid, w_pub, key, alpha_slice, delay_model: DelayModel):
-        shard = self.ds.shard(wid)
+        shard = self._recovery.shard(wid)  # follows re-homed shards
         delay_ms = delay_model.delay_ms(wid)
-        dev = self._shard_device(wid)
+        dev = shard.X.device
         step = self._step
+        # injected delay fires once: a speculative copy / replacement
+        # executor is a healthy host path and bypasses the straggler
+        delay_fired = threading.Event()
 
         def fn():
-            if delay_ms > 0:
+            if delay_ms > 0 and not delay_fired.is_set():
+                delay_fired.set()
                 time.sleep(delay_ms / 1e3)
             w_local = w_pub
             if w_local.device != dev:
                 w_local = jax.device_put(w_local, dev)
-            g, diff, mask, new_key = step(shard.X, shard.y, w_local, alpha_slice, key)
+            # a slice/key captured around a concurrent shard re-home may
+            # still live on the old device; normalize onto the shard's home
+            a_local = alpha_slice
+            if a_local.device != dev:
+                a_local = jax.device_put(a_local, dev)
+            key_local = key
+            if key_local.device != dev:
+                key_local = jax.device_put(key_local, dev)
+            g, diff, mask, new_key = step(shard.X, shard.y, w_local, a_local, key_local)
             g.block_until_ready()
             return g, diff, mask, new_key
 
@@ -425,10 +555,10 @@ class ASAGA:
         W = jnp.stack([h for (_t, h) in snapshots])
         totals = np.zeros(len(snapshots), np.float64)
         for wid in range(self.cfg.num_workers):
-            shard = self.ds.shard(wid)
+            shard = self._recovery.shard(wid)  # follows re-homed shards
             Wd = W
-            if Wd.device != self._shard_device(wid):
-                Wd = jax.device_put(W, self._shard_device(wid))
+            if Wd.device != shard.X.device:
+                Wd = jax.device_put(W, shard.X.device)
             totals += np.asarray(self._eval(shard.X, shard.y, Wd), np.float64)
         totals /= self.ds.n
         return [(t, float(l)) for (t, _), l in zip(snapshots, totals)]

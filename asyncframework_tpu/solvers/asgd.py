@@ -31,10 +31,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncframework_tpu.broadcast import VersionedModelStore
 from asyncframework_tpu.context import AsyncContext
 from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.engine.barrier import bucket_predicate, partial_barrier
+from asyncframework_tpu.engine.recovery import ShardRecovery
 from asyncframework_tpu.engine.scheduler import ASYNC, JobScheduler
+from asyncframework_tpu.engine.speculation import SpeculationMonitor
 from asyncframework_tpu.engine.straggler import DelayModel
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers.base import (
@@ -44,6 +47,10 @@ from asyncframework_tpu.solvers.base import (
     TrainResult,
     WaitingTimeTable,
     resolve_dataset,
+)
+from asyncframework_tpu.solvers.instrumentation import (
+    FaultTolerantRun,
+    RunInstruments,
 )
 
 
@@ -69,6 +76,9 @@ class ASGD:
             config.gamma, config.batch_rate, self.ds.n
         )
         self._eval = steps.make_trajectory_loss_eval(config.loss)
+        # all shard access routes through the recovery view so a re-homed
+        # shard is transparently picked up by later rounds and by evaluation
+        self._recovery = ShardRecovery(self.ds, self.devices)
 
     # ------------------------------------------------------------------ async
     def run(self) -> TrainResult:
@@ -78,9 +88,36 @@ class ASGD:
         ctx: AsyncContext = AsyncContext()
         sched = JobScheduler(num_workers=nw, devices=self.devices)
         sched.set_mode(ASYNC)
+        self.scheduler = sched  # exposed for fault-injection tests/tools
         delay_model = DelayModel(cfg.coeff, nw, cfg.seed)
         calibrator = DelayCalibrator(cfg.effective_calibration_iters())
         waiting = WaitingTimeTable()
+        inst = RunInstruments(cfg, nw)
+        inst.register_queue_depth(ctx.size)
+        ft = None
+        if cfg.heartbeat:
+            ft = FaultTolerantRun(
+                sched, self._recovery, inst, nw,
+                heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+                check_interval_s=cfg.heartbeat_interval_s,
+                max_slot_failures=cfg.max_slot_failures,
+            )
+            ft.start()
+        spec = None
+        if cfg.speculation:
+            spec = SpeculationMonitor(
+                sched, quantile=cfg.speculation_quantile,
+                multiplier=cfg.speculation_multiplier,
+                min_time_ms=cfg.speculation_min_ms,
+                on_launch=inst.on_speculative_launch,
+            )
+            spec.start()
+        # stale-read experiment: workers read version (latest - offset)
+        store = (
+            VersionedModelStore(cfg.max_live_versions)
+            if cfg.stale_read_offset is not None
+            else None
+        )
 
         d = self.ds.d
         ckpt = SolverCheckpointer(cfg, "asgd", d, self.ds.n)
@@ -151,7 +188,8 @@ class ASGD:
                 do_save = False
                 with state_lock:
                     k = state["k"]
-                    if res.staleness <= cfg.taw:
+                    accepted = res.staleness <= cfg.taw
+                    if accepted:
                         if g.device != self.driver_device:
                             g = jax.device_put(g, self.driver_device)
                         state["w"], state["k_dev"] = self._apply(
@@ -166,6 +204,10 @@ class ASGD:
                         save_k, save_w = state["k"], state["w"]
                     else:
                         state["dropped"] += 1
+                inst.on_gradient_merged(
+                    res.worker_id, res.staleness, accepted, k,
+                    batch_size=res.batch_size, task_ms=task_ms,
+                )
                 if do_save:
                     save_checkpoint(save_k, save_w)
                 if calibrator.maybe_finalize(state["k"]):
@@ -199,6 +241,20 @@ class ASGD:
                     continue
                 with state_lock:
                     w_pub = state["w"]  # immutable handle = model version
+                    model_version = state["k"]
+                if store is not None:
+                    # ASYNCbroadcast parity: publish this round's model as a
+                    # new version, then point workers at (latest - offset).
+                    # The version's device buffer is resolved HERE, at submit
+                    # time: a straggling worker must not re-query the store
+                    # later (the version may have been evicted by newer
+                    # publishes); the captured handle keeps the array alive
+                    # regardless of store eviction.
+                    v = store.publish(np.asarray(w_pub))
+                    live = store.live_versions()
+                    tv = max(live[0], v - cfg.stale_read_offset)
+                    w_pub = store.value(self.driver_device, version=tv)
+                    model_version = v
                 ts = ctx.get_current_time()
                 ctx.set_last_time(ts)
                 ctx.mark_busy(cohort)
@@ -215,9 +271,14 @@ class ASGD:
                 waiters.append(waiter)
                 with state_lock:
                     state["rounds"] += 1
+                inst.on_round_submitted(state["rounds"], cohort, model_version)
         finally:
             stop.set()
             upd.join(timeout=10)
+            if ft is not None:
+                ft.stop()
+            if spec is not None:
+                spec.stop()
             sched.shutdown()
 
         elapsed = time.monotonic() - start_wall
@@ -228,6 +289,10 @@ class ASGD:
         if ckpt.enabled:
             save_checkpoint(final_k, final_w_dev)
         traj = self._evaluate_trajectory(snapshots)
+        extras = inst.extras()
+        if spec is not None:
+            extras["speculated"] = spec.speculated_count()
+        inst.close(traj, cfg.printer_freq)
         return TrainResult(
             final_w=final_w,
             trajectory=traj,
@@ -239,6 +304,7 @@ class ASGD:
             avg_delay_ms=calibrator.avg_delay_ms,
             updates_per_sec=state["accepted"] / elapsed if elapsed > 0 else 0.0,
             waiting_time_ms=waiting.snapshot(),
+            extras=extras,
         )
 
     # ------------------------------------------------------------------ sync
@@ -249,9 +315,32 @@ class ASGD:
         ctx: AsyncContext = AsyncContext()
         sched = JobScheduler(num_workers=nw, devices=self.devices)
         sched.set_mode(ASYNC)  # non-blocking submit + driver-side drain
+        self.scheduler = sched  # exposed for fault-injection tests/tools
         delay_model = DelayModel(cfg.coeff, nw, cfg.seed)
         calibrator = DelayCalibrator(100)  # sync calibrates over first 100 rounds
         waiting = WaitingTimeTable()
+        inst = RunInstruments(cfg, nw)
+        inst.register_queue_depth(ctx.size)
+        ft = None
+        if cfg.heartbeat:
+            ft = FaultTolerantRun(
+                sched, self._recovery, inst, nw,
+                heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+                check_interval_s=cfg.heartbeat_interval_s,
+                max_slot_failures=cfg.max_slot_failures,
+            )
+            ft.start()
+        spec = None
+        if cfg.speculation:
+            # the reference runs speculation on its synchronous stages: the
+            # full drain is exactly where one straggler stalls the round
+            spec = SpeculationMonitor(
+                sched, quantile=cfg.speculation_quantile,
+                multiplier=cfg.speculation_multiplier,
+                min_time_ms=cfg.speculation_min_ms,
+                on_launch=inst.on_speculative_launch,
+            )
+            spec.start()
 
         w = jax.device_put(jnp.zeros(self.ds.d, jnp.float32), self.driver_device)
         k_dev = jax.device_put(jnp.float32(0.0), self.driver_device)
@@ -283,12 +372,17 @@ class ASGD:
                 waiter = sched.run_job(
                     fns, self._handler(ctx, ts, now_ms, worker_keys, key_lock)
                 )
+                inst.on_round_submitted(k, cohort, model_version=k)
                 acc = None
                 for _ in range(nw):
                     res = self._collect_checked(ctx, waiter, cfg.run_timeout_s)
                     g = res.data
                     task_ms = waiting.on_finish(res.worker_id, now_ms())
                     calibrator.record(k, task_ms)
+                    inst.on_gradient_merged(
+                        res.worker_id, res.staleness, True, k,
+                        batch_size=res.batch_size, task_ms=task_ms,
+                    )
                     if g.device != self.driver_device:
                         g = jax.device_put(g, self.driver_device)
                     acc = g if acc is None else steps.add_grads(acc, g)
@@ -299,11 +393,19 @@ class ASGD:
                 if calibrator.maybe_finalize(k):
                     delay_model.calibrate(calibrator.avg_delay_ms)
         finally:
+            if ft is not None:
+                ft.stop()
+            if spec is not None:
+                spec.stop()
             sched.shutdown()
 
         elapsed = time.monotonic() - start_wall
         snapshots.append((elapsed * 1e3, w))
         traj = self._evaluate_trajectory(snapshots)
+        extras = inst.extras()
+        if spec is not None:
+            extras["speculated"] = spec.speculated_count()
+        inst.close(traj, cfg.printer_freq)
         return TrainResult(
             final_w=np.asarray(w),
             trajectory=traj,
@@ -314,6 +416,7 @@ class ASGD:
             avg_delay_ms=calibrator.avg_delay_ms,
             updates_per_sec=rounds / elapsed if elapsed > 0 else 0.0,
             waiting_time_ms=waiting.snapshot(),
+            extras=extras,
         )
 
     # ---------------------------------------------------------------- helpers
@@ -334,18 +437,28 @@ class ASGD:
         return self.devices[wid % len(self.devices)]
 
     def _make_task(self, wid: int, w_pub, key, delay_model: DelayModel):
-        shard = self.ds.shard(wid)
+        # recovery view: a re-homed shard is transparently computed on its
+        # new device; w and the PRNG chain follow the shard's home
+        shard = self._recovery.shard(wid)
         delay_ms = delay_model.delay_ms(wid)
-        dev = self._shard_device(wid)
+        dev = shard.X.device
         step = self._step
+        # The injected delay models a slow *machine*: only the first body to
+        # run it sleeps -- a speculative copy or a replacement executor is a
+        # different (healthy) host path and must bypass the straggler.
+        delay_fired = threading.Event()
 
         def fn():
-            if delay_ms > 0:
+            if delay_ms > 0 and not delay_fired.is_set():
+                delay_fired.set()
                 time.sleep(delay_ms / 1e3)
             w_local = w_pub
             if w_local.device != dev:
                 w_local = jax.device_put(w_local, dev)
-            g, new_key = step(shard.X, shard.y, w_local, key)
+            key_local = key
+            if key_local.device != dev:
+                key_local = jax.device_put(key_local, dev)
+            g, new_key = step(shard.X, shard.y, w_local, key_local)
             g.block_until_ready()  # completion only; data stays in HBM
             return g, new_key
 
@@ -382,10 +495,10 @@ class ASGD:
         W = jnp.stack([h for (_t, h) in snapshots])
         totals = np.zeros(len(snapshots), np.float64)
         for wid in range(self.cfg.num_workers):
-            shard = self.ds.shard(wid)
+            shard = self._recovery.shard(wid)  # follows re-homed shards
             Wd = W
-            if Wd.device != self._shard_device(wid):
-                Wd = jax.device_put(W, self._shard_device(wid))
+            if Wd.device != shard.X.device:
+                Wd = jax.device_put(W, shard.X.device)
             totals += np.asarray(self._eval(shard.X, shard.y, Wd), np.float64)
         totals /= self.ds.n
         return [(t, float(l)) for (t, _), l in zip(snapshots, totals)]

@@ -127,6 +127,27 @@ class SolverConfig:
     checkpoint_dir: Optional[str] = None  # None = checkpointing off
     checkpoint_freq: int = 0              # accepted updates between saves; 0 = off
     checkpoint_keep: int = 3
+    # observability (EventLoggingListener / MetricsSystem parity; None = off)
+    event_log: Optional[str] = None       # JSONL (.gz ok) event log path
+    metrics_csv: Optional[str] = None     # CsvSink path
+    metrics_jsonl: Optional[str] = None   # JsonlSink path
+    metrics_period_s: float = 1.0
+    # failure detection / elastic recovery (HeartbeatReceiver parity)
+    heartbeat: bool = True                # liveness monitoring during the run
+    heartbeat_timeout_ms: float = 2000.0
+    heartbeat_interval_s: float = 0.25
+    max_slot_failures: int = 2            # repeated deaths => re-home the shard
+    # speculative execution (TaskSetManager.checkSpeculatableTasks parity)
+    speculation: bool = False
+    speculation_quantile: float = 0.75
+    speculation_multiplier: float = 1.5
+    speculation_min_ms: float = 100.0
+    # stale-read experiment (ASYNCbroadcast.value(index) parity): workers
+    # read model version (latest - offset) from a VersionedModelStore
+    stale_read_offset: Optional[int] = None
+    max_live_versions: int = 4
+    # HBM budget consulted before placement; None = query the device
+    hbm_budget_bytes: Optional[int] = None
 
     def effective_calibration_iters(self) -> int:
         if self.calibration_iters is not None:

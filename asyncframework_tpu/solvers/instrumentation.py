@@ -1,0 +1,259 @@
+"""Run instrumentation: the sidecar subsystems wired INTO solver runs.
+
+The reference's observability and resilience live *inside* jobs, not beside
+them: every task launch flows through ``LiveListenerBus`` listeners
+(``scheduler/LiveListenerBus.scala:44``), ``EventLoggingListener`` streams the
+run to disk (``scheduler/EventLoggingListener.scala:55``), ``MetricsSystem``
+polls sources on an interval (``metrics/MetricsSystem.scala:70``), and
+``HeartbeatReceiver`` (``HeartbeatReceiver.scala:59``) watches executor
+liveness for the scheduler.  :class:`RunInstruments` is the per-run bundle of
+those capabilities for this framework's solvers: one object the solver
+creates from its :class:`~asyncframework_tpu.solvers.base.SolverConfig`,
+posts events to from its hot threads, and closes at the end of the run.
+
+Everything here is optional and off the hot path: posting to the bus is a
+non-blocking enqueue; when no event log / metrics sink / heartbeat is
+configured the instruments are inert no-ops.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from asyncframework_tpu.metrics.bus import (
+    Event,
+    GradientMerged,
+    ListenerBus,
+    ModelSnapshot,
+    RoundSubmitted,
+    ShardMoved,
+    SpeculativeLaunch,
+    WorkerLost,
+)
+from asyncframework_tpu.metrics.eventlog import EventLogWriter
+from asyncframework_tpu.metrics.system import CsvSink, JsonlSink, MetricsSystem
+
+
+class RunInstruments:
+    """Per-run observability bundle: listener bus + event log + metrics.
+
+    The solver calls the ``on_*`` hooks from its submitter/updater threads;
+    they update metrics instruments synchronously (cheap: a lock and an
+    append) and post typed events to the asynchronous bus (never blocks).
+    """
+
+    def __init__(self, cfg, num_workers: int):
+        self.cfg = cfg
+        self._t0 = time.monotonic()
+        self.bus = ListenerBus()
+        self.writer: Optional[EventLogWriter] = None
+        self.metrics: Optional[MetricsSystem] = None
+        self.workers_lost = 0
+        self.shards_moved = 0
+        self._lock = threading.Lock()
+
+        event_log = getattr(cfg, "event_log", None)
+        if event_log:
+            self.writer = EventLogWriter(event_log)
+            self.bus.add_listener(self.writer)
+            self.bus.start()
+
+        metrics_csv = getattr(cfg, "metrics_csv", None)
+        metrics_jsonl = getattr(cfg, "metrics_jsonl", None)
+        if metrics_csv or metrics_jsonl:
+            self.metrics = MetricsSystem()
+            if metrics_csv:
+                self.metrics.add_sink(CsvSink(metrics_csv))
+            if metrics_jsonl:
+                self.metrics.add_sink(JsonlSink(metrics_jsonl))
+            self._c_accepted = self.metrics.counter("updates.accepted")
+            self._c_dropped = self.metrics.counter("updates.dropped")
+            self._c_rounds = self.metrics.counter("rounds.submitted")
+            self._h_staleness = self.metrics.histogram("staleness")
+            self._h_task_ms = self.metrics.histogram("task.ms")
+            self._g_updates_per_sec = self.metrics.gauge("updates.per_sec")
+            self.metrics.start(getattr(cfg, "metrics_period_s", 1.0))
+
+    # ----------------------------------------------------------------- time
+    def now_ms(self) -> float:
+        return (time.monotonic() - self._t0) * 1e3
+
+    # ------------------------------------------------------------ run hooks
+    def register_queue_depth(self, fn: Callable[[], int]) -> None:
+        """Expose the result queue's depth as a polled metrics source."""
+        if self.metrics is not None:
+            self.metrics.register_source("queue", lambda: {"depth": fn()})
+
+    def on_round_submitted(
+        self, round_idx: int, cohort, model_version: int
+    ) -> None:
+        self.bus.post(
+            RoundSubmitted(self.now_ms(), round_idx, tuple(cohort), model_version)
+        )
+        if self.metrics is not None:
+            self._c_rounds.inc()
+
+    def on_gradient_merged(
+        self,
+        worker_id: int,
+        staleness: int,
+        accepted: bool,
+        iteration: int,
+        batch_size: int = 0,
+        task_ms: float = 0.0,
+    ) -> None:
+        self.bus.post(
+            GradientMerged(
+                self.now_ms(), worker_id, staleness, accepted, iteration,
+                batch_size,
+            )
+        )
+        if self.metrics is not None:
+            (self._c_accepted if accepted else self._c_dropped).inc()
+            self._h_staleness.update(float(staleness))
+            if task_ms:
+                self._h_task_ms.update(task_ms)
+            el = time.monotonic() - self._t0
+            if el > 0:
+                self._g_updates_per_sec.set(self._c_accepted.value / el)
+
+    def on_worker_lost(self, worker_id: int, reason: str) -> None:
+        with self._lock:
+            self.workers_lost += 1
+        self.bus.post(WorkerLost(self.now_ms(), worker_id, reason))
+
+    def on_shard_moved(self, shard_id: int, new_owner: int, device) -> None:
+        with self._lock:
+            self.shards_moved += 1
+        self.bus.post(
+            ShardMoved(self.now_ms(), shard_id, new_owner, str(device))
+        )
+
+    def on_speculative_launch(self, job_id: int, worker_id: int) -> None:
+        self.bus.post(SpeculativeLaunch(self.now_ms(), job_id, worker_id))
+
+    def post(self, event: Event) -> None:
+        self.bus.post(event)
+
+    # ----------------------------------------------------------------- close
+    def close(
+        self, trajectory: Optional[List[Tuple[float, float]]] = None,
+        printer_freq: int = 1,
+    ) -> None:
+        """Flush trajectory snapshots (objectives are evaluated post-hoc, so
+        ``ModelSnapshot`` events are emitted at close) and stop everything."""
+        if trajectory:
+            for i, (t_ms, obj) in enumerate(trajectory):
+                self.bus.post(
+                    ModelSnapshot(t_ms, iteration=i * printer_freq,
+                                  objective=float(obj))
+                )
+        if self.metrics is not None:
+            self.metrics.report()  # final sample so short runs get >= 1 row
+            self.metrics.stop()
+        self.bus.stop()
+        if self.writer is not None:
+            self.writer.close()
+
+    # ---------------------------------------------------------------- extras
+    def extras(self) -> Dict[str, object]:
+        """Summary facts for ``TrainResult.extras``."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            if self.workers_lost:
+                out["workers_lost"] = self.workers_lost
+            if self.shards_moved:
+                out["shards_moved"] = self.shards_moved
+        if self.bus.dropped_events:
+            out["dropped_events"] = self.bus.dropped_events
+        return out
+
+
+def log_trajectory(path, trajectory, printer_freq: int = 1) -> None:
+    """Write a bare trajectory as ModelSnapshot events (for runs that have no
+    per-task event stream, e.g. the fused-scan baseline); numbering matches
+    :meth:`RunInstruments.close` so report tooling sees one convention."""
+    from asyncframework_tpu.metrics.bus import ModelSnapshot
+    from asyncframework_tpu.metrics.eventlog import EventLogWriter
+
+    wr = EventLogWriter(path)
+    try:
+        for i, (t_ms, obj) in enumerate(trajectory):
+            wr.on_event(
+                ModelSnapshot(t_ms, iteration=i * printer_freq,
+                              objective=float(obj))
+            )
+    finally:
+        wr.close()
+
+
+class FaultTolerantRun:
+    """Heartbeat + executor replacement + shard re-homing for one run.
+
+    Wires :class:`~asyncframework_tpu.engine.heartbeat.HeartbeatMonitor` to
+    the scheduler's ``on_executor_lost`` (in-flight task resubmission on a
+    fresh executor -- the transient-failure path) and, when the same worker
+    slot keeps dying (``max_slot_failures``), re-homes its data shard onto a
+    surviving worker's device via
+    :class:`~asyncframework_tpu.engine.recovery.ShardRecovery` (the
+    permanent-loss path; lineage-recomputation analog, SURVEY.md section 5).
+    """
+
+    def __init__(
+        self,
+        scheduler,
+        recovery,
+        instruments: RunInstruments,
+        num_workers: int,
+        heartbeat_timeout_ms: float = 2000.0,
+        check_interval_s: float = 0.25,
+        max_slot_failures: int = 2,
+        on_moved=None,
+    ):
+        from asyncframework_tpu.engine.heartbeat import HeartbeatMonitor
+
+        self._sched = scheduler
+        self._recovery = recovery
+        self._inst = instruments
+        self._nw = num_workers
+        self._max_slot_failures = max_slot_failures
+        self._on_moved = on_moved  # callback(shard_id, moved_shard)
+        self._losses: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self.monitor = HeartbeatMonitor(
+            scheduler.pool,
+            self._on_lost,
+            timeout_ms=heartbeat_timeout_ms,
+            check_interval_s=check_interval_s,
+        )
+
+    def _on_lost(self, worker_id: int) -> None:
+        with self._lock:
+            n = self._losses.get(worker_id, 0) + 1
+            self._losses[worker_id] = n
+        self._inst.on_worker_lost(worker_id, f"heartbeat timeout (loss #{n})")
+        # replacement executor + in-flight resubmission (DAGScheduler parity)
+        self._sched.on_executor_lost(worker_id)
+        if n >= self._max_slot_failures and self._recovery is not None:
+            # repeated deaths: treat the slot's device home as suspect and
+            # re-home the shard to the least-loaded surviving slot
+            from asyncframework_tpu.engine.recovery import plan_reassignment
+
+            survivors = [w for w in range(self._nw) if w != worker_id]
+            if survivors:
+                plan = plan_reassignment(range(self._nw), [worker_id])
+                new_owner = plan.moves[worker_id]
+                moved = self._recovery.move_shard(worker_id, new_owner)
+                self._inst.on_shard_moved(
+                    worker_id, new_owner, moved.X.device
+                )
+                if self._on_moved is not None:
+                    self._on_moved(worker_id, moved)
+
+    def start(self) -> None:
+        self.monitor.start()
+
+    def stop(self) -> None:
+        self.monitor.stop()

@@ -100,6 +100,12 @@ class _PyBackend:
         self.path = path
         self.live: Dict[bytes, bytes] = {}
         fresh = not os.path.exists(path)
+        if not fresh and os.path.getsize(path) < len(_MAGIC):
+            # crash between file creation and the magic write: treat as fresh
+            # (consistent with the torn-tail truncation policy) instead of
+            # failing every subsequent open as "not an AKV1 kvstore"
+            os.remove(path)
+            fresh = True
         if not fresh:
             valid_end = self._load()
             if valid_end is not None:

@@ -52,12 +52,16 @@ class WriteAheadLog:
         if arr is not None:
             np.savez(buf, t=np.int64(time_ms), kind=np.uint8(0), batch=arr)
         else:
-            # non-array batches ride as object payloads via pickle-in-npz
+            # Non-array batches ride as JSON payloads.  JSON (not pickle) on
+            # purpose: a WAL may be replayed after a restart or copied across
+            # hosts, and replay of untrusted bytes must never execute code.
+            # Payloads are therefore restricted to JSON-safe structures
+            # (dict/list/str/int/float/bool/None; tuples come back as lists).
             np.savez(
                 buf,
                 t=np.int64(time_ms),
-                kind=np.uint8(1),
-                batch=np.frombuffer(_pickle(batch), np.uint8),
+                kind=np.uint8(2),  # 2 = JSON (1 was the old pickle format)
+                batch=np.frombuffer(_to_json(batch), np.uint8),
             )
         blob = buf.getvalue()
         with self._lock:
@@ -80,10 +84,17 @@ class WriteAheadLog:
                     return
                 with np.load(io.BytesIO(blob), allow_pickle=False) as z:
                     t = int(z["t"])
-                    if int(z["kind"]) == 0:
+                    kind = int(z["kind"])
+                    if kind == 0:
                         yield t, z["batch"]
+                    elif kind == 2:
+                        yield t, _from_json(z["batch"].tobytes())
                     else:
-                        yield t, _unpickle(z["batch"].tobytes())
+                        raise ValueError(
+                            f"{self.path}: record kind={kind} is an "
+                            "unsupported legacy WAL payload (pre-JSON "
+                            "pickle format); re-create the log"
+                        )
 
     def clear(self) -> None:
         """Truncate the log (after a successful checkpoint: processed batches
@@ -103,13 +114,19 @@ class WriteAheadLog:
         self.close()
 
 
-def _pickle(obj: Any) -> bytes:
-    import pickle
+def _to_json(obj: Any) -> bytes:
+    import json
 
-    return pickle.dumps(obj, protocol=4)
+    try:
+        return json.dumps(obj).encode("utf-8")
+    except TypeError as e:
+        raise TypeError(
+            "WAL batches must be arrays or JSON-serializable structures "
+            f"(dict/list/str/number/bool/None); got {type(obj).__name__}"
+        ) from e
 
 
-def _unpickle(b: bytes) -> Any:
-    import pickle
+def _from_json(b: bytes) -> Any:
+    import json
 
-    return pickle.loads(b)
+    return json.loads(b.decode("utf-8"))

@@ -55,7 +55,17 @@ bool load(Store* s) {
   FILE* f = fopen(s->path.c_str(), "rb");
   if (!f) return true;  // fresh store
   char magic[4];
-  if (fread(magic, 1, 4, f) != 4 || memcmp(magic, kMagic, 4) != 0) {
+  size_t got = fread(magic, 1, 4, f);
+  if (got < 4) {
+    // crash between file creation and the magic write: treat as fresh
+    // (consistent with the torn-tail truncation policy) instead of
+    // permanently failing every subsequent open
+    fclose(f);
+    truncate(s->path.c_str(), 0);
+    remove(s->path.c_str());
+    return true;
+  }
+  if (memcmp(magic, kMagic, 4) != 0) {
     fclose(f);
     return false;
   }

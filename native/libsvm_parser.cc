@@ -142,11 +142,13 @@ long long parse_libsvm_dense(const char* buf, long long len, long long d,
     for (;;) {
       q = skip_ws(q, line_end);
       if (q >= line_end || *q == '#') break;
-      // index
+      // index; clamp the accumulator (like scan_float's exponent) so a
+      // hostile digit run cannot overflow signed arithmetic (UB) -- any
+      // clamped value already exceeds every valid d and fails the range check
       long long idx = 0;
       bool iany = false;
       while (q < line_end && *q >= '0' && *q <= '9') {
-        idx = idx * 10 + (*q - '0');
+        if (idx <= (long long)d) idx = idx * 10 + (*q - '0');
         iany = true;
         ++q;
       }

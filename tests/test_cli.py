@@ -92,3 +92,39 @@ class TestDrivers:
         )
         # overlay lifted taw back to infinite: nothing dropped
         assert summary["dropped"] == 0
+
+
+class TestObservabilityFlags:
+    def test_event_log_and_report(self, capsys, tmp_path):
+        log = tmp_path / "run.jsonl"
+        report = tmp_path / "run.html"
+        summary, _ = run_cli(capsys, recipe(
+            "asgd", iters=30,
+            extra=("--quiet", "--event-log", str(log), "--report", str(report)),
+        ))
+        assert summary["accepted"] == 30
+        assert summary["report"] == str(report)
+        assert log.exists()
+        html = report.read_text()
+        assert "Summary" in html and "Objective" in html
+
+    def test_report_requires_event_log(self, tmp_path):
+        with pytest.raises(SystemExit):
+            cli.main(recipe("asgd", iters=5,
+                            extra=("--report", str(tmp_path / "r.html"))))
+
+    def test_stale_read_flag(self, capsys):
+        summary, _ = run_cli(capsys, recipe(
+            "asgd", iters=30, extra=("--quiet", "--stale-read", "2"),
+        ))
+        assert summary["accepted"] == 30
+
+    def test_stale_read_rejected_for_sync(self):
+        with pytest.raises(SystemExit):
+            cli.main(recipe("asgd-sync", iters=5, extra=("--stale-read", "1")))
+
+    def test_speculation_flag_smoke(self, capsys):
+        summary, _ = run_cli(capsys, recipe(
+            "asgd-sync", iters=10, extra=("--quiet", "--speculation"),
+        ))
+        assert summary["accepted"] == 10 * 8

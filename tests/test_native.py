@@ -82,6 +82,14 @@ class TestLibsvmParser:
         with pytest.raises(ValueError, match="-3"):
             load_libsvm(str(p), num_features=3, use_native=True)
 
+    def test_native_huge_index_rejected_not_ub(self, tmp_path):
+        # a 30-digit index would overflow a naive accumulator (UB); the
+        # parser clamps it and reports out-of-range like any bad index
+        p = tmp_path / "huge.libsvm"
+        p.write_text("1 123456789012345678901234567890:1.0\n")
+        with pytest.raises(ValueError, match="-3"):
+            load_libsvm(str(p), num_features=3, use_native=True)
+
 
 class TestKVStore:
     @pytest.mark.parametrize(
@@ -165,6 +173,20 @@ class TestKVStore:
             assert kv.get("good") == b"v"
             assert kv.get("after") == b"crash"
             assert len(kv) == 2
+
+    @pytest.mark.parametrize(
+        "backend", ["python", pytest.param("native", marks=needs_native)]
+    )
+    def test_short_file_reopens_as_fresh(self, tmp_path, backend):
+        """A crash between creation and the magic write leaves a <4-byte
+        file; later opens must recover (treat as fresh), not fail forever."""
+        path = tmp_path / "short.kv"
+        path.write_bytes(b"AK")  # torn magic
+        with KVStore(path, backend=backend) as kv:
+            assert len(kv) == 0
+            kv.put("k", b"v")
+        with KVStore(path, backend=backend) as kv:
+            assert kv.get("k") == b"v"
 
 
 class TestStringHashCode:

@@ -1,0 +1,174 @@
+"""End-to-end tests that the sidecar subsystems are wired INTO solver runs.
+
+Round-2 requirement (VERDICT.md item 3): event log + metrics emitted by real
+runs, heartbeat-driven executor replacement DURING a run, shard re-homing on
+repeated loss, speculation in sync mode, and the versioned-store stale-read
+experiment -- each exercised through an actual training run, not a unit
+harness.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.data import make_regression
+from asyncframework_tpu.metrics.eventlog import EventLogReader
+from asyncframework_tpu.metrics.report import render_report
+from asyncframework_tpu.solvers import ASAGA, ASGD, SolverConfig
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return make_regression(2048, 32, seed=3)
+
+
+def cfg_with(**kw):
+    defaults = dict(
+        num_workers=8,
+        num_iterations=200,
+        gamma=0.5,
+        taw=2**31 - 1,
+        batch_rate=0.3,
+        bucket_ratio=0.5,
+        printer_freq=50,
+        coeff=0.0,
+        seed=42,
+        calibration_iters=10,
+        run_timeout_s=120.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+class TestEventLogWiring:
+    def test_asgd_run_emits_event_log_and_metrics(self, devices8, problem, tmp_path):
+        X, y, _ = problem
+        log = tmp_path / "run.jsonl"
+        csv = tmp_path / "metrics.csv"
+        cfg = cfg_with(event_log=str(log), metrics_csv=str(csv),
+                       metrics_period_s=0.2)
+        res = ASGD(X, y, cfg, devices=devices8).run()
+        assert res.accepted == 200
+
+        summary = EventLogReader(log).summary()
+        assert summary["rounds"] > 0
+        assert summary["merges"] >= 200
+        assert summary["accepted"] == 200
+        # the log's max is over ALL merges; res.max_staleness is the
+        # reference's STAT scan (current per-worker values) -- a lower bound
+        assert summary["staleness"]["max"] >= res.max_staleness
+        # trajectory snapshots flushed at close
+        assert len(summary["trajectory"]) == len(res.trajectory)
+
+        # metrics CSV: header + at least one sample (final report guaranteed)
+        lines = csv.read_text().strip().splitlines()
+        assert len(lines) >= 2
+        assert "updates.accepted" in lines[0]
+
+        html = render_report(log, tmp_path / "report.html")
+        assert "Summary" in html and "Staleness" in html
+        assert (tmp_path / "report.html").exists()
+
+    def test_asaga_run_emits_event_log(self, devices8, problem, tmp_path):
+        X, y, _ = problem
+        log = tmp_path / "saga.jsonl.gz"
+        cfg = cfg_with(num_iterations=100, gamma=0.05, event_log=str(log))
+        res = ASAGA(X, y, cfg, devices=devices8).run()
+        assert res.accepted == 100
+        summary = EventLogReader(log).summary()
+        assert summary["accepted"] == 100
+        assert summary["rounds"] > 0
+
+
+class TestFaultToleranceWiring:
+    def _run_async_with_kills(self, devices8, problem, kills, cfg):
+        """Start an async ASGD run, kill executor 3 `kills` times, return res."""
+        X, y, _ = problem
+        solver = ASGD(X, y, cfg, devices=devices8)
+        out = {}
+
+        def target():
+            out["res"] = solver.run()
+
+        t = threading.Thread(target=target)
+        t.start()
+        try:
+            deadline = time.monotonic() + 30
+            while not hasattr(solver, "scheduler") and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert hasattr(solver, "scheduler"), "run never started"
+            for _ in range(kills):
+                time.sleep(0.4)  # let some rounds flow
+                ex = solver.scheduler.pool.executors[3]
+                if ex.alive:
+                    ex.kill()
+        finally:
+            t.join(timeout=120)
+        assert not t.is_alive(), "run did not finish"
+        return out["res"]
+
+    def test_run_survives_executor_death(self, devices8, problem, tmp_path):
+        log = tmp_path / "kill.jsonl"
+        cfg = cfg_with(
+            num_iterations=1200,
+            event_log=str(log),
+            heartbeat_timeout_ms=200.0,
+            heartbeat_interval_s=0.05,
+            max_slot_failures=99,  # transient path only: no re-homing
+        )
+        res = self._run_async_with_kills(devices8, problem, kills=1, cfg=cfg)
+        # the run completed despite the mid-run executor loss
+        assert res.accepted == 1200
+        assert res.extras.get("workers_lost", 0) >= 1
+        summary = EventLogReader(log).summary()
+        assert 3 in summary["workers_lost"]
+        # convergence still happened
+        assert res.trajectory[-1][1] < res.trajectory[0][1]
+
+    def test_repeated_death_rehomes_shard(self, devices8, problem, tmp_path):
+        log = tmp_path / "rehome.jsonl"
+        cfg = cfg_with(
+            num_iterations=2000,
+            event_log=str(log),
+            heartbeat_timeout_ms=200.0,
+            heartbeat_interval_s=0.05,
+            max_slot_failures=2,
+        )
+        res = self._run_async_with_kills(devices8, problem, kills=2, cfg=cfg)
+        assert res.accepted == 2000
+        assert res.extras.get("workers_lost", 0) >= 2
+        assert res.extras.get("shards_moved", 0) >= 1
+        # the re-homed shard lives on another worker's device now, and both
+        # later rounds and the trajectory evaluation used it successfully
+        assert np.isfinite(res.trajectory[-1][1])
+
+
+class TestSpeculationWiring:
+    def test_sync_run_speculates_around_straggler(self, devices8, problem):
+        X, y, _ = problem
+        cfg = cfg_with(
+            num_iterations=40,
+            coeff=3.0,            # worker 0 sleeps 3x avg delay per round
+            calibration_iters=5,  # calibrate quickly, then inject
+            speculation=True,
+            speculation_quantile=0.5,
+            speculation_multiplier=1.3,
+            speculation_min_ms=5.0,
+        )
+        res = ASGD(X, y, cfg, devices=devices8).run_sync()
+        assert res.rounds == 40
+        # at least one speculative copy launched and the run completed
+        assert res.extras.get("speculated", 0) >= 1
+
+
+class TestStaleReadWiring:
+    def test_stale_read_offset_run(self, devices8, problem):
+        X, y, _ = problem
+        cfg = cfg_with(num_iterations=200, stale_read_offset=2,
+                       max_live_versions=4)
+        res = ASGD(X, y, cfg, devices=devices8).run()
+        assert res.accepted == 200
+        # stale model reads slow convergence but must not break it
+        assert res.trajectory[-1][1] < res.trajectory[0][1]
