@@ -117,6 +117,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "version (latest - OFFSET) from the versioned store")
     p.add_argument("--no-heartbeat", action="store_true",
                    help="disable executor liveness monitoring")
+    p.add_argument("--sparse", action="store_true",
+                   help="rcv1-class path: keep data sparse on device "
+                        "(padded-ELL shards; never densified)")
+    p.add_argument("--sparse-density", type=float, default=0.002,
+                   help="row density for synthetic --sparse data")
     return p
 
 
@@ -146,6 +151,37 @@ def load_data(args, cfg, devices, need_host: bool = False):
     *global* arrays over the mesh itself.
     """
     from asyncframework_tpu.data.sharded import ShardedDataset
+
+    if getattr(args, "sparse", False):
+        if need_host:
+            raise SystemExit(
+                "--sparse is not supported by the sgd-mllib SPMD baseline "
+                "(it shards dense global arrays); use asgd/asaga drivers"
+            )
+        from asyncframework_tpu.data.sparse import SparseShardedDataset
+
+        if args.path == "synthetic":
+            from asyncframework_tpu.data.synthetic import make_sparse_regression
+
+            indptr, indices, values, y = make_sparse_regression(
+                args.N, args.d, density=args.sparse_density, seed=cfg.seed
+            )
+        else:
+            path = os.path.join(args.path, args.file)
+            if not os.path.exists(path):
+                raise SystemExit(f"no such data file: {path}")
+            from asyncframework_tpu.data.libsvm import load_libsvm_sparse
+
+            indptr, indices, values, y = load_libsvm_sparse(path, args.d)
+            if args.N and len(indptr) - 1 > args.N:
+                indptr = indptr[: args.N + 1]
+                indices = indices[: indptr[-1]]
+                values = values[: indptr[-1]]
+                y = y[: args.N]
+        ds = SparseShardedDataset(
+            indptr, indices, values, y, args.d, cfg.num_workers, devices
+        )
+        return ds, None
 
     if args.path == "synthetic":
         if need_host:

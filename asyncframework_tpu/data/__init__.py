@@ -1,13 +1,28 @@
-from asyncframework_tpu.data.libsvm import load_libsvm, parse_libsvm_lines
-from asyncframework_tpu.data.synthetic import make_regression, make_classification
+from asyncframework_tpu.data.libsvm import (
+    load_libsvm,
+    load_libsvm_sparse,
+    parse_libsvm_lines,
+    parse_libsvm_lines_sparse,
+)
+from asyncframework_tpu.data.synthetic import (
+    make_classification,
+    make_regression,
+    make_sparse_regression,
+)
 from asyncframework_tpu.data.sharded import ShardedDataset
+from asyncframework_tpu.data.sparse import SparseShardedDataset, densify
 from asyncframework_tpu.data.dataset import DistributedDataset
 
 __all__ = [
     "load_libsvm",
+    "load_libsvm_sparse",
     "parse_libsvm_lines",
+    "parse_libsvm_lines_sparse",
     "make_regression",
     "make_classification",
+    "make_sparse_regression",
     "ShardedDataset",
+    "SparseShardedDataset",
+    "densify",
     "DistributedDataset",
 ]

@@ -96,6 +96,56 @@ def parse_libsvm_lines(
     return X, np.asarray(labels, np.float32)
 
 
+def parse_libsvm_lines_sparse(
+    lines, num_features: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Parse LibSVM text to CSR triplets ``(indptr, indices, values, y)``
+    with 0-based ``indices`` -- the rcv1-class path that must never densify
+    (``MLUtils.loadLibSVMFile`` parity; 47k-dim rcv1 would be 131 GB dense)."""
+    labels = []
+    indptr = [0]
+    indices: list = []
+    values: list = []
+    max_idx = 0
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        labels.append(float(parts[0]))
+        for tok in parts[1:]:
+            k, v = tok.split(":")
+            ki = int(k)
+            if ki < 1 or (num_features is not None and ki > num_features):
+                # must fail HERE: downstream the jitted gather/scatter clamps
+                # out-of-range indices, which would silently corrupt training
+                raise ValueError(
+                    f"libsvm feature index {ki} out of range "
+                    f"[1, {num_features}]"
+                )
+            if ki > max_idx:
+                max_idx = ki
+            indices.append(ki - 1)  # libsvm is 1-based
+            values.append(float(v))
+        indptr.append(len(indices))
+    d = num_features if num_features is not None else max_idx
+    return (
+        np.asarray(indptr, np.int64),
+        np.asarray(indices, np.int32),
+        np.asarray(values, np.float32),
+        np.asarray(labels, np.float32),
+    )
+
+
+def load_libsvm_sparse(
+    path: str, num_features: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Load a LibSVM file as CSR triplets (see
+    :func:`parse_libsvm_lines_sparse`)."""
+    with open(path, "r") as f:
+        return parse_libsvm_lines_sparse(f, num_features)
+
+
 def load_libsvm(
     path: str,
     num_features: Optional[int] = None,

@@ -48,6 +48,16 @@ W = TypeVar("W")
 C = TypeVar("C")
 
 
+def _append(c: list, v) -> list:
+    c.append(v)
+    return c
+
+
+def _extend(a: list, b: list) -> list:
+    a.extend(b)
+    return a
+
+
 def portable_hash(key: Any) -> int:
     """Process-stable hash (Python's ``hash`` is salted for str/bytes)."""
     if key is None:
@@ -191,8 +201,8 @@ class PairOpsMixin:
         reference documents the same no-map-side-combine memory caveat)."""
         return self.combine_by_key(
             lambda v: [v],
-            lambda c, v: c + [v],
-            lambda a, b: a + b,
+            _append,  # in-place: `c + [v]` would be O(m^2) per skewed key
+            _extend,
             num_partitions,
         )
 

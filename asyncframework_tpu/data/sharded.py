@@ -45,6 +45,10 @@ class Shard:
     start: int    # global index of row 0 (partitionCumList parity)
     size: int
 
+    @property
+    def device(self):
+        return self.X.device
+
 
 class ShardedDataset:
     """Immutable row-sharded (X, y) resident on devices."""

@@ -81,7 +81,7 @@ class ShardRecovery:
         for shard_id, new_owner in plan.moves.items():
             self.move_shard(shard_id, new_owner)
 
-    def move_shard(self, shard_id: int, new_owner: int) -> Shard:
+    def move_shard(self, shard_id: int, new_owner: int):
         """Re-place one shard on ``new_owner``'s device; returns the new view."""
         with self._lock:
             cur = self._placed[shard_id]
@@ -89,11 +89,25 @@ class ShardRecovery:
             # jax.device_put from a live device buffer is a device-to-device
             # (or host-bounce) copy; from the host copy it is a fresh upload.
             # Either way the result lives on the adopting worker's device.
-            X = jax.device_put(cur.X, target_dev)
-            y = jax.device_put(cur.y, target_dev)
-            moved = Shard(
-                worker_id=shard_id, X=X, y=y, start=cur.start, size=cur.size
-            )
+            if hasattr(cur, "cols"):  # padded-ELL sparse shard
+                from asyncframework_tpu.data.sparse import SparseShard
+
+                moved = SparseShard(
+                    worker_id=shard_id,
+                    cols=jax.device_put(cur.cols, target_dev),
+                    vals=jax.device_put(cur.vals, target_dev),
+                    y=jax.device_put(cur.y, target_dev),
+                    start=cur.start,
+                    size=cur.size,
+                )
+            else:
+                moved = Shard(
+                    worker_id=shard_id,
+                    X=jax.device_put(cur.X, target_dev),
+                    y=jax.device_put(cur.y, target_dev),
+                    start=cur.start,
+                    size=cur.size,
+                )
             self._placed[shard_id] = moved
             self._owner[shard_id] = new_owner
             return moved

@@ -109,6 +109,33 @@ def saga_shard_step(
     return g, diff
 
 
+# ------------------------------------------------------------------ sparse
+# rcv1-class data in padded-ELL form (data/sparse.py): cols/vals are
+# (n, K) with zero padding; w stays dense (the PS applies dense updates).
+
+@jax.jit
+def sparse_residual(
+    cols: jax.Array, vals: jax.Array, y: jax.Array, w: jax.Array
+) -> jax.Array:
+    """Per-sample ``x_i . w - y_i`` via gather: padding contributes 0."""
+    return jnp.sum(vals * w[cols], axis=1) - y
+
+
+def make_sparse_grad_sum(d: int):
+    """jit (cols, vals, coeff) -> dense (d,) gradient via scatter-add.
+
+    ``g = sum_i coeff_i * x_i`` -- the sparse analog of ``X.T @ coeff``;
+    XLA lowers the ``.at[].add`` to one static scatter kernel.
+    """
+
+    @jax.jit
+    def grad_sum(cols, vals, coeff):
+        contrib = vals * coeff[:, None]
+        return jnp.zeros(d, vals.dtype).at[cols.ravel()].add(contrib.ravel())
+
+    return grad_sum
+
+
 @functools.partial(jax.jit, donate_argnums=(1,))
 def saga_commit_history(
     alpha: jax.Array, diff: jax.Array, mask: jax.Array

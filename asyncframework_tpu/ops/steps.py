@@ -170,6 +170,92 @@ def make_saga_table_delta():
     return delta
 
 
+# ------------------------------------------------------------------ sparse
+def make_sparse_asgd_worker_step(batch_rate: float, d: int):
+    """jit (cols, vals, y, w, key) -> (g_sum (d,), new_key).
+
+    The sparse analog of :func:`make_asgd_worker_step` for padded-ELL shards
+    (rcv1-class data): residual by gather, gradient by scatter-add; the
+    returned gradient is dense because the parameter server applies dense
+    updates (the reference's driver-side axpy is dense too).
+    """
+    from asyncframework_tpu.ops.gradients import (
+        make_sparse_grad_sum,
+        sparse_residual,
+    )
+
+    grad_sum = make_sparse_grad_sum(d)
+
+    @jax.jit
+    def step(cols, vals, y, w, key):
+        key, sub = jax.random.split(key)
+        mask = jax.random.bernoulli(sub, batch_rate, (y.shape[0],)).astype(
+            vals.dtype
+        )
+        r = sparse_residual(cols, vals, y, w)
+        return grad_sum(cols, vals, mask * r), key
+
+    return step
+
+
+def make_sparse_saga_worker_step(batch_rate: float, d: int):
+    """jit (cols, vals, y, w, alpha, key) -> (g, diff, mask, new_key).
+
+    Sparse ASAGA worker computation: ``diff`` are candidate history scalars,
+    ``g = sum_i mask_i (diff_i - alpha_i) x_i`` via scatter-add.
+    """
+    from asyncframework_tpu.ops.gradients import (
+        make_sparse_grad_sum,
+        sparse_residual,
+    )
+
+    grad_sum = make_sparse_grad_sum(d)
+
+    @jax.jit
+    def step(cols, vals, y, w, alpha, key):
+        key, sub = jax.random.split(key)
+        mask = jax.random.bernoulli(sub, batch_rate, (y.shape[0],)).astype(
+            vals.dtype
+        )
+        diff = sparse_residual(cols, vals, y, w)
+        g = grad_sum(cols, vals, mask * (diff - alpha))
+        return g, diff, mask, key
+
+    return step
+
+
+def make_sparse_table_delta(d: int):
+    """jit (cols, vals, diff, mask, alpha_cur) -> exact table delta (sparse
+    analog of :func:`make_saga_table_delta`)."""
+    from asyncframework_tpu.ops.gradients import make_sparse_grad_sum
+
+    grad_sum = make_sparse_grad_sum(d)
+
+    @jax.jit
+    def delta(cols, vals, diff, mask, alpha_cur):
+        return grad_sum(cols, vals, mask * (diff - alpha_cur))
+
+    return delta
+
+
+def make_sparse_trajectory_loss_eval():
+    """jit (cols, vals, y, W (S,d)) -> (S,) per-snapshot loss sums.
+
+    Scans over snapshots so peak memory stays one (n_p, K) gather, not
+    (S, n_p, K).
+    """
+
+    @jax.jit
+    def eval_shard(cols, vals, y, W):
+        def one(w):
+            r = jnp.sum(vals * w[cols], axis=1) - y
+            return jnp.sum(r * r)
+
+        return jax.lax.map(one, W)
+
+    return eval_shard
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def add_grads(a, b):
     """Associative combine for the sync drain (comOp parity: vector add).

@@ -26,6 +26,7 @@ Staleness filter quirk preserved: ASAGA accepts iff ``k - staleness <= taw``
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -48,6 +49,7 @@ from asyncframework_tpu.solvers.base import (
     SolverConfig,
     TrainResult,
     WaitingTimeTable,
+    check_hbm_plan,
     resolve_dataset,
 )
 from asyncframework_tpu.solvers.instrumentation import (
@@ -73,14 +75,23 @@ class ASAGA:
             )
         self.cfg = config
         self.devices = list(devices) if devices is not None else jax.devices()
+        check_hbm_plan(X, config, self.devices, history_table=True)
         self.ds = resolve_dataset(X, y, config.num_workers, self.devices)
         self.driver_device = self.devices[0]
-        self._step = steps.make_saga_worker_step(config.batch_rate)
+        self._sparse = bool(getattr(self.ds, "is_sparse", False))
+        if self._sparse:
+            self._step = steps.make_sparse_saga_worker_step(
+                config.batch_rate, self.ds.d
+            )
+            self._table_delta = steps.make_sparse_table_delta(self.ds.d)
+            self._eval = steps.make_sparse_trajectory_loss_eval()
+        else:
+            self._step = steps.make_saga_worker_step(config.batch_rate)
+            self._table_delta = steps.make_saga_table_delta()
+            self._eval = steps.make_trajectory_loss_eval("least_squares")
         self._apply = steps.make_saga_apply(
             config.gamma, config.batch_rate, self.ds.n, config.num_workers
         )
-        self._table_delta = steps.make_saga_table_delta()
-        self._eval = steps.make_trajectory_loss_eval("least_squares")
         self._recovery = ShardRecovery(self.ds, self.devices)
 
     # ------------------------------------------------------------------ async
@@ -141,9 +152,9 @@ class ASAGA:
         def on_shard_moved(shard_id, moved):
             # the history slice and PRNG chain follow the shard's new home
             with hot_lock:
-                alpha[shard_id] = jax.device_put(alpha[shard_id], moved.X.device)
+                alpha[shard_id] = jax.device_put(alpha[shard_id], moved.device)
                 worker_keys[shard_id] = jax.device_put(
-                    worker_keys[shard_id], moved.X.device
+                    worker_keys[shard_id], moved.device
                 )
 
         ft = None
@@ -165,6 +176,16 @@ class ASAGA:
                 on_launch=inst.on_speculative_launch,
             )
             spec.start()
+        # stale-read experiment: the reference's ASAGA driver is the main
+        # ASYNCbroadcast user (SparkASAGAThread.scala:268); workers read
+        # model version (latest - offset)
+        from asyncframework_tpu.broadcast import VersionedModelStore
+
+        store = (
+            VersionedModelStore(cfg.max_live_versions)
+            if cfg.stale_read_offset is not None
+            else None
+        )
 
         state = {"w": w, "ab": alpha_bar, "k": k0, "accepted": 0, "dropped": 0,
                  "rounds": 0}
@@ -216,7 +237,14 @@ class ASAGA:
                                 diff = jax.device_put(diff, alpha_cur.device)
                                 mask = jax.device_put(mask, alpha_cur.device)
                             # exact table delta (see make_saga_table_delta)
-                            delta = self._table_delta(shard.X, diff, mask, alpha_cur)
+                            if self._sparse:
+                                delta = self._table_delta(
+                                    shard.cols, shard.vals, diff, mask, alpha_cur
+                                )
+                            else:
+                                delta = self._table_delta(
+                                    shard.X, diff, mask, alpha_cur
+                                )
                             alpha[res.worker_id] = steps.saga_commit_history(
                                 alpha_cur, diff, mask
                             )
@@ -271,6 +299,15 @@ class ASAGA:
                     continue
                 with state_lock:
                     w_pub = state["w"]
+                    model_version = state["k"]
+                if store is not None:
+                    # version buffer resolved at submit time: eviction by
+                    # later publishes cannot invalidate an in-flight read
+                    v = store.publish(np.asarray(w_pub))
+                    live = store.live_versions()
+                    tv = max(live[0], v - cfg.stale_read_offset)
+                    w_pub = store.value(self.driver_device, version=tv)
+                    model_version = v
                 ts = ctx.get_current_time()
                 ctx.set_last_time(ts)
                 ctx.mark_busy(cohort)
@@ -291,7 +328,7 @@ class ASAGA:
                 waiters.append(waiter)
                 with state_lock:
                     state["rounds"] += 1
-                inst.on_round_submitted(state["rounds"], cohort, state["k"])
+                inst.on_round_submitted(state["rounds"], cohort, model_version)
         finally:
             stop.set()
             upd.join(timeout=10)
@@ -300,6 +337,8 @@ class ASAGA:
             if spec is not None:
                 spec.stop()
             sched.shutdown()
+            if sys.exc_info()[0] is not None:
+                inst.close()  # crash path: flush/seal the event log now
 
         elapsed = time.monotonic() - start_wall
         with state_lock:
@@ -373,9 +412,9 @@ class ASAGA:
             # the history slice and PRNG chain follow the shard's new home
             # (same discipline as the async path)
             with hot_lock:
-                alpha[shard_id] = jax.device_put(alpha[shard_id], moved.X.device)
+                alpha[shard_id] = jax.device_put(alpha[shard_id], moved.device)
                 worker_keys[shard_id] = jax.device_put(
-                    worker_keys[shard_id], moved.X.device
+                    worker_keys[shard_id], moved.device
                 )
 
         ft = None
@@ -461,6 +500,8 @@ class ASAGA:
             if spec is not None:
                 spec.stop()
             sched.shutdown()
+            if sys.exc_info()[0] is not None:
+                inst.close()  # crash path: flush/seal the event log now
 
         elapsed = time.monotonic() - start_wall
         snapshots.append((elapsed * 1e3, w))
@@ -489,8 +530,9 @@ class ASAGA:
     def _make_task(self, wid, w_pub, key, alpha_slice, delay_model: DelayModel):
         shard = self._recovery.shard(wid)  # follows re-homed shards
         delay_ms = delay_model.delay_ms(wid)
-        dev = shard.X.device
+        dev = shard.device
         step = self._step
+        sparse = self._sparse
         # injected delay fires once: a speculative copy / replacement
         # executor is a healthy host path and bypasses the straggler
         delay_fired = threading.Event()
@@ -510,7 +552,14 @@ class ASAGA:
             key_local = key
             if key_local.device != dev:
                 key_local = jax.device_put(key_local, dev)
-            g, diff, mask, new_key = step(shard.X, shard.y, w_local, a_local, key_local)
+            if sparse:
+                g, diff, mask, new_key = step(
+                    shard.cols, shard.vals, shard.y, w_local, a_local, key_local
+                )
+            else:
+                g, diff, mask, new_key = step(
+                    shard.X, shard.y, w_local, a_local, key_local
+                )
             g.block_until_ready()
             return g, diff, mask, new_key
 
@@ -557,8 +606,12 @@ class ASAGA:
         for wid in range(self.cfg.num_workers):
             shard = self._recovery.shard(wid)  # follows re-homed shards
             Wd = W
-            if Wd.device != shard.X.device:
-                Wd = jax.device_put(W, shard.X.device)
-            totals += np.asarray(self._eval(shard.X, shard.y, Wd), np.float64)
+            if Wd.device != shard.device:
+                Wd = jax.device_put(W, shard.device)
+            if self._sparse:
+                part = self._eval(shard.cols, shard.vals, shard.y, Wd)
+            else:
+                part = self._eval(shard.X, shard.y, Wd)
+            totals += np.asarray(part, np.float64)
         totals /= self.ds.n
         return [(t, float(l)) for (t, _), l in zip(snapshots, totals)]

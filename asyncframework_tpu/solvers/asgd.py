@@ -23,6 +23,7 @@ dispatches, not two transfers.
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -46,6 +47,7 @@ from asyncframework_tpu.solvers.base import (
     SolverConfig,
     TrainResult,
     WaitingTimeTable,
+    check_hbm_plan,
     resolve_dataset,
 )
 from asyncframework_tpu.solvers.instrumentation import (
@@ -66,16 +68,30 @@ class ASGD:
         :class:`ShardedDataset` (e.g. generated on device), with ``y=None``."""
         self.cfg = config
         self.devices = list(devices) if devices is not None else jax.devices()
+        check_hbm_plan(X, config, self.devices, history_table=False)
         self.ds = resolve_dataset(X, y, config.num_workers, self.devices)
         self.driver_device = self.devices[0]
-        self._step = steps.make_asgd_worker_step(config.batch_rate, config.loss)
+        self._sparse = bool(getattr(self.ds, "is_sparse", False))
+        if self._sparse:
+            if config.loss != "least_squares":
+                raise ValueError(
+                    "sparse shards currently support least_squares only"
+                )
+            self._step = steps.make_sparse_asgd_worker_step(
+                config.batch_rate, self.ds.d
+            )
+            self._eval = steps.make_sparse_trajectory_loss_eval()
+        else:
+            self._step = steps.make_asgd_worker_step(
+                config.batch_rate, config.loss
+            )
+            self._eval = steps.make_trajectory_loss_eval(config.loss)
         self._apply = steps.make_asgd_apply(
             config.gamma, config.batch_rate, self.ds.n, config.num_workers
         )
         self._sync_apply = steps.make_sync_apply(
             config.gamma, config.batch_rate, self.ds.n
         )
-        self._eval = steps.make_trajectory_loss_eval(config.loss)
         # all shard access routes through the recovery view so a re-homed
         # shard is transparently picked up by later rounds and by evaluation
         self._recovery = ShardRecovery(self.ds, self.devices)
@@ -280,6 +296,8 @@ class ASGD:
             if spec is not None:
                 spec.stop()
             sched.shutdown()
+            if sys.exc_info()[0] is not None:
+                inst.close()  # crash path: flush/seal the event log now
 
         elapsed = time.monotonic() - start_wall
         with state_lock:
@@ -398,6 +416,8 @@ class ASGD:
             if spec is not None:
                 spec.stop()
             sched.shutdown()
+            if sys.exc_info()[0] is not None:
+                inst.close()  # crash path: flush/seal the event log now
 
         elapsed = time.monotonic() - start_wall
         snapshots.append((elapsed * 1e3, w))
@@ -441,8 +461,9 @@ class ASGD:
         # new device; w and the PRNG chain follow the shard's home
         shard = self._recovery.shard(wid)
         delay_ms = delay_model.delay_ms(wid)
-        dev = shard.X.device
+        dev = shard.device
         step = self._step
+        sparse = self._sparse
         # The injected delay models a slow *machine*: only the first body to
         # run it sleeps -- a speculative copy or a replacement executor is a
         # different (healthy) host path and must bypass the straggler.
@@ -458,7 +479,10 @@ class ASGD:
             key_local = key
             if key_local.device != dev:
                 key_local = jax.device_put(key_local, dev)
-            g, new_key = step(shard.X, shard.y, w_local, key_local)
+            if sparse:
+                g, new_key = step(shard.cols, shard.vals, shard.y, w_local, key_local)
+            else:
+                g, new_key = step(shard.X, shard.y, w_local, key_local)
             g.block_until_ready()  # completion only; data stays in HBM
             return g, new_key
 
@@ -497,8 +521,12 @@ class ASGD:
         for wid in range(self.cfg.num_workers):
             shard = self._recovery.shard(wid)  # follows re-homed shards
             Wd = W
-            if Wd.device != shard.X.device:
-                Wd = jax.device_put(W, shard.X.device)
-            totals += np.asarray(self._eval(shard.X, shard.y, Wd), np.float64)
+            if Wd.device != shard.device:
+                Wd = jax.device_put(W, shard.device)
+            if self._sparse:
+                part = self._eval(shard.cols, shard.vals, shard.y, Wd)
+            else:
+                part = self._eval(shard.X, shard.y, Wd)
+            totals += np.asarray(part, np.float64)
         totals /= self.ds.n
         return [(t, float(l)) for (t, _), l in zip(snapshots, totals)]

@@ -21,13 +21,39 @@ from asyncframework_tpu.checkpoint import CheckpointManager
 from asyncframework_tpu.data.sharded import ShardedDataset
 
 
-def resolve_dataset(X, y, num_workers: int, devices) -> ShardedDataset:
-    """Accept either host arrays (sharded here) or a pre-built
-    :class:`ShardedDataset`; validate consistency with the solver's setup."""
-    if isinstance(X, ShardedDataset):
+def check_hbm_plan(X, cfg: "SolverConfig", devices, history_table: bool) -> None:
+    """Consult the HBM planner before committing to a run (VERDICT item 10):
+    host arrays are planned from shape BEFORE placement; a pre-built dataset
+    has its actual residency measured.  Raises ``MemoryError`` with the
+    planner's accounting when the budget is oversubscribed."""
+    from asyncframework_tpu.utils.hbm import plan_for_run
+
+    num_devices = max(len(set(devices)), 1)
+    versions = (
+        cfg.max_live_versions if cfg.stale_read_offset is not None else 2
+    )
+    target = (X.shape[0], X.shape[1]) if isinstance(X, np.ndarray) else X
+    plan_for_run(
+        target,
+        cfg.num_workers,
+        num_devices,
+        history_table=history_table,
+        model_versions=versions,
+        budget_bytes=cfg.hbm_budget_bytes,
+    ).require_fits()
+
+
+def resolve_dataset(X, y, num_workers: int, devices):
+    """Accept host arrays (sharded here) or a pre-built dataset
+    (:class:`ShardedDataset` or
+    :class:`~asyncframework_tpu.data.sparse.SparseShardedDataset`);
+    validate consistency with the solver's setup."""
+    from asyncframework_tpu.data.sparse import SparseShardedDataset
+
+    if isinstance(X, (ShardedDataset, SparseShardedDataset)):
         if y is not None:
             raise ValueError(
-                "y must be None when passing a pre-built ShardedDataset "
+                "y must be None when passing a pre-built dataset "
                 "(its labels are already resident on device)"
             )
         if X.num_workers != num_workers:
@@ -37,7 +63,7 @@ def resolve_dataset(X, y, num_workers: int, devices) -> ShardedDataset:
             )
         for wid in range(num_workers):
             expect = devices[wid % len(devices)]
-            actual = X.shard(wid).X.device
+            actual = X.shard(wid).device
             if actual != expect:
                 raise ValueError(
                     f"shard {wid} lives on {actual} but the solver will "

@@ -143,7 +143,17 @@ class RunInstruments:
         printer_freq: int = 1,
     ) -> None:
         """Flush trajectory snapshots (objectives are evaluated post-hoc, so
-        ``ModelSnapshot`` events are emitted at close) and stop everything."""
+        ``ModelSnapshot`` events are emitted at close) and stop everything.
+
+        Idempotent: the solvers' ``finally`` blocks close WITHOUT a
+        trajectory when an exception is unwinding (the event log must get
+        its gzip footer exactly when the run crashed); the success path then
+        skips its second close.
+        """
+        with self._lock:
+            if getattr(self, "_closed", False):
+                return
+            self._closed = True
         if trajectory:
             for i, (t_ms, obj) in enumerate(trajectory):
                 self.bus.post(
@@ -247,7 +257,7 @@ class FaultTolerantRun:
                 new_owner = plan.moves[worker_id]
                 moved = self._recovery.move_shard(worker_id, new_owner)
                 self._inst.on_shard_moved(
-                    worker_id, new_owner, moved.X.device
+                    worker_id, new_owner, moved.device
                 )
                 if self._on_moved is not None:
                     self._on_moved(worker_id, moved)

@@ -30,6 +30,10 @@ class StreamingContext:
         self.batch_interval_ms = int(batch_interval_ms)
         self.clock = clock or SystemClock()
         self._outputs: List[Tuple[DStream, Callable[[int, Any], None]]] = []
+        self._statefuls: List = []  # StatefulDStream registration order = id
+        self._ckpt_mgr = None
+        self._ckpt_every = 0
+        self._pending_restore = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._started = False
@@ -42,16 +46,104 @@ class StreamingContext:
             raise RuntimeError("cannot add outputs after start()")
         self._outputs.append((ds, fn))
 
+    def _register_stateful(self, ds) -> None:
+        idx = len(self._statefuls)
+        self._statefuls.append(ds)
+        # a restore_state() that ran before the graph was rebuilt parks the
+        # checkpoint here; hand each stateful its slice as it registers
+        if self._pending_restore is not None:
+            self._apply_restore(idx, ds)
+
+    # ------------------------------------------------------------- checkpoint
+    def enable_state_checkpoint(
+        self, directory, every_n_intervals: int = 5, keep: int = 3
+    ) -> None:
+        """Periodic snapshot of every stateful stream's keyed state.
+
+        Parity: streaming metadata checkpoints
+        (``streaming/.../Checkpoint.scala:55``); rides
+        :class:`~asyncframework_tpu.checkpoint.CheckpointManager` (atomic
+        rename + fsync + GC).  Keys/states must be JSON-serializable (same
+        trust posture as the WAL: replay never executes code).
+        """
+        from asyncframework_tpu.checkpoint import CheckpointManager
+
+        if every_n_intervals < 1:
+            raise ValueError("every_n_intervals must be >= 1")
+        self._ckpt_mgr = CheckpointManager(directory, keep)
+        self._ckpt_every = int(every_n_intervals)
+
+    def _maybe_checkpoint(self, interval_idx: int) -> None:
+        if self._ckpt_mgr is None or interval_idx % self._ckpt_every != 0:
+            return
+        import json
+
+        import numpy as np
+
+        state = {}
+        for i, ds in enumerate(self._statefuls):
+            t, items = ds.snapshot_state()
+            blob = json.dumps([t, items]).encode("utf-8")
+            state[f"stream_{i}"] = np.frombuffer(blob, np.uint8)
+        self._ckpt_mgr.save(interval_idx, state)
+
+    @staticmethod
+    def _freeze(k):
+        """JSON turns tuple keys into lists; re-freeze so restored keys hash
+        identically to the keys the update function will produce."""
+        return tuple(StreamingContext._freeze(x) for x in k) if isinstance(
+            k, list
+        ) else k
+
+    def _apply_restore(self, idx: int, ds) -> None:
+        import json
+
+        blob = self._pending_restore.get(f"stream_{idx}")
+        if blob is None:
+            return
+        t, items = json.loads(bytes(bytearray(blob)).decode("utf-8"))
+        ds.restore(t, [(self._freeze(k), v) for k, v in items])
+
+    def restore_state(self) -> Optional[int]:
+        """Load the latest state checkpoint.  May be called before OR after
+        the stream graph is rebuilt: state is handed to stateful streams as
+        they register, matched by registration order (the rebuilt graph must
+        register its stateful streams in the same order).  Returns the
+        checkpoint's newest state time in ms (use it as
+        ``recovered_stream(..., after_ms=...)`` to skip WAL batches already
+        folded into the state), or None when there is no checkpoint."""
+        if self._ckpt_mgr is None:
+            raise RuntimeError("enable_state_checkpoint first")
+        ck = self._ckpt_mgr.restore_latest_or_none()
+        if ck is None:
+            return None
+        import json
+
+        self._pending_restore = ck
+        last_t = 0
+        for i, ds in enumerate(self._statefuls):
+            self._apply_restore(i, ds)
+        for key, blob in ck.items():
+            if key.startswith("stream_"):
+                t, _items = json.loads(bytes(bytearray(blob)).decode("utf-8"))
+                last_t = max(last_t, int(t))
+        return last_t
+
     # ----------------------------------------------------------------- sources
     def queue_stream(self, batches=None, wal: Optional[WriteAheadLog] = None
                      ) -> QueueStream:
         return QueueStream(self, batches, wal=wal)
 
-    def recovered_stream(self, wal: WriteAheadLog) -> QueueStream:
-        """Re-emit every batch recorded in a write-ahead log (restart
-        recovery: the reference replays WAL-backed blocks after driver
-        failure)."""
-        return QueueStream(self, [b for (_t, b) in wal.replay()])
+    def recovered_stream(
+        self, wal: WriteAheadLog, after_ms: int = 0
+    ) -> QueueStream:
+        """Re-emit batches recorded in a write-ahead log (restart recovery:
+        the reference replays WAL-backed blocks after driver failure).
+        ``after_ms`` skips batches already folded into a restored state
+        checkpoint (pass ``restore_state()``'s return value)."""
+        return QueueStream(
+            self, [b for (t, b) in wal.replay() if t > after_ms]
+        )
 
     # ------------------------------------------------------------ job generation
     def generate_batch(self, time_ms: int) -> int:
@@ -67,6 +159,7 @@ class StreamingContext:
                 fired += 1
         with self._lock:
             self._processed_batches += 1
+        self._maybe_checkpoint(time_ms // self.batch_interval_ms)
         return fired
 
     @property

@@ -108,6 +108,21 @@ class DStream:
     def union(self, other: "DStream") -> "DStream":
         return _Union(self.ssc, [self, other])
 
+    def update_state_by_key(
+        self,
+        update_fn: Callable[[List[Any], Optional[Any]], Optional[Any]],
+    ) -> "StatefulDStream":
+        """Keyed running state across intervals.
+
+        Parity: ``streaming/.../dstream/PairDStreamFunctions.scala``
+        ``updateStateByKey`` -- batches are iterables of ``(key, value)``
+        pairs; every interval, ``update_fn(new_values, prev_state)`` runs for
+        EVERY key that has new values or existing state (the reference's
+        cogroup-with-state semantics); returning ``None`` drops the key.  The
+        emitted batch is the full ``[(key, state), ...]`` snapshot.
+        """
+        return StatefulDStream(self.ssc, self, update_fn)
+
     # ---------------------------------------------------------------- outputs
     def foreach_batch(self, fn: Callable[[int, Any], None]) -> "DStream":
         """Register an output operation (``foreachRDD`` parity): ``fn(time_ms,
@@ -181,6 +196,58 @@ def _concat(batches: List[Any]) -> Any:
     for b in batches:
         out.extend(b)
     return out
+
+
+class StatefulDStream(DStream):
+    """``updateStateByKey`` node: per-key state carried across intervals.
+
+    State advances exactly once per interval (the context's job generator
+    visits intervals in order; ``get_or_compute`` memoization absorbs
+    re-reads of the current interval).  ``snapshot_state`` / ``restore``
+    expose the state for the streaming checkpoint
+    (``streaming/.../Checkpoint.scala:55`` parity via ``checkpoint.py``).
+    """
+
+    def __init__(self, ssc, parent: DStream, update_fn):
+        super().__init__(ssc, [parent])
+        self._update = update_fn
+        self._state: Dict[Any, Any] = {}
+        self._state_time = 0  # last interval folded into the state
+        ssc._register_stateful(self)
+
+    def compute(self, time_ms: int) -> Any:
+        if time_ms <= self._state_time:
+            # interval predates the restored/advanced state (e.g. WAL replay
+            # overlapping a checkpoint): state already includes it
+            return [(k, v) for k, v in self._state.items()]
+        b = self.parents[0].get_or_compute(time_ms)
+        grouped: Dict[Any, List[Any]] = {}
+        if b is not EMPTY:
+            for k, v in b:
+                grouped.setdefault(k, []).append(v)
+        # the update runs for every key with new values OR existing state
+        next_state: Dict[Any, Any] = {}
+        for k in set(grouped) | set(self._state):
+            s = self._update(grouped.get(k, []), self._state.get(k))
+            if s is not None:
+                next_state[k] = s
+        self._state = next_state
+        self._state_time = time_ms
+        return [(k, v) for k, v in next_state.items()]
+
+    # -------------------------------------------------------------- checkpoint
+    def snapshot_state(self):
+        """(state_time_ms, [(key, state), ...]) for the checkpointer."""
+        return self._state_time, list(self._state.items())
+
+    def restore(self, state_time: int, items) -> None:
+        """Install checkpointed state.  ``_state_time`` resets to 0: a
+        rebuilt context restarts interval numbering, and batches already
+        folded into this state are excluded at the source instead
+        (``recovered_stream(..., after_ms=state_time)``)."""
+        del state_time  # recorded in the checkpoint for the source filter
+        self._state = dict(items)
+        self._state_time = 0
 
 
 class QueueStream(DStream):

@@ -107,6 +107,66 @@ def plan_dataset(
     )
 
 
+def dataset_residency_bytes(ds) -> Dict[object, int]:
+    """Actual per-device bytes of an already-placed sharded dataset
+    (dense or sparse): what the shards occupy in each device's HBM."""
+    per_dev: Dict[object, int] = {}
+    for wid in range(ds.num_workers):
+        s = ds.shard(wid)
+        arrays = (
+            (s.cols, s.vals, s.y) if hasattr(s, "cols") else (s.X, s.y)
+        )
+        dev = arrays[0].device
+        per_dev[dev] = per_dev.get(dev, 0) + sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize for a in arrays
+        )
+    return per_dev
+
+
+def plan_for_run(
+    ds_or_shape,
+    num_workers: int,
+    num_devices: int,
+    history_table: bool = False,
+    model_versions: int = 2,
+    budget_bytes: Optional[int] = None,
+    headroom: float = 0.85,
+) -> ShardPlan:
+    """Placement plan for one training run.
+
+    ``ds_or_shape`` is either a *placed* dataset (actual residency measured
+    from its shards) or an ``(n, d)`` tuple for data not yet placed (planned
+    from shapes).  Solvers call this before training and fail fast via
+    :meth:`ShardPlan.require_fits` when the budget is oversubscribed.
+    """
+    if isinstance(ds_or_shape, tuple):
+        n, d = ds_or_shape
+        return plan_dataset(
+            n, d, num_workers, num_devices,
+            history_table=history_table, model_versions=model_versions,
+            budget_bytes=budget_bytes, headroom=headroom,
+        )
+    ds = ds_or_shape
+    budget = budget_bytes if budget_bytes is not None else device_hbm_bytes()
+    per_dev = dataset_residency_bytes(ds)
+    worst = max(per_dev.values()) if per_dev else 0
+    extra = model_versions * nbytes((ds.d,), np.float32)
+    if history_table:
+        # one slice per WORKER; workers sharing a device stack their slices
+        workers_per_device = -(-num_workers // num_devices)
+        extra += workers_per_device * nbytes(
+            (-(-ds.n // num_workers),), np.float32
+        )
+    total = worst + extra
+    usable = int(budget * headroom)
+    return ShardPlan(
+        bytes_per_device=int(total),
+        budget_bytes=usable,
+        fits=total <= usable,
+        utilization=total / usable if usable else float("inf"),
+    )
+
+
 def fmt_bytes(b: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if abs(b) < 1024 or unit == "TiB":

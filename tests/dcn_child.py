@@ -1,0 +1,52 @@
+"""Child process for the two-process DCN bring-up test (test_multihost.py).
+
+Runs on the forced-CPU platform with 2 virtual devices, initializes
+``jax.distributed`` from the ASYNCTPU_* env vars through the framework's
+``multihost`` wrapper, fences on the host barrier, and performs one global
+psum whose result proves the collective crossed the process boundary.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from asyncframework_tpu.parallel import multihost  # noqa: E402
+
+
+def main() -> None:
+    active = multihost.ensure_initialized()  # env-driven (ASYNCTPU_*)
+    pid, pc = multihost.process_info()
+    multihost.sync_hosts("dcn-test")
+    import jax.numpy as jnp
+
+    # global psum: each device contributes (process_id + 1); with 2 procs x 2
+    # devices the total is 2*1 + 2*2 = 6 everywhere
+    local = jnp.full((jax.local_device_count(),), float(pid + 1))
+    total = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(local)
+    mesh = multihost.global_mesh()
+    print(json.dumps({
+        "active": bool(active),
+        "pid": int(pid),
+        "pc": int(pc),
+        "devices": int(jax.device_count()),
+        "local_devices": int(jax.local_device_count()),
+        "psum": float(total[0]),
+        "mesh_size": int(mesh.devices.size),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Two-process DCN bring-up test (VERDICT item 8).
+
+Parity: ``deploy/LocalSparkCluster.scala:36`` -- the reference proves its
+cluster story by booting a real Master + Workers inside one machine and
+running real jobs over real RPC.  The analog here: two OS processes on
+localhost initialize ``jax.distributed`` through ``parallel/multihost.py``
+(one coordinator, gRPC over the loopback DCN), fence on the host barrier,
+and run a psum that must cross the process boundary to produce the right
+answer.  No TPU required: the forced-CPU platform exercises the identical
+code path.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+CHILD = Path(__file__).parent / "dcn_child.py"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_bringup_barrier_and_psum():
+    port = free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # child sets its own platform
+        env.pop("XLA_FLAGS", None)
+        env.update(
+            ASYNCTPU_COORDINATOR=f"127.0.0.1:{port}",
+            ASYNCTPU_NUM_PROCESSES="2",
+            ASYNCTPU_PROCESS_ID=str(pid),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, str(CHILD)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        ))
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=150)
+        assert p.returncode == 0, f"child failed:\nstdout={out}\nstderr={err}"
+        results.append(json.loads(out.strip().splitlines()[-1]))
+
+    by_pid = {r["pid"]: r for r in results}
+    assert set(by_pid) == {0, 1}
+    for r in results:
+        assert r["active"] is True          # multi-process mode detected
+        assert r["pc"] == 2                 # both processes joined
+        assert r["devices"] == 4            # 2 hosts x 2 virtual devices
+        assert r["local_devices"] == 2
+        assert r["psum"] == 6.0             # 2*1 + 2*2: crossed the boundary
+        assert r["mesh_size"] == 4          # global mesh spans both hosts

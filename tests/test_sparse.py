@@ -1,0 +1,155 @@
+"""Sparse (rcv1-class) end-to-end tests.
+
+Round-2 requirement (VERDICT.md item 4): CSR shards resident on device in a
+static-shape form, the worker step computing sparse gradients without ever
+densifying the data, and an ASGD recipe on a 47k-dim ~0.2%-dense problem
+converging -- through the CLI as well.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from asyncframework_tpu.data import (
+    SparseShardedDataset,
+    densify,
+    make_sparse_regression,
+    parse_libsvm_lines_sparse,
+)
+from asyncframework_tpu.ops import gradients, steps
+from asyncframework_tpu.solvers import ASAGA, ASGD, SolverConfig
+
+
+def small_sparse(n=512, d=256, density=0.05, seed=0):
+    indptr, indices, values, y = make_sparse_regression(n, d, density, seed)
+    return indptr, indices, values, y
+
+
+class TestSparseData:
+    def test_parse_libsvm_sparse(self):
+        lines = ["1.0 3:2.5 7:1.0", "# comment", "-1 1:0.5"]
+        indptr, indices, values, y = parse_libsvm_lines_sparse(lines, 8)
+        assert list(indptr) == [0, 2, 3]
+        assert list(indices) == [2, 6, 0]  # 0-based
+        np.testing.assert_allclose(values, [2.5, 1.0, 0.5])
+        np.testing.assert_allclose(y, [1.0, -1.0])
+
+    def test_shards_padded_and_faithful(self, devices8):
+        indptr, indices, values, y = small_sparse()
+        ds = SparseShardedDataset(indptr, indices, values, y, 256, 8, devices8)
+        assert ds.n == 512 and ds.d == 256
+        s0 = ds.shard(0)
+        assert s0.cols.shape == s0.vals.shape
+        assert s0.cols.shape[1] % 8 == 0  # lane-padded
+        # densify reproduces the CSR rows
+        X, y2 = densify(ds)
+        np.testing.assert_allclose(y2, y)
+        i = 5  # spot-check one row
+        a, b = indptr[i], indptr[i + 1]
+        row = np.zeros(256, np.float32)
+        row[indices[a:b]] = values[a:b]
+        np.testing.assert_allclose(X[i], row)
+
+
+class TestSparseOps:
+    def test_sparse_grad_matches_dense(self, devices8):
+        indptr, indices, values, y = small_sparse(128, 64, 0.1, seed=3)
+        ds = SparseShardedDataset(indptr, indices, values, y, 64, 1, devices8[:1])
+        s = ds.shard(0)
+        rs = np.random.default_rng(1)
+        w = rs.normal(size=(64,)).astype(np.float32)
+        mask = (rs.random(128) < 0.5).astype(np.float32)
+        X, _ = densify(ds)
+
+        r = np.asarray(gradients.sparse_residual(s.cols, s.vals, s.y, w))
+        np.testing.assert_allclose(r, X @ w - y, rtol=1e-4, atol=1e-5)
+
+        grad_sum = gradients.make_sparse_grad_sum(64)
+        g = np.asarray(grad_sum(s.cols, s.vals, mask * r))
+        np.testing.assert_allclose(
+            g, X.T @ (mask * (X @ w - y)), rtol=1e-3, atol=1e-3
+        )
+
+    def test_sparse_saga_step_matches_dense_formula(self, devices8):
+        indptr, indices, values, y = small_sparse(64, 32, 0.2, seed=5)
+        ds = SparseShardedDataset(indptr, indices, values, y, 32, 1, devices8[:1])
+        s = ds.shard(0)
+        rs = np.random.default_rng(2)
+        w = rs.normal(size=(32,)).astype(np.float32)
+        alpha = rs.normal(size=(64,)).astype(np.float32)
+        step = steps.make_sparse_saga_worker_step(0.5, 32)
+        g, diff, mask, _ = step(s.cols, s.vals, s.y, w, alpha, jax.random.PRNGKey(0))
+        X, _ = densify(ds)
+        np.testing.assert_allclose(np.asarray(diff), X @ w - y, rtol=1e-4, atol=1e-5)
+        m = np.asarray(mask)
+        expect = X.T @ (m * ((X @ w - y) - alpha))
+        np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-3, atol=1e-3)
+
+
+class TestSparseSolvers:
+    def cfg(self, **kw):
+        defaults = dict(
+            num_workers=8, num_iterations=200, gamma=0.3,
+            taw=2**31 - 1, batch_rate=0.2, bucket_ratio=0.5,
+            printer_freq=50, coeff=0.0, seed=42,
+            calibration_iters=10, run_timeout_s=120.0,
+        )
+        defaults.update(kw)
+        return SolverConfig(**defaults)
+
+    def test_asgd_converges_47kdim_sparse(self, devices8):
+        # the VERDICT-prescribed shape: 47k dims at ~0.2% density
+        indptr, indices, values, y = make_sparse_regression(
+            2048, 47_236, density=0.002, seed=11
+        )
+        ds = SparseShardedDataset(
+            indptr, indices, values, y, 47_236, 8, devices8
+        )
+        res = ASGD(ds, None, self.cfg(gamma=0.5), devices=devices8).run()
+        assert res.accepted == 200
+        first, last = res.trajectory[0][1], res.trajectory[-1][1]
+        assert last < first * 0.7, res.trajectory
+
+    def test_asgd_sync_sparse(self, devices8):
+        indptr, indices, values, y = small_sparse(1024, 512, 0.01, seed=7)
+        ds = SparseShardedDataset(indptr, indices, values, y, 512, 8, devices8)
+        res = ASGD(ds, None, self.cfg(num_iterations=50, gamma=0.5),
+                   devices=devices8).run_sync()
+        assert res.rounds == 50
+        assert res.trajectory[-1][1] < res.trajectory[0][1]
+
+    def test_asaga_sparse_runs_and_converges(self, devices8):
+        indptr, indices, values, y = small_sparse(1024, 512, 0.01, seed=9)
+        ds = SparseShardedDataset(indptr, indices, values, y, 512, 8, devices8)
+        res = ASAGA(ds, None, self.cfg(num_iterations=150, gamma=0.05),
+                    devices=devices8).run()
+        assert res.accepted == 150
+        assert res.trajectory[-1][1] < res.trajectory[0][1]
+
+
+class TestSparseCLI:
+    def test_rcv1_shaped_recipe(self, capsys):
+        from asyncframework_tpu import cli
+
+        rc = cli.main([
+            "SparkASGDThread", "synthetic", "x", "47236", "1024", "8", "60",
+            "0.5", "2147483647", "0.2", "0.5", "20", "0", "42",
+            "--quiet", "--sparse",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        summary = json.loads(out[-1])
+        assert summary["accepted"] == 60
+        assert np.isfinite(summary["final_objective"])
+
+    def test_sparse_rejected_for_mllib(self):
+        from asyncframework_tpu import cli
+
+        with pytest.raises(SystemExit):
+            cli.main([
+                "sgd-mllib", "synthetic", "x", "64", "256", "8", "5",
+                "0.5", "0", "0.2", "0.5", "5", "0", "42", "--sparse",
+            ])

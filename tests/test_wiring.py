@@ -172,3 +172,31 @@ class TestStaleReadWiring:
         assert res.accepted == 200
         # stale model reads slow convergence but must not break it
         assert res.trajectory[-1][1] < res.trajectory[0][1]
+
+
+class TestHBMPlanWiring:
+    def test_oversized_problem_rejected_with_accounting(self, devices8, problem):
+        X, y, _ = problem
+        cfg = cfg_with(hbm_budget_bytes=1024)  # absurdly small budget
+        with pytest.raises(MemoryError, match="exceeds the"):
+            ASGD(X, y, cfg, devices=devices8)
+        with pytest.raises(MemoryError, match="exceeds the"):
+            ASAGA(X, y, cfg, devices=devices8)
+
+    def test_prebuilt_dataset_residency_measured(self, devices8):
+        from asyncframework_tpu.data import SparseShardedDataset, make_sparse_regression
+
+        indptr, indices, values, y = make_sparse_regression(512, 256, 0.05, 0)
+        ds = SparseShardedDataset(indptr, indices, values, y, 256, 8, devices8)
+        cfg = cfg_with(hbm_budget_bytes=1024)
+        with pytest.raises(MemoryError):
+            ASGD(ds, None, cfg, devices=devices8)
+        # a sane budget accepts the same dataset
+        ASGD(ds, None, cfg_with(hbm_budget_bytes=1 << 30), devices=devices8)
+
+    def test_asaga_stale_read_offset_run(self, devices8, problem):
+        X, y, _ = problem
+        cfg = cfg_with(num_iterations=100, gamma=0.05, stale_read_offset=2)
+        res = ASAGA(X, y, cfg, devices=devices8).run()
+        assert res.accepted == 100
+        assert res.trajectory[-1][1] < res.trajectory[0][1]
