@@ -128,7 +128,7 @@ def parse_libsvm_lines_sparse(
             indices.append(ki - 1)  # libsvm is 1-based
             values.append(float(v))
         indptr.append(len(indices))
-    d = num_features if num_features is not None else max_idx
+    del max_idx  # callers size d themselves (sharding requires explicit d)
     return (
         np.asarray(indptr, np.int64),
         np.asarray(indices, np.int32),

@@ -32,6 +32,15 @@ from asyncframework_tpu.ml.recommendation import ALS, ALSModel
 from asyncframework_tpu.ml.feature import MinMaxScaler, Normalizer, StandardScaler
 from asyncframework_tpu.ml.stat import ColStats, col_stats, corr
 
+from asyncframework_tpu.ml.bayes import NaiveBayes, NaiveBayesModel
+from asyncframework_tpu.ml.decomposition import PCA, PCAModel, svd
+from asyncframework_tpu.ml.evaluation import (
+    BinaryClassificationMetrics,
+    MulticlassMetrics,
+    RegressionMetrics,
+)
+from asyncframework_tpu.ml.tree import DecisionTree, DecisionTreeModel
+
 __all__ = [
     "ALS",
     "ALSModel",
@@ -57,4 +66,14 @@ __all__ = [
     "LinearSVM",
     "KMeans",
     "KMeansModel",
+    "NaiveBayes",
+    "NaiveBayesModel",
+    "PCA",
+    "PCAModel",
+    "svd",
+    "BinaryClassificationMetrics",
+    "RegressionMetrics",
+    "MulticlassMetrics",
+    "DecisionTree",
+    "DecisionTreeModel",
 ]

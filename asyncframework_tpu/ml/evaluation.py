@@ -1,0 +1,164 @@
+"""Evaluation metrics.
+
+Parity: ``mllib/src/main/scala/org/apache/spark/mllib/evaluation/`` --
+``BinaryClassificationMetrics.scala`` (ROC/AUC/PR by score thresholds),
+``RegressionMetrics.scala``, ``MulticlassMetrics.scala`` (confusion-matrix
+derived precision/recall/F1).
+
+TPU mapping: the reference computes these with sort-and-aggregate jobs over
+RDDs; here a metric is one device program -- sort by score (XLA sort),
+cumulative TP/FP (scan/cumsum), trapezoid AUC (one reduction).  Everything
+is O(n log n) on device with static shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------- binary
+@jax.jit
+def _roc_points(scores: jax.Array, labels: jax.Array):
+    """Sorted-by-score-descending cumulative TP/FP counts."""
+    order = jnp.argsort(-scores)
+    y = labels[order].astype(jnp.float32)
+    tp = jnp.cumsum(y)
+    fp = jnp.cumsum(1.0 - y)
+    return tp, fp, scores[order]
+
+
+class BinaryClassificationMetrics:
+    """AUC-ROC / AUC-PR / curves from (score, label in {0,1}) pairs.
+
+    Ties in scores are handled like the reference: threshold points are
+    taken at distinct score boundaries, so tied scores move as one block.
+    """
+
+    def __init__(self, scores, labels):
+        scores = jnp.asarray(scores, jnp.float32)
+        labels = jnp.asarray(labels, jnp.float32)
+        if scores.shape != labels.shape:
+            raise ValueError("scores and labels must have the same shape")
+        self._n = int(scores.shape[0])
+        tp, fp, sorted_scores = _roc_points(scores, labels)
+        # collapse tied scores: keep the LAST cumulative point of each block
+        s = np.asarray(sorted_scores)
+        tp = np.asarray(tp)
+        fp = np.asarray(fp)
+        is_boundary = np.ones(self._n, bool)
+        if self._n > 1:
+            is_boundary[:-1] = s[:-1] != s[1:]
+        self._tp = tp[is_boundary]
+        self._fp = fp[is_boundary]
+        self._thresholds = s[is_boundary]
+        self._p = float(tp[-1]) if self._n else 0.0
+        self._neg = float(fp[-1]) if self._n else 0.0
+
+    def roc(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(fpr, tpr) points, starting at (0,0) and ending at (1,1)."""
+        tpr = np.concatenate([[0.0], self._tp / max(self._p, 1e-12)])
+        fpr = np.concatenate([[0.0], self._fp / max(self._neg, 1e-12)])
+        return fpr, tpr
+
+    def area_under_roc(self) -> float:
+        fpr, tpr = self.roc()
+        return float(np.trapezoid(tpr, fpr))
+
+    def pr(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(recall, precision) points; first point (0, p0) like the
+        reference (precision of the highest-score block)."""
+        recall = self._tp / max(self._p, 1e-12)
+        precision = self._tp / np.maximum(self._tp + self._fp, 1e-12)
+        return (
+            np.concatenate([[0.0], recall]),
+            np.concatenate([[precision[0] if len(precision) else 1.0],
+                            precision]),
+        )
+
+    def area_under_pr(self) -> float:
+        recall, precision = self.pr()
+        return float(np.trapezoid(precision, recall))
+
+    def thresholds(self) -> np.ndarray:
+        return self._thresholds
+
+
+# ------------------------------------------------------------- regression
+@jax.jit
+def _regression_sums(pred, y):
+    err = pred - y
+    return (
+        jnp.sum(err * err),
+        jnp.sum(jnp.abs(err)),
+        jnp.sum(y),
+        jnp.sum(y * y),
+        jnp.sum(err),
+    )
+
+
+@dataclass(frozen=True)
+class RegressionMetrics:
+    """mse / rmse / mae / r2 / explained variance over (pred, label)."""
+
+    mean_squared_error: float
+    root_mean_squared_error: float
+    mean_absolute_error: float
+    r2: float
+    explained_variance: float
+
+    @classmethod
+    def of(cls, predictions, labels) -> "RegressionMetrics":
+        pred = jnp.asarray(predictions, jnp.float32)
+        y = jnp.asarray(labels, jnp.float32)
+        n = y.shape[0]
+        sse, sae, sy, syy, serr = (float(v) for v in _regression_sums(pred, y))
+        mse = sse / n
+        var_y = syy / n - (sy / n) ** 2
+        # explained variance: Var(y) - Var(err) (the reference's definition)
+        var_err = sse / n - (serr / n) ** 2
+        return cls(
+            mean_squared_error=mse,
+            root_mean_squared_error=float(np.sqrt(mse)),
+            mean_absolute_error=sae / n,
+            r2=1.0 - sse / max(n * var_y, 1e-12),
+            explained_variance=var_y - var_err,
+        )
+
+
+# -------------------------------------------------------------- multiclass
+class MulticlassMetrics:
+    """Confusion-matrix metrics over (prediction, label) integer pairs."""
+
+    def __init__(self, predictions, labels, num_classes: Optional[int] = None):
+        pred = np.asarray(predictions).astype(np.int64)
+        y = np.asarray(labels).astype(np.int64)
+        k = num_classes or int(max(pred.max(initial=0), y.max(initial=0))) + 1
+        cm = jnp.zeros((k, k), jnp.int32).at[y, pred].add(1)
+        self.confusion = np.asarray(cm)
+        self._k = k
+        self._n = len(y)
+
+    @property
+    def accuracy(self) -> float:
+        return float(np.trace(self.confusion)) / max(self._n, 1)
+
+    def precision(self, label: int) -> float:
+        col = self.confusion[:, label].sum()
+        return float(self.confusion[label, label]) / max(col, 1)
+
+    def recall(self, label: int) -> float:
+        row = self.confusion[label, :].sum()
+        return float(self.confusion[label, label]) / max(row, 1)
+
+    def f1(self, label: int) -> float:
+        p, r = self.precision(label), self.recall(label)
+        return 2 * p * r / max(p + r, 1e-12)
+
+    def weighted_f1(self) -> float:
+        weights = self.confusion.sum(axis=1) / max(self._n, 1)
+        return float(sum(w * self.f1(i) for i, w in enumerate(weights)))

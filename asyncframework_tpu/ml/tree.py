@@ -1,0 +1,245 @@
+"""Decision trees: histogram-based, level-wise, device-batched.
+
+Parity: ``mllib/src/main/scala/org/apache/spark/mllib/tree/DecisionTree.scala``
+-- the reference grows trees level by level; each level is one aggregation
+job computing per-(node, feature, bin) statistics over binned features
+(``findSplitsBins`` quantile binning, ``DTStatsAggregator``), then the
+driver picks best splits by impurity gain (gini/entropy/variance).
+
+TPU mapping: that per-level aggregation IS a scatter-add -- every sample
+contributes one count per feature into a flat (node, feature, bin, stat)
+histogram, which XLA compiles to a single static scatter kernel per level.
+The split search over the (tiny) histogram and the tree bookkeeping stay on
+the host, exactly like the reference's driver-side best-split loop.  Nodes
+live in a binary-heap layout (root 0, children 2i+1 / 2i+2) so the sample ->
+node assignment update is one vectorized gather/where per level.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantile_bins(X: np.ndarray, max_bins: int) -> np.ndarray:
+    """Per-feature split thresholds from quantiles (findSplitsBins parity).
+
+    Returns (F, max_bins - 1) thresholds; feature value v falls in bin
+    ``searchsorted(thresholds, v, 'left')`` (value <= threshold goes left).
+    """
+    X = np.asarray(X, np.float32)
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    thr = np.quantile(X, qs, axis=0).T.astype(np.float32)  # (F, B-1)
+    return thr
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def _class_histogram(bins, node_of, y, n_nodes, max_bins, num_classes):
+    """(n_nodes, F, B, C) class counts in one scatter-add."""
+    n, F = bins.shape
+    f_idx = jnp.arange(F)[None, :]
+    flat = (
+        (node_of[:, None] * F + f_idx) * max_bins + bins
+    ) * num_classes + y[:, None]
+    out = jnp.zeros(n_nodes * F * max_bins * num_classes, jnp.float32)
+    out = out.at[flat.ravel()].add(1.0)
+    return out.reshape(n_nodes, F, max_bins, num_classes)
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _reg_histogram(bins, node_of, y, n_nodes, max_bins):
+    """(n_nodes, F, B, 3) [count, sum, sumsq] in one scatter-add."""
+    n, F = bins.shape
+    f_idx = jnp.arange(F)[None, :]
+    flat = (node_of[:, None] * F + f_idx) * max_bins + bins
+    stats = jnp.stack(
+        [jnp.ones_like(y), y, y * y], axis=1
+    )  # (n, 3)
+    out = jnp.zeros((n_nodes * F * max_bins, 3), jnp.float32)
+    out = out.at[flat.ravel()].add(jnp.repeat(stats, F, axis=0))
+    return out.reshape(n_nodes, F, max_bins, 3)
+
+
+def _gini(counts: np.ndarray) -> np.ndarray:
+    """Gini impurity of (..., C) count stacks; 0 for empty."""
+    total = counts.sum(-1, keepdims=True)
+    p = counts / np.maximum(total, 1e-12)
+    return (1.0 - (p * p).sum(-1)) * (total[..., 0] > 0)
+
+
+@dataclass
+class DecisionTreeModel:
+    """Heap-layout arrays: node i's children are 2i+1 / 2i+2."""
+
+    feature: np.ndarray    # (n_nodes,) split feature, -1 at leaves
+    threshold: np.ndarray  # (n_nodes,) go left when x[f] <= thr
+    prediction: np.ndarray # (n_nodes,) class id or regression mean
+    depth: int
+    task: str
+
+    def predict(self, X) -> np.ndarray:
+        X = jnp.asarray(X, jnp.float32)
+        feat = jnp.asarray(self.feature)
+        thr = jnp.asarray(self.threshold)
+        node = jnp.zeros(X.shape[0], jnp.int32)
+
+        def step(_, node):
+            f = feat[node]
+            is_leaf = f < 0
+            x = jnp.take_along_axis(
+                X, jnp.maximum(f, 0)[:, None], axis=1
+            )[:, 0]
+            go_right = x > thr[node]
+            child = 2 * node + 1 + go_right.astype(jnp.int32)
+            return jnp.where(is_leaf, node, child)
+
+        node = jax.lax.fori_loop(0, self.depth, step, node)
+        pred = jnp.asarray(self.prediction)[node]
+        out = np.asarray(pred)
+        return out.astype(np.int64) if self.task == "classification" else out
+
+
+class DecisionTree:
+    """``DecisionTree.trainClassifier / trainRegressor`` analog."""
+
+    def __init__(
+        self,
+        task: str = "classification",
+        max_depth: int = 5,
+        max_bins: int = 32,
+        min_instances_per_node: int = 1,
+        min_info_gain: float = 0.0,
+        num_classes: Optional[int] = None,
+    ):
+        if task not in ("classification", "regression"):
+            raise ValueError("task must be classification or regression")
+        if max_depth < 1 or max_bins < 2:
+            raise ValueError("max_depth >= 1 and max_bins >= 2 required")
+        self.task = task
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_node = min_instances_per_node
+        self.min_gain = min_info_gain
+        self.num_classes = num_classes
+
+    def fit(self, X, y) -> DecisionTreeModel:
+        Xh = np.asarray(X, np.float32)
+        n, F = Xh.shape
+        thr_table = quantile_bins(Xh, self.max_bins)
+        bins_h = np.empty((n, F), np.int32)
+        for f in range(F):
+            bins_h[:, f] = np.searchsorted(thr_table[f], Xh[:, f], "left")
+        bins = jnp.asarray(bins_h)
+        B = self.max_bins
+
+        if self.task == "classification":
+            labels = np.asarray(y).astype(np.int32)
+            C = self.num_classes or int(labels.max()) + 1
+            y_dev = jnp.asarray(labels)
+        else:
+            y_dev = jnp.asarray(np.asarray(y, np.float32))
+
+        max_nodes = 2 ** (self.max_depth + 1) - 1
+        feature = np.full(max_nodes, -1, np.int32)
+        threshold = np.zeros(max_nodes, np.float32)
+        split_bin = np.zeros(max_nodes, np.int32)
+        prediction = np.zeros(max_nodes, np.float32)
+        node_of = jnp.zeros(n, jnp.int32)
+
+        level_start, level_size = 0, 1
+        for depth in range(self.max_depth + 1):
+            n_nodes_total = level_start + level_size
+            if self.task == "classification":
+                hist = np.asarray(_class_histogram(
+                    bins, node_of, y_dev, n_nodes_total, B, C
+                ))[level_start:]
+            else:
+                hist = np.asarray(_reg_histogram(
+                    bins, node_of, y_dev, n_nodes_total, B
+                ))[level_start:]
+
+            any_split = False
+            for li in range(level_size):
+                node = level_start + li
+                h = hist[li]  # (F, B, C) or (F, B, 3)
+                if self.task == "classification":
+                    node_counts = h.sum(axis=(0, 1)) / F  # per-class
+                    total = node_counts.sum()
+                    prediction[node] = float(np.argmax(node_counts))
+                    parent_imp = float(_gini(node_counts[None])[0])
+                else:
+                    node_stats = h.sum(axis=(0, 1)) / F  # [cnt, s, ss]
+                    total = node_stats[0]
+                    mean = node_stats[1] / max(total, 1e-12)
+                    prediction[node] = float(mean)
+                    parent_imp = float(
+                        node_stats[2] / max(total, 1e-12) - mean**2
+                    )
+                if (
+                    depth == self.max_depth
+                    or total < 2 * self.min_node
+                    or parent_imp <= 1e-12
+                ):
+                    continue  # stays a leaf (feature[node] == -1)
+
+                # vectorized best-split search over (F, B-1) candidates
+                left = np.cumsum(h, axis=1)[:, :-1]       # (F, B-1, S)
+                if self.task == "classification":
+                    right = h.sum(axis=1, keepdims=True) - left
+                    nl = left.sum(-1)
+                    nr = right.sum(-1)
+                    child = (
+                        nl * _gini(left) + nr * _gini(right)
+                    ) / max(total, 1e-12)
+                else:
+                    right = h.sum(axis=1, keepdims=True) - left
+                    nl, sl, ssl = left[..., 0], left[..., 1], left[..., 2]
+                    nr, sr, ssr = right[..., 0], right[..., 1], right[..., 2]
+                    vl = ssl / np.maximum(nl, 1e-12) - (
+                        sl / np.maximum(nl, 1e-12)
+                    ) ** 2
+                    vr = ssr / np.maximum(nr, 1e-12) - (
+                        sr / np.maximum(nr, 1e-12)
+                    ) ** 2
+                    child = (nl * vl + nr * vr) / max(total, 1e-12)
+                gain = parent_imp - child
+                ok = (nl >= self.min_node) & (nr >= self.min_node)
+                gain = np.where(ok, gain, -np.inf)
+                f_best, b_best = np.unravel_index(
+                    np.argmax(gain), gain.shape
+                )
+                if gain[f_best, b_best] <= self.min_gain:
+                    continue
+                feature[node] = f_best
+                threshold[node] = thr_table[f_best, b_best]
+                split_bin[node] = b_best
+                any_split = True
+
+            if not any_split:
+                break
+            # advance sample assignments through this level's splits
+            feat_dev = jnp.asarray(feature)
+            is_split = feat_dev >= 0
+            f_of = jnp.maximum(feat_dev, 0)
+            b_of_split = jnp.asarray(split_bin)
+            sample_bin = jnp.take_along_axis(
+                bins, f_of[node_of][:, None], axis=1
+            )[:, 0]
+            go_right = sample_bin > b_of_split[node_of]
+            child = 2 * node_of + 1 + go_right.astype(jnp.int32)
+            node_of = jnp.where(is_split[node_of], child, node_of)
+            level_start += level_size
+            level_size *= 2
+
+        return DecisionTreeModel(
+            feature=feature,
+            threshold=threshold,
+            prediction=prediction,
+            depth=self.max_depth,
+            task=self.task,
+        )

@@ -23,7 +23,6 @@ dispatches, not two transfers.
 from __future__ import annotations
 
 import queue
-import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -238,6 +237,7 @@ class ASGD:
 
         waiters: deque = deque(maxlen=4 * nw)  # recent jobs, failure check
         deadline = time.monotonic() + cfg.run_timeout_s
+        run_ok = False
         try:
             while not stop.is_set() and time.monotonic() < deadline:
                 failed = next((x.failed for x in waiters if x.failed), None)
@@ -288,6 +288,7 @@ class ASGD:
                 with state_lock:
                     state["rounds"] += 1
                 inst.on_round_submitted(state["rounds"], cohort, model_version)
+            run_ok = True
         finally:
             stop.set()
             upd.join(timeout=10)
@@ -296,7 +297,7 @@ class ASGD:
             if spec is not None:
                 spec.stop()
             sched.shutdown()
-            if sys.exc_info()[0] is not None:
+            if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
 
         elapsed = time.monotonic() - start_wall
@@ -376,6 +377,7 @@ class ASGD:
             return (time.monotonic() - start_wall) * 1e3
 
         rounds = 0
+        run_ok = False
         try:
             for k in range(cfg.num_iterations):
                 cohort = list(range(nw))
@@ -410,13 +412,14 @@ class ASGD:
                     snapshots.append((now_ms(), w))
                 if calibrator.maybe_finalize(k):
                     delay_model.calibrate(calibrator.avg_delay_ms)
+            run_ok = True
         finally:
             if ft is not None:
                 ft.stop()
             if spec is not None:
                 spec.stop()
             sched.shutdown()
-            if sys.exc_info()[0] is not None:
+            if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
 
         elapsed = time.monotonic() - start_wall

@@ -1,4 +1,15 @@
 from asyncframework_tpu.sql.expressions import Column, col, lit
 from asyncframework_tpu.sql.frame import ColumnarFrame
+from asyncframework_tpu.sql.io import (
+    read_csv,
+    read_json,
+    read_parquet,
+    write_csv,
+)
+from asyncframework_tpu.sql.parser import SQLContext, sql
 
-__all__ = ["ColumnarFrame", "Column", "col", "lit"]
+__all__ = [
+    "ColumnarFrame", "Column", "col", "lit",
+    "read_csv", "read_json", "read_parquet", "write_csv",
+    "SQLContext", "sql",
+]

@@ -161,18 +161,48 @@ class ColumnarFrame:
     def join(
         self, other: "ColumnarFrame", on: str, how: str = "inner"
     ) -> "ColumnarFrame":
-        """Equi-join on column ``on``; ``how`` in ('inner', 'left').
+        """Equi-join on column ``on``;
+        ``how`` in ('inner', 'left', 'right', 'full', 'semi', 'anti').
 
         Index build is a host-side sort/searchsorted (keys may be strings);
         the row materialization is device gathers.  Duplicate right keys
-        produce one output row per match, like SQL.  Left-join rows with no
-        match carry NaN in the right frame's float columns (other dtypes
+        produce one output row per match, like SQL.  Outer-join rows with no
+        match carry NaN in the other frame's float columns (other dtypes
         get 0/empty -- a columnar store has no NULL; document over invent).
+        ``semi``/``anti`` return only left columns: rows with >=1 match /
+        rows with none (no duplication), like Spark's LeftSemi/LeftAnti.
         """
-        if how not in ("inner", "left"):
-            raise ValueError("how must be 'inner' or 'left'")
+        if how == "right":
+            # a right join IS a left join with the frames swapped.  Colliding
+            # names must still follow the left-keeps-bare convention, so
+            # left's collisions are parked under temp names through the swap
+            # and the pair is renamed back afterwards.
+            collide = [
+                c for c in self.columns if c != on and c in other.columns
+            ]
+            lf = self.rename({c: f"__swap__{c}" for c in collide})
+            j = other.join(lf, on, "left")
+            j = j.rename(
+                {c: f"{c}_right" for c in collide}
+                | {f"__swap__{c}": c for c in collide}
+            )
+            order = [on] + [c for c in self.columns if c != on] + [
+                c for c in j.columns
+                if c not in self.columns and c != on
+            ]
+            return ColumnarFrame({c: j._cols[c] for c in order})
+        if how not in ("inner", "left", "full", "semi", "anti"):
+            raise ValueError(
+                "how must be one of inner/left/right/full/semi/anti"
+            )
         lk = np.asarray(self._cols[on])
         rk = np.asarray(other._cols[on])
+        if how in ("semi", "anti"):
+            r_sorted = np.sort(rk)
+            s = np.searchsorted(r_sorted, lk, "left")
+            e = np.searchsorted(r_sorted, lk, "right")
+            keep = (e > s) if how == "semi" else (e == s)
+            return self._take(np.where(keep)[0])
         r_order = np.argsort(rk, kind="stable")
         rk_sorted = rk[r_order]
         start = np.searchsorted(rk_sorted, lk, "left")
@@ -180,7 +210,8 @@ class ColumnarFrame:
         counts = end - start
         matched = counts > 0
         # expand: for left row i with c matches, right rows r_order[start_i..]
-        rep_counts = np.where(matched, counts, 1 if how == "left" else 0)
+        keep_left = how in ("left", "full")
+        rep_counts = np.where(matched, counts, 1 if keep_left else 0)
         left_idx = np.repeat(np.arange(len(lk)), rep_counts)
         total = int(rep_counts.sum())
         offs = np.arange(total) - np.repeat(
@@ -197,6 +228,7 @@ class ColumnarFrame:
             right_idx = np.zeros(total, np.intp)
 
         out: Dict[str, object] = {}
+        right_src: Dict[str, str] = {}  # out name -> original right column
         left_taken = self._take(left_idx)
         for name in self.columns:
             out[name] = left_taken._cols[name]
@@ -204,6 +236,7 @@ class ColumnarFrame:
             if name == on:
                 continue
             out_name = name if name not in out else f"{name}_right"
+            right_src[out_name] = name
             src = other._cols[name]
             if len(rk):
                 if isinstance(src, jnp.ndarray):
@@ -216,20 +249,59 @@ class ColumnarFrame:
                     if isinstance(src, jnp.ndarray)
                     else np.zeros(total, np.asarray(src).dtype)
                 )
-            if how == "left":
+            if keep_left:
                 # mask unmatched rows in EVERY right column: floats get NaN,
                 # other device dtypes 0, host (string/object) columns the
                 # dtype's zero ('' for strings) -- never row-0's real data
-                if isinstance(v, jnp.ndarray) and jnp.issubdtype(
-                    v.dtype, jnp.floating
-                ):
-                    v = jnp.where(jnp.asarray(has_match), v, jnp.nan)
-                elif isinstance(v, jnp.ndarray):
-                    v = jnp.where(jnp.asarray(has_match), v, 0)
-                else:
-                    v = np.where(has_match, v, np.zeros_like(v))
+                v = _mask_fill(v, has_match)
             out[out_name] = v
+
+        if how == "full":
+            # append right rows no left row matched, with left-column fills
+            r_hit = np.zeros(len(rk), bool)
+            if len(rk) and total:
+                r_hit[right_idx[has_match]] = True
+            miss = np.where(~r_hit)[0]
+            if len(miss):
+                none = np.zeros(len(miss), bool)
+                for name in list(out):
+                    cur = out[name]
+                    if name == on:
+                        extra = rk[miss]  # key survives from the right side
+                    elif name in right_src:
+                        src = other._cols[right_src[name]]
+                        extra = (
+                            jnp.take(src, jnp.asarray(miss), axis=0)
+                            if isinstance(src, jnp.ndarray)
+                            else np.asarray(src)[miss]
+                        )
+                    else:  # left-only column: all fills
+                        src = self._cols[name]
+                        extra = _mask_fill(
+                            jnp.zeros((len(miss),), src.dtype)
+                            if isinstance(src, jnp.ndarray)
+                            else np.zeros(len(miss), np.asarray(src).dtype),
+                            none,
+                        )
+                    if isinstance(cur, jnp.ndarray):
+                        out[name] = jnp.concatenate(
+                            [cur, jnp.asarray(extra, cur.dtype)]
+                        )
+                    else:
+                        out[name] = np.concatenate(
+                            [np.asarray(cur), np.asarray(extra)]
+                        )
         return ColumnarFrame(out)
+
+
+def _mask_fill(v, keep_mask: np.ndarray):
+    """NULL emulation for non-matching join rows: floats NaN, other device
+    dtypes 0, host columns the dtype's zero value."""
+    if isinstance(v, jnp.ndarray) and jnp.issubdtype(v.dtype, jnp.floating):
+        return jnp.where(jnp.asarray(keep_mask), v, jnp.nan)
+    if isinstance(v, jnp.ndarray):
+        return jnp.where(jnp.asarray(keep_mask), v, 0)
+    return np.where(keep_mask, v, np.zeros_like(v))
 
 
 class GroupedFrame:

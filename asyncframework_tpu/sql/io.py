@@ -1,0 +1,149 @@
+"""Data sources: CSV / JSON-lines / Parquet readers into ColumnarFrame.
+
+Parity: ``sql/core/src/main/scala/.../DataFrameReader.scala:64`` (the
+``spark.read.csv/json/parquet`` front door) and the format implementations
+under ``sql/core/.../execution/datasources/``.
+
+TPU-first mapping: a data source's job here is to land numeric columns as
+device arrays (ready for the fused expression DSL / segment aggregates) and
+keep string columns host-side.  CSV and JSON-lines are parsed natively
+(stdlib); Parquet rides pyarrow when present (the environment ships it) and
+fails with a clear message when not -- a columnar wire format needs a real
+decoder, and vendoring one would be padding, not capability.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import json as _json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from asyncframework_tpu.sql.frame import ColumnarFrame
+
+
+def _to_column(values: List[str], name: str):
+    """Infer int -> float -> string, with '' treated as missing (NaN for
+    floats; kept as '' for strings; promotes int columns to float)."""
+    has_missing = any(v == "" for v in values)
+    if not has_missing:
+        try:
+            return np.asarray([int(v) for v in values], np.int32)
+        except ValueError:
+            pass
+    try:
+        return np.asarray(
+            [float(v) if v != "" else np.nan for v in values], np.float32
+        )
+    except ValueError:
+        return np.asarray(values, dtype=object)
+
+
+def read_csv(
+    path: Union[str, Path],
+    header: bool = True,
+    columns: Optional[Sequence[str]] = None,
+    delimiter: str = ",",
+) -> ColumnarFrame:
+    """Load a CSV into a ColumnarFrame.
+
+    Numeric columns (int/float inference per column) become device arrays;
+    anything else stays a host string column.  ``columns`` overrides/provides
+    names (required when ``header=False``).
+    """
+    with open(path, newline="") as f:
+        reader = _csv.reader(f, delimiter=delimiter)
+        rows = [r for r in reader if r]
+    if not rows:
+        raise ValueError(f"{path}: empty CSV")
+    if header:
+        names = rows[0]
+        rows = rows[1:]
+    else:
+        if columns is None:
+            raise ValueError("header=False requires explicit column names")
+        names = list(columns)
+    if columns is not None and header:
+        names = list(columns)
+    width = len(names)
+    for i, r in enumerate(rows):
+        if len(r) != width:
+            raise ValueError(
+                f"{path}: row {i + 1} has {len(r)} fields, expected {width}"
+            )
+    cols: Dict[str, object] = {}
+    for j, name in enumerate(names):
+        cols[name] = _to_column([r[j] for r in rows], name)
+    return ColumnarFrame(cols)
+
+
+def read_json(path: Union[str, Path]) -> ColumnarFrame:
+    """JSON-lines (one object per line) into a ColumnarFrame; the schema is
+    the union of keys, missing values become NaN/''."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(_json.loads(line))
+    if not records:
+        raise ValueError(f"{path}: no records")
+    names: List[str] = []
+    for r in records:
+        for k in r:
+            if k not in names:
+                names.append(k)
+    cols: Dict[str, object] = {}
+    for name in names:
+        vals = [r.get(name) for r in records]
+        if all(isinstance(v, (int, float)) or v is None for v in vals):
+            arr = np.asarray(
+                [float(v) if v is not None else np.nan for v in vals],
+                np.float32,
+            )
+            if not np.isnan(arr).any() and np.all(arr == arr.astype(np.int32)):
+                arr = arr.astype(np.int32)
+            cols[name] = arr
+        else:
+            cols[name] = np.asarray(
+                ["" if v is None else str(v) for v in vals], dtype=object
+            )
+    return ColumnarFrame(cols)
+
+
+def read_parquet(
+    path: Union[str, Path], columns: Optional[Sequence[str]] = None
+) -> ColumnarFrame:
+    """Parquet into a ColumnarFrame via pyarrow."""
+    try:
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover - environment ships pyarrow
+        raise ImportError(
+            "read_parquet requires pyarrow; install it or convert the data "
+            "to CSV/JSON-lines for the native readers"
+        ) from e
+    table = pq.read_table(path, columns=list(columns) if columns else None)
+    cols: Dict[str, object] = {}
+    for name in table.column_names:
+        arr = table.column(name).to_numpy(zero_copy_only=False)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        elif arr.dtype == np.int64:
+            arr = arr.astype(np.int32)
+        elif not np.issubdtype(arr.dtype, np.number):
+            arr = arr.astype(object)
+        cols[name] = arr
+    return ColumnarFrame(cols)
+
+
+def write_csv(frame: ColumnarFrame, path: Union[str, Path]) -> None:
+    """Round-trip writer (tests / interchange)."""
+    names = frame.columns
+    host = {n: np.asarray(frame[n]) for n in names}
+    with open(path, "w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(names)
+        for i in range(len(frame)):
+            w.writerow([host[n][i] for n in names])

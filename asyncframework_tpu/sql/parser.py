@@ -1,0 +1,404 @@
+"""SQL text front door: a small parser lowering onto the ColumnarFrame DSL.
+
+Parity: the relational *front door* of the reference's SQL stack --
+``sql/catalyst/src/main/scala/.../parser/AstBuilder.scala`` (ANTLR AST ->
+logical plan) and ``SparkSession.sql``.  The reference needs 68k lines of
+catalyst because it plans lazy trees onto a shuffle engine with codegen;
+here the execution layer is the eager columnar frame (``sql/frame.py``)
+whose ops are already fused XLA kernels, so the front door reduces to:
+tokenize -> recursive-descent parse -> direct lowering.
+
+Supported surface (the queries the reference's examples actually run):
+
+    SELECT expr [AS name], ... | SELECT agg(expr), ...
+    FROM table [INNER|LEFT|RIGHT|FULL|SEMI|ANTI] JOIN table2 ON key
+    WHERE expr        -- arithmetic/comparison/AND/OR/NOT, strings, NULLs out
+    GROUP BY k        -- lowered to the device segment aggregates
+    ORDER BY c [ASC|DESC]
+    LIMIT n
+
+Aggregates: SUM, AVG, MEAN, MIN, MAX, COUNT(expr|*).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from asyncframework_tpu.sql.expressions import Column, col, lit
+from asyncframework_tpu.sql.frame import ColumnarFrame
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<num>\d+\.\d*|\.\d+|\d+)
+      | (?P<str>'(?:[^']|'')*')
+      | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+      | (?P<op><>|<=|>=|==|!=|[(),*+\-/%<>=.])
+    )""",
+    re.VERBOSE,
+)
+
+_AGG_FNS = {"SUM": "sum", "AVG": "mean", "MEAN": "mean", "MIN": "min",
+            "MAX": "max", "COUNT": "count"}
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "ORDER", "BY", "LIMIT", "AS",
+    "AND", "OR", "NOT", "JOIN", "ON", "INNER", "LEFT", "RIGHT", "FULL",
+    "OUTER", "SEMI", "ANTI", "ASC", "DESC",
+}
+
+
+def tokenize(text: str) -> List[str]:
+    out: List[str] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise ValueError(f"SQL syntax error near: {rest[:30]!r}")
+        pos = m.end()
+        tok = m.group().strip()
+        if tok:
+            out.append(tok)
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: List[str]):
+        self.toks = tokens
+        self.i = 0
+
+    # ------------------------------------------------------------- utilities
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def peek_upper(self) -> Optional[str]:
+        t = self.peek()
+        return t.upper() if t is not None else None
+
+    def next(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of SQL")
+        self.i += 1
+        return t
+
+    def accept(self, kw: str) -> bool:
+        if self.peek_upper() == kw:
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, kw: str) -> None:
+        t = self.next()
+        if t.upper() != kw:
+            raise ValueError(f"expected {kw}, got {t!r}")
+
+    def ident(self) -> str:
+        t = self.next()
+        if not re.fullmatch(r"[A-Za-z_][A-Za-z_0-9]*", t):
+            raise ValueError(f"expected identifier, got {t!r}")
+        return t
+
+    # ------------------------------------------------------------ expressions
+    def expr(self) -> Column:
+        return self._or()
+
+    def _or(self) -> Column:
+        e = self._and()
+        while self.accept("OR"):
+            e = e | self._and()
+        return e
+
+    def _and(self) -> Column:
+        e = self._not()
+        while self.accept("AND"):
+            e = e & self._not()
+        return e
+
+    def _not(self) -> Column:
+        if self.accept("NOT"):
+            return ~self._not()
+        return self._cmp()
+
+    def _cmp(self) -> Column:
+        e = self._add()
+        op = self.peek()
+        if op in ("=", "==", "!=", "<>", "<", "<=", ">", ">="):
+            self.next()
+            rhs = self._add()
+            if op in ("=", "=="):
+                return e == rhs
+            if op in ("!=", "<>"):
+                return e != rhs
+            return {"<": e < rhs, "<=": e <= rhs,
+                    ">": e > rhs, ">=": e >= rhs}[op]
+        return e
+
+    def _add(self) -> Column:
+        e = self._mul()
+        while self.peek() in ("+", "-"):
+            if self.next() == "+":
+                e = e + self._mul()
+            else:
+                e = e - self._mul()
+        return e
+
+    def _mul(self) -> Column:
+        e = self._unary()
+        while self.peek() in ("*", "/", "%"):
+            op = self.next()
+            rhs = self._unary()
+            e = e * rhs if op == "*" else (
+                e / rhs if op == "/" else e % rhs
+            )
+        return e
+
+    def _unary(self) -> Column:
+        if self.peek() == "-":
+            self.next()
+            return -self._unary()
+        return self._primary()
+
+    def _primary(self) -> Column:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of expression")
+        if t == "(":
+            self.next()
+            e = self.expr()
+            self.expect(")")
+            return e
+        if re.fullmatch(r"\d+\.\d*|\.\d+|\d+", t):
+            self.next()
+            return lit(float(t) if ("." in t) else int(t))
+        if t.startswith("'"):
+            self.next()
+            return lit(t[1:-1].replace("''", "'"))
+        name = self.ident()
+        if name.upper() in _KEYWORDS:
+            raise ValueError(f"unexpected keyword {name!r} in expression")
+        # qualified name t.c: the frame is flat, keep the column part
+        if self.peek() == ".":
+            self.next()
+            name = self.ident()
+        return col(name)
+
+    # --------------------------------------------------------------- clauses
+    def select_items(self) -> List[Tuple[str, Any]]:
+        """[(kind, payload)]: ('star', None) | ('agg', (fn, colname, out))
+        | ('expr', (Column, out))."""
+        items: List[Tuple[str, Any]] = []
+        while True:
+            if self.peek() == "*":
+                self.next()
+                items.append(("star", None))
+            elif (
+                self.peek_upper() in _AGG_FNS
+                and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1] == "("
+            ):
+                fn = _AGG_FNS[self.next().upper()]
+                self.expect("(")
+                if self.peek() == "*":
+                    self.next()
+                    arg = None
+                else:
+                    arg = self.ident()
+                    if self.peek() == ".":
+                        self.next()
+                        arg = self.ident()
+                self.expect(")")
+                out = f"{fn}({arg or '*'})"
+                if self.accept("AS"):
+                    out = self.ident()
+                items.append(("agg", (fn, arg, out)))
+            else:
+                start = self.i
+                e = self.expr()
+                out = e.name
+                # a bare column reference keeps its own name
+                if self.i == start + 1:
+                    out = self.toks[start]
+                elif self.i == start + 3 and self.toks[start + 1] == ".":
+                    out = self.toks[start + 2]
+                if self.accept("AS"):
+                    out = self.ident()
+                items.append(("expr", (e, out)))
+            if not self.accept(","):
+                return items
+
+
+class SQLContext:
+    """Table registry + ``sql()`` entry point (SparkSession.sql analog)."""
+
+    def __init__(self):
+        self._tables: Dict[str, ColumnarFrame] = {}
+
+    def register(self, name: str, frame: ColumnarFrame) -> None:
+        """``createOrReplaceTempView`` analog."""
+        self._tables[name.lower()] = frame
+
+    def table(self, name: str) -> ColumnarFrame:
+        key = name.lower()
+        if key not in self._tables:
+            raise KeyError(
+                f"no table {name!r}; registered: {sorted(self._tables)}"
+            )
+        return self._tables[key]
+
+    # ----------------------------------------------------------------- query
+    def sql(self, text: str) -> ColumnarFrame:
+        p = _Parser(tokenize(text))
+        p.expect("SELECT")
+        items = p.select_items()
+        p.expect("FROM")
+        frame = self.table(p.ident())
+
+        # joins
+        while True:
+            how = "inner"
+            if p.peek_upper() in ("INNER", "LEFT", "RIGHT", "FULL",
+                                  "SEMI", "ANTI"):
+                how = p.next().lower()
+                p.accept("OUTER")
+                p.expect("JOIN")
+            elif p.peek_upper() == "JOIN":
+                p.next()
+            else:
+                break
+            right = self.table(p.ident())
+            p.expect("ON")
+            k1 = p.ident()
+            if p.peek() == ".":
+                p.next()
+                k1 = p.ident()
+            key = k1
+            if p.accept("="):
+                k2 = p.ident()
+                if p.peek() == ".":
+                    p.next()
+                    k2 = p.ident()
+                if k2 != k1:
+                    raise ValueError(
+                        f"equi-join keys must share a name: {k1!r} != {k2!r}"
+                    )
+            frame = frame.join(right, on=key, how=how)
+
+        if p.accept("WHERE"):
+            frame = frame.filter(p.expr())
+
+        group_key = None
+        if p.accept("GROUP"):
+            p.expect("BY")
+            group_key = p.ident()
+
+        order_by = None
+        ascending = True
+        if p.accept("ORDER"):
+            p.expect("BY")
+            order_by = p.ident()
+            if p.accept("DESC"):
+                ascending = False
+            else:
+                p.accept("ASC")
+
+        limit = None
+        if p.accept("LIMIT"):
+            limit = int(p.next())
+
+        if p.peek() is not None:
+            raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
+
+        frame = self._project(frame, items, group_key)
+        if order_by is not None:
+            frame = frame.sort(order_by, ascending=ascending)
+        if limit is not None:
+            frame = _limit(frame, limit)
+        return frame
+
+    # ---------------------------------------------------------------- lowering
+    def _project(self, frame, items, group_key):
+        aggs = [it for kind, it in items if kind == "agg"]
+        exprs = [(e, name) for kind, (e, name) in (
+            (k, v) for k, v in items if k == "expr"
+        )]
+        has_star = any(kind == "star" for kind, _ in items)
+
+        if group_key is not None:
+            # SELECT key?, aggs FROM ... GROUP BY key
+            if has_star:
+                raise ValueError(
+                    "SELECT * is not valid with GROUP BY; name the "
+                    "group key and aggregates explicitly"
+                )
+            for e, name in exprs:
+                if name != group_key:
+                    raise ValueError(
+                        "non-aggregate select item "
+                        f"{name!r} must be the GROUP BY key"
+                    )
+            gb = frame.groupby(group_key)
+            spec = {}
+            for fn, arg, out in aggs:
+                if arg is None:  # COUNT(*): count over any device column
+                    arg = _any_device_column(frame)
+                    fn = "count"
+                spec[out] = (arg, fn)
+            if not spec:
+                return gb.count()
+            return gb.agg(**spec)
+
+        if aggs:
+            if exprs or has_star:
+                raise ValueError(
+                    "mixing aggregates and plain columns needs GROUP BY"
+                )
+            spec = {}
+            for fn, arg, out in aggs:
+                if arg is None:
+                    arg = _any_device_column(frame)
+                    fn = "count"
+                spec[out] = (arg, fn)
+            scalars = frame.agg(**spec)
+            return ColumnarFrame(
+                {k: np.asarray([v]) for k, v in scalars.items()}
+            )
+
+        if has_star and not exprs:
+            return frame
+        if has_star:
+            sel = list(frame.columns) + [
+                e.alias(name) for e, name in exprs
+                if name not in frame.columns
+            ]
+            return frame.select(*sel)
+        return frame.select(*[e.alias(name) for e, name in exprs])
+
+
+def _any_device_column(frame: ColumnarFrame) -> str:
+    import jax.numpy as jnp
+
+    for name in frame.columns:
+        if isinstance(frame[name], jnp.ndarray):
+            return name
+    raise ValueError("COUNT(*) needs at least one numeric column")
+
+
+def _limit(frame: ColumnarFrame, n: int) -> ColumnarFrame:
+    return frame._take(np.arange(min(n, len(frame))))
+
+
+def self_rest(p: _Parser) -> str:
+    return " ".join(p.toks[p.i : p.i + 8])
+
+
+def sql(text: str, **tables: ColumnarFrame) -> ColumnarFrame:
+    """One-shot convenience: ``sql("SELECT ...", t=frame)``."""
+    ctx = SQLContext()
+    for name, frame in tables.items():
+        ctx.register(name, frame)
+    return ctx.sql(text)

@@ -135,12 +135,15 @@ class StreamingContext:
         return QueueStream(self, batches, wal=wal)
 
     def recovered_stream(
-        self, wal: WriteAheadLog, after_ms: int = 0
+        self, wal: WriteAheadLog, after_ms: Optional[int] = None
     ) -> QueueStream:
         """Re-emit batches recorded in a write-ahead log (restart recovery:
         the reference replays WAL-backed blocks after driver failure).
         ``after_ms`` skips batches already folded into a restored state
-        checkpoint (pass ``restore_state()``'s return value)."""
+        checkpoint (pass ``restore_state()``'s return value; ``None`` -- a
+        cold start -- replays everything, including a t=0 batch)."""
+        if after_ms is None:
+            return QueueStream(self, [b for (_t, b) in wal.replay()])
         return QueueStream(
             self, [b for (t, b) in wal.replay() if t > after_ms]
         )

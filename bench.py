@@ -26,8 +26,11 @@ Output: ONE json line {"metric", "value", "unit", "vs_baseline"};
 vs_baseline > 1 means faster than the reference estimate.
 """
 
+import faulthandler
 import json
+import os
 import sys
+import threading
 import time
 import traceback
 
@@ -44,6 +47,27 @@ NUM_WORKERS = 8
 BASELINE_S = 120.0  # below the 200 s recipe-derived lower bound; BASELINE.md
 TARGET_FRACTION = 0.01
 BACKEND_INIT_BUDGET_S = 360.0  # total retry budget for flaky TPU backend init
+RUN_TIMEOUT_S = 240.0          # solver-internal deadline
+WATCHDOG_S = 600.0             # hard kill: a dead device link can block a
+                               # device op forever (threads stuck in C code)
+
+
+def arm_watchdog() -> None:
+    """Emit a parseable failure line and hard-exit if the process wedges
+    (e.g. the host<->TPU tunnel dies mid-run and block_until_ready never
+    returns -- observed in round 2).  ``os._exit`` on purpose: stuck C calls
+    do not honor normal interpreter shutdown."""
+    faulthandler.dump_traceback_later(WATCHDOG_S - 30, file=sys.stderr)
+
+    def fire():
+        emit(0.0, "s (WATCHDOG: process wedged past "
+             f"{WATCHDOG_S:.0f}s; see stderr traceback)", 0.0)
+        sys.stdout.flush()
+        os._exit(0)
+
+    t = threading.Timer(WATCHDOG_S, fire)
+    t.daemon = True
+    t.start()
 
 
 def emit(value: float, unit: str, vs_baseline: float) -> None:
@@ -112,7 +136,7 @@ def main() -> None:
         coeff=0.0,
         seed=42,
         calibration_iters=100,
-        run_timeout_s=600.0,
+        run_timeout_s=RUN_TIMEOUT_S,
     )
     solver = ASGD(ds, None, cfg, devices=devices)
 
@@ -154,6 +178,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    arm_watchdog()
     try:
         main()
     except Exception as e:

@@ -313,3 +313,16 @@ class TestStreamingStateCheckpoint:
         # restored tuple key merged with the new value, not duplicated
         assert out[0][("u1", "home")] == 6
         assert out[0][("u2", "cart")] == 2
+
+    def test_cold_recovery_replays_time_zero_batch(self, tmp_path):
+        with WriteAheadLog(tmp_path / "wal0") as wal:
+            wal.append(0, ["first"])
+            wal.append(100, ["second"])
+        ssc = StreamingContext(batch_interval_ms=100)
+        with WriteAheadLog(tmp_path / "wal0") as wal2:
+            rec = ssc.recovered_stream(wal2)  # cold start: replay everything
+            out = []
+            rec.foreach_batch(lambda t, b: out.append(list(b)))
+            ssc.generate_batch(100)
+            ssc.generate_batch(200)
+        assert out == [["first"], ["second"]]
