@@ -288,6 +288,112 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
             self.scheduler, {wid: indexer(wid) for wid in self._parts}
         )
 
+    def glom(self) -> "DistributedDataset[List[E]]":
+        """``RDD.glom`` parity: each partition becomes one list element."""
+        return self.map_partitions(lambda xs: [xs])
+
+    def key_by(self, f: Callable[[E], Any]) -> "DistributedDataset":
+        """``RDD.keyBy`` parity: element -> (f(element), element)."""
+        return self.map(lambda x: (f(x), x))
+
+    def coalesce(self, num_partitions: int) -> "DistributedDataset[E]":
+        """``RDD.coalesce(n)`` parity (shuffle=false spirit): adjacent
+        partitions concatenate into ``num_partitions`` groups, preserving
+        element order; growing the partition count requires a reshuffle
+        (use :meth:`partition_by` on keyed data)."""
+        ids = self.partition_ids()
+        if num_partitions < 1:
+            raise ValueError("num_partitions must be >= 1")
+        if num_partitions >= len(ids):
+            return self
+        groups: Dict[int, List[int]] = {i: [] for i in range(num_partitions)}
+        for j, wid in enumerate(ids):
+            groups[j * num_partitions // len(ids)].append(wid)
+
+        def compute_group(members):
+            def run(ms=tuple(members)):
+                out: List[E] = []
+                for w in ms:
+                    out.extend(self._compute(w))
+                return out
+
+            return run
+
+        return DistributedDataset(
+            self.scheduler,
+            {i: compute_group(m) for i, m in groups.items()},
+        )
+
+    def sort_by(
+        self, key: Callable[[E], Any], ascending: bool = True,
+        num_partitions: Optional[int] = None,
+    ) -> "DistributedDataset[E]":
+        """``RDD.sortBy`` parity, riding the pair layer's range-partitioned
+        ``sort_by_key``."""
+        return self.key_by(key).sort_by_key(
+            ascending=ascending, num_partitions=num_partitions
+        ).values()
+
+    def count_by_value(self) -> Dict[E, int]:
+        """``RDD.countByValue`` parity (driver-side dict)."""
+        return self.map(lambda x: (x, 1)).count_by_key()
+
+    def fold(self, zero: E, op: Callable[[E, E], E]) -> E:
+        """``RDD.fold`` parity: like reduce with a per-partition zero."""
+        return self.aggregate(zero, op, op)
+
+    def top(self, n: int, key: Optional[Callable[[E], Any]] = None) -> List[E]:
+        """``RDD.top`` parity: n largest, descending (per-partition heads
+        combined on the driver)."""
+        import heapq
+
+        k = key or (lambda x: x)
+        per = self._run_sync(
+            lambda wid: (
+                lambda w=wid: heapq.nlargest(n, self._compute(w), key=k)
+            )
+        )
+        allv = [x for wid in self.partition_ids() for x in per[wid]]
+        return heapq.nlargest(n, allv, key=k)
+
+    def take_ordered(
+        self, n: int, key: Optional[Callable[[E], Any]] = None
+    ) -> List[E]:
+        """``RDD.takeOrdered`` parity: n smallest, ascending."""
+        import heapq
+
+        k = key or (lambda x: x)
+        per = self._run_sync(
+            lambda wid: (
+                lambda w=wid: heapq.nsmallest(n, self._compute(w), key=k)
+            )
+        )
+        allv = [x for wid in self.partition_ids() for x in per[wid]]
+        return heapq.nsmallest(n, allv, key=k)
+
+    def subtract(self, other: "DistributedDataset[E]") -> "DistributedDataset[E]":
+        """``RDD.subtract`` parity: elements of self not present in other
+        (duplicates of surviving elements are preserved, like the
+        reference's cogroup formulation)."""
+        gone = set(other.distinct().collect())
+        return self.filter(lambda x: x not in gone)
+
+    def intersection(
+        self, other: "DistributedDataset[E]"
+    ) -> "DistributedDataset[E]":
+        """``RDD.intersection`` parity: distinct elements present in both."""
+        have = set(other.distinct().collect())
+        return self.distinct().filter(lambda x: x in have)
+
+    def cartesian(
+        self, other: "DistributedDataset[U]"
+    ) -> "DistributedDataset[Tuple[E, U]]":
+        """``RDD.cartesian`` parity: partition (i) pairs with the WHOLE other
+        dataset (the reference builds p*q partitions; worker-pinned
+        partitions keep self's layout and broadcast other's rows)."""
+        other_all = other.collect()
+        return self.flat_map(lambda x: [(x, ygg) for ygg in other_all])
+
     def barrier(
         self,
         ctx: AsyncContext,

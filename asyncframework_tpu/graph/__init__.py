@@ -1,5 +1,16 @@
 from asyncframework_tpu.graph.graph import Graph
 from asyncframework_tpu.graph.pregel import pregel
-from asyncframework_tpu.graph.algorithms import connected_components, pagerank
+from asyncframework_tpu.graph.algorithms import (
+    connected_components,
+    label_propagation,
+    pagerank,
+    partition_edges,
+    shortest_paths,
+    triangle_count,
+)
 
-__all__ = ["Graph", "pregel", "pagerank", "connected_components"]
+__all__ = [
+    "Graph", "pregel", "pagerank", "connected_components",
+    "triangle_count", "label_propagation", "shortest_paths",
+    "partition_edges",
+]

@@ -72,3 +72,134 @@ def connected_components(graph: Graph, max_iterations: int = 100) -> jnp.ndarray
         g2, labels0, vprog, send_msg, merge="min",
         max_iterations=max_iterations,
     )
+
+
+def triangle_count(graph: Graph) -> jnp.ndarray:
+    """Per-vertex triangle counts (GraphX ``TriangleCount.scala`` semantics:
+    the graph is canonicalized -- undirected, deduped, no self loops).
+
+    TPU-first: the reference intersects per-vertex neighbor sets through a
+    shuffle; here the graph is materialized as a dense 0/1 adjacency matrix
+    and counted with matmuls on the MXU -- ``count_v = (A @ A * A).sum(row)/2``
+    counts, for each edge (v,u), the common neighbors of v and u.  O(n^2)
+    memory by design: the dense regime (n up to ~2^14, 1 GB HBM at f32)
+    covers the reference's own benchmark graphs; larger graphs shard A's
+    rows over the mesh.
+    """
+    n = graph.num_vertices
+    src, dst = graph.src, graph.dst
+    keep = src != dst  # drop self loops
+    A = jnp.zeros((n, n), jnp.float32)
+    A = A.at[src, dst].max(jnp.where(keep, 1.0, 0.0))
+    A = jnp.maximum(A, A.T)  # canonical undirected, deduped
+    common = (A @ A) * A
+    return (common.sum(axis=1) / 2).astype(jnp.int32)
+
+
+def label_propagation(graph: Graph, max_iterations: int = 10) -> jnp.ndarray:
+    """Community detection by synchronous label propagation (GraphX
+    ``LabelPropagation.scala``): every step each vertex adopts the most
+    frequent label among its neighbors (ties -> smallest label, a
+    deterministic refinement of the reference's map-ordering tie).
+
+    Dense label-histogram formulation: labels live in ``0..n-1``, so one
+    scatter-add builds the (n, n) neighbor-label histogram per step --
+    O(n^2) memory, same regime note as :func:`triangle_count`.
+    """
+    n = graph.num_vertices
+    src = jnp.concatenate([graph.src, graph.dst])
+    dst = jnp.concatenate([graph.dst, graph.src])
+    labels = jnp.arange(n, dtype=jnp.int32)
+
+    def step(_, labels):
+        hist = jnp.zeros((n, n), jnp.int32).at[dst, labels[src]].add(1)
+        # most frequent neighbor label; ties break to the SMALLEST label
+        # (argmax returns the first maximum)
+        best = jnp.argmax(hist, axis=1).astype(jnp.int32)
+        has_neighbors = hist.sum(axis=1) > 0
+        return jnp.where(has_neighbors, best, labels)
+
+    import jax
+
+    return jax.lax.fori_loop(0, max_iterations, step, labels)
+
+
+def shortest_paths(
+    graph: Graph, landmarks, max_iterations: int = 50
+) -> jnp.ndarray:
+    """Hop-count distances from every vertex to each landmark (GraphX
+    ``ShortestPaths.scala``).  Returns (n, L) float32 with ``inf`` for
+    unreachable pairs.  One Pregel run with a vector vertex attribute:
+    the per-edge message is ``dist[src] + 1`` and the merge is ``min`` --
+    the min-plus semiring ridden by a segment-min.
+    """
+    n = graph.num_vertices
+    lms = jnp.asarray(landmarks, jnp.int32)
+    L = int(lms.shape[0])
+    # undirected hop counts: propagate along both edge directions
+    g2 = Graph(
+        jnp.concatenate([graph.dst, graph.src]),
+        jnp.concatenate([graph.src, graph.dst]),
+        n,
+    )
+    d0 = jnp.full((n, L), jnp.inf, jnp.float32)
+    d0 = d0.at[lms, jnp.arange(L)].set(0.0)
+
+    def vprog(d, incoming):
+        return jnp.minimum(d, incoming)
+
+    def send_msg(src_d, dst_d, _e):
+        return src_d + 1.0
+
+    return pregel(
+        g2, d0, vprog, send_msg, merge="min",
+        max_iterations=max_iterations,
+    )
+
+
+# ------------------------------------------------------------- partitioning
+def partition_edges(
+    graph: Graph, num_partitions: int, strategy: str = "edge_2d"
+) -> jnp.ndarray:
+    """Edge -> partition assignment (GraphX ``PartitionStrategy.scala``).
+
+    Strategies: ``edge_1d`` (hash src -- co-locates out-edges),
+    ``edge_2d`` (sqrt-grid block of (src, dst) -- bounds vertex replication
+    by 2*sqrt(p)), ``random_vertex_cut`` (hash of the ordered pair),
+    ``canonical_random_vertex_cut`` (hash of the sorted pair, so both
+    directions of an undirected edge land together).  Deterministic: a
+    mixed-congruential integer hash, no process salt.
+    """
+    if num_partitions < 1:
+        raise ValueError("num_partitions must be >= 1")
+    src = graph.src.astype(jnp.uint32)
+    dst = graph.dst.astype(jnp.uint32)
+    p = jnp.uint32(num_partitions)
+
+    def mix(x):
+        # xorshift-multiply mix (splitmix-style), stable across runs
+        x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+        x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+        return x ^ (x >> 16)
+
+    if strategy == "edge_1d":
+        out = mix(src) % p
+    elif strategy == "edge_2d":
+        import math
+
+        side = int(math.ceil(math.sqrt(num_partitions)))
+        col = mix(src) % jnp.uint32(side)
+        row = mix(dst) % jnp.uint32(side)
+        out = (col * jnp.uint32(side) + row) % p
+    elif strategy == "random_vertex_cut":
+        out = mix(src * jnp.uint32(0x9E3779B1) ^ dst) % p
+    elif strategy == "canonical_random_vertex_cut":
+        lo = jnp.minimum(src, dst)
+        hi = jnp.maximum(src, dst)
+        out = mix(lo * jnp.uint32(0x9E3779B1) ^ hi) % p
+    else:
+        raise ValueError(
+            "strategy must be edge_1d / edge_2d / random_vertex_cut / "
+            "canonical_random_vertex_cut"
+        )
+    return out.astype(jnp.int32)

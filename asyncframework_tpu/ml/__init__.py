@@ -40,6 +40,9 @@ from asyncframework_tpu.ml.evaluation import (
     RegressionMetrics,
 )
 from asyncframework_tpu.ml.tree import DecisionTree, DecisionTreeModel
+from asyncframework_tpu.ml.forest import RandomForest, RandomForestModel
+from asyncframework_tpu.ml.mixture import GaussianMixture, GaussianMixtureModel
+from asyncframework_tpu.ml.fpm import FPGrowth, FPGrowthModel, Rule
 
 __all__ = [
     "ALS",
@@ -76,4 +79,11 @@ __all__ = [
     "MulticlassMetrics",
     "DecisionTree",
     "DecisionTreeModel",
+    "RandomForest",
+    "RandomForestModel",
+    "GaussianMixture",
+    "GaussianMixtureModel",
+    "FPGrowth",
+    "FPGrowthModel",
+    "Rule",
 ]

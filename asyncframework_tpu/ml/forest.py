@@ -1,0 +1,108 @@
+"""Random forests: bagged histogram trees.
+
+Parity: ``mllib/src/main/scala/org/apache/spark/mllib/tree/RandomForest.scala``
+-- bootstrap-sampled training sets, per-tree feature subsampling
+(``featureSubsetStrategy``), majority vote (classification) / mean
+(regression).
+
+TPU mapping: each member is this framework's histogram
+:class:`~asyncframework_tpu.ml.tree.DecisionTree` (device scatter-add
+levels); bagging reuses the same binned design, so a forest is T sequential
+device-accelerated tree fits.  (The reference trains groups of trees in one
+pass over the data; with the per-level aggregation already a single device
+op, per-tree passes are the simpler schedule at this scale.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from asyncframework_tpu.ml.tree import DecisionTree, DecisionTreeModel
+
+
+@dataclass
+class RandomForestModel:
+    trees: List[DecisionTreeModel]
+    task: str
+    num_classes: int
+
+    def predict(self, X) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        votes = [t.predict(X) for t in self.trees]
+        stack = np.stack(votes)
+        if self.task == "regression":
+            return stack.mean(axis=0)
+        # majority vote via per-row bincount
+        counts = np.zeros((X.shape[0], self.num_classes), np.int32)
+        rows = np.arange(X.shape[0])
+        for v in votes:
+            counts[rows, v.astype(np.int64)] += 1
+        return counts.argmax(axis=1)
+
+
+class RandomForest:
+    """``RandomForest.trainClassifier / trainRegressor`` analog."""
+
+    def __init__(
+        self,
+        task: str = "classification",
+        num_trees: int = 10,
+        max_depth: int = 5,
+        max_bins: int = 32,
+        feature_subset_strategy: str = "auto",
+        seed: int = 0,
+        num_classes: Optional[int] = None,
+    ):
+        if num_trees < 1:
+            raise ValueError("num_trees must be >= 1")
+        self.task = task
+        self.num_trees = num_trees
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.strategy = feature_subset_strategy
+        self.seed = seed
+        self.num_classes = num_classes
+
+    def _subset_size(self, F: int) -> int:
+        # featureSubsetStrategy defaults: sqrt for classification,
+        # one-third for regression ("auto" in the reference)
+        if self.strategy == "all":
+            return F
+        if self.strategy == "sqrt":
+            return max(1, int(np.sqrt(F)))
+        if self.strategy == "onethird":
+            return max(1, F // 3)
+        if self.strategy == "auto":
+            return (
+                max(1, int(np.sqrt(F)))
+                if self.task == "classification"
+                else max(1, F // 3)
+            )
+        raise ValueError("feature_subset_strategy: auto/all/sqrt/onethird")
+
+    def fit(self, X, y) -> RandomForestModel:
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        n, F = X.shape
+        m = self._subset_size(F)
+        rs = np.random.default_rng(self.seed)
+        if self.task == "classification":
+            C = self.num_classes or int(y.max()) + 1
+        else:
+            C = 0
+        trees: List[DecisionTreeModel] = []
+        for t_idx in range(self.num_trees):
+            rows = rs.integers(0, n, n)           # bootstrap sample
+            tree = DecisionTree(
+                task=self.task,
+                max_depth=self.max_depth,
+                max_bins=self.max_bins,
+                num_classes=C or None,
+                feature_subset=m if m < F else None,  # per-NODE sampling
+                seed=self.seed + 1000 * t_idx,
+            ).fit(X[rows], y[rows])
+            trees.append(tree)
+        return RandomForestModel(trees=trees, task=self.task, num_classes=C)

@@ -115,7 +115,12 @@ class DecisionTree:
         min_instances_per_node: int = 1,
         min_info_gain: float = 0.0,
         num_classes: Optional[int] = None,
+        feature_subset: Optional[int] = None,
+        seed: int = 0,
     ):
+        """``feature_subset``: consider only that many randomly drawn
+        features PER NODE (random-forest mode; the reference's
+        ``featureSubsetStrategy`` samples per node too)."""
         if task not in ("classification", "regression"):
             raise ValueError("task must be classification or regression")
         if max_depth < 1 or max_bins < 2:
@@ -126,6 +131,8 @@ class DecisionTree:
         self.min_node = min_instances_per_node
         self.min_gain = min_info_gain
         self.num_classes = num_classes
+        self.feature_subset = feature_subset
+        self.seed = seed
 
     def fit(self, X, y) -> DecisionTreeModel:
         Xh = np.asarray(X, np.float32)
@@ -151,6 +158,7 @@ class DecisionTree:
         prediction = np.zeros(max_nodes, np.float32)
         node_of = jnp.zeros(n, jnp.int32)
 
+        rng = np.random.default_rng(self.seed)
         level_start, level_size = 0, 1
         for depth in range(self.max_depth + 1):
             n_nodes_total = level_start + level_size
@@ -210,6 +218,11 @@ class DecisionTree:
                 gain = parent_imp - child
                 ok = (nl >= self.min_node) & (nr >= self.min_node)
                 gain = np.where(ok, gain, -np.inf)
+                if self.feature_subset is not None and self.feature_subset < F:
+                    allowed = rng.choice(F, self.feature_subset, replace=False)
+                    mask = np.full(F, True)
+                    mask[allowed] = False
+                    gain[mask] = -np.inf
                 f_best, b_best = np.unravel_index(
                     np.argmax(gain), gain.shape
                 )

@@ -24,13 +24,27 @@ import numpy as np
 from asyncframework_tpu.sql.frame import ColumnarFrame
 
 
+_I32 = (np.iinfo(np.int32).min, np.iinfo(np.int32).max)
+
+
+def _int_column(ints: List[int]):
+    """int32 device column when every value fits; otherwise a HOST column of
+    Python ints.  The frame's device dtype for integers is int32 (jax x64 is
+    off), and silently wrapping a 64-bit ID would corrupt data -- wide
+    integers are identifiers in practice, and identifiers are join/group
+    keys, which host columns serve exactly."""
+    if all(_I32[0] <= v <= _I32[1] for v in ints):
+        return np.asarray(ints, np.int32)
+    return np.asarray(ints, dtype=object)
+
+
 def _to_column(values: List[str], name: str):
     """Infer int -> float -> string, with '' treated as missing (NaN for
     floats; kept as '' for strings; promotes int columns to float)."""
     has_missing = any(v == "" for v in values)
     if not has_missing:
         try:
-            return np.asarray([int(v) for v in values], np.int32)
+            return _int_column([int(v) for v in values])
         except ValueError:
             pass
     try:
@@ -98,14 +112,17 @@ def read_json(path: Union[str, Path]) -> ColumnarFrame:
     cols: Dict[str, object] = {}
     for name in names:
         vals = [r.get(name) for r in records]
-        if all(isinstance(v, (int, float)) or v is None for v in vals):
-            arr = np.asarray(
+        if all(
+            isinstance(v, int) and not isinstance(v, bool) for v in vals
+        ):
+            # pure-integer column: size-check BEFORE any float32 round trip
+            # (float32 silently distorts ints above 2**24)
+            cols[name] = _int_column(vals)
+        elif all(isinstance(v, (int, float)) or v is None for v in vals):
+            cols[name] = np.asarray(
                 [float(v) if v is not None else np.nan for v in vals],
                 np.float32,
             )
-            if not np.isnan(arr).any() and np.all(arr == arr.astype(np.int32)):
-                arr = arr.astype(np.int32)
-            cols[name] = arr
         else:
             cols[name] = np.asarray(
                 ["" if v is None else str(v) for v in vals], dtype=object
@@ -131,7 +148,14 @@ def read_parquet(
         if arr.dtype == np.float64:
             arr = arr.astype(np.float32)
         elif arr.dtype == np.int64:
-            arr = arr.astype(np.int32)
+            # downcast only when lossless; wide ints become host columns
+            # (see _int_column -- silent int32 wraparound corrupts IDs)
+            if len(arr) == 0 or (
+                arr.min() >= _I32[0] and arr.max() <= _I32[1]
+            ):
+                arr = arr.astype(np.int32)
+            else:
+                arr = np.asarray([int(v) for v in arr], dtype=object)
         elif not np.issubdtype(arr.dtype, np.number):
             arr = arr.astype(object)
         cols[name] = arr

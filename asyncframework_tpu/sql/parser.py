@@ -205,14 +205,24 @@ class _Parser:
                 self.expect("(")
                 if self.peek() == "*":
                     self.next()
-                    arg = None
+                    arg = None  # COUNT(*)
                 else:
-                    arg = self.ident()
-                    if self.peek() == ".":
-                        self.next()
-                        arg = self.ident()
+                    # full expression allowed: SUM(v * 2); a bare column
+                    # reference stays a plain name, anything else is a
+                    # Column the lowering materializes first
+                    start = self.i
+                    e = self.expr()
+                    if self.i == start + 1:
+                        arg = self.toks[start]
+                    elif self.i == start + 3 and self.toks[start + 1] == ".":
+                        arg = self.toks[start + 2]
+                    else:
+                        arg = e
                 self.expect(")")
-                out = f"{fn}({arg or '*'})"
+                label = arg if isinstance(arg, str) else (
+                    "expr" if arg is not None else "*"
+                )
+                out = f"{fn}({label})"
                 if self.accept("AS"):
                     out = self.ident()
                 items.append(("agg", (fn, arg, out)))
@@ -313,9 +323,24 @@ class SQLContext:
         if p.peek() is not None:
             raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
 
+        pre = frame
         frame = self._project(frame, items, group_key)
         if order_by is not None:
-            frame = frame.sort(order_by, ascending=ascending)
+            if order_by in frame.columns:
+                frame = frame.sort(order_by, ascending=ascending)
+            elif group_key is None and order_by in pre.columns:
+                # standard SQL: ORDER BY may reference an unprojected source
+                # column -- sort the source, then re-project (projection
+                # preserves row order)
+                frame = self._project(
+                    pre.sort(order_by, ascending=ascending), items, group_key
+                )
+            else:
+                raise ValueError(
+                    f"ORDER BY {order_by!r}: not a result column"
+                    + ("" if group_key is None else
+                       " (aggregated queries sort by output columns only)")
+                )
         if limit is not None:
             frame = _limit(frame, limit)
         return frame
@@ -341,13 +366,8 @@ class SQLContext:
                         "non-aggregate select item "
                         f"{name!r} must be the GROUP BY key"
                     )
+            frame, spec = _agg_spec(frame, aggs)
             gb = frame.groupby(group_key)
-            spec = {}
-            for fn, arg, out in aggs:
-                if arg is None:  # COUNT(*): count over any device column
-                    arg = _any_device_column(frame)
-                    fn = "count"
-                spec[out] = (arg, fn)
             if not spec:
                 return gb.count()
             return gb.agg(**spec)
@@ -357,12 +377,7 @@ class SQLContext:
                 raise ValueError(
                     "mixing aggregates and plain columns needs GROUP BY"
                 )
-            spec = {}
-            for fn, arg, out in aggs:
-                if arg is None:
-                    arg = _any_device_column(frame)
-                    fn = "count"
-                spec[out] = (arg, fn)
+            frame, spec = _agg_spec(frame, aggs)
             scalars = frame.agg(**spec)
             return ColumnarFrame(
                 {k: np.asarray([v]) for k, v in scalars.items()}
@@ -377,6 +392,22 @@ class SQLContext:
             ]
             return frame.select(*sel)
         return frame.select(*[e.alias(name) for e, name in exprs])
+
+
+def _agg_spec(frame: ColumnarFrame, aggs):
+    """Resolve aggregate arguments: bare columns pass through, expression
+    arguments are materialized as temp columns, COUNT(*) counts rows."""
+    spec = {}
+    for i, (fn, arg, out) in enumerate(aggs):
+        if arg is None:  # COUNT(*): count over any device column
+            arg = _any_device_column(frame)
+            fn = "count"
+        elif isinstance(arg, Column):
+            tmp = f"__agg_{i}"
+            frame = frame.with_column(tmp, arg)
+            arg = tmp
+        spec[out] = (arg, fn)
+    return frame, spec
 
 
 def _any_device_column(frame: ColumnarFrame) -> str:

@@ -243,3 +243,51 @@ def test_async_reduce_with_bucket_barrier_roundtrip(sched):
             ctx.collect_all(timeout=5.0)
         assert ctx.available_workers() == 4
     assert ctx.get_current_time() == 8  # 4 merges per round, 2 rounds
+
+
+class TestRDDBreadth:
+    """The long tail of the RDD surface: glom/coalesce/sortBy/top/... ."""
+
+    def test_glom_and_key_by(self, sched):
+        ds = DistributedDataset.from_list(sched, list(range(8)))
+        parts = ds.glom().collect()
+        assert [len(p) for p in parts] == [2, 2, 2, 2]
+        kv = ds.key_by(lambda x: x % 2).collect()
+        assert kv[:2] == [(0, 0), (1, 1)]
+
+    def test_coalesce_preserves_order(self, sched):
+        ds = DistributedDataset.from_list(sched, list(range(10)))
+        c = ds.coalesce(2)
+        assert c.num_partitions == 2
+        assert c.collect() == list(range(10))
+        assert ds.coalesce(8) is ds  # growing is a no-op
+
+    def test_sort_by(self, sched):
+        ds = DistributedDataset.from_list(sched, [5, 2, 9, 1, 7])
+        assert ds.sort_by(lambda x: x).collect() == [1, 2, 5, 7, 9]
+        assert ds.sort_by(lambda x: x, ascending=False).collect() == [9, 7, 5, 2, 1]
+
+    def test_count_by_value_and_fold(self, sched):
+        ds = DistributedDataset.from_list(sched, ["a", "b", "a", "a"])
+        assert ds.count_by_value() == {"a": 3, "b": 1}
+        nums = DistributedDataset.from_list(sched, [1, 2, 3, 4])
+        assert nums.fold(0, lambda a, b: a + b) == 10
+
+    def test_top_and_take_ordered(self, sched):
+        ds = DistributedDataset.from_list(sched, [5, 2, 9, 1, 7, 3])
+        assert ds.top(3) == [9, 7, 5]
+        assert ds.take_ordered(3) == [1, 2, 3]
+        assert ds.top(2, key=lambda x: -x) == [1, 2]
+
+    def test_subtract_and_intersection(self, sched):
+        a = DistributedDataset.from_list(sched, [1, 2, 2, 3, 4])
+        b = DistributedDataset.from_list(sched, [2, 4, 5])
+        assert sorted(a.subtract(b).collect()) == [1, 3]
+        assert sorted(a.intersection(b).collect()) == [2, 4]
+
+    def test_cartesian(self, sched):
+        a = DistributedDataset.from_list(sched, [1, 2])
+        b = DistributedDataset.from_list(sched, ["x", "y"])
+        assert sorted(a.cartesian(b).collect()) == [
+            (1, "x"), (1, "y"), (2, "x"), (2, "y")
+        ]

@@ -57,3 +57,17 @@ def test_sql_example():
     report = sql_pipeline.main(n=500)
     assert set(report.columns) >= {"region", "revenue", "manager"}
     assert len(report) == 3
+
+
+def test_sql_ml_pipeline_example():
+    import sql_ml_pipeline
+
+    acc = sql_ml_pipeline.main(n=600, quiet=True)
+    assert acc > 0.7
+
+
+def test_sparse_asgd_example():
+    import sparse_asgd
+
+    res = sparse_asgd.main(n=512, d=4096, iters=60, quiet=True)
+    assert res.accepted == 60

@@ -162,3 +162,88 @@ class TestConnectedComponents:
         g = Graph.from_edges([(1, 0), (1, 2)], num_vertices=3)  # arrows differ
         labels = np.asarray(connected_components(g))
         np.testing.assert_array_equal(labels, [0, 0, 0])
+
+
+class TestTriangleCount:
+    def test_single_triangle(self):
+        from asyncframework_tpu.graph import triangle_count
+
+        g = Graph.from_edges([(0, 1), (1, 2), (2, 0), (2, 3)], 4)
+        counts = np.asarray(triangle_count(g))
+        np.testing.assert_array_equal(counts, [1, 1, 1, 0])
+
+    def test_duplicate_and_self_edges_canonicalized(self):
+        from asyncframework_tpu.graph import triangle_count
+
+        g = Graph.from_edges(
+            [(0, 1), (1, 0), (1, 2), (2, 0), (0, 0), (2, 2)], 3
+        )
+        np.testing.assert_array_equal(np.asarray(triangle_count(g)), [1, 1, 1])
+
+    def test_k4_has_three_per_vertex(self):
+        from asyncframework_tpu.graph import triangle_count
+
+        edges = [(i, j) for i in range(4) for j in range(i + 1, 4)]
+        g = Graph.from_edges(edges, 4)
+        np.testing.assert_array_equal(
+            np.asarray(triangle_count(g)), [3, 3, 3, 3]
+        )
+
+
+class TestLabelPropagation:
+    def test_two_cliques_converge_to_two_labels(self):
+        from asyncframework_tpu.graph import label_propagation
+
+        clique = lambda vs: [(a, b) for a in vs for b in vs if a < b]
+        g = Graph.from_edges(clique([0, 1, 2, 3]) + clique([4, 5, 6, 7])
+                             + [(3, 4)], 8)
+        labels = np.asarray(label_propagation(g, max_iterations=10))
+        assert len(set(labels[:3])) == 1
+        assert len(set(labels[5:])) == 1
+
+
+class TestShortestPaths:
+    def test_hop_counts_to_landmarks(self):
+        from asyncframework_tpu.graph import shortest_paths
+
+        # path 0-1-2-3, isolated 4
+        g = Graph.from_edges([(0, 1), (1, 2), (2, 3)], 5)
+        d = np.asarray(shortest_paths(g, landmarks=[0, 3]))
+        np.testing.assert_array_equal(d[:, 0][:4], [0, 1, 2, 3])
+        np.testing.assert_array_equal(d[:, 1][:4], [3, 2, 1, 0])
+        assert np.isinf(d[4]).all()
+
+
+class TestPartitionStrategies:
+    def edges(self):
+        rs = np.random.default_rng(0)
+        return Graph.from_edges(rs.integers(0, 100, size=(2000, 2)), 100)
+
+    @pytest.mark.parametrize("strategy", [
+        "edge_1d", "edge_2d", "random_vertex_cut",
+        "canonical_random_vertex_cut",
+    ])
+    def test_valid_deterministic_and_balanced(self, strategy):
+        from asyncframework_tpu.graph import partition_edges
+
+        g = self.edges()
+        p1 = np.asarray(partition_edges(g, 8, strategy))
+        p2 = np.asarray(partition_edges(g, 8, strategy))
+        np.testing.assert_array_equal(p1, p2)
+        assert p1.min() >= 0 and p1.max() < 8
+        counts = np.bincount(p1, minlength=8)
+        assert counts.max() < 4 * max(counts.min(), 1)  # rough balance
+
+    def test_canonical_colocates_both_directions(self):
+        from asyncframework_tpu.graph import partition_edges
+
+        g = Graph.from_edges([(1, 7), (7, 1), (3, 9), (9, 3)], 10)
+        p = np.asarray(partition_edges(g, 6, "canonical_random_vertex_cut"))
+        assert p[0] == p[1] and p[2] == p[3]
+
+    def test_edge_1d_groups_by_src(self):
+        from asyncframework_tpu.graph import partition_edges
+
+        g = Graph.from_edges([(5, 1), (5, 2), (5, 3)], 6)
+        p = np.asarray(partition_edges(g, 4, "edge_1d"))
+        assert len(set(p)) == 1

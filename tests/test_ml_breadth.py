@@ -213,3 +213,118 @@ class TestEvaluation:
             rtol=1e-6,
         )
         assert 0.7 < m.accuracy <= 1.0
+
+
+class TestGaussianMixture:
+    def test_recovers_separated_blobs(self):
+        from asyncframework_tpu.ml import GaussianMixture
+
+        rs = np.random.default_rng(0)
+        a = rs.normal([-4, 0], 0.5, size=(300, 2))
+        b = rs.normal([4, 1], 0.8, size=(300, 2))
+        X = np.vstack([a, b]).astype(np.float32)
+        model = GaussianMixture(2, seed=1).fit(X)
+        pred = model.predict(X)
+        # each blob lands (almost) entirely in one component
+        pa = np.bincount(pred[:300], minlength=2)
+        pb = np.bincount(pred[300:], minlength=2)
+        assert pa.max() > 290 and pb.max() > 290
+        assert pa.argmax() != pb.argmax()
+        means = np.sort(model.means[:, 0])
+        np.testing.assert_allclose(means, [-4, 4], atol=0.3)
+
+    def test_loglik_close_to_sklearn(self):
+        from sklearn.mixture import GaussianMixture as SKGMM
+
+        from asyncframework_tpu.ml import GaussianMixture
+
+        rs = np.random.default_rng(2)
+        X = np.vstack([
+            rs.normal(0, 1, size=(200, 3)),
+            rs.normal(3, 1.5, size=(200, 3)),
+        ]).astype(np.float32)
+        ours = GaussianMixture(2, seed=0, max_iterations=200).fit(X)
+        sk = SKGMM(2, random_state=0, max_iter=200).fit(X)
+        ours_avg_ll = ours.log_likelihood / len(X)
+        assert ours_avg_ll >= sk.score(X) - 0.05
+
+    def test_proba_rows_sum_to_one(self):
+        from asyncframework_tpu.ml import GaussianMixture
+
+        rs = np.random.default_rng(3)
+        X = rs.normal(size=(100, 2)).astype(np.float32)
+        p = GaussianMixture(3, seed=0, max_iterations=10).fit(X).predict_proba(X)
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-4)
+
+
+class TestFPGrowth:
+    TXS = [
+        ["bread", "milk"],
+        ["bread", "diapers", "beer", "eggs"],
+        ["milk", "diapers", "beer", "cola"],
+        ["bread", "milk", "diapers", "beer"],
+        ["bread", "milk", "diapers", "cola"],
+    ]
+
+    def brute_force(self, min_support):
+        from itertools import combinations
+
+        n = len(self.TXS)
+        items = sorted({i for t in self.TXS for i in t})
+        out = {}
+        for r in range(1, len(items) + 1):
+            for combo in combinations(items, r):
+                s = frozenset(combo)
+                c = sum(1 for t in self.TXS if s <= set(t))
+                if c / n >= min_support:
+                    out[s] = c
+        return out
+
+    @pytest.mark.parametrize("min_support", [0.2, 0.4, 0.6])
+    def test_matches_brute_force(self, min_support):
+        from asyncframework_tpu.ml import FPGrowth
+
+        model = FPGrowth(min_support).run(self.TXS)
+        assert model.freq_itemsets == self.brute_force(min_support)
+
+    def test_association_rules(self):
+        from asyncframework_tpu.ml import FPGrowth
+
+        model = FPGrowth(0.4).run(self.TXS)
+        rules = model.association_rules(min_confidence=0.9)
+        by_pair = {(tuple(sorted(r.antecedent)), tuple(r.consequent)): r
+                   for r in rules}
+        # beer appears in 3 transactions, all of which contain diapers
+        key = (("beer",), ("diapers",))
+        assert key in by_pair and by_pair[key].confidence == 1.0
+
+
+class TestRandomForest:
+    def test_forest_beats_single_tree_on_noise(self, clf_data):
+        from asyncframework_tpu.ml import RandomForest
+
+        X, y = clf_data
+        rs = np.random.default_rng(0)
+        flip = rs.random(len(y)) < 0.15
+        y_noisy = np.where(flip, rs.integers(0, 3, len(y)), y)
+        half = len(y) // 2
+        forest = RandomForest(num_trees=15, max_depth=6, seed=3).fit(
+            X[:half], y_noisy[:half]
+        )
+        tree_pred = DecisionTree(max_depth=6).fit(
+            X[:half], y_noisy[:half]
+        ).predict(X[half:])
+        forest_pred = forest.predict(X[half:])
+        acc_f = (forest_pred == y[half:]).mean()
+        acc_t = (tree_pred == y[half:]).mean()
+        assert acc_f >= acc_t - 0.01  # ensemble at least matches, usually beats
+        assert acc_f > 0.75
+
+    def test_regression_forest(self, reg_data):
+        from asyncframework_tpu.ml import RandomForest, RegressionMetrics
+
+        X, y = reg_data
+        model = RandomForest("regression", num_trees=10, max_depth=5,
+                             feature_subset_strategy="all", seed=1).fit(X, y)
+        r2 = RegressionMetrics.of(model.predict(X), y).r2
+        assert r2 > 0.5

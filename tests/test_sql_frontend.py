@@ -214,3 +214,51 @@ class TestJoinFlavors:
             sql("SELECT * FROM t GROUP BY k", t=read_csv(csv_path))
         with pytest.raises(ValueError, match="SELECT \\*"):
             sql("SELECT *, SUM(v) FROM t GROUP BY k", t=read_csv(csv_path))
+
+
+class TestReviewRegressions:
+    def test_wide_ints_survive_csv(self, tmp_path):
+        p = tmp_path / "ids.csv"
+        p.write_text("id,v\n3000000000,1\n9007199254740993,2\n")
+        f = read_csv(p)
+        ids = np.asarray(f["id"])
+        assert ids.dtype == object  # host column: no silent wraparound
+        assert ids[0] == 3000000000 and ids[1] == 9007199254740993
+
+    def test_wide_ints_survive_json(self, tmp_path):
+        p = tmp_path / "ids.jsonl"
+        p.write_text('{"id": 20000001}\n{"id": 3000000000}\n')
+        f = read_json(p)
+        ids = np.asarray(f["id"])
+        assert ids[0] == 20000001  # NOT the float32-rounded 20000000
+        assert ids[1] == 3000000000
+
+    def test_wide_ints_survive_parquet(self, tmp_path):
+        df = pd.DataFrame({"id": np.asarray([3_000_000_000, 1], np.int64)})
+        p = tmp_path / "ids.parquet"
+        df.to_parquet(p)
+        ids = np.asarray(read_parquet(p)["id"])
+        assert ids[0] == 3_000_000_000
+
+    def test_order_by_unprojected_column(self, csv_path):
+        got = sql("SELECT v FROM t ORDER BY w DESC LIMIT 2",
+                  t=read_csv(csv_path))
+        np.testing.assert_allclose(np.asarray(got["v"]), [6, 5])
+
+    def test_order_by_missing_from_aggregate_rejected(self, csv_path):
+        with pytest.raises(ValueError, match="ORDER BY"):
+            sql("SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY w",
+                t=read_csv(csv_path))
+
+    def test_aggregate_over_expression(self, csv_path):
+        got = sql("SELECT SUM(v * 2) AS s FROM t", t=read_csv(csv_path))
+        assert float(np.asarray(got["s"])[0]) == 42
+        grouped = sql(
+            "SELECT k, SUM(v + w) AS s FROM t GROUP BY k ORDER BY k",
+            t=read_csv(csv_path),
+        )
+        pdf = pd.read_csv(csv_path)
+        expect = (pdf.v + pdf.w).groupby(pdf.k).sum()
+        np.testing.assert_allclose(
+            np.asarray(grouped["s"]), expect.to_numpy(), rtol=1e-6
+        )
