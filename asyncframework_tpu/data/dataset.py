@@ -371,19 +371,48 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
         allv = [x for wid in self.partition_ids() for x in per[wid]]
         return heapq.nsmallest(n, allv, key=k)
 
+    def _lazy_elements(self) -> Callable[[], List[E]]:
+        """Memoized deferred materialization of every element, computed
+        DIRECTLY on the first caller's thread (not via scheduler jobs:
+        transformations stay lazy like the rest of the file, and a nested
+        job launched from inside a worker task could deadlock the pool)."""
+        cell: Dict[str, List[E]] = {}
+        lock = threading.Lock()
+
+        def get() -> List[E]:
+            with lock:
+                if "v" not in cell:
+                    cell["v"] = [
+                        x
+                        for wid in self.partition_ids()
+                        for x in self._compute(wid)
+                    ]
+                return cell["v"]
+
+        return get
+
     def subtract(self, other: "DistributedDataset[E]") -> "DistributedDataset[E]":
         """``RDD.subtract`` parity: elements of self not present in other
         (duplicates of surviving elements are preserved, like the
-        reference's cogroup formulation)."""
-        gone = set(other.distinct().collect())
-        return self.filter(lambda x: x not in gone)
+        reference's cogroup formulation).  Lazy: ``other`` materializes at
+        first action, not at definition."""
+        get_other = other._lazy_elements()
+        return self.map_partitions(
+            lambda xs: (lambda gone: [x for x in xs if x not in gone])(
+                set(get_other())
+            )
+        )
 
     def intersection(
         self, other: "DistributedDataset[E]"
     ) -> "DistributedDataset[E]":
         """``RDD.intersection`` parity: distinct elements present in both."""
-        have = set(other.distinct().collect())
-        return self.distinct().filter(lambda x: x in have)
+        get_other = other._lazy_elements()
+        return self.distinct().map_partitions(
+            lambda xs: (lambda have: [x for x in xs if x in have])(
+                set(get_other())
+            )
+        )
 
     def cartesian(
         self, other: "DistributedDataset[U]"
@@ -391,8 +420,10 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
         """``RDD.cartesian`` parity: partition (i) pairs with the WHOLE other
         dataset (the reference builds p*q partitions; worker-pinned
         partitions keep self's layout and broadcast other's rows)."""
-        other_all = other.collect()
-        return self.flat_map(lambda x: [(x, ygg) for ygg in other_all])
+        get_other = other._lazy_elements()
+        return self.map_partitions(
+            lambda xs: [(x, o) for x in xs for o in get_other()]
+        )
 
     def barrier(
         self,

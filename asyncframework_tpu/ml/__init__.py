@@ -26,6 +26,8 @@ from asyncframework_tpu.ml.models import (
     LinearRegression,
     LinearSVM,
     LogisticRegression,
+    SoftmaxRegression,
+    SoftmaxRegressionModel,
 )
 from asyncframework_tpu.ml.clustering import KMeans, KMeansModel
 from asyncframework_tpu.ml.recommendation import ALS, ALSModel
@@ -66,6 +68,8 @@ __all__ = [
     "LinearModel",
     "LinearRegression",
     "LogisticRegression",
+    "SoftmaxRegression",
+    "SoftmaxRegressionModel",
     "LinearSVM",
     "KMeans",
     "KMeansModel",

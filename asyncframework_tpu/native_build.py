@@ -17,7 +17,7 @@ _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
 )
 
-SOURCES = ("libsvm_parser", "kvstore")
+SOURCES = ("libsvm_parser", "kvstore", "codec")
 
 
 def native_dir() -> str:

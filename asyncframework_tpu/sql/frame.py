@@ -88,7 +88,12 @@ class ColumnarFrame:
     def _eval(self, expr: Union[str, Column]):
         if isinstance(expr, str):
             expr = col(expr)
-        return expr(self._cols), expr.name
+        val = expr(self._cols)
+        if np.ndim(val) == 0:
+            # literal expressions (SELECT 1, COUNT(1)'s temp column, ...)
+            # broadcast to the frame's length like SQL scalars do
+            val = jnp.full((self._n,), val)
+        return val, expr.name
 
     def select(self, *exprs: Union[str, Column]) -> "ColumnarFrame":
         out: Dict[str, object] = {}

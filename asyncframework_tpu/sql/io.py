@@ -25,6 +25,7 @@ from asyncframework_tpu.sql.frame import ColumnarFrame
 
 
 _I32 = (np.iinfo(np.int32).min, np.iinfo(np.int32).max)
+_F32_EXACT = 1 << 24  # float32 represents integers exactly up to 2**24
 
 
 def _int_column(ints: List[int]):
@@ -45,6 +46,17 @@ def _to_column(values: List[str], name: str):
     if not has_missing:
         try:
             return _int_column([int(v) for v in values])
+        except ValueError:
+            pass
+    else:
+        # nullable int column: float32 only when every value is exactly
+        # representable; wide IDs stay a host column with None for missing
+        try:
+            ints = [int(v) if v != "" else None for v in values]
+            if any(
+                v is not None and abs(v) > _F32_EXACT for v in ints
+            ):
+                return np.asarray(ints, dtype=object)
         except ValueError:
             pass
     try:
@@ -119,10 +131,19 @@ def read_json(path: Union[str, Path]) -> ColumnarFrame:
             # (float32 silently distorts ints above 2**24)
             cols[name] = _int_column(vals)
         elif all(isinstance(v, (int, float)) or v is None for v in vals):
-            cols[name] = np.asarray(
-                [float(v) if v is not None else np.nan for v in vals],
-                np.float32,
-            )
+            if any(
+                isinstance(v, int) and not isinstance(v, bool)
+                and abs(v) > _F32_EXACT
+                for v in vals
+            ):
+                # nullable/mixed column with wide ints: a single null must
+                # not reroute IDs through lossy float32
+                cols[name] = np.asarray(vals, dtype=object)
+            else:
+                cols[name] = np.asarray(
+                    [float(v) if v is not None else np.nan for v in vals],
+                    np.float32,
+                )
         else:
             cols[name] = np.asarray(
                 ["" if v is None else str(v) for v in vals], dtype=object

@@ -208,19 +208,25 @@ class _Parser:
                     arg = None  # COUNT(*)
                 else:
                     # full expression allowed: SUM(v * 2); a bare column
-                    # reference stays a plain name, anything else is a
-                    # Column the lowering materializes first
+                    # reference stays a plain name, anything else (incl.
+                    # literals like COUNT(1)) is a Column the lowering
+                    # materializes first
                     start = self.i
                     e = self.expr()
-                    if self.i == start + 1:
+                    ident_re = r"[A-Za-z_][A-Za-z_0-9]*"
+                    if self.i == start + 1 and re.fullmatch(
+                        ident_re, self.toks[start]
+                    ):
                         arg = self.toks[start]
                     elif self.i == start + 3 and self.toks[start + 1] == ".":
                         arg = self.toks[start + 2]
                     else:
                         arg = e
                 self.expect(")")
+                # unaliased labels must be unique per item or later spec
+                # entries silently overwrite earlier ones
                 label = arg if isinstance(arg, str) else (
-                    "expr" if arg is not None else "*"
+                    f"expr#{len(items)}" if arg is not None else "*"
                 )
                 out = f"{fn}({label})"
                 if self.accept("AS"):
@@ -323,24 +329,27 @@ class SQLContext:
         if p.peek() is not None:
             raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
 
-        pre = frame
+        if (
+            order_by is not None
+            and group_key is None
+            and not aggs_present(items)
+            and order_by in frame.columns
+        ):
+            # standard SQL: ORDER BY may reference an unprojected source
+            # column -- sorting the source BEFORE projecting covers both
+            # source columns and pass-through selections in one projection
+            # (projection preserves row order)
+            frame = frame.sort(order_by, ascending=ascending)
+            order_by = None
         frame = self._project(frame, items, group_key)
         if order_by is not None:
-            if order_by in frame.columns:
-                frame = frame.sort(order_by, ascending=ascending)
-            elif group_key is None and order_by in pre.columns:
-                # standard SQL: ORDER BY may reference an unprojected source
-                # column -- sort the source, then re-project (projection
-                # preserves row order)
-                frame = self._project(
-                    pre.sort(order_by, ascending=ascending), items, group_key
-                )
-            else:
+            if order_by not in frame.columns:
                 raise ValueError(
                     f"ORDER BY {order_by!r}: not a result column"
                     + ("" if group_key is None else
                        " (aggregated queries sort by output columns only)")
                 )
+            frame = frame.sort(order_by, ascending=ascending)
         if limit is not None:
             frame = _limit(frame, limit)
         return frame
@@ -392,6 +401,10 @@ class SQLContext:
             ]
             return frame.select(*sel)
         return frame.select(*[e.alias(name) for e, name in exprs])
+
+
+def aggs_present(items) -> bool:
+    return any(kind == "agg" for kind, _ in items)
 
 
 def _agg_spec(frame: ColumnarFrame, aggs):

@@ -23,9 +23,18 @@ from typing import Any, Iterator, List, Tuple
 import numpy as np
 
 
+_COMPRESSED_FLAG = 0x80000000  # high bit of the record length
+
+
 class WriteAheadLog:
-    def __init__(self, path):
+    def __init__(self, path, compress: bool = False):
+        """``compress=True`` writes each record as an AZ1 block
+        (``utils/codec.py`` -- the native-codec analog of the reference
+        compressing its WAL/event bytes through lz4); the flag rides the
+        high bit of the length word, so compressed and plain records can
+        coexist in one log and replay handles both."""
         self.path = Path(path)
+        self.compress = compress
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
         if self.path.exists():
@@ -42,6 +51,7 @@ class WriteAheadLog:
                 if len(hdr) < 4:
                     return start  # clean end (0 bytes) or torn header
                 (n,) = struct.unpack("<I", hdr)
+                n &= ~_COMPRESSED_FLAG
                 blob = f.read(n)
                 if len(blob) < n:
                     return start  # torn record
@@ -64,8 +74,14 @@ class WriteAheadLog:
                 batch=np.frombuffer(_to_json(batch), np.uint8),
             )
         blob = buf.getvalue()
+        flag = 0
+        if self.compress:
+            from asyncframework_tpu.utils.codec import compress as az1
+
+            blob = az1(blob)
+            flag = _COMPRESSED_FLAG
         with self._lock:
-            self._f.write(struct.pack("<I", len(blob)))
+            self._f.write(struct.pack("<I", len(blob) | flag))
             self._f.write(blob)
             self._f.flush()
             os.fsync(self._f.fileno())
@@ -79,9 +95,15 @@ class WriteAheadLog:
                 if len(hdr) < 4:
                     return
                 (n,) = struct.unpack("<I", hdr)
+                compressed = bool(n & _COMPRESSED_FLAG)
+                n &= ~_COMPRESSED_FLAG
                 blob = f.read(n)
                 if len(blob) < n:
                     return
+                if compressed:
+                    from asyncframework_tpu.utils.codec import decompress
+
+                    blob = decompress(blob)
                 with np.load(io.BytesIO(blob), allow_pickle=False) as z:
                     t = int(z["t"])
                     kind = int(z["kind"])

@@ -1,0 +1,187 @@
+"""AZ1 block compression: C++ fast path + pure-Python fallback.
+
+Role parity: the reference reaches lz4/snappy/zstd through JNI for shuffle,
+broadcast, and event-log bytes (``core/.../io/CompressionCodec.scala:113``).
+AZ1 is this framework's native codec -- an original LZ77-family block format
+(greedy hash matching, byte-aligned tokens; see ``native/codec.cc`` for the
+format spec).  Both backends produce interchangeable blocks and both
+decoders are bounds-checked against hostile input.
+
+Consumers: the write-ahead log's ``compress=True`` mode
+(``streaming/wal.py``); any host blob can use :func:`compress` /
+:func:`decompress` directly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_MIN_MATCH = 4
+_MAX_LIT = 0x7F
+_MAX_MATCH = 0x7F + _MIN_MATCH
+_MAX_OFFSET = 0xFFFF
+_HASH_BITS = 15
+_HASH_MUL = 2654435761
+
+_NATIVE = None
+
+
+def _native_lib():
+    global _NATIVE
+    if _NATIVE is not None:
+        return _NATIVE or None
+    lib = None
+    try:
+        from asyncframework_tpu.native_build import ensure_built
+
+        built = ensure_built("codec")
+        if built and os.path.exists(built):
+            lib = ctypes.CDLL(built)
+            lib.az1_max_compressed_size.restype = ctypes.c_longlong
+            lib.az1_max_compressed_size.argtypes = [ctypes.c_longlong]
+            lib.az1_compress.restype = ctypes.c_longlong
+            lib.az1_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_longlong,
+            ]
+            lib.az1_decompress.restype = ctypes.c_longlong
+            lib.az1_decompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_longlong,
+            ]
+    except Exception:  # noqa: BLE001 - fall back to Python
+        lib = None
+    _NATIVE = lib or False
+    return lib
+
+
+def max_compressed_size(n: int) -> int:
+    return 4 + n + (n // _MAX_LIT + 1)
+
+
+# ------------------------------------------------------------------ python
+def _hash4(b: bytes, i: int) -> int:
+    v = int.from_bytes(b[i : i + 4], "little")
+    return ((v * _HASH_MUL) & 0xFFFFFFFF) >> (32 - _HASH_BITS)
+
+
+def _py_compress(src: bytes) -> bytes:
+    n = len(src)
+    out = bytearray(n.to_bytes(4, "little"))
+    table = [-1] * (1 << _HASH_BITS)
+    i = 0
+    lit_start = 0
+
+    def flush(upto: int) -> None:
+        nonlocal lit_start
+        while lit_start < upto:
+            run = min(upto - lit_start, _MAX_LIT)
+            out.append(run)
+            out.extend(src[lit_start : lit_start + run])
+            lit_start += run
+
+    while i + _MIN_MATCH <= n:
+        h = _hash4(src, i)
+        cand = table[h]
+        table[h] = i
+        if (
+            cand >= 0
+            and i - cand <= _MAX_OFFSET
+            and src[cand : cand + _MIN_MATCH] == src[i : i + _MIN_MATCH]
+        ):
+            length = _MIN_MATCH
+            max_len = min(n - i, _MAX_MATCH)
+            while length < max_len and src[cand + length] == src[i + length]:
+                length += 1
+            flush(i)
+            out.append(0x80 | (length - _MIN_MATCH))
+            out.extend((i - cand).to_bytes(2, "little"))
+            stop = i + length - _MIN_MATCH
+            j = i + 1
+            while j <= stop:
+                table[_hash4(src, j)] = j
+                j += 1
+            i += length
+            lit_start = i
+        else:
+            i += 1
+    flush(n)
+    return bytes(out)
+
+
+def _py_decompress(blob: bytes) -> bytes:
+    if len(blob) < 4:
+        raise ValueError("AZ1: truncated header")
+    raw = int.from_bytes(blob[:4], "little")
+    out = bytearray()
+    i = 4
+    n = len(blob)
+    while len(out) < raw:
+        if i >= n:
+            raise ValueError("AZ1: truncated token")
+        c = blob[i]
+        i += 1
+        if c & 0x80:
+            length = (c & 0x7F) + _MIN_MATCH
+            if i + 2 > n:
+                raise ValueError("AZ1: truncated match")
+            off = int.from_bytes(blob[i : i + 2], "little")
+            i += 2
+            if off == 0 or off > len(out):
+                raise ValueError("AZ1: bad offset")
+            if len(out) + length > raw:
+                raise ValueError("AZ1: overlong match")
+            start = len(out) - off
+            for j in range(length):  # may overlap forward (RLE)
+                out.append(out[start + j])
+        else:
+            if c == 0:
+                raise ValueError("AZ1: zero literal run")
+            if i + c > n:
+                raise ValueError("AZ1: truncated literals")
+            if len(out) + c > raw:
+                raise ValueError("AZ1: overlong literals")
+            out.extend(blob[i : i + c])
+            i += c
+    if i != n:
+        raise ValueError("AZ1: trailing garbage")
+    return bytes(out)
+
+
+# -------------------------------------------------------------------- API
+def compress(data: bytes, backend: Optional[str] = None) -> bytes:
+    """Compress one block; backend 'native'/'python'/None (auto)."""
+    data = bytes(data)
+    lib = _native_lib() if backend in (None, "native") else None
+    if backend == "native" and lib is None:
+        raise RuntimeError("native codec unavailable (build native/codec.cc)")
+    if lib is not None:
+        cap = max_compressed_size(len(data))
+        buf = (ctypes.c_uint8 * cap)()
+        got = lib.az1_compress(data, len(data), buf, cap)
+        if got < 0:
+            raise RuntimeError("AZ1 native compress failed")
+        return bytes(bytearray(buf)[:got])
+    return _py_compress(data)
+
+
+def decompress(blob: bytes, backend: Optional[str] = None) -> bytes:
+    """Decompress one block (raises ValueError on corrupt input)."""
+    blob = bytes(blob)
+    if len(blob) < 4:
+        raise ValueError("AZ1: truncated header")
+    raw = int.from_bytes(blob[:4], "little")
+    if raw > 1 << 31:
+        raise ValueError("AZ1: implausible raw length")
+    lib = _native_lib() if backend in (None, "native") else None
+    if backend == "native" and lib is None:
+        raise RuntimeError("native codec unavailable (build native/codec.cc)")
+    if lib is not None:
+        buf = (ctypes.c_uint8 * max(raw, 1))()
+        got = lib.az1_decompress(blob, len(blob), buf, raw)
+        if got < 0:
+            raise ValueError("AZ1: corrupt block")
+        return bytes(bytearray(buf)[:got])
+    return _py_decompress(blob)

@@ -1,0 +1,62 @@
+"""The paper's core experiment: async vs sync under injected stragglers.
+
+Reproduces the shape of ASYNC's Figures 3-4 (arXiv:1907.08526) on a small
+planted problem: with straggler delay injected (the reference's
+delay-intensity knob), synchronous SGD pays the straggler every round while
+bounded-staleness ASGD keeps updating; an unbounded-tau run and a stale-read
+(ASYNCbroadcast) run complete the comparison.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+
+def run_one(mode, X, y, devices, iters, coeff, taw=2**31 - 1,
+            stale_offset=None):
+    from asyncframework_tpu.solvers import ASGD, SolverConfig
+
+    cfg = SolverConfig(
+        num_workers=8, num_iterations=iters, gamma=0.5,
+        taw=taw, batch_rate=0.3, bucket_ratio=0.5,
+        printer_freq=max(iters // 10, 1), coeff=coeff, seed=42,
+        calibration_iters=10, stale_read_offset=stale_offset,
+    )
+    solver = ASGD(X, y, cfg, devices=devices)
+    res = solver.run_sync() if mode == "sync" else solver.run()
+    return res
+
+
+def main(n: int = 4096, d: int = 32, iters: int = 200, coeff: float = 2.0,
+         quiet: bool = False):
+    import jax
+
+    from asyncframework_tpu.data import make_regression
+
+    X, y, _ = make_regression(n, d, seed=3)
+    devices = jax.devices()[:8] if len(jax.devices()) >= 8 else jax.devices()
+
+    rows = []
+    for name, kwargs in [
+        ("sync + straggler", dict(mode="sync", coeff=coeff,
+                                  iters=max(iters // 8, 10))),
+        ("async tau=inf", dict(mode="async", coeff=coeff, iters=iters)),
+        ("async tau=8", dict(mode="async", coeff=coeff, iters=iters, taw=8)),
+        ("async stale-read-2", dict(mode="async", coeff=coeff, iters=iters,
+                                    stale_offset=2)),
+    ]:
+        mode = kwargs.pop("mode")
+        it = kwargs.pop("iters")
+        res = run_one(mode, X, y, devices, it, **kwargs)
+        rows.append((name, res))
+        if not quiet:
+            first, last = res.trajectory[0][1], res.trajectory[-1][1]
+            print(f"{name:>20}: obj {first:8.4f} -> {last:8.6f}  "
+                  f"updates/s={res.updates_per_sec:7.1f}  "
+                  f"max_staleness={res.max_staleness}  "
+                  f"dropped={res.dropped}")
+    return {name: res for name, res in rows}
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+// AZ1: a byte-oriented LZ77 block codec (the framework's native
+// compression component).
+//
+// Role parity: the reference wires lz4/snappy/zstd through JNI for shuffle,
+// broadcast, and event-log compression (core/.../io/CompressionCodec.scala).
+// This framework's equivalent hot consumers are the write-ahead log and any
+// host-side blob that leaves memory.  AZ1 is an original, deliberately
+// simple design in the LZ4 family's spirit -- greedy hash-chain matching,
+// byte-aligned tokens -- tuned for "fast and safe" rather than maximal
+// ratio.
+//
+// Block format (little-endian):
+//   [u32 raw_len] followed by tokens until the block ends:
+//     control byte c:
+//       c & 0x80 == 0: literal run of (c & 0x7f) bytes (1..127), bytes follow
+//       c & 0x80 != 0: match; length = (c & 0x7f) + MIN_MATCH (4..131),
+//                      followed by u16 offset (1..65535) back from the
+//                      current output position
+//   matches may overlap forward (offset < length), enabling RLE.
+// The decoder is fully bounds-checked: any out-of-range offset, overlong
+// run, or truncated token fails with -1 instead of reading/writing OOB.
+//
+// Exported (C ABI, used via ctypes from utils/codec.py):
+//   long long az1_max_compressed_size(long long n);
+//   long long az1_compress(const uint8_t* src, long long n,
+//                          uint8_t* dst, long long cap);   // -1 = cap
+//   long long az1_decompress(const uint8_t* src, long long n,
+//                            uint8_t* dst, long long cap); // -1 = corrupt
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kMinMatch = 4;
+constexpr int kMaxMatchToken = 0x7f;             // match len 4..131
+constexpr int kMaxLiteralRun = 0x7f;             // 1..127
+constexpr long long kMaxOffset = 0xffff;
+constexpr int kHashBits = 15;
+constexpr uint32_t kHashMul = 2654435761u;       // Knuth multiplicative
+
+inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash4(const uint8_t* p) {
+  return (load32(p) * kHashMul) >> (32 - kHashBits);
+}
+
+}  // namespace
+
+extern "C" {
+
+long long az1_max_compressed_size(long long n) {
+  // worst case: all literals -> ceil(n/127) control bytes + n + header
+  if (n < 0) return -1;
+  return 4 + n + (n / kMaxLiteralRun + 1);
+}
+
+long long az1_compress(const uint8_t* src, long long n, uint8_t* dst,
+                       long long cap) {
+  if (n < 0 || cap < 4 || n > 0x7fffffffLL) return -1;
+  uint8_t* out = dst;
+  uint8_t* out_end = dst + cap;
+  uint32_t raw = (uint32_t)n;
+  if (out + 4 > out_end) return -1;
+  std::memcpy(out, &raw, 4);
+  out += 4;
+
+  long long table[1 << kHashBits];
+  for (auto& t : table) t = -1;
+
+  long long i = 0;
+  long long lit_start = 0;
+
+  auto flush_literals = [&](long long upto) -> bool {
+    long long len = upto - lit_start;
+    while (len > 0) {
+      int run = len > kMaxLiteralRun ? kMaxLiteralRun : (int)len;
+      if (out + 1 + run > out_end) return false;
+      *out++ = (uint8_t)run;
+      std::memcpy(out, src + lit_start, run);
+      out += run;
+      lit_start += run;
+      len -= run;
+    }
+    return true;
+  };
+
+  while (i + kMinMatch <= n) {
+    uint32_t h = hash4(src + i);
+    long long cand = table[h];
+    table[h] = i;
+    if (cand >= 0 && i - cand <= kMaxOffset &&
+        load32(src + cand) == load32(src + i)) {
+      // extend the match
+      long long len = kMinMatch;
+      long long max_len = n - i;
+      if (max_len > kMaxMatchToken + kMinMatch)
+        max_len = kMaxMatchToken + kMinMatch;
+      while (len < max_len && src[cand + len] == src[i + len]) ++len;
+      if (!flush_literals(i)) return -1;
+      if (out + 3 > out_end) return -1;
+      *out++ = (uint8_t)(0x80 | (len - kMinMatch));
+      uint16_t off = (uint16_t)(i - cand);
+      std::memcpy(out, &off, 2);
+      out += 2;
+      // seed the table inside the match so later data can reference it
+      long long stop = i + len - kMinMatch;
+      for (long long j = i + 1; j <= stop; ++j) table[hash4(src + j)] = j;
+      i += len;
+      lit_start = i;
+    } else {
+      ++i;
+    }
+  }
+  if (!flush_literals(n)) return -1;
+  return out - dst;
+}
+
+long long az1_decompress(const uint8_t* src, long long n, uint8_t* dst,
+                         long long cap) {
+  if (n < 4) return -1;
+  uint32_t raw;
+  std::memcpy(&raw, src, 4);
+  if ((long long)raw > cap) return -1;
+  const uint8_t* in = src + 4;
+  const uint8_t* in_end = src + n;
+  uint8_t* out = dst;
+  uint8_t* out_end = dst + raw;
+
+  while (out < out_end) {
+    if (in >= in_end) return -1;  // truncated token
+    uint8_t c = *in++;
+    if (c & 0x80) {
+      long long len = (c & 0x7f) + kMinMatch;
+      if (in + 2 > in_end) return -1;
+      uint16_t off;
+      std::memcpy(&off, in, 2);
+      in += 2;
+      if (off == 0 || (long long)(out - dst) < off) return -1;
+      if (out + len > out_end) return -1;
+      // byte-by-byte on purpose: overlapping matches (offset < len) must
+      // replicate forward, memcpy semantics would be undefined
+      const uint8_t* from = out - off;
+      for (long long j = 0; j < len; ++j) out[j] = from[j];
+      out += len;
+    } else {
+      if (c == 0) return -1;  // zero-length literal run is invalid
+      if (in + c > in_end) return -1;
+      if (out + c > out_end) return -1;
+      std::memcpy(out, in, c);
+      in += c;
+      out += c;
+    }
+  }
+  if (in != in_end) return -1;  // trailing garbage
+  return (long long)raw;
+}
+
+}  // extern "C"

@@ -291,3 +291,23 @@ class TestRDDBreadth:
         assert sorted(a.cartesian(b).collect()) == [
             (1, "x"), (1, "y"), (2, "x"), (2, "y")
         ]
+
+    def test_set_ops_are_lazy(self, sched):
+        computed = {"n": 0}
+
+        def make_part(vals):
+            def run():
+                computed["n"] += 1
+                return vals
+            return run
+
+        other = DistributedDataset(
+            sched, {0: make_part([2, 4]), 1: make_part([5])}
+        )
+        a = DistributedDataset.from_list(sched, [1, 2, 3, 4])
+        diff = a.subtract(other)
+        cart = a.cartesian(other)
+        assert computed["n"] == 0  # defining transformations computed nothing
+        assert sorted(diff.collect()) == [1, 3]
+        assert computed["n"] > 0
+        assert len(cart.collect()) == 4 * 3

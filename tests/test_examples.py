@@ -71,3 +71,14 @@ def test_sparse_asgd_example():
 
     res = sparse_asgd.main(n=512, d=4096, iters=60, quiet=True)
     assert res.accepted == 60
+
+
+def test_staleness_experiment_example():
+    import staleness_experiment
+
+    out = staleness_experiment.main(n=1024, d=16, iters=80, coeff=1.0,
+                                    quiet=True)
+    assert set(out) == {"sync + straggler", "async tau=inf", "async tau=8",
+                        "async stale-read-2"}
+    for res in out.values():
+        assert res.trajectory[-1][1] < res.trajectory[0][1]

@@ -328,3 +328,21 @@ class TestRandomForest:
                              feature_subset_strategy="all", seed=1).fit(X, y)
         r2 = RegressionMetrics.of(model.predict(X), y).r2
         assert r2 > 0.5
+
+
+class TestSoftmaxRegression:
+    def test_multiclass_close_to_sklearn(self, clf_data):
+        from sklearn.linear_model import LogisticRegression as SKLR
+
+        from asyncframework_tpu.ml import SoftmaxRegression
+
+        X, y = clf_data
+        model = SoftmaxRegression(step_size=0.5, num_iterations=400).fit(X, y)
+        acc = (model.predict(X) == y).mean()
+        sk_acc = (SKLR(max_iter=400).fit(X, y).predict(X) == y).mean()
+        assert acc >= sk_acc - 0.03, (acc, sk_acc)
+        # loss monotonically decreasing over the scan (full batch, fixed lr)
+        losses = model.loss_history
+        assert losses[-1] < losses[0]
+        p = model.predict_proba(X[:5])
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)

@@ -262,3 +262,25 @@ class TestReviewRegressions:
         np.testing.assert_allclose(
             np.asarray(grouped["s"]), expect.to_numpy(), rtol=1e-6
         )
+
+    def test_count_one_literal(self, csv_path):
+        got = sql("SELECT COUNT(1) AS n FROM t", t=read_csv(csv_path))
+        assert int(np.asarray(got["n"])[0]) == 6
+
+    def test_two_unaliased_expression_aggs_both_survive(self, csv_path):
+        got = sql("SELECT SUM(v * 2), SUM(v + 1) FROM t", t=read_csv(csv_path))
+        assert len(got.columns) == 2
+        vals = sorted(float(np.asarray(got[c])[0]) for c in got.columns)
+        assert vals == [27.0, 42.0]  # sum(v)+6 and 2*sum(v)
+
+    def test_nullable_wide_ints_stay_exact(self, tmp_path):
+        p = tmp_path / "n.jsonl"
+        p.write_text('{"id": 20000001}\n{"id": null}\n{"id": 3000000000}\n')
+        ids = np.asarray(read_json(p)["id"])
+        assert ids.dtype == object
+        assert ids[0] == 20000001 and ids[1] is None and ids[2] == 3000000000
+
+        c = tmp_path / "n.csv"
+        c.write_text("id\n3000000000\n\n")
+        got = np.asarray(read_csv(c)["id"])
+        assert got.dtype == object and got[0] == 3000000000

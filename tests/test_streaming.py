@@ -326,3 +326,63 @@ class TestStreamingStateCheckpoint:
             ssc.generate_batch(100)
             ssc.generate_batch(200)
         assert out == [["first"], ["second"]]
+
+
+class TestReceivers:
+    def test_receiver_stream_batches_by_interval(self):
+        from asyncframework_tpu.streaming import ReceiverStream
+
+        ssc = StreamingContext(batch_interval_ms=100)
+        rec = ReceiverStream(ssc)
+        out = []
+        rec.foreach_batch(lambda t, b: out.append(list(b)))
+        rec.store("a"); rec.store("b")
+        ssc.generate_batch(100)
+        ssc.generate_batch(200)  # nothing buffered: no output fires
+        rec.store("c")
+        ssc.generate_batch(300)
+        assert out == [["a", "b"], ["c"]]
+
+    def test_socket_text_stream_end_to_end(self, tmp_path):
+        import socket as socketlib
+        import threading
+        import time as _time
+
+        from asyncframework_tpu.streaming import SocketTextStream, WriteAheadLog
+
+        server = socketlib.socket()
+        server.bind(("127.0.0.1", 0))
+        server.listen(1)
+        port = server.getsockname()[1]
+
+        def serve():
+            conn, _ = server.accept()
+            with conn:
+                conn.sendall(b"alpha\nbeta\ngam")
+                _time.sleep(0.05)
+                conn.sendall(b"ma\n")
+                _time.sleep(0.2)
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+
+        ssc = StreamingContext(batch_interval_ms=100)
+        wal = WriteAheadLog(tmp_path / "rx-wal", compress=True)
+        rx = SocketTextStream(ssc, "127.0.0.1", port, wal=wal)
+        counts = rx.map_batch(lambda lines: len(lines))
+        seen = []
+        counts.foreach_batch(lambda tms, n: seen.append(n))
+        rx.start()
+        deadline = _time.monotonic() + 5
+        tick = 1
+        while sum(seen) < 3 and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+            ssc.generate_batch(tick * 100)
+            tick += 1
+        rx.stop()
+        server.close()
+        assert sum(seen) == 3  # all three lines arrived, split-safe
+        # reliability: the WAL persisted every drained batch
+        replayed = [b for (_t2, b) in wal.replay()]
+        wal.close()
+        assert sorted(x for b in replayed for x in b) == ["alpha", "beta", "gamma"]
