@@ -31,8 +31,22 @@ from asyncframework_tpu.ml.models import (
 )
 from asyncframework_tpu.ml.clustering import KMeans, KMeansModel
 from asyncframework_tpu.ml.recommendation import ALS, ALSModel
-from asyncframework_tpu.ml.feature import MinMaxScaler, Normalizer, StandardScaler
-from asyncframework_tpu.ml.stat import ColStats, col_stats, corr
+from asyncframework_tpu.ml.feature import (
+    IDF,
+    HashingTF,
+    IDFModel,
+    MinMaxScaler,
+    Normalizer,
+    StandardScaler,
+)
+from asyncframework_tpu.ml.stat import (
+    ChiSqTestResult,
+    ColStats,
+    chi_sq_test,
+    chi_sq_test_matrix,
+    col_stats,
+    corr,
+)
 
 from asyncframework_tpu.ml.bayes import NaiveBayes, NaiveBayesModel
 from asyncframework_tpu.ml.decomposition import PCA, PCAModel, svd
@@ -45,6 +59,7 @@ from asyncframework_tpu.ml.tree import DecisionTree, DecisionTreeModel
 from asyncframework_tpu.ml.forest import RandomForest, RandomForestModel
 from asyncframework_tpu.ml.mixture import GaussianMixture, GaussianMixtureModel
 from asyncframework_tpu.ml.fpm import FPGrowth, FPGrowthModel, Rule
+from asyncframework_tpu.ml.lda import LDA, LDAModel
 
 __all__ = [
     "ALS",
@@ -90,4 +105,12 @@ __all__ = [
     "FPGrowth",
     "FPGrowthModel",
     "Rule",
+    "LDA",
+    "LDAModel",
+    "HashingTF",
+    "IDF",
+    "IDFModel",
+    "ChiSqTestResult",
+    "chi_sq_test",
+    "chi_sq_test_matrix",
 ]

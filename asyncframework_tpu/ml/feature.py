@@ -96,3 +96,70 @@ class Normalizer:
         else:
             n = jnp.max(jnp.abs(X), axis=1, keepdims=True)
         return X / jnp.where(n > 0, n, 1.0)
+
+
+class HashingTF:
+    """Term-frequency vectors by the hashing trick.
+
+    Parity: ``mllib/.../feature/HashingTF.scala`` -- term -> bucket via a
+    stable hash mod ``num_features``; a document's vector counts bucket
+    hits.  TPU mapping: per-document token hashes are computed host-side
+    (strings), the count matrix lands via one device scatter-add.
+    """
+
+    def __init__(self, num_features: int = 1 << 10):
+        if num_features < 1:
+            raise ValueError("num_features must be >= 1")
+        self.num_features = num_features
+
+    def indices(self, doc) -> np.ndarray:
+        from asyncframework_tpu.data.pairs import portable_hash
+
+        return np.asarray(
+            [portable_hash(t) % self.num_features for t in doc], np.int32
+        )
+
+    def transform(self, docs) -> jnp.ndarray:
+        """docs: iterable of token iterables -> (n_docs, num_features)."""
+        import jax
+
+        docs = list(docs)
+        if not docs:
+            # empty corpora flow through (filter-then-vectorize pipelines)
+            return jnp.zeros((0, self.num_features), jnp.float32)
+        rows = []
+        cols = []
+        for i, doc in enumerate(docs):
+            idx = self.indices(doc)
+            rows.append(np.full(len(idx), i, np.int32))
+            cols.append(idx)
+        r = jnp.asarray(np.concatenate(rows))
+        c = jnp.asarray(np.concatenate(cols))
+        out = jnp.zeros((len(docs), self.num_features), jnp.float32)
+        return out.at[r, c].add(1.0)
+
+
+class IDFModel:
+    def __init__(self, idf: jnp.ndarray):
+        self.idf = idf
+
+    def transform(self, tf) -> jnp.ndarray:
+        return jnp.asarray(tf, jnp.float32) * self.idf[None, :]
+
+
+class IDF:
+    """Inverse document frequency (``mllib/.../feature/IDF.scala``):
+    ``idf = log((n_docs + 1) / (df + 1))`` with ``min_doc_freq`` zeroing
+    rare terms, fit as one device reduction over the TF matrix."""
+
+    def __init__(self, min_doc_freq: int = 0):
+        self.min_doc_freq = min_doc_freq
+
+    def fit(self, tf) -> IDFModel:
+        tf = jnp.asarray(tf, jnp.float32)
+        n = tf.shape[0]
+        df = jnp.sum(tf > 0, axis=0).astype(jnp.float32)
+        idf = jnp.log((n + 1.0) / (df + 1.0))
+        if self.min_doc_freq > 0:
+            idf = jnp.where(df >= self.min_doc_freq, idf, 0.0)
+        return IDFModel(idf)

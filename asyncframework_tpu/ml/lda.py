@@ -1,0 +1,139 @@
+"""Latent Dirichlet Allocation by batch variational EM.
+
+Parity: ``mllib/src/main/scala/org/apache/spark/mllib/clustering/LDA.scala``
+(``EMLDAOptimizer`` / ``OnlineLDAOptimizer``) -- topic distributions over a
+vocabulary, document-topic mixtures, Dirichlet priors alpha (doc-topic) and
+eta (topic-word).
+
+TPU mapping: the whole variational E-step is batched over documents -- the
+fixed-point iteration for every document's gamma runs as (D, K) x (K, V)
+matmuls on the MXU (the reference's per-document loop becomes two GEMMs per
+iteration), and the M-step's sufficient statistics are one more GEMM.  The
+term-count matrix is dense (D, V): the tested regime is vocab up to ~tens of
+thousands, exactly the reference's experiments' scale.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _exp_elog_dirichlet(x):
+    """exp(E[log theta]) for Dirichlet rows: digamma(x) - digamma(sum x)."""
+    from jax.scipy.special import digamma
+
+    return jnp.exp(digamma(x) - digamma(jnp.sum(x, axis=1, keepdims=True)))
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _e_step(X, exp_elog_beta, alpha, n_iter):
+    """Batched variational fixed point: returns (gamma (D,K), sstats (K,V))."""
+    D = X.shape[0]
+    K = exp_elog_beta.shape[0]
+    gamma0 = jnp.full((D, K), 1.0, jnp.float32)
+
+    def body(_, gamma):
+        elog_t = _exp_elog_dirichlet(gamma)          # (D, K)
+        phinorm = elog_t @ exp_elog_beta + 1e-30     # (D, V)
+        return alpha + elog_t * ((X / phinorm) @ exp_elog_beta.T)
+
+    gamma = jax.lax.fori_loop(0, n_iter, body, gamma0)
+    elog_t = _exp_elog_dirichlet(gamma)
+    phinorm = elog_t @ exp_elog_beta + 1e-30
+    sstats = elog_t.T @ (X / phinorm) * exp_elog_beta
+    return gamma, sstats
+
+
+@dataclass
+class LDAModel:
+    topics: np.ndarray        # (K, V) normalized topic-word distributions
+    doc_topics: np.ndarray    # (D, K) normalized training doc mixtures
+    alpha: float
+    log_perplexity_history: np.ndarray
+
+    @property
+    def k(self) -> int:
+        return self.topics.shape[0]
+
+    def describe_topics(self, max_terms: int = 10):
+        """[(term indices, weights)] per topic, weight-descending
+        (``LDAModel.describeTopics`` parity)."""
+        out = []
+        for k in range(self.k):
+            order = np.argsort(-self.topics[k])[:max_terms]
+            out.append((order, self.topics[k][order]))
+        return out
+
+    def transform(self, X, n_iter: int = 50) -> np.ndarray:
+        """Infer doc-topic mixtures for new documents."""
+        lam = jnp.asarray(self.topics, jnp.float32) + 1e-12
+        exp_elog_beta = lam / lam.sum(axis=1, keepdims=True)
+        gamma, _ = _e_step(
+            jnp.asarray(X, jnp.float32), exp_elog_beta,
+            jnp.float32(self.alpha), n_iter,
+        )
+        g = np.asarray(gamma)
+        return g / g.sum(axis=1, keepdims=True)
+
+
+class LDA:
+    """``new LDA().setK(k).run(corpus)`` analog (batch variational EM)."""
+
+    def __init__(
+        self,
+        k: int,
+        max_iterations: int = 50,
+        doc_concentration: float = None,
+        topic_concentration: float = 1.01,
+        e_step_iters: int = 30,
+        seed: int = 0,
+    ):
+        if k < 2:
+            raise ValueError("k must be >= 2")
+        if max_iterations < 1:
+            raise ValueError("max_iterations must be >= 1")
+        self.k = k
+        self.max_iterations = max_iterations
+        # reference defaults: alpha = 50/k + 1 (EM); keep the spirit, smaller
+        self.alpha = doc_concentration if doc_concentration is not None \
+            else 1.0 / k
+        self.eta = topic_concentration
+        self.e_iters = e_step_iters
+        self.seed = seed
+
+    def fit(self, X) -> LDAModel:
+        """X: (D, V) term-count matrix (dense; counts, not tf-idf)."""
+        Xd = jnp.asarray(X, jnp.float32)
+        D, V = Xd.shape
+        rs = np.random.default_rng(self.seed)
+        lam = jnp.asarray(
+            rs.gamma(100.0, 0.01, size=(self.k, V)).astype(np.float32)
+        )
+        total_tokens = float(jnp.sum(Xd))
+        hist = []
+        gamma = None
+        for _ in range(self.max_iterations):
+            exp_elog_beta = _exp_elog_dirichlet(lam)
+            gamma, sstats = _e_step(
+                Xd, exp_elog_beta, jnp.float32(self.alpha), self.e_iters
+            )
+            lam = self.eta + sstats  # M-step
+            # variational bound proxy: per-token log likelihood
+            beta = lam / lam.sum(axis=1, keepdims=True)
+            theta = gamma / gamma.sum(axis=1, keepdims=True)
+            ll = jnp.sum(Xd * jnp.log(theta @ beta + 1e-30))
+            hist.append(-float(ll) / total_tokens)
+        beta = np.asarray(lam / lam.sum(axis=1, keepdims=True))
+        g = np.asarray(gamma)
+        return LDAModel(
+            topics=beta,
+            doc_topics=g / g.sum(axis=1, keepdims=True),
+            alpha=self.alpha,
+            log_perplexity_history=np.asarray(hist),
+        )

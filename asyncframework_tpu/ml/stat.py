@@ -127,3 +127,64 @@ def corr(X, method: str = "pearson") -> np.ndarray:
         )
         return np.asarray(_pearson(jnp.asarray(R, jnp.float32)))
     raise ValueError(f"unknown correlation method {method!r}")
+
+
+@dataclass(frozen=True)
+class ChiSqTestResult:
+    """Parity: ``mllib/.../stat/test/ChiSqTest.scala`` result fields."""
+
+    statistic: float
+    degrees_of_freedom: int
+    p_value: float
+    method: str = "pearson"
+
+
+def _chi2_sf(x: float, df: int) -> float:
+    """Chi-square survival function = regularized UPPER incomplete gamma
+    (gammaincc directly: ``1 - gammainc`` would lose every significant
+    digit once p drops below float32 epsilon)."""
+    from jax.scipy.special import gammaincc
+
+    if x <= 0:
+        return 1.0
+    return float(gammaincc(df / 2.0, x / 2.0))
+
+
+def chi_sq_test(observed, expected=None) -> ChiSqTestResult:
+    """Pearson goodness-of-fit test of an observed frequency vector against
+    ``expected`` (uniform when omitted), like ``Statistics.chiSqTest(vec)``."""
+    obs = jnp.asarray(observed, jnp.float32)
+    if obs.ndim != 1:
+        raise ValueError("observed must be 1-d; use chi_sq_test_matrix")
+    n = obs.shape[0]
+    if expected is None:
+        exp = jnp.full(n, jnp.sum(obs) / n)
+    else:
+        exp = jnp.asarray(expected, jnp.float32)
+        # scale expected to the observed total (reference semantics)
+        exp = exp * (jnp.sum(obs) / jnp.sum(exp))
+    if bool(jnp.any(exp <= 0)):
+        # the reference's ChiSqTest raises on non-positive expected
+        # frequencies; silent inf/nan would poison downstream comparisons
+        raise ValueError("chi_sq_test: expected frequencies must be > 0")
+    stat = float(jnp.sum((obs - exp) ** 2 / exp))
+    df = int(n - 1)
+    return ChiSqTestResult(stat, df, _chi2_sf(stat, df))
+
+
+def chi_sq_test_matrix(counts) -> ChiSqTestResult:
+    """Pearson independence test on a contingency matrix, like
+    ``Statistics.chiSqTest(Matrix)``: expected = outer(row, col) / total."""
+    m = jnp.asarray(counts, jnp.float32)
+    if m.ndim != 2:
+        raise ValueError("counts must be a 2-d contingency matrix")
+    total = jnp.sum(m)
+    exp = jnp.outer(jnp.sum(m, axis=1), jnp.sum(m, axis=0)) / total
+    if bool(jnp.any(exp <= 0)):
+        raise ValueError(
+            "chi_sq_test_matrix: every row and column must have a "
+            "positive total (empty rows/columns make the test undefined)"
+        )
+    stat = float(jnp.sum((m - exp) ** 2 / exp))
+    df = int((m.shape[0] - 1) * (m.shape[1] - 1))
+    return ChiSqTestResult(stat, df, _chi2_sf(stat, df))

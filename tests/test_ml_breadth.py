@@ -346,3 +346,127 @@ class TestSoftmaxRegression:
         assert losses[-1] < losses[0]
         p = model.predict_proba(X[:5])
         np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+
+
+class TestTFIDFandChiSq:
+    DOCS = [["tpu", "async", "tpu"], ["async"], ["sgd", "tpu"]]
+
+    def test_hashing_tf_counts(self):
+        from asyncframework_tpu.ml import HashingTF
+
+        tf = HashingTF(64)
+        M = np.asarray(tf.transform(self.DOCS))
+        assert M.shape == (3, 64)
+        # row sums = token counts; identical tokens share a bucket
+        np.testing.assert_array_equal(M.sum(axis=1), [3, 1, 2])
+        tpu_bucket = tf.indices(["tpu"])[0]
+        assert M[0, tpu_bucket] == 2
+
+    def test_tf_idf_matches_sklearn_formula(self):
+        from asyncframework_tpu.ml import IDF, HashingTF
+
+        tf = HashingTF(128).transform(self.DOCS)
+        model = IDF().fit(tf)
+        tfidf = np.asarray(model.transform(tf))
+        # spot-check the "sgd" term: df=1, idf=log(4/2)
+        from asyncframework_tpu.ml import HashingTF as H
+
+        b = H(128).indices(["sgd"])[0]
+        np.testing.assert_allclose(tfidf[2, b], np.log(4 / 2), rtol=1e-5)
+        # "async": df=2 -> log(4/3)
+        b2 = H(128).indices(["async"])[0]
+        np.testing.assert_allclose(tfidf[1, b2], np.log(4 / 3), rtol=1e-5)
+
+    def test_min_doc_freq_zeroes_rare_terms(self):
+        from asyncframework_tpu.ml import IDF, HashingTF
+
+        tf = HashingTF(128).transform(self.DOCS)
+        model = IDF(min_doc_freq=2).fit(tf)
+        b = HashingTF(128).indices(["sgd"])[0]  # df=1 < 2
+        assert float(model.idf[b]) == 0.0
+
+    def test_chi_sq_goodness_of_fit_matches_scipy(self):
+        from scipy.stats import chisquare
+
+        from asyncframework_tpu.ml import chi_sq_test
+
+        obs = [16, 18, 16, 14, 12, 12]
+        got = chi_sq_test(obs)
+        ref = chisquare(obs)
+        np.testing.assert_allclose(got.statistic, ref.statistic, rtol=1e-5)
+        np.testing.assert_allclose(got.p_value, ref.pvalue, rtol=1e-4)
+        assert got.degrees_of_freedom == 5
+
+    def test_chi_sq_independence_matches_scipy(self):
+        from scipy.stats import chi2_contingency
+
+        from asyncframework_tpu.ml import chi_sq_test_matrix
+
+        table = [[10, 20, 30], [6, 9, 17]]
+        got = chi_sq_test_matrix(table)
+        ref = chi2_contingency(table, correction=False)
+        np.testing.assert_allclose(got.statistic, ref.statistic, rtol=1e-5)
+        np.testing.assert_allclose(got.p_value, ref.pvalue, rtol=1e-4)
+        assert got.degrees_of_freedom == 2
+
+
+class TestLDA:
+    def synthetic_corpus(self, n_docs=200, vocab=40, seed=0):
+        """Two planted topics on disjoint vocab halves."""
+        rs = np.random.default_rng(seed)
+        X = np.zeros((n_docs, vocab), np.float32)
+        labels = rs.random(n_docs) < 0.5
+        for i in range(n_docs):
+            lo, hi = (0, vocab // 2) if labels[i] else (vocab // 2, vocab)
+            words = rs.integers(lo, hi, 30)
+            np.add.at(X[i], words, 1)
+        return X, labels
+
+    def test_recovers_planted_topics(self):
+        from asyncframework_tpu.ml import LDA
+
+        X, labels = self.synthetic_corpus()
+        model = LDA(k=2, max_iterations=30, seed=1).fit(X)
+        # each learned topic concentrates on one vocab half
+        half = X.shape[1] // 2
+        mass_lo = model.topics[:, :half].sum(axis=1)
+        assert (mass_lo > 0.95).any() and (mass_lo < 0.05).any()
+        # doc mixtures separate the two doc groups
+        t0 = model.doc_topics[labels].argmax(axis=1)
+        t1 = model.doc_topics[~labels].argmax(axis=1)
+        assert (t0 == np.bincount(t0).argmax()).mean() > 0.95
+        assert np.bincount(t0).argmax() != np.bincount(t1).argmax()
+
+    def test_perplexity_decreases_and_transform(self):
+        from asyncframework_tpu.ml import LDA
+
+        X, _ = self.synthetic_corpus(seed=3)
+        model = LDA(k=2, max_iterations=25, seed=2).fit(X)
+        h = model.log_perplexity_history
+        assert h[-1] < h[0]
+        mix = model.transform(X[:10])
+        np.testing.assert_allclose(mix.sum(axis=1), 1.0, rtol=1e-4)
+        terms, weights = model.describe_topics(5)[0]
+        assert len(terms) == 5 and (np.diff(weights) <= 1e-9).all()
+
+    def test_chi_sq_rejects_zero_expected(self):
+        from asyncframework_tpu.ml import chi_sq_test, chi_sq_test_matrix
+
+        with pytest.raises(ValueError, match="expected"):
+            chi_sq_test([5, 3], expected=[1, 0])
+        with pytest.raises(ValueError, match="positive total"):
+            chi_sq_test_matrix([[0, 0], [3, 4]])
+
+    def test_chi_sq_extreme_p_not_underflowed_to_garbage(self):
+        from asyncframework_tpu.ml import chi_sq_test
+
+        res = chi_sq_test([1000, 10])
+        assert res.statistic > 900
+        assert 0.0 <= res.p_value < 1e-30  # survival fn, not 1 - cdf
+
+    def test_empty_corpus_flows(self):
+        from asyncframework_tpu.ml import IDF, HashingTF
+
+        tf = HashingTF(32).transform([])
+        assert np.asarray(tf).shape == (0, 32)
+        IDF().fit(tf)  # no crash
