@@ -121,12 +121,11 @@ class HashingTF:
 
     def transform(self, docs) -> jnp.ndarray:
         """docs: iterable of token iterables -> (n_docs, num_features)."""
-        import jax
-
         docs = list(docs)
         if not docs:
             # empty corpora flow through (filter-then-vectorize pipelines)
             return jnp.zeros((0, self.num_features), jnp.float32)
+        # (rows/cols built host-side; the count matrix is one scatter-add)
         rows = []
         cols = []
         for i, doc in enumerate(docs):

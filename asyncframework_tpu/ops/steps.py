@@ -170,6 +170,37 @@ def make_saga_table_delta():
     return delta
 
 
+def make_asgd_apply_batch(
+    gamma: float, batch_rate: float, n: int, num_workers: int, m: int
+):
+    """jit (w, G (m, d), mask (m,), k) -> (w', k') -- ``m`` queued gradients
+    applied in ONE dispatch.
+
+    Exactness: the sequential accept path is ``w <- w - c_j g_j`` with step
+    sizes ``c_j = (gamma / sqrt(k_j/P + 1)) / parRecs`` that do not depend on
+    ``w``, so a drained batch folds into one masked weighted sum --
+    numerically the same model (up to float addition order) at 1/m the
+    dispatch cost.  The reference drains its whole queue per updater wake for
+    the same reason (``SparkASGDThread.scala:154-158``); here the drain is
+    also one device op.  ``mask`` marks accepted entries (stale slots are 0);
+    ``k`` advances by the number accepted.
+    """
+    par_recs = batch_rate * n / num_workers
+
+    # only k is donated: no output matches G/mask shapes, so donating them
+    # would just emit unusable-buffer warnings
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def apply_batch(w, G, mask, k):
+        accepted_before = jnp.cumsum(mask) - mask  # per-slot accepted count
+        kk = k + accepted_before
+        lr = gamma / jnp.sqrt(kk / num_workers + 1.0)
+        coeff = (lr / par_recs) * mask
+        return w - coeff @ G, k + jnp.sum(mask)
+
+    del m  # shape is carried by G itself; kept in the signature for intent
+    return apply_batch
+
+
 # ------------------------------------------------------------------ sparse
 def make_sparse_asgd_worker_step(batch_rate: float, d: int):
     """jit (cols, vals, y, w, key) -> (g_sum (d,), new_key).

@@ -189,40 +189,100 @@ class ASGD:
                 worker_keys=keys_h,
             )
 
+        apply_batch = steps.make_asgd_apply_batch(
+            cfg.gamma, cfg.batch_rate, self.ds.n, nw, cfg.drain_batch
+        )
+
         def updater():
+            max_drain = max(cfg.drain_batch, 1)
             while not stop.is_set():
                 with state_lock:
                     if state["k"] >= cfg.num_iterations:
                         break
                 try:
-                    res = ctx.collect_all(timeout=cfg.collect_timeout_s)
+                    results = [ctx.collect_all(timeout=cfg.collect_timeout_s)]
                 except queue.Empty:
                     continue
-                g = res.data
-                task_ms = waiting.on_finish(res.worker_id, now_ms())
+                # opportunistic drain: everything already queued, up to the
+                # batch cap, folds into one device dispatch below
+                while len(results) < max_drain:
+                    try:
+                        results.append(ctx.collect_all(timeout=0))
+                    except queue.Empty:
+                        break
                 do_save = False
                 with state_lock:
                     k = state["k"]
-                    accepted = res.staleness <= cfg.taw
-                    if accepted:
-                        if g.device != self.driver_device:
-                            g = jax.device_put(g, self.driver_device)
-                        state["w"], state["k_dev"] = self._apply(
-                            state["w"], g, state["k_dev"]
+                    # never apply past the iteration budget: trim the batch
+                    room = cfg.num_iterations - k
+                    merged = []
+                    accepted_g = []
+                    for res in results:
+                        task_ms = waiting.on_finish(res.worker_id, now_ms())
+                        if res.staleness > cfg.taw:
+                            state["dropped"] += 1
+                            merged.append((res, False, task_ms))
+                        elif len(accepted_g) < room:
+                            g = res.data
+                            if g.device != self.driver_device:
+                                g = jax.device_put(g, self.driver_device)
+                            accepted_g.append(g)
+                            calibrator.record(
+                                k + len(accepted_g) - 1, task_ms
+                            )
+                            merged.append((res, True, task_ms))
+                        # else: beyond the iteration budget -- ignored, like
+                        # the old per-result loop's break-at-limit
+                    if len(accepted_g) >= 3:
+                        # stack+apply = 2 dispatches replacing m; below 3
+                        # the stack copy costs more than it saves.  G is
+                        # padded to the fixed (max_drain, d) shape with a
+                        # zero mask tail so apply_batch compiles ONCE, not
+                        # once per drained batch size.
+                        mcount = len(accepted_g)
+                        G = jnp.stack(accepted_g)
+                        if mcount < max_drain:
+                            G = jnp.concatenate([
+                                G,
+                                jnp.zeros(
+                                    (max_drain - mcount, G.shape[1]), G.dtype
+                                ),
+                            ])
+                        mask = jnp.asarray(
+                            np.concatenate([
+                                np.ones(mcount, np.float32),
+                                np.zeros(max_drain - mcount, np.float32),
+                            ])
                         )
-                        state["k"] = k + 1
-                        state["accepted"] += 1
-                        calibrator.record(k, task_ms)
-                        if k % cfg.printer_freq == 0:
-                            snapshots.append((now_ms(), state["w"]))
-                        do_save = ckpt.should_save(state["k"])
-                        save_k, save_w = state["k"], state["w"]
+                        state["w"], state["k_dev"] = apply_batch(
+                            state["w"], G, mask, state["k_dev"]
+                        )
                     else:
-                        state["dropped"] += 1
-                inst.on_gradient_merged(
-                    res.worker_id, res.staleness, accepted, k,
-                    batch_size=res.batch_size, task_ms=task_ms,
-                )
+                        for g in accepted_g:
+                            state["w"], state["k_dev"] = self._apply(
+                                state["w"], g, state["k_dev"]
+                            )
+                    if accepted_g:
+                        k_new = k + len(accepted_g)
+                        state["k"] = k_new
+                        state["accepted"] += len(accepted_g)
+                        # snapshot when the batch crossed a printer boundary
+                        # (the single-apply path snapshotted at each
+                        # k % printer_freq == 0; a batch may cover several)
+                        if any(
+                            (k + j) % cfg.printer_freq == 0
+                            for j in range(len(accepted_g))
+                        ):
+                            snapshots.append((now_ms(), state["w"]))
+                        # range check: a batch jumping over a checkpoint
+                        # boundary must still save
+                        do_save = ckpt.should_save_range(k, k_new)
+                        save_k, save_w = state["k"], state["w"]
+                for res, accepted, task_ms in merged:
+                    inst.on_gradient_merged(
+                        res.worker_id, res.staleness, accepted, k,
+                        batch_size=res.batch_size, task_ms=task_ms,
+                    )
                 if do_save:
                     save_checkpoint(save_k, save_w)
                 if calibrator.maybe_finalize(state["k"]):

@@ -113,6 +113,16 @@ class SolverCheckpointer:
             and k % self.cfg.checkpoint_freq == 0
         )
 
+    def should_save_range(self, k_old: int, k_new: int) -> bool:
+        """True when any k in (k_old, k_new] hits the cadence -- a batched
+        drain may jump OVER a checkpoint boundary and must still save."""
+        freq = self.cfg.checkpoint_freq
+        return (
+            self.mgr is not None
+            and freq > 0
+            and k_new // freq > k_old // freq
+        )
+
     def save(self, k: int, **state) -> None:
         self.mgr.save(k, {**state, "k": k, "meta": self.meta})
 
@@ -149,6 +159,13 @@ class SolverConfig:
     calibration_iters: Optional[int] = None  # default 100 * num_workers
     collect_timeout_s: float = 0.05
     run_timeout_s: float = 600.0
+    # updater drain batching (SparkASGDThread.scala:154-158 drains the whole
+    # queue per wake; with drain_batch > 1 a drained batch also folds into
+    # ONE device dispatch -- exact for ASGD's w-independent step sizes).
+    # Default 1: on fast-dispatch backends the stack copy outweighs the
+    # saved dispatches (measured on the CPU mesh); raise it when per-dispatch
+    # latency dominates (remote/tunneled devices).
+    drain_batch: int = 1
     # checkpoint/resume (SURVEY.md section 5: a capability the reference lacks)
     checkpoint_dir: Optional[str] = None  # None = checkpointing off
     checkpoint_freq: int = 0              # accepted updates between saves; 0 = off

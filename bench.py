@@ -42,7 +42,10 @@ from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers import ASGD, SolverConfig
 
-N, D = 400_000, 2_000
+# BENCH_N/BENCH_D env overrides let the full flow be validated on a small
+# CPU problem; the driver's TPU run uses the defaults
+N = int(os.environ.get("BENCH_N", 400_000))
+D = int(os.environ.get("BENCH_D", 2_000))
 NUM_WORKERS = 8
 BASELINE_S = 120.0  # below the 200 s recipe-derived lower bound; BASELINE.md
 TARGET_FRACTION = 0.01
@@ -81,8 +84,17 @@ def emit(value: float, unit: str, vs_baseline: float) -> None:
 
 def init_devices():
     """jax.devices() with retry/backoff: one flaky TPU backend init must not
-    erase the round's perf evidence (BENCH_r01 died exactly this way)."""
+    erase the round's perf evidence (BENCH_r01 died exactly this way).
+
+    BENCH_PLATFORM=cpu forces the CPU backend through the config API (env
+    vars alone cannot: the image's sitecustomize latches the TPU plugin
+    first) -- used with BENCH_N/BENCH_D to validate the whole flow off-TPU.
+    """
     import jax
+
+    forced = os.environ.get("BENCH_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
 
     deadline = time.monotonic() + BACKEND_INIT_BUDGET_S
     delay = 5.0
@@ -125,14 +137,20 @@ def main() -> None:
     gen_s = time.monotonic() - t0
     print(f"# data: {N}x{D} generated on device in {gen_s:.1f}s", file=sys.stderr)
 
+    # gamma is tuned to the problem's conditioning: rows are N(0, I/d), so
+    # the covariance is I/d and per-update contraction is ~gamma/d -- the
+    # measured updates-to-1%-target is ~300 at gamma=100 (gamma=6 cannot
+    # reach the target in any feasible budget).  Each side of a
+    # wall-clock-to-target comparison runs its own best recipe, as in the
+    # paper's figures.
     cfg = SolverConfig(
         num_workers=NUM_WORKERS,
-        num_iterations=60_000,
-        gamma=6.0,
+        num_iterations=5_000,
+        gamma=100.0,
         taw=2**31 - 1,
         batch_rate=0.1,
         bucket_ratio=0.7,
-        printer_freq=250,
+        printer_freq=25,
         coeff=0.0,
         seed=42,
         calibration_iters=100,

@@ -165,3 +165,50 @@ class TestCollectives:
         g = fn(Xs, ys, jnp.asarray(w), ms)
         expected = X.T @ (X @ w - y)
         np.testing.assert_allclose(np.asarray(g), expected, rtol=2e-4, atol=1e-2)
+
+
+class TestBatchedApply:
+    def test_batch_apply_matches_sequential(self):
+        from asyncframework_tpu.ops import steps
+
+        rs = np.random.default_rng(0)
+        d, m = 32, 6
+        gamma, b, n, nw = 0.7, 0.1, 10_000, 8
+        w0 = rs.normal(size=d).astype(np.float32)
+        G = rs.normal(size=(m, d)).astype(np.float32)
+
+        apply_one = steps.make_asgd_apply(gamma, b, n, nw)
+        w_seq = jnp.asarray(w0)
+        k = jnp.float32(5.0)
+        for i in range(m):
+            w_seq, k = apply_one(w_seq, jnp.asarray(G[i]), k)
+
+        apply_many = steps.make_asgd_apply_batch(gamma, b, n, nw, m)
+        w_bat, k_bat = apply_many(
+            jnp.asarray(w0), jnp.asarray(G),
+            jnp.ones(m, jnp.float32), jnp.float32(5.0),
+        )
+        np.testing.assert_allclose(np.asarray(w_bat), np.asarray(w_seq),
+                                   rtol=1e-5, atol=1e-6)
+        assert float(k_bat) == float(k)
+
+    def test_batch_apply_mask_skips_slots(self):
+        from asyncframework_tpu.ops import steps
+
+        rs = np.random.default_rng(1)
+        d = 16
+        w0 = rs.normal(size=d).astype(np.float32)
+        G = rs.normal(size=(4, d)).astype(np.float32)
+        mask = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+
+        apply_many = steps.make_asgd_apply_batch(0.5, 0.1, 1000, 4, 4)
+        w_bat, k_bat = apply_many(
+            jnp.asarray(w0), jnp.asarray(G), mask, jnp.float32(0.0)
+        )
+        apply_one = steps.make_asgd_apply(0.5, 0.1, 1000, 4)
+        w_seq, k = jnp.asarray(w0), jnp.float32(0.0)
+        for i in (0, 2):
+            w_seq, k = apply_one(w_seq, jnp.asarray(G[i]), k)
+        np.testing.assert_allclose(np.asarray(w_bat), np.asarray(w_seq),
+                                   rtol=1e-5, atol=1e-6)
+        assert float(k_bat) == 2.0

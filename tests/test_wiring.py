@@ -200,3 +200,30 @@ class TestHBMPlanWiring:
         res = ASAGA(X, y, cfg, devices=devices8).run()
         assert res.accepted == 100
         assert res.trajectory[-1][1] < res.trajectory[0][1]
+
+
+class TestDrainBatch:
+    def test_batched_drain_run_converges(self, devices8, problem):
+        X, y, _ = problem
+        cfg = cfg_with(num_iterations=300, drain_batch=8)
+        res = ASGD(X, y, cfg, devices=devices8).run()
+        assert res.accepted == 300
+        assert res.dropped == 0
+        assert res.trajectory[-1][1] < res.trajectory[0][1] * 0.5
+
+    def test_batched_drain_checkpoints_across_boundary(self, devices8, problem,
+                                                       tmp_path):
+        X, y, _ = problem
+        cfg = cfg_with(num_iterations=250, drain_batch=8,
+                       checkpoint_dir=str(tmp_path / "ck"),
+                       checkpoint_freq=100)
+        res = ASGD(X, y, cfg, devices=devices8).run()
+        assert res.accepted == 250
+        from asyncframework_tpu.checkpoint import CheckpointManager
+
+        steps_saved = CheckpointManager(tmp_path / "ck").all_steps()
+        # batches jump over k=100/k=200; checkpoints must still exist at or
+        # just past every boundary (plus the final save)
+        assert len(steps_saved) >= 2
+        assert any(100 <= s < 200 for s in steps_saved)
+        assert any(200 <= s for s in steps_saved)
