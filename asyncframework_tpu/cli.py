@@ -208,6 +208,7 @@ def load_data(args, cfg, devices, need_host: bool = False):
 def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
     import jax
 
+    from asyncframework_tpu.parallel import multihost
     from asyncframework_tpu.solvers import ASAGA, ASGD, MiniBatchSGD, SolverConfig
 
     driver = DRIVER_ALIASES.get(args.driver.lower())
@@ -215,6 +216,14 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         raise SystemExit(
             f"unknown driver {args.driver!r}; one of "
             f"{sorted(set(DRIVER_ALIASES.values()))} (or reference class names)"
+        )
+    # multi-host bring-up is env-driven (ASYNCTPU_COORDINATOR/...); a
+    # single-process invocation is a no-op
+    if multihost.ensure_initialized() and driver != "sgd-mllib":
+        raise SystemExit(
+            "multi-process runs support the SPMD sgd-mllib driver (the mesh "
+            "spans hosts); the async parameter-server drivers are "
+            "single-host by design (the driver IS the server)"
         )
     devices = jax.devices()
     if args.devices is not None:

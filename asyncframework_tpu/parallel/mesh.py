@@ -53,10 +53,22 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _put_sharded(a, sh: NamedSharding):
+    """Multi-host-aware placement: single-process uses device_put; with
+    ``jax.distributed`` active, every process holds the same global host
+    array and contributes only its addressable shards (the SPMD-driver
+    convention -- ``device_put`` would reject non-addressable devices)."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(
+            np.shape(a), sh, lambda idx: np.asarray(a)[idx]
+        )
+    return jax.device_put(a, sh)
+
+
 def shard_batch(mesh: Mesh, *arrays, axis: str = "dp"):
     """Place host arrays onto the mesh sharded on their leading dim."""
     sh = batch_sharding(mesh, axis)
-    out = tuple(jax.device_put(a, sh) for a in arrays)
+    out = tuple(_put_sharded(a, sh) for a in arrays)
     return out if len(out) > 1 else out[0]
 
 

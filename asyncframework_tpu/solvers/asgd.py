@@ -192,6 +192,11 @@ class ASGD:
         apply_batch = steps.make_asgd_apply_batch(
             cfg.gamma, cfg.batch_rate, self.ds.n, nw, cfg.drain_batch
         )
+        # per-accepted-count pad/mask cache: rebuilt host constants would
+        # cost an extra transfer per drain on the latency-bound backends
+        # this feature targets
+        _mask_cache: Dict[int, jax.Array] = {}
+        _pad_cache: Dict[int, jax.Array] = {}
 
         def updater():
             max_drain = max(cfg.drain_batch, 1)
@@ -221,7 +226,9 @@ class ASGD:
                         task_ms = waiting.on_finish(res.worker_id, now_ms())
                         if res.staleness > cfg.taw:
                             state["dropped"] += 1
-                            merged.append((res, False, task_ms))
+                            merged.append(
+                                (res, False, task_ms, k + len(accepted_g))
+                            )
                         elif len(accepted_g) < room:
                             g = res.data
                             if g.device != self.driver_device:
@@ -230,7 +237,9 @@ class ASGD:
                             calibrator.record(
                                 k + len(accepted_g) - 1, task_ms
                             )
-                            merged.append((res, True, task_ms))
+                            merged.append(
+                                (res, True, task_ms, k + len(accepted_g) - 1)
+                            )
                         # else: beyond the iteration budget -- ignored, like
                         # the old per-result loop's break-at-limit
                     if len(accepted_g) >= 3:
@@ -242,18 +251,28 @@ class ASGD:
                         mcount = len(accepted_g)
                         G = jnp.stack(accepted_g)
                         if mcount < max_drain:
-                            G = jnp.concatenate([
-                                G,
-                                jnp.zeros(
-                                    (max_drain - mcount, G.shape[1]), G.dtype
+                            pad = _pad_cache.get(mcount)
+                            if pad is None:
+                                pad = jax.device_put(
+                                    jnp.zeros(
+                                        (max_drain - mcount, G.shape[1]),
+                                        G.dtype,
+                                    ),
+                                    self.driver_device,
+                                )
+                                _pad_cache[mcount] = pad
+                            G = jnp.concatenate([G, pad])
+                        mask = _mask_cache.get(mcount)
+                        if mask is None:
+                            mask = jax.device_put(
+                                jnp.asarray(
+                                    [1.0] * mcount
+                                    + [0.0] * (max_drain - mcount),
+                                    jnp.float32,
                                 ),
-                            ])
-                        mask = jnp.asarray(
-                            np.concatenate([
-                                np.ones(mcount, np.float32),
-                                np.zeros(max_drain - mcount, np.float32),
-                            ])
-                        )
+                                self.driver_device,
+                            )
+                            _mask_cache[mcount] = mask
                         state["w"], state["k_dev"] = apply_batch(
                             state["w"], G, mask, state["k_dev"]
                         )
@@ -278,9 +297,9 @@ class ASGD:
                         # boundary must still save
                         do_save = ckpt.should_save_range(k, k_new)
                         save_k, save_w = state["k"], state["w"]
-                for res, accepted, task_ms in merged:
+                for res, accepted, task_ms, at_k in merged:
                     inst.on_gradient_merged(
-                        res.worker_id, res.staleness, accepted, k,
+                        res.worker_id, res.staleness, accepted, at_k,
                         batch_size=res.batch_size, task_ms=task_ms,
                     )
                 if do_save:

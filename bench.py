@@ -48,6 +48,7 @@ N = int(os.environ.get("BENCH_N", 400_000))
 D = int(os.environ.get("BENCH_D", 2_000))
 NUM_WORKERS = 8
 BASELINE_S = 120.0  # below the 200 s recipe-derived lower bound; BASELINE.md
+SPARK_TASK_FLOOR_S = 0.005  # per-gradient driver-mediated floor (BASELINE.md)
 TARGET_FRACTION = 0.01
 BACKEND_INIT_BUDGET_S = 360.0  # total retry budget for flaky TPU backend init
 RUN_TIMEOUT_S = 240.0          # solver-internal deadline
@@ -171,15 +172,30 @@ def main() -> None:
     )
     print("# compile warm-up done", file=sys.stderr)
 
+    # dispatch round-trip diagnostic: on a tunneled/remote device the
+    # per-dispatch RTT, not the framework, bounds updates/sec -- record it
+    # so the headline number can be read in context
+    probe = jax.device_put(np.zeros(8, np.float32), devices[0])
+    t0 = time.monotonic()
+    for _ in range(20):
+        probe = (probe + 1.0).block_until_ready()
+    rtt_ms = (time.monotonic() - t0) / 20 * 1e3
+    print(f"# device dispatch round-trip ~{rtt_ms:.2f} ms "
+          f"(bounds updates/sec at ~{8 / max(rtt_ms, 1e-3) * 1e3:.0f}/s)",
+          file=sys.stderr)
+
     res = solver.run()
 
     # wall-clock to target from the evaluated trajectory
     initial = res.trajectory[0][1]
     target = initial * TARGET_FRACTION
     t_hit = None
-    for t_ms, obj in res.trajectory:
+    k_hit = None
+    for i, (t_ms, obj) in enumerate(res.trajectory):
         if obj <= target:
             t_hit = t_ms / 1e3
+            # snapshot i covers ~i * printer_freq accepted updates
+            k_hit = max(i * cfg.printer_freq, 1)
             break
     print(
         f"# accepted={res.accepted} dropped={res.dropped} rounds={res.rounds} "
@@ -192,7 +208,26 @@ def main() -> None:
         # did not reach target: report elapsed as value with penalty ratio
         emit(round(res.elapsed_s, 2), "s (TARGET NOT REACHED)", 0.0)
         return
-    emit(round(t_hit, 2), "s", round(BASELINE_S / t_hit, 2))
+    # EQUAL-RECIPE baseline: the reference running this same recipe (same
+    # update count) pays at least SPARK_TASK_FLOOR_S per gradient across 8
+    # pipelined workers (BASELINE.md "Derived baseline") -- comparing
+    # against the fixed 320k-iteration recipe would credit step-size tuning
+    # to the framework.  Also floor the baseline at the recipe-independent
+    # BASELINE_S when OUR update count exceeds the reference recipe's.
+    # per-gradient cost for the reference at THIS recipe = scheduling floor
+    # + gradient compute: 2 * par_recs * d flops on a 2-core executor at an
+    # optimistic 6 GFLOP/s (BASELINE.md "Derived baseline")
+    par_recs = cfg.batch_rate * N / NUM_WORKERS
+    spark_compute_s = 2.0 * par_recs * D / 6e9
+    per_grad_s = SPARK_TASK_FLOOR_S + spark_compute_s
+    equal_recipe_baseline = k_hit * per_grad_s / NUM_WORKERS
+    baseline = min(max(equal_recipe_baseline, 1e-3), BASELINE_S)
+    print(
+        f"# k_hit={k_hit} spark_per_grad={per_grad_s * 1e3:.1f}ms "
+        f"equal-recipe baseline={equal_recipe_baseline:.3f}s",
+        file=sys.stderr,
+    )
+    emit(round(t_hit, 2), "s", round(baseline / t_hit, 2))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,62 @@
+"""Child for the two-process distributed TRAINING test (test_multihost.py).
+
+Each process joins jax.distributed through the multihost wrapper, builds the
+global mesh over both hosts' devices, and runs the fused-SPMD MiniBatchSGD
+training step over it -- the same mesh/pjit code that rides ICI in a slice
+rides DCN here (loopback gRPC).  Prints the resulting weights so the parent
+can check both processes agree AND match a single-process run bit-for-bit
+modulo float tolerance.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from asyncframework_tpu.parallel import make_mesh, multihost  # noqa: E402
+from asyncframework_tpu.solvers import MiniBatchSGD  # noqa: E402
+
+
+def problem():
+    rs = np.random.default_rng(7)
+    X = rs.normal(size=(256, 16)).astype(np.float32)
+    w = rs.normal(size=(16,)).astype(np.float32)
+    y = (X @ w + 0.01 * rs.normal(size=(256,))).astype(np.float32)
+    return X, y
+
+
+def main() -> None:
+    active = multihost.ensure_initialized()
+    pid, pc = multihost.process_info()
+    multihost.sync_hosts("train-start")
+    X, y = problem()  # every process holds the same global host arrays
+    mesh = make_mesh(jax.device_count(), devices=jax.devices())
+    sgd = MiniBatchSGD(gamma=0.5, batch_rate=0.5, num_iterations=40, seed=3)
+    w, losses, _ = sgd.run(X, y, mesh=mesh)
+    multihost.sync_hosts("train-end")
+    print(json.dumps({
+        "active": bool(active),
+        "pid": int(pid),
+        "pc": int(pc),
+        "mesh": int(mesh.devices.size),
+        "w": np.asarray(w).tolist(),
+        "final_loss": float(losses[-1]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -62,3 +62,55 @@ def test_two_process_bringup_barrier_and_psum():
         assert r["local_devices"] == 2
         assert r["psum"] == 6.0             # 2*1 + 2*2: crossed the boundary
         assert r["mesh_size"] == 4          # global mesh spans both hosts
+
+
+def test_two_process_distributed_training_matches_local():
+    """The cluster story end to end: the SAME MiniBatchSGD code trains over
+    a 2-process global mesh (DCN) and produces the same model as one
+    process with an equal-size mesh."""
+    port = free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        env.update(
+            ASYNCTPU_COORDINATOR=f"127.0.0.1:{port}",
+            ASYNCTPU_NUM_PROCESSES="2",
+            ASYNCTPU_PROCESS_ID=str(pid),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, str(Path(__file__).parent / "dcn_train_child.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=150)
+        assert p.returncode == 0, f"child failed:\nstdout={out}\nstderr={err}"
+        results.append(json.loads(out.strip().splitlines()[-1]))
+
+    import numpy as np
+
+    for r in results:
+        assert r["active"] and r["pc"] == 2 and r["mesh"] == 4
+    # both processes computed the identical replicated model
+    np.testing.assert_allclose(results[0]["w"], results[1]["w"], rtol=1e-6)
+
+    # and it matches a single-process run on an equal-size mesh
+    import dcn_train_child as child_mod  # same problem() fixture
+
+    from asyncframework_tpu.parallel import make_mesh
+    from asyncframework_tpu.solvers import MiniBatchSGD
+    import jax
+
+    X, y = child_mod.problem()
+    mesh = make_mesh(4, devices=jax.devices()[:4])
+    w_local, losses, _ = MiniBatchSGD(
+        gamma=0.5, batch_rate=0.5, num_iterations=40, seed=3
+    ).run(X, y, mesh=mesh)
+    np.testing.assert_allclose(
+        results[0]["w"], np.asarray(w_local), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        results[0]["final_loss"], float(losses[-1]), rtol=1e-5
+    )
