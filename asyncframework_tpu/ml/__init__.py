@@ -56,6 +56,10 @@ from asyncframework_tpu.ml.evaluation import (
     RegressionMetrics,
 )
 from asyncframework_tpu.ml.tree import DecisionTree, DecisionTreeModel
+from asyncframework_tpu.ml.boosting import (
+    GradientBoostedTrees,
+    GradientBoostedTreesModel,
+)
 from asyncframework_tpu.ml.forest import RandomForest, RandomForestModel
 from asyncframework_tpu.ml.mixture import GaussianMixture, GaussianMixtureModel
 from asyncframework_tpu.ml.fpm import FPGrowth, FPGrowthModel, Rule
@@ -98,6 +102,8 @@ __all__ = [
     "MulticlassMetrics",
     "DecisionTree",
     "DecisionTreeModel",
+    "GradientBoostedTrees",
+    "GradientBoostedTreesModel",
     "RandomForest",
     "RandomForestModel",
     "GaussianMixture",

@@ -108,6 +108,57 @@ class DStream:
     def union(self, other: "DStream") -> "DStream":
         return _Union(self.ssc, [self, other])
 
+    def reduce_by_key_batch(
+        self, fn: Callable[[Any, Any], Any]
+    ) -> "DStream":
+        """Per-interval keyed reduce over a batch of (key, value) pairs
+        (``PairDStreamFunctions.reduceByKey`` parity)."""
+
+        def red(_t, b):
+            acc: Dict[Any, Any] = {}
+            for k, v in b:
+                acc[k] = fn(acc[k], v) if k in acc else v
+            return list(acc.items())
+
+        return _Transformed(self.ssc, self, red)
+
+    def reduce_by_key_and_window(
+        self,
+        fn: Callable[[Any, Any], Any],
+        length: int,
+        slide: int = 1,
+        inv_fn: Optional[Callable[[Any, Any], Any]] = None,
+        filter_fn: Optional[Callable[[Any, Any], bool]] = None,
+    ) -> "DStream":
+        """Keyed reduce over the last ``length`` intervals, every ``slide``.
+
+        Parity: ``PairDStreamFunctions.reduceByKeyAndWindow`` -- without
+        ``inv_fn`` each emission recombines the window's per-interval
+        partials; with ``inv_fn`` the previous window result is updated
+        incrementally (add entering intervals, invert leaving ones), the
+        reference's O(slide) formulation.  ``filter_fn(key, value)`` prunes
+        keys (the reference's filterFunc; without it, inverse-mode keys
+        linger at their neutral value, exactly like Spark).
+        """
+        per = self.reduce_by_key_batch(fn)
+        if inv_fn is None:
+            win = per.window(length, slide)
+
+            def combine(_t, batches):
+                acc: Dict[Any, Any] = {}
+                for b in batches:
+                    for k, v in b:
+                        acc[k] = fn(acc[k], v) if k in acc else v
+                out = list(acc.items())
+                if filter_fn is not None:
+                    out = [(k, v) for k, v in out if filter_fn(k, v)]
+                return out if out else EMPTY
+
+            return _Transformed(self.ssc, win, combine)
+        return _InvWindowReduce(
+            self.ssc, per, fn, inv_fn, length, slide, filter_fn
+        )
+
     def update_state_by_key(
         self,
         update_fn: Callable[[List[Any], Optional[Any]], Optional[Any]],
@@ -196,6 +247,108 @@ def _concat(batches: List[Any]) -> Any:
     for b in batches:
         out.extend(b)
     return out
+
+
+class _InvWindowReduce(DStream):
+    """Incremental windowed keyed reduce (the ``invReduceFunc`` path).
+
+    Carries the previous window's keyed result; each slide adds the
+    entering intervals' partials with ``fn`` and removes the leaving ones
+    with ``inv_fn``.  The parent (per-interval partials) retains enough
+    intervals for both edges of the window.
+    """
+
+    def __init__(self, ssc, parent, fn, inv_fn, length, slide, filter_fn):
+        if length < 1 or slide < 1:
+            raise ValueError("window length and slide must be >= 1")
+        super().__init__(ssc, [parent])
+        self._fn = fn
+        self._inv = inv_fn
+        self._filter = filter_fn
+        self.length = length
+        self.slide = slide
+        parent._retain(length + slide)
+        self._state: Dict[Any, Any] = {}
+        self._state_time = 0
+
+    def _fold(self, acc, t, invert: bool) -> None:
+        b = self.parents[0].get_or_compute(t)
+        if b is EMPTY:
+            return
+        for k, v in b:
+            if invert:
+                acc[k] = self._inv(acc[k], v)  # key must exist: it entered
+            else:
+                acc[k] = self._fn(acc[k], v) if k in acc else v
+
+    def _window_keys(self, time_ms: int, interval: int) -> set:
+        """Keys present in any interval of the window ending at time_ms --
+        the only keys a FUTURE leaving interval can invert."""
+        keys = set()
+        for t in range(
+            max(time_ms - (self.length - 1) * interval, interval),
+            time_ms + 1,
+            interval,
+        ):
+            b = self.parents[0].get_or_compute(t)
+            if b is not EMPTY:
+                keys.update(k for k, _v in b)
+        return keys
+
+    def _recompute(self, time_ms: int) -> Any:
+        """Full recombination of one (possibly past) window -- the stale
+        re-read path must not leak the CURRENT state under an old label."""
+        interval = self.ssc.batch_interval_ms
+        acc: Dict[Any, Any] = {}
+        for t in range(
+            max(time_ms - (self.length - 1) * interval, interval),
+            time_ms + 1,
+            interval,
+        ):
+            self._fold(acc, t, invert=False)
+        out = list(acc.items())
+        if self._filter is not None:
+            out = [(k, v) for k, v in out if self._filter(k, v)]
+        return out if out else EMPTY
+
+    def compute(self, time_ms: int) -> Any:
+        interval = self.ssc.batch_interval_ms
+        idx = time_ms // interval
+        if idx % self.slide != 0:
+            return EMPTY
+        if time_ms <= self._state_time:
+            # re-read of a past window (cache miss): recompute that window
+            # rather than mislabel the current state
+            return self._recompute(time_ms)
+        acc = dict(self._state)
+        # entering intervals: those in the new window, after the old one
+        enter_from = max(
+            time_ms - (self.length - 1) * interval,
+            self._state_time + interval if self._state_time else interval,
+        )
+        for t in range(enter_from, time_ms + 1, interval):
+            self._fold(acc, t, invert=False)
+        # leaving intervals: in the old window, before the new one
+        if self._state_time:
+            old_start = self._state_time - (self.length - 1) * interval
+            new_start = time_ms - (self.length - 1) * interval
+            for t in range(max(old_start, interval), min(new_start, self._state_time + interval), interval):
+                self._fold(acc, t, invert=True)
+        if self._filter is not None:
+            # prune carried state too (the reference's filterFunc exists to
+            # bound it) -- but only keys no future leaving interval can
+            # invert, i.e. keys absent from the current window's partials
+            live = self._window_keys(time_ms, interval)
+            acc = {
+                k: v for k, v in acc.items()
+                if k in live or self._filter(k, v)
+            }
+        self._state = acc
+        self._state_time = time_ms
+        out = list(acc.items())
+        if self._filter is not None:
+            out = [(k, v) for k, v in out if self._filter(k, v)]
+        return out if out else EMPTY
 
 
 class StatefulDStream(DStream):

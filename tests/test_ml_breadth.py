@@ -470,3 +470,39 @@ class TestLDA:
         tf = HashingTF(32).transform([])
         assert np.asarray(tf).shape == (0, 32)
         IDF().fit(tf)  # no crash
+
+
+class TestGradientBoostedTrees:
+    def test_regression_beats_single_tree(self, reg_data):
+        from asyncframework_tpu.ml import GradientBoostedTrees
+
+        X, y = reg_data
+        gbt = GradientBoostedTrees("regression", num_iterations=30,
+                                   learning_rate=0.2, max_depth=3).fit(X, y)
+        tree = DecisionTree("regression", max_depth=3).fit(X, y)
+        gbt_r2 = RegressionMetrics.of(gbt.predict(X), y).r2
+        tree_r2 = RegressionMetrics.of(tree.predict(X), y).r2
+        assert gbt_r2 > tree_r2 + 0.05
+        assert gbt_r2 > 0.7
+
+    def test_classification_close_to_sklearn(self, clf_data):
+        from sklearn.ensemble import GradientBoostingClassifier
+
+        from asyncframework_tpu.ml import GradientBoostedTrees
+
+        X, y3 = clf_data
+        y = (y3 > 0).astype(np.int64)  # binary
+        ours = GradientBoostedTrees("classification", num_iterations=30,
+                                    learning_rate=0.2, max_depth=3).fit(X, y)
+        acc = (ours.predict(X) == y).mean()
+        sk = GradientBoostingClassifier(n_estimators=30, learning_rate=0.2,
+                                        max_depth=3, random_state=0).fit(X, y)
+        sk_acc = (sk.predict(X) == y).mean()
+        assert acc >= sk_acc - 0.05, (acc, sk_acc)
+
+    def test_rejects_bad_labels(self, reg_data):
+        from asyncframework_tpu.ml import GradientBoostedTrees
+
+        X, y = reg_data
+        with pytest.raises(ValueError, match="labels"):
+            GradientBoostedTrees("classification").fit(X, y)

@@ -386,3 +386,79 @@ class TestReceivers:
         replayed = [b for (_t2, b) in wal.replay()]
         wal.close()
         assert sorted(x for b in replayed for x in b) == ["alpha", "beta", "gamma"]
+
+
+class TestReduceByKeyAndWindow:
+    def feed(self, n=8, seed=0):
+        import random
+
+        rng = random.Random(seed)
+        return [
+            [(rng.choice("abc"), rng.randint(1, 5)) for _ in range(6)]
+            for _ in range(n)
+        ]
+
+    @pytest.mark.parametrize("length,slide", [(3, 1), (3, 2), (2, 3), (4, 4)])
+    def test_inverse_matches_recompute(self, length, slide):
+        """The incremental (inv_fn) path must emit exactly what full
+        recombination emits, window for window."""
+        batches = self.feed()
+        out_full, out_inc = [], []
+        for out, inv in ((out_full, None), (out_inc, lambda a, b: a - b)):
+            ssc = StreamingContext(batch_interval_ms=100)
+            src = ssc.queue_stream([list(b) for b in batches])
+            win = src.reduce_by_key_and_window(
+                lambda a, b: a + b, length, slide, inv_fn=inv,
+                filter_fn=(lambda k, v: v != 0) if inv else None,
+            )
+            win.foreach_batch(lambda t, b: out.append((t, dict(b))))
+            for n in range(1, len(batches) + 1):
+                ssc.generate_batch(n * 100)
+        full = {t: {k: v for k, v in d.items() if v != 0}
+                for t, d in out_full}
+        inc = {t: {k: v for k, v in d.items() if v != 0} for t, d in out_inc}
+        assert inc == full
+
+    def test_per_interval_reduce_by_key(self):
+        ssc = StreamingContext(batch_interval_ms=100)
+        src = ssc.queue_stream([[("a", 1), ("b", 2), ("a", 3)]])
+        out = []
+        src.reduce_by_key_batch(lambda x, y: x + y).foreach_batch(
+            lambda t, b: out.append(dict(b))
+        )
+        ssc.generate_batch(100)
+        assert out == [{"a": 4, "b": 2}]
+
+    def test_filter_fn_prunes_carried_state(self):
+        """Keys whose value zeroed out and that left the window must leave
+        the carried state dict (unbounded growth otherwise)."""
+        ssc = StreamingContext(batch_interval_ms=100)
+        batches = [[("gone", 1)], [], [], [("new", 2)], []]
+        src = ssc.queue_stream([list(b) for b in batches])
+        win = src.reduce_by_key_and_window(
+            lambda a, b: a + b, 2, 1, inv_fn=lambda a, b: a - b,
+            filter_fn=lambda k, v: v != 0,
+        )
+        node = win
+        out = []
+        win.foreach_batch(lambda t, b: out.append(dict(b)))
+        for n in range(1, 6):
+            ssc.generate_batch(n * 100)
+        assert "gone" not in node._state  # pruned once out of the window
+        assert out[-2] == {"new": 2}
+
+    def test_stale_window_reread_recomputes_not_mislabels(self):
+        ssc = StreamingContext(batch_interval_ms=100)
+        src = ssc.queue_stream([[("a", 1)], [("a", 10)], [("a", 100)]])
+        win = src.reduce_by_key_and_window(
+            lambda a, b: a + b, 2, 1, inv_fn=lambda a, b: a - b,
+        )
+        src._retain(5)  # keep partials so the past window is recomputable
+        outs = {}
+        win.foreach_batch(lambda t, b: outs.setdefault(t, dict(b)))
+        ssc.generate_batch(100)
+        ssc.generate_batch(200)
+        ssc.generate_batch(300)
+        # stale re-read of t=200 (memo cache for win holds only 1 interval)
+        got = win.compute(200)
+        assert dict(got) == {"a": 11}  # the true t=200 window, not t=300's
