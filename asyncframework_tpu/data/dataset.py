@@ -250,11 +250,10 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
 
         def sampler(wid: int) -> Callable[[], Iterable[E]]:
             def compute() -> Iterable[E]:
+                from asyncframework_tpu.data.pairs import partition_draws
+
                 xs = self._compute(wid)
-                rs = np.random.default_rng(
-                    np.random.SeedSequence(entropy=seed, spawn_key=(wid,))
-                )
-                mask = rs.random(len(xs)) < fraction
+                mask = partition_draws(seed, wid, len(xs)) < fraction
                 return [x for x, m in zip(xs, mask) if m]
 
             return compute
@@ -337,6 +336,57 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
     def count_by_value(self) -> Dict[E, int]:
         """``RDD.countByValue`` parity (driver-side dict)."""
         return self.map(lambda x: (x, 1)).count_by_key()
+
+    def count_approx_distinct(self, relative_sd: float = 0.05) -> int:
+        """``RDD.countApproxDistinct`` parity: per-partition HyperLogLog
+        sketches merged on the driver (register-max is the shuffle-free
+        combine).  ``relative_sd`` sets the register count like the
+        reference maps it to HLL precision."""
+        import math
+
+        from asyncframework_tpu.utils.sketch import HyperLogLog
+
+        p = int(math.ceil(2 * math.log2(1.04 / relative_sd)))
+        if p > 18:
+            raise ValueError(
+                f"relative_sd={relative_sd} needs HLL precision p={p} > 18; "
+                "the achievable floor is ~0.0021"
+            )
+        p = max(p, 4)
+
+        def sketch(wid: int):
+            def run(w=wid):
+                h = HyperLogLog(p=p)
+                xs = self._compute(w)
+                if xs:
+                    h.add(_hashable_u64(xs))
+                return h
+
+            return run
+
+        per = self._run_sync(sketch)
+        acc: Optional[object] = None
+        for wid in self.partition_ids():
+            acc = per[wid] if acc is None else acc.merge(per[wid])
+        return int(round(acc.estimate())) if acc is not None else 0
+
+    def take_sample(
+        self, with_replacement: bool, num: int, seed: int = 42
+    ) -> List[E]:
+        """``RDD.takeSample`` parity: a fixed-size uniform sample collected
+        to the driver."""
+        if num < 0:
+            raise ValueError("num must be >= 0")
+        if num == 0:
+            return []
+        rs = np.random.default_rng(seed)
+        allv = self.collect()
+        if not allv:
+            return []
+        idx = rs.choice(len(allv), size=num, replace=with_replacement) \
+            if (with_replacement or num <= len(allv)) \
+            else rs.permutation(len(allv))
+        return [allv[i] for i in np.atleast_1d(idx)[:num]]
 
     def fold(self, zero: E, op: Callable[[E, E], E]) -> E:
         """``RDD.fold`` parity: like reduce with a per-partition zero."""
@@ -661,6 +711,24 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
             lambda _exc: [ctx.mark_available(w) for w in wids]
         )
         return waiter
+
+
+def _hashable_u64(xs: List) -> np.ndarray:
+    """Elements -> uint64 for sketching: numeric sequences take the
+    vectorized path; everything else (tuples, strings, mixed) hashes per
+    element through the stable portable hash (tuple support is what makes
+    countApproxDistinct work on pair datasets)."""
+    try:
+        a = np.asarray(xs)
+    except ValueError:  # ragged
+        a = None
+    if a is not None and a.ndim == 1 and a.dtype.kind in "iuf":
+        return a
+    from asyncframework_tpu.data.pairs import portable_hash
+
+    return np.asarray(
+        [portable_hash(x) & 0xFFFFFFFFFFFFFFFF for x in xs], np.uint64
+    )
 
 
 def _local_reduce(xs: List[E], op: Callable[[E, E], E]) -> Tuple[Any, bool]:

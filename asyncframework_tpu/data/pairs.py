@@ -48,6 +48,19 @@ W = TypeVar("W")
 C = TypeVar("C")
 
 
+def partition_draws(seed: int, wid: int, n: int):
+    """The shared per-partition uniform-draw recipe: deterministic in
+    (seed, partition id) -- ``PartitionwiseSampledRDD`` parity.  Both
+    ``DistributedDataset.sample`` and ``sample_by_key`` derive their
+    Bernoulli draws from here so their seeding stays in lockstep."""
+    import numpy as _np
+
+    rs = _np.random.default_rng(
+        _np.random.SeedSequence(entropy=seed, spawn_key=(wid,))
+    )
+    return rs.random(n)
+
+
 def _append(c: list, v) -> list:
     c.append(v)
     return c
@@ -204,6 +217,29 @@ class PairOpsMixin:
             _append,  # in-place: `c + [v]` would be O(m^2) per skewed key
             _extend,
             num_partitions,
+        )
+
+    def sample_by_key(self, fractions: Dict[Any, float], seed: int = 42):
+        """``sampleByKey`` parity: per-key Bernoulli fractions, deterministic
+        in (seed, partition) like :meth:`DistributedDataset.sample`; keys
+        absent from ``fractions`` are dropped."""
+        for k, f in fractions.items():
+            if not 0.0 <= f <= 1.0:
+                raise ValueError(f"fraction for key {k!r} must be in [0, 1]")
+
+        def sampler(wid: int):
+            def run(w=wid):
+                xs = self._compute(w)
+                draws = partition_draws(seed, w, len(xs))
+                return [
+                    kv for kv, u in zip(xs, draws)
+                    if u < fractions.get(kv[0], 0.0)
+                ]
+
+            return run
+
+        return type(self)(
+            self.scheduler, {wid: sampler(wid) for wid in self._parts}
         )
 
     def count_by_key(self) -> Dict[Any, int]:

@@ -311,3 +311,32 @@ class TestRDDBreadth:
         assert sorted(diff.collect()) == [1, 3]
         assert computed["n"] > 0
         assert len(cart.collect()) == 4 * 3
+
+    def test_count_approx_distinct(self, sched):
+        data = [i % 500 for i in range(5000)]
+        ds = DistributedDataset.from_list(sched, data)
+        est = ds.count_approx_distinct(relative_sd=0.02)
+        assert abs(est - 500) / 500 < 0.1
+
+    def test_take_sample(self, sched):
+        ds = DistributedDataset.from_list(sched, list(range(100)))
+        s1 = ds.take_sample(False, 10, seed=1)
+        assert len(s1) == 10 and len(set(s1)) == 10
+        s2 = ds.take_sample(True, 150, seed=2)
+        assert len(s2) == 150  # replacement allows > population
+        assert ds.take_sample(False, 10, seed=1) == s1  # deterministic
+
+    def test_count_approx_distinct_on_pairs_and_strings(self, sched):
+        data = [("k%d" % (i % 40), i % 3) for i in range(1000)]
+        ds = DistributedDataset.from_list(sched, data)
+        est = ds.count_approx_distinct(relative_sd=0.01)
+        assert abs(est - 120) <= 12  # 40 keys x 3 values
+        strs = DistributedDataset.from_list(sched, ["s%d" % (i % 77) for i in range(500)])
+        assert abs(strs.count_approx_distinct(0.01) - 77) <= 8
+
+    def test_count_approx_distinct_unachievable_sd_rejected(self, sched):
+        ds = DistributedDataset.from_list(sched, [1, 2, 3])
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="p="):
+            ds.count_approx_distinct(relative_sd=0.0001)

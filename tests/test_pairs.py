@@ -149,3 +149,25 @@ class TestSortByKey:
 
     def test_empty(self, sched):
         assert pairs(sched, []).sort_by_key().collect() == []
+
+
+class TestSampleByKey:
+    def test_fractions_respected(self, sched):
+        data = [("a", i) for i in range(2000)] + [("b", i) for i in range(2000)]
+        ds = pairs(sched, data)
+        got = ds.sample_by_key({"a": 0.5, "b": 0.1}, seed=3).collect()
+        ca = sum(1 for k, _ in got if k == "a")
+        cb = sum(1 for k, _ in got if k == "b")
+        assert 850 < ca < 1150
+        assert 120 < cb < 290
+        # keys not in fractions are dropped entirely
+        got2 = ds.sample_by_key({"a": 1.0}, seed=3).collect()
+        assert all(k == "a" for k, _ in got2)
+        assert len(got2) == 2000
+
+    def test_deterministic(self, sched):
+        data = [(i % 5, i) for i in range(500)]
+        ds = pairs(sched, data)
+        f = {k: 0.3 for k in range(5)}
+        assert ds.sample_by_key(f, seed=9).collect() == \
+            ds.sample_by_key(f, seed=9).collect()

@@ -209,3 +209,41 @@ class TestDatasetOps:
         ds = DistributedDataset.from_list(sched, [1]).filter(lambda x: False)
         with pytest.raises(ValueError, match="empty"):
             ds.first()
+
+
+class TestHyperLogLog:
+    def test_estimate_within_error(self):
+        from asyncframework_tpu.utils.sketch import HyperLogLog
+
+        h = HyperLogLog(p=12)
+        n = 100_000
+        h.add(np.arange(n))
+        h.add(np.arange(n // 2))  # duplicates must not inflate
+        est = h.estimate()
+        assert abs(est - n) / n < 4 * h.relative_error
+
+    def test_merge_equals_union(self):
+        from asyncframework_tpu.utils.sketch import HyperLogLog
+
+        a = HyperLogLog(p=12)
+        b = HyperLogLog(p=12)
+        a.add(np.arange(0, 60_000))
+        b.add(np.arange(40_000, 100_000))
+        a.merge(b)
+        assert abs(a.estimate() - 100_000) / 100_000 < 4 * a.relative_error
+        with pytest.raises(ValueError):
+            a.merge(HyperLogLog(p=11))
+
+    def test_small_range_linear_counting(self):
+        from asyncframework_tpu.utils.sketch import HyperLogLog
+
+        h = HyperLogLog(p=12)
+        h.add(np.arange(25))
+        assert abs(h.estimate() - 25) <= 2
+
+    def test_strings_and_mixed(self):
+        from asyncframework_tpu.utils.sketch import HyperLogLog
+
+        h = HyperLogLog(p=10)
+        h.add(np.asarray([f"user-{i}" for i in range(5000)], dtype=object))
+        assert abs(h.estimate() - 5000) / 5000 < 4 * h.relative_error
