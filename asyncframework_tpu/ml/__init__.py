@@ -64,6 +64,11 @@ from asyncframework_tpu.ml.forest import RandomForest, RandomForestModel
 from asyncframework_tpu.ml.mixture import GaussianMixture, GaussianMixtureModel
 from asyncframework_tpu.ml.fpm import FPGrowth, FPGrowthModel, Rule
 from asyncframework_tpu.ml.lda import LDA, LDAModel
+from asyncframework_tpu.ml.persistence import (
+    load_model,
+    save_as_libsvm_file,
+    save_model,
+)
 
 __all__ = [
     "ALS",
@@ -113,6 +118,9 @@ __all__ = [
     "Rule",
     "LDA",
     "LDAModel",
+    "save_model",
+    "load_model",
+    "save_as_libsvm_file",
     "HashingTF",
     "IDF",
     "IDFModel",

@@ -1,0 +1,232 @@
+"""Model persistence: save/load for every model family.
+
+Parity: each MLlib model implements ``Saveable``/``Loader`` (e.g.
+``mllib/.../classification/NaiveBayes.scala`` save/load, tree models via
+``tree/model/treeEnsembleModels.scala``) -- models round-trip through a
+storage path with a format tag and validation on load.
+
+Format here: one ``.npz`` per model (array fields as arrays, scalars/str as
+0-d arrays, nested lists of models flattened with indexed keys) plus a
+``__class__`` tag checked on load.  Array-only on purpose -- the same
+no-code-execution trust posture as the checkpoint and WAL formats.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, List, Union
+
+import numpy as np
+
+from asyncframework_tpu.ml.bayes import NaiveBayesModel
+from asyncframework_tpu.ml.boosting import GradientBoostedTreesModel
+from asyncframework_tpu.ml.clustering import KMeansModel
+from asyncframework_tpu.ml.decomposition import PCAModel
+from asyncframework_tpu.ml.forest import RandomForestModel
+from asyncframework_tpu.ml.lda import LDAModel
+from asyncframework_tpu.ml.mixture import GaussianMixtureModel
+from asyncframework_tpu.ml.models import (
+    LinearModel,
+    LogisticRegressionModel,
+    SoftmaxRegressionModel,
+    SVMModel,
+)
+from asyncframework_tpu.ml.recommendation import ALSModel
+from asyncframework_tpu.ml.tree import DecisionTreeModel
+
+
+def _tree_payload(t: DecisionTreeModel, prefix: str) -> Dict[str, np.ndarray]:
+    return {
+        f"{prefix}feature": t.feature,
+        f"{prefix}threshold": t.threshold,
+        f"{prefix}prediction": t.prediction,
+        f"{prefix}depth": np.int64(t.depth),
+        f"{prefix}task": np.str_(t.task),
+    }
+
+
+def _tree_restore(z, prefix: str) -> DecisionTreeModel:
+    return DecisionTreeModel(
+        feature=np.asarray(z[f"{prefix}feature"]),
+        threshold=np.asarray(z[f"{prefix}threshold"]),
+        prediction=np.asarray(z[f"{prefix}prediction"]),
+        depth=int(z[f"{prefix}depth"]),
+        task=str(z[f"{prefix}task"]),
+    )
+
+
+def save_model(model: Any, path: Union[str, Path]) -> Path:
+    """Persist a model to ``path`` (``.npz`` appended when absent)."""
+    path = Path(path)
+    if path.suffix != ".npz":
+        path = path.with_suffix(path.suffix + ".npz")
+    payload: Dict[str, Any] = {"__class__": np.str_(type(model).__name__)}
+
+    if isinstance(model, DecisionTreeModel):
+        payload.update(_tree_payload(model, "t_"))
+    elif isinstance(model, (RandomForestModel, GradientBoostedTreesModel)):
+        payload["n_trees"] = np.int64(len(model.trees))
+        payload["task"] = np.str_(model.task)
+        for i, t in enumerate(model.trees):
+            payload.update(_tree_payload(t, f"tree{i}_"))
+        if isinstance(model, RandomForestModel):
+            payload["num_classes"] = np.int64(model.num_classes)
+        else:
+            payload["learning_rate"] = np.float64(model.learning_rate)
+            payload["init_value"] = np.float64(model.init_value)
+    elif isinstance(model, NaiveBayesModel):
+        payload["model_type"] = np.str_(model.model_type)
+        payload["log_pi"] = np.asarray(model.log_pi)
+        if model.model_type == "gaussian":
+            mean, var = model._gauss
+            payload["mean"] = np.asarray(mean)
+            payload["var"] = np.asarray(var)
+        else:
+            payload["log_theta"] = np.asarray(model.log_theta)
+    elif isinstance(model, KMeansModel):
+        payload["centers"] = np.asarray(model.centers)
+        payload["cost"] = np.float64(model.cost)
+        payload["iterations"] = np.int64(model.iterations)
+    elif isinstance(model, PCAModel):
+        payload["components"] = model.components
+        payload["explained_variance"] = model.explained_variance
+        payload["mean"] = model.mean
+    elif isinstance(model, GaussianMixtureModel):
+        payload["weights"] = model.weights
+        payload["means"] = model.means
+        payload["covariances"] = model.covariances
+        payload["log_likelihood"] = np.float64(model.log_likelihood)
+    elif isinstance(model, LDAModel):
+        payload["topics"] = model.topics
+        payload["doc_topics"] = model.doc_topics
+        payload["alpha"] = np.float64(model.alpha)
+        payload["hist"] = model.log_perplexity_history
+    elif isinstance(model, ALSModel):
+        payload["user_factors"] = model.user_factors
+        payload["item_factors"] = model.item_factors
+        payload["rank"] = np.int64(model.rank)
+    elif isinstance(model, SoftmaxRegressionModel):
+        payload["W"] = model.W
+        payload["b"] = model.b
+        payload["loss_history"] = model.loss_history
+    elif isinstance(model, LinearModel):  # covers logistic/SVM subclasses
+        payload["weights"] = np.asarray(model.weights)
+        payload["intercept"] = np.float64(model.intercept)
+        payload["loss_history"] = np.asarray(model.loss_history)
+        # the Warray-parity trajectory round-trips as indexed pairs
+        payload["n_wh"] = np.int64(len(model.weight_history))
+        for i, (t, w) in enumerate(model.weight_history):
+            payload[f"wh_t_{i}"] = np.float64(t)
+            payload[f"wh_w_{i}"] = np.asarray(w)
+    else:
+        raise TypeError(f"no persistence for {type(model).__name__}")
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "wb") as f:  # direct handle: no double-buffered archive
+        np.savez(f, **payload)
+    return path
+
+
+def load_model(path: Union[str, Path]) -> Any:
+    path = Path(path)
+    if not path.exists() and path.suffix != ".npz":
+        path = path.with_suffix(path.suffix + ".npz")
+    with np.load(path, allow_pickle=False) as z:
+        cls = str(z["__class__"])
+        if cls == "DecisionTreeModel":
+            return _tree_restore(z, "t_")
+        if cls in ("RandomForestModel", "GradientBoostedTreesModel"):
+            trees = [
+                _tree_restore(z, f"tree{i}_")
+                for i in range(int(z["n_trees"]))
+            ]
+            if cls == "RandomForestModel":
+                return RandomForestModel(
+                    trees=trees, task=str(z["task"]),
+                    num_classes=int(z["num_classes"]),
+                )
+            return GradientBoostedTreesModel(
+                trees=trees, task=str(z["task"]),
+                learning_rate=float(z["learning_rate"]),
+                init_value=float(z["init_value"]),
+            )
+        if cls == "NaiveBayesModel":
+            mtype = str(z["model_type"])
+            if mtype == "gaussian":
+                return NaiveBayesModel(
+                    np.asarray(z["log_pi"]), None, "gaussian",
+                    (np.asarray(z["mean"]), np.asarray(z["var"])),
+                )
+            return NaiveBayesModel(
+                np.asarray(z["log_pi"]), np.asarray(z["log_theta"]), mtype
+            )
+        if cls == "KMeansModel":
+            return KMeansModel(
+                centers=np.asarray(z["centers"]), cost=float(z["cost"]),
+                iterations=int(z["iterations"]),
+            )
+        if cls == "PCAModel":
+            return PCAModel(
+                components=np.asarray(z["components"]),
+                explained_variance=np.asarray(z["explained_variance"]),
+                mean=np.asarray(z["mean"]),
+            )
+        if cls == "GaussianMixtureModel":
+            return GaussianMixtureModel(
+                weights=np.asarray(z["weights"]),
+                means=np.asarray(z["means"]),
+                covariances=np.asarray(z["covariances"]),
+                log_likelihood=float(z["log_likelihood"]),
+            )
+        if cls == "LDAModel":
+            return LDAModel(
+                topics=np.asarray(z["topics"]),
+                doc_topics=np.asarray(z["doc_topics"]),
+                alpha=float(z["alpha"]),
+                log_perplexity_history=np.asarray(z["hist"]),
+            )
+        if cls == "ALSModel":
+            return ALSModel(
+                user_factors=np.asarray(z["user_factors"]),
+                item_factors=np.asarray(z["item_factors"]),
+                rank=int(z["rank"]),
+            )
+        if cls == "SoftmaxRegressionModel":
+            return SoftmaxRegressionModel(
+                W=np.asarray(z["W"]), b=np.asarray(z["b"]),
+                loss_history=np.asarray(z["loss_history"]),
+            )
+        if cls in ("LinearModel", "LogisticRegressionModel", "SVMModel"):
+            klass = {
+                "LinearModel": LinearModel,
+                "LogisticRegressionModel": LogisticRegressionModel,
+                "SVMModel": SVMModel,
+            }[cls]
+            wh = [
+                (float(z[f"wh_t_{i}"]), np.asarray(z[f"wh_w_{i}"]))
+                for i in range(int(z["n_wh"])) if f"wh_t_{i}" in z
+            ] if "n_wh" in z else []
+            return klass(
+                weights=np.asarray(z["weights"]),
+                intercept=float(z["intercept"]),
+                loss_history=np.asarray(z["loss_history"]),
+                weight_history=wh,
+            )
+        raise ValueError(f"unknown model class tag {cls!r}")
+
+
+def save_as_libsvm_file(
+    X: np.ndarray, y: np.ndarray, path: Union[str, Path]
+) -> Path:
+    """``MLUtils.saveAsLibSVMFile`` parity (1-based indices, zeros skipped)."""
+    X = np.asarray(X)
+    y = np.asarray(y)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            row = X[i]
+            nz = np.nonzero(row)[0]
+            feats = " ".join(f"{j + 1}:{row[j]:.9g}" for j in nz)
+            f.write(f"{y[i]:.9g} {feats}\n".rstrip() + "\n")
+    return path

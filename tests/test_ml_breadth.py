@@ -506,3 +506,100 @@ class TestGradientBoostedTrees:
         X, y = reg_data
         with pytest.raises(ValueError, match="labels"):
             GradientBoostedTrees("classification").fit(X, y)
+
+
+class TestModelPersistence:
+    def test_round_trip_every_family(self, clf_data, reg_data, tmp_path):
+        from asyncframework_tpu.ml import (
+            GaussianMixture,
+            GradientBoostedTrees,
+            KMeans,
+            NaiveBayes,
+            PCA,
+            RandomForest,
+            SoftmaxRegression,
+            load_model,
+            save_model,
+        )
+        from asyncframework_tpu.ml.recommendation import ALS
+
+        X, y = clf_data
+        Xr, yr = reg_data
+        Xs = X[:300]
+        ys = y[:300]
+        rs = np.random.default_rng(0)
+        R = ((rs.random((20, 15)) < 0.4) * rs.random((20, 15))).astype(
+            np.float32
+        )
+
+        models = {
+            "tree": DecisionTree(max_depth=3).fit(Xs, ys),
+            "forest": RandomForest(num_trees=3, max_depth=3).fit(Xs, ys),
+            "gbt": GradientBoostedTrees("regression", num_iterations=3).fit(
+                Xr[:300], yr[:300]
+            ),
+            "nb": NaiveBayes(model_type="gaussian").fit(Xs, ys),
+            "nbm": NaiveBayes(model_type="multinomial").fit(np.abs(Xs), ys),
+            "kmeans": KMeans(3, seed=0).fit(Xs),
+            "pca": PCA(2).fit(Xs),
+            "gmm": GaussianMixture(2, max_iterations=5, seed=0).fit(Xs[:, :3]),
+            "softmax": SoftmaxRegression(num_iterations=20).fit(Xs, ys),
+            "als": ALS(rank=3, num_iterations=3).fit(R),
+        }
+        for name, model in models.items():
+            p = save_model(model, tmp_path / name)
+            loaded = load_model(p)
+            assert type(loaded).__name__ == type(model).__name__
+            if name == "als":  # different-signature predict
+                np.testing.assert_allclose(
+                    loaded.predict([0, 1], [2, 3]), model.predict([0, 1], [2, 3])
+                )
+                continue
+            if name == "pca":  # transform, not predict
+                np.testing.assert_allclose(
+                    np.asarray(loaded.transform(Xs[:20])),
+                    np.asarray(model.transform(Xs[:20])), rtol=1e-6,
+                )
+                continue
+            feed = Xr[:20] if name == "gbt" else (
+                np.abs(Xs[:20]) if name == "nbm" else
+                (Xs[:20, :3] if name == "gmm" else Xs[:20])
+            )
+            np.testing.assert_allclose(
+                np.asarray(model.predict(feed), np.float64),
+                np.asarray(loaded.predict(feed), np.float64),
+                rtol=1e-6,
+            )
+
+    def test_linear_models_round_trip(self, tmp_path):
+        from asyncframework_tpu.ml import load_model, save_model
+        from asyncframework_tpu.ml.models import LogisticRegressionModel
+
+        m = LogisticRegressionModel(
+            weights=np.asarray([0.5, -1.0], np.float32), intercept=0.25,
+            loss_history=np.asarray([1.0, 0.5]), weight_history=[],
+        )
+        p = save_model(m, tmp_path / "lr")
+        loaded = load_model(p)
+        X = np.asarray([[1.0, 1.0], [-2.0, 0.5]], np.float32)
+        np.testing.assert_allclose(loaded.predict(X), m.predict(X))
+
+    def test_save_as_libsvm_round_trip(self, tmp_path):
+        from asyncframework_tpu.data import load_libsvm
+        from asyncframework_tpu.ml import save_as_libsvm_file
+
+        rs = np.random.default_rng(1)
+        X = (rs.random((20, 6)) < 0.4) * rs.normal(size=(20, 6))
+        X = X.astype(np.float32)
+        y = rs.normal(size=20).astype(np.float32)
+        p = save_as_libsvm_file(X, y, tmp_path / "d.libsvm")
+        X2, y2 = load_libsvm(str(p), num_features=6, use_native=False)
+        # %.9g writes full float32 precision: exact round trip
+        np.testing.assert_array_equal(X2, X)
+        np.testing.assert_array_equal(y2, y)
+
+    def test_unknown_class_rejected(self, tmp_path):
+        from asyncframework_tpu.ml import save_model
+
+        with pytest.raises(TypeError, match="no persistence"):
+            save_model(object(), tmp_path / "x")
