@@ -26,6 +26,8 @@ from asyncframework_tpu.ml.models import (
     LinearRegression,
     LinearSVM,
     LogisticRegression,
+    RidgeRegression,
+    Lasso,
     SoftmaxRegression,
     SoftmaxRegressionModel,
 )
@@ -42,8 +44,10 @@ from asyncframework_tpu.ml.feature import (
 from asyncframework_tpu.ml.stat import (
     ChiSqTestResult,
     ColStats,
+    KSTestResult,
     chi_sq_test,
     chi_sq_test_matrix,
+    ks_test,
     col_stats,
     corr,
 )
@@ -63,6 +67,7 @@ from asyncframework_tpu.ml.boosting import (
 from asyncframework_tpu.ml.forest import RandomForest, RandomForestModel
 from asyncframework_tpu.ml.mixture import GaussianMixture, GaussianMixtureModel
 from asyncframework_tpu.ml.fpm import FPGrowth, FPGrowthModel, Rule
+from asyncframework_tpu.ml.isotonic import IsotonicRegression, IsotonicRegressionModel
 from asyncframework_tpu.ml.lda import LDA, LDAModel
 from asyncframework_tpu.ml.persistence import (
     load_model,
@@ -92,6 +97,12 @@ __all__ = [
     "LinearModel",
     "LinearRegression",
     "LogisticRegression",
+    "RidgeRegression",
+    "Lasso",
+    "IsotonicRegression",
+    "IsotonicRegressionModel",
+    "ks_test",
+    "KSTestResult",
     "SoftmaxRegression",
     "SoftmaxRegressionModel",
     "LinearSVM",

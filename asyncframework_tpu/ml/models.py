@@ -232,3 +232,17 @@ class SoftmaxRegression:
         return SoftmaxRegressionModel(
             W=np.asarray(W), b=np.asarray(b), loss_history=np.asarray(losses)
         )
+
+
+class RidgeRegression(_SGDEstimator):
+    """``RidgeRegressionWithSGD`` analog: least squares + L2 updater."""
+
+    _gradient_cls = LeastSquaresGradient
+    _default_updater = SquaredL2Updater
+
+
+class Lasso(_SGDEstimator):
+    """``LassoWithSGD`` analog: least squares + L1 (soft-threshold) updater."""
+
+    _gradient_cls = LeastSquaresGradient
+    _default_updater = L1Updater

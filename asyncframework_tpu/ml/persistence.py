@@ -23,6 +23,7 @@ from asyncframework_tpu.ml.boosting import GradientBoostedTreesModel
 from asyncframework_tpu.ml.clustering import KMeansModel
 from asyncframework_tpu.ml.decomposition import PCAModel
 from asyncframework_tpu.ml.forest import RandomForestModel
+from asyncframework_tpu.ml.isotonic import IsotonicRegressionModel
 from asyncframework_tpu.ml.lda import LDAModel
 from asyncframework_tpu.ml.mixture import GaussianMixtureModel
 from asyncframework_tpu.ml.models import (
@@ -83,6 +84,10 @@ def save_model(model: Any, path: Union[str, Path]) -> Path:
             payload["var"] = np.asarray(var)
         else:
             payload["log_theta"] = np.asarray(model.log_theta)
+    elif isinstance(model, IsotonicRegressionModel):
+        payload["boundaries"] = model.boundaries
+        payload["predictions"] = model.predictions
+        payload["increasing"] = np.bool_(model.increasing)
     elif isinstance(model, KMeansModel):
         payload["centers"] = np.asarray(model.centers)
         payload["cost"] = np.float64(model.cost)
@@ -159,6 +164,12 @@ def load_model(path: Union[str, Path]) -> Any:
                 )
             return NaiveBayesModel(
                 np.asarray(z["log_pi"]), np.asarray(z["log_theta"]), mtype
+            )
+        if cls == "IsotonicRegressionModel":
+            return IsotonicRegressionModel(
+                boundaries=np.asarray(z["boundaries"]),
+                predictions=np.asarray(z["predictions"]),
+                increasing=bool(z["increasing"]),
             )
         if cls == "KMeansModel":
             return KMeansModel(

@@ -603,3 +603,85 @@ class TestModelPersistence:
 
         with pytest.raises(TypeError, match="no persistence"):
             save_model(object(), tmp_path / "x")
+
+
+class TestRegressionVariantsAndTests:
+    def test_ridge_and_lasso(self, reg_data):
+        from asyncframework_tpu.ml import Lasso, RidgeRegression
+
+        rs = np.random.default_rng(0)
+        X = rs.normal(size=(600, 10)).astype(np.float32)
+        w_true = np.zeros(10, np.float32)
+        w_true[:3] = [2.0, -1.5, 1.0]  # sparse truth for the lasso
+        y = (X @ w_true + 0.05 * rs.normal(size=600)).astype(np.float32)
+        ridge = RidgeRegression(step_size=0.1, num_iterations=300,
+                                reg_param=0.01).fit(X, y)
+        lasso = Lasso(step_size=0.1, num_iterations=300,
+                      reg_param=0.05).fit(X, y)
+        np.testing.assert_allclose(ridge.weights[:3], w_true[:3], atol=0.2)
+        # L1 drives the dead coefficients toward exactly zero
+        assert np.abs(lasso.weights[3:]).max() < 0.05
+        assert np.abs(ridge.weights[3:]).max() < 0.2
+
+    def test_isotonic_matches_sklearn(self):
+        from sklearn.isotonic import IsotonicRegression as SKIso
+
+        from asyncframework_tpu.ml import IsotonicRegression
+
+        rs = np.random.default_rng(1)
+        x = np.sort(rs.random(200) * 10)
+        y = np.log1p(x) + rs.normal(0, 0.15, 200)
+        ours = IsotonicRegression().fit(x, y)
+        sk = SKIso(out_of_bounds="clip").fit(x, y)
+        grid = np.linspace(0, 10, 50)
+        np.testing.assert_allclose(
+            ours.predict(grid), sk.predict(grid), atol=1e-6
+        )
+
+    def test_isotonic_decreasing_and_weights(self):
+        from asyncframework_tpu.ml import IsotonicRegression
+
+        x = np.asarray([1.0, 2, 3, 4])
+        y = np.asarray([4.0, 3, 3.5, 1])
+        m = IsotonicRegression(increasing=False).fit(x, y)
+        pred = m.predict(x)
+        assert all(a >= b - 1e-9 for a, b in zip(pred, pred[1:]))
+        with pytest.raises(ValueError, match="positive"):
+            IsotonicRegression().fit(x, y, weights=[1, 0, 1, 1])
+
+    def test_ks_test_matches_scipy(self):
+        from scipy.stats import kstest
+
+        from asyncframework_tpu.ml import ks_test
+
+        rs = np.random.default_rng(2)
+        sample = rs.normal(0.2, 1.0, 400)
+        got = ks_test(sample, "norm")
+        ref = kstest(sample, "norm")
+        np.testing.assert_allclose(got.statistic, ref.statistic, rtol=1e-6)
+        np.testing.assert_allclose(got.p_value, ref.pvalue, rtol=0.05)
+        # agreement with scipy on a null-true sample as well (an absolute
+        # p > 0.05 assertion would fail ~5% of seeds by definition)
+        s2 = rs.normal(0, 1, 400)
+        got2 = ks_test(s2, "norm")
+        ref2 = kstest(s2, "norm")
+        np.testing.assert_allclose(got2.statistic, ref2.statistic, rtol=1e-6)
+        np.testing.assert_allclose(got2.p_value, ref2.pvalue, rtol=0.05)
+
+    def test_isotonic_ties_pooled_and_persist(self, tmp_path):
+        from sklearn.isotonic import IsotonicRegression as SKIso
+
+        from asyncframework_tpu.ml import (
+            IsotonicRegression,
+            load_model,
+            save_model,
+        )
+
+        x = np.asarray([1.0, 1.0, 2.0])
+        y = np.asarray([0.0, 1.0, 2.0])
+        m = IsotonicRegression().fit(x, y)
+        sk = SKIso(out_of_bounds="clip").fit(x, y)
+        np.testing.assert_allclose(m.predict([1.0]), sk.predict([1.0]))
+        loaded = load_model(save_model(m, tmp_path / "iso"))
+        grid = np.linspace(0.5, 2.5, 9)
+        np.testing.assert_allclose(loaded.predict(grid), m.predict(grid))
