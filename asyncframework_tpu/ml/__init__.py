@@ -69,6 +69,15 @@ from asyncframework_tpu.ml.mixture import GaussianMixture, GaussianMixtureModel
 from asyncframework_tpu.ml.fpm import FPGrowth, FPGrowthModel, Rule
 from asyncframework_tpu.ml.isotonic import IsotonicRegression, IsotonicRegressionModel
 from asyncframework_tpu.ml.lda import LDA, LDAModel
+from asyncframework_tpu.ml.pipeline import (
+    CrossValidator,
+    CrossValidatorModel,
+    Pipeline,
+    PipelineModel,
+    accuracy_scorer,
+    r2_scorer,
+    train_test_split,
+)
 from asyncframework_tpu.ml.persistence import (
     load_model,
     save_as_libsvm_file,
@@ -129,6 +138,13 @@ __all__ = [
     "Rule",
     "LDA",
     "LDAModel",
+    "Pipeline",
+    "PipelineModel",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "train_test_split",
+    "accuracy_scorer",
+    "r2_scorer",
     "save_model",
     "load_model",
     "save_as_libsvm_file",

@@ -32,6 +32,7 @@ from asyncframework_tpu.ml.models import (
     SoftmaxRegressionModel,
     SVMModel,
 )
+from asyncframework_tpu.ml.pipeline import PipelineModel
 from asyncframework_tpu.ml.recommendation import ALSModel
 from asyncframework_tpu.ml.tree import DecisionTreeModel
 
@@ -56,14 +57,16 @@ def _tree_restore(z, prefix: str) -> DecisionTreeModel:
     )
 
 
-def save_model(model: Any, path: Union[str, Path]) -> Path:
-    """Persist a model to ``path`` (``.npz`` appended when absent)."""
-    path = Path(path)
-    if path.suffix != ".npz":
-        path = path.with_suffix(path.suffix + ".npz")
+def _model_payload(model: Any) -> Dict[str, Any]:
     payload: Dict[str, Any] = {"__class__": np.str_(type(model).__name__)}
 
-    if isinstance(model, DecisionTreeModel):
+    if isinstance(model, PipelineModel):
+        payload["n_tf"] = np.int64(len(model.transformers))
+        for i, t in enumerate(model.transformers):
+            payload.update(_transformer_payload(t, f"tf{i}_"))
+        for k, v in _model_payload(model.model).items():
+            payload[f"inner_{k}"] = v
+    elif isinstance(model, DecisionTreeModel):
         payload.update(_tree_payload(model, "t_"))
     elif isinstance(model, (RandomForestModel, GradientBoostedTreesModel)):
         payload["n_trees"] = np.int64(len(model.trees))
@@ -125,11 +128,69 @@ def save_model(model: Any, path: Union[str, Path]) -> Path:
             payload[f"wh_w_{i}"] = np.asarray(w)
     else:
         raise TypeError(f"no persistence for {type(model).__name__}")
+    return payload
 
+
+def save_model(model: Any, path: Union[str, Path]) -> Path:
+    """Persist a model to ``path`` (``.npz`` appended when absent)."""
+    path = Path(path)
+    if path.suffix != ".npz":
+        path = path.with_suffix(path.suffix + ".npz")
+    payload = _model_payload(model)
     path.parent.mkdir(parents=True, exist_ok=True)
     with open(path, "wb") as f:  # direct handle: no double-buffered archive
         np.savez(f, **payload)
     return path
+
+
+_TRANSFORMER_FIELDS = {
+    "StandardScaler": ("mean_", "std_", "with_mean", "with_std"),
+    "MinMaxScaler": ("min_", "max_", "lo", "hi"),
+    "Normalizer": ("p",),
+    "IDFModel": ("idf",),
+}
+
+
+def _transformer_payload(t: Any, prefix: str) -> Dict[str, Any]:
+    name = type(t).__name__
+    if name not in _TRANSFORMER_FIELDS:
+        raise TypeError(f"no persistence for pipeline stage {name}")
+    out: Dict[str, Any] = {f"{prefix}__class__": np.str_(name)}
+    for field in _TRANSFORMER_FIELDS[name]:
+        out[f"{prefix}{field}"] = np.asarray(getattr(t, field))
+    return out
+
+
+def _transformer_restore(z, prefix: str) -> Any:
+    from asyncframework_tpu.ml.feature import (
+        IDF,
+        IDFModel,
+        MinMaxScaler,
+        Normalizer,
+        StandardScaler,
+    )
+
+    name = str(z[f"{prefix}__class__"])
+    if name == "StandardScaler":
+        t = StandardScaler(
+            with_mean=bool(z[f"{prefix}with_mean"]),
+            with_std=bool(z[f"{prefix}with_std"]),
+        )
+        t.mean_ = np.asarray(z[f"{prefix}mean_"])
+        t.std_ = np.asarray(z[f"{prefix}std_"])
+        return t
+    if name == "MinMaxScaler":
+        t = MinMaxScaler(lo=float(z[f"{prefix}lo"]), hi=float(z[f"{prefix}hi"]))
+        t.min_ = np.asarray(z[f"{prefix}min_"])
+        t.max_ = np.asarray(z[f"{prefix}max_"])
+        return t
+    if name == "Normalizer":
+        return Normalizer(p=float(z[f"{prefix}p"]))
+    if name == "IDFModel":
+        import jax.numpy as jnp
+
+        return IDFModel(jnp.asarray(z[f"{prefix}idf"]))
+    raise ValueError(f"unknown transformer tag {name}")
 
 
 def load_model(path: Union[str, Path]) -> Any:
@@ -137,7 +198,24 @@ def load_model(path: Union[str, Path]) -> Any:
     if not path.exists() and path.suffix != ".npz":
         path = path.with_suffix(path.suffix + ".npz")
     with np.load(path, allow_pickle=False) as z:
+        return _model_restore({k: z[k] for k in z.files})
+
+
+def _model_restore(z: Dict[str, Any]) -> Any:
+    if True:
         cls = str(z["__class__"])
+        if cls == "PipelineModel":
+            tfs = [
+                _transformer_restore(z, f"tf{i}_")
+                for i in range(int(z["n_tf"]))
+            ]
+            inner = {
+                k[len("inner_"):]: v
+                for k, v in z.items() if k.startswith("inner_")
+            }
+            return PipelineModel(
+                transformers=tfs, model=_model_restore(inner)
+            )
         if cls == "DecisionTreeModel":
             return _tree_restore(z, "t_")
         if cls in ("RandomForestModel", "GradientBoostedTreesModel"):

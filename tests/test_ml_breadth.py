@@ -685,3 +685,86 @@ class TestRegressionVariantsAndTests:
         loaded = load_model(save_model(m, tmp_path / "iso"))
         grid = np.linspace(0.5, 2.5, 9)
         np.testing.assert_allclose(loaded.predict(grid), m.predict(grid))
+
+
+class TestPipelineAndTuning:
+    def test_pipeline_scaler_into_classifier(self, clf_data):
+        from asyncframework_tpu.ml import (
+            DecisionTree,
+            Pipeline,
+            StandardScaler,
+            accuracy_scorer,
+            train_test_split,
+        )
+
+        X, y = clf_data
+        Xtr, ytr, Xte, yte = train_test_split(X, y, 0.3, seed=0)
+        model = Pipeline([
+            StandardScaler(),
+            DecisionTree(max_depth=5, max_bins=64),
+        ]).fit(Xtr, ytr)
+        acc = accuracy_scorer(model, Xte, yte)
+        assert acc > 0.75
+        # the fitted scaler travels with the model
+        assert model.transformers[0].mean_ is not None
+
+    def test_pipeline_rejects_bad_shapes(self):
+        from asyncframework_tpu.ml import DecisionTree, Pipeline
+
+        with pytest.raises(ValueError):
+            Pipeline([])
+        with pytest.raises(TypeError, match="transform"):
+            Pipeline([DecisionTree(), DecisionTree()]).fit(
+                np.zeros((4, 2), np.float32), np.zeros(4)
+            )
+
+    def test_cross_validator_picks_better_depth(self, clf_data):
+        from asyncframework_tpu.ml import (
+            CrossValidator,
+            DecisionTree,
+            accuracy_scorer,
+        )
+
+        X, y = clf_data
+        cv = CrossValidator(
+            estimator_factory=lambda max_depth: DecisionTree(
+                max_depth=max_depth, max_bins=32
+            ),
+            param_grid={"max_depth": [1, 5]},
+            scorer=accuracy_scorer,
+            num_folds=3,
+            seed=1,
+        ).fit(X[:900], y[:900])
+        assert cv.best_params == {"max_depth": 5}
+        assert len(cv.all_scores) == 2
+        scores = dict((tuple(p.items()), s) for p, s in cv.all_scores)
+        assert scores[(("max_depth", 5),)] > scores[(("max_depth", 1),)]
+        assert (cv.predict(X[:50]) == y[:50]).mean() > 0.7
+
+    def test_train_test_split_partitions(self):
+        from asyncframework_tpu.ml import train_test_split
+
+        X = np.arange(40).reshape(20, 2)
+        y = np.arange(20)
+        Xtr, ytr, Xte, yte = train_test_split(X, y, 0.25, seed=3)
+        assert len(Xte) == 5 and len(Xtr) == 15
+        assert sorted(np.concatenate([ytr, yte]).tolist()) == list(range(20))
+
+    def test_pipeline_model_persists(self, clf_data, tmp_path):
+        from asyncframework_tpu.ml import (
+            DecisionTree,
+            Pipeline,
+            StandardScaler,
+            load_model,
+            save_model,
+        )
+
+        X, y = clf_data
+        pipe = Pipeline([StandardScaler(), DecisionTree(max_depth=4)]).fit(
+            X[:600], y[:600]
+        )
+        p = save_model(pipe, tmp_path / "pipe")
+        loaded = load_model(p)
+        np.testing.assert_array_equal(
+            loaded.predict(X[600:700]), pipe.predict(X[600:700])
+        )
