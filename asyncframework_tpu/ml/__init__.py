@@ -31,7 +31,11 @@ from asyncframework_tpu.ml.models import (
     SoftmaxRegression,
     SoftmaxRegressionModel,
 )
-from asyncframework_tpu.ml.clustering import KMeans, KMeansModel
+from asyncframework_tpu.ml.clustering import (
+    KMeans,
+    KMeansModel,
+    PowerIterationClustering,
+)
 from asyncframework_tpu.ml.recommendation import ALS, ALSModel
 from asyncframework_tpu.ml.feature import (
     IDF,
@@ -78,6 +82,7 @@ from asyncframework_tpu.ml.pipeline import (
     r2_scorer,
     train_test_split,
 )
+from asyncframework_tpu.ml.word2vec import Word2Vec, Word2VecModel
 from asyncframework_tpu.ml.persistence import (
     load_model,
     save_as_libsvm_file,
@@ -117,6 +122,9 @@ __all__ = [
     "LinearSVM",
     "KMeans",
     "KMeansModel",
+    "PowerIterationClustering",
+    "Word2Vec",
+    "Word2VecModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "PCA",

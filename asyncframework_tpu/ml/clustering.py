@@ -120,3 +120,55 @@ class KMeans:
         # assignment pass -- the in-loop cost is w.r.t. pre-update centers
         _s, _c, cost_arr = lloyd_step(Xs, vs, centers)
         return KMeansModel(np.asarray(centers), float(cost_arr), it)
+
+
+class PowerIterationClustering:
+    """Clustering by power iteration on the normalized affinity matrix.
+
+    Parity: ``mllib/src/main/scala/org/apache/spark/mllib/clustering/
+    PowerIterationClustering.scala`` (Lin & Cohen) -- iterate
+    ``v <- W v / |W v|_1`` on the row-normalized affinities, then k-means
+    the resulting 1-d embedding.
+
+    TPU mapping: the reference runs each iteration as a GraphX
+    aggregateMessages job; here the affinity is a dense (n, n) matrix and
+    every iteration is one MXU matvec (dense regime note as in
+    ``graph/algorithms.py``: n up to ~2^14).
+    """
+
+    def __init__(self, k: int, max_iterations: int = 30, seed: int = 0):
+        if k < 2:
+            raise ValueError("k must be >= 2")
+        self.k = k
+        self.max_iterations = max_iterations
+        self.seed = seed
+
+    def fit_predict(self, affinity) -> np.ndarray:
+        W = jnp.asarray(affinity, jnp.float32)
+        if W.ndim != 2 or W.shape[0] != W.shape[1]:
+            raise ValueError("affinity must be square (n, n)")
+        if bool(jnp.any(W < 0)):
+            raise ValueError("affinities must be nonnegative")
+        n = W.shape[0]
+        deg = jnp.maximum(W.sum(axis=1, keepdims=True), 1e-12)
+        Wn = W / deg  # row-normalized
+
+        # init: degree-proportional vector (the reference's default)
+        v0 = (deg[:, 0] / jnp.sum(deg)).astype(jnp.float32)
+        v = _pic_iterate(Wn, v0, self.max_iterations)
+        emb = np.asarray(v)[:, None]
+        km = KMeans(self.k, seed=self.seed).fit(emb)
+        return np.asarray(km.predict(emb))
+
+
+@jax.jit
+def _pic_iterate(Wn, v, iters):
+    """Power iteration; Wn rides as a jit ARGUMENT (a captured closure
+    would bake the (n, n) matrix into the executable as a constant and
+    retrace per call)."""
+
+    def body(_i, v):
+        v = Wn @ v
+        return v / jnp.sum(jnp.abs(v))
+
+    return jax.lax.fori_loop(0, iters, body, v)

@@ -202,106 +202,105 @@ def load_model(path: Union[str, Path]) -> Any:
 
 
 def _model_restore(z: Dict[str, Any]) -> Any:
-    if True:
-        cls = str(z["__class__"])
-        if cls == "PipelineModel":
-            tfs = [
-                _transformer_restore(z, f"tf{i}_")
-                for i in range(int(z["n_tf"]))
-            ]
-            inner = {
-                k[len("inner_"):]: v
-                for k, v in z.items() if k.startswith("inner_")
-            }
-            return PipelineModel(
-                transformers=tfs, model=_model_restore(inner)
-            )
-        if cls == "DecisionTreeModel":
-            return _tree_restore(z, "t_")
-        if cls in ("RandomForestModel", "GradientBoostedTreesModel"):
-            trees = [
-                _tree_restore(z, f"tree{i}_")
-                for i in range(int(z["n_trees"]))
-            ]
-            if cls == "RandomForestModel":
-                return RandomForestModel(
-                    trees=trees, task=str(z["task"]),
-                    num_classes=int(z["num_classes"]),
-                )
-            return GradientBoostedTreesModel(
+    cls = str(z["__class__"])
+    if cls == "PipelineModel":
+        tfs = [
+            _transformer_restore(z, f"tf{i}_")
+            for i in range(int(z["n_tf"]))
+        ]
+        inner = {
+            k[len("inner_"):]: v
+            for k, v in z.items() if k.startswith("inner_")
+        }
+        return PipelineModel(
+            transformers=tfs, model=_model_restore(inner)
+        )
+    if cls == "DecisionTreeModel":
+        return _tree_restore(z, "t_")
+    if cls in ("RandomForestModel", "GradientBoostedTreesModel"):
+        trees = [
+            _tree_restore(z, f"tree{i}_")
+            for i in range(int(z["n_trees"]))
+        ]
+        if cls == "RandomForestModel":
+            return RandomForestModel(
                 trees=trees, task=str(z["task"]),
-                learning_rate=float(z["learning_rate"]),
-                init_value=float(z["init_value"]),
+                num_classes=int(z["num_classes"]),
             )
-        if cls == "NaiveBayesModel":
-            mtype = str(z["model_type"])
-            if mtype == "gaussian":
-                return NaiveBayesModel(
-                    np.asarray(z["log_pi"]), None, "gaussian",
-                    (np.asarray(z["mean"]), np.asarray(z["var"])),
-                )
+        return GradientBoostedTreesModel(
+            trees=trees, task=str(z["task"]),
+            learning_rate=float(z["learning_rate"]),
+            init_value=float(z["init_value"]),
+        )
+    if cls == "NaiveBayesModel":
+        mtype = str(z["model_type"])
+        if mtype == "gaussian":
             return NaiveBayesModel(
-                np.asarray(z["log_pi"]), np.asarray(z["log_theta"]), mtype
+                np.asarray(z["log_pi"]), None, "gaussian",
+                (np.asarray(z["mean"]), np.asarray(z["var"])),
             )
-        if cls == "IsotonicRegressionModel":
-            return IsotonicRegressionModel(
-                boundaries=np.asarray(z["boundaries"]),
-                predictions=np.asarray(z["predictions"]),
-                increasing=bool(z["increasing"]),
-            )
-        if cls == "KMeansModel":
-            return KMeansModel(
-                centers=np.asarray(z["centers"]), cost=float(z["cost"]),
-                iterations=int(z["iterations"]),
-            )
-        if cls == "PCAModel":
-            return PCAModel(
-                components=np.asarray(z["components"]),
-                explained_variance=np.asarray(z["explained_variance"]),
-                mean=np.asarray(z["mean"]),
-            )
-        if cls == "GaussianMixtureModel":
-            return GaussianMixtureModel(
-                weights=np.asarray(z["weights"]),
-                means=np.asarray(z["means"]),
-                covariances=np.asarray(z["covariances"]),
-                log_likelihood=float(z["log_likelihood"]),
-            )
-        if cls == "LDAModel":
-            return LDAModel(
-                topics=np.asarray(z["topics"]),
-                doc_topics=np.asarray(z["doc_topics"]),
-                alpha=float(z["alpha"]),
-                log_perplexity_history=np.asarray(z["hist"]),
-            )
-        if cls == "ALSModel":
-            return ALSModel(
-                user_factors=np.asarray(z["user_factors"]),
-                item_factors=np.asarray(z["item_factors"]),
-                rank=int(z["rank"]),
-            )
-        if cls == "SoftmaxRegressionModel":
-            return SoftmaxRegressionModel(
-                W=np.asarray(z["W"]), b=np.asarray(z["b"]),
-                loss_history=np.asarray(z["loss_history"]),
-            )
-        if cls in ("LinearModel", "LogisticRegressionModel", "SVMModel"):
-            klass = {
-                "LinearModel": LinearModel,
-                "LogisticRegressionModel": LogisticRegressionModel,
-                "SVMModel": SVMModel,
-            }[cls]
-            wh = [
-                (float(z[f"wh_t_{i}"]), np.asarray(z[f"wh_w_{i}"]))
-                for i in range(int(z["n_wh"])) if f"wh_t_{i}" in z
-            ] if "n_wh" in z else []
-            return klass(
-                weights=np.asarray(z["weights"]),
-                intercept=float(z["intercept"]),
-                loss_history=np.asarray(z["loss_history"]),
-                weight_history=wh,
-            )
-        raise ValueError(f"unknown model class tag {cls!r}")
+        return NaiveBayesModel(
+            np.asarray(z["log_pi"]), np.asarray(z["log_theta"]), mtype
+        )
+    if cls == "IsotonicRegressionModel":
+        return IsotonicRegressionModel(
+            boundaries=np.asarray(z["boundaries"]),
+            predictions=np.asarray(z["predictions"]),
+            increasing=bool(z["increasing"]),
+        )
+    if cls == "KMeansModel":
+        return KMeansModel(
+            centers=np.asarray(z["centers"]), cost=float(z["cost"]),
+            iterations=int(z["iterations"]),
+        )
+    if cls == "PCAModel":
+        return PCAModel(
+            components=np.asarray(z["components"]),
+            explained_variance=np.asarray(z["explained_variance"]),
+            mean=np.asarray(z["mean"]),
+        )
+    if cls == "GaussianMixtureModel":
+        return GaussianMixtureModel(
+            weights=np.asarray(z["weights"]),
+            means=np.asarray(z["means"]),
+            covariances=np.asarray(z["covariances"]),
+            log_likelihood=float(z["log_likelihood"]),
+        )
+    if cls == "LDAModel":
+        return LDAModel(
+            topics=np.asarray(z["topics"]),
+            doc_topics=np.asarray(z["doc_topics"]),
+            alpha=float(z["alpha"]),
+            log_perplexity_history=np.asarray(z["hist"]),
+        )
+    if cls == "ALSModel":
+        return ALSModel(
+            user_factors=np.asarray(z["user_factors"]),
+            item_factors=np.asarray(z["item_factors"]),
+            rank=int(z["rank"]),
+        )
+    if cls == "SoftmaxRegressionModel":
+        return SoftmaxRegressionModel(
+            W=np.asarray(z["W"]), b=np.asarray(z["b"]),
+            loss_history=np.asarray(z["loss_history"]),
+        )
+    if cls in ("LinearModel", "LogisticRegressionModel", "SVMModel"):
+        klass = {
+            "LinearModel": LinearModel,
+            "LogisticRegressionModel": LogisticRegressionModel,
+            "SVMModel": SVMModel,
+        }[cls]
+        wh = [
+            (float(z[f"wh_t_{i}"]), np.asarray(z[f"wh_w_{i}"]))
+            for i in range(int(z["n_wh"])) if f"wh_t_{i}" in z
+        ] if "n_wh" in z else []
+        return klass(
+            weights=np.asarray(z["weights"]),
+            intercept=float(z["intercept"]),
+            loss_history=np.asarray(z["loss_history"]),
+            weight_history=wh,
+        )
+    raise ValueError(f"unknown model class tag {cls!r}")
 
 
 def save_as_libsvm_file(

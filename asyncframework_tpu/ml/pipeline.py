@@ -105,6 +105,11 @@ def train_test_split(
     rs = np.random.default_rng(seed)
     perm = rs.permutation(len(X))
     cut = int(round(len(X) * (1.0 - test_fraction)))
+    if cut == 0 or cut == len(X):
+        raise ValueError(
+            f"test_fraction={test_fraction} leaves an empty partition for "
+            f"{len(X)} rows"
+        )
     tr, te = perm[:cut], perm[cut:]
     return X[tr], y[tr], X[te], y[te]
 
@@ -177,7 +182,13 @@ class CrossValidator:
                 model = self.factory(**params).fit(X[trn], y[trn])
                 scores.append(float(self.scorer(model, X[val], y[val])))
             results.append((params, float(np.mean(scores))))
-        best_params, best_score = max(results, key=lambda r: r[1])
+        valid = [r for r in results if not np.isnan(r[1])]
+        if not valid:
+            raise ValueError(
+                "every candidate scored NaN (scorer undefined on these "
+                "folds, e.g. constant-target validation splits)"
+            )
+        best_params, best_score = max(valid, key=lambda r: r[1])
         best_model = self.factory(**best_params).fit(X, y)  # refit on all
         return CrossValidatorModel(
             best_params=best_params,

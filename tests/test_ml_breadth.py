@@ -768,3 +768,102 @@ class TestPipelineAndTuning:
         np.testing.assert_array_equal(
             loaded.predict(X[600:700]), pipe.predict(X[600:700])
         )
+
+
+class TestPowerIterationClustering:
+    def test_two_blocks_recovered(self):
+        from asyncframework_tpu.ml import PowerIterationClustering
+
+        rs = np.random.default_rng(0)
+        n = 60
+        W = np.zeros((n, n), np.float32)
+        # two dense blocks with weak cross links
+        for lo, hi in [(0, 30), (30, 60)]:
+            block = rs.random((30, 30)) * 0.9 + 0.1
+            W[lo:hi, lo:hi] = (block + block.T) / 2
+        W += rs.random((n, n)).astype(np.float32) * 0.02
+        W = (W + W.T) / 2
+        np.fill_diagonal(W, 0.0)
+        labels = PowerIterationClustering(2, max_iterations=40).fit_predict(W)
+        a, b = labels[:30], labels[30:]
+        assert (a == np.bincount(a).argmax()).mean() > 0.9
+        assert np.bincount(a).argmax() != np.bincount(b).argmax()
+
+    def test_rejects_bad_affinity(self):
+        from asyncframework_tpu.ml import PowerIterationClustering
+
+        with pytest.raises(ValueError, match="square"):
+            PowerIterationClustering(2).fit_predict(np.ones((3, 4)))
+        with pytest.raises(ValueError, match="nonnegative"):
+            PowerIterationClustering(2).fit_predict(
+                np.asarray([[0.0, -1.0], [-1.0, 0.0]])
+            )
+
+
+class TestWord2Vec:
+    def corpus(self, n=400, seed=0):
+        """Two topic groups whose words co-occur only within the group."""
+        rs = np.random.default_rng(seed)
+        tech = ["chip", "mesh", "ici", "hbm", "kernel", "compile"]
+        food = ["bread", "milk", "butter", "cheese", "apple", "flour"]
+        sents = []
+        for _ in range(n):
+            group = tech if rs.random() < 0.5 else food
+            sents.append(list(rs.choice(group, size=6)))
+        return sents, tech, food
+
+    def test_groups_separate_in_embedding_space(self):
+        from asyncframework_tpu.ml import Word2Vec
+
+        sents, tech, food = self.corpus()
+        model = Word2Vec(vector_size=16, window=3, min_count=2,
+                         num_iterations=25, learning_rate=0.3,
+                         batch_size=256, seed=1).fit(sents)
+        # within-group similarity dominates cross-group
+        win, cross = [], []
+        for a in tech:
+            for b in tech:
+                if a != b:
+                    win.append(model.similarity(a, b))
+            for b in food:
+                cross.append(model.similarity(a, b))
+        assert np.mean(win) > np.mean(cross) + 0.2
+
+    def test_find_synonyms_prefers_same_group(self):
+        from asyncframework_tpu.ml import Word2Vec
+
+        sents, tech, food = self.corpus(seed=2)
+        model = Word2Vec(vector_size=16, window=3, num_iterations=25,
+                         learning_rate=0.3, batch_size=256, seed=3).fit(sents)
+        top = [w for w, _ in model.find_synonyms("chip", 3)]
+        assert all(w in tech for w in top), top
+        assert "chip" not in top
+
+    def test_vocab_and_errors(self):
+        from asyncframework_tpu.ml import Word2Vec
+
+        sents, _, _ = self.corpus()
+        model = Word2Vec(vector_size=8, num_iterations=1, seed=0).fit(sents)
+        assert "chip" in model and "nonexistent" not in model
+        with pytest.raises(KeyError):
+            model.transform("nonexistent")
+        with pytest.raises(ValueError, match="vocabulary"):
+            Word2Vec(min_count=100).fit([["a", "b"]])
+
+    def test_cv_rejects_all_nan_and_split_guards(self):
+        from asyncframework_tpu.ml import (
+            CrossValidator,
+            DecisionTree,
+            train_test_split,
+        )
+
+        X = np.random.default_rng(0).normal(size=(30, 3)).astype(np.float32)
+        y = np.zeros(30)
+        nan_scorer = lambda m, Xv, yv: float("nan")  # noqa: E731
+        with pytest.raises(ValueError, match="NaN"):
+            CrossValidator(
+                lambda max_depth: DecisionTree(max_depth=max_depth),
+                {"max_depth": [2]}, nan_scorer, 3,
+            ).fit(X, y)
+        with pytest.raises(ValueError, match="empty partition"):
+            train_test_split(X[:2], y[:2], test_fraction=0.1)
