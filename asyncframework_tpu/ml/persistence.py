@@ -35,6 +35,7 @@ from asyncframework_tpu.ml.models import (
 from asyncframework_tpu.ml.pipeline import PipelineModel
 from asyncframework_tpu.ml.recommendation import ALSModel
 from asyncframework_tpu.ml.tree import DecisionTreeModel
+from asyncframework_tpu.ml.word2vec import Word2VecModel
 
 
 def _tree_payload(t: DecisionTreeModel, prefix: str) -> Dict[str, np.ndarray]:
@@ -113,6 +114,9 @@ def _model_payload(model: Any) -> Dict[str, Any]:
         payload["user_factors"] = model.user_factors
         payload["item_factors"] = model.item_factors
         payload["rank"] = np.int64(model.rank)
+    elif isinstance(model, Word2VecModel):
+        payload["vocab"] = np.asarray(model.vocab, dtype=np.str_)
+        payload["vectors"] = np.asarray(model.vectors)
     elif isinstance(model, SoftmaxRegressionModel):
         payload["W"] = model.W
         payload["b"] = model.b
@@ -278,6 +282,11 @@ def _model_restore(z: Dict[str, Any]) -> Any:
             user_factors=np.asarray(z["user_factors"]),
             item_factors=np.asarray(z["item_factors"]),
             rank=int(z["rank"]),
+        )
+    if cls == "Word2VecModel":
+        return Word2VecModel(
+            vocab=[str(w) for w in z["vocab"]],
+            vectors=np.asarray(z["vectors"]),
         )
     if cls == "SoftmaxRegressionModel":
         return SoftmaxRegressionModel(

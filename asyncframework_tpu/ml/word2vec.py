@@ -32,17 +32,20 @@ class Word2VecModel:
     def __contains__(self, word: str) -> bool:
         return word in self._index
 
-    def transform(self, word: str) -> np.ndarray:
+    def _idx(self, word: str) -> int:
         if word not in self._index:
             raise KeyError(f"word {word!r} not in vocabulary")
-        return self.vectors[self._index[word]]
+        return self._index[word]
+
+    def transform(self, word: str) -> np.ndarray:
+        return self.vectors[self._idx(word)]
 
     def similarity(self, a: str, b: str) -> float:
-        return float(self._unit[self._index[a]] @ self._unit[self._index[b]])
+        return float(self._unit[self._idx(a)] @ self._unit[self._idx(b)])
 
     def find_synonyms(self, word: str, num: int) -> List[tuple]:
         """Top-``num`` (word, cosine) excluding the query (reference API)."""
-        q = self._unit[self._index[word]]
+        q = self._unit[self._idx(word)]
         sims = self._unit @ q
         order = np.argsort(-sims)
         out = []
@@ -69,6 +72,8 @@ class Word2Vec:
     ):
         if vector_size < 1 or window < 1 or negative < 1:
             raise ValueError("vector_size, window, negative must be >= 1")
+        if batch_size < 1 or num_iterations < 1:
+            raise ValueError("batch_size and num_iterations must be >= 1")
         self.vector_size = vector_size
         self.window = window
         self.min_count = min_count
@@ -106,8 +111,13 @@ class Word2Vec:
         rs = np.random.default_rng(self.seed)
         rs.shuffle(pairs)
         B = min(self.batch_size, len(pairs))
+        r = len(pairs) % B
+        if r:
+            # wrap the remainder into a full final batch: a truncated tail
+            # would silently exclude the same pairs from every epoch
+            pairs = np.concatenate([pairs, pairs[: B - r]])
         n_batches = len(pairs) // B
-        pairs = pairs[: n_batches * B].reshape(n_batches, B, 2)
+        pairs = pairs.reshape(n_batches, B, 2)
 
         # negative-sampling distribution: unigram^(3/4)
         counts = np.asarray([freq[w] for w in vocab], np.float64) ** 0.75
@@ -127,15 +137,24 @@ class Word2Vec:
             u_neg = W_out[negs]                    # (B, K, d)
             pos = jnp.sum(v * u_pos, axis=1)
             neg = jnp.einsum("bd,bkd->bk", v, u_neg)
+            # a drawn negative that collides with the pair's true context
+            # would push the same dot product both ways in one step; mask
+            # it out (canonical SGNS skips target == positive)
+            valid = (negs != contexts[:, None]).astype(neg.dtype)
             return -(
                 jnp.mean(jax.nn.log_sigmoid(pos))
-                + jnp.mean(jnp.sum(jax.nn.log_sigmoid(-neg), axis=1))
+                + jnp.mean(
+                    jnp.sum(jax.nn.log_sigmoid(-neg) * valid, axis=1)
+                )
             )
 
         grad_fn = jax.value_and_grad(loss_fn)
 
+        # pairs ride as a jit ARGUMENT: a captured closure would bake the
+        # whole dataset into the executable as a constant (same note as
+        # clustering._pic_iterate)
         @jax.jit
-        def epoch(params, key):
+        def epoch(params, key, batches):
             def step(carry, batch):
                 params, key = carry
                 key, sub = jax.random.split(key)
@@ -149,13 +168,12 @@ class Word2Vec:
                 )
                 return (params, key), loss
 
-            (params, key), losses = jax.lax.scan(
-                step, (params, key), jnp.asarray(pairs)
-            )
+            (params, key), losses = jax.lax.scan(step, (params, key), batches)
             return params, key, jnp.mean(losses)
 
         params = (W_in0, W_out0)
         key = jax.random.PRNGKey(self.seed)
+        batches = jnp.asarray(pairs)
         for _ in range(self.epochs):
-            params, key, _loss = epoch(params, key)
+            params, key, _loss = epoch(params, key, batches)
         return Word2VecModel(vocab, np.asarray(params[0]))

