@@ -12,8 +12,10 @@ from asyncframework_tpu.data.synthetic import (
 from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.data.sparse import SparseShardedDataset, densify
 from asyncframework_tpu.data.dataset import DistributedDataset
+from asyncframework_tpu.data import random as random_datasets
 
 __all__ = [
+    "random_datasets",
     "load_libsvm",
     "load_libsvm_sparse",
     "parse_libsvm_lines",

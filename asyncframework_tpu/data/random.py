@@ -1,0 +1,99 @@
+"""Random dataset generators (RandomRDDs parity).
+
+Parity: ``mllib/src/main/scala/org/apache/spark/mllib/random/
+RandomRDDs.scala`` -- uniform/normal/poisson/exponential/gamma/log-normal
+scalar and vector generators partitioned across the cluster.
+
+Design: one ``jax.random`` draw per generator (a single counter-based PRNG
+key replaces the reference's RDD of per-partition seeds -- same
+independence guarantee, no seed bookkeeping), then the host values are
+partitioned into a :class:`DistributedDataset` so the full dataset op
+surface (map/filter/reduce/pair ops) applies.  The engine-dataset layer is
+host-resident by design (see ``data/dataset.py``); device-resident sharded
+generation lives in ``ShardedDataset.generate_on_device``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncframework_tpu.data.dataset import DistributedDataset
+
+
+def _draw(sampler, scheduler, n: int, d: Optional[int], seed: int,
+          num_partitions: Optional[int]):
+    key = jax.random.PRNGKey(seed)
+    shape = (n,) if d is None else (n, d)
+    values = np.asarray(sampler(key, shape))
+    data = [float(v) for v in values] if d is None else list(values)
+    return DistributedDataset.from_list(
+        scheduler, data, num_partitions=num_partitions
+    )
+
+
+def uniform_dataset(scheduler, n, num_partitions=None, seed=0):
+    """U[0, 1) scalars (``RandomRDDs.uniformRDD``)."""
+    return _draw(
+        lambda k, s: jax.random.uniform(k, s, jnp.float32),
+        scheduler, n, None, seed, num_partitions,
+    )
+
+
+def normal_dataset(scheduler, n, num_partitions=None, seed=0):
+    """Standard normal scalars (``RandomRDDs.normalRDD``)."""
+    return _draw(
+        lambda k, s: jax.random.normal(k, s, jnp.float32),
+        scheduler, n, None, seed, num_partitions,
+    )
+
+
+def poisson_dataset(scheduler, n, mean, num_partitions=None, seed=0):
+    """Poisson(mean) scalars (``RandomRDDs.poissonRDD``)."""
+    return _draw(
+        lambda k, s: jax.random.poisson(k, mean, s).astype(jnp.float32),
+        scheduler, n, None, seed, num_partitions,
+    )
+
+
+def exponential_dataset(scheduler, n, mean, num_partitions=None, seed=0):
+    """Exponential(mean) scalars (``RandomRDDs.exponentialRDD``)."""
+    return _draw(
+        lambda k, s: jax.random.exponential(k, s) * mean,
+        scheduler, n, None, seed, num_partitions,
+    )
+
+
+def gamma_dataset(scheduler, n, shape, scale, num_partitions=None, seed=0):
+    """Gamma(shape, scale) scalars (``RandomRDDs.gammaRDD``)."""
+    return _draw(
+        lambda k, s: jax.random.gamma(k, shape, s) * scale,
+        scheduler, n, None, seed, num_partitions,
+    )
+
+
+def log_normal_dataset(scheduler, n, mean, std, num_partitions=None, seed=0):
+    """Log-normal scalars (``RandomRDDs.logNormalRDD``)."""
+    return _draw(
+        lambda k, s: jnp.exp(mean + std * jax.random.normal(k, s)),
+        scheduler, n, None, seed, num_partitions,
+    )
+
+
+def uniform_vector_dataset(scheduler, n, d, num_partitions=None, seed=0):
+    """U[0, 1) row vectors (``RandomRDDs.uniformVectorRDD``)."""
+    return _draw(
+        lambda k, s: jax.random.uniform(k, s, jnp.float32),
+        scheduler, n, d, seed, num_partitions,
+    )
+
+
+def normal_vector_dataset(scheduler, n, d, num_partitions=None, seed=0):
+    """Standard normal row vectors (``RandomRDDs.normalVectorRDD``)."""
+    return _draw(
+        lambda k, s: jax.random.normal(k, s, jnp.float32),
+        scheduler, n, d, seed, num_partitions,
+    )

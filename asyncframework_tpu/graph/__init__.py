@@ -1,16 +1,20 @@
 from asyncframework_tpu.graph.graph import Graph
 from asyncframework_tpu.graph.pregel import pregel
 from asyncframework_tpu.graph.algorithms import (
+    SVDPlusPlusModel,
     connected_components,
     label_propagation,
     pagerank,
     partition_edges,
     shortest_paths,
+    strongly_connected_components,
+    svd_plus_plus,
     triangle_count,
 )
 
 __all__ = [
     "Graph", "pregel", "pagerank", "connected_components",
     "triangle_count", "label_propagation", "shortest_paths",
-    "partition_edges",
+    "partition_edges", "strongly_connected_components",
+    "svd_plus_plus", "SVDPlusPlusModel",
 ]

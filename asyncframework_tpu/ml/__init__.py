@@ -32,13 +32,18 @@ from asyncframework_tpu.ml.models import (
     SoftmaxRegressionModel,
 )
 from asyncframework_tpu.ml.clustering import (
+    BisectingKMeans,
     KMeans,
     KMeansModel,
     PowerIterationClustering,
+    StreamingKMeans,
 )
 from asyncframework_tpu.ml.recommendation import ALS, ALSModel
 from asyncframework_tpu.ml.feature import (
     IDF,
+    ChiSqSelector,
+    ChiSqSelectorModel,
+    ElementwiseProduct,
     HashingTF,
     IDFModel,
     MinMaxScaler,
@@ -48,6 +53,7 @@ from asyncframework_tpu.ml.feature import (
 from asyncframework_tpu.ml.stat import (
     ChiSqTestResult,
     ColStats,
+    KernelDensity,
     KSTestResult,
     chi_sq_test,
     chi_sq_test_matrix,
@@ -58,9 +64,17 @@ from asyncframework_tpu.ml.stat import (
 
 from asyncframework_tpu.ml.bayes import NaiveBayes, NaiveBayesModel
 from asyncframework_tpu.ml.decomposition import PCA, PCAModel, svd
+from asyncframework_tpu.ml.linalg_distributed import (
+    BlockMatrix,
+    CoordinateMatrix,
+    IndexedRowMatrix,
+    RowMatrix,
+)
 from asyncframework_tpu.ml.evaluation import (
     BinaryClassificationMetrics,
     MulticlassMetrics,
+    MultilabelMetrics,
+    RankingMetrics,
     RegressionMetrics,
 )
 from asyncframework_tpu.ml.tree import DecisionTree, DecisionTreeModel
@@ -70,7 +84,14 @@ from asyncframework_tpu.ml.boosting import (
 )
 from asyncframework_tpu.ml.forest import RandomForest, RandomForestModel
 from asyncframework_tpu.ml.mixture import GaussianMixture, GaussianMixtureModel
-from asyncframework_tpu.ml.fpm import FPGrowth, FPGrowthModel, Rule
+from asyncframework_tpu.ml.fpm import (
+    AssociationRules,
+    FPGrowth,
+    FPGrowthModel,
+    FreqSequence,
+    PrefixSpan,
+    Rule,
+)
 from asyncframework_tpu.ml.isotonic import IsotonicRegression, IsotonicRegressionModel
 from asyncframework_tpu.ml.lda import LDA, LDAModel
 from asyncframework_tpu.ml.pipeline import (
@@ -162,4 +183,19 @@ __all__ = [
     "ChiSqTestResult",
     "chi_sq_test",
     "chi_sq_test_matrix",
+    "RowMatrix",
+    "IndexedRowMatrix",
+    "CoordinateMatrix",
+    "BlockMatrix",
+    "BisectingKMeans",
+    "StreamingKMeans",
+    "PrefixSpan",
+    "FreqSequence",
+    "AssociationRules",
+    "KernelDensity",
+    "ChiSqSelector",
+    "ChiSqSelectorModel",
+    "ElementwiseProduct",
+    "RankingMetrics",
+    "MultilabelMetrics",
 ]

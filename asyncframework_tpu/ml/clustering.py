@@ -122,6 +122,202 @@ class KMeans:
         return KMeansModel(np.asarray(centers), float(cost_arr), it)
 
 
+class BisectingKMeans:
+    """Divisive hierarchical k-means.
+
+    Parity: ``mllib/src/main/scala/org/apache/spark/mllib/clustering/
+    BisectingKMeans.scala`` -- start from one root cluster, repeatedly
+    2-means-split the largest divisible cluster until ``k`` leaves exist
+    (the reference splits level-by-level; largest-first yields the same
+    leaf set for the common balanced case and a strictly better cost
+    greedy otherwise).  ``min_divisible_cluster_size`` gates which
+    clusters may split, as in the reference.
+
+    TPU mapping: every split is a 2-means Lloyd loop on the member rows --
+    the same one-hot-matmul assignment kernel as :class:`KMeans`, batched
+    on device; the hierarchy bookkeeping (tiny) stays on host.
+    """
+
+    def __init__(
+        self,
+        k: int = 4,
+        max_iterations: int = 20,
+        min_divisible_cluster_size: int = 1,
+        seed: int = 42,
+    ):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = k
+        self.max_iterations = max_iterations
+        self.min_divisible = max(int(min_divisible_cluster_size), 1)
+        self.seed = seed
+
+    def fit(self, X: np.ndarray) -> KMeansModel:
+        X = np.asarray(X, np.float32)
+        n = X.shape[0]
+        # leaves: list of (member row indices, center, sse cost)
+        root_center = X.mean(axis=0)
+        root_cost = float(((X - root_center) ** 2).sum())
+        # leaf: (member row indices, center, sse cost, divisible flag)
+        leaves = [(np.arange(n), root_center, root_cost, True)]
+        it = 0
+        while len(leaves) < self.k:
+            # split the largest divisible leaf (>= 2 rows, >= min size)
+            order = sorted(
+                range(len(leaves)),
+                key=lambda i: len(leaves[i][0]),
+                reverse=True,
+            )
+            target = next(
+                (
+                    i for i in order
+                    if leaves[i][3]
+                    and len(leaves[i][0]) >= max(2, self.min_divisible)
+                ),
+                None,
+            )
+            if target is None:
+                break  # nothing divisible; fewer than k leaves (reference
+                # behavior: the tree just stops growing)
+            idx, _, _, _ = leaves.pop(target)
+            sub = X[idx]
+            km = KMeans(
+                2,
+                max_iterations=self.max_iterations,
+                seed=self.seed + it,
+            ).fit(sub)
+            assign = km.predict(sub)
+            it += 1
+            if len(np.unique(assign)) < 2:
+                # degenerate split (duplicate rows): keep the leaf, mark it
+                # indivisible, and move on to the next candidate
+                leaves.append((idx, km.centers[0], km.cost, False))
+                continue
+            for c in (0, 1):
+                members = idx[assign == c]
+                center = km.centers[c]
+                cost = float(((X[members] - center) ** 2).sum())
+                leaves.append((members, center, cost, True))
+        centers = np.stack([c for (_i, c, _s, _d) in leaves]).astype(
+            np.float32
+        )
+        return KMeansModel(
+            centers, cost=float(sum(s for (_i, _c, s, _d) in leaves)),
+            iterations=it,
+        )
+
+
+class StreamingKMeans:
+    """Online k-means with exponential forgetfulness.
+
+    Parity: ``mllib/src/main/stream/.../clustering/StreamingKMeans.scala``
+    update rule -- per batch:
+
+        c' = (c * n * a + sum_batch) / (n * a + m),   n' = n * a + m
+
+    with decay ``a`` applied per batch (``time_unit="batches"``) or as
+    ``a^m`` (``time_unit="points"``); ``set_half_life`` derives ``a`` from
+    a half-life.  Dying clusters (the reference's zero-weight check) are
+    re-seeded by splitting the heaviest cluster.
+
+    Each batch's (per-center sum, count) is one one-hot matmul on device.
+    """
+
+    def __init__(
+        self,
+        k: int,
+        decay_factor: float = 1.0,
+        time_unit: str = "batches",
+        seed: int = 42,
+    ):
+        if time_unit not in ("batches", "points"):
+            raise ValueError("time_unit must be 'batches' or 'points'")
+        if not 0.0 < decay_factor <= 1.0:
+            raise ValueError("decay_factor must be in (0, 1]")
+        self.k = k
+        self.decay = decay_factor
+        self.time_unit = time_unit
+        self.seed = seed
+        self.centers: Optional[np.ndarray] = None
+        self.weights: Optional[np.ndarray] = None
+
+    def set_half_life(self, half_life: float, time_unit: str) -> "StreamingKMeans":
+        if time_unit not in ("batches", "points"):
+            raise ValueError("time_unit must be 'batches' or 'points'")
+        self.decay = float(np.exp(np.log(0.5) / half_life))
+        self.time_unit = time_unit
+        return self
+
+    def set_initial_centers(self, centers, weights=None) -> "StreamingKMeans":
+        self.centers = np.asarray(centers, np.float32)
+        self.weights = (
+            np.asarray(weights, np.float64)
+            if weights is not None
+            else np.zeros(self.centers.shape[0], np.float64)
+        )
+        return self
+
+    def set_random_centers(self, d: int, weight: float = 0.0) -> "StreamingKMeans":
+        rs = np.random.default_rng(self.seed)
+        self.centers = rs.normal(size=(self.k, d)).astype(np.float32)
+        self.weights = np.full(self.k, weight, np.float64)
+        return self
+
+    def update(self, batch) -> "StreamingKMeans":
+        batch = np.asarray(batch, np.float32)
+        if batch.ndim != 2 or batch.shape[0] == 0:
+            return self
+        if self.centers is None:
+            self.set_random_centers(batch.shape[1])
+        sums, counts = _assign_sums(
+            jnp.asarray(batch), jnp.asarray(self.centers)
+        )
+        sums = np.asarray(sums, np.float64)
+        counts = np.asarray(counts, np.float64)
+        m = batch.shape[0]
+        a = self.decay if self.time_unit == "batches" else self.decay ** m
+        discounted = self.weights * a
+        new_w = discounted + counts
+        safe = np.maximum(new_w, 1e-12)
+        self.centers = (
+            (self.centers * discounted[:, None] + sums) / safe[:, None]
+        ).astype(np.float32)
+        self.weights = new_w
+        # re-seed dying clusters: split the heaviest (reference behavior)
+        dead = self.weights < 1e-8
+        if dead.any() and (~dead).any():
+            heavy = int(np.argmax(self.weights))
+            for j in np.nonzero(dead)[0]:
+                jitter = 1e-4 * np.abs(self.centers[heavy]).max()
+                self.centers[j] = self.centers[heavy] + jitter
+                self.centers[heavy] = self.centers[heavy] - jitter
+                self.weights[j] = self.weights[heavy] / 2
+                self.weights[heavy] /= 2
+        return self
+
+    def latest_model(self) -> KMeansModel:
+        if self.centers is None:
+            raise ValueError("no data seen yet")
+        return KMeansModel(self.centers.copy(), cost=float("nan"),
+                           iterations=0)
+
+    def predict(self, X) -> np.ndarray:
+        return self.latest_model().predict(np.asarray(X, np.float32))
+
+
+@jax.jit
+def _assign_sums(batch, centers):
+    """Per-center (sum of assigned rows, count): one-hot matmul kernel."""
+    d2 = (
+        (batch * batch).sum(1)[:, None]
+        - 2.0 * batch @ centers.T
+        + (centers * centers).sum(1)[None, :]
+    )
+    onehot = jax.nn.one_hot(jnp.argmin(d2, axis=1), centers.shape[0],
+                            dtype=batch.dtype)
+    return onehot.T @ batch, onehot.sum(0)
+
+
 class PowerIterationClustering:
     """Clustering by power iteration on the normalized affinity matrix.
 

@@ -162,3 +162,147 @@ class MulticlassMetrics:
     def weighted_f1(self) -> float:
         weights = self.confusion.sum(axis=1) / max(self._n, 1)
         return float(sum(w * self.f1(i) for i, w in enumerate(weights)))
+
+
+class RankingMetrics:
+    """Ranking quality over (predicted ranking, ground-truth set) pairs.
+
+    Parity: ``mllib/src/main/scala/org/apache/spark/mllib/evaluation/
+    RankingMetrics.scala`` -- precisionAt(k), meanAveragePrecision, and
+    ndcgAt(k) with the reference's exact conventions: predictions beyond
+    position k are ignored, queries with empty ground truth contribute 0
+    (and log a warning there; silently here), relevance is binary, and
+    the IDCG normalizer uses min(|truth|, k) ideal hits.
+
+    Host-side: rankings are short, ragged integer lists; there is no dense
+    kernel to win on device.
+    """
+
+    def __init__(self, prediction_and_labels):
+        self._pairs = [
+            (list(pred), set(truth)) for pred, truth in prediction_and_labels
+        ]
+        if not self._pairs:
+            raise ValueError("no (prediction, labels) pairs")
+
+    def precision_at(self, k: int) -> float:
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        vals = []
+        for pred, truth in self._pairs:
+            top = pred[:k]
+            hits = sum(1 for p in top if p in truth)
+            # reference divides by k even when fewer predictions exist
+            vals.append(hits / k)
+        return float(np.mean(vals))
+
+    def mean_average_precision(self) -> float:
+        vals = []
+        for pred, truth in self._pairs:
+            if not truth:
+                vals.append(0.0)
+                continue
+            hits = 0
+            score = 0.0
+            # duplicate predictions each count (reference semantics:
+            # RankingMetrics.scala scans positions, not distinct items)
+            for i, p in enumerate(pred):
+                if p in truth:
+                    hits += 1
+                    score += hits / (i + 1.0)
+            vals.append(score / len(truth))
+        return float(np.mean(vals))
+
+    def ndcg_at(self, k: int) -> float:
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        vals = []
+        for pred, truth in self._pairs:
+            if not truth:
+                vals.append(0.0)
+                continue
+            n = min(k, len(pred))
+            dcg = sum(
+                1.0 / np.log2(i + 2.0)
+                for i in range(n) if pred[i] in truth
+            )
+            ideal = sum(
+                1.0 / np.log2(i + 2.0) for i in range(min(len(truth), k))
+            )
+            vals.append(dcg / ideal)
+        return float(np.mean(vals))
+
+
+class MultilabelMetrics:
+    """Multi-label classification metrics over (predicted set, true set)
+    pairs.
+
+    Parity: ``mllib/.../evaluation/MultilabelMetrics.scala`` -- document-
+    averaged accuracy/precision/recall/F1, subset accuracy, Hamming loss,
+    and micro-averaged precision/recall/F1 over the label universe.
+    """
+
+    def __init__(self, prediction_and_labels):
+        self._pairs = [
+            (set(pred), set(truth)) for pred, truth in prediction_and_labels
+        ]
+        if not self._pairs:
+            raise ValueError("no (prediction, labels) pairs")
+        # label universe from GROUND TRUTH only (MultilabelMetrics.scala
+        # derives numLabels from the label sets; counting predicted-only
+        # labels would deflate hamming_loss)
+        self._labels = sorted({x for _p, t in self._pairs for x in t})
+
+    @property
+    def accuracy(self) -> float:
+        return float(np.mean([
+            len(p & t) / max(len(p | t), 1) for p, t in self._pairs
+        ]))
+
+    @property
+    def precision(self) -> float:
+        return float(np.mean([
+            len(p & t) / len(p) if p else 0.0 for p, t in self._pairs
+        ]))
+
+    @property
+    def recall(self) -> float:
+        return float(np.mean([
+            len(p & t) / len(t) if t else 0.0 for p, t in self._pairs
+        ]))
+
+    @property
+    def f1_measure(self) -> float:
+        return float(np.mean([
+            2.0 * len(p & t) / (len(p) + len(t))
+            if (p or t) else 0.0
+            for p, t in self._pairs
+        ]))
+
+    @property
+    def subset_accuracy(self) -> float:
+        return float(np.mean([p == t for p, t in self._pairs]))
+
+    @property
+    def hamming_loss(self) -> float:
+        n_labels = max(len(self._labels), 1)
+        return float(np.mean([
+            len(p ^ t) / n_labels for p, t in self._pairs
+        ]))
+
+    @property
+    def micro_precision(self) -> float:
+        tp = sum(len(p & t) for p, t in self._pairs)
+        fp = sum(len(p - t) for p, t in self._pairs)
+        return tp / max(tp + fp, 1)
+
+    @property
+    def micro_recall(self) -> float:
+        tp = sum(len(p & t) for p, t in self._pairs)
+        fn = sum(len(t - p) for p, t in self._pairs)
+        return tp / max(tp + fn, 1)
+
+    @property
+    def micro_f1_measure(self) -> float:
+        p, r = self.micro_precision, self.micro_recall
+        return 2 * p * r / max(p + r, 1e-12)

@@ -162,3 +162,67 @@ class IDF:
         if self.min_doc_freq > 0:
             idf = jnp.where(df >= self.min_doc_freq, idf, 0.0)
         return IDFModel(idf)
+
+
+class ElementwiseProduct:
+    """Hadamard scaling by a fixed weight vector.
+
+    Parity: ``mllib/src/main/scala/org/apache/spark/mllib/feature/
+    ElementwiseProduct.scala`` -- one broadcasted multiply on device.
+    """
+
+    def __init__(self, scaling_vector):
+        self.scaling_vector = jnp.asarray(
+            np.asarray(scaling_vector), jnp.float32
+        )
+
+    def transform(self, X) -> jnp.ndarray:
+        X = jnp.asarray(X, jnp.float32)
+        w = self.scaling_vector
+        return X * (w[None, :] if X.ndim == 2 else w)
+
+
+class ChiSqSelectorModel:
+    def __init__(self, selected: np.ndarray):
+        self.selected = np.asarray(selected, np.int64)  # sorted feature ids
+
+    def transform(self, X) -> jnp.ndarray:
+        X = jnp.asarray(X, jnp.float32)
+        idx = jnp.asarray(self.selected)
+        return X[:, idx] if X.ndim == 2 else X[idx]
+
+
+class ChiSqSelector:
+    """Chi-squared feature selection for categorical features.
+
+    Parity: ``mllib/src/main/scala/org/apache/spark/mllib/feature/
+    ChiSqSelector.scala`` -- ranks features by the chi-squared test of
+    independence against the label and keeps ``num_top_features`` (the
+    reference's default selector type); selected indices are sorted so
+    transformed columns keep their relative order.
+
+    Contingency tables are tiny (distinct feature values x labels) and are
+    built host-side; the chi-squared statistic itself reuses
+    ``chi_sq_test_matrix``.
+    """
+
+    def __init__(self, num_top_features: int = 50):
+        if num_top_features < 1:
+            raise ValueError("num_top_features must be >= 1")
+        self.num_top_features = num_top_features
+
+    def fit(self, X, y) -> ChiSqSelectorModel:
+        from asyncframework_tpu.ml.stat import chi_sq_test_matrix
+
+        X = np.asarray(X)
+        y = np.asarray(y)
+        labels, li = np.unique(y, return_inverse=True)
+        stats = np.empty(X.shape[1])
+        for j in range(X.shape[1]):
+            vals, vi = np.unique(X[:, j], return_inverse=True)
+            cont = np.zeros((len(vals), len(labels)), np.float64)
+            np.add.at(cont, (vi, li), 1.0)
+            stats[j] = chi_sq_test_matrix(cont).statistic
+        k = min(self.num_top_features, stats.shape[0])
+        top = np.argsort(-stats, kind="stable")[:k]
+        return ChiSqSelectorModel(np.sort(top))

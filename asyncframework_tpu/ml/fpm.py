@@ -133,6 +133,143 @@ class FPGrowthModel:
         )
 
 
+class AssociationRules:
+    """Standalone rule generator (``AssociationRules.scala`` public API):
+    takes pre-mined (itemset, count) pairs, emits single-consequent rules.
+    ``FPGrowthModel.association_rules`` delegates the same logic."""
+
+    def __init__(self, min_confidence: float = 0.8):
+        if not 0.0 <= min_confidence <= 1.0:
+            raise ValueError("min_confidence must be in [0, 1]")
+        self.min_confidence = min_confidence
+
+    def run(
+        self,
+        freq_itemsets: Iterable[Tuple[Iterable, int]],
+        num_transactions: int,
+    ) -> List[Rule]:
+        if num_transactions < 1:
+            # support fractions are counts / num_transactions; guessing the
+            # denominator would silently misreport every rule's support
+            raise ValueError("num_transactions must be >= 1")
+        table = {frozenset(items): int(c) for items, c in freq_itemsets}
+        return FPGrowthModel(table, num_transactions).association_rules(
+            self.min_confidence
+        )
+
+
+@dataclass(frozen=True)
+class FreqSequence:
+    """A frequent sequential pattern: a tuple of itemsets + its support."""
+
+    sequence: Tuple[FrozenSet, ...]
+    freq: int
+
+
+class PrefixSpan:
+    """Sequential pattern mining by prefix-projected growth.
+
+    Parity: ``mllib/src/main/scala/org/apache/spark/mllib/fpm/
+    PrefixSpan.scala`` -- patterns are sequences of itemsets, grown one
+    item at a time either by EXTENDING the last itemset (same-element
+    growth) or APPENDING a new itemset, counting support in the projected
+    database (Pei et al.'s PrefixSpan).  ``min_support`` is a fraction of
+    sequences; ``max_pattern_length`` bounds the total item count.
+
+    Host-side for the same reason as FP-Growth (symbolic recursion over
+    projections; the reference distributes only to shard candidate
+    prefixes).
+    """
+
+    def __init__(
+        self,
+        min_support: float = 0.1,
+        max_pattern_length: int = 10,
+    ):
+        if not 0.0 < min_support <= 1.0:
+            raise ValueError("min_support must be in (0, 1]")
+        if max_pattern_length < 1:
+            raise ValueError("max_pattern_length must be >= 1")
+        self.min_support = min_support
+        self.max_len = max_pattern_length
+
+    def run(self, sequences: Sequence[Sequence[Iterable]]) -> List[FreqSequence]:
+        import math
+
+        db = [[frozenset(ev) for ev in seq if ev] for seq in sequences]
+        n = len(db)
+        if n == 0:
+            raise ValueError("no sequences")
+        min_count = max(1, math.ceil(self.min_support * n - 1e-9))
+        out: List[FreqSequence] = []
+        # projections: list of (seq_idx, event_idx, within-event frontier)
+        start = [(i, 0, frozenset()) for i in range(len(db))]
+        self._grow((), start, db, min_count, 0, out)
+        return sorted(
+            out,
+            key=lambda f: (-f.freq, len(f.sequence),
+                           [sorted(map(repr, s)) for s in f.sequence]),
+        )
+
+    def _grow(self, prefix, proj, db, min_count, length, out):
+        if length >= self.max_len:
+            return
+        # candidate growth items: 'append' starts a new itemset; 'extend'
+        # adds to the prefix's last itemset (only items > frontier items
+        # are considered, using repr order for a canonical form)
+        append_support: Dict[object, set] = defaultdict(set)
+        extend_support: Dict[object, set] = defaultdict(set)
+        for (si, ei, frontier) in proj:
+            seq = db[si]
+            if frontier:
+                # same-element extension: the current event must contain
+                # the frontier and a strictly "later" item
+                for ev_i in range(ei, len(seq)):
+                    ev = seq[ev_i]
+                    if frontier <= ev:
+                        for item in ev - frontier:
+                            if repr(item) > max(map(repr, frontier)):
+                                extend_support[item].add(si)
+            for ev_i in range(ei + (1 if frontier else 0), len(seq)):
+                for item in seq[ev_i]:
+                    append_support[item].add(si)
+        for item, seqs in sorted(
+            extend_support.items(), key=lambda kv: repr(kv[0])
+        ):
+            if len(seqs) < min_count:
+                continue
+            last = prefix[-1] | {item}
+            pattern = prefix[:-1] + (last,)
+            out.append(FreqSequence(pattern, len(seqs)))
+            new_proj = []
+            for (si, ei, frontier) in proj:
+                if si not in seqs or not frontier:
+                    continue
+                seq = db[si]
+                for ev_i in range(ei, len(seq)):
+                    if last <= seq[ev_i]:
+                        new_proj.append((si, ev_i, last))
+                        break
+            self._grow(pattern, new_proj, db, min_count, length + 1, out)
+        for item, seqs in sorted(
+            append_support.items(), key=lambda kv: repr(kv[0])
+        ):
+            if len(seqs) < min_count:
+                continue
+            pattern = prefix + (frozenset({item}),)
+            out.append(FreqSequence(pattern, len(seqs)))
+            new_proj = []
+            for (si, ei, frontier) in proj:
+                if si not in seqs:
+                    continue
+                seq = db[si]
+                for ev_i in range(ei + (1 if frontier else 0), len(seq)):
+                    if item in seq[ev_i]:
+                        new_proj.append((si, ev_i, frozenset({item})))
+                        break
+            self._grow(pattern, new_proj, db, min_count, length + 1, out)
+
+
 class FPGrowth:
     """``new FPGrowth().setMinSupport(s).run(transactions)`` analog."""
 

@@ -234,3 +234,40 @@ def ks_test(sample, cdf="norm", *params) -> KSTestResult:
     for j in range(1, 101):
         s += 2.0 * (-1.0) ** (j - 1) * np.exp(-2.0 * j * j * t * t)
     return KSTestResult(statistic=d, p_value=float(min(max(s, 0.0), 1.0)))
+
+
+class KernelDensity:
+    """Gaussian kernel density estimation.
+
+    Parity: ``mllib/src/main/scala/org/apache/spark/mllib/stat/
+    KernelDensity.scala`` -- density at each query point is the mean of
+    normal kernels centered at the samples.  The reference aggregates the
+    (n_samples x n_points) kernel grid with a fold over the RDD; here the
+    grid is ONE broadcasted device op (samples on rows, query points on
+    columns) reduced along the sample axis.
+    """
+
+    def __init__(self, bandwidth: float = 1.0):
+        if bandwidth <= 0:
+            raise ValueError("bandwidth must be > 0")
+        self.bandwidth = float(bandwidth)
+        self._samples = None
+
+    def set_sample(self, samples) -> "KernelDensity":
+        self._samples = jnp.asarray(np.asarray(samples), jnp.float32).ravel()
+        return self
+
+    def estimate(self, points) -> np.ndarray:
+        if self._samples is None:
+            raise ValueError("call set_sample first")
+        pts = jnp.asarray(np.asarray(points), jnp.float32).ravel()
+        return np.asarray(
+            _kde_estimate(self._samples, pts, self.bandwidth)
+        )
+
+
+@jax.jit
+def _kde_estimate(samples, points, h):
+    z = (points[None, :] - samples[:, None]) / h
+    k = jnp.exp(-0.5 * z * z) / (h * jnp.sqrt(2.0 * jnp.pi))
+    return k.mean(axis=0)

@@ -109,15 +109,18 @@ class Word2Vec:
             raise ValueError("no (center, context) pairs within the window")
 
         rs = np.random.default_rng(self.seed)
-        rs.shuffle(pairs)
         B = min(self.batch_size, len(pairs))
-        r = len(pairs) % B
-        if r:
-            # wrap the remainder into a full final batch: a truncated tail
-            # would silently exclude the same pairs from every epoch
-            pairs = np.concatenate([pairs, pairs[: B - r]])
-        n_batches = len(pairs) // B
-        pairs = pairs.reshape(n_batches, B, 2)
+
+        def epoch_batches() -> np.ndarray:
+            """Fresh shuffle + remainder wrap per epoch: a fixed wrap would
+            give the same pairs double gradient weight in every epoch, the
+            mirror image of the tail-exclusion bias it replaces."""
+            perm = rs.permutation(len(pairs))
+            p = pairs[perm]
+            r = len(p) % B
+            if r:
+                p = np.concatenate([p, p[: B - r]])
+            return p.reshape(len(p) // B, B, 2)
 
         # negative-sampling distribution: unigram^(3/4)
         counts = np.asarray([freq[w] for w in vocab], np.float64) ** 0.75
@@ -173,7 +176,8 @@ class Word2Vec:
 
         params = (W_in0, W_out0)
         key = jax.random.PRNGKey(self.seed)
-        batches = jnp.asarray(pairs)
         for _ in range(self.epochs):
-            params, key, _loss = epoch(params, key, batches)
+            params, key, _loss = epoch(
+                params, key, jnp.asarray(epoch_batches())
+            )
         return Word2VecModel(vocab, np.asarray(params[0]))

@@ -383,7 +383,10 @@ class ASAGA:
         sched.set_mode(ASYNC)
         self.scheduler = sched  # exposed for fault-injection tests/tools
         delay_model = DelayModel(cfg.coeff, nw, cfg.seed)
-        calibrator = DelayCalibrator(100)
+        # rounds, not accepted gradients; explicit calibration_iters overrides
+        calibrator = DelayCalibrator(
+            cfg.calibration_iters if cfg.calibration_iters is not None else 100
+        )
         waiting = WaitingTimeTable()
         inst = RunInstruments(cfg, nw)
         inst.register_queue_depth(ctx.size)

@@ -55,6 +55,13 @@ from asyncframework_tpu.solvers.instrumentation import (
 )
 
 
+# minimum drained-batch size for the stacked one-dispatch apply: below
+# this, the stack copy costs more than the dispatches it saves.  Shared by
+# the runtime drain and the warm-up gate so the pre-compile always covers
+# the path the updater actually takes.
+BATCH_DRAIN_MIN = 3
+
+
 class ASGD:
     def __init__(
         self,
@@ -243,9 +250,8 @@ class ASGD:
                             )
                         # else: beyond the iteration budget -- ignored, like
                         # the old per-result loop's break-at-limit
-                    if len(accepted_g) >= 3:
-                        # stack+apply = 2 dispatches replacing m; below 3
-                        # the stack copy costs more than it saves.  G is
+                    if len(accepted_g) >= BATCH_DRAIN_MIN:
+                        # stack+apply = 2 dispatches replacing m.  G is
                         # padded to the fixed (max_drain, d) shape with a
                         # zero mask tail so apply_batch compiles ONCE, not
                         # once per drained batch size.
@@ -416,7 +422,12 @@ class ASGD:
         sched.set_mode(ASYNC)  # non-blocking submit + driver-side drain
         self.scheduler = sched  # exposed for fault-injection tests/tools
         delay_model = DelayModel(cfg.coeff, nw, cfg.seed)
-        calibrator = DelayCalibrator(100)  # sync calibrates over first 100 rounds
+        # sync counts rounds, not accepted gradients: the reference's
+        # k < 100*numPart window covers the first 100 full-drain rounds.
+        # An explicit calibration_iters overrides (in rounds).
+        calibrator = DelayCalibrator(
+            cfg.calibration_iters if cfg.calibration_iters is not None else 100
+        )
         waiting = WaitingTimeTable()
         inst = RunInstruments(cfg, nw)
         inst.register_queue_depth(ctx.size)
@@ -590,7 +601,7 @@ class ASGD:
             wd, kd = self._sync_apply(wd, acc, kd)
         else:
             wd, kd = self._apply(wd, g, kd)
-            if apply_batch is not None and max_drain >= 3:
+            if apply_batch is not None and max_drain >= BATCH_DRAIN_MIN:
                 G = jax.device_put(
                     jnp.zeros((max_drain, d), jnp.float32), drv
                 )

@@ -1,0 +1,290 @@
+"""Round-2 library breadth: bisecting/streaming k-means, PrefixSpan,
+association rules, kernel density, chi-sq selection, ranking/multilabel
+metrics, random datasets, SCC, SVD++.
+"""
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.data import random_datasets
+from asyncframework_tpu.engine.scheduler import JobScheduler
+from asyncframework_tpu.ml import (
+    AssociationRules,
+    BisectingKMeans,
+    ChiSqSelector,
+    ElementwiseProduct,
+    FPGrowth,
+    KernelDensity,
+    MultilabelMetrics,
+    PrefixSpan,
+    RankingMetrics,
+    StreamingKMeans,
+)
+
+
+@pytest.fixture()
+def blobs():
+    rs = np.random.default_rng(0)
+    centers = np.array([[-5, -5], [5, 5], [5, -5], [-5, 5]], np.float32)
+    X = np.concatenate([
+        c + 0.3 * rs.normal(size=(50, 2)).astype(np.float32)
+        for c in centers
+    ])
+    return X, centers
+
+
+class TestBisectingKMeans:
+    def test_recovers_blobs(self, blobs):
+        X, centers = blobs
+        model = BisectingKMeans(k=4, seed=1).fit(X)
+        assert model.k == 4
+        # every true center has a recovered center nearby
+        d = np.linalg.norm(
+            model.centers[:, None, :] - centers[None, :, :], axis=2
+        )
+        assert d.min(axis=0).max() < 1.0
+        # predictions separate the blobs perfectly
+        labels = model.predict(X)
+        for b in range(4):
+            blk = labels[50 * b: 50 * (b + 1)]
+            assert len(np.unique(blk)) == 1
+
+    def test_fewer_than_k_when_indivisible(self):
+        X = np.zeros((3, 2), np.float32)  # all identical: nothing to split
+        model = BisectingKMeans(k=4).fit(X)
+        assert model.k <= 4
+
+    def test_min_divisible_gate(self, blobs):
+        X, _ = blobs
+        model = BisectingKMeans(k=4, min_divisible_cluster_size=1000).fit(X)
+        assert model.k == 1  # nothing large enough to split
+
+
+class TestStreamingKMeans:
+    def test_tracks_moving_centers(self):
+        rs = np.random.default_rng(1)
+        skm = StreamingKMeans(k=2, decay_factor=0.5, seed=3)
+        skm.set_initial_centers(
+            np.array([[-1.0], [1.0]], np.float32), [1.0, 1.0]
+        )
+        for _ in range(20):
+            batch = np.concatenate([
+                -4 + 0.1 * rs.normal(size=(20, 1)),
+                4 + 0.1 * rs.normal(size=(20, 1)),
+            ]).astype(np.float32)
+            skm.update(batch)
+        c = np.sort(skm.centers.ravel())
+        np.testing.assert_allclose(c, [-4.0, 4.0], atol=0.3)
+
+    def test_decay_forgets_history(self):
+        # decay=0.01/batch: after the data jumps, one batch dominates
+        skm = StreamingKMeans(k=1, decay_factor=0.01)
+        skm.set_initial_centers(np.array([[0.0]], np.float32), [1.0])
+        skm.update(np.full((50, 1), 10.0, np.float32))
+        skm.update(np.full((50, 1), -10.0, np.float32))
+        assert abs(float(skm.centers[0, 0]) + 10.0) < 0.5
+
+    def test_update_rule_exact(self):
+        # c' = (c*n*a + sum) / (n*a + m) checked by hand
+        skm = StreamingKMeans(k=1, decay_factor=0.5)
+        skm.set_initial_centers(np.array([[2.0]], np.float32), [4.0])
+        skm.update(np.array([[8.0], [10.0]], np.float32))
+        # (2*4*0.5 + 18) / (4*0.5 + 2) = 22/4 = 5.5
+        assert abs(float(skm.centers[0, 0]) - 5.5) < 1e-5
+        assert abs(float(skm.weights[0]) - 4.0) < 1e-9
+
+    def test_predict(self):
+        skm = StreamingKMeans(k=2).set_initial_centers(
+            np.array([[0.0], [10.0]], np.float32)
+        )
+        lab = skm.predict(np.array([[1.0], [9.0]], np.float32))
+        assert lab[0] != lab[1]
+
+
+class TestPrefixSpan:
+    def test_spark_docs_example(self):
+        # the reference documentation's canonical example
+        seqs = [
+            [[1, 2], [3]],
+            [[1], [3, 2], [1, 2]],
+            [[1, 2], [5]],
+            [[6]],
+        ]
+        out = PrefixSpan(min_support=0.5).run(seqs)
+        found = {
+            (tuple(sorted(s)) for s in f.sequence) and
+            tuple(tuple(sorted(s)) for s in f.sequence): f.freq
+            for f in out
+        }
+        assert found[((1,),)] == 3
+        assert found[((2,),)] == 3
+        assert found[((3,),)] == 2
+        assert found[((1, 2),)] == 3
+        assert found[((1,), (3,))] == 2
+        # infrequent items never appear
+        assert all(
+            5 not in s and 6 not in s for pat in found for s in pat
+        )
+
+    def test_max_pattern_length(self):
+        seqs = [[[1], [1], [1], [1]]] * 2
+        out = PrefixSpan(min_support=1.0, max_pattern_length=2).run(seqs)
+        assert max(sum(len(s) for s in f.sequence) for f in out) <= 2
+
+
+class TestAssociationRules:
+    def test_standalone_runner_matches_model(self):
+        txs = [["a", "b"], ["a", "b", "c"], ["a", "c"], ["a"]]
+        model = FPGrowth(min_support=0.5).run(txs)
+        direct = model.association_rules(0.6)
+        standalone = AssociationRules(0.6).run(
+            model.itemsets(), model.num_transactions
+        )
+        assert direct == standalone
+        # a -> nothing (a is in every tx but nothing implies from it at .6+)
+        antecedents = {tuple(sorted(r.antecedent)) for r in standalone}
+        assert ("b",) in antecedents  # b -> a with confidence 1.0
+        conf = {
+            (tuple(sorted(r.antecedent)), tuple(r.consequent)): r.confidence
+            for r in standalone
+        }
+        assert conf[(("b",), ("a",))] == 1.0
+
+
+class TestKernelDensity:
+    def test_matches_scipy_oracle(self):
+        rs = np.random.default_rng(5)
+        sample = rs.normal(size=400)
+        pts = np.linspace(-3, 3, 7)
+        est = KernelDensity(bandwidth=0.5).set_sample(sample).estimate(pts)
+        # direct numpy oracle
+        z = (pts[None, :] - sample[:, None]) / 0.5
+        want = (np.exp(-0.5 * z * z) / (0.5 * np.sqrt(2 * np.pi))).mean(0)
+        np.testing.assert_allclose(est, want, rtol=1e-4, atol=1e-6)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            KernelDensity(bandwidth=0.0)
+        with pytest.raises(ValueError):
+            KernelDensity().estimate([0.0])
+
+
+class TestFeatureSelection:
+    def test_chisq_selector_finds_informative(self):
+        rs = np.random.default_rng(6)
+        n = 400
+        y = rs.integers(0, 2, n)
+        X = np.zeros((n, 5))
+        X[:, 1] = y  # perfectly informative
+        X[:, 3] = y ^ (rs.random(n) < 0.1)  # mostly informative
+        X[:, 0] = rs.integers(0, 3, n)
+        X[:, 2] = rs.integers(0, 3, n)
+        X[:, 4] = rs.integers(0, 2, n)
+        model = ChiSqSelector(num_top_features=2).fit(X, y)
+        assert set(model.selected) == {1, 3}
+        out = np.asarray(model.transform(X))
+        assert out.shape == (n, 2)
+        np.testing.assert_array_equal(out[:, 0], X[:, 1])
+
+    def test_elementwise_product(self):
+        ep = ElementwiseProduct([1.0, 2.0, 3.0])
+        out = np.asarray(ep.transform([[1.0, 1.0, 1.0], [2.0, 2.0, 2.0]]))
+        np.testing.assert_allclose(out, [[1, 2, 3], [2, 4, 6]])
+        np.testing.assert_allclose(
+            np.asarray(ep.transform([1.0, 1.0, 1.0])), [1, 2, 3]
+        )
+
+
+class TestRankingMetrics:
+    def test_reference_semantics(self):
+        # adapted from the reference's RankingMetricsSuite fixture
+        pairs = [
+            ([1, 6, 2, 7, 8, 3, 9, 10, 4, 5], {1, 2, 3, 4, 5}),
+            ([4, 1, 5, 6, 2, 7, 3, 8, 9, 10], {1, 2, 3}),
+            ([1, 2, 3, 4, 5], set()),
+        ]
+        m = RankingMetrics(pairs)
+        assert abs(m.precision_at(1) - 1 / 3) < 1e-9
+        assert abs(m.precision_at(2) - (0.5 + 0.5 + 0.0) / 3) < 1e-9
+        # MAP contribution of the empty-truth query is 0
+        assert 0.0 < m.mean_average_precision() < 1.0
+        assert m.ndcg_at(3) >= 0.0
+        # perfect ranking: every metric is 1
+        perfect = RankingMetrics([([1, 2, 3], {1, 2, 3})])
+        assert perfect.precision_at(3) == 1.0
+        assert perfect.mean_average_precision() == 1.0
+        assert abs(perfect.ndcg_at(3) - 1.0) < 1e-9
+
+
+class TestMultilabelMetrics:
+    def test_hand_computed(self):
+        pairs = [
+            ({0, 1}, {0, 2}),
+            ({0, 2}, {0, 2}),
+            ({0}, {0, 1}),
+        ]
+        m = MultilabelMetrics(pairs)
+        assert abs(m.subset_accuracy - 1 / 3) < 1e-9
+        # doc accuracy: (1/3 + 1 + 1/2) / 3
+        assert abs(m.accuracy - (1 / 3 + 1.0 + 0.5) / 3) < 1e-9
+        assert abs(m.precision - (0.5 + 1.0 + 1.0) / 3) < 1e-9
+        assert abs(m.recall - (0.5 + 1.0 + 0.5) / 3) < 1e-9
+        tp = 1 + 2 + 1
+        fp = 1 + 0 + 0
+        fn = 1 + 0 + 1
+        assert abs(m.micro_precision - tp / (tp + fp)) < 1e-9
+        assert abs(m.micro_recall - tp / (tp + fn)) < 1e-9
+
+
+class TestRandomDatasets:
+    def test_generators_shapes_and_stats(self):
+        sched = JobScheduler(num_workers=4)
+        try:
+            ds = random_datasets.normal_dataset(sched, 4000, seed=1)
+            vals = np.asarray(ds.collect())
+            assert vals.shape == (4000,)
+            assert abs(vals.mean()) < 0.1 and abs(vals.std() - 1) < 0.1
+            u = np.asarray(
+                random_datasets.uniform_dataset(sched, 2000, seed=2).collect()
+            )
+            assert 0 <= u.min() and u.max() < 1
+            p = np.asarray(
+                random_datasets.poisson_dataset(sched, 2000, 3.0, seed=3)
+                .collect()
+            )
+            assert abs(p.mean() - 3.0) < 0.3
+            v = random_datasets.normal_vector_dataset(
+                sched, 100, 8, seed=4
+            ).collect()
+            assert len(v) == 100 and v[0].shape == (8,)
+        finally:
+            sched.shutdown()
+
+
+class TestReviewRegressions:
+    def test_bisecting_continues_past_degenerate_leaf(self):
+        # 40 identical rows (indivisible once split fails) + two separable
+        # clusters: the degenerate leaf must not abort the whole loop
+        rs = np.random.default_rng(11)
+        X = np.concatenate([
+            np.zeros((40, 2), np.float32),
+            np.float32([10, 10]) + 0.1 * rs.normal(size=(6, 2)).astype(np.float32),
+            np.float32([-10, 10]) + 0.1 * rs.normal(size=(6, 2)).astype(np.float32),
+        ])
+        model = BisectingKMeans(k=4, seed=0).fit(X)
+        assert model.k == 4
+
+    def test_association_rules_requires_count(self):
+        with pytest.raises(ValueError):
+            AssociationRules(0.5).run([(frozenset("ab"), 2)], 0)
+
+    def test_map_counts_duplicate_predictions(self):
+        m = RankingMetrics([([1, 1], {1})])
+        assert abs(m.mean_average_precision() - 2.0) < 1e-9
+
+    def test_svdpp_validates_bounds(self):
+        from asyncframework_tpu.graph import svd_plus_plus
+
+        with pytest.raises(ValueError):
+            svd_plus_plus([0, 70], [0, 1], [1.0, 2.0], num_users=50,
+                          num_iterations=1)
