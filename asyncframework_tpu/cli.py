@@ -112,6 +112,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="periodic metrics samples as CSV")
     p.add_argument("--speculation", action="store_true",
                    help="launch speculative copies of straggling tasks")
+    p.add_argument("--dynamic-allocation", action="store_true",
+                   help="scale slot capacity with task backlog (sibling "
+                        "executors added/retired, ExecutorAllocationManager "
+                        "parity)")
     p.add_argument("--stale-read", type=int, default=None, metavar="OFFSET",
                    help="ASYNCbroadcast experiment: workers read model "
                         "version (latest - OFFSET) from the versioned store")
@@ -258,6 +262,7 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         event_log=args.event_log,
         metrics_csv=args.metrics_csv,
         speculation=args.speculation,
+        dynamic_allocation=args.dynamic_allocation,
         stale_read_offset=args.stale_read,
         heartbeat=not args.no_heartbeat,
     )

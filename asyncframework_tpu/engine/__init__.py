@@ -4,6 +4,7 @@ from asyncframework_tpu.engine.scheduler import JobScheduler
 from asyncframework_tpu.engine.barrier import partial_barrier
 from asyncframework_tpu.engine.straggler import DelayModel, build_cloud_stragglers
 from asyncframework_tpu.engine.blacklist import BlacklistTracker
+from asyncframework_tpu.engine.allocation import ExecutorAllocationManager
 from asyncframework_tpu.engine.speculation import SpeculationMonitor, find_speculatable
 from asyncframework_tpu.engine.recovery import (
     ReassignmentPlan,
@@ -37,6 +38,7 @@ __all__ = [
     "build_cloud_stragglers",
     "BlacklistTracker",
     "SpeculationMonitor",
+    "ExecutorAllocationManager",
     "find_speculatable",
     "ReassignmentPlan",
     "ShardRecovery",

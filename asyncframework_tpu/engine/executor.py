@@ -118,7 +118,22 @@ class DeviceExecutor:
                 self.last_heartbeat_ms = self._clock.now_ms()
                 continue
             if task is None or self._killed:
-                return
+                if (
+                    task is None
+                    and self.shutdown_requested
+                    and not self._killed
+                ):
+                    # graceful retirement: a task enqueued concurrently
+                    # with shutdown() may sit behind the sentinel -- drain
+                    # and run it rather than strand its job forever
+                    try:
+                        task = self._inbox.get_nowait()
+                    except queue.Empty:
+                        return
+                    if task is None:
+                        return
+                else:
+                    return
             self.last_heartbeat_ms = self._clock.now_ms()
             self.busy = True
             self.busy_since_ms = self.last_heartbeat_ms
@@ -176,10 +191,93 @@ class ExecutorPool:
             for wid in range(num_workers)
         }
         self._spares: List[DeviceExecutor] = []
+        # long-lived extra executors per slot, added/removed by the
+        # allocation manager (dynamic allocation); distinct from one-shot
+        # speculation spares
+        self._siblings: Dict[int, List[DeviceExecutor]] = {}
+        # TaskMetrics of retired siblings: their tasks must stay accounted
+        self._retired_metrics: List[TaskMetrics] = []
 
     def get(self, worker_id: int) -> DeviceExecutor:
         with self._lock:
             return self.executors[worker_id]
+
+    # ------------------------------------------------- dynamic allocation
+    def add_sibling(self, worker_id: int) -> DeviceExecutor:
+        """Register a long-lived extra executor on a slot.  New launches go
+        to the least-loaded of the slot's executors (``least_loaded``) --
+        the in-process analog of dynamic executor allocation adding
+        capacity where tasks back up."""
+        with self._lock:
+            if self.closed:
+                raise RuntimeError("pool is shut down; cannot add sibling")
+            ex = DeviceExecutor(
+                worker_id, self._status_update,
+                self._device_of(worker_id), self._clock,
+            )
+            self._siblings.setdefault(worker_id, []).append(ex)
+            return ex
+
+    def remove_idle_sibling(self, worker_id: int) -> bool:
+        """Retire one idle sibling from the slot (scale-down); returns
+        whether one was removed.  Busy siblings (running OR queued work)
+        are never killed; the check and the removal happen under the pool
+        lock, the same lock ``launch_on_slot`` holds while enqueuing, so a
+        concurrently-launched task cannot land on a retiring sibling."""
+        with self._lock:
+            sibs = self._siblings.get(worker_id, [])
+            for i, ex in enumerate(sibs):
+                if ex.pending_tasks() == 0 and not ex.busy:
+                    del sibs[i]
+                    self._retired_metrics.extend(ex.metrics)
+                    break
+            else:
+                return False
+        ex.shutdown()
+        return True
+
+    def launch_on_slot(self, worker_id: int, task) -> None:
+        """Pick the slot's least-loaded executor and enqueue the task in
+        one pool-locked step, so sibling retirement (which takes the same
+        lock) can never shut down the chosen executor between the pick and
+        the enqueue."""
+        with self._lock:
+            self._least_loaded_locked(worker_id).launch_task(task)
+
+    def sibling_count(self, worker_id: int) -> int:
+        with self._lock:
+            return len(self._siblings.get(worker_id, []))
+
+    def slot_backlog(self, worker_id: int) -> int:
+        """Queued-but-unstarted tasks across the slot's executors."""
+        with self._lock:
+            ex = self.executors.get(worker_id)
+            total = ex.pending_tasks() if ex is not None and ex.alive else 0
+            for s in self._siblings.get(worker_id, []):
+                if s.alive:
+                    total += s.pending_tasks()
+            return total
+
+    def least_loaded(self, worker_id: int) -> DeviceExecutor:
+        """The slot's executor with the lightest load (primary when tied --
+        keeps single-executor behavior identical).  Load counts the queued
+        inbox PLUS the currently-running task: a busy executor with an
+        empty inbox must lose the tie to an idle sibling."""
+        with self._lock:
+            return self._least_loaded_locked(worker_id)
+
+    def _least_loaded_locked(self, worker_id: int) -> DeviceExecutor:
+        def load_of(ex: DeviceExecutor) -> float:
+            if not ex.alive:
+                return float("inf")
+            return ex.pending_tasks() + (1 if ex.busy else 0)
+
+        best = self.executors[worker_id]
+        load = load_of(best)
+        for s in self._siblings.get(worker_id, []):
+            if load_of(s) < load:
+                best, load = s, load_of(s)
+        return best
 
     # ----------------------------------------------------- speculative spares
     def spawn_spare(self, worker_id: int) -> DeviceExecutor:
@@ -202,6 +300,7 @@ class ExecutorPool:
         """One-shot spares are shut down and dropped after their task."""
         with self._lock:
             self._spares = [s for s in self._spares if s is not ex]
+            self._retired_metrics.extend(ex.metrics)
         ex.shutdown()
 
     def replace(self, worker_id: int) -> DeviceExecutor:
@@ -210,8 +309,10 @@ class ExecutorPool:
             if self.closed:
                 raise RuntimeError("pool is shut down; cannot replace executor")
             old = self.executors.get(worker_id)
-            if old is not None and old.alive:
-                old.shutdown()
+            if old is not None:
+                self._retired_metrics.extend(old.metrics)
+                if old.alive:
+                    old.shutdown()
             ex = DeviceExecutor(
                 worker_id, self._status_update, self._device_of(worker_id), self._clock
             )
@@ -234,10 +335,20 @@ class ExecutorPool:
             for ex in self._spares:
                 ex.shutdown()
             self._spares = []
+            for sibs in self._siblings.values():
+                for ex in sibs:
+                    ex.shutdown()
+            self._siblings = {}
 
     def all_metrics(self) -> List[TaskMetrics]:
         with self._lock:
             out: List[TaskMetrics] = []
             for ex in self.executors.values():
                 out.extend(ex.metrics)
+            for sibs in self._siblings.values():
+                for ex in sibs:
+                    out.extend(ex.metrics)
+            for ex in self._spares:
+                out.extend(ex.metrics)
+            out.extend(self._retired_metrics)
             return out

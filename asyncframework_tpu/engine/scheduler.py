@@ -119,9 +119,19 @@ class JobScheduler:
                 # every launch in the timeout window would churn executors
                 ex = self.pool.replace(worker_id)
                 self.blacklist.clear(worker_id)
+            else:
+                # healthy slot: route to its least-loaded executor (equals
+                # the primary unless dynamic allocation added siblings).
+                # Pick + enqueue happen atomically under the POOL lock so a
+                # concurrent sibling retirement cannot shut the chosen
+                # executor down in between (see ExecutorPool.launch_on_slot)
+                ex = None
             self._inflight.setdefault(worker_id, []).append(task)
             self._launch_ms[(task.job_id, worker_id)] = self._clock.now_ms()
-        ex.launch_task(task)
+        if ex is not None:
+            ex.launch_task(task)
+        else:
+            self.pool.launch_on_slot(worker_id, task)
 
     # -------------------------------------------------------- status updates
     def _status_update(

@@ -22,6 +22,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 from asyncframework_tpu.parallel.mesh import make_mesh, pad_and_shard
 
 
+def _pairwise_d2(X, centers):
+    """Squared euclidean distances (n, k) via the expanded-norm matmul form
+    -- the ONE assignment kernel shared by Lloyd iterations, streaming
+    updates, and prediction (works on numpy and jax arrays alike)."""
+    return (
+        (X * X).sum(1)[:, None]
+        - 2.0 * X @ centers.T
+        + (centers * centers).sum(1)[None, :]
+    )
+
+
 class KMeansModel:
     def __init__(self, centers: np.ndarray, cost: float, iterations: int):
         self.centers = centers
@@ -33,12 +44,7 @@ class KMeansModel:
         return self.centers.shape[0]
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        d2 = (
-            (X * X).sum(1)[:, None]
-            - 2.0 * X @ self.centers.T
-            + (self.centers * self.centers).sum(1)[None, :]
-        )
-        return np.argmin(d2, axis=1)
+        return np.argmin(_pairwise_d2(X, self.centers), axis=1)
 
 
 class KMeans:
@@ -91,11 +97,7 @@ class KMeans:
             out_specs=(P(None, None), P(None), P()),
         )
         def lloyd_step(Xl, vl, centers):
-            d2 = (
-                (Xl * Xl).sum(1)[:, None]
-                - 2.0 * Xl @ centers.T
-                + (centers * centers).sum(1)[None, :]
-            )
+            d2 = _pairwise_d2(Xl, centers)
             assign = jnp.argmin(d2, axis=1)
             onehot = jax.nn.one_hot(assign, k, dtype=Xl.dtype) * vl[:, None]
             sums = onehot.T @ Xl                      # (k, d)
@@ -304,15 +306,26 @@ class StreamingKMeans:
     def predict(self, X) -> np.ndarray:
         return self.latest_model().predict(np.asarray(X, np.float32))
 
+    # -------------------------------------------------- DStream integration
+    def train_on(self, dstream) -> "StreamingKMeans":
+        """Update the model from every batch of a DStream
+        (``StreamingKMeans.trainOn`` parity).  Registers an output op; the
+        stream's clock drives updates."""
+        dstream.foreach_batch(lambda _t, b: self.update(np.asarray(b)))
+        return self
+
+    def predict_on(self, dstream):
+        """Per-interval cluster assignments (``predictOn`` parity): a new
+        DStream of label arrays using the model AS OF each interval."""
+        return dstream.map_batch(
+            lambda b: self.predict(np.asarray(b, np.float32))
+        )
+
 
 @jax.jit
 def _assign_sums(batch, centers):
     """Per-center (sum of assigned rows, count): one-hot matmul kernel."""
-    d2 = (
-        (batch * batch).sum(1)[:, None]
-        - 2.0 * batch @ centers.T
-        + (centers * centers).sum(1)[None, :]
-    )
+    d2 = _pairwise_d2(batch, centers)
     onehot = jax.nn.one_hot(jnp.argmin(d2, axis=1), centers.shape[0],
                             dtype=batch.dtype)
     return onehot.T @ batch, onehot.sum(0)

@@ -44,6 +44,7 @@ from asyncframework_tpu.engine.straggler import DelayModel
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers.base import (
     DelayCalibrator,
+    make_allocation_manager,
     SolverCheckpointer,
     SolverConfig,
     TrainResult,
@@ -175,6 +176,7 @@ class ASAGA:
                 on_launch=inst.on_speculative_launch,
             )
             spec.start()
+        alloc = make_allocation_manager(cfg, sched)
         # stale-read experiment: the reference's ASAGA driver is the main
         # ASYNCbroadcast user (SparkASAGAThread.scala:268); workers read
         # model version (latest - offset)
@@ -338,6 +340,8 @@ class ASAGA:
                 ft.stop()
             if spec is not None:
                 spec.stop()
+            if alloc is not None:
+                alloc.stop()
             sched.shutdown()
             if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
@@ -353,6 +357,11 @@ class ASAGA:
         run_extras = inst.extras()
         if spec is not None:
             run_extras["speculated"] = spec.speculated_count()
+        if alloc is not None:
+            (
+                run_extras["executors_added"],
+                run_extras["executors_removed"],
+            ) = alloc.counts()
         inst.close(traj, cfg.printer_freq)
         return TrainResult(
             final_w=final_w,
@@ -441,6 +450,7 @@ class ASAGA:
                 on_launch=inst.on_speculative_launch,
             )
             spec.start()
+        alloc = make_allocation_manager(cfg, sched)
         self._warm_hot_path(apply=sync_apply, sync=True)
         start_wall = time.monotonic()
         snapshots: List[Tuple[float, jax.Array]] = [(0.0, w)]
@@ -507,6 +517,8 @@ class ASAGA:
                 ft.stop()
             if spec is not None:
                 spec.stop()
+            if alloc is not None:
+                alloc.stop()
             sched.shutdown()
             if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
@@ -517,6 +529,10 @@ class ASAGA:
         extras = inst.extras()
         if spec is not None:
             extras["speculated"] = spec.speculated_count()
+        if alloc is not None:
+            extras["executors_added"], extras["executors_removed"] = (
+                alloc.counts()
+            )
         inst.close(traj, cfg.printer_freq)
         return TrainResult(
             final_w=np.asarray(w),

@@ -42,6 +42,7 @@ from asyncframework_tpu.engine.straggler import DelayModel
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers.base import (
     DelayCalibrator,
+    make_allocation_manager,
     SolverCheckpointer,
     SolverConfig,
     TrainResult,
@@ -134,6 +135,7 @@ class ASGD:
                 on_launch=inst.on_speculative_launch,
             )
             spec.start()
+        alloc = make_allocation_manager(cfg, sched)
         # stale-read experiment: workers read version (latest - offset)
         store = (
             VersionedModelStore(cfg.max_live_versions)
@@ -382,6 +384,8 @@ class ASGD:
                 ft.stop()
             if spec is not None:
                 spec.stop()
+            if alloc is not None:
+                alloc.stop()
             sched.shutdown()
             if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
@@ -397,6 +401,10 @@ class ASGD:
         extras = inst.extras()
         if spec is not None:
             extras["speculated"] = spec.speculated_count()
+        if alloc is not None:
+            extras["executors_added"], extras["executors_removed"] = (
+                alloc.counts()
+            )
         inst.close(traj, cfg.printer_freq)
         return TrainResult(
             final_w=final_w,
@@ -451,6 +459,7 @@ class ASGD:
                 on_launch=inst.on_speculative_launch,
             )
             spec.start()
+        alloc = make_allocation_manager(cfg, sched)
 
         w = jax.device_put(jnp.zeros(self.ds.d, jnp.float32), self.driver_device)
         k_dev = jax.device_put(jnp.float32(0.0), self.driver_device)
@@ -510,6 +519,8 @@ class ASGD:
                 ft.stop()
             if spec is not None:
                 spec.stop()
+            if alloc is not None:
+                alloc.stop()
             sched.shutdown()
             if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
@@ -520,6 +531,10 @@ class ASGD:
         extras = inst.extras()
         if spec is not None:
             extras["speculated"] = spec.speculated_count()
+        if alloc is not None:
+            extras["executors_added"], extras["executors_removed"] = (
+                alloc.counts()
+            )
         inst.close(traj, cfg.printer_freq)
         return TrainResult(
             final_w=np.asarray(w),

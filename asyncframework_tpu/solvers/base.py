@@ -185,6 +185,12 @@ class SolverConfig:
     speculation_quantile: float = 0.75
     speculation_multiplier: float = 1.5
     speculation_min_ms: float = 100.0
+    # dynamic executor allocation (ExecutorAllocationManager.scala:82
+    # parity): sibling host threads added to backlogged slots, retired idle
+    dynamic_allocation: bool = False
+    allocation_max_extra: int = 1
+    allocation_backlog_threshold: int = 2
+    allocation_idle_timeout_s: float = 1.0
     # stale-read experiment (ASYNCbroadcast.value(index) parity): workers
     # read model version (latest - offset) from a VersionedModelStore
     stale_read_offset: Optional[int] = None
@@ -291,3 +297,21 @@ class DelayCalibrator:
                 self.calibrated = True
                 return True
             return False
+
+
+def make_allocation_manager(cfg: "SolverConfig", scheduler):
+    """Start a dynamic-allocation manager when the config asks for one
+    (``ExecutorAllocationManager`` parity); returns None otherwise.  Shared
+    by every solver run path."""
+    if not cfg.dynamic_allocation:
+        return None
+    from asyncframework_tpu.engine.allocation import ExecutorAllocationManager
+
+    mgr = ExecutorAllocationManager(
+        scheduler,
+        max_extra_per_slot=cfg.allocation_max_extra,
+        backlog_threshold=cfg.allocation_backlog_threshold,
+        idle_timeout_s=cfg.allocation_idle_timeout_s,
+    )
+    mgr.start()
+    return mgr

@@ -131,6 +131,24 @@ class ColumnarFrame:
                 out[name] = np.asarray(arr)[idx]
         return ColumnarFrame(out)
 
+    def distinct(self) -> "ColumnarFrame":
+        """Row dedup (``Dataset.distinct`` parity): keeps the FIRST
+        occurrence of each distinct row, in first-seen order.  Vectorized:
+        columns pack into one structured array and ``np.unique`` finds the
+        first index of each distinct row; the row materialization is one
+        device gather."""
+        arrays = [
+            (f"f{i}", np.asarray(self._cols[c]))
+            for i, c in enumerate(self._cols)
+        ]
+        rec = np.empty(
+            self._n, dtype=[(name, a.dtype) for name, a in arrays]
+        )
+        for name, a in arrays:
+            rec[name] = a
+        _vals, idx = np.unique(rec, return_index=True)
+        return self._take(np.sort(idx))
+
     # --------------------------------------------------------------- sorting
     def sort(self, by: str, ascending: bool = True) -> "ColumnarFrame":
         keys = np.asarray(self._cols[by])

@@ -45,7 +45,7 @@ _AGG_FNS = {"SUM": "sum", "AVG": "mean", "MEAN": "mean", "MIN": "min",
 _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "ORDER", "BY", "LIMIT", "AS",
     "AND", "OR", "NOT", "JOIN", "ON", "INNER", "LEFT", "RIGHT", "FULL",
-    "OUTER", "SEMI", "ANTI", "ASC", "DESC",
+    "OUTER", "SEMI", "ANTI", "ASC", "DESC", "DISTINCT", "HAVING",
 }
 
 
@@ -178,6 +178,21 @@ class _Parser:
         if t.startswith("'"):
             self.next()
             return lit(t[1:-1].replace("''", "'"))
+        if (
+            t.upper() in _AGG_FNS
+            and self.i + 1 < len(self.toks)
+            and self.toks[self.i + 1] == "("
+        ):
+            # aggregate-call syntax inside an expression (HAVING SUM(v) > 1)
+            # references the aggregated OUTPUT column by its default label
+            fn = _AGG_FNS[self.next().upper()]
+            self.expect("(")
+            arg = "*" if self.peek() == "*" else self.ident()
+            if arg == "*":
+                self.next()
+                fn = "count"
+            self.expect(")")
+            return col(f"{fn}({arg})")
         name = self.ident()
         if name.upper() in _KEYWORDS:
             raise ValueError(f"unexpected keyword {name!r} in expression")
@@ -270,6 +285,7 @@ class SQLContext:
     def sql(self, text: str) -> ColumnarFrame:
         p = _Parser(tokenize(text))
         p.expect("SELECT")
+        distinct = p.accept("DISTINCT")
         items = p.select_items()
         p.expect("FROM")
         frame = self.table(p.ident())
@@ -308,9 +324,16 @@ class SQLContext:
             frame = frame.filter(p.expr())
 
         group_key = None
+        having = None
         if p.accept("GROUP"):
             p.expect("BY")
             group_key = p.ident()
+            if p.accept("HAVING"):
+                # HAVING filters the AGGREGATED result, so its expression
+                # references OUTPUT column names (the group key, aggregate
+                # labels like sum(v), or AS aliases) -- the documented
+                # subset; raw-aggregate syntax inside HAVING is not re-parsed
+                having = p.expr()
 
         order_by = None
         ascending = True
@@ -342,6 +365,10 @@ class SQLContext:
             frame = frame.sort(order_by, ascending=ascending)
             order_by = None
         frame = self._project(frame, items, group_key)
+        if having is not None:
+            frame = frame.filter(having)
+        if distinct:
+            frame = frame.distinct()
         if order_by is not None:
             if order_by not in frame.columns:
                 raise ValueError(

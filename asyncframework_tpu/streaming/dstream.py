@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 EMPTY = object()  # sentinel: no batch this interval
 
@@ -159,6 +159,22 @@ class DStream:
             self.ssc, per, fn, inv_fn, length, slide, filter_fn
         )
 
+    def join(self, other: "DStream") -> "DStream":
+        """Per-interval inner join of two keyed streams
+        (``PairDStreamFunctions.join`` parity): emits ``(k, (v, w))`` for
+        every pairing of the interval's left and right values of ``k``."""
+        return _BinaryKeyed(self.ssc, self, other, how="inner")
+
+    def left_outer_join(self, other: "DStream") -> "DStream":
+        """``leftOuterJoin`` parity: unmatched left keys emit
+        ``(k, (v, None))``."""
+        return _BinaryKeyed(self.ssc, self, other, how="left")
+
+    def cogroup(self, other: "DStream") -> "DStream":
+        """``cogroup`` parity: ``(k, ([left values], [right values]))`` for
+        every key present on either side this interval."""
+        return _BinaryKeyed(self.ssc, self, other, how="cogroup")
+
     def update_state_by_key(
         self,
         update_fn: Callable[[List[Any], Optional[Any]], Optional[Any]],
@@ -219,6 +235,50 @@ class _Windowed(DStream):
             if b is not EMPTY:
                 batches.append(b)
         return batches if batches else EMPTY
+
+
+class _BinaryKeyed(DStream):
+    """Two-parent keyed combine: join / left_outer_join / cogroup.
+
+    Both parents' interval batches are iterables of (key, value) pairs; a
+    missing batch on one side is an empty side (EMPTY only when both
+    parents are silent, so a left join still emits for a silent right).
+    """
+
+    def __init__(self, ssc, left: DStream, right: DStream, how: str):
+        super().__init__(ssc, [left, right])
+        self._how = how
+
+    def compute(self, time_ms: int) -> Any:
+        lb = self.parents[0].get_or_compute(time_ms)
+        rb = self.parents[1].get_or_compute(time_ms)
+        if lb is EMPTY and rb is EMPTY:
+            return EMPTY
+        lgroups: Dict[Any, List[Any]] = {}
+        rgroups: Dict[Any, List[Any]] = {}
+        for groups, batch in ((lgroups, lb), (rgroups, rb)):
+            if batch is EMPTY:
+                continue
+            for k, v in batch:
+                groups.setdefault(k, []).append(v)
+        out: List[Tuple[Any, Any]] = []
+        if self._how == "cogroup":
+            for k in {**lgroups, **rgroups}:
+                out.append((k, (lgroups.get(k, []), rgroups.get(k, []))))
+        elif self._how == "inner":
+            for k, lvs in lgroups.items():
+                for lv in lvs:
+                    for rv in rgroups.get(k, []):
+                        out.append((k, (lv, rv)))
+        else:  # left
+            for k, lvs in lgroups.items():
+                rvs = rgroups.get(k)
+                for lv in lvs:
+                    if rvs:
+                        out.extend((k, (lv, rv)) for rv in rvs)
+                    else:
+                        out.append((k, (lv, None)))
+        return out if out else EMPTY
 
 
 class _Union(DStream):

@@ -1,0 +1,53 @@
+"""SQL front door: DISTINCT, HAVING, and multi-way joins over device frames.
+
+``SparkSession.sql`` parity on the TPU build: SQL text lowers onto the fused
+Column DSL; aggregates run as device segment reductions, joins as host key
+index + device gathers.
+"""
+
+import numpy as np
+
+from asyncframework_tpu.sql.frame import ColumnarFrame
+from asyncframework_tpu.sql.parser import SQLContext
+
+
+def main(n=4000, n_users=50):
+    rs = np.random.default_rng(7)
+    ctx = SQLContext()
+    ctx.register("events", ColumnarFrame({
+        "user": rs.integers(0, n_users, n),
+        "amount": rs.gamma(2.0, 10.0, n).astype(np.float32),
+        "kind": np.array(["view", "click", "buy"])[rs.integers(0, 3, n)],
+    }))
+    ctx.register("users", ColumnarFrame({
+        "user": np.arange(n_users),
+        "tier": np.array(["free", "pro"])[rs.integers(0, 2, n_users)],
+    }))
+
+    kinds = ctx.sql("SELECT DISTINCT kind FROM events ORDER BY kind")
+    print("event kinds:", list(np.asarray(kinds["kind"])))
+
+    heavy = ctx.sql(
+        "SELECT user, SUM(amount) AS total, COUNT(*) AS n "
+        "FROM events GROUP BY user HAVING total > 500 "
+        "ORDER BY total DESC LIMIT 5"
+    )
+    print("top spenders over 500:")
+    for u, t, c in zip(
+        np.asarray(heavy["user"]), np.asarray(heavy["total"]),
+        np.asarray(heavy["n"]),
+    ):
+        print(f"  user {u:3d}  total {t:8.1f}  events {c:4.0f}")
+
+    joined = ctx.sql(
+        "SELECT kind, COUNT(*) AS n FROM events JOIN users ON user "
+        "WHERE tier = 'pro' GROUP BY kind ORDER BY kind"
+    )
+    print("pro-tier events by kind:",
+          dict(zip(np.asarray(joined["kind"]),
+                   np.asarray(joined["n"]).astype(int))))
+    return heavy
+
+
+if __name__ == "__main__":
+    main()

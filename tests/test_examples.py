@@ -82,3 +82,22 @@ def test_staleness_experiment_example():
                         "async stale-read-2"}
     for res in out.values():
         assert res.trajectory[-1][1] < res.trajectory[0][1]
+
+
+def test_streaming_kmeans_example():
+    import streaming_kmeans_demo
+
+    model, labels = streaming_kmeans_demo.main(n_batches=6, per_cluster=20)
+    # centers tracked the drifting clusters: still well separated
+    c = np.sort(model.centers[:, 0])
+    assert c[1] - c[0] > 5.0
+    assert len(labels) == 6
+
+
+def test_sql_analytics_example():
+    import sql_analytics
+
+    heavy = sql_analytics.main(n=1000, n_users=20)
+    totals = np.asarray(heavy["total"])
+    assert np.all(totals > 500)
+    assert np.all(np.diff(totals) <= 0)  # ORDER BY total DESC

@@ -284,3 +284,57 @@ class TestReviewRegressions:
         c.write_text("id\n3000000000\n\n")
         got = np.asarray(read_csv(c)["id"])
         assert got.dtype == object and got[0] == 3000000000
+
+
+class TestDistinctAndHaving:
+    def _ctx(self):
+        from asyncframework_tpu.sql.parser import SQLContext
+        from asyncframework_tpu.sql.frame import ColumnarFrame
+        import numpy as np
+
+        ctx = SQLContext()
+        ctx.register("t", ColumnarFrame({
+            "k": np.array(["a", "a", "b", "b", "b", "c"]),
+            "v": np.array([1.0, 1.0, 2.0, 3.0, 5.0, 9.0], np.float32),
+        }))
+        return ctx
+
+    def test_select_distinct(self):
+        ctx = self._ctx()
+        out = ctx.sql("SELECT DISTINCT k, v FROM t")
+        assert len(out) == 5  # the duplicate (a, 1.0) row collapses
+        # first-seen order preserved
+        assert list(np.asarray(out["k"])[:2]) == ["a", "b"]
+
+    def test_having_with_alias(self):
+        ctx = self._ctx()
+        out = ctx.sql(
+            "SELECT k, SUM(v) AS s FROM t GROUP BY k HAVING s > 2 "
+            "ORDER BY s DESC"
+        )
+        assert list(np.asarray(out["k"])) == ["b", "c"]
+        np.testing.assert_allclose(np.asarray(out["s"]), [10.0, 9.0])
+
+    def test_having_with_aggregate_syntax(self):
+        ctx = self._ctx()
+        out = ctx.sql(
+            "SELECT k, SUM(v) FROM t GROUP BY k HAVING SUM(v) > 2"
+        )
+        assert sorted(np.asarray(out["k"])) == ["b", "c"]
+
+    def test_having_count_star(self):
+        ctx = self._ctx()
+        out = ctx.sql(
+            "SELECT k, COUNT(*) FROM t GROUP BY k HAVING COUNT(*) >= 2"
+        )
+        assert sorted(np.asarray(out["k"])) == ["a", "b"]
+
+    def test_distinct_matches_pandas(self):
+        import pandas as pd
+
+        ctx = self._ctx()
+        out = ctx.sql("SELECT DISTINCT k FROM t")
+        want = pd.DataFrame({"k": ["a", "a", "b", "b", "b", "c"]})[
+            "k"
+        ].drop_duplicates()
+        assert list(np.asarray(out["k"])) == list(want)

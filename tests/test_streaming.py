@@ -462,3 +462,48 @@ class TestReduceByKeyAndWindow:
         # stale re-read of t=200 (memo cache for win holds only 1 interval)
         got = win.compute(200)
         assert dict(got) == {"a": 11}  # the true t=200 window, not t=300's
+
+
+class TestPairStreamJoins:
+    def test_inner_join(self):
+        ssc = StreamingContext(batch_interval_ms=100, clock=ManualClock())
+        left = ssc.queue_stream([[("a", 1), ("b", 2)], [("a", 3)]])
+        right = ssc.queue_stream([[("a", 10), ("c", 30)], [("b", 20)]])
+        out, sink = collect_sink()
+        left.join(right).foreach_batch(sink)
+        ssc.generate_batch(100)
+        ssc.generate_batch(200)
+        assert out[0] == (100, [("a", (1, 10))])
+        # interval 2: no common keys -> nothing fires
+        assert len(out) == 1
+
+    def test_left_outer_join(self):
+        ssc = StreamingContext(batch_interval_ms=100, clock=ManualClock())
+        left = ssc.queue_stream([[("a", 1), ("b", 2)]])
+        right = ssc.queue_stream([[("a", 10)]])
+        out, sink = collect_sink()
+        left.left_outer_join(right).foreach_batch(sink)
+        ssc.generate_batch(100)
+        assert sorted(out[0][1]) == [("a", (1, 10)), ("b", (2, None))]
+
+    def test_cogroup_covers_both_sides(self):
+        ssc = StreamingContext(batch_interval_ms=100, clock=ManualClock())
+        left = ssc.queue_stream([[("a", 1), ("a", 2)]])
+        right = ssc.queue_stream([[("a", 9), ("z", 7)]])
+        out, sink = collect_sink()
+        left.cogroup(right).foreach_batch(sink)
+        ssc.generate_batch(100)
+        got = dict(out[0][1])
+        assert got["a"] == ([1, 2], [9])
+        assert got["z"] == ([], [7])
+
+    def test_join_duplicate_keys_cartesian(self):
+        ssc = StreamingContext(batch_interval_ms=100, clock=ManualClock())
+        left = ssc.queue_stream([[("k", 1), ("k", 2)]])
+        right = ssc.queue_stream([[("k", 10), ("k", 20)]])
+        out, sink = collect_sink()
+        left.join(right).foreach_batch(sink)
+        ssc.generate_batch(100)
+        assert sorted(out[0][1]) == [
+            ("k", (1, 10)), ("k", (1, 20)), ("k", (2, 10)), ("k", (2, 20))
+        ]
