@@ -22,6 +22,7 @@ schedulerBacklogTimeout analog), and a slot must be quiet for
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -58,6 +59,7 @@ class ExecutorAllocationManager:
         self._idle_since_ms: Dict[int, float] = {}
         self._added = 0
         self._removed = 0
+        self.last_error: Optional[BaseException] = None
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ policy
@@ -116,11 +118,18 @@ class ExecutorAllocationManager:
             while not self._stop.wait(self._interval):
                 try:
                     self.check_once()
-                except Exception:
-                    # the pool may be shutting down mid-scan; allocation is
-                    # best-effort and must never take down a run
+                except Exception as e:
                     if self._sched.pool.closed:
-                        return
+                        return  # pool torn down mid-scan: normal exit
+                    # a real policy/callback bug: record it, log it once,
+                    # and stop scanning -- silently retrying every tick
+                    # would leave allocation half-applied with misleading
+                    # counts and no diagnostic
+                    self.last_error = e
+                    logging.getLogger(__name__).warning(
+                        "dynamic allocation stopped after error: %r", e
+                    )
+                    return
 
         self._thread = threading.Thread(
             target=loop, name="executor-allocation", daemon=True

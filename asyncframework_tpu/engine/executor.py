@@ -70,6 +70,7 @@ class DeviceExecutor:
         self._killed = False
         self.shutdown_requested = False
         self.busy = False
+        self.current_task: Optional[TaskSpec] = None
         self.busy_since_ms = 0.0
         self.last_heartbeat_ms = self._clock.now_ms()
         self.metrics: List[TaskMetrics] = []
@@ -107,6 +108,12 @@ class DeviceExecutor:
     def pending_tasks(self) -> int:
         return self._inbox.qsize()
 
+    def idle(self) -> bool:
+        """True iff no queued AND no dequeued-but-unfinished task.  Uses
+        the queue's unfinished-task count (decremented only after the task
+        completes), so the dequeue->busy window cannot misreport idle."""
+        return self._inbox.unfinished_tasks == 0
+
     # ------------------------------------------------------------ main loop
     def _run(self) -> None:
         while True:
@@ -118,6 +125,8 @@ class DeviceExecutor:
                 self.last_heartbeat_ms = self._clock.now_ms()
                 continue
             if task is None or self._killed:
+                if task is not None or not self._killed:
+                    self._inbox.task_done()  # sentinel / killed-drop
                 if (
                     task is None
                     and self.shutdown_requested
@@ -131,11 +140,13 @@ class DeviceExecutor:
                     except queue.Empty:
                         return
                     if task is None:
+                        self._inbox.task_done()
                         return
                 else:
                     return
             self.last_heartbeat_ms = self._clock.now_ms()
             self.busy = True
+            self.current_task = task
             self.busy_since_ms = self.last_heartbeat_ms
             m = TaskMetrics(
                 job_id=task.job_id,
@@ -155,6 +166,8 @@ class DeviceExecutor:
             m.run_ms = m.finish_ms - m.launch_ms
             self.metrics.append(m)
             self.busy = False
+            self.current_task = None
+            self._inbox.task_done()
             self.last_heartbeat_ms = self._clock.now_ms()
             if self._killed:
                 return  # killed mid-task: never report (the monitor handles it)
@@ -227,7 +240,7 @@ class ExecutorPool:
         with self._lock:
             sibs = self._siblings.get(worker_id, [])
             for i, ex in enumerate(sibs):
-                if ex.pending_tasks() == 0 and not ex.busy:
+                if ex.idle():
                     del sibs[i]
                     self._retired_metrics.extend(ex.metrics)
                     break
@@ -258,15 +271,39 @@ class ExecutorPool:
                     total += s.pending_tasks()
             return total
 
-    def least_loaded(self, worker_id: int) -> DeviceExecutor:
+    def siblings_of(self, worker_id: int) -> List[DeviceExecutor]:
+        with self._lock:
+            return list(self._siblings.get(worker_id, []))
+
+    def drop_sibling(self, worker_id: int, ex: DeviceExecutor):
+        """Remove a dead/hung sibling (failure path -- contrast the
+        scale-down path ``remove_idle_sibling``); its metrics are retained
+        and it is killed, not drained.  Returns ``(queued, running)``: the
+        never-started tasks recovered from its inbox (relaunchable at the
+        SAME attempt) and the task it was running when it died, if any
+        (failed once -- relaunch bumps the attempt)."""
+        with self._lock:
+            sibs = self._siblings.get(worker_id, [])
+            self._siblings[worker_id] = [s for s in sibs if s is not ex]
+            self._retired_metrics.extend(ex.metrics)
+        running = ex.current_task
+        ex.kill()
+        queued = []
+        try:
+            while True:
+                t = ex._inbox.get_nowait()
+                if t is not None:
+                    queued.append(t)
+        except queue.Empty:
+            pass
+        return queued, running
+
+    def _least_loaded_locked(self, worker_id: int) -> DeviceExecutor:
         """The slot's executor with the lightest load (primary when tied --
         keeps single-executor behavior identical).  Load counts the queued
         inbox PLUS the currently-running task: a busy executor with an
-        empty inbox must lose the tie to an idle sibling."""
-        with self._lock:
-            return self._least_loaded_locked(worker_id)
-
-    def _least_loaded_locked(self, worker_id: int) -> DeviceExecutor:
+        empty inbox must lose the tie to an idle sibling.  Internal: pick
+        and enqueue must share one lock hold (``launch_on_slot``)."""
         def load_of(ex: DeviceExecutor) -> float:
             if not ex.alive:
                 return float("inf")
@@ -333,10 +370,12 @@ class ExecutorPool:
             for ex in self.executors.values():
                 ex.shutdown()
             for ex in self._spares:
+                self._retired_metrics.extend(ex.metrics)
                 ex.shutdown()
             self._spares = []
             for sibs in self._siblings.values():
                 for ex in sibs:
+                    self._retired_metrics.extend(ex.metrics)
                     ex.shutdown()
             self._siblings = {}
 

@@ -26,6 +26,7 @@ class HeartbeatMonitor:
         check_interval_s: float = 0.5,
         task_timeout_ms: Optional[float] = None,
         clock: Optional[Clock] = None,
+        on_sibling_lost=None,
     ):
         """``timeout_ms`` applies to *idle* silence (a dead thread).  A worker
         legitimately goes silent while running a long task (first XLA compile
@@ -35,6 +36,12 @@ class HeartbeatMonitor:
         not by killing workers)."""
         self._pool = pool
         self._on_lost = on_executor_lost
+        # on_sibling_lost(wid, queued_tasks, running_task): a failed
+        # dynamic-allocation sibling must NOT escalate to slot loss -- the
+        # primary is healthy, and resubmitting ITS in-flight tasks would
+        # inflate their attempts (spurious max-failures abort) and
+        # duplicate running work.  Only the sibling's own tasks resubmit.
+        self._on_sibling_lost = on_sibling_lost
         self._timeout_ms = timeout_ms
         self._task_timeout_ms = task_timeout_ms
         self._interval = check_interval_s
@@ -58,20 +65,31 @@ class HeartbeatMonitor:
         if self._pool.closed:
             return []
         now = self._clock.now_ms()
-        lost = []
-        for wid, ex in list(self._pool.executors.items()):
+
+        def is_bad(ex) -> bool:
             if ex.shutdown_requested:
-                continue  # graceful stop, not a failure
+                return False  # graceful stop, not a failure
             if not ex.alive:
-                lost.append(wid)
-            elif ex.busy:
-                if (
+                return True
+            if ex.busy:
+                return (
                     self._task_timeout_ms is not None
                     and now - ex.busy_since_ms > self._task_timeout_ms
-                ):
-                    lost.append(wid)
-            elif now - ex.last_heartbeat_ms > self._timeout_ms:
+                )
+            return now - ex.last_heartbeat_ms > self._timeout_ms
+
+        lost = []
+        for wid, ex in list(self._pool.executors.items()):
+            if is_bad(ex):
                 lost.append(wid)
+            # dynamic-allocation siblings carry tasks too: a dead or hung
+            # sibling is dropped and ONLY ITS tasks resubmit -- the healthy
+            # primary's in-flight work keeps its attempt counts
+            for sib in self._pool.siblings_of(wid):
+                if is_bad(sib):
+                    queued, running = self._pool.drop_sibling(wid, sib)
+                    if self._on_sibling_lost is not None:
+                        self._on_sibling_lost(wid, queued, running)
         for wid in lost:
             self._on_lost(wid)
         return lost

@@ -277,5 +277,45 @@ class JobScheduler:
             else:
                 self._launch(worker_id, retry)
 
+    def on_sibling_lost(self, worker_id: int, queued, running) -> None:
+        """Resubmit a failed dynamic-allocation sibling's own tasks.
+
+        ``queued`` never started: relaunch at the SAME attempt.  ``running``
+        died mid-task: bump its attempt (one real failure), abort the job
+        at ``max_task_failures`` exactly like the slot-loss path.  The
+        healthy primary's in-flight tasks are untouched.
+        """
+        for task in queued:
+            self._launch(worker_id, task)
+        if running is None:
+            return
+        with self._lock:
+            active = self._active_jobs.get(running.job_id)
+        if active is not None and active.waiter.is_claimed(running.worker_id):
+            with self._lock:
+                self._launch_ms.pop(
+                    (running.job_id, running.worker_id), None
+                )
+            return  # another copy already delivered this result
+        retry = TaskSpec(
+            job_id=running.job_id,
+            worker_id=running.worker_id,
+            fn=running.fn,
+            attempt=running.attempt + 1,
+        )
+        if retry.attempt >= self.max_task_failures:
+            with self._lock:
+                job = self._active_jobs.pop(running.job_id, None)
+                self._finished_ms.pop(running.job_id, None)
+            if job is not None:
+                job.waiter.job_failed(
+                    RuntimeError(
+                        f"sibling on slot {worker_id} lost with task at "
+                        "max attempts"
+                    )
+                )
+        else:
+            self._launch(worker_id, retry)
+
     def shutdown(self) -> None:
         self.pool.shutdown()

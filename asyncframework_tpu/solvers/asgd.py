@@ -202,11 +202,18 @@ class ASGD:
                 worker_keys=keys_h,
             )
 
-        # per-accepted-count pad/mask cache: rebuilt host constants would
-        # cost an extra transfer per drain on the latency-bound backends
-        # this feature targets
+        # per-accepted-count mask cache: rebuilt host constants would cost
+        # an extra transfer per drain on the latency-bound backends this
+        # feature targets.  Short drains pad the gradient LIST with this
+        # cached zero handle so the stacked G is always exactly
+        # (max_drain, d) -- ONE stack shape, ONE compile (a per-mcount
+        # stack/concat would compile a fresh executable for every distinct
+        # drain size, and on a tunneled backend each compile blocks the
+        # device for seconds -- measured 70x throughput loss)
         _mask_cache: Dict[int, jax.Array] = {}
-        _pad_cache: Dict[int, jax.Array] = {}
+        _zero_g = jax.device_put(
+            jnp.zeros(d, jnp.float32), self.driver_device
+        )
 
         def updater():
             max_drain = max(cfg.drain_batch, 1)
@@ -253,24 +260,16 @@ class ASGD:
                         # else: beyond the iteration budget -- ignored, like
                         # the old per-result loop's break-at-limit
                     if len(accepted_g) >= BATCH_DRAIN_MIN:
-                        # stack+apply = 2 dispatches replacing m.  G is
-                        # padded to the fixed (max_drain, d) shape with a
-                        # zero mask tail so apply_batch compiles ONCE, not
-                        # once per drained batch size.
+                        # stack+apply = 2 dispatches replacing m.  The list
+                        # is padded with the cached zero handle to the fixed
+                        # max_drain length and masked, so stack AND
+                        # apply_batch each compile ONCE, never per drained
+                        # batch size.
                         mcount = len(accepted_g)
-                        G = jnp.stack(accepted_g)
-                        if mcount < max_drain:
-                            pad = _pad_cache.get(mcount)
-                            if pad is None:
-                                pad = jax.device_put(
-                                    jnp.zeros(
-                                        (max_drain - mcount, G.shape[1]),
-                                        G.dtype,
-                                    ),
-                                    self.driver_device,
-                                )
-                                _pad_cache[mcount] = pad
-                            G = jnp.concatenate([G, pad])
+                        padded = accepted_g + [_zero_g] * (
+                            max_drain - mcount
+                        )
+                        G = jnp.stack(padded)
                         mask = _mask_cache.get(mcount)
                         if mask is None:
                             mask = jax.device_put(
@@ -617,9 +616,11 @@ class ASGD:
         else:
             wd, kd = self._apply(wd, g, kd)
             if apply_batch is not None and max_drain >= BATCH_DRAIN_MIN:
-                G = jax.device_put(
-                    jnp.zeros((max_drain, d), jnp.float32), drv
-                )
+                # stack of max_drain vectors, exactly like the drain path
+                # builds G -- warms the stack executable too, not just
+                # apply_batch
+                zero = jax.device_put(jnp.zeros(d, jnp.float32), drv)
+                G = jnp.stack([zero] * max_drain)
                 mask = jax.device_put(
                     jnp.zeros((max_drain,), jnp.float32), drv
                 )

@@ -237,6 +237,7 @@ class FaultTolerantRun:
             self._on_lost,
             timeout_ms=heartbeat_timeout_ms,
             check_interval_s=check_interval_s,
+            on_sibling_lost=scheduler.on_sibling_lost,
         )
 
     def _on_lost(self, worker_id: int) -> None:

@@ -107,6 +107,29 @@ class ColumnarFrame:
         out[name], _ = self._eval(expr)
         return ColumnarFrame(out)
 
+    def with_window(
+        self,
+        name: str,
+        fn: str,
+        arg: Optional[str] = None,
+        partition_by: Optional[str] = None,
+        order_by: Optional[str] = None,
+        ascending: bool = True,
+        offset: int = 1,
+        default=np.nan,
+    ) -> "ColumnarFrame":
+        """Add a window-function column (Spark ``Window.partitionBy(...)``
+        analog): row_number/rank/dense_rank, lag/lead, and running or
+        whole-partition sum/mean/min/max/count.  See ``sql/window.py``."""
+        from asyncframework_tpu.sql.window import window_column
+
+        out = dict(self._cols)
+        out[name] = window_column(
+            self, fn, arg, partition_by, order_by,
+            ascending=ascending, offset=offset, default=default,
+        )
+        return ColumnarFrame(out)
+
     def rename(self, mapping: Dict[str, str]) -> "ColumnarFrame":
         return ColumnarFrame(
             {mapping.get(k, k): v for k, v in self._cols.items()}
@@ -137,10 +160,16 @@ class ColumnarFrame:
         columns pack into one structured array and ``np.unique`` finds the
         first index of each distinct row; the row materialization is one
         device gather."""
-        arrays = [
-            (f"f{i}", np.asarray(self._cols[c]))
-            for i, c in enumerate(self._cols)
-        ]
+        arrays = []
+        for i, c in enumerate(self._cols):
+            a = np.asarray(self._cols[c])
+            if a.dtype.kind == "f":
+                # NaN != NaN would keep duplicate NaN rows; compare floats
+                # by bit pattern instead (normalizing -0.0 first so the two
+                # zeros still collapse) -- matches Dataset.distinct/pandas
+                a = np.where(a == 0, 0.0, a).astype(a.dtype)
+                a = a.view(f"u{a.dtype.itemsize}")
+            arrays.append((f"f{i}", a))
         rec = np.empty(
             self._n, dtype=[(name, a.dtype) for name, a in arrays]
         )

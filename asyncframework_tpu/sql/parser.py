@@ -46,6 +46,12 @@ _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "ORDER", "BY", "LIMIT", "AS",
     "AND", "OR", "NOT", "JOIN", "ON", "INNER", "LEFT", "RIGHT", "FULL",
     "OUTER", "SEMI", "ANTI", "ASC", "DESC", "DISTINCT", "HAVING",
+    "OVER", "PARTITION",
+}
+
+_WINDOW_ONLY_FNS = {
+    "ROW_NUMBER": "row_number", "RANK": "rank", "DENSE_RANK": "dense_rank",
+    "LAG": "lag", "LEAD": "lead",
 }
 
 
@@ -212,6 +218,27 @@ class _Parser:
                 self.next()
                 items.append(("star", None))
             elif (
+                self.peek_upper() in _WINDOW_ONLY_FNS
+                and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1] == "("
+            ):
+                wfn = _WINDOW_ONLY_FNS[self.next().upper()]
+                self.expect("(")
+                warg = None
+                woffset = 1
+                if wfn in ("lag", "lead"):
+                    warg = self.ident()
+                    if self.accept(","):
+                        woffset = int(self.next())
+                self.expect(")")
+                spec = self._over_clause()
+                out = wfn
+                if self.accept("AS"):
+                    out = self.ident()
+                items.append(
+                    ("window", (wfn, warg, woffset, spec, out))
+                )
+            elif (
                 self.peek_upper() in _AGG_FNS
                 and self.i + 1 < len(self.toks)
                 and self.toks[self.i + 1] == "("
@@ -238,6 +265,20 @@ class _Parser:
                     else:
                         arg = e
                 self.expect(")")
+                if self.peek_upper() == "OVER":
+                    # aggregate as a WINDOW function: SUM(v) OVER (...)
+                    if not isinstance(arg, str) and arg is not None:
+                        raise ValueError(
+                            "window aggregates take a bare column argument"
+                        )
+                    spec = self._over_clause()
+                    out = fn if arg is None else f"{fn}_{arg}"
+                    if self.accept("AS"):
+                        out = self.ident()
+                    items.append(("window", (fn, arg, 1, spec, out)))
+                    if not self.accept(","):
+                        return items
+                    continue
                 # unaliased labels must be unique per item or later spec
                 # entries silently overwrite earlier ones
                 label = arg if isinstance(arg, str) else (
@@ -261,6 +302,27 @@ class _Parser:
                 items.append(("expr", (e, out)))
             if not self.accept(","):
                 return items
+
+    def _over_clause(self) -> Tuple[Optional[str], Optional[str], bool]:
+        """OVER ( [PARTITION BY k] [ORDER BY c [ASC|DESC]] ) ->
+        (partition_by, order_by, ascending)."""
+        self.expect("OVER")
+        self.expect("(")
+        partition_by = None
+        order_by = None
+        ascending = True
+        if self.accept("PARTITION"):
+            self.expect("BY")
+            partition_by = self.ident()
+        if self.accept("ORDER"):
+            self.expect("BY")
+            order_by = self.ident()
+            if self.accept("DESC"):
+                ascending = False
+            else:
+                self.accept("ASC")
+        self.expect(")")
+        return partition_by, order_by, ascending
 
 
 class SQLContext:
@@ -366,7 +428,33 @@ class SQLContext:
             order_by = None
         frame = self._project(frame, items, group_key)
         if having is not None:
+            # HAVING may reference an aggregate by its CALL syntax (default
+            # label "fn(arg)") even when the SELECT aliased it -- bridge the
+            # default labels onto the aliased output columns for the filter,
+            # then drop the bridges
+            bridges = {}
+            for kind, it in items:
+                if kind != "agg":
+                    continue
+                fn, arg, out = it
+                default = (
+                    f"{fn}({arg})" if isinstance(arg, str)
+                    else ("count(*)" if arg is None else None)
+                )
+                if (
+                    default is not None
+                    and default != out
+                    and default not in frame.columns
+                    and out in frame.columns
+                ):
+                    bridges[default] = out
+            for default, out in bridges.items():
+                frame = frame.with_column(default, col(out))
             frame = frame.filter(having)
+            if bridges:
+                frame = frame.select(
+                    *[c for c in frame.columns if c not in bridges]
+                )
         if distinct:
             frame = frame.distinct()
         if order_by is not None:
@@ -388,6 +476,27 @@ class SQLContext:
             (k, v) for k, v in items if k == "expr"
         )]
         has_star = any(kind == "star" for kind, _ in items)
+        windows = [it for kind, it in items if kind == "window"]
+
+        if windows:
+            if group_key is not None or aggs:
+                raise ValueError(
+                    "window functions cannot mix with GROUP BY aggregates"
+                )
+            for fn, arg, offset, (pby, oby, asc), out in windows:
+                frame = frame.with_window(
+                    out, fn, arg, partition_by=pby, order_by=oby,
+                    ascending=asc, offset=offset,
+                )
+            if has_star:
+                return frame
+            sel = []
+            for kind, it in items:
+                if kind == "expr":
+                    sel.append(it[0].alias(it[1]))
+                else:
+                    sel.append(it[4])
+            return frame.select(*sel)
 
         if group_key is not None:
             # SELECT key?, aggs FROM ... GROUP BY key

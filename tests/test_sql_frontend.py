@@ -338,3 +338,127 @@ class TestDistinctAndHaving:
             "k"
         ].drop_duplicates()
         assert list(np.asarray(out["k"])) == list(want)
+
+    def test_having_aggregate_syntax_with_alias(self):
+        ctx = self._ctx()
+        out = ctx.sql(
+            "SELECT k, SUM(v) AS s FROM t GROUP BY k HAVING SUM(v) > 2"
+        )
+        assert sorted(np.asarray(out["k"])) == ["b", "c"]
+        assert sorted(out.columns) == ["k", "s"]  # bridge column dropped
+
+    def test_distinct_collapses_nan(self):
+        from asyncframework_tpu.sql.frame import ColumnarFrame
+        from asyncframework_tpu.sql.parser import SQLContext
+
+        ctx = SQLContext()
+        ctx.register("f", ColumnarFrame({
+            "v": np.array([np.nan, np.nan, 1.0, -0.0, 0.0], np.float32),
+        }))
+        out = ctx.sql("SELECT DISTINCT v FROM f")
+        assert len(out) == 3  # {nan, 1.0, 0.0}: NaNs and both zeros collapse
+
+
+class TestWindowFunctions:
+    """Window functions vs pandas oracles (WindowExec / Window.partitionBy
+    parity: ranking, offsets, whole-partition and running aggregates)."""
+
+    def _fixture(self):
+        from asyncframework_tpu.sql.frame import ColumnarFrame
+        from asyncframework_tpu.sql.parser import SQLContext
+        import pandas as pd
+
+        rs = np.random.default_rng(0)
+        k = np.array(list("abab" * 5))
+        v = rs.integers(0, 8, 20).astype(np.float64)
+        ctx = SQLContext()
+        ctx.register("t", ColumnarFrame({"k": k, "v": v}))
+        return ctx, pd.DataFrame({"k": k, "v": v})
+
+    def test_row_number_rank_dense_rank(self):
+        ctx, df = self._fixture()
+        out = ctx.sql(
+            "SELECT k, v, ROW_NUMBER() OVER (PARTITION BY k ORDER BY v) "
+            "AS rn, RANK() OVER (PARTITION BY k ORDER BY v) AS r, "
+            "DENSE_RANK() OVER (PARTITION BY k ORDER BY v) AS dr FROM t"
+        )
+        g = df.groupby("k")["v"]
+        np.testing.assert_array_equal(
+            np.asarray(out["rn"]), g.rank(method="first").astype(int)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["r"]), g.rank(method="min").astype(int)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["dr"]), g.rank(method="dense").astype(int)
+        )
+
+    def test_partition_and_running_aggregates(self):
+        ctx, df = self._fixture()
+        out = ctx.sql(
+            "SELECT k, v, SUM(v) OVER (PARTITION BY k) AS tot, "
+            "SUM(v) OVER (PARTITION BY k ORDER BY v) AS run, "
+            "AVG(v) OVER (PARTITION BY k) AS m FROM t"
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["tot"]), df.groupby("k")["v"].transform("sum")
+        )
+        want_run = (
+            df.sort_values(["k", "v"], kind="stable")
+            .groupby("k")["v"].cumsum().sort_index()
+        )
+        np.testing.assert_allclose(np.asarray(out["run"]), want_run)
+        np.testing.assert_allclose(
+            np.asarray(out["m"]), df.groupby("k")["v"].transform("mean")
+        )
+
+    def test_lag_lead(self):
+        ctx, df = self._fixture()
+        out = ctx.sql(
+            "SELECT k, v, LAG(v) OVER (PARTITION BY k ORDER BY v) AS p, "
+            "LEAD(v, 2) OVER (PARTITION BY k ORDER BY v) AS nx FROM t"
+        )
+        s = df.sort_values(["k", "v"], kind="stable")
+        np.testing.assert_allclose(
+            np.asarray(out["p"]),
+            s.groupby("k")["v"].shift(1).sort_index(), equal_nan=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["nx"]),
+            s.groupby("k")["v"].shift(-2).sort_index(), equal_nan=True,
+        )
+
+    def test_running_min_desc_and_global_window(self):
+        ctx, df = self._fixture()
+        # no PARTITION BY: one global partition; DESC running max = cummax
+        out = ctx.sql(
+            "SELECT v, MAX(v) OVER (ORDER BY v DESC) AS mx FROM t"
+        )
+        s = df.sort_values("v", ascending=False, kind="stable")
+        want = s["v"].cummax().sort_index()
+        np.testing.assert_allclose(np.asarray(out["mx"]), want)
+
+    def test_window_rejects_group_by_mix(self):
+        ctx, _ = self._fixture()
+        with pytest.raises(ValueError):
+            ctx.sql(
+                "SELECT k, ROW_NUMBER() OVER (ORDER BY v) FROM t GROUP BY k"
+            )
+
+    def test_frame_level_api(self):
+        from asyncframework_tpu.sql.frame import ColumnarFrame
+
+        f = ColumnarFrame({
+            "g": np.array(["x", "x", "y"]),
+            "v": np.array([3.0, 1.0, 2.0]),
+        })
+        out = f.with_window("c", "count", None, partition_by="g")
+        np.testing.assert_array_equal(np.asarray(out["c"]), [2, 2, 1])
+
+    def test_window_on_empty_result(self):
+        ctx, _ = self._fixture()
+        out = ctx.sql(
+            "SELECT k, ROW_NUMBER() OVER (PARTITION BY k ORDER BY v) AS rn "
+            "FROM t WHERE v > 99"
+        )
+        assert len(out) == 0
