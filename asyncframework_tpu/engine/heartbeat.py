@@ -84,12 +84,19 @@ class HeartbeatMonitor:
                 lost.append(wid)
             # dynamic-allocation siblings carry tasks too: a dead or hung
             # sibling is dropped and ONLY ITS tasks resubmit -- the healthy
-            # primary's in-flight work keeps its attempt counts
+            # primary's in-flight work keeps its attempt counts.  Without a
+            # resubmission handler the sibling's tasks would be silently
+            # discarded (hung jobs), so fall back to escalating the whole
+            # slot -- on_lost's resubmission covers them
             for sib in self._pool.siblings_of(wid):
                 if is_bad(sib):
-                    queued, running = self._pool.drop_sibling(wid, sib)
                     if self._on_sibling_lost is not None:
+                        queued, running = self._pool.drop_sibling(wid, sib)
                         self._on_sibling_lost(wid, queued, running)
+                    else:
+                        self._pool.drop_sibling(wid, sib)
+                        if wid not in lost:
+                            lost.append(wid)
         for wid in lost:
             self._on_lost(wid)
         return lost

@@ -285,6 +285,18 @@ class JobScheduler:
         at ``max_task_failures`` exactly like the slot-loss path.  The
         healthy primary's in-flight tasks are untouched.
         """
+        # drop the sibling's entries from the in-flight registry first
+        # (identity match): _launch re-registers each relaunch, and a stale
+        # duplicate would look forever-running to the speculation monitor
+        # and get re-executed on a later primary loss
+        with self._lock:
+            gone = {id(t) for t in queued}
+            if running is not None:
+                gone.add(id(running))
+            inflight = self._inflight.get(worker_id, [])
+            self._inflight[worker_id] = [
+                t for t in inflight if id(t) not in gone
+            ]
         for task in queued:
             self._launch(worker_id, task)
         if running is None:

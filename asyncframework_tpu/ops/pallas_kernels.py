@@ -13,6 +13,11 @@ module is the layer below that for cases XLA's fusion does not cover:
   worker step's core contraction with the HBM round-trip for the
   n-vector residual removed -- exactly the kind of fusion worth hand-
   scheduling when ``n`` is millions of rows (mnist8m).
+- :func:`chunk_attention` -- block attention with local softmax stats for
+  the long-context path: two MXU matmuls + exp per (batch, head) program
+  entirely in VMEM, returning the (o, m, l) flash triple so
+  ``parallel/ring.py`` can merge ring steps with the cheap rescale
+  (``ring_attention(..., block_kernel="pallas")``).
 - For rcv1-style sparse data the SURVEY-prescribed alternative (densify
   per batch, then this kernel) lives in the data layer; a scatter/gather
   CSR kernel is deliberately NOT attempted -- vector gather does not map
@@ -116,3 +121,107 @@ def reference_masked_grad(X, y, w, mask=None):
     if mask is not None:
         r = r * jnp.asarray(mask, jnp.float32)
     return X.T @ r
+
+
+# --------------------------------------------------------------- attention
+def _chunk_attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref,
+                       *, scale: float):
+    """One (batch*head) program: block attention with LOCAL softmax stats.
+
+    s = (q k^T) * scale masked to _NEG_BIG; emits (o = p v, m = rowmax,
+    l = rowsum) so the caller can merge blocks with the standard flash
+    rescale -- the kernel is the heavy part (two MXU matmuls + exp), the
+    merge is cheap elementwise XLA.
+    """
+    s = jnp.dot(
+        q_ref[0], k_ref[0].T, preferred_element_type=jnp.float32
+    ) * scale
+    s = jnp.where(mask_ref[:] > 0, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)          # (Tq, 1)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)          # (Tq, 1)
+    o_ref[0] = jnp.dot(p, v_ref[0], preferred_element_type=jnp.float32)
+    m_ref[0] = m
+    l_ref[0] = l
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "vma")
+)
+def _chunk_attn_padded(q, k, v, mask, scale: float, interpret: bool, vma):
+    bh, tq, dp = q.shape
+    tk = k.shape[1]
+    kw = {} if vma is None else {"vma": frozenset(vma)}
+    return pl.pallas_call(
+        functools.partial(_chunk_attn_kernel, scale=scale),
+        grid=(bh,),
+        in_specs=[
+            pl.BlockSpec((1, tq, dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tk, dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tk, dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((tq, tk), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tq, dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, dp), jnp.float32, **kw),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32, **kw),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32, **kw),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask)
+
+
+def chunk_attention(q, k, v, mask=None, interpret: bool = False, vma=None):
+    """Block attention with softmax stats: ``(o, m, l)`` per query row.
+
+    ``q``: (B, Tq, H, D); ``k``/``v``: (B, Tk, H, D); ``mask``: (Tq, Tk)
+    bool/0-1 (True = attend) or None.  Returns ``o`` (B, Tq, H, D) f32
+    un-normalized, ``m``/``l`` (B, H, Tq) f32 -- exactly the running-state
+    triple :func:`asyncframework_tpu.parallel.ring._block_accumulate`
+    folds, so a ring step can offload its block compute to this kernel
+    and keep the (cheap) rescale-merge in XLA.
+
+    Padding: Tq/Tk to sublane multiples (8), D to the 128-lane tile.
+    Padded K columns are masked out; padded D columns are zero so they
+    contribute nothing; padded Q rows are sliced off.
+
+    ``vma``: when called inside ``shard_map`` with vma checking, the mesh
+    axes the outputs vary over (e.g. ``("sp",)``) -- pallas outputs must
+    declare their varying-axes explicitly.
+    """
+    import math
+
+    B, tq, H, D = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    pad_q = (-tq) % 8
+    pad_k = (-tk) % 8
+    pad_d = (-D) % 128
+
+    if mask is None:
+        mask_f = jnp.ones((tq, tk), jnp.float32)
+    else:
+        mask_f = jnp.asarray(mask, jnp.float32)
+    mask_f = jnp.pad(mask_f, ((0, pad_q), (0, pad_k)))  # padded K masked
+
+    def to_bhd(x, pad_t):
+        x = jnp.asarray(x, jnp.float32)
+        x = jnp.pad(x, ((0, 0), (0, pad_t), (0, 0), (0, pad_d)))
+        # (B, T, H, D) -> (B*H, T, Dp)
+        return x.transpose(0, 2, 1, 3).reshape(
+            B * H, x.shape[1], D + pad_d
+        )
+
+    o, m, l = _chunk_attn_padded(
+        to_bhd(q, pad_q), to_bhd(k, pad_k), to_bhd(v, pad_k),
+        mask_f, scale, interpret, tuple(vma) if vma else None,
+    )
+    o = o.reshape(B, H, tq + pad_q, D + pad_d)[:, :, :tq, :D]
+    o = o.transpose(0, 2, 1, 3)                      # (B, Tq, H, D)
+    m = m.reshape(B, H, tq + pad_q)[:, :, :tq]
+    l = l.reshape(B, H, tq + pad_q)[:, :, :tq]
+    return o, m, l

@@ -76,8 +76,23 @@ def _block_accumulate(q, k, v, m, l, o, mask):
     return m_new, l_new, o_new
 
 
+def _merge_stats(m, l, o, m_b, l_b, o_b):
+    """Fold a block's local softmax stats into the running (m, l, o) --
+    the standard flash rescale, shared by the XLA and Pallas block paths."""
+    m_new = jnp.maximum(m, m_b)
+    c_old = jnp.exp(m - m_new)
+    c_new = jnp.exp(m_b - m_new)
+    l_new = l * c_old + l_b * c_new
+    o_new = (
+        o * c_old.transpose(0, 2, 1)[..., None]
+        + o_b * c_new.transpose(0, 2, 1)[..., None]
+    )
+    return m_new, l_new, o_new
+
+
 def ring_attention(
-    q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False
+    q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False,
+    block_kernel: str = "xla",
 ):
     """Exact attention over a sequence-sharded mesh axis via a K/V ring.
 
@@ -86,7 +101,15 @@ def ring_attention(
     block to the next device).  Causal masking uses global positions, so
     fully-masked future blocks contribute nothing (their probabilities
     underflow to zero against the running max).
+
+    ``block_kernel``: "xla" runs the per-step block attention as fused XLA
+    (:func:`_block_accumulate`); "pallas" offloads it to the hand-tiled
+    :func:`~asyncframework_tpu.ops.pallas_kernels.chunk_attention` kernel
+    (two MXU matmuls + exp entirely in VMEM, interpret-mode on CPU) and
+    merges the returned (o, m, l) stats with the same flash rescale.
     """
+    if block_kernel not in ("xla", "pallas"):
+        raise ValueError("block_kernel must be 'xla' or 'pallas'")
     n_dev = mesh.shape[axis]
     if q.shape[1] % n_dev:
         raise ValueError(
@@ -100,11 +123,18 @@ def ring_attention(
             f"vs {k.shape[1]}"
         )
 
+    # check_vma must be off for the pallas block path: the pallas
+    # interpreter's internal pad/slice mixes varying and invariant
+    # operands, which strict vma checking rejects (a JAX interpreter
+    # limitation, not a sharding bug -- the XLA path keeps the check)
+    use_vma = block_kernel != "pallas"
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None),
+        check_vma=use_vma,
     )
     def ring(ql, kl, vl):
         p_idx = jax.lax.axis_index(axis)
@@ -115,12 +145,27 @@ def ring_attention(
         # axis (the loop body's outputs are, via axis_index), so carry types
         # match.  Accumulators are f32 (see _block_accumulate).
         def varying(x):
+            if not use_vma:
+                return x  # vma tracking off: pcast is meaningless
             return jax.lax.pcast(x, (axis,), to="varying")
 
         m0 = varying(jnp.full((b, h, tq), _NEG, jnp.float32))
         l0 = varying(jnp.zeros((b, h, tq), jnp.float32))
         o0 = varying(jnp.zeros(ql.shape, jnp.float32))
         q_pos = p_idx * tq + jnp.arange(tq)
+
+        def fold(kb, vb, m, l, o, mask):
+            if block_kernel == "pallas":
+                from asyncframework_tpu.ops.pallas_kernels import (
+                    chunk_attention,
+                )
+
+                o_b, m_b, l_b = chunk_attention(
+                    ql, kb, vb, mask,
+                    interpret=jax.default_backend() != "tpu",
+                )
+                return _merge_stats(m, l, o, m_b, l_b, o_b)
+            return _block_accumulate(ql, kb, vb, m, l, o, mask)
 
         def accumulate(s, kb, vb, m, l, o):
             if causal:
@@ -132,12 +177,10 @@ def ring_attention(
                 return jax.lax.cond(
                     k_block > p_idx,
                     lambda m, l, o: (m, l, o),
-                    lambda m, l, o: _block_accumulate(
-                        ql, kb, vb, m, l, o, mask
-                    ),
+                    lambda m, l, o: fold(kb, vb, m, l, o, mask),
                     m, l, o,
                 )
-            return _block_accumulate(ql, kb, vb, m, l, o, None)
+            return fold(kb, vb, m, l, o, None)
 
         def step(s, carry):
             kb, vb, m, l, o = carry

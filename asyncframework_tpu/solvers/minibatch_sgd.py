@@ -16,6 +16,14 @@ fold_in keys -- ``sample(false, b, seed+i)`` parity), computes the local
 gradient sum, ``psum``s it over ICI, and applies the update on every device
 identically.  Zero host round-trips for the whole run; the per-step stochastic
 loss and the weight trajectory come back as stacked scan outputs.
+
+2-D meshes: with a mesh carrying a model-dim axis (``("dp", "md")``), rows
+shard over ``dp`` AND features over ``md`` (net-new tensor-parallel scope:
+the reference replicates its whole ``w``, which caps it at models that fit
+one executor heap).  Per step the partial products ``X_l w_l`` psum over
+``md`` into the full margin, the gradient slice psums over ``dp``, and each
+device updates only ITS ``w`` slice -- both collectives ride ICI, and ``w``
+never materializes whole on any chip.
 """
 
 from __future__ import annotations
@@ -61,7 +69,13 @@ class MiniBatchSGD:
         self.snapshot_every = snapshot_every
         self.convergence_tol = convergence_tol
 
-    def _build(self, mesh: Mesh, n_global: int, axis: str = "dp"):
+    def _build(
+        self,
+        mesh: Mesh,
+        n_global: int,
+        axis: str = "dp",
+        md_axis: Optional[str] = None,
+    ):
         gamma, b = self.gamma, self.batch_rate
         loss_kind, upd, reg = self.loss, self.updater, self.reg_param
         T = self.num_iterations
@@ -69,19 +83,29 @@ class MiniBatchSGD:
         def body(carry, it, X, y, valid):
             w, key = carry
             key, sub = jax.random.split(key)
+            # fold by dp index ONLY: with an md axis, every feature shard
+            # of the same row block must draw the identical sample mask
             sub = jax.random.fold_in(sub, jax.lax.axis_index(axis))
             mask = jax.random.bernoulli(sub, b, (X.shape[0],)).astype(X.dtype)
             mask = mask * valid  # exclude padding rows from sample & count
+            margin = X @ w
+            if md_axis is not None:
+                # partial products over the feature shards -> full margin
+                margin = jax.lax.psum(margin, md_axis)
             if loss_kind == "least_squares":
-                r = X @ w - y
+                r = margin - y
                 # MLlib LeastSquaresGradient: loss_i = diff^2 / 2
                 local_loss = 0.5 * jnp.sum(mask * r * r)
                 local_g = X.T @ (mask * r)
             else:
-                m = X @ w
-                p = jax.nn.sigmoid(m)
-                local_loss = jnp.sum(mask * (jnp.logaddexp(0.0, m) - y * m))
+                p = jax.nn.sigmoid(margin)
+                local_loss = jnp.sum(
+                    mask * (jnp.logaddexp(0.0, margin) - y * margin)
+                )
                 local_g = X.T @ (mask * (p - y))
+            # gradient slices combine over rows only; loss/count are
+            # identical across md shards (same r, same mask), so they
+            # psum over dp alone in both layouts
             g, loss_sum, count = jax.lax.psum(
                 (local_g, local_loss, jnp.sum(mask)), axis
             )
@@ -94,21 +118,32 @@ class MiniBatchSGD:
             elif upd == "l2":
                 # SquaredL2Updater: w2 = w(1 - lr*reg) - step; reg = reg/2 |w|^2
                 w2 = w * (1.0 - lr * reg) - step
-                reg_val = 0.5 * reg * jnp.sum(w2 * w2)
+                sq = jnp.sum(w2 * w2)
+                if md_axis is not None:
+                    sq = jax.lax.psum(sq, md_axis)  # |w|^2 spans the shards
+                reg_val = 0.5 * reg * sq
             else:
                 # L1Updater: soft threshold at lr*reg; reg = reg * |w|_1
                 shrink = lr * reg
                 raw = w - step
                 w2 = jnp.sign(raw) * jnp.maximum(jnp.abs(raw) - shrink, 0.0)
-                reg_val = reg * jnp.sum(jnp.abs(w2))
+                l1 = jnp.sum(jnp.abs(w2))
+                if md_axis is not None:
+                    l1 = jax.lax.psum(l1, md_axis)
+                reg_val = reg * l1
             stoch_loss = loss_sum / count + reg_val
             return (w2, key), (stoch_loss, w2)
+
+        in_specs = (
+            P(axis, md_axis), P(axis), P(axis), P(md_axis), P(None),
+        )
+        out_specs = (P(md_axis), P(None), P(None, md_axis))
 
         @partial(
             jax.shard_map,
             mesh=mesh,
-            in_specs=(P(axis, None), P(axis), P(axis), P(None), P(None)),
-            out_specs=(P(None), P(None), P(None)),
+            in_specs=in_specs,
+            out_specs=out_specs,
         )
         def train(X, y, valid, w0, key0):
             def scan_body(carry, it):
@@ -129,14 +164,51 @@ class MiniBatchSGD:
         w0: Optional[np.ndarray] = None,
     ):
         """Returns (w_final, loss_history, snapshots) where snapshots is the
-        Warray analog: [(iteration, w)] every ``snapshot_every`` steps."""
+        Warray analog: [(iteration, w)] every ``snapshot_every`` steps.
+
+        With a 2-D mesh (axes ``("dp", "md")``, md size > 1) the feature
+        dimension shards over ``md`` -- see the module docstring.
+        """
         mesh = mesh or make_mesh()
-        n = X.shape[0]
-        train = self._build(mesh, n_global=n)
-        Xs, ys, vs, _n = pad_and_shard(mesh, X, y)
-        w0 = np.zeros(X.shape[1], np.float32) if w0 is None else w0
+        n, d = X.shape
+        md_axis = (
+            "md"
+            if ("md" in mesh.axis_names and mesh.shape["md"] > 1)
+            else None
+        )
+        train = self._build(mesh, n_global=n, md_axis=md_axis)
+        w0 = np.zeros(d, np.float32) if w0 is None else np.asarray(w0)
+        if md_axis is None:
+            Xs, ys, vs, _n = pad_and_shard(mesh, X, y)
+            w_dev = jnp.asarray(w0)
+        else:
+            from jax.sharding import NamedSharding
+
+            from asyncframework_tpu.parallel.mesh import _put_sharded
+
+            n_dp = mesh.shape["dp"]
+            n_md = mesh.shape["md"]
+            pad_n = (-n) % n_dp
+            pad_d = (-d) % n_md
+            Xp = np.pad(np.asarray(X, np.float32),
+                        ((0, pad_n), (0, pad_d)))
+            yp = np.pad(np.asarray(y, np.float32), (0, pad_n))
+            valid = np.pad(np.ones(n, np.float32), (0, pad_n))
+            # _put_sharded, not bare device_put: under jax.distributed the
+            # mesh spans non-addressable devices and each process must
+            # contribute only its own shards (same path as pad_and_shard)
+            Xs = _put_sharded(Xp, NamedSharding(mesh, P("dp", "md")))
+            ys = _put_sharded(yp, NamedSharding(mesh, P("dp")))
+            vs = _put_sharded(valid, NamedSharding(mesh, P("dp")))
+            w_dev = _put_sharded(
+                np.pad(w0.astype(np.float32), (0, pad_d)),
+                NamedSharding(mesh, P("md")),
+            )
         key0 = jax.random.PRNGKey(self.seed)
-        wT, losses, ws = train(Xs, ys, vs, jnp.asarray(w0), key0)
+        wT, losses, ws = train(Xs, ys, vs, w_dev, key0)
+        if md_axis is not None:
+            wT = wT[:d]
+            ws = ws[:, :d]
         losses = np.asarray(losses)
         ws = np.asarray(ws)
         snaps = [

@@ -489,7 +489,16 @@ class SQLContext:
                     ascending=asc, offset=offset,
                 )
             if has_star:
-                return frame
+                if not exprs:
+                    return frame
+                # star + extra expressions: same contract as the
+                # non-window star path -- source columns, then windows,
+                # then non-colliding aliased expressions
+                sel = list(frame.columns) + [
+                    e.alias(name) for e, name in exprs
+                    if name not in frame.columns
+                ]
+                return frame.select(*sel)
             sel = []
             for kind, it in items:
                 if kind == "expr":

@@ -127,9 +127,11 @@ def window_column(
 
 
 def _descending_key(okey: np.ndarray):
-    if okey.dtype.kind in "fiub":
+    if okey.dtype.kind == "f":
         return -okey.astype(np.float64)
-    # strings: rank-invert through the sorted unique table
+    # ints and strings: rank-invert through the sorted unique table --
+    # negating through float64 would collapse distinct int64 values above
+    # 2^53 (e.g. nanosecond timestamps) into spurious ties
     _u, inv = np.unique(okey, return_inverse=True)
     return -inv
 

@@ -124,23 +124,25 @@ class TestAllocationInSolver:
 
 
 class TestSiblingFailureDetection:
-    def test_dead_sibling_dropped_and_slot_flagged(self):
+    def test_dead_sibling_dropped_without_slot_escalation(self):
         from asyncframework_tpu.engine.heartbeat import HeartbeatMonitor
 
         sched = JobScheduler(num_workers=2)
         try:
             sib = sched.pool.add_sibling(1)
-            lost = []
+            lost, sib_events = [], []
             mon = HeartbeatMonitor(
                 sched.pool, on_executor_lost=lost.append,
                 timeout_ms=1000.0,
+                on_sibling_lost=lambda w, q, r: sib_events.append(w),
             )
             assert mon.check_once() == []  # healthy
             sib.kill()  # simulated sibling death (not graceful)
             flagged = mon.check_once()
-            # sibling loss does NOT escalate to slot loss: the healthy
-            # primary's in-flight attempts must not inflate
+            # with a resubmission handler wired, sibling loss does NOT
+            # escalate: the healthy primary's attempts must not inflate
             assert flagged == []
+            assert sib_events == [1]
             assert sched.pool.sibling_count(1) == 0  # dropped from the pool
             assert lost == []
             # scan is idempotent once dropped (primary is healthy)
@@ -209,6 +211,65 @@ class TestSiblingFailureDetection:
             gate.set()
             w1.await_result(timeout=5)
             w2.await_result(timeout=5)
+        finally:
+            gate.set()
+            sched.shutdown()
+
+    def test_sibling_loss_without_handler_escalates_to_slot(self):
+        """No resubmission handler wired: sibling loss must fall back to
+        the slot-loss path so the tasks are not silently dropped."""
+        from asyncframework_tpu.engine.heartbeat import HeartbeatMonitor
+
+        sched = JobScheduler(num_workers=1)
+        try:
+            sib = sched.pool.add_sibling(0)
+            lost = []
+            mon = HeartbeatMonitor(
+                sched.pool, on_executor_lost=lost.append,
+                timeout_ms=1000.0,
+            )
+            sib.kill()
+            assert mon.check_once() == [0]
+            assert lost == [0]
+            assert sched.pool.sibling_count(0) == 0
+        finally:
+            sched.shutdown()
+
+    def test_sibling_loss_clears_inflight_registry(self):
+        """Relaunched sibling tasks must not leave stale _inflight entries
+        (they would look forever-running to the speculation monitor)."""
+        from asyncframework_tpu.engine.heartbeat import HeartbeatMonitor
+        from asyncframework_tpu.utils.clock import ManualClock
+
+        clock = ManualClock()
+        sched = JobScheduler(num_workers=1, clock=clock)
+        sched.set_mode(ASYNC)
+        mon = HeartbeatMonitor(
+            sched.pool, on_executor_lost=lambda w: None,
+            timeout_ms=10_000.0, task_timeout_ms=500.0, clock=clock,
+            on_sibling_lost=sched.on_sibling_lost,
+        )
+        sib = sched.pool.add_sibling(0)
+        gate = threading.Event()
+        try:
+            sched.run_job({0: (lambda: 0)}, lambda *a: None)
+            w1 = sched.run_job({0: _slow_task(gate)}, lambda *a: None)
+            w2 = sched.run_job({0: _slow_task(gate)}, lambda *a: None)
+            deadline = time.monotonic() + 5
+            while not (sched.pool.executors[0].busy and sib.busy):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            clock.advance(1_000)
+            mon.check_once()
+            gate.set()
+            w1.await_result(timeout=5)
+            w2.await_result(timeout=5)
+            deadline = time.monotonic() + 5
+            while any(sched._inflight.values()):
+                assert time.monotonic() < deadline, (
+                    f"stale inflight: {sched._inflight}"
+                )
+                time.sleep(0.01)
         finally:
             gate.set()
             sched.shutdown()

@@ -462,3 +462,25 @@ class TestWindowFunctions:
             "FROM t WHERE v > 99"
         )
         assert len(out) == 0
+
+    def test_star_plus_expr_plus_window(self):
+        ctx, df = self._fixture()
+        out = ctx.sql(
+            "SELECT *, v + 1 AS w, ROW_NUMBER() OVER "
+            "(PARTITION BY k ORDER BY v) AS rn FROM t"
+        )
+        assert sorted(out.columns) == ["k", "rn", "v", "w"]
+        np.testing.assert_allclose(
+            np.asarray(out["w"]), df["v"].to_numpy() + 1
+        )
+
+    def test_desc_order_large_int64_keys(self):
+        from asyncframework_tpu.sql.frame import ColumnarFrame
+
+        # distinct int64 keys above 2^53 must keep distinct ranks
+        base = 1_700_000_000_000_000_000
+        f = ColumnarFrame({"ts": np.array([base, base + 1, base + 2],
+                                          np.int64)})
+        out = f.with_window("rn", "row_number", None, order_by="ts",
+                            ascending=False)
+        np.testing.assert_array_equal(np.asarray(out["rn"]), [3, 2, 1])
