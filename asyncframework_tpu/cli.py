@@ -51,6 +51,16 @@ CONF_TO_FIELD: Dict[str, str] = {
     "async.printer.freq": "printer_freq",
     "async.delay.coeff": "coeff",
     "async.seed": "seed",
+    # engine knobs (spark.speculation / dynamicAllocation analogs)
+    "async.drain.batch": "drain_batch",
+    "async.speculation.quantile": "speculation_quantile",
+    "async.speculation.multiplier": "speculation_multiplier",
+    "async.speculation.min.ms": "speculation_min_ms",
+    "async.allocation.max.extra": "allocation_max_extra",
+    "async.allocation.backlog.threshold": "allocation_backlog_threshold",
+    "async.allocation.idle.timeout.s": "allocation_idle_timeout_s",
+    "async.heartbeat.timeout.ms": "heartbeat_timeout_ms",
+    "async.max.slot.failures": "max_slot_failures",
 }
 
 DRIVER_ALIASES: Dict[str, str] = {

@@ -124,3 +124,29 @@ HEARTBEAT_INTERVAL_S = ConfigEntry("async.heartbeat.interval", 0.5, float,
                                    "Executor heartbeat period, seconds.")
 HEARTBEAT_TIMEOUT_S = ConfigEntry("async.heartbeat.timeout", 5.0, float,
                                   "Executor declared dead after this silence.")
+DRAIN_BATCH = ConfigEntry("async.drain.batch", 1, int,
+                          "Queued gradients folded into one device dispatch.")
+SPECULATION_QUANTILE = ConfigEntry(
+    "async.speculation.quantile", 0.75, float,
+    "Fraction of tasks that must finish before speculating.")
+SPECULATION_MULTIPLIER = ConfigEntry(
+    "async.speculation.multiplier", 1.5, float,
+    "Running task speculated past multiplier * median duration.")
+SPECULATION_MIN_MS = ConfigEntry(
+    "async.speculation.min.ms", 100.0, float,
+    "Never speculate tasks younger than this.")
+ALLOCATION_MAX_EXTRA = ConfigEntry(
+    "async.allocation.max.extra", 1, int,
+    "Max sibling executors added per slot by dynamic allocation.")
+ALLOCATION_BACKLOG = ConfigEntry(
+    "async.allocation.backlog.threshold", 2, int,
+    "Queued tasks per slot that trigger a sibling (sustained).")
+ALLOCATION_IDLE_S = ConfigEntry(
+    "async.allocation.idle.timeout.s", 1.0, float,
+    "Idle seconds before a sibling executor retires.")
+HEARTBEAT_TIMEOUT_MS = ConfigEntry(
+    "async.heartbeat.timeout.ms", 2000.0, float,
+    "Solver-run heartbeat timeout (ms), see SolverConfig.")
+MAX_SLOT_FAILURES = ConfigEntry(
+    "async.max.slot.failures", 2, int,
+    "Repeated executor deaths on a slot before its shard re-homes.")

@@ -90,10 +90,15 @@ class HeartbeatMonitor:
             # slot -- on_lost's resubmission covers them
             for sib in self._pool.siblings_of(wid):
                 if is_bad(sib):
-                    if self._on_sibling_lost is not None:
+                    if self._on_sibling_lost is not None and wid not in lost:
                         queued, running = self._pool.drop_sibling(wid, sib)
                         self._on_sibling_lost(wid, queued, running)
                     else:
+                        # no handler, OR the slot is already being
+                        # escalated this scan: on_lost's resubmission
+                        # covers the sibling's tasks -- relaunching them
+                        # here too would double-execute and double-bump
+                        # their attempts
                         self._pool.drop_sibling(wid, sib)
                         if wid not in lost:
                             lost.append(wid)

@@ -72,6 +72,39 @@ def shard_batch(mesh: Mesh, *arrays, axis: str = "dp"):
     return out if len(out) > 1 else out[0]
 
 
+def pad_and_shard_2d(
+    mesh: Mesh,
+    X,
+    y,
+    w0,
+    dp_axis: str = "dp",
+    md_axis: str = "md",
+):
+    """2-D layout: rows pad+shard over ``dp_axis`` AND features over
+    ``md_axis`` (``w`` sharded over the feature axis, never whole on one
+    chip).  Returns ``(Xs, ys, valid, w_dev, d)`` with ``d`` the original
+    feature count (padded feature columns are zero and slice off the
+    results).  Placement goes through :func:`_put_sharded`, so the same
+    code runs single-process and under ``jax.distributed``.
+    """
+    n, d = X.shape
+    n_dp = mesh.shape[dp_axis]
+    n_md = mesh.shape[md_axis]
+    pad_n = (-n) % n_dp
+    pad_d = (-d) % n_md
+    Xp = np.pad(np.asarray(X, np.float32), ((0, pad_n), (0, pad_d)))
+    yp = np.pad(np.asarray(y, np.float32), (0, pad_n))
+    valid = np.pad(np.ones(n, np.float32), (0, pad_n))
+    Xs = _put_sharded(Xp, NamedSharding(mesh, P(dp_axis, md_axis)))
+    ys = _put_sharded(yp, NamedSharding(mesh, P(dp_axis)))
+    vs = _put_sharded(valid, NamedSharding(mesh, P(dp_axis)))
+    w_dev = _put_sharded(
+        np.pad(np.asarray(w0, np.float32), (0, pad_d)),
+        NamedSharding(mesh, P(md_axis)),
+    )
+    return Xs, ys, vs, w_dev, d
+
+
 def pad_and_shard(mesh: Mesh, *arrays, axis: str = "dp"):
     """Pad rows to a multiple of the mesh size (static shapes for XLA) and
     shard on the batch axis.

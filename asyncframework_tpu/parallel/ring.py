@@ -65,15 +65,17 @@ def _block_accumulate(q, k, v, m, l, o, mask):
     ) / math.sqrt(d)
     if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG)
-    m_new = jnp.maximum(m, s.max(axis=-1))
-    p = jnp.exp(s - m_new[..., None])           # (B, H, Tq, Tk) f32
-    corr = jnp.exp(m - m_new)                   # (B, H, Tq) f32
-    l_new = l * corr + p.sum(axis=-1)
-    o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+    # local stats for this block, then the ONE shared flash rescale
+    # (_merge_stats) -- the same fold the Pallas path uses, so the two
+    # block kernels can never drift numerically
+    m_b = s.max(axis=-1)                         # (B, H, Tq) f32
+    p = jnp.exp(s - m_b[..., None])              # (B, H, Tq, Tk) f32
+    l_b = p.sum(axis=-1)
+    o_b = jnp.einsum(
         "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     )
-    return m_new, l_new, o_new
+    return _merge_stats(m, l, o, m_b, l_b, o_b)
 
 
 def _merge_stats(m, l, o, m_b, l_b, o_b):

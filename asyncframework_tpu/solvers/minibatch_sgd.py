@@ -182,28 +182,9 @@ class MiniBatchSGD:
             Xs, ys, vs, _n = pad_and_shard(mesh, X, y)
             w_dev = jnp.asarray(w0)
         else:
-            from jax.sharding import NamedSharding
+            from asyncframework_tpu.parallel.mesh import pad_and_shard_2d
 
-            from asyncframework_tpu.parallel.mesh import _put_sharded
-
-            n_dp = mesh.shape["dp"]
-            n_md = mesh.shape["md"]
-            pad_n = (-n) % n_dp
-            pad_d = (-d) % n_md
-            Xp = np.pad(np.asarray(X, np.float32),
-                        ((0, pad_n), (0, pad_d)))
-            yp = np.pad(np.asarray(y, np.float32), (0, pad_n))
-            valid = np.pad(np.ones(n, np.float32), (0, pad_n))
-            # _put_sharded, not bare device_put: under jax.distributed the
-            # mesh spans non-addressable devices and each process must
-            # contribute only its own shards (same path as pad_and_shard)
-            Xs = _put_sharded(Xp, NamedSharding(mesh, P("dp", "md")))
-            ys = _put_sharded(yp, NamedSharding(mesh, P("dp")))
-            vs = _put_sharded(valid, NamedSharding(mesh, P("dp")))
-            w_dev = _put_sharded(
-                np.pad(w0.astype(np.float32), (0, pad_d)),
-                NamedSharding(mesh, P("md")),
-            )
+            Xs, ys, vs, w_dev, _d = pad_and_shard_2d(mesh, X, y, w0)
         key0 = jax.random.PRNGKey(self.seed)
         wT, losses, ws = train(Xs, ys, vs, w_dev, key0)
         if md_axis is not None:

@@ -46,6 +46,17 @@ def main(n=4000, n_users=50):
     print("pro-tier events by kind:",
           dict(zip(np.asarray(joined["kind"]),
                    np.asarray(joined["n"]).astype(int))))
+
+    # window function: each user's single largest purchase
+    from asyncframework_tpu.sql.expressions import col
+
+    ranked = ctx.sql(
+        "SELECT user, amount, ROW_NUMBER() OVER "
+        "(PARTITION BY user ORDER BY amount DESC) AS rk FROM events"
+    )
+    top = ranked.filter(col("rk") == 1)
+    print(f"window fn: top purchase per user ({len(top)} rows, "
+          f"max {float(np.asarray(top['amount']).max()):.1f})")
     return heavy
 
 

@@ -188,31 +188,33 @@ class TestSiblingFailureDetection:
             on_sibling_lost=sibling_lost,
         )
         sib = sched.pool.add_sibling(0)
-        gate = threading.Event()
+        gate_p = threading.Event()
+        gate_s = threading.Event()
         try:
             # burn first-iter blocking with a trivial job
             sched.run_job({0: (lambda: 0)}, lambda *a: None)
-            # occupy BOTH executors with gated tasks, then advance time
-            # past the hang threshold; both look hung, but only the
-            # sibling path must fire for the sibling
-            w1 = sched.run_job({0: _slow_task(gate)}, lambda *a: None)
-            w2 = sched.run_job({0: _slow_task(gate)}, lambda *a: None)
+            # primary takes job1 (released early -> healthy); the sibling
+            # takes job2 and stays stuck past the hang threshold
+            w1 = sched.run_job({0: _slow_task(gate_p)}, lambda *a: None)
+            w2 = sched.run_job({0: _slow_task(gate_s)}, lambda *a: None)
             deadline = time.monotonic() + 5
             while not (sched.pool.executors[0].busy and sib.busy):
                 assert time.monotonic() < deadline
                 time.sleep(0.01)
+            gate_p.set()
+            w1.await_result(timeout=5)  # primary healthy again
             clock.advance(1_000)
             mon.check_once()
-            # the sibling was dropped with its running task recovered;
-            # the primary was flagged through the normal slot path
+            # ONLY the sibling path fired; no slot escalation
             assert len(sib_events) == 1
             wid, queued, running = sib_events[0]
             assert wid == 0 and queued == [] and running is not None
-            gate.set()
-            w1.await_result(timeout=5)
-            w2.await_result(timeout=5)
+            assert lost == []
+            gate_s.set()
+            w2.await_result(timeout=5)  # completes via the resubmitted copy
         finally:
-            gate.set()
+            gate_p.set()
+            gate_s.set()
             sched.shutdown()
 
     def test_sibling_loss_without_handler_escalates_to_slot(self):
@@ -250,19 +252,21 @@ class TestSiblingFailureDetection:
             on_sibling_lost=sched.on_sibling_lost,
         )
         sib = sched.pool.add_sibling(0)
-        gate = threading.Event()
+        gate_p = threading.Event()
+        gate_s = threading.Event()
         try:
             sched.run_job({0: (lambda: 0)}, lambda *a: None)
-            w1 = sched.run_job({0: _slow_task(gate)}, lambda *a: None)
-            w2 = sched.run_job({0: _slow_task(gate)}, lambda *a: None)
+            w1 = sched.run_job({0: _slow_task(gate_p)}, lambda *a: None)
+            w2 = sched.run_job({0: _slow_task(gate_s)}, lambda *a: None)
             deadline = time.monotonic() + 5
             while not (sched.pool.executors[0].busy and sib.busy):
                 assert time.monotonic() < deadline
                 time.sleep(0.01)
+            gate_p.set()
+            w1.await_result(timeout=5)  # primary healthy before the scan
             clock.advance(1_000)
             mon.check_once()
-            gate.set()
-            w1.await_result(timeout=5)
+            gate_s.set()
             w2.await_result(timeout=5)
             deadline = time.monotonic() + 5
             while any(sched._inflight.values()):
@@ -271,5 +275,6 @@ class TestSiblingFailureDetection:
                 )
                 time.sleep(0.01)
         finally:
-            gate.set()
+            gate_p.set()
+            gate_s.set()
             sched.shutdown()
