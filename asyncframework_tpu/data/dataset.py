@@ -28,6 +28,7 @@ TPU mapping / design deltas:
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import (
     Any,
@@ -336,6 +337,71 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
     def count_by_value(self) -> Dict[E, int]:
         """``RDD.countByValue`` parity (driver-side dict)."""
         return self.map(lambda x: (x, 1)).count_by_key()
+
+    def stats(self) -> "StatCounter":
+        """``DoubleRDDFunctions.stats`` parity: one pass merging per-
+        partition (count, mean, M2, min, max) with Chan's parallel-moments
+        update -- the numerically stable merge ``StatCounter.scala`` uses."""
+        def seq(acc: "StatCounter", x) -> "StatCounter":
+            acc.merge_value(float(x))
+            return acc
+
+        def comb(a: "StatCounter", b: "StatCounter") -> "StatCounter":
+            a.merge_stats(b)
+            return a
+
+        return self.aggregate(StatCounter(), seq, comb)
+
+    def histogram(self, buckets):
+        """``DoubleRDDFunctions.histogram`` parity.
+
+        ``buckets`` int: ``buckets`` evenly spaced bins over [min, max],
+        returns ``(bucket_edges, counts)``.  ``buckets`` sequence: custom
+        edges (len B+1, ascending), returns counts only.  The last bucket
+        is closed on the right (reference semantics); values outside custom
+        edges are ignored.
+        """
+        if isinstance(buckets, int):
+            if buckets < 1:
+                raise ValueError("buckets must be >= 1")
+            st = self.stats()
+            if st.count == 0:
+                raise ValueError("histogram of an empty dataset")
+            lo, hi = st.min, st.max
+            edges = [
+                lo + (hi - lo) * i / buckets for i in range(buckets + 1)
+            ]
+            # float rounding can land edges[-1] BELOW the true max (which
+            # would silently drop the maximum values), and a range tiny
+            # relative to |lo| can collapse interior edges entirely
+            edges[-1] = hi
+            if lo == hi or any(
+                a >= b for a, b in zip(edges, edges[1:])
+            ):
+                edges = [lo + i for i in range(buckets + 1)]
+                counts = [0] * buckets
+                counts[0] = int(st.count)
+                return edges, counts
+            return edges, self.histogram(edges)
+        edges = [float(b) for b in buckets]
+        if len(edges) < 2 or any(
+            a >= b for a, b in zip(edges, edges[1:])
+        ):
+            raise ValueError("bucket edges must be ascending, len >= 2")
+        nb = len(edges) - 1
+
+        def seq(counts, x):
+            x = float(x)
+            if edges[0] <= x <= edges[-1]:
+                # right-closed last bucket, like the reference
+                i = min(bisect.bisect_right(edges, x) - 1, nb - 1)
+                counts[i] += 1
+            return counts
+
+        def comb(a, b):
+            return [x + y for x, y in zip(a, b)]
+
+        return self.aggregate([0] * nb, seq, comb)
 
     def count_approx_distinct(self, relative_sd: float = 0.05) -> int:
         """``RDD.countApproxDistinct`` parity: per-partition HyperLogLog
@@ -753,3 +819,71 @@ def _local_aggregate(
     for x in xs:
         acc = seq_op(acc, x)
     return acc
+
+
+class StatCounter:
+    """Running (count, mean, variance, min, max) with a numerically stable
+    merge (``org.apache.spark.util.StatCounter`` parity: Chan et al.'s
+    parallel-moments update, the same algebra ``stats()`` relies on)."""
+
+    def __init__(self):
+        self.count = 0
+        self.mean = 0.0
+        self._m2 = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def merge_value(self, x: float) -> "StatCounter":
+        delta = x - self.mean
+        self.count += 1
+        self.mean += delta / self.count
+        self._m2 += delta * (x - self.mean)
+        self.min = min(self.min, x)
+        self.max = max(self.max, x)
+        return self
+
+    def merge_stats(self, other: "StatCounter") -> "StatCounter":
+        if other.count == 0:
+            return self
+        if self.count == 0:
+            self.count = other.count
+            self.mean = other.mean
+            self._m2 = other._m2
+            self.min = other.min
+            self.max = other.max
+            return self
+        delta = other.mean - self.mean
+        total = self.count + other.count
+        self.mean += delta * other.count / total
+        self._m2 += other._m2 + delta * delta * self.count * other.count / total
+        self.count = total
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        return self
+
+    @property
+    def variance(self) -> float:
+        """Population variance (StatCounter.variance semantics)."""
+        return self._m2 / self.count if self.count else float("nan")
+
+    @property
+    def sample_variance(self) -> float:
+        return (
+            self._m2 / (self.count - 1)
+            if self.count > 1
+            else float("nan")
+        )
+
+    @property
+    def stdev(self) -> float:
+        return self.variance ** 0.5
+
+    @property
+    def sum(self) -> float:
+        return self.mean * self.count
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"StatCounter(count={self.count}, mean={self.mean:.6g}, "
+            f"stdev={self.stdev:.6g}, min={self.min:.6g}, max={self.max:.6g})"
+        )

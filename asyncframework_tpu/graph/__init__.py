@@ -6,6 +6,7 @@ from asyncframework_tpu.graph.algorithms import (
     label_propagation,
     pagerank,
     partition_edges,
+    personalized_pagerank,
     shortest_paths,
     strongly_connected_components,
     svd_plus_plus,
@@ -16,5 +17,5 @@ __all__ = [
     "Graph", "pregel", "pagerank", "connected_components",
     "triangle_count", "label_propagation", "shortest_paths",
     "partition_edges", "strongly_connected_components",
-    "svd_plus_plus", "SVDPlusPlusModel",
+    "svd_plus_plus", "SVDPlusPlusModel", "personalized_pagerank",
 ]

@@ -15,6 +15,40 @@ from asyncframework_tpu.graph.graph import Graph
 from asyncframework_tpu.graph.pregel import pregel
 
 
+def _pagerank_impl(
+    graph: Graph,
+    teleport: jnp.ndarray,
+    alpha: float,
+    num_iterations: int,
+    tol: Optional[float],
+) -> jnp.ndarray:
+    """One power-iteration lowering shared by both PageRank variants:
+    ``r' = (1-a)*teleport + a*(sum_in r/outdeg + dangling_mass*teleport)``
+    -- uniform ``teleport`` is classic PageRank, a one-hot is the
+    personalized form.  Teleport and dangling mass share the same
+    destination distribution (both variants' semantics)."""
+    outdeg = graph.out_degrees().astype(jnp.float32)
+    safe_deg = jnp.maximum(outdeg, 1)
+    dangling = (outdeg == 0).astype(jnp.float32)
+
+    def vprog(r, incoming):
+        # dangling vertices' rank re-enters via the teleport distribution;
+        # recomputed from the *current* ranks so it is one fused pass
+        d_mass = jnp.sum(r * dangling)
+        return (1.0 - alpha) * teleport + alpha * (
+            incoming + d_mass * teleport
+        )
+
+    def send_msg(src_r, dst_r, _e):
+        # message = r[src]/outdeg[src]: the division rides the edge gather
+        return src_r / safe_deg[graph.src]
+
+    return pregel(
+        graph, teleport, vprog, send_msg, merge="sum",
+        max_iterations=num_iterations, tol=tol,
+    )
+
+
 def pagerank(
     graph: Graph,
     alpha: float = 0.85,
@@ -27,26 +61,26 @@ def pagerank(
     With ``tol`` set, stops early once max-abs rank change <= tol.
     """
     n = graph.num_vertices
-    outdeg = graph.out_degrees().astype(jnp.float32)
-    safe_deg = jnp.maximum(outdeg, 1)
-    dangling = (outdeg == 0).astype(jnp.float32)
+    uniform = jnp.full(n, 1.0 / n, jnp.float32)
+    return _pagerank_impl(graph, uniform, alpha, num_iterations, tol)
 
-    def vprog(r, incoming):
-        # dangling vertices' rank spreads uniformly; recompute their mass
-        # from the *current* ranks so it is one fused pass
-        d_mass = jnp.sum(r * dangling)
-        return (1.0 - alpha) / n + alpha * (incoming + d_mass / n)
 
-    r0 = jnp.full(n, 1.0 / n, jnp.float32)
-
-    def send_msg(src_r, dst_r, _e):
-        # message = r[src]/outdeg[src]: the division rides the edge gather
-        return src_r / safe_deg[graph.src]
-
-    return pregel(
-        graph, r0, vprog, send_msg, merge="sum",
-        max_iterations=num_iterations, tol=tol,
-    )
+def personalized_pagerank(
+    graph: Graph,
+    source: int,
+    alpha: float = 0.85,
+    num_iterations: int = 20,
+    tol: Optional[float] = None,
+) -> jnp.ndarray:
+    """Personalized PageRank from a single source vertex (GraphX
+    ``PageRank.runWithOptions`` with ``srcId`` semantics): the teleport
+    mass returns to ``source`` instead of spreading uniformly, so ranks
+    measure proximity to the source."""
+    n = graph.num_vertices
+    if not 0 <= source < n:
+        raise ValueError(f"source {source} out of range [0, {n})")
+    onehot = jnp.zeros(n, jnp.float32).at[source].set(1.0)
+    return _pagerank_impl(graph, onehot, alpha, num_iterations, tol)
 
 
 def connected_components(graph: Graph, max_iterations: int = 100) -> jnp.ndarray:

@@ -83,6 +83,62 @@ class Graph:
     def with_vertex_attr(self, attr) -> "Graph":
         return Graph(self.src, self.dst, self.num_vertices, attr, self.edge_attr)
 
+    def map_vertices(self, f) -> "Graph":
+        """``Graph.mapVertices`` parity: new vertex attributes from one
+        vectorized map over the attribute array."""
+        if self.vertex_attr is None:
+            raise ValueError("graph has no vertex_attr to map")
+        return self.with_vertex_attr(f(self.vertex_attr))
+
+    def map_edges(self, f) -> "Graph":
+        """``Graph.mapEdges`` parity (vectorized over the edge array)."""
+        if self.edge_attr is None:
+            raise ValueError("graph has no edge_attr to map")
+        return Graph(
+            self.src, self.dst, self.num_vertices, self.vertex_attr,
+            f(self.edge_attr),
+        )
+
+    def subgraph(self, edge_mask=None, vertex_mask=None) -> "Graph":
+        """``Graph.subgraph`` parity: keep edges passing ``edge_mask``
+        whose BOTH endpoints pass ``vertex_mask``.  Vertex ids are
+        preserved (dropped vertices just become isolates), matching the
+        reference's behavior of keeping the vertex domain."""
+        keep = jnp.ones(self.num_edges, bool)
+        if edge_mask is not None:
+            keep = keep & jnp.asarray(edge_mask, bool)
+        if vertex_mask is not None:
+            vm = jnp.asarray(vertex_mask, bool)
+            if vm.shape[0] != self.num_vertices:
+                raise ValueError("vertex_mask must have num_vertices entries")
+            keep = keep & vm[self.src] & vm[self.dst]
+        idx = np.nonzero(np.asarray(keep))[0]
+        return Graph(
+            np.asarray(self.src)[idx], np.asarray(self.dst)[idx],
+            self.num_vertices, self.vertex_attr,
+            None if self.edge_attr is None
+            else np.asarray(self.edge_attr)[idx],
+        )
+
+    def aggregate_messages(self, send_msg, merge: str = "sum"):
+        """``Graph.aggregateMessages`` parity -- THE GraphX primitive: per
+        edge, ``send_msg(src_attr, dst_attr, edge_attr)`` produces a message
+        to the edge's destination; messages combine per vertex with one
+        device segment-``merge``.  Returns the (num_vertices, ...) combined
+        array (vertices with no messages get the merge identity)."""
+        from asyncframework_tpu.graph.pregel import segment_combine
+
+        sa = (
+            self.vertex_attr[self.src]
+            if self.vertex_attr is not None else None
+        )
+        da = (
+            self.vertex_attr[self.dst]
+            if self.vertex_attr is not None else None
+        )
+        msgs = send_msg(sa, da, self.edge_attr)
+        return segment_combine(msgs, self.dst, self.num_vertices, merge)
+
     @classmethod
     def from_edges(cls, edges, num_vertices: Optional[int] = None) -> "Graph":
         """Build from an (E, 2) array or list of (src, dst) pairs."""

@@ -340,3 +340,57 @@ class TestRDDBreadth:
 
         with _pytest.raises(ValueError, match="p="):
             ds.count_approx_distinct(relative_sd=0.0001)
+
+
+class TestStatsAndHistogram:
+    def test_stats_matches_numpy(self, sched):
+        rs = np.random.default_rng(4)
+        vals = rs.normal(3.0, 2.0, 500).tolist()
+        ds = DistributedDataset.from_list(sched, vals)
+        st = ds.stats()
+        assert st.count == 500
+        np.testing.assert_allclose(st.mean, np.mean(vals), rtol=1e-9)
+        np.testing.assert_allclose(st.stdev, np.std(vals), rtol=1e-9)
+        np.testing.assert_allclose(
+            st.sample_variance, np.var(vals, ddof=1), rtol=1e-9
+        )
+        assert st.min == min(vals) and st.max == max(vals)
+        np.testing.assert_allclose(st.sum, np.sum(vals), rtol=1e-9)
+
+    def test_histogram_even_buckets(self, sched):
+        ds = DistributedDataset.from_list(sched, [float(i) for i in range(100)])
+        edges, counts = ds.histogram(4)
+        np.testing.assert_allclose(edges, [0, 24.75, 49.5, 74.25, 99.0])
+        assert counts == [25, 25, 25, 25]
+        assert sum(counts) == 100
+
+    def test_histogram_custom_edges_matches_numpy(self, sched):
+        rs = np.random.default_rng(5)
+        vals = rs.uniform(0, 10, 400)
+        ds = DistributedDataset.from_list(sched, vals.tolist())
+        edges = [0.0, 2.5, 5.0, 7.5, 10.0]
+        counts = ds.histogram(edges)
+        want, _ = np.histogram(vals, bins=edges)
+        assert counts == want.tolist()
+
+    def test_histogram_constant_and_validation(self, sched):
+        ds = DistributedDataset.from_list(sched, [7.0] * 12)
+        edges, counts = ds.histogram(3)
+        assert counts == [12, 0, 0]
+        with pytest.raises(ValueError):
+            ds.histogram(0)
+        with pytest.raises(ValueError):
+            ds.histogram([3.0, 1.0])
+
+    def test_histogram_max_value_never_dropped(self, sched):
+        # float rounding can land the computed last edge below the true
+        # max; counts must still cover every value (review regression)
+        vals = [-479733.491561483, 450148.38147423544, 1.0]
+        ds = DistributedDataset.from_list(sched, vals)
+        _edges, counts = ds.histogram(3)
+        assert sum(counts) == 3
+
+    def test_histogram_degenerate_range(self, sched):
+        ds = DistributedDataset.from_list(sched, [1e18, 1e18 + 128])
+        edges, counts = ds.histogram(4)  # interior edges collapse
+        assert sum(counts) == 2
