@@ -368,6 +368,13 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
             if st.count == 0:
                 raise ValueError("histogram of an empty dataset")
             lo, hi = st.min, st.max
+            if not (np.isfinite(lo) and np.isfinite(hi)):
+                # DoubleRDDFunctions.histogram parity: an infinite/NaN
+                # range has no meaningful even buckets -- raise, never
+                # fabricate a distribution
+                raise ValueError(
+                    f"histogram range is not finite: [{lo}, {hi}]"
+                )
             edges = [
                 lo + (hi - lo) * i / buckets for i in range(buckets + 1)
             ]
@@ -378,7 +385,10 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
             if lo == hi or any(
                 a >= b for a, b in zip(edges, edges[1:])
             ):
-                edges = [lo + i for i in range(buckets + 1)]
+                # constant (or unresolvably narrow) data: one occupied
+                # bucket with edges spaced representably at lo's magnitude
+                span = max(1.0, abs(lo) * 1e-9)
+                edges = [lo + span * i for i in range(buckets + 1)]
                 counts = [0] * buckets
                 counts[0] = int(st.count)
                 return edges, counts
@@ -838,8 +848,15 @@ class StatCounter:
         self.count += 1
         self.mean += delta / self.count
         self._m2 += delta * (x - self.mean)
-        self.min = min(self.min, x)
-        self.max = max(self.max, x)
+        if x != x:
+            # NaN poisons min/max like the moments (StatCounter parity:
+            # Java's Math.min propagates NaN; Python's min() would
+            # silently skip it and report an inconsistent clean range)
+            self.min = float("nan")
+            self.max = float("nan")
+        else:
+            self.min = min(self.min, x)
+            self.max = max(self.max, x)
         return self
 
     def merge_stats(self, other: "StatCounter") -> "StatCounter":
@@ -857,8 +874,12 @@ class StatCounter:
         self.mean += delta * other.count / total
         self._m2 += other._m2 + delta * delta * self.count * other.count / total
         self.count = total
-        self.min = min(self.min, other.min)
-        self.max = max(self.max, other.max)
+        if other.min != other.min or self.min != self.min:
+            self.min = float("nan")
+            self.max = float("nan")
+        else:
+            self.min = min(self.min, other.min)
+            self.max = max(self.max, other.max)
         return self
 
     @property

@@ -36,6 +36,7 @@ from asyncframework_tpu.ml.pipeline import PipelineModel
 from asyncframework_tpu.ml.recommendation import ALSModel
 from asyncframework_tpu.ml.tree import DecisionTreeModel
 from asyncframework_tpu.ml.word2vec import Word2VecModel
+from asyncframework_tpu.graph.algorithms import SVDPlusPlusModel
 
 
 def _tree_payload(t: DecisionTreeModel, prefix: str) -> Dict[str, np.ndarray]:
@@ -117,6 +118,12 @@ def _model_payload(model: Any) -> Dict[str, Any]:
     elif isinstance(model, Word2VecModel):
         payload["vocab"] = np.asarray(model.vocab, dtype=np.str_)
         payload["vectors"] = np.asarray(model.vectors)
+    elif isinstance(model, SVDPlusPlusModel):
+        payload["user_vectors"] = np.asarray(model.user_vectors)
+        payload["item_vectors"] = np.asarray(model.item_vectors)
+        payload["user_bias"] = np.asarray(model.user_bias)
+        payload["item_bias"] = np.asarray(model.item_bias)
+        payload["mean"] = np.float64(model.mean)
     elif isinstance(model, SoftmaxRegressionModel):
         payload["W"] = model.W
         payload["b"] = model.b
@@ -287,6 +294,14 @@ def _model_restore(z: Dict[str, Any]) -> Any:
         return Word2VecModel(
             vocab=[str(w) for w in z["vocab"]],
             vectors=np.asarray(z["vectors"]),
+        )
+    if cls == "SVDPlusPlusModel":
+        return SVDPlusPlusModel(
+            user_vectors=np.asarray(z["user_vectors"]),
+            item_vectors=np.asarray(z["item_vectors"]),
+            user_bias=np.asarray(z["user_bias"]),
+            item_bias=np.asarray(z["item_bias"]),
+            mean=float(z["mean"]),
         )
     if cls == "SoftmaxRegressionModel":
         return SoftmaxRegressionModel(

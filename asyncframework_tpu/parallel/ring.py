@@ -206,10 +206,19 @@ def ring_attention(
 
 
 def ulysses_attention(
-    q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False
+    q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False,
+    block_kernel: str = "xla",
 ):
     """All-to-all sequence parallelism (Ulysses-style): reshard seq->heads,
-    attend over the full sequence per local head group, reshard back."""
+    attend over the full sequence per local head group, reshard back.
+
+    ``block_kernel="pallas"`` runs each head group's full-sequence
+    attention through :func:`~asyncframework_tpu.ops.pallas_kernels.
+    chunk_attention` (normalizing its (o, l) stats -- a single block IS
+    full softmax attention) instead of the XLA reference path.
+    """
+    if block_kernel not in ("xla", "pallas"):
+        raise ValueError("block_kernel must be 'xla' or 'pallas'")
     n_dev = mesh.shape[axis]
     h = q.shape[2]
     if h % n_dev:
@@ -232,6 +241,7 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None),
+        check_vma=block_kernel != "pallas",  # see ring_attention
     )
     def ulysses(ql, kl, vl):
         # (B, T/P, H, D) --all_to_all--> (B, T, H/P, D)
@@ -246,7 +256,23 @@ def ulysses_attention(
             )
 
         qh, kh, vh = seq_to_heads(ql), seq_to_heads(kl), seq_to_heads(vl)
-        oh = reference_attention(qh, kh, vh, causal=causal)
+        if block_kernel == "pallas":
+            from asyncframework_tpu.ops.pallas_kernels import chunk_attention
+
+            tq, tk = qh.shape[1], kh.shape[1]
+            mask = (
+                jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+                if causal else None
+            )
+            o, _m, l = chunk_attention(
+                qh, kh, vh, mask,
+                interpret=jax.default_backend() != "tpu",
+            )
+            # one block covers the whole sequence: normalizing by l IS the
+            # full softmax
+            oh = (o / l.transpose(0, 2, 1)[..., None]).astype(qh.dtype)
+        else:
+            oh = reference_attention(qh, kh, vh, causal=causal)
         return heads_to_seq(oh)
 
     return ulysses(q, k, v)

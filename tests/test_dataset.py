@@ -394,3 +394,21 @@ class TestStatsAndHistogram:
         ds = DistributedDataset.from_list(sched, [1e18, 1e18 + 128])
         edges, counts = ds.histogram(4)  # interior edges collapse
         assert sum(counts) == 2
+
+    def test_histogram_rejects_nonfinite_range(self, sched):
+        ds = DistributedDataset.from_list(sched, [1.0, 2.0, float("inf")])
+        with pytest.raises(ValueError, match="not finite"):
+            ds.histogram(3)
+
+    def test_stats_nan_poisons_min_max(self, sched):
+        st = DistributedDataset.from_list(
+            sched, [1.0, float("nan"), 5.0]
+        ).stats()
+        assert st.count == 3
+        assert st.min != st.min and st.max != st.max  # NaN, like the mean
+
+    def test_degenerate_edges_stay_ascending(self, sched):
+        ds = DistributedDataset.from_list(sched, [1e18, 1e18 + 128])
+        edges, counts = ds.histogram(4)
+        assert all(a < b for a, b in zip(edges, edges[1:]))
+        assert sum(counts) == 2

@@ -288,3 +288,17 @@ class TestReviewRegressions:
         with pytest.raises(ValueError):
             svd_plus_plus([0, 70], [0, 1], [1.0, 2.0], num_users=50,
                           num_iterations=1)
+
+
+class TestSVDPPPersistence:
+    def test_roundtrip(self, tmp_path):
+        from asyncframework_tpu.graph import svd_plus_plus
+        from asyncframework_tpu.ml import load_model, save_model
+
+        m = svd_plus_plus([0, 0, 1], [0, 1, 1], [5.0, 1.0, 4.0],
+                          rank=2, num_iterations=50)
+        p = save_model(m, tmp_path / "svdpp")
+        m2 = load_model(p)
+        np.testing.assert_allclose(
+            m.predict([0, 1], [0, 1]), m2.predict([0, 1], [0, 1])
+        )

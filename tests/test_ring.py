@@ -186,3 +186,21 @@ class TestChunkAttentionKernel:
             np.asarray(jnp.einsum("bhqk,bkhd->bqhd", p, v)),
             rtol=1e-4, atol=1e-5,
         )
+
+
+class TestUlyssesPallas:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_matches_reference(self, rng, sp_mesh, causal):
+        from asyncframework_tpu.parallel import ulysses_attention
+
+        q, k, v = (
+            rng.normal(size=(2, 32, 8, 16)).astype(np.float32)
+            for _ in range(3)
+        )
+        got = ulysses_attention(
+            q, k, v, sp_mesh, causal=causal, block_kernel="pallas"
+        )
+        want = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
