@@ -86,13 +86,21 @@ class EventLogReader:
         except EOFError:
             return
 
-    def replay(self) -> Iterator[Event]:
+    def replay(self, strict: bool = True) -> Iterator[Event]:
+        """Yield events; with ``strict=False`` a torn tail (crash mid-write)
+        ends the replay at the last valid line instead of raising -- the
+        history server's inspect-a-dead-run case."""
         with _open_log(self.path, "r") as f:
             for line in self._lines(f):
                 line = line.strip()
                 if not line:
                     continue
-                rec = json.loads(line)
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    if strict:
+                        raise
+                    return  # torn tail: the valid prefix stands
                 name = rec.pop("event", None)
                 cls = EVENT_TYPES.get(name)
                 if cls is None:

@@ -1,0 +1,126 @@
+"""History server: render every event log in a directory to browsable HTML.
+
+Parity: ``deploy/history/FsHistoryProvider.scala`` -- the reference's
+history server watches a log directory and serves past applications' UIs.
+The TPU build keeps the capability without the daemon: one command scans
+the directory, renders a per-run report (``metrics/report.py``) for every
+JSONL(.gz) event log, and writes an ``index.html`` linking them with
+summary rows -- a static history "server" viewable from any file browser.
+
+CLI: ``bin/async-history <log_dir> [out_dir]`` (defaults
+``out_dir = <log_dir>/history``).
+"""
+
+from __future__ import annotations
+
+import html
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional, Tuple, Union
+
+from asyncframework_tpu.metrics.bus import GradientMerged, JobStart
+from asyncframework_tpu.metrics.eventlog import EventLogReader
+from asyncframework_tpu.metrics.report import render_report
+
+_LOG_SUFFIXES = (".jsonl", ".jsonl.gz")
+
+
+def _is_event_log(p: Path) -> bool:
+    name = p.name
+    return any(name.endswith(sfx) for sfx in _LOG_SUFFIXES)
+
+
+def _scan(path: Path):
+    """ONE tolerant replay: (events, merges, jobs) -- the same pass feeds
+    both the index row and the report render.  A torn tail (crash
+    mid-write) keeps the valid prefix (``strict=False``); only a file that
+    yields nothing readable at all is flagged unreadable."""
+    events = []
+    merges = jobs = 0
+    try:
+        for ev in EventLogReader(path).replay(strict=False):
+            events.append(ev)
+            if isinstance(ev, GradientMerged):
+                merges += 1
+            elif isinstance(ev, JobStart):
+                jobs += 1
+    except Exception:
+        return None, -1, -1  # foreign/binary file: listed, unreadable
+    if not events:
+        return None, -1, -1
+    return events, merges, jobs
+
+
+def build_history(
+    log_dir: Union[str, Path],
+    out_dir: Optional[Union[str, Path]] = None,
+) -> Path:
+    """Render all event logs under ``log_dir``; returns the index path."""
+    log_dir = Path(log_dir)
+    if not log_dir.is_dir():
+        raise ValueError(f"{log_dir} is not a directory")
+    out_dir = Path(out_dir) if out_dir is not None else log_dir / "history"
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    logs = sorted(
+        (p for p in log_dir.iterdir() if _is_event_log(p)),
+        key=lambda p: p.stat().st_mtime,
+        reverse=True,
+    )
+    rows: List[str] = []
+    for p in logs:
+        stem = p.name
+        for sfx in _LOG_SUFFIXES:
+            if stem.endswith(sfx):
+                stem = stem[: -len(sfx)]
+                break
+        # report name from the FULL filename: "run.jsonl" and
+        # "run.jsonl.gz" must not collide, and "index.jsonl" must not
+        # render onto the index itself
+        report_name = f"{p.name}.html"
+        events, merges, jobs = _scan(p)
+        if events is not None:
+            render_report(
+                p, out_dir / report_name, title=f"run: {stem}",
+                events=events,
+            )
+            link = f'<a href="{html.escape(report_name)}">{html.escape(stem)}</a>'
+            status = f"{merges} updates, {jobs} jobs"
+        else:
+            link = html.escape(stem)
+            status = "unreadable"
+        mtime = time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(p.stat().st_mtime)
+        )
+        rows.append(
+            f"<tr><td>{link}</td><td>{mtime}</td><td>{status}</td></tr>"
+        )
+
+    index = out_dir / "index.html"
+    index.write_text(
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<title>asyncframework-tpu history</title>"
+        "<style>body{font-family:sans-serif;margin:2em}"
+        "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+        "padding:4px 10px}</style></head><body>"
+        f"<h1>Run history ({len(logs)} logs)</h1>"
+        "<table><thead><tr><th>run</th><th>modified</th><th>summary</th>"
+        "</tr></thead><tbody>" + "".join(rows) + "</tbody></table>"
+        "</body></html>"
+    )
+    return index
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not 1 <= len(argv) <= 2:
+        print("usage: async-history <log_dir> [out_dir]", file=sys.stderr)
+        return 2
+    index = build_history(*argv)
+    print(index)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

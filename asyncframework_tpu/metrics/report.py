@@ -80,11 +80,14 @@ def render_report(
     event_log_path: Union[str, Path],
     out_path: Optional[Union[str, Path]] = None,
     title: str = "asyncframework-tpu run report",
+    events: Optional[list] = None,
 ) -> str:
     """Build the HTML report; optionally write it to ``out_path``.
 
     Sections: run summary, objective-vs-iteration curve, staleness
-    histogram, per-worker task table, failures.
+    histogram, per-worker task table, failures.  ``events`` (pre-replayed)
+    skips re-reading the log -- the history index scans once and reuses
+    the same pass here.
     """
     reader = EventLogReader(event_log_path)
     merges: List[GradientMerged] = []
@@ -94,7 +97,7 @@ def render_report(
     jobs = 0
     job_fail = 0
     rounds = 0
-    for ev in reader.replay():
+    for ev in (events if events is not None else reader.replay()):
         if isinstance(ev, GradientMerged):
             merges.append(ev)
         elif isinstance(ev, ModelSnapshot):

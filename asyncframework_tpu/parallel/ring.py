@@ -207,15 +207,15 @@ def ring_attention(
 
 def ulysses_attention(
     q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False,
-    block_kernel: str = "xla",
+    block_kernel: str = "xla", pallas_block: int = 512,
 ):
     """All-to-all sequence parallelism (Ulysses-style): reshard seq->heads,
     attend over the full sequence per local head group, reshard back.
 
-    ``block_kernel="pallas"`` runs each head group's full-sequence
-    attention through :func:`~asyncframework_tpu.ops.pallas_kernels.
-    chunk_attention` (normalizing its (o, l) stats -- a single block IS
-    full softmax attention) instead of the XLA reference path.
+    ``block_kernel="pallas"`` folds the full-sequence attention through
+    :func:`~asyncframework_tpu.ops.pallas_kernels.chunk_attention` in
+    ``pallas_block``-sized K/V blocks (VMEM-bounded) merged by the shared
+    flash rescale, instead of the XLA reference path.
     """
     if block_kernel not in ("xla", "pallas"):
         raise ValueError("block_kernel must be 'xla' or 'pallas'")
@@ -260,16 +260,25 @@ def ulysses_attention(
             from asyncframework_tpu.ops.pallas_kernels import chunk_attention
 
             tq, tk = qh.shape[1], kh.shape[1]
-            mask = (
-                jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-                if causal else None
-            )
-            o, _m, l = chunk_attention(
-                qh, kh, vh, mask,
-                interpret=jax.default_backend() != "tpu",
-            )
-            # one block covers the whole sequence: normalizing by l IS the
-            # full softmax
+            full_mask = jnp.tril(
+                jnp.ones((tq, tk), bool), k=tk - tq
+            ) if causal else None
+            # fold K/V in VMEM-sized blocks through the shared flash
+            # rescale -- one monolithic (Tq, Tk) block would not fit VMEM
+            # at exactly the long sequences this module targets
+            blk = min(tk, max(int(pallas_block), 8))
+            b, _, hl, dh = qh.shape
+            m = jnp.full((b, hl, tq), _NEG, jnp.float32)
+            l = jnp.zeros((b, hl, tq), jnp.float32)
+            o = jnp.zeros(qh.shape, jnp.float32)
+            interp = jax.default_backend() != "tpu"
+            for s in range(0, tk, blk):
+                e = min(s + blk, tk)
+                mask_b = None if full_mask is None else full_mask[:, s:e]
+                o_b, m_b, l_b = chunk_attention(
+                    qh, kh[:, s:e], vh[:, s:e], mask_b, interpret=interp
+                )
+                m, l, o = _merge_stats(m, l, o, m_b, l_b, o_b)
             oh = (o / l.transpose(0, 2, 1)[..., None]).astype(qh.dtype)
         else:
             oh = reference_attention(qh, kh, vh, causal=causal)

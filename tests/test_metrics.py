@@ -262,3 +262,66 @@ def test_polling_loop_with_manual_clock():
         time.sleep(0.01)
     ms.stop()
     assert len(seen) >= 3
+
+
+class TestHistory:
+    def test_history_index_over_real_runs(self, tmp_path, devices8, tiny_problem):
+        """FsHistoryProvider parity: two solver runs' event logs render to
+        per-run reports plus an index; a torn log is listed as unreadable."""
+        from asyncframework_tpu.metrics.history import build_history
+        from asyncframework_tpu.solvers import ASGD, SolverConfig
+
+        X, y, _ = tiny_problem
+        logs = tmp_path / "logs"
+        logs.mkdir()
+        for i, name in enumerate(("run-a", "run-b")):
+            cfg = SolverConfig(
+                num_workers=8, num_iterations=40, gamma=1.0,
+                taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5,
+                printer_freq=20, coeff=0.0, seed=42 + i,
+                calibration_iters=5, run_timeout_s=60.0,
+                event_log=str(logs / f"{name}.jsonl"),
+            )
+            ASGD(X, y, cfg, devices=devices8).run()
+        (logs / "torn.jsonl").write_text("{not json")
+        index = build_history(logs)
+        html_text = index.read_text()
+        assert "run-a" in html_text and "run-b" in html_text
+        assert "unreadable" in html_text
+        assert (index.parent / "run-a.jsonl.html").exists()
+        assert (index.parent / "run-b.jsonl.html").exists()
+        assert "updates" in html_text
+
+    def test_history_cli_usage(self, tmp_path, capsys):
+        from asyncframework_tpu.metrics.history import main
+
+        assert main([]) == 2
+        d = tmp_path / "empty"
+        d.mkdir()
+        assert main([str(d)]) == 0
+
+
+    def test_torn_tail_renders_valid_prefix(self, tmp_path, devices8,
+                                            tiny_problem):
+        """A crash-torn log (valid prefix + partial last line) must still
+        render a report from the prefix, not show as unreadable."""
+        from asyncframework_tpu.metrics.history import build_history
+        from asyncframework_tpu.solvers import ASGD, SolverConfig
+
+        X, y, _ = tiny_problem
+        logs = tmp_path / "logs"
+        logs.mkdir()
+        log = logs / "crashed.jsonl"
+        cfg = SolverConfig(
+            num_workers=8, num_iterations=30, gamma=1.0, taw=2**31 - 1,
+            batch_rate=0.3, bucket_ratio=0.5, printer_freq=10, coeff=0.0,
+            seed=1, calibration_iters=5, run_timeout_s=60.0,
+            event_log=str(log),
+        )
+        ASGD(X, y, cfg, devices=devices8).run()
+        with open(log, "a") as f:
+            f.write('{"event": "task_end", "worker')  # torn mid-write
+        index = build_history(logs)
+        html_text = index.read_text()
+        assert "unreadable" not in html_text
+        assert (index.parent / "crashed.jsonl.html").exists()

@@ -204,3 +204,22 @@ class TestUlyssesPallas:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
         )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_blockwise_fold(self, rng, sp_mesh, causal):
+        """pallas_block smaller than the sequence exercises the K/V fold
+        loop (the VMEM-bounded path real long sequences take)."""
+        from asyncframework_tpu.parallel import ulysses_attention
+
+        q, k, v = (
+            rng.normal(size=(1, 32, 8, 8)).astype(np.float32)
+            for _ in range(3)
+        )
+        got = ulysses_attention(
+            q, k, v, sp_mesh, causal=causal, block_kernel="pallas",
+            pallas_block=8,
+        )
+        want = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
