@@ -346,6 +346,13 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    if os.environ.get("ASYNCTPU_FORCE_CPU"):
+        # the local-cluster launcher's test-rig mode: the env var alone
+        # cannot force CPU (the image's sitecustomize latches the TPU
+        # plugin first); the config API set before any device touch can
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     args = build_parser().parse_args(argv)
     conf = parse_conf_overlays(args.conf)
     summary = run_driver(args, conf)

@@ -1,0 +1,143 @@
+"""Local multi-process cluster launcher.
+
+Parity: ``deploy/LocalSparkCluster.scala:36`` -- the reference's
+single-machine REAL cluster (actual Master/Worker processes, actual RPC,
+no fake backends), used both as a test rig and a demo.  The TPU-native
+analog: N OS processes on one machine joined through ``jax.distributed``
+(loopback gRPC = the DCN control plane), each seeing the global device set;
+the same mesh/``shard_map`` code that rides ICI in a slice rides the
+process boundary here.
+
+Every process runs the stock CLI (``asyncframework_tpu.cli``) with the
+bring-up env vars set (``ASYNCTPU_COORDINATOR`` / ``ASYNCTPU_NUM_PROCESSES``
+/ ``ASYNCTPU_PROCESS_ID``), so a recipe that works single-process works on
+the cluster unchanged -- multi-process supports the SPMD ``sgd-mllib``
+driver (the async parameter-server drivers are single-host by design; the
+driver IS the server).
+
+CLI: ``bin/async-cluster <N> [--devices-per-process K] -- <cli args...>``
+e.g. ``bin/async-cluster 2 -- sgd-mllib synthetic synthetic 64 4096 8 100
+1.0 0 0.5 0.5 25 0 42``
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_local_cluster(
+    num_processes: int,
+    cli_args: List[str],
+    devices_per_process: int = 2,
+    timeout_s: float = 300.0,
+    platform: str = "cpu",
+) -> Tuple[int, List[str]]:
+    """Spawn ``num_processes`` CLI processes joined via ``jax.distributed``.
+
+    Returns ``(worst_returncode, [process-0 stdout lines])``.  Process 0's
+    output is the run's output (every process computes identical results --
+    SPMD); other processes' stdout is suppressed unless they fail.
+
+    ``platform="cpu"`` forces ``devices_per_process`` virtual CPU devices
+    per process (the LocalSparkCluster test-rig mode, no TPU needed); pass
+    ``platform=None`` on real multi-host TPU deployments where each
+    process owns its local chips.
+    """
+    if num_processes < 1:
+        raise ValueError("num_processes must be >= 1")
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    procs = []
+    for pid in range(num_processes):
+        env = dict(os.environ)
+        env["ASYNCTPU_COORDINATOR"] = coord
+        env["ASYNCTPU_NUM_PROCESSES"] = str(num_processes)
+        env["ASYNCTPU_PROCESS_ID"] = str(pid)
+        if platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["ASYNCTPU_FORCE_CPU"] = "1"
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{devices_per_process}"
+                ).strip()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "asyncframework_tpu.cli", *cli_args],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    # drain every process CONCURRENTLY: a sequential communicate() would
+    # let a later process block on its full 64KB stdout pipe while we wait
+    # on an earlier one stuck in the distributed barrier behind it
+    import threading
+
+    results: List[Optional[Tuple[str, str]]] = [None] * num_processes
+
+    def drain(pid: int, p) -> None:
+        try:
+            results[pid] = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            results[pid] = p.communicate()
+
+    threads = [
+        threading.Thread(target=drain, args=(pid, p), daemon=True)
+        for pid, p in enumerate(procs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    outs: List[str] = []
+    worst = 0
+    for pid, p in enumerate(procs):
+        out, err = results[pid] if results[pid] is not None else ("", "")
+        if p.returncode:
+            worst = p.returncode
+            print(f"--- process {pid} rc={p.returncode} stderr tail ---",
+                  file=sys.stderr)
+            print("\n".join(err.splitlines()[-15:]), file=sys.stderr)
+        if pid == 0:
+            outs = out.splitlines()
+    return worst, outs
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if not argv or not argv[0].isdigit():
+        print(
+            "usage: async-cluster <num_processes> "
+            "[--devices-per-process K] -- <cli args...>",
+            file=sys.stderr,
+        )
+        return 2
+    n = int(argv.pop(0))
+    dpp = 2
+    if argv and argv[0] == "--devices-per-process":
+        argv.pop(0)
+        if not argv or not argv[0].isdigit():
+            print("--devices-per-process needs an integer", file=sys.stderr)
+            return 2
+        dpp = int(argv.pop(0))
+    if argv and argv[0] == "--":
+        argv.pop(0)
+    rc, out = launch_local_cluster(n, argv, devices_per_process=dpp)
+    for line in out:
+        print(line)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,10 +81,16 @@ def build_history(
         report_name = f"{p.name}.html"
         events, merges, jobs = _scan(p)
         if events is not None:
-            render_report(
-                p, out_dir / report_name, title=f"run: {stem}",
-                events=events,
-            )
+            try:
+                render_report(
+                    p, out_dir / report_name, title=f"run: {stem}",
+                    events=events,
+                )
+            except Exception:
+                # schema-drifted field VALUES can pass replay but break
+                # the render; one bad log must not abort the whole index
+                events = None
+        if events is not None:
             link = f'<a href="{html.escape(report_name)}">{html.escape(stem)}</a>'
             status = f"{merges} updates, {jobs} jobs"
         else:

@@ -260,25 +260,43 @@ def ulysses_attention(
             from asyncframework_tpu.ops.pallas_kernels import chunk_attention
 
             tq, tk = qh.shape[1], kh.shape[1]
-            full_mask = jnp.tril(
-                jnp.ones((tq, tk), bool), k=tk - tq
-            ) if causal else None
             # fold K/V in VMEM-sized blocks through the shared flash
-            # rescale -- one monolithic (Tq, Tk) block would not fit VMEM
-            # at exactly the long sequences this module targets
+            # rescale, as a lax.scan so the PROGRAM stays O(1) in sequence
+            # length (a Python loop would inline tk/blk pallas calls), and
+            # per-block masks from index arithmetic so nothing O(Tq*Tk)
+            # ever materializes
             blk = min(tk, max(int(pallas_block), 8))
+            pad_k = (-tk) % blk
+            kh_p = jnp.pad(kh, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+            vh_p = jnp.pad(vh, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+            nb = (tk + pad_k) // blk
             b, _, hl, dh = qh.shape
-            m = jnp.full((b, hl, tq), _NEG, jnp.float32)
-            l = jnp.zeros((b, hl, tq), jnp.float32)
-            o = jnp.zeros(qh.shape, jnp.float32)
+            q_pos = jnp.arange(tq)
             interp = jax.default_backend() != "tpu"
-            for s in range(0, tk, blk):
-                e = min(s + blk, tk)
-                mask_b = None if full_mask is None else full_mask[:, s:e]
+
+            def fold_block(carry, i):
+                m, l, o = carry
+                kb = jax.lax.dynamic_slice_in_dim(kh_p, i * blk, blk, 1)
+                vb = jax.lax.dynamic_slice_in_dim(vh_p, i * blk, blk, 1)
+                k_pos = i * blk + jnp.arange(blk)
+                valid = k_pos[None, :] < tk  # padded K columns masked off
+                if causal:
+                    mask_b = (q_pos[:, None] >= k_pos[None, :]) & valid
+                else:
+                    mask_b = jnp.broadcast_to(valid, (tq, blk))
                 o_b, m_b, l_b = chunk_attention(
-                    qh, kh[:, s:e], vh[:, s:e], mask_b, interpret=interp
+                    qh, kb, vb, mask_b, interpret=interp
                 )
-                m, l, o = _merge_stats(m, l, o, m_b, l_b, o_b)
+                return _merge_stats(m, l, o, m_b, l_b, o_b), None
+
+            init = (
+                jnp.full((b, hl, tq), _NEG, jnp.float32),
+                jnp.zeros((b, hl, tq), jnp.float32),
+                jnp.zeros(qh.shape, jnp.float32),
+            )
+            (m, l, o), _ = jax.lax.scan(
+                fold_block, init, jnp.arange(nb)
+            )
             oh = (o / l.transpose(0, 2, 1)[..., None]).astype(qh.dtype)
         else:
             oh = reference_attention(qh, kh, vh, causal=causal)

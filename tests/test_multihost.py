@@ -114,3 +114,43 @@ def test_two_process_distributed_training_matches_local():
     np.testing.assert_allclose(
         results[0]["final_loss"], float(losses[-1]), rtol=1e-5
     )
+
+
+class TestLocalClusterLauncher:
+    def test_two_process_cluster_matches_single(self):
+        """LocalSparkCluster parity: the launcher's 2-process run produces
+        the same recipe output as a single-process run of the same CLI."""
+        import json
+
+        from asyncframework_tpu.cluster import launch_local_cluster
+
+        recipe = ["--quiet", "sgd-mllib", "synthetic", "synthetic",
+                  "16", "512", "4", "30", "1.0", "0", "0.5", "0.5",
+                  "15", "0", "42"]
+        rc, out = launch_local_cluster(
+            2, recipe, devices_per_process=2, timeout_s=240.0
+        )
+        assert rc == 0
+        summary = json.loads(
+            [ln for ln in out if ln.startswith("{")][-1]
+        )
+        assert summary["driver"] == "sgd-mllib"
+        assert summary["iterations"] == 30
+        rc1, out1 = launch_local_cluster(
+            1, recipe, devices_per_process=4, timeout_s=240.0
+        )
+        assert rc1 == 0
+        s1 = json.loads([ln for ln in out1 if ln.startswith("{")][-1])
+        # same global device count (2x2 vs 1x4) and same seed -- but the
+        # cross-process psum reduces in a different float order, and 30
+        # gamma=1.0 steps amplify the ulp-level drift; both runs must
+        # converge into the same band, not match bit-for-bit
+        a, b = s1["final_objective"], summary["final_objective"]
+        assert a < 0.5 and b < 0.5  # both converged (initial ~ 16)
+        assert abs(a - b) / max(a, b) < 0.3
+
+    def test_usage_errors(self):
+        from asyncframework_tpu.cluster import main
+
+        assert main([]) == 2
+        assert main(["notanint"]) == 2
