@@ -103,6 +103,10 @@ from asyncframework_tpu.ml.pipeline import (
     r2_scorer,
     train_test_split,
 )
+from asyncframework_tpu.ml.streaming_models import (
+    StreamingLinearRegression,
+    StreamingLogisticRegression,
+)
 from asyncframework_tpu.ml.word2vec import Word2Vec, Word2VecModel
 from asyncframework_tpu.ml.persistence import (
     load_model,
@@ -198,4 +202,6 @@ __all__ = [
     "ElementwiseProduct",
     "RankingMetrics",
     "MultilabelMetrics",
+    "StreamingLinearRegression",
+    "StreamingLogisticRegression",
 ]

@@ -302,3 +302,92 @@ class TestSVDPPPersistence:
         np.testing.assert_allclose(
             m.predict([0, 1], [0, 1]), m2.predict([0, 1], [0, 1])
         )
+
+
+class TestStreamingRegression:
+    def _stream(self, batches):
+        from asyncframework_tpu.streaming import StreamingContext
+        from asyncframework_tpu.utils.clock import ManualClock
+
+        ssc = StreamingContext(batch_interval_ms=100, clock=ManualClock())
+        return ssc, ssc.queue_stream(batches)
+
+    def test_linear_tracks_drifting_weights(self):
+        """The named behavior: the truth CHANGES mid-stream and the
+        warm-started model must follow it to the new target."""
+        from asyncframework_tpu.ml import StreamingLinearRegression
+
+        rs = np.random.default_rng(0)
+        d = 8
+        w_a = rs.normal(size=(d,)).astype(np.float32)
+        w_b = rs.normal(size=(d,)).astype(np.float32)
+        batches = []
+        for t in range(24):
+            w_true = w_a if t < 12 else w_b  # drift at the midpoint
+            X = rs.normal(size=(200, d)).astype(np.float32)
+            batches.append((X, (X @ w_true).astype(np.float32)))
+        ssc, stream = self._stream(batches)
+        model = StreamingLinearRegression(step_size=0.5, num_iterations=20)
+        model.train_on(stream)
+        for k in range(1, 13):
+            ssc.generate_batch(k * 100)
+        np.testing.assert_allclose(
+            model.latest_weights(), w_a, rtol=0.05, atol=0.02
+        )
+        for k in range(13, 25):
+            ssc.generate_batch(k * 100)
+        np.testing.assert_allclose(
+            model.latest_weights(), w_b, rtol=0.05, atol=0.02
+        )
+
+    def test_logistic_predict_on_uses_interval_model(self):
+        from asyncframework_tpu.ml import StreamingLogisticRegression
+
+        rs = np.random.default_rng(1)
+        d = 6
+        w_true = np.zeros(d, np.float32)
+        w_true[0] = 4.0
+        train = []
+        for _ in range(10):
+            X = rs.normal(size=(300, d)).astype(np.float32)
+            y = (X @ w_true > 0).astype(np.float32)
+            train.append((X, y))
+        ssc, stream = self._stream(train)
+        model = StreamingLogisticRegression(step_size=1.0, num_iterations=20)
+        model.set_initial_weights(np.zeros(d, np.float32))
+        model.train_on(stream)
+        preds = []
+        Xq = rs.normal(size=(100, d)).astype(np.float32)
+        pred_stream = ssc.queue_stream([Xq] * 10)
+        model.predict_on(pred_stream).foreach_batch(
+            lambda _t, p: preds.append(np.asarray(p))
+        )
+        for k in range(1, 11):
+            ssc.generate_batch(k * 100)
+        want = (Xq @ w_true > 0).astype(np.int32)
+        acc = (preds[-1] == want).mean()
+        assert acc > 0.95
+
+    def test_warm_start_and_validation(self):
+        from asyncframework_tpu.ml import StreamingLinearRegression
+
+        m = StreamingLinearRegression()
+        with pytest.raises(ValueError):
+            m.latest_weights()
+        m.set_initial_weights(np.ones(3, np.float32))
+        np.testing.assert_allclose(m.latest_weights(), [1, 1, 1])
+
+
+    def test_predict_on_requires_initialized_model(self):
+        from asyncframework_tpu.ml import StreamingLinearRegression
+
+        ssc, stream = self._stream([np.zeros((4, 3), np.float32)])
+        with pytest.raises(ValueError, match="not initialized"):
+            StreamingLinearRegression().predict_on(stream)
+
+    def test_malformed_batch_raises(self):
+        from asyncframework_tpu.ml import StreamingLinearRegression
+
+        m = StreamingLinearRegression()
+        with pytest.raises(ValueError, match="feature matrices"):
+            m._update((np.zeros(5, np.float32), np.zeros(5, np.float32)))
