@@ -281,12 +281,17 @@ class StreamingKMeans:
         discounted = self.weights * a
         new_w = discounted + counts
         safe = np.maximum(new_w, 1e-12)
-        self.centers = (
+        updated = (
             (self.centers * discounted[:, None] + sums) / safe[:, None]
         ).astype(np.float32)
+        # only move centers that actually received points this batch: the
+        # reference updates from pointStats entries only, so a zero-weight
+        # user-supplied center with no points stays where it was put
+        self.centers = np.where((counts > 0)[:, None], updated, self.centers)
         self.weights = new_w
-        # re-seed dying clusters: split the heaviest (reference behavior)
-        dead = self.weights < 1e-8
+        # re-seed dying clusters: split the heaviest; relative threshold
+        # matches StreamingKMeans.scala (minWeight < 1e-8 * maxWeight)
+        dead = self.weights < 1e-8 * self.weights.max()
         if dead.any() and (~dead).any():
             heavy = int(np.argmax(self.weights))
             for j in np.nonzero(dead)[0]:

@@ -217,12 +217,15 @@ class ChiSqSelector:
         X = np.asarray(X)
         y = np.asarray(y)
         labels, li = np.unique(y, return_inverse=True)
-        stats = np.empty(X.shape[1])
+        # rank by p-value, not raw statistic: features with different numbers
+        # of distinct values have different degrees of freedom, and the
+        # reference sorts (p-value, index) ascending (ChiSqSelector.scala)
+        pvals = np.empty(X.shape[1])
         for j in range(X.shape[1]):
             vals, vi = np.unique(X[:, j], return_inverse=True)
             cont = np.zeros((len(vals), len(labels)), np.float64)
             np.add.at(cont, (vi, li), 1.0)
-            stats[j] = chi_sq_test_matrix(cont).statistic
-        k = min(self.num_top_features, stats.shape[0])
-        top = np.argsort(-stats, kind="stable")[:k]
+            pvals[j] = chi_sq_test_matrix(cont).p_value
+        k = min(self.num_top_features, pvals.shape[0])
+        top = np.argsort(pvals, kind="stable")[:k]
         return ChiSqSelectorModel(np.sort(top))

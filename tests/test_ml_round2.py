@@ -100,6 +100,28 @@ class TestStreamingKMeans:
         lab = skm.predict(np.array([[1.0], [9.0]], np.float32))
         assert lab[0] != lab[1]
 
+    def test_no_point_center_unchanged(self):
+        # a zero-weight user-supplied center that receives no points must
+        # stay put (reference updates only clusters present in pointStats)
+        skm = StreamingKMeans(k=2, decay_factor=0.5)
+        skm.set_initial_centers(
+            np.array([[0.0], [100.0]], np.float32), [1.0, 1.0]
+        )
+        skm.update(np.full((10, 1), 1.0, np.float32))  # all go to center 0
+        assert abs(float(skm.centers[1, 0]) - 100.0) < 1e-6
+
+    def test_dying_threshold_is_relative(self):
+        # check is minWeight < 1e-8 * maxWeight: a weight of 10 is "dying"
+        # next to a 1e10 heavyweight even though it passes any absolute bound
+        skm = StreamingKMeans(k=2, decay_factor=1.0)
+        skm.set_initial_centers(
+            np.array([[0.0], [5.0]], np.float32), [10.0, 1e10]
+        )
+        skm.update(np.array([[0.0], [5.0]], np.float32))
+        # cluster 0 was reseeded by splitting the heavy cluster
+        assert abs(float(skm.weights[0]) - float(skm.weights[1])) < 1e-3
+        assert abs(float(skm.centers[0, 0]) - 5.0) < 0.1
+
 
 class TestPrefixSpan:
     def test_spark_docs_example(self):
