@@ -62,6 +62,7 @@ class ShardedDataset:
         devices: Optional[Sequence] = None,
         seed: int = 42,
         noise: float = 0.01,
+        dtype=None,
     ) -> "ShardedDataset":
         """Synthesize a planted least-squares problem directly in HBM.
 
@@ -69,11 +70,21 @@ class ShardedDataset:
         PRNG on its own device (essential when the host link is slow -- and
         the TPU generates gigabytes/s anyway).  ``_host_X/_host_y`` stay None;
         host-side accessors raise.
+
+        ``dtype=jnp.bfloat16`` stores X in bf16 (half the HBM -- what lets
+        mnist8m's 8.1M x 784 fit a single v5e chip); rows are DRAWN in bf16
+        so no f32 copy of the shard ever materializes, and labels are
+        computed from the bf16-rounded rows with f32 accumulation so the
+        planted noise floor stays exactly ``noise**2``.  Labels and the
+        planted model stay f32.
         """
         import functools
 
         import jax.numpy as jnp
 
+        from asyncframework_tpu.ops.gradients import mm_f32
+
+        dtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
         obj = cls.__new__(cls)
         sizes = balanced_sizes(n, num_workers)
         obj.n, obj.d, obj.num_workers = n, d, num_workers
@@ -84,8 +95,12 @@ class ShardedDataset:
         @functools.partial(jax.jit, static_argnums=(2,))
         def gen_shard(key, w_true, size):
             kx, kn = jax.random.split(key)
-            Xp = jax.random.normal(kx, (size, d), jnp.float32) / jnp.sqrt(d)
-            yp = Xp @ w_true + noise * jax.random.normal(kn, (size,), jnp.float32)
+            Xp = jax.random.normal(kx, (size, d), dtype) / jnp.sqrt(d).astype(
+                dtype
+            )
+            yp = mm_f32(Xp, w_true) + noise * jax.random.normal(
+                kn, (size,), jnp.float32
+            )
             return Xp, yp
 
         # Domain-separate the data stream from the solvers' per-worker mask
@@ -112,6 +127,7 @@ class ShardedDataset:
         y: np.ndarray,
         num_workers: int,
         devices: Optional[Sequence] = None,
+        dtype=None,
     ):
         n = X.shape[0]
         if y.shape[0] != n:
@@ -127,9 +143,12 @@ class ShardedDataset:
         for w in range(num_workers):
             lo, hi = self.partition_cum[w], self.partition_cum[w + 1]
             dev = devs[w % len(devs)]
+            Xs = jax.device_put(X[lo:hi], dev)
+            if dtype is not None:
+                Xs = Xs.astype(dtype)  # cast on device: bf16 storage
             self.shards[w] = Shard(
                 worker_id=w,
-                X=jax.device_put(X[lo:hi], dev),
+                X=Xs,
                 y=jax.device_put(y[lo:hi], dev),
                 start=lo,
                 size=hi - lo,

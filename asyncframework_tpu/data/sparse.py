@@ -54,6 +54,77 @@ class SparseShardedDataset:
 
     is_sparse = True
 
+    @classmethod
+    def generate_on_device(
+        cls,
+        n: int,
+        d: int,
+        nnz_per_row: int,
+        num_workers: int,
+        devices: Optional[Sequence] = None,
+        seed: int = 42,
+        noise: float = 0.01,
+    ) -> "SparseShardedDataset":
+        """Synthesize a planted rcv1-shaped sparse problem directly in HBM.
+
+        Each row has ``nnz_per_row`` entries at uniform random columns with
+        values N(0, 1/nnz), so ``E[x x^T] = I/d`` -- the same conditioning as
+        the dense generator, which keeps step-size tuning commensurable
+        across bench configs.  Labels are ``x . w* + noise`` computed on
+        device.  Rows are padded to a lane multiple exactly like the CSR
+        path; padding slots carry ``col=0, val=0``.
+        """
+        import functools
+
+        import jax.numpy as jnp
+
+        obj = cls.__new__(cls)
+        sizes = balanced_sizes(n, num_workers)
+        obj.n, obj.d, obj.num_workers = n, int(d), num_workers
+        devs = list(devices) if devices is not None else jax.devices()
+        cum = np.concatenate([[0], np.cumsum(sizes)])
+        obj.partition_cum = [int(c) for c in cum]
+        K = _round_up(int(nnz_per_row))
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def gen_shard(key, w_true, size):
+            kc, kv, kn = jax.random.split(key, 3)
+            cols = jax.random.randint(kc, (size, K), 0, d, jnp.int32)
+            vals = jax.random.normal(kv, (size, K), jnp.float32) / jnp.sqrt(
+                float(nnz_per_row)
+            )
+            live = (jnp.arange(K) < nnz_per_row)[None, :]
+            cols = jnp.where(live, cols, 0)
+            vals = jnp.where(live, vals, 0.0)
+            yp = jnp.sum(vals * w_true[cols], axis=1) + noise * (
+                jax.random.normal(kn, (size,), jnp.float32)
+            )
+            return cols, vals, yp
+
+        obj.row_perm = np.arange(n)
+        root = jax.random.fold_in(jax.random.PRNGKey(seed), 0x53505253)  # "SPRS"
+        w_true = jax.random.normal(
+            jax.random.fold_in(root, 2**30), (d,), jnp.float32
+        )
+        obj.shards = {}
+        for w in range(num_workers):
+            dev = devs[w % len(devs)]
+            key = jax.device_put(jax.random.fold_in(root, w), dev)
+            cols, vals, yp = gen_shard(
+                key, jax.device_put(w_true, dev), sizes[w]
+            )
+            obj.shards[w] = SparseShard(
+                worker_id=w, cols=cols, vals=vals, y=yp,
+                start=obj.partition_cum[w], size=sizes[w],
+            )
+        return obj
+
+    #: warn when a shard's padded footprint exceeds its true nnz by this
+    #: factor AND the max/mean row-nnz ratio exceeds SKEW_RATIO -- one dense
+    #: outlier row multiplies the whole shard's HBM cost under padded ELL
+    PAD_OVERHEAD_WARN = 4.0
+    SKEW_RATIO_WARN = 8.0
+
     def __init__(
         self,
         indptr: np.ndarray,
@@ -63,7 +134,18 @@ class SparseShardedDataset:
         d: int,
         num_workers: int,
         devices: Optional[Sequence] = None,
+        nnz_partition: bool = False,
     ):
+        """``nnz_partition=True`` assigns rows to shards in row-nnz-sorted
+        order (a stable permutation, recorded in ``row_perm``) so each
+        shard's pad width tracks its own densest row instead of the global
+        outlier -- the skew guard's *fix*.  Statistically neutral for the
+        solvers (workers Bernoulli-sample within their shard either way);
+        ``start``/``partition_cum`` then index the permuted order, and
+        shard ``j``'s original row id is ``row_perm[start + j]``.  Without
+        it, a skewed matrix still loads but emits a detailed warning
+        (``skew_report``).
+        """
         n = len(indptr) - 1
         if y.shape[0] != n:
             raise ValueError(f"indptr implies {n} rows but y has {y.shape[0]}")
@@ -74,33 +156,88 @@ class SparseShardedDataset:
         self.partition_cum: List[int] = [int(c) for c in cum]
         self.shards: Dict[int, SparseShard] = {}
         indptr = np.asarray(indptr, np.int64)
+        all_nnz = indptr[1:] - indptr[:-1]
+        if nnz_partition:
+            self.row_perm = np.argsort(all_nnz, kind="stable")
+        else:
+            self.row_perm = np.arange(n)
+        y = np.asarray(y, np.float32)
         for w in range(num_workers):
             lo, hi = self.partition_cum[w], self.partition_cum[w + 1]
-            row_nnz = indptr[lo + 1 : hi + 1] - indptr[lo:hi]
+            rows = self.row_perm[lo:hi]
+            row_nnz = all_nnz[rows]
             K = _round_up(int(row_nnz.max()) if len(row_nnz) else 1)
             size = hi - lo
             cols = np.zeros((size, K), np.int32)
             vals = np.zeros((size, K), np.float32)
             # vectorized CSR -> ELL packing (a Python per-row loop would be
             # an interpreter-speed O(n) pass on exactly the rcv1-scale data
-            # this class exists for): destination (row, slot) of the shard's
-            # j-th nonzero is (its row, offset within its row)
-            a0, b0 = int(indptr[lo]), int(indptr[hi])
-            if b0 > a0:
-                rows = np.repeat(np.arange(size), row_nnz)
-                slots = np.arange(b0 - a0) - np.repeat(
-                    (indptr[lo:hi] - a0), row_nnz
+            # this class exists for): the shard's j-th nonzero comes from
+            # source position indptr[row]+slot and lands at (row, slot)
+            total = int(row_nnz.sum())
+            if total > 0:
+                dst_rows = np.repeat(np.arange(size), row_nnz)
+                slots = np.arange(total) - np.repeat(
+                    np.cumsum(row_nnz) - row_nnz, row_nnz
                 )
-                cols[rows, slots] = indices[a0:b0]
-                vals[rows, slots] = values[a0:b0]
+                src = np.repeat(indptr[rows], row_nnz) + slots
+                cols[dst_rows, slots] = indices[src]
+                vals[dst_rows, slots] = values[src]
             dev = devs[w % len(devs)]
             self.shards[w] = SparseShard(
                 worker_id=w,
                 cols=jax.device_put(cols, dev),
                 vals=jax.device_put(vals, dev),
-                y=jax.device_put(np.asarray(y[lo:hi], np.float32), dev),
+                y=jax.device_put(y[rows], dev),
                 start=lo,
                 size=size,
+            )
+        # the guard only *suggests* nnz_partition when it is off; with it on,
+        # residual padding is inherent (a dense row among light rows in the
+        # same shard) and re-warning would be noise
+        if not nnz_partition:
+            self._maybe_warn_skew(all_nnz)
+
+    # ----------------------------------------------------------- skew guard
+    def skew_report(self) -> Dict[str, float]:
+        """Padding-cost accounting: the true nnz, what padded ELL actually
+        occupies, and the worst per-shard max/mean row-nnz ratio."""
+        true_nnz = 0
+        padded = 0
+        worst_ratio = 0.0
+        for s in self.shards.values():
+            v = np.asarray(s.vals)
+            row_nnz = np.count_nonzero(v, axis=1)
+            true_nnz += int(row_nnz.sum())
+            padded += int(np.prod(v.shape))
+            mean = max(float(row_nnz.mean()), 1e-9)
+            worst_ratio = max(worst_ratio, float(row_nnz.max()) / mean)
+        return {
+            "nnz": true_nnz,
+            "padded_nnz": padded,
+            "pad_overhead": padded / max(true_nnz, 1),
+            "worst_shard_skew": worst_ratio,
+        }
+
+    def _maybe_warn_skew(self, all_nnz: np.ndarray) -> None:
+        """rcv1-class real data is skewed: one dense row pads the whole
+        shard to its width.  Computed from host-side CSR stats (free) --
+        not :meth:`skew_report`, which reads device buffers back."""
+        import warnings
+
+        padded = sum(int(np.prod(s.vals.shape)) for s in self.shards.values())
+        true_nnz = max(int(all_nnz.sum()), 1)
+        overhead = padded / true_nnz
+        mean = max(float(all_nnz.mean()), 1e-9)
+        skew = float(all_nnz.max()) / mean
+        if overhead > self.PAD_OVERHEAD_WARN and skew > self.SKEW_RATIO_WARN:
+            warnings.warn(
+                f"padded-ELL overhead {overhead:.1f}x true nnz (max/mean "
+                f"row nnz = {skew:.1f}): a few dense rows are inflating "
+                f"every shard's pad width; rebuild with nnz_partition=True "
+                f"to bound padding per shard",
+                RuntimeWarning,
+                stacklevel=3,
             )
 
     # ------------------------------------------------------------------ views
@@ -129,7 +266,11 @@ class SparseShardedDataset:
 
 
 def densify(ds: SparseShardedDataset) -> Tuple[np.ndarray, np.ndarray]:
-    """Small-fixture helper (tests / baselines): padded-ELL -> dense host X."""
+    """Small-fixture helper (tests / baselines): padded-ELL -> dense host X.
+
+    Rows come back in SHARD order (the dataset's own ordering): under
+    ``nnz_partition`` that is the permuted order, with original row ids in
+    ``ds.row_perm`` -- X and y stay mutually consistent either way."""
     X = np.zeros((ds.n, ds.d), np.float32)
     ys = []
     for w in range(ds.num_workers):

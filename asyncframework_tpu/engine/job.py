@@ -71,10 +71,12 @@ class JobWaiter:
         with self._lock:
             return worker_id in self._claimed
 
-    def task_succeeded(self, worker_id: int, result: Any) -> None:
+    def task_succeeded(self, worker_id: int, result: Any) -> bool:
+        """Returns True when this completion claimed the worker's slot
+        (False = a duplicate; the other copy already won the race)."""
         with self._lock:
             if worker_id in self._claimed:
-                return  # duplicate completion (speculative copy lost the race)
+                return False  # duplicate (speculative copy lost the race)
             self._claimed.add(worker_id)
         # Handler runs outside the lock but BEFORE the worker counts toward
         # completion: await_result must never release while a claimed
@@ -84,6 +86,7 @@ class JobWaiter:
             self._handled.add(worker_id)
             if self._handled >= self._expected:
                 self._done.set()
+        return True
 
     def job_failed(self, exc: BaseException) -> None:
         with self._lock:

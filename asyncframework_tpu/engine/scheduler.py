@@ -59,6 +59,7 @@ class JobScheduler:
         # speculation bookkeeping: launch stamps + finished durations per job
         self._launch_ms: Dict[Tuple[int, int], float] = {}
         self._finished_ms: Dict[int, List[float]] = {}
+        self._spec_wins = 0  # speculative copies that beat their primary
         self.blacklist = blacklist
         self.pool = pool or ExecutorPool(
             num_workers, self._status_update, devices=devices, clock=self._clock
@@ -168,7 +169,12 @@ class JobScheduler:
             # result: nothing to retry, and certainly nothing to abort
             return
         if exc is None:
-            job.waiter.task_succeeded(task.worker_id, result)
+            won = job.waiter.task_succeeded(task.worker_id, result)
+            if task.speculative and won:
+                # the copy beat the (straggling) primary -- the observable
+                # payoff of TaskSetManager-style speculation
+                with self._lock:
+                    self._spec_wins += 1
             if job.waiter.completed:
                 with self._lock:
                     self._active_jobs.pop(task.job_id, None)
@@ -197,6 +203,12 @@ class JobScheduler:
         self._launch(task.worker_id, retry)
 
     # ------------------------------------------------------------ speculation
+    def speculative_wins(self) -> int:
+        """Speculative copies whose result claimed the slot (copy beat the
+        primary) -- the observable payoff of speculation."""
+        with self._lock:
+            return self._spec_wins
+
     def speculation_snapshot(self) -> Dict[int, Tuple[List[float], Dict[int, float]]]:
         """Per active job: (finished task durations, running task elapsed).
 

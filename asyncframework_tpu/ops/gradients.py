@@ -29,10 +29,24 @@ import jax
 import jax.numpy as jnp
 
 
+def mm_f32(A: jax.Array, v: jax.Array) -> jax.Array:
+    """Matmul in ``A``'s storage dtype with f32 accumulation.
+
+    The bf16 data path: shards stored bfloat16 hit the MXU at native rate
+    while partial sums accumulate in float32 (``preferred_element_type``) --
+    the standard mixed-precision recipe.  For f32 ``A`` this is exactly the
+    plain matmul, so every gradient below is dtype-polymorphic over the
+    shard's storage dtype; ``w``/``y``/gradients stay f32 throughout.
+    Casting ``v`` down to ``A.dtype`` (rather than promoting ``A`` up) is
+    what keeps an (n, d) bf16 shard from being materialized in f32.
+    """
+    return jnp.matmul(A, v.astype(A.dtype), preferred_element_type=jnp.float32)
+
+
 @jax.jit
 def least_squares_residual(X: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
     """Per-sample scalar ``x_i . w - y_i`` (the ASAGA 'scalar' form)."""
-    return X @ w - y
+    return mm_f32(X, w) - y
 
 
 @jax.jit
@@ -44,8 +58,8 @@ def least_squares_grad_sum(
     ``mask`` is {0,1} (or weights) of shape ``(n,)``; equivalent to the
     reference's sample-then-map-then-reduce with vector-add comOp.
     """
-    r = X @ w - y
-    return X.T @ (mask * r)
+    r = mm_f32(X, w) - y
+    return mm_f32(X.T, mask * r)
 
 
 @jax.jit
@@ -56,7 +70,7 @@ def least_squares_loss(X: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
     (``SparkASGDThread.scala:386-401``); normalization by N happens at the
     caller, which knows the global N.
     """
-    r = X @ w - y
+    r = mm_f32(X, w) - y
     return jnp.sum(r * r)
 
 
@@ -69,15 +83,15 @@ def logistic_grad_sum(
     Parity: ``LogisticGradient`` (binary case) -- labels in {0,1};
     ``grad_i = (sigmoid(x_i.w) - y_i) x_i``.
     """
-    margin = X @ w
+    margin = mm_f32(X, w)
     p = jax.nn.sigmoid(margin)
-    return X.T @ (mask * (p - y))
+    return mm_f32(X.T, mask * (p - y))
 
 
 @jax.jit
 def logistic_loss(X: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
     """Unnormalized logistic loss, numerically stable log1p(exp(.)) form."""
-    margin = X @ w
+    margin = mm_f32(X, w)
     # log(1+e^m) - y*m, stable for both signs of margin
     return jnp.sum(jnp.logaddexp(0.0, margin) - y * margin)
 
@@ -104,8 +118,8 @@ def saga_shard_step(
     (:func:`saga_commit_history`) issued by the updater only for *accepted*
     (non-stale) results -- the reference's driver-side ScalarMap merge.
     """
-    diff = X @ w - y
-    g = X.T @ (mask * (diff - alpha))
+    diff = mm_f32(X, w) - y
+    g = mm_f32(X.T, mask * (diff - alpha))
     return g, diff
 
 
@@ -122,16 +136,24 @@ def sparse_residual(
 
 
 def make_sparse_grad_sum(d: int):
-    """jit (cols, vals, coeff) -> dense (d,) gradient via scatter-add.
+    """jit (cols, vals, coeff) -> dense (d,) gradient via SORTED scatter-add.
 
-    ``g = sum_i coeff_i * x_i`` -- the sparse analog of ``X.T @ coeff``;
-    XLA lowers the ``.at[].add`` to one static scatter kernel.
+    ``g = sum_i coeff_i * x_i`` -- the sparse analog of ``X.T @ coeff``.
+    The updates are sorted by destination column first: TPU XLA executes an
+    unsorted colliding scatter nearly serially, while a bitonic argsort +
+    ``indices_are_sorted=True`` scatter runs vectorized (measured on v5e at
+    rcv1's compacted shape, 349k updates into d=47,236: ~110 ms unsorted ->
+    ~5 ms sorted, ~20x).
     """
 
     @jax.jit
     def grad_sum(cols, vals, coeff):
-        contrib = vals * coeff[:, None]
-        return jnp.zeros(d, vals.dtype).at[cols.ravel()].add(contrib.ravel())
+        contrib = (vals * coeff[:, None]).ravel()
+        flat = cols.ravel()
+        order = jnp.argsort(flat)
+        return jnp.zeros(d, vals.dtype).at[flat[order]].add(
+            contrib[order], indices_are_sorted=True, mode="drop"
+        )
 
     return grad_sum
 

@@ -40,13 +40,25 @@ from asyncframework_tpu.ops.gradients import (
     least_squares_grad_sum,
     least_squares_residual,
     logistic_grad_sum,
+    mm_f32,
     saga_commit_history,  # re-exported: the solvers' committed-history op
 )
 
 
 # ---------------------------------------------------------------- builders
 def make_asgd_worker_step(batch_rate: float, loss: str = "least_squares"):
-    """jit (X, y, w, key) -> (g_sum, new_key); mask drawn on device."""
+    """jit (X, y, w, key) -> (g_sum, new_key); mask drawn on device.
+
+    For ``batch_rate <= 0.5`` the sampled rows are **compacted** first
+    (``jnp.nonzero(size=...)`` -- static capacity = E[count] + 6 sigma, see
+    :func:`sparse_step_capacity`): the two matmuls then touch only ~b of
+    the shard instead of streaming all of it through a mask.  The full-shard
+    step is HBM-bandwidth-bound (an mnist8m shard is 1.6 GB bf16 read twice
+    per task), so at b=0.1 compaction cuts per-task traffic ~5x.  The
+    gradient is the reference's sampled-sum exactly, up to the vanishing
+    (~1e-9/step) chance of the draw exceeding capacity, where the excess
+    rows are dropped for that step.
+    """
     if loss == "least_squares":
         grad_sum = least_squares_grad_sum
     elif loss == "logistic":
@@ -54,11 +66,29 @@ def make_asgd_worker_step(batch_rate: float, loss: str = "least_squares"):
     else:
         raise ValueError(f"unknown loss {loss!r}")
 
+    if batch_rate > 0.5:
+        # dense sampling: masking the full shard moves less data than a
+        # near-full gather copy would
+        @jax.jit
+        def step(X, y, w, key):
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(
+                sub, batch_rate, (X.shape[0],)
+            ).astype(jnp.float32)
+            return grad_sum(X, y, w, mask), key
+
+        return step
+
     @jax.jit
     def step(X, y, w, key):
+        n_rows = X.shape[0]  # static at trace time
+        cap = sparse_step_capacity(batch_rate, n_rows)
         key, sub = jax.random.split(key)
-        mask = jax.random.bernoulli(sub, batch_rate, (X.shape[0],)).astype(X.dtype)
-        return grad_sum(X, y, w, mask), key
+        mask = jax.random.bernoulli(sub, batch_rate, (n_rows,))
+        (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
+        valid = (jnp.arange(cap) < jnp.sum(mask)).astype(jnp.float32)
+        Xs = X[idx]
+        return grad_sum(Xs, y[idx], w, valid), key
 
     return step
 
@@ -107,9 +137,11 @@ def make_saga_worker_step(batch_rate: float):
     @jax.jit
     def step(X, y, w, alpha, key):
         key, sub = jax.random.split(key)
-        mask = jax.random.bernoulli(sub, batch_rate, (X.shape[0],)).astype(X.dtype)
+        mask = jax.random.bernoulli(sub, batch_rate, (X.shape[0],)).astype(
+            jnp.float32
+        )
         diff = least_squares_residual(X, y, w)
-        g = X.T @ (mask * (diff - alpha))
+        g = mm_f32(X.T, mask * (diff - alpha))
         return g, diff, mask, key
 
     return step
@@ -202,29 +234,52 @@ def make_asgd_apply_batch(
 
 
 # ------------------------------------------------------------------ sparse
+def sparse_step_capacity(batch_rate: float, n_rows: int) -> int:
+    """Static slot count for the compacted sparse step: E[count] + 6 sigma
+    of the Bernoulli draw, lane-rounded and capped at the shard size.
+    Overflow probability per step is ~1e-9; overflowing rows are dropped
+    (the sample is fractionally smaller that step, nothing corrupts).
+    """
+    import math
+
+    mean = batch_rate * n_rows
+    sigma = math.sqrt(max(batch_rate * (1.0 - batch_rate) * n_rows, 0.0))
+    cap = int(math.ceil(mean + 6.0 * sigma))
+    cap = max(8, ((cap + 7) // 8) * 8)
+    return min(cap, n_rows)
+
+
 def make_sparse_asgd_worker_step(batch_rate: float, d: int):
     """jit (cols, vals, y, w, key) -> (g_sum (d,), new_key).
 
     The sparse analog of :func:`make_asgd_worker_step` for padded-ELL shards
-    (rcv1-class data): residual by gather, gradient by scatter-add; the
+    (rcv1-class data), with **masked-row compaction**: a Bernoulli(b) sample
+    touches only ~b of the shard's rows, so gathering/scattering the FULL
+    (n_p, K) arrays wastes (1-b) of the memory traffic (measured on v5e:
+    ~47 ms gather + ~47 ms scatter at 87k x 80, dominated by padded volume,
+    not useful work).  Instead the sampled row ids are compacted into a
+    static-capacity index vector (``jnp.nonzero(size=...)`` -- static
+    shapes, jit-stable), and only those rows' cols/vals are gathered and
+    scatter-added: ~b of the traffic for the identical gradient.  The
     returned gradient is dense because the parameter server applies dense
     updates (the reference's driver-side axpy is dense too).
     """
-    from asyncframework_tpu.ops.gradients import (
-        make_sparse_grad_sum,
-        sparse_residual,
-    )
+    from asyncframework_tpu.ops.gradients import make_sparse_grad_sum
 
     grad_sum = make_sparse_grad_sum(d)
 
     @jax.jit
     def step(cols, vals, y, w, key):
+        n_rows = y.shape[0]  # static at trace time
+        cap = sparse_step_capacity(batch_rate, n_rows)
         key, sub = jax.random.split(key)
-        mask = jax.random.bernoulli(sub, batch_rate, (y.shape[0],)).astype(
-            vals.dtype
-        )
-        r = sparse_residual(cols, vals, y, w)
-        return grad_sum(cols, vals, mask * r), key
+        mask = jax.random.bernoulli(sub, batch_rate, (n_rows,))
+        (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
+        valid = (jnp.arange(cap) < jnp.sum(mask)).astype(vals.dtype)
+        c_sel = cols[idx]
+        v_sel = vals[idx] * valid[:, None]  # unfilled slots contribute 0
+        r = jnp.sum(v_sel * w[c_sel], axis=1) - y[idx] * valid
+        return grad_sum(c_sel, v_sel, r), key
 
     return step
 
@@ -302,7 +357,7 @@ def make_trajectory_loss_eval(loss: str = "least_squares"):
 
     @jax.jit
     def eval_shard(X, y, W):
-        R = X @ W.T  # (n, S)
+        R = mm_f32(X, W.T)  # (n, S); bf16 shards stay bf16 in the matmul
         if loss == "least_squares":
             E = R - y[:, None]
             return jnp.sum(E * E, axis=0)

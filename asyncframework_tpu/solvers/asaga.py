@@ -44,6 +44,7 @@ from asyncframework_tpu.engine.straggler import DelayModel
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers.base import (
     DelayCalibrator,
+    FlopsAccountingMixin,
     make_allocation_manager,
     SolverCheckpointer,
     SolverConfig,
@@ -58,7 +59,7 @@ from asyncframework_tpu.solvers.instrumentation import (
 )
 
 
-class ASAGA:
+class ASAGA(FlopsAccountingMixin):
     def __init__(
         self,
         X,
@@ -189,7 +190,7 @@ class ASAGA:
         )
 
         state = {"w": w, "ab": alpha_bar, "k": k0, "accepted": 0, "dropped": 0,
-                 "rounds": 0}
+                 "rounds": 0, "flops": 0.0}
         state_lock = threading.Lock()
         stop = threading.Event()
         self._warm_hot_path()
@@ -225,6 +226,7 @@ class ASAGA:
                 task_ms = waiting.on_finish(res.worker_id, now_ms())
                 do_save = False
                 with state_lock:
+                    state["flops"] += self._task_flops(res.worker_id)
                     k = state["k"]
                     # ASAGA acceptance quirk: k - staleness <= taw
                     accepted = k - res.staleness <= cfg.taw
@@ -325,13 +327,16 @@ class ASAGA:
                     )
                     for wid in cohort
                 }
+                with state_lock:
+                    state["rounds"] += 1
+                    round_idx = state["rounds"]
+                # post BEFORE launching: a fast worker could otherwise merge
+                # before its round's RoundSubmitted event exists
+                inst.on_round_submitted(round_idx, cohort, model_version)
                 waiter = sched.run_job(
                     fns, self._handler(ctx, ts, now_ms, worker_keys, hot_lock)
                 )
                 waiters.append(waiter)
-                with state_lock:
-                    state["rounds"] += 1
-                inst.on_round_submitted(state["rounds"], cohort, model_version)
             run_ok = True
         finally:
             stop.set()
@@ -346,17 +351,21 @@ class ASAGA:
             if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
 
-        elapsed = time.monotonic() - start_wall
         with state_lock:
-            final_w = np.asarray(state["w"])
-            snapshots.append((elapsed * 1e3, state["w"]))
             final_k, final_w_dev, final_ab = state["k"], state["w"], state["ab"]
+        # materialize BEFORE taking elapsed: np.asarray is the only fence the
+        # tunneled backend honors unconditionally, so elapsed covers work
+        # actually done, not merely dispatched (see ASGD.run)
+        final_w = np.asarray(final_w_dev)
+        elapsed = time.monotonic() - start_wall
+        snapshots.append((elapsed * 1e3, final_w_dev))
         if ckpt.enabled:
             save_checkpoint(final_k, final_w_dev, final_ab)
         traj = self._evaluate_trajectory(snapshots)
         run_extras = inst.extras()
         if spec is not None:
             run_extras["speculated"] = spec.speculated_count()
+            run_extras["speculation_wins"] = sched.speculative_wins()
         if alloc is not None:
             (
                 run_extras["executors_added"],
@@ -373,6 +382,7 @@ class ASAGA:
             max_staleness=ctx.max_staleness(),
             avg_delay_ms=calibrator.avg_delay_ms,
             updates_per_sec=state["accepted"] / elapsed if elapsed > 0 else 0.0,
+            total_flops=state["flops"],
             waiting_time_ms=waiting.snapshot(),
             extras={
                 "alpha": {wid: np.asarray(a) for wid, a in alpha.items()},
@@ -459,6 +469,7 @@ class ASAGA:
             return (time.monotonic() - start_wall) * 1e3
 
         rounds = 0
+        flops = 0.0
         run_ok = False
         try:
             for k in range(cfg.num_iterations):
@@ -476,14 +487,15 @@ class ASAGA:
                     )
                     for wid in cohort
                 }
+                inst.on_round_submitted(k, cohort, model_version=k)
                 waiter = sched.run_job(
                     fns, self._handler(ctx, ts, now_ms, worker_keys, hot_lock)
                 )
-                inst.on_round_submitted(k, cohort, model_version=k)
                 acc = None
                 for _ in range(nw):
                     res = self._collect_checked(ctx, waiter, cfg.run_timeout_s)
                     g, diff, mask = res.data
+                    flops += self._task_flops(res.worker_id)
                     task_ms = waiting.on_finish(res.worker_id, now_ms())
                     calibrator.record(k, task_ms)
                     inst.on_gradient_merged(
@@ -523,19 +535,21 @@ class ASAGA:
             if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
 
+        final_w = np.asarray(w)  # fence: see the async path's comment
         elapsed = time.monotonic() - start_wall
         snapshots.append((elapsed * 1e3, w))
         traj = self._evaluate_trajectory(snapshots)
         extras = inst.extras()
         if spec is not None:
             extras["speculated"] = spec.speculated_count()
+            extras["speculation_wins"] = sched.speculative_wins()
         if alloc is not None:
             extras["executors_added"], extras["executors_removed"] = (
                 alloc.counts()
             )
         inst.close(traj, cfg.printer_freq)
         return TrainResult(
-            final_w=np.asarray(w),
+            final_w=final_w,
             trajectory=traj,
             elapsed_s=elapsed,
             accepted=rounds * nw,
@@ -543,6 +557,7 @@ class ASAGA:
             max_staleness=ctx.max_staleness(),
             avg_delay_ms=calibrator.avg_delay_ms,
             updates_per_sec=rounds / elapsed if elapsed > 0 else 0.0,
+            total_flops=flops,
             waiting_time_ms=waiting.snapshot(),
             extras=extras,
         )

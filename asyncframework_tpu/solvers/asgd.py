@@ -42,6 +42,7 @@ from asyncframework_tpu.engine.straggler import DelayModel
 from asyncframework_tpu.ops import steps
 from asyncframework_tpu.solvers.base import (
     DelayCalibrator,
+    FlopsAccountingMixin,
     make_allocation_manager,
     SolverCheckpointer,
     SolverConfig,
@@ -63,7 +64,7 @@ from asyncframework_tpu.solvers.instrumentation import (
 BATCH_DRAIN_MIN = 3
 
 
-class ASGD:
+class ASGD(FlopsAccountingMixin):
     def __init__(
         self,
         X,
@@ -87,11 +88,14 @@ class ASGD:
             self._step = steps.make_sparse_asgd_worker_step(
                 config.batch_rate, self.ds.d
             )
+            self._sparse_compact = True  # flops = compacted rows, not n_p
             self._eval = steps.make_sparse_trajectory_loss_eval()
         else:
             self._step = steps.make_asgd_worker_step(
                 config.batch_rate, config.loss
             )
+            # flops accounting mirrors the step's row compaction gate
+            self._dense_compact = config.batch_rate <= 0.5
             self._eval = steps.make_trajectory_loss_eval(config.loss)
         self._apply = steps.make_asgd_apply(
             config.gamma, config.batch_rate, self.ds.n, config.num_workers
@@ -178,6 +182,7 @@ class ASGD:
             "accepted": 0,
             "dropped": 0,
             "rounds": 0,
+            "flops": 0.0,
         }
         state_lock = threading.Lock()
         stop = threading.Event()
@@ -240,6 +245,7 @@ class ASGD:
                     merged = []
                     accepted_g = []
                     for res in results:
+                        state["flops"] += self._task_flops(res.worker_id)
                         task_ms = waiting.on_finish(res.worker_id, now_ms())
                         if res.staleness > cfg.taw:
                             state["dropped"] += 1
@@ -368,13 +374,17 @@ class ASGD:
                     wid: self._make_task(wid, w_pub, keys[wid], delay_model)
                     for wid in cohort
                 }
+                with state_lock:
+                    state["rounds"] += 1
+                    round_idx = state["rounds"]
+                # post BEFORE launching: a fast worker could otherwise merge
+                # (and the live UI could observe accepted>0) before its
+                # round's RoundSubmitted event exists
+                inst.on_round_submitted(round_idx, cohort, model_version)
                 waiter = sched.run_job(
                     fns, self._handler(ctx, ts, now_ms, worker_keys, key_lock)
                 )
                 waiters.append(waiter)
-                with state_lock:
-                    state["rounds"] += 1
-                inst.on_round_submitted(state["rounds"], cohort, model_version)
             run_ok = True
         finally:
             stop.set()
@@ -389,17 +399,23 @@ class ASGD:
             if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
 
-        elapsed = time.monotonic() - start_wall
         with state_lock:
-            final_w = np.asarray(state["w"])
-            snapshots.append((elapsed * 1e3, state["w"]))
             final_k, final_w_dev = state["k"], state["w"]
+        # materialize BEFORE taking elapsed: np.asarray is the only fence
+        # this backend honors unconditionally (block_until_ready has been
+        # observed returning before execution on the tunneled platform), so
+        # elapsed/updates_per_sec cover the work actually done, not merely
+        # dispatched
+        final_w = np.asarray(final_w_dev)
+        elapsed = time.monotonic() - start_wall
+        snapshots.append((elapsed * 1e3, final_w_dev))
         if ckpt.enabled:
             save_checkpoint(final_k, final_w_dev)
         traj = self._evaluate_trajectory(snapshots)
         extras = inst.extras()
         if spec is not None:
             extras["speculated"] = spec.speculated_count()
+            extras["speculation_wins"] = sched.speculative_wins()
         if alloc is not None:
             extras["executors_added"], extras["executors_removed"] = (
                 alloc.counts()
@@ -415,6 +431,7 @@ class ASGD:
             max_staleness=ctx.max_staleness(),
             avg_delay_ms=calibrator.avg_delay_ms,
             updates_per_sec=state["accepted"] / elapsed if elapsed > 0 else 0.0,
+            total_flops=state["flops"],
             waiting_time_ms=waiting.snapshot(),
             extras=extras,
         )
@@ -477,6 +494,7 @@ class ASGD:
             return (time.monotonic() - start_wall) * 1e3
 
         rounds = 0
+        flops = 0.0
         run_ok = False
         try:
             for k in range(cfg.num_iterations):
@@ -489,14 +507,15 @@ class ASGD:
                     wid: self._make_task(wid, w, worker_keys[wid], delay_model)
                     for wid in cohort
                 }
+                inst.on_round_submitted(k, cohort, model_version=k)
                 waiter = sched.run_job(
                     fns, self._handler(ctx, ts, now_ms, worker_keys, key_lock)
                 )
-                inst.on_round_submitted(k, cohort, model_version=k)
                 acc = None
                 for _ in range(nw):
                     res = self._collect_checked(ctx, waiter, cfg.run_timeout_s)
                     g = res.data
+                    flops += self._task_flops(res.worker_id)
                     task_ms = waiting.on_finish(res.worker_id, now_ms())
                     calibrator.record(k, task_ms)
                     inst.on_gradient_merged(
@@ -524,19 +543,21 @@ class ASGD:
             if not run_ok:
                 inst.close()  # crash path: flush/seal the event log now
 
+        final_w = np.asarray(w)  # fence: see the async path's comment
         elapsed = time.monotonic() - start_wall
         snapshots.append((elapsed * 1e3, w))
         traj = self._evaluate_trajectory(snapshots)
         extras = inst.extras()
         if spec is not None:
             extras["speculated"] = spec.speculated_count()
+            extras["speculation_wins"] = sched.speculative_wins()
         if alloc is not None:
             extras["executors_added"], extras["executors_removed"] = (
                 alloc.counts()
             )
         inst.close(traj, cfg.printer_freq)
         return TrainResult(
-            final_w=np.asarray(w),
+            final_w=final_w,
             trajectory=traj,
             elapsed_s=elapsed,
             accepted=rounds * nw,
@@ -544,6 +565,7 @@ class ASGD:
             max_staleness=ctx.max_staleness(),
             avg_delay_ms=calibrator.avg_delay_ms,
             updates_per_sec=rounds / elapsed if elapsed > 0 else 0.0,
+            total_flops=flops,
             waiting_time_ms=waiting.snapshot(),
             extras=extras,
         )

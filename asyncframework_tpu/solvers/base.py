@@ -74,6 +74,40 @@ def resolve_dataset(X, y, num_workers: int, devices):
     return ShardedDataset(X, y, num_workers, devices)
 
 
+class FlopsAccountingMixin:
+    """Shared counted-flops accounting for the async solvers.
+
+    Hosts expect ``self._recovery`` (shard view), ``self._sparse`` and
+    ``self.ds`` -- both ASGD and ASAGA provide them.  One implementation so
+    a flop-model change can never make the two solvers disagree.
+    """
+
+    def _task_flops(self, wid: int) -> float:
+        """Counted flops of one worker gradient (utils/flops.py model);
+        cached per worker -- re-homed shards keep their shapes.  A solver
+        whose sparse step compacts masked rows (ASGD) sets
+        ``_sparse_compact`` so only the compacted rows count."""
+        cache = self.__dict__.setdefault("_flops_cache", {})
+        cached = cache.get(wid)
+        if cached is None:
+            from asyncframework_tpu.utils import flops as _fl
+
+            shard = self._recovery.shard(wid)
+            rows = shard.size
+            if getattr(self, "_sparse_compact" if self._sparse
+                       else "_dense_compact", False):
+                from asyncframework_tpu.ops.steps import sparse_step_capacity
+
+                rows = sparse_step_capacity(self.cfg.batch_rate, shard.size)
+            cached = (
+                _fl.sparse_task_flops(rows, shard.cols.shape[1])
+                if self._sparse
+                else _fl.dense_task_flops(rows, self.ds.d)
+            )
+            cache[wid] = cached
+        return cached
+
+
 class SolverCheckpointer:
     """Shared checkpoint plumbing for the async solvers.
 
@@ -172,6 +206,9 @@ class SolverConfig:
     checkpoint_freq: int = 0              # accepted updates between saves; 0 = off
     checkpoint_keep: int = 3
     # observability (EventLoggingListener / MetricsSystem parity; None = off)
+    # live dashboard (SparkUI.scala:39 parity): HTTP port serving run state
+    # DURING the run; 0 = ephemeral (metrics/live.py); None = off
+    ui_port: Optional[int] = None
     event_log: Optional[str] = None       # JSONL (.gz ok) event log path
     metrics_csv: Optional[str] = None     # CsvSink path
     metrics_jsonl: Optional[str] = None   # JsonlSink path
@@ -227,6 +264,9 @@ class TrainResult:
     max_staleness: int = 0
     avg_delay_ms: float = 0.0
     updates_per_sec: float = 0.0
+    # counted worker-gradient flops (utils/flops.py model; excludes the
+    # post-hoc trajectory evaluation) -- the MFU numerator
+    total_flops: float = 0.0
     waiting_time_ms: Dict[int, float] = field(default_factory=dict)
     extras: Dict[str, object] = field(default_factory=dict)
 

@@ -1,0 +1,63 @@
+"""Achieved-FLOP/s and MFU accounting.
+
+The reference never reports compute efficiency (its metric is wall-clock to
+target loss); the TPU build records it so "matching-or-beating on perf"
+carries an absolute number: solvers count the flops of every worker gradient
+they merge, and the bench divides by elapsed time and the chip's peak.
+
+Flop model (counted, not estimated): a dense worker step is two matmuls over
+the full shard -- residual ``X @ w`` and gradient ``X^T @ (mask*r)`` -- i.e.
+``4 * n_p * d`` flops (2 per multiply-add).  A sparse (padded-ELL) step is the
+gather/scatter pair at ``4 * n_p * K`` (padding lanes execute real FMAs).  The
+trajectory evaluation runs outside the timed region and is not counted.
+
+Peak table: dense matmul peak per chip for bf16 inputs (MXU native; the
+industry-standard MFU denominator).  f32 runs are still divided by the bf16
+peak -- that is deliberate: MFU answers "what fraction of the chip's usable
+matmul throughput did the run extract", and on TPU the usable peak IS the
+bf16 MXU rate (f32 matmuls lower to multi-pass bf16).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: dense-matmul peak FLOP/s per chip by device_kind substring (public specs)
+_PEAK_BF16 = (
+    ("v6", 918e12),        # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),        # v5e / "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def chip_peak_flops(device) -> Optional[float]:
+    """Best-effort bf16 dense-matmul peak for ``device``; None if unknown
+    (CPU backends have no meaningful MXU peak -- MFU is reported null)."""
+    kind = str(getattr(device, "device_kind", "")).lower()
+    if "tpu" not in kind and getattr(device, "platform", "") != "tpu":
+        return None
+    for tag, peak in _PEAK_BF16:
+        if tag in kind:
+            return peak
+    return 197e12 if kind else None  # unknown TPU: assume the v5e floor
+
+
+def dense_task_flops(n_rows: int, d: int) -> float:
+    """Flops of one dense worker gradient over an ``(n_rows, d)`` shard."""
+    return 4.0 * n_rows * d
+
+
+def sparse_task_flops(n_rows: int, k_padded: int) -> float:
+    """Flops of one padded-ELL worker gradient (gather + scatter lanes)."""
+    return 4.0 * n_rows * k_padded
+
+
+def mfu(total_flops: float, elapsed_s: float, device) -> Optional[float]:
+    """Model FLOP utilization in [0, 1]; None when the peak is unknown."""
+    peak = chip_peak_flops(device)
+    if peak is None or elapsed_s <= 0:
+        return None
+    return total_flops / elapsed_s / peak

@@ -1,37 +1,50 @@
 #!/usr/bin/env python
-"""Benchmark: ASGD wall-clock to target objective on an epsilon-shaped problem.
+"""Benchmark: ASGD wall-clock to target objective on the reference's three
+dataset shapes -- epsilon (400k x 2000 dense f32), mnist8m (8.1M x 784 dense
+bf16), rcv1 (~700k x 47,236 sparse) -- with fresh-process medians.
 
 Metric of record (BASELINE.md): wall-clock to target loss, asynchronous SGD.
-The reference repo publishes recipes but no absolute numbers (its figures live
-in the IPDPS 2020 paper, arXiv:1907.08526).  BASELINE_S is derived from the
-reference's own recipe (derivation recorded in BASELINE.md section "Derived
-baseline"): the epsilon ASGD recipe runs 320k gradient updates to reach its
-target band (README.md:64); Spark's driver-mediated per-task path (launch RPC
-+ result serde + scheduling) has a widely measured floor of ~5 ms/task, and 8
-workers pipeline it, giving >= 320000 x 5ms / 8 = 200 s as a lower bound for
-the 8-worker cluster.  BASELINE_S = 120 s is kept BELOW that derived bound
-(i.e. generous to the reference) and fixed so rounds are comparable.
+The reference repo publishes recipes but no absolute numbers (its figures
+live in the IPDPS 2020 paper, arXiv:1907.08526); the per-config baseline is
+derived from the reference's own recipe (BASELINE.md "Derived baseline"):
+Spark's driver-mediated per-task path has a ~5 ms floor, plus gradient
+compute at an optimistic 6 GFLOP/s for the recipe's 2-core executor, across
+8 pipelined workers; capped by the recipe-length bound with the same
+generosity ratio that put the round-1 epsilon cap at 120 s (below the 200 s
+derived lower bound).
 
-Workload: epsilon-shaped planted least squares (400k x 2000 dense f32,
-generated directly in device HBM -- this container's host<->device link is a
-high-latency tunnel, and shipping 3.2 GB through it would benchmark the
-tunnel, not the framework).  Target: reduce the mean objective to 0.1% of
-its initial value (~2,500-4,000 accepted updates at the tuned step size) --
-deep enough that steady-state update throughput, not the dispatch ramp,
-decides wall-clock, yet a decade above the planted noise floor (~1e-4 of
-initial, measured), so the target is always reachable.
+Measurement discipline (BASELINE.md round 2): the tunneled backend's first
+device->host readback permanently degrades per-dispatch latency for the rest
+of the process, and run-to-run variance exceeded the effects measured.  So
+EVERY measurement runs in a fresh subprocess (`bench.py --config NAME`), the
+parent reports per-config MEDIANS of >= BENCH_REPEATS runs, and the timed
+region is readback-free.
 
-The run exercises the REAL framework hot path: executor threads, result
-queue, tau filter, partial barrier, versioned model handles, on-device updates
--- 8 logical workers on however many chips are attached (1 in this harness).
+Workloads are planted problems generated directly in device HBM (this
+container's host<->device link is a high-latency tunnel; shipping 3-13 GB
+through it would benchmark the tunnel).  All three share E[x x^T] = I/d
+conditioning so the gamma = 0.05*d step-size rule transfers; targets are
+0.1% of the initial objective -- deep enough that steady-state update
+throughput decides wall-clock, a decade above each problem's noise floor.
 
-Output: ONE json line {"metric", "value", "unit", "vs_baseline"};
-vs_baseline > 1 means faster than the reference estimate.
+Every run exercises the REAL framework hot path: executor threads, result
+queue, tau filter, partial barrier, versioned model handles, on-device
+updates.  The bf16 config stores shards in bfloat16 with f32 accumulation
+(the MXU-native mixed-precision path); the sparse config runs the
+padded-ELL gather/scatter kernels.
+
+Output: ONE json line {"metric", "value", "unit", "vs_baseline", "configs",
+"gflops", "mfu"}.  value = epsilon median time-to-target; vs_baseline = the
+MINIMUM of the three per-config median ratios (the conservative claim: every
+dataset beats its reference estimate by at least this factor); gflops/mfu =
+achieved compute rate of the flop-heaviest config (mnist8m).
 """
 
 import faulthandler
 import json
 import os
+import statistics
+import subprocess
 import sys
 import threading
 import time
@@ -39,61 +52,81 @@ import traceback
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
 
-from asyncframework_tpu.data.sharded import ShardedDataset
-from asyncframework_tpu.ops import steps
-from asyncframework_tpu.solvers import ASGD, SolverConfig
-
-# BENCH_N/BENCH_D env overrides let the full flow be validated on a small
-# CPU problem; the driver's TPU run uses the defaults
-N = int(os.environ.get("BENCH_N", 400_000))
-D = int(os.environ.get("BENCH_D", 2_000))
 NUM_WORKERS = 8
-BASELINE_S = 120.0  # below the 200 s recipe-derived lower bound; BASELINE.md
-SPARK_TASK_FLOOR_S = 0.005  # per-gradient driver-mediated floor (BASELINE.md)
+SPARK_TASK_FLOOR_S = 0.005   # per-gradient driver-mediated floor (BASELINE.md)
+SPARK_GFLOPS = 6e9           # optimistic 2-core executor gradient compute rate
+CAP_GENEROSITY = 0.6         # epsilon: 320k * 5ms / 8 * 0.6 = 120 s (round-1 cap)
 TARGET_FRACTION = 0.001
-BACKEND_INIT_BUDGET_S = 360.0  # total retry budget for flaky TPU backend init
-RUN_TIMEOUT_S = 240.0          # solver-internal deadline
-WATCHDOG_S = 600.0             # hard kill: a dead device link can block a
-                               # device op forever (threads stuck in C code)
+BACKEND_INIT_BUDGET_S = 360.0
+RUN_TIMEOUT_S = 240.0
+CHILD_WATCHDOG_S = 600.0     # child hard-kill (dead device link wedges C code)
+CHILD_TIMEOUT_S = 660.0      # parent's per-child subprocess timeout
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 2400.0))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+
+# Each config mirrors one reference dataset's shape and recipe
+# (README.md:44-74; BASELINE.md).  gamma follows the 0.05*d conditioning
+# rule validated in round 2 (rows ~ N(0, I/d) -> contraction ~ lr/d).
+CONFIGS = {
+    "epsilon": dict(
+        n=400_000, d=2_000, dtype="float32", sparse=False, nnz=None,
+        gamma=100.0, batch_rate=0.1, iters=5_000,
+        ref_iters=320_000, ref_dims=2_000,   # README.md:64 ASGD epsilon row
+    ),
+    "mnist8m": dict(
+        n=8_100_000, d=784, dtype="bfloat16", sparse=False, nnz=None,
+        gamma=39.2, batch_rate=0.1, iters=5_000,
+        ref_iters=300_000, ref_dims=784,     # README.md:64 ASGD mnist8m row
+    ),
+    "rcv1": dict(
+        n=697_641, d=47_236, dtype="float32", sparse=True, nnz=75,
+        # iters capped lower than the dense configs: target is reached by
+        # ~k=300 and each sparse task costs real device milliseconds even
+        # compacted -- a 5k budget would pay for nothing but drain time
+        gamma=2361.8, batch_rate=0.05, iters=1_200, printer_freq=50,
+        ref_iters=100_000, ref_dims=75,      # README.md:64 ASGD rcv1 row;
+        # reference compute scales with nnz, not d, on sparse vectors
+    ),
+}
+
+# BENCH_SCALE=small shrinks every config for off-TPU flow validation
+if os.environ.get("BENCH_SCALE") == "small":
+    for _name, _c in CONFIGS.items():
+        _c.update(
+            n=20_000, d=128, gamma=0.05 * 128, iters=600,
+            nnz=(8 if _c["sparse"] else None),
+        )
 
 
-def arm_watchdog() -> None:
+def emit(payload: dict) -> None:
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+# --------------------------------------------------------------------- child
+def arm_watchdog(config_name: str) -> None:
     """Emit a parseable failure line and hard-exit if the process wedges
-    (e.g. the host<->TPU tunnel dies mid-run and block_until_ready never
-    returns -- observed in round 2).  ``os._exit`` on purpose: stuck C calls
-    do not honor normal interpreter shutdown."""
-    faulthandler.dump_traceback_later(WATCHDOG_S - 30, file=sys.stderr)
+    (a dead host<->TPU tunnel can block a device op forever in C code, where
+    normal interpreter shutdown never runs)."""
+    faulthandler.dump_traceback_later(CHILD_WATCHDOG_S - 30, file=sys.stderr)
 
     def fire():
-        emit(0.0, "s (WATCHDOG: process wedged past "
-             f"{WATCHDOG_S:.0f}s; see stderr traceback)", 0.0)
-        sys.stdout.flush()
+        emit({"config": config_name, "ok": False,
+              "note": f"WATCHDOG: wedged past {CHILD_WATCHDOG_S:.0f}s"})
         os._exit(0)
 
-    t = threading.Timer(WATCHDOG_S, fire)
+    t = threading.Timer(CHILD_WATCHDOG_S, fire)
     t.daemon = True
     t.start()
 
 
-def emit(value: float, unit: str, vs_baseline: float) -> None:
-    print(json.dumps({
-        "metric": "asgd_epsilon_time_to_target",
-        "value": value,
-        "unit": unit,
-        "vs_baseline": vs_baseline,
-    }))
-
-
 def init_devices():
     """jax.devices() with retry/backoff: one flaky TPU backend init must not
-    erase the round's perf evidence (BENCH_r01 died exactly this way).
-
-    BENCH_PLATFORM=cpu forces the CPU backend through the config API (env
-    vars alone cannot: the image's sitecustomize latches the TPU plugin
-    first) -- used with BENCH_N/BENCH_D to validate the whole flow off-TPU.
-    """
+    erase a sample.  BENCH_PLATFORM=cpu forces the CPU backend through the
+    config API (env vars alone cannot: the image's sitecustomize latches the
+    TPU plugin first)."""
     import jax
 
     forced = os.environ.get("BENCH_PLATFORM")
@@ -110,14 +143,12 @@ def init_devices():
             print(f"# backend up on attempt {attempt}: "
                   f"{[d.platform for d in devices]}", file=sys.stderr)
             return devices
-        except Exception as e:  # backend init raises RuntimeError/JaxRuntimeError
+        except Exception as e:
             remaining = deadline - time.monotonic()
             print(f"# backend init attempt {attempt} failed: {e!r}; "
                   f"{remaining:.0f}s budget left", file=sys.stderr)
             if remaining <= 0:
                 raise
-            # jax caches the failed-backend error; clear it so the next
-            # attempt actually re-initializes the plugin
             try:
                 jax.extend.backend.clear_backends()
             except Exception:
@@ -129,117 +160,281 @@ def init_devices():
             delay = min(delay * 2, 60.0)
 
 
-def main() -> None:
+def build_dataset(cfg: dict, devices):
+    from asyncframework_tpu.data.sharded import ShardedDataset
+    from asyncframework_tpu.data.sparse import SparseShardedDataset
+
+    if cfg["sparse"]:
+        return SparseShardedDataset.generate_on_device(
+            cfg["n"], cfg["d"], cfg["nnz"], NUM_WORKERS,
+            devices=devices, seed=7, noise=0.01,
+        )
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if cfg["dtype"] == "bfloat16" else jnp.float32
+    return ShardedDataset.generate_on_device(
+        cfg["n"], cfg["d"], NUM_WORKERS, devices=devices, seed=7,
+        noise=0.01, dtype=dtype,
+    )
+
+
+def spark_equal_recipe_baseline(cfg: dict, k_hit: int) -> float:
+    """Reference cost to produce k_hit accepted gradients on this recipe
+    (scheduling floor + compute, 8 pipelined workers), capped by the
+    recipe-length bound at round-1's generosity ratio."""
+    par_recs = cfg["batch_rate"] * cfg["n"] / NUM_WORKERS
+    per_grad_s = SPARK_TASK_FLOOR_S + 2.0 * par_recs * cfg["ref_dims"] / SPARK_GFLOPS
+    equal = k_hit * per_grad_s / NUM_WORKERS
+    cap = cfg["ref_iters"] * SPARK_TASK_FLOOR_S / NUM_WORKERS * CAP_GENEROSITY
+    return min(max(equal, 1e-3), cap)
+
+
+def run_child(config_name: str) -> None:
+    """One fresh-process measurement; prints one JSON line."""
+    cfg = CONFIGS[config_name]
     devices = init_devices()
     import jax
-    t0 = time.monotonic()
-    ds = ShardedDataset.generate_on_device(
-        N, D, NUM_WORKERS, devices=devices, seed=7, noise=0.01
-    )
-    for w in range(NUM_WORKERS):
-        ds.shard(w).y.block_until_ready()
-    gen_s = time.monotonic() - t0
-    print(f"# data: {N}x{D} generated on device in {gen_s:.1f}s", file=sys.stderr)
+    import jax.numpy as jnp
 
-    # gamma is tuned to the problem's conditioning: rows are N(0, I/d), so
-    # the covariance is I/d and per-update contraction is ~gamma/d -- the
-    # measured updates-to-1%-target is ~300 at gamma=100 (gamma=6 cannot
-    # reach the target in any feasible budget).  Each side of a
-    # wall-clock-to-target comparison runs its own best recipe, as in the
-    # paper's figures.
-    cfg = SolverConfig(
+    from asyncframework_tpu.solvers import ASGD, SolverConfig
+    from asyncframework_tpu.utils import flops as fl
+
+    t0 = time.monotonic()
+    ds = build_dataset(cfg, devices)
+    for wid in range(NUM_WORKERS):
+        ds.shard(wid).y.block_until_ready()
+    print(f"# {config_name}: data {cfg['n']}x{cfg['d']} "
+          f"({'sparse' if cfg['sparse'] else cfg['dtype']}) generated on "
+          f"device in {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+    scfg = SolverConfig(
         num_workers=NUM_WORKERS,
-        num_iterations=5_000,
-        gamma=100.0,
+        num_iterations=cfg["iters"],
+        gamma=cfg["gamma"],
         taw=2**31 - 1,
-        batch_rate=0.1,
+        batch_rate=cfg["batch_rate"],
         bucket_ratio=0.7,
-        printer_freq=25,
+        printer_freq=cfg.get("printer_freq", 25),
         coeff=0.0,
         seed=42,
         calibration_iters=100,
         run_timeout_s=RUN_TIMEOUT_S,
     )
-    solver = ASGD(ds, None, cfg, devices=devices)
+    solver = ASGD(ds, None, scfg, devices=devices)
 
     # warm the XLA compile caches outside the timed region (the reference's
     # first blocking iteration plays the same role for Spark's caches)
     shard = ds.shard(0)
     key = jax.random.PRNGKey(0)
-    g, _ = solver._step(shard.X, shard.y, jax.device_put(
-        np.zeros(D, np.float32), devices[0]), key)
+    w0 = jax.device_put(np.zeros(cfg["d"], np.float32), devices[0])
+    if cfg["sparse"]:
+        g, _ = solver._step(shard.cols, shard.vals, shard.y, w0, key)
+    else:
+        g, _ = solver._step(shard.X, shard.y, w0, key)
     solver._apply(
-        jax.device_put(np.zeros(D, np.float32), devices[0]),
+        jax.device_put(np.zeros(cfg["d"], np.float32), devices[0]),
         jax.device_put(g, devices[0]),
         jax.device_put(np.float32(0), devices[0]),
     )
     print("# compile warm-up done", file=sys.stderr)
 
     # dispatch round-trip diagnostic: on a tunneled/remote device the
-    # per-dispatch RTT, not the framework, bounds updates/sec -- record it
-    # so the headline number can be read in context
+    # per-dispatch RTT, not the framework, bounds updates/sec
     probe = jax.device_put(np.zeros(8, np.float32), devices[0])
     t0 = time.monotonic()
     for _ in range(20):
         probe = (probe + 1.0).block_until_ready()
     rtt_ms = (time.monotonic() - t0) / 20 * 1e3
-    print(f"# device dispatch round-trip ~{rtt_ms:.2f} ms "
-          f"(bounds updates/sec at ~{8 / max(rtt_ms, 1e-3) * 1e3:.0f}/s)",
-          file=sys.stderr)
+    print(f"# device dispatch round-trip ~{rtt_ms:.2f} ms", file=sys.stderr)
 
     res = solver.run()
 
-    # wall-clock to target from the evaluated trajectory
     initial = res.trajectory[0][1]
     target = initial * TARGET_FRACTION
-    t_hit = None
+    t_hit_traj = None
     k_hit = None
     for i, (t_ms, obj) in enumerate(res.trajectory):
         if obj <= target:
-            t_hit = t_ms / 1e3
-            # snapshot i covers ~i * printer_freq accepted updates
-            k_hit = max(i * cfg.printer_freq, 1)
+            t_hit_traj = t_ms / 1e3
+            k_hit = max(i * scfg.printer_freq, 1)
             break
+    # HONEST time-to-target: trajectory timestamps are host dispatch times,
+    # and this backend has been observed completing dispatches lazily --
+    # so attribute wall-clock by the run's true (fenced) throughput:
+    # t_hit = k_hit / (accepted / elapsed).  elapsed_s is measured after a
+    # full device sync (solvers fence with np.asarray before timing).
+    t_hit = None
+    if k_hit is not None and res.accepted > 0 and res.elapsed_s > 0:
+        t_hit = k_hit * res.elapsed_s / res.accepted
+    gflops = res.total_flops / res.elapsed_s / 1e9 if res.elapsed_s > 0 else 0.0
+    mfu = fl.mfu(res.total_flops, res.elapsed_s, devices[0])
     print(
-        f"# accepted={res.accepted} dropped={res.dropped} rounds={res.rounds} "
-        f"updates/s={res.updates_per_sec:.0f} max_staleness={res.max_staleness} "
-        f"elapsed={res.elapsed_s:.1f}s obj {initial:.4f}->{res.trajectory[-1][1]:.6f} "
-        f"target={target:.6f} t_hit={t_hit}",
+        f"# {config_name}: accepted={res.accepted} dropped={res.dropped} "
+        f"rounds={res.rounds} updates/s={res.updates_per_sec:.0f} "
+        f"elapsed={res.elapsed_s:.1f}s obj {initial:.4f}->"
+        f"{res.trajectory[-1][1]:.6f} target={target:.6f} t_hit={t_hit} "
+        f"(traj={t_hit_traj}) gflops={gflops:.1f} mfu={mfu}",
         file=sys.stderr,
     )
     if t_hit is None:
-        # did not reach target: report elapsed as value with penalty ratio
-        emit(round(res.elapsed_s, 2), "s (TARGET NOT REACHED)", 0.0)
+        emit({"config": config_name, "ok": False,
+              "note": "TARGET NOT REACHED",
+              "elapsed_s": round(res.elapsed_s, 2),
+              "final_over_initial": res.trajectory[-1][1] / initial})
         return
-    # EQUAL-RECIPE baseline: the reference running this same recipe (same
-    # update count) pays at least SPARK_TASK_FLOOR_S per gradient across 8
-    # pipelined workers (BASELINE.md "Derived baseline") -- comparing
-    # against the fixed 320k-iteration recipe would credit step-size tuning
-    # to the framework.  Also floor the baseline at the recipe-independent
-    # BASELINE_S when OUR update count exceeds the reference recipe's.
-    # per-gradient cost for the reference at THIS recipe = scheduling floor
-    # + gradient compute: 2 * par_recs * d flops on a 2-core executor at an
-    # optimistic 6 GFLOP/s (BASELINE.md "Derived baseline")
-    par_recs = cfg.batch_rate * N / NUM_WORKERS
-    spark_compute_s = 2.0 * par_recs * D / 6e9
-    per_grad_s = SPARK_TASK_FLOOR_S + spark_compute_s
-    equal_recipe_baseline = k_hit * per_grad_s / NUM_WORKERS
-    baseline = min(max(equal_recipe_baseline, 1e-3), BASELINE_S)
-    print(
-        f"# k_hit={k_hit} spark_per_grad={per_grad_s * 1e3:.1f}ms "
-        f"equal-recipe baseline={equal_recipe_baseline:.3f}s",
-        file=sys.stderr,
-    )
-    emit(round(t_hit, 2), "s", round(baseline / t_hit, 2))
+    baseline = spark_equal_recipe_baseline(cfg, k_hit)
+    emit({
+        "config": config_name,
+        "ok": True,
+        "t_hit": round(t_hit, 3),
+        "t_hit_traj": (round(t_hit_traj, 3) if t_hit_traj is not None
+                       else None),
+        "k_hit": k_hit,
+        "vs_baseline": round(baseline / t_hit, 2),
+        "baseline_s": round(baseline, 3),
+        "updates_per_sec": round(res.updates_per_sec, 1),
+        "accepted": res.accepted,
+        "elapsed_s": round(res.elapsed_s, 2),
+        "gflops": round(gflops, 2),
+        "mfu": (round(mfu, 6) if mfu is not None else None),
+        "rtt_ms": round(rtt_ms, 2),
+    })
+
+
+# -------------------------------------------------------------------- parent
+def median_or_none(xs):
+    return round(statistics.median(xs), 3) if xs else None
+
+
+def run_parent() -> None:
+    names = [
+        s for s in os.environ.get(
+            "BENCH_CONFIGS", "epsilon,mnist8m,rcv1"
+        ).split(",") if s
+    ]
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    samples = {name: [] for name in names}
+    env = dict(os.environ)
+    # round-robin repeats so every config gets one sample before the budget
+    # can run out
+    for rep in range(REPEATS):
+        for name in names:
+            have = len(samples[name])
+            if rep > 0 and have == 0:
+                continue  # config is failing; don't burn budget re-proving it
+            if time.monotonic() > deadline and have >= 1:
+                print(f"# budget exhausted; skipping {name} repeat {rep}",
+                      file=sys.stderr)
+                continue
+            t0 = time.monotonic()
+            try:
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--config", name],
+                    capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+                    env=env,
+                )
+            except subprocess.TimeoutExpired:
+                print(f"# {name} rep {rep}: child timed out", file=sys.stderr)
+                continue
+            sys.stderr.write(out.stderr)
+            line = next(
+                (l for l in reversed(out.stdout.splitlines())
+                 if l.startswith("{")), None,
+            )
+            if line is None:
+                print(f"# {name} rep {rep}: no JSON from child "
+                      f"(rc={out.returncode})", file=sys.stderr)
+                continue
+            rec = json.loads(line)
+            print(f"# {name} rep {rep}: {line} "
+                  f"({time.monotonic() - t0:.0f}s wall)", file=sys.stderr)
+            if rec.get("ok"):
+                samples[name].append(rec)
+
+    configs_out = {}
+    ratios = []
+    headline_value = None
+    gflops = None
+    mfu_out = None
+    for name in names:
+        recs = samples[name]
+        if not recs:
+            configs_out[name] = {"ok": False, "runs": 0}
+            continue
+        med_ratio = median_or_none([r["vs_baseline"] for r in recs])
+        med_t = median_or_none([r["t_hit"] for r in recs])
+        configs_out[name] = {
+            "ok": True,
+            "runs": len(recs),
+            "t_hit_median_s": med_t,
+            "vs_baseline_median": med_ratio,
+            "t_hit_all": [r["t_hit"] for r in recs],
+            "vs_baseline_all": [r["vs_baseline"] for r in recs],
+            "updates_per_sec_median": median_or_none(
+                [r["updates_per_sec"] for r in recs]
+            ),
+            "gflops_median": median_or_none([r["gflops"] for r in recs]),
+            "mfu_median": median_or_none(
+                [r["mfu"] for r in recs if r.get("mfu") is not None]
+            ),
+        }
+        ratios.append(med_ratio)
+        if name == "epsilon":
+            headline_value = med_t
+        if name == "mnist8m":
+            gflops = configs_out[name]["gflops_median"]
+            mfu_out = configs_out[name]["mfu_median"]
+    if headline_value is None:  # epsilon failed: fall back to any config
+        for name in names:
+            if configs_out[name].get("ok"):
+                headline_value = configs_out[name]["t_hit_median_s"]
+                break
+    if gflops is None:
+        for name in names:
+            if configs_out[name].get("ok"):
+                gflops = configs_out[name]["gflops_median"]
+                mfu_out = configs_out[name]["mfu_median"]
+                break
+    ok_all = all(configs_out[n].get("ok") for n in names)
+    # a failed config contributes ratio 0.0: vs_baseline is defined as
+    # "EVERY dataset beats its reference estimate by at least this factor",
+    # so a partial failure must not report the min over survivors
+    for n in names:
+        if not configs_out[n].get("ok"):
+            ratios.append(0.0)
+    emit({
+        "metric": "asgd_time_to_target_3datasets",
+        "value": headline_value if headline_value is not None else 0.0,
+        "unit": "s" if ok_all else "s (SOME CONFIGS FAILED)",
+        "vs_baseline": round(min(ratios), 2) if ratios else 0.0,
+        "configs": configs_out,
+        "gflops": gflops,
+        "mfu": mfu_out,
+    })
+
+
+def main() -> None:
+    if "--config" in sys.argv:
+        name = sys.argv[sys.argv.index("--config") + 1]
+        arm_watchdog(name)
+        try:
+            run_child(name)
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            emit({"config": name, "ok": False,
+                  "note": f"FAILED: {type(e).__name__}: {str(e)[:200]}"})
+            sys.exit(0)
+    else:
+        try:
+            run_parent()
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "asgd_time_to_target_3datasets", "value": 0.0,
+                  "unit": f"s (FAILED: {type(e).__name__}: {str(e)[:200]})",
+                  "vs_baseline": 0.0})
+            sys.exit(0)
 
 
 if __name__ == "__main__":
-    arm_watchdog()
-    try:
-        main()
-    except Exception as e:
-        # Persistent failure: still produce ONE parseable JSON line so the
-        # round records a diagnosable result instead of a bare traceback.
-        traceback.print_exc(file=sys.stderr)
-        emit(0.0, f"s (FAILED: {type(e).__name__}: {str(e)[:200]})", 0.0)
-        sys.exit(0)
+    main()

