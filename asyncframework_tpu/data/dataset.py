@@ -29,6 +29,7 @@ TPU mapping / design deltas:
 from __future__ import annotations
 
 import bisect
+import pickle
 import threading
 from typing import (
     Any,
@@ -128,17 +129,20 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
         """Materialize one partition (on the calling thread).
 
         Cache hits return a fresh list (shallow copy) so downstream in-place
-        list mutation cannot corrupt the cached payload.
+        list mutation cannot corrupt the cached payload.  The cache dict is
+        captured once per call: :meth:`checkpoint` may null ``_cache`` from
+        another thread mid-action (writing into the dead dict is harmless).
         """
-        if self._cache is not None:
+        cache = self._cache
+        if cache is not None:
             with self._cache_lock:
-                hit = self._cache.get(wid)
+                hit = cache.get(wid)
             if hit is not None:
                 return list(hit)
         out = list(self._parts[wid]())
-        if self._cache is not None:
+        if cache is not None:
             with self._cache_lock:
-                self._cache[wid] = out
+                cache[wid] = out
                 out = list(out)
         return out
 
@@ -147,6 +151,94 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
         if self._cache is None:
             self._cache = {}
         return self
+
+    # ------------------------------------------------------------- checkpoint
+    def checkpoint(self, directory: str) -> "DistributedDataset[E]":
+        """Materialize every partition to reliable storage and TRUNCATE
+        lineage.
+
+        Parity: ``RDD.checkpoint`` (``rdd/RDD.scala:1773``) +
+        ``ReliableCheckpointRDD`` (``rdd/ReliableCheckpointRDD.scala:38``) --
+        after this call the (possibly long) upstream closure chain is cut:
+        this dataset's partitions read back from ``directory``, upstream
+        compute never runs again, and the data survives process restart via
+        :meth:`from_checkpoint`.  Two deliberate deltas from the reference:
+        materialization is EAGER (the reference defers to the end of the
+        next job -- with lazy closures there is no "next job" hook worth the
+        surprise), and payload device arrays are stored as host numpy (a
+        restarted process re-places them; device residency is a property of
+        the worker, not the bytes).
+
+        Layout (FsHistoryProvider-style): ``part-NNNNN.pkl`` per partition,
+        ``_meta.json``, then a ``_SUCCESS`` marker written LAST -- a reader
+        never trusts a directory without it (torn writes are invisible).
+        """
+        import json
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        # invalidate any previous checkpoint FIRST: a crash mid-rewrite must
+        # never leave an old _SUCCESS blessing a torn mix of old/new parts
+        for marker in ("_SUCCESS", "_meta.json"):
+            try:
+                os.remove(os.path.join(directory, marker))
+            except FileNotFoundError:
+                pass
+
+        def write_part(wid: int):
+            # runs ON the partition's worker: one partition in memory at a
+            # time per worker (ReliableCheckpointRDD writes per-task too),
+            # not the whole dataset staged on the driver
+            def task():
+                payload = self._compute(wid)
+                path = os.path.join(directory, f"part-{wid:05d}.pkl")
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump(
+                        [_payload_to_host(e) for e in payload],
+                        f,
+                        protocol=pickle.HIGHEST_PROTOCOL,
+                    )
+                os.replace(tmp, path)
+                return wid
+
+            return task
+
+        written = self._run_sync(write_part)
+        meta = {"format": 1, "partitions": sorted(written)}
+        with open(os.path.join(directory, "_meta.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(directory, "_SUCCESS"), "w") as f:
+            f.write("")
+        # lineage truncation: from here on, partitions come from disk
+        with self._cache_lock:
+            self._parts = {
+                wid: _checkpoint_loader(directory, wid) for wid in written
+            }
+            self._cache = None  # payloads may be large; disk is the pin now
+        return self
+
+    @classmethod
+    def from_checkpoint(
+        cls, scheduler: JobScheduler, directory: str
+    ) -> "DistributedDataset[E]":
+        """Reconstruct a checkpointed dataset in a (possibly new) process."""
+        import json
+        import os
+
+        if not os.path.exists(os.path.join(directory, "_SUCCESS")):
+            raise FileNotFoundError(
+                f"no complete checkpoint at {directory!r} (missing _SUCCESS)"
+            )
+        with open(os.path.join(directory, "_meta.json")) as f:
+            meta = json.load(f)
+        return cls(
+            scheduler,
+            {
+                int(wid): _checkpoint_loader(directory, int(wid))
+                for wid in meta["partitions"]
+            },
+        )
 
     def _run_job_dict(
         self,
@@ -787,6 +879,40 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
             lambda _exc: [ctx.mark_available(w) for w in wids]
         )
         return waiter
+
+
+def _payload_to_host(e):
+    """Recursively convert device arrays to host numpy for pickling
+    (tuples/lists/dicts of arrays are common payload shapes).  Tuple
+    subclasses (namedtuples) are rebuilt with their own type so a
+    checkpoint round trip preserves element types."""
+    import jax
+
+    if isinstance(e, jax.Array):
+        return np.asarray(e)
+    if isinstance(e, tuple):
+        converted = [_payload_to_host(x) for x in e]
+        if type(e) is tuple:
+            return tuple(converted)
+        return type(e)(*converted)  # namedtuple and friends
+    if isinstance(e, list):
+        return [_payload_to_host(x) for x in e]
+    if isinstance(e, dict):
+        return {k: _payload_to_host(v) for k, v in e.items()}
+    return e
+
+
+def _checkpoint_loader(directory: str, wid: int):
+    """Partition-reader closure; runs on the partition's worker thread."""
+    import os
+
+    path = os.path.join(directory, f"part-{wid:05d}.pkl")
+
+    def load():
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    return load
 
 
 def _hashable_u64(xs: List) -> np.ndarray:
