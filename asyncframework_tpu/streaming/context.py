@@ -31,6 +31,7 @@ class StreamingContext:
         self.clock = clock or SystemClock()
         self._outputs: List[Tuple[DStream, Callable[[int, Any], None]]] = []
         self._statefuls: List = []  # StatefulDStream registration order = id
+        self._receivers: List = []  # ReceiverStreams (rate-control feedback)
         self._ckpt_mgr = None
         self._ckpt_every = 0
         self._pending_restore = None
@@ -45,6 +46,9 @@ class StreamingContext:
         if self._started:
             raise RuntimeError("cannot add outputs after start()")
         self._outputs.append((ds, fn))
+
+    def _register_receiver(self, ds) -> None:
+        self._receivers.append(ds)
 
     def _register_stateful(self, ds) -> None:
         idx = len(self._statefuls)
@@ -149,17 +153,29 @@ class StreamingContext:
         )
 
     # ------------------------------------------------------------ job generation
-    def generate_batch(self, time_ms: int) -> int:
+    def generate_batch(self, time_ms: int, scheduled_at_ms=None) -> int:
         """Run one interval synchronously; returns #outputs that fired.
 
         Exposed for deterministic tests (JobGenerator tick parity).
+        ``scheduled_at_ms``: the interval's target time on the CONTEXT
+        clock (absolute); the generator loop passes it so receivers see a
+        real scheduling delay -- PIDRateEstimator.scala's integral input.
         """
+        t_start = self.clock.now_ms()
+        scheduling_delay = (
+            max(0.0, t_start - scheduled_at_ms)
+            if scheduled_at_ms is not None
+            else 0.0
+        )
         fired = 0
         for ds, fn in self._outputs:
             batch = ds.get_or_compute(time_ms)
             if batch is not EMPTY:
                 fn(time_ms, batch)
                 fired += 1
+        processing_delay = max(self.clock.now_ms() - t_start, 0.0)
+        for rec in self._receivers:
+            rec.on_batch_completed(time_ms, processing_delay, scheduling_delay)
         with self._lock:
             self._processed_batches += 1
         self._maybe_checkpoint(time_ms // self.batch_interval_ms)
@@ -186,7 +202,9 @@ class StreamingContext:
                 while self.clock.now_ms() < target:
                     if self.clock.wait_for(self._stop, 0.01):
                         return
-                self.generate_batch(n * self.batch_interval_ms)
+                self.generate_batch(
+                    n * self.batch_interval_ms, scheduled_at_ms=target
+                )
                 n += 1
 
         self._thread = threading.Thread(

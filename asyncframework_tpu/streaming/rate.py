@@ -1,0 +1,163 @@
+"""Receiver rate control: PID estimation + token-bucket limiting.
+
+Parity: ``streaming/.../scheduler/rate/PIDRateEstimator.scala:48`` (the
+estimator: a textbook PID loop on processing rate, with scheduling delay as
+the integral term) and ``receiver/RateLimiter.scala`` (the enforcement side:
+the block generator's guava RateLimiter).  Together they are Spark
+Streaming's backpressure: when batches take longer than the interval, the
+receiver's permitted ingest rate ramps down until the pipeline keeps up.
+
+TPU build note: ingestion is host-side (receivers feed host buffers that the
+interval clock drains), so this subsystem is pure host logic -- but without
+it a fast producer OOMs the host while the chip is busy, which is exactly
+the failure the reference built backpressure for.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class PIDRateEstimator:
+    """Estimate the ingest rate (elements/sec) the pipeline can sustain.
+
+    ``compute`` is fed one observation per completed batch:
+    (completion time, batch size, processing delay, scheduling delay).
+    Semantics follow ``PIDRateEstimator.scala:48``:
+
+    - error            = latest_rate - processing_rate
+    - historical_error = scheduling_delay * processing_rate / batch_interval
+      (elements queued *behind* schedule, expressed as a rate)
+    - d_error          = (error - latest_error) / delta_t
+
+    new_rate = max(latest_rate - Kp*error - Ki*historical_error - Kd*d_error,
+                   min_rate); returns None until it has two observations or
+    when the observation is degenerate (empty batch / zero delay).
+    """
+
+    def __init__(
+        self,
+        batch_interval_ms: float,
+        proportional: float = 1.0,
+        integral: float = 0.2,
+        derivative: float = 0.0,
+        min_rate: float = 100.0,
+    ):
+        if batch_interval_ms <= 0:
+            raise ValueError("batch_interval_ms must be > 0")
+        if min(proportional, integral, derivative) < 0 or min_rate <= 0:
+            raise ValueError("PID gains must be >= 0 and min_rate > 0")
+        self.batch_interval_s = batch_interval_ms / 1e3
+        self.kp = proportional
+        self.ki = integral
+        self.kd = derivative
+        self.min_rate = min_rate
+        self._latest_time_ms: Optional[float] = None
+        self._latest_rate: Optional[float] = None
+        self._latest_error = 0.0
+        self._lock = threading.Lock()
+
+    def compute(
+        self,
+        time_ms: float,
+        num_elements: int,
+        processing_delay_ms: float,
+        scheduling_delay_ms: float,
+    ) -> Optional[float]:
+        with self._lock:
+            valid = (
+                num_elements > 0
+                and processing_delay_ms > 0
+                and (self._latest_time_ms is None
+                     or time_ms > self._latest_time_ms)
+            )
+            if not valid:
+                return None
+            processing_rate = num_elements / (processing_delay_ms / 1e3)
+            if self._latest_rate is None:
+                # first observation seeds the loop at the measured rate
+                self._latest_time_ms = time_ms
+                self._latest_rate = processing_rate
+                self._latest_error = 0.0
+                return None
+            delta_s = (time_ms - self._latest_time_ms) / 1e3
+            error = self._latest_rate - processing_rate
+            historical = (
+                (scheduling_delay_ms / 1e3) * processing_rate
+                / self.batch_interval_s
+            )
+            d_error = (error - self._latest_error) / max(delta_s, 1e-9)
+            new_rate = max(
+                self._latest_rate
+                - self.kp * error
+                - self.ki * historical
+                - self.kd * d_error,
+                self.min_rate,
+            )
+            self._latest_time_ms = time_ms
+            self._latest_rate = new_rate
+            self._latest_error = error
+            return new_rate
+
+
+class RateLimiter:
+    """Blocking token bucket: ``acquire()`` admits one element, waiting
+    when the current second's allowance is spent (RateLimiter.scala role).
+
+    ``set_rate`` is thread-safe and takes effect immediately -- the
+    estimator calls it from the batch-completion path while the receiver
+    thread sits in ``acquire``.
+    """
+
+    def __init__(self, rate: Optional[float] = None, burst_s: float = 0.1):
+        self._rate = rate  # None = unlimited
+        self._burst_s = burst_s  # bucket depth in seconds of allowance
+        self._tokens = 0.0
+        self._stamp = time.monotonic()
+        self._cv = threading.Condition()
+
+    @property
+    def rate(self) -> Optional[float]:
+        with self._cv:
+            return self._rate
+
+    def set_rate(self, rate: Optional[float]) -> None:
+        with self._cv:
+            self._rate = rate
+            self._cv.notify_all()
+
+    def _refill_locked(self) -> None:
+        now = time.monotonic()
+        if self._rate is not None:
+            cap = self._rate * self._burst_s
+            self._tokens = min(cap, self._tokens + (now - self._stamp) * self._rate)
+        self._stamp = now
+
+    def try_acquire(self) -> bool:
+        """Non-blocking: True = admitted (drop policies use this)."""
+        with self._cv:
+            if self._rate is None:
+                return True
+            self._refill_locked()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def acquire(self, stop_check=None, poll_s: float = 0.01) -> bool:
+        """Block until admitted; returns False if ``stop_check()`` turned
+        true first (receiver shutdown must never deadlock in the limiter)."""
+        while True:
+            with self._cv:
+                if self._rate is None:
+                    return True
+                self._refill_locked()
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return True
+                need_s = (1.0 - self._tokens) / self._rate
+            if stop_check is not None and stop_check():
+                return False
+            time.sleep(min(need_s, poll_s))

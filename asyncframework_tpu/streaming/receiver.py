@@ -28,22 +28,101 @@ class ReceiverStream(DStream):
     Subclasses (or callers via :meth:`store`) push elements; each interval's
     ``compute`` drains everything buffered since the previous interval into
     one batch (list of elements), or EMPTY when nothing arrived.
+
+    Backpressure (``PIDRateEstimator.scala:48`` + bounded block-generator
+    buffer): ``max_buffer`` bounds the in-flight element count -- a producer
+    faster than the consumer then either *blocks* in :meth:`store` (default;
+    TCP pushback for socket sources) or *drops* (``overflow="drop"``).
+    ``backpressure=True`` additionally runs a PID estimator over completed
+    batches and ramps the admitted ingest rate to what the pipeline
+    sustains; ``max_rate`` seeds/caps it (``spark.streaming.receiver.
+    maxRate`` analog).  All control is host-side; :meth:`store` never
+    deadlocks on shutdown (it polls ``stopped``).
     """
 
-    def __init__(self, ssc, wal=None):
+    def __init__(self, ssc, wal=None, max_buffer: Optional[int] = None,
+                 overflow: str = "block", backpressure: bool = False,
+                 max_rate: Optional[float] = None):
         super().__init__(ssc)
+        if overflow not in ("block", "drop"):
+            raise ValueError(f"overflow must be 'block' or 'drop', got {overflow!r}")
         self._buf: List[Any] = []
-        self._buf_lock = threading.Lock()
+        self._buf_lock = threading.Condition()
         self._wal = wal
         self._started = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._max_buffer = max_buffer
+        self._overflow = overflow
+        self.dropped = 0          # elements rejected by buffer/rate policy
+        self.peak_buffer = 0      # high-water mark (test/metrics hook)
+        self._last_drain = 0      # size of the most recent drained batch
+        from asyncframework_tpu.streaming.rate import (
+            PIDRateEstimator,
+            RateLimiter,
+        )
+
+        self._limiter = RateLimiter(max_rate)
+        self._estimator = (
+            PIDRateEstimator(ssc.batch_interval_ms, min_rate=10.0)
+            if backpressure
+            else None
+        )
+        self._max_rate = max_rate
+        ssc._register_receiver(self)
 
     # ------------------------------------------------------------- receiver
-    def store(self, element: Any) -> None:
-        """Called by the receiver thread for each ingested element."""
+    def store(self, element: Any) -> bool:
+        """Called by the receiver thread for each ingested element.
+
+        Returns False when the element was NOT admitted (dropped, or the
+        receiver stopped while blocked) -- reliable sources use this to
+        hold their ack.
+        """
+        if self._overflow == "drop":
+            if not self._limiter.try_acquire():
+                self.dropped += 1
+                return False
+        elif not self._limiter.acquire(stop_check=self._stop.is_set):
+            return False  # stopped while blocked on the rate
         with self._buf_lock:
+            while (
+                self._max_buffer is not None
+                and len(self._buf) >= self._max_buffer
+            ):
+                if self._overflow == "drop":
+                    self.dropped += 1
+                    return False
+                if self._stop.is_set():
+                    return False
+                self._buf_lock.wait(timeout=0.05)
             self._buf.append(element)
+            self.peak_buffer = max(self.peak_buffer, len(self._buf))
+        return True
+
+    # ------------------------------------------------------- rate feedback
+    def on_batch_completed(
+        self,
+        time_ms: float,
+        processing_delay_ms: float,
+        scheduling_delay_ms: float,
+    ) -> None:
+        """Fed by the job generator after each interval; updates the
+        admitted ingest rate from the PID estimate (capped at max_rate)."""
+        if self._estimator is None:
+            return
+        rate = self._estimator.compute(
+            time_ms, self._last_drain, processing_delay_ms,
+            scheduling_delay_ms,
+        )
+        if rate is not None:
+            if self._max_rate is not None:
+                rate = min(rate, self._max_rate)
+            self._limiter.set_rate(rate)
+
+    @property
+    def current_rate(self) -> Optional[float]:
+        return self._limiter.rate
 
     def on_start(self) -> None:  # pragma: no cover - subclass hook
         """Receiver body; runs on the receiver thread until ``stopped``."""
@@ -70,8 +149,11 @@ class ReceiverStream(DStream):
     def compute(self, time_ms: int) -> Any:
         with self._buf_lock:
             if not self._buf:
+                self._last_drain = 0
                 return EMPTY
             batch, self._buf = self._buf, []
+            self._last_drain = len(batch)
+            self._buf_lock.notify_all()  # blocked producers may proceed
         if self._wal is not None:
             self._wal.append(time_ms, batch)
         return batch

@@ -7,6 +7,7 @@ window is exactly reproducible; WAL crash-recovery mirrors
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -507,3 +508,120 @@ class TestPairStreamJoins:
         assert sorted(out[0][1]) == [
             ("k", (1, 10)), ("k", (1, 20)), ("k", (2, 10)), ("k", (2, 20))
         ]
+
+
+class TestBackpressure:
+    """PIDRateEstimator.scala:48 parity + bounded-buffer receiver policies:
+    a producer 10x faster than the consumer must neither OOM nor deadlock,
+    and the admitted rate must converge toward what the pipeline sustains."""
+
+    def test_pid_ramps_down_to_processing_rate(self):
+        from asyncframework_tpu.streaming.rate import PIDRateEstimator
+
+        est = PIDRateEstimator(batch_interval_ms=100, min_rate=10.0)
+        # pipeline sustains 500 el/s; first obs seeds, then overloaded
+        assert est.compute(100, 100, 200.0, 0.0) is None  # seed: 500 el/s
+        rates = []
+        for i in range(2, 12):
+            # keep observing 500 el/s processing with growing backlog
+            r = est.compute(i * 100, 100, 200.0, 50.0)
+            rates.append(r)
+        assert all(r is not None for r in rates)
+        # converges near the sustainable 500 el/s and never below min_rate
+        assert abs(rates[-1] - 500.0) < 100.0
+        assert min(rates) >= 10.0
+
+    def test_pid_rejects_degenerate_observations(self):
+        from asyncframework_tpu.streaming.rate import PIDRateEstimator
+
+        est = PIDRateEstimator(batch_interval_ms=100)
+        assert est.compute(100, 0, 50.0, 0.0) is None      # empty batch
+        assert est.compute(200, 10, 0.0, 0.0) is None      # zero delay
+        est.compute(300, 10, 50.0, 0.0)                    # seed
+        assert est.compute(300, 10, 50.0, 0.0) is None     # non-advancing t
+
+    def test_bounded_buffer_blocks_without_loss(self):
+        import threading as th
+
+        from asyncframework_tpu.streaming.context import StreamingContext
+        from asyncframework_tpu.streaming.receiver import ReceiverStream
+
+        ssc = StreamingContext(batch_interval_ms=10, clock=ManualClock())
+        rx = ReceiverStream(ssc, max_buffer=100)
+        total = 5000
+        done = th.Event()
+
+        def produce():
+            for i in range(total):
+                rx.store(i)
+            done.set()
+
+        t = th.Thread(target=produce, daemon=True)
+        t.start()
+        got = []
+        deadline = time.monotonic() + 30
+        tick = 0
+        while (not done.is_set() or rx._buf) and time.monotonic() < deadline:
+            tick += 10
+            b = rx.compute(tick)
+            if b is not EMPTY:
+                got.extend(b)
+            time.sleep(0.001)
+        t.join(timeout=5)
+        assert done.is_set(), "producer deadlocked against the bounded buffer"
+        assert rx.peak_buffer <= 100
+        assert rx.dropped == 0
+        assert sorted(got) == list(range(total))  # block mode loses nothing
+
+    def test_drop_policy_sheds_load_without_growth(self):
+        from asyncframework_tpu.streaming.context import StreamingContext
+        from asyncframework_tpu.streaming.receiver import ReceiverStream
+
+        ssc = StreamingContext(batch_interval_ms=10, clock=ManualClock())
+        rx = ReceiverStream(ssc, max_buffer=50, overflow="drop")
+        for i in range(1000):  # no consumer draining
+            rx.store(i)
+        assert rx.peak_buffer <= 50
+        assert rx.dropped == 1000 - 50
+
+    def test_backpressure_converges_under_overload(self):
+        import threading as th
+
+        from asyncframework_tpu.streaming.context import StreamingContext
+        from asyncframework_tpu.streaming.receiver import ReceiverStream
+
+        # real clock: the PID loop needs real scheduling/processing delays
+        ssc = StreamingContext(batch_interval_ms=30)
+        rx = ReceiverStream(ssc, max_buffer=500, backpressure=True)
+        seen = []
+
+        def slow_consumer(_t, batch):
+            seen.append(len(batch))
+            time.sleep(0.06)  # 2x the interval: pipeline is overloaded
+
+        rx.foreach_batch(slow_consumer)
+        stop = th.Event()
+
+        def produce():
+            i = 0
+            while not stop.is_set():
+                rx.store(i)  # as fast as admitted
+                i += 1
+
+        prod = th.Thread(target=produce, daemon=True)
+        ssc.start()
+        prod.start()
+        try:
+            ssc.await_intervals(12, timeout_s=30.0)
+        finally:
+            stop.set()
+            rx.stop()
+            ssc.stop()
+            prod.join(timeout=5)
+        # the estimator engaged and throttled ingest to a finite rate
+        assert rx.current_rate is not None
+        assert rx.peak_buffer <= 500
+        # batches shrank: the tail averages below the head's unthrottled size
+        head = sum(seen[:3]) / max(len(seen[:3]), 1)
+        tail = sum(seen[-3:]) / max(len(seen[-3:]), 1)
+        assert tail < head, (seen, rx.current_rate)
