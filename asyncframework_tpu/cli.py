@@ -120,6 +120,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(requires --event-log)")
     p.add_argument("--metrics-csv", default=None,
                    help="periodic metrics samples as CSV")
+    p.add_argument("--ui-port", type=int, default=None, metavar="PORT",
+                   help="serve a live run dashboard on this HTTP port "
+                        "during the run (0 = ephemeral; SparkUI parity)")
     p.add_argument("--speculation", action="store_true",
                    help="launch speculative copies of straggling tasks")
     p.add_argument("--dynamic-allocation", action="store_true",
@@ -271,6 +274,7 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         checkpoint_freq=args.checkpoint_freq,
         event_log=args.event_log,
         metrics_csv=args.metrics_csv,
+        ui_port=args.ui_port,
         speculation=args.speculation,
         dynamic_allocation=args.dynamic_allocation,
         stale_read_offset=args.stale_read,

@@ -1,0 +1,222 @@
+"""Live run dashboard: HTTP endpoint serving in-progress run state.
+
+Parity: ``ui/SparkUI.scala:39`` -- the reference serves jobs / stages /
+executors pages *during* a run from the listener-bus-fed AppStatusStore;
+the post-hoc analog here is ``metrics/report.py`` + ``bin/async-history``.
+This module closes the gap VERDICT r2 item 7 named: a long ASGD run is no
+longer a black box until it ends.
+
+Design: a :class:`LiveStateListener` subscribes to the run's ListenerBus
+(same events the event log gets) and folds them into one JSON-able snapshot
+-- rounds, accepted/dropped, updates/s, staleness histogram, queue depth,
+per-worker state, losses/moves/speculation.  A stdlib ThreadingHTTPServer
+(daemon threads, ephemeral port support) serves:
+
+- ``GET /api/status`` -- the snapshot (machine-readable; tests poll this)
+- ``GET /``           -- a self-refreshing HTML view of the same snapshot
+
+Zero dependencies, nothing on the hot path: the listener runs on the bus's
+single drain thread; HTTP reads take the same lock only per request.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from asyncframework_tpu.metrics.bus import (
+    Event,
+    GradientMerged,
+    Listener,
+    ModelSnapshot,
+    RoundSubmitted,
+    ShardMoved,
+    SpeculativeLaunch,
+    WorkerLost,
+)
+
+#: running servers by most-recent-first (tests and tools discover ephemeral
+#: ports here; entries are removed on stop)
+_ACTIVE: List["LiveUIServer"] = []
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_servers() -> List["LiveUIServer"]:
+    with _ACTIVE_LOCK:
+        return list(_ACTIVE)
+
+
+class LiveStateListener(Listener):
+    """Folds bus events into the dashboard snapshot (AppStatusStore role)."""
+
+    STALENESS_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64)
+
+    def __init__(self, num_workers: int):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.num_workers = num_workers
+        self.rounds = 0
+        self.accepted = 0
+        self.dropped = 0
+        self.model_version = 0
+        self.workers_lost = 0
+        self.shards_moved = 0
+        self.speculative_launches = 0
+        self.last_objective: Optional[float] = None
+        self.staleness_hist = [0] * (len(self.STALENESS_BUCKETS) + 1)
+        self.max_staleness = 0
+        # per-worker: {state, merges, accepted, last_staleness, last_seen_ms}
+        self.workers: Dict[int, Dict] = {
+            w: {"state": "idle", "merges": 0, "accepted": 0,
+                "last_staleness": None, "last_seen_ms": None}
+            for w in range(num_workers)
+        }
+        self._queue_depth_fn: Optional[Callable[[], int]] = None
+
+    def register_queue_depth(self, fn: Callable[[], int]) -> None:
+        self._queue_depth_fn = fn
+
+    # ----------------------------------------------------------- bus events
+    def on_event(self, event: Event) -> None:
+        with self._lock:
+            if isinstance(event, RoundSubmitted):
+                # count events rather than trusting round_idx: async paths
+                # post 1-based counters, sync paths 0-based loop indices
+                self.rounds += 1
+                self.model_version = event.model_version
+                for wid in event.cohort:
+                    if wid in self.workers:
+                        self.workers[wid]["state"] = "running"
+            elif isinstance(event, GradientMerged):
+                if event.accepted:
+                    self.accepted += 1
+                else:
+                    self.dropped += 1
+                s = event.staleness
+                self.max_staleness = max(self.max_staleness, s)
+                import bisect
+
+                # bisect_left: staleness == bucket bound belongs in "<=b"
+                self.staleness_hist[
+                    bisect.bisect_left(self.STALENESS_BUCKETS, s)
+                ] += 1
+                w = self.workers.get(event.worker_id)
+                if w is not None:
+                    w["state"] = "idle"
+                    w["merges"] += 1
+                    w["accepted"] += int(event.accepted)
+                    w["last_staleness"] = s
+                    w["last_seen_ms"] = event.time_ms
+            elif isinstance(event, WorkerLost):
+                self.workers_lost += 1
+                w = self.workers.get(event.worker_id)
+                if w is not None:
+                    w["state"] = "lost"
+            elif isinstance(event, ShardMoved):
+                self.shards_moved += 1
+            elif isinstance(event, SpeculativeLaunch):
+                self.speculative_launches += 1
+            elif isinstance(event, ModelSnapshot):
+                self.last_objective = event.objective
+
+    # ------------------------------------------------------------- snapshot
+    def snapshot(self) -> Dict:
+        with self._lock:
+            elapsed = time.monotonic() - self._t0
+            buckets = [
+                f"<={b}" for b in self.STALENESS_BUCKETS
+            ] + [f">{self.STALENESS_BUCKETS[-1]}"]
+            return {
+                "elapsed_s": round(elapsed, 3),
+                "rounds": self.rounds,
+                "accepted": self.accepted,
+                "dropped": self.dropped,
+                "updates_per_sec": round(self.accepted / elapsed, 1)
+                if elapsed > 0 else 0.0,
+                "model_version": self.model_version,
+                "queue_depth": (
+                    self._queue_depth_fn() if self._queue_depth_fn else None
+                ),
+                "staleness": dict(zip(buckets, self.staleness_hist)),
+                "max_staleness": self.max_staleness,
+                "workers_lost": self.workers_lost,
+                "shards_moved": self.shards_moved,
+                "speculative_launches": self.speculative_launches,
+                "last_objective": self.last_objective,
+                "workers": {str(k): dict(v) for k, v in self.workers.items()},
+            }
+
+
+_PAGE = """<!doctype html><html><head><title>async run</title>
+<meta http-equiv="refresh" content="1">
+<style>body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+table{border-collapse:collapse}td,th{border:1px solid #444;padding:4px 10px}
+h1{font-size:1.2em}.k{color:#8cf}</style></head><body>
+<h1>asyncframework-tpu &mdash; live run</h1><pre id="s">%s</pre>
+</body></html>"""
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "AsyncLiveUI/1"
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+        state = self.server.state_listener  # type: ignore[attr-defined]
+        if self.path.startswith("/api/status"):
+            body = json.dumps(state.snapshot()).encode()
+            self._send(200, body, "application/json")
+        elif self.path == "/" or self.path.startswith("/index"):
+            snap = json.dumps(state.snapshot(), indent=2)
+            self._send(200, (_PAGE % snap).encode(), "text/html")
+        else:
+            self._send(404, b"not found", "text/plain")
+
+    def log_message(self, *a) -> None:  # quiet: no stderr per request
+        pass
+
+
+class LiveUIServer:
+    """Threaded HTTP server around a :class:`LiveStateListener`.
+
+    ``port=0`` binds an ephemeral port (read it from ``.port`` after
+    ``start``; also discoverable via :func:`active_servers`).
+    """
+
+    def __init__(self, state: LiveStateListener, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.state = state
+        self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.state_listener = state  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "LiveUIServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="live-ui", daemon=True
+        )
+        self._thread.start()
+        with _ACTIVE_LOCK:
+            _ACTIVE.insert(0, self)
+        return self
+
+    def stop(self) -> None:
+        with _ACTIVE_LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

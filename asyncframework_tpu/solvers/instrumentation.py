@@ -60,6 +60,20 @@ class RunInstruments:
             self.bus.add_listener(self.writer)
             self.bus.start()
 
+        self.ui = None
+        self.live_state = None
+        ui_port = getattr(cfg, "ui_port", None)
+        if ui_port is not None:
+            from asyncframework_tpu.metrics.live import (
+                LiveStateListener,
+                LiveUIServer,
+            )
+
+            self.live_state = LiveStateListener(num_workers)
+            self.bus.add_listener(self.live_state)
+            self.bus.start()
+            self.ui = LiveUIServer(self.live_state, port=ui_port).start()
+
         metrics_csv = getattr(cfg, "metrics_csv", None)
         metrics_jsonl = getattr(cfg, "metrics_jsonl", None)
         if metrics_csv or metrics_jsonl:
@@ -85,6 +99,8 @@ class RunInstruments:
         """Expose the result queue's depth as a polled metrics source."""
         if self.metrics is not None:
             self.metrics.register_source("queue", lambda: {"depth": fn()})
+        if self.live_state is not None:
+            self.live_state.register_queue_depth(fn)
 
     def on_round_submitted(
         self, round_idx: int, cohort, model_version: int
@@ -164,6 +180,8 @@ class RunInstruments:
             self.metrics.report()  # final sample so short runs get >= 1 row
             self.metrics.stop()
         self.bus.stop()
+        if self.ui is not None:
+            self.ui.stop()
         if self.writer is not None:
             self.writer.close()
 
@@ -178,6 +196,8 @@ class RunInstruments:
                 out["shards_moved"] = self.shards_moved
         if self.bus.dropped_events:
             out["dropped_events"] = self.bus.dropped_events
+        if self.ui is not None:
+            out["ui_port"] = self.ui.port
         return out
 
 

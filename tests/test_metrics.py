@@ -325,3 +325,82 @@ class TestHistory:
         html_text = index.read_text()
         assert "unreadable" not in html_text
         assert (index.parent / "crashed.jsonl.html").exists()
+
+
+class TestLiveUI:
+    """SparkUI parity: run state is served over HTTP DURING the run."""
+
+    def test_fetch_status_mid_run(self, devices8):
+        import json
+        import threading
+        import urllib.request
+
+        from asyncframework_tpu.data import make_regression
+        from asyncframework_tpu.metrics.live import active_servers
+        from asyncframework_tpu.solvers import ASGD, SolverConfig
+
+        X, y, _ = make_regression(2048, 16, seed=3)
+        cfg = SolverConfig(
+            num_workers=8, num_iterations=2000, gamma=0.5, batch_rate=0.3,
+            bucket_ratio=0.5, printer_freq=100, seed=42,
+            calibration_iters=10, run_timeout_s=120.0, ui_port=0,
+        )
+        holder = {}
+
+        def run():
+            holder["res"] = ASGD(X, y, cfg, devices=devices8).run()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # discover the ephemeral port, then poll /api/status mid-run
+        deadline = time.monotonic() + 30
+        snap = None
+        while time.monotonic() < deadline:
+            servers = active_servers()
+            if servers:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{servers[0].port}/api/status",
+                        timeout=5,
+                    ) as r:
+                        snap = json.loads(r.read())
+                except OSError:
+                    snap = None
+                if snap and snap["accepted"] > 0:
+                    break
+            time.sleep(0.01)
+        t.join(timeout=60)
+        assert snap is not None, "never fetched a live snapshot"
+        assert snap["accepted"] > 0 and snap["rounds"] > 0
+        assert "staleness" in snap and "workers" in snap
+        assert len(snap["workers"]) == 8
+        assert snap["queue_depth"] is not None
+        res = holder["res"]
+        assert res.extras.get("ui_port", 0) > 0
+        # server is torn down with the run
+        assert not active_servers()
+
+    def test_html_index_served(self):
+        import urllib.request
+
+        from asyncframework_tpu.metrics.live import (
+            LiveStateListener,
+            LiveUIServer,
+        )
+
+        state = LiveStateListener(4)
+        srv = LiveUIServer(state, port=0).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/", timeout=5
+            ) as r:
+                body = r.read().decode()
+            assert "live run" in body
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5
+            ) as r:
+                pass
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        finally:
+            srv.stop()
