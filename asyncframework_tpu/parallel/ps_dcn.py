@@ -1,0 +1,484 @@
+"""Asynchronous parameter server across OS processes (the DCN channel).
+
+Parity: the reference's whole point is async gradient flow from REMOTE
+workers to the driver -- executor processes push task results over Netty
+RPC to the driver's result queue
+(``CoarseGrainedSchedulerBackend.scala:239-307``,
+``CoarseGrainedExecutorBackend.scala:92``), where the updater thread applies
+the tau-filter and gamma-schedule.  This module is that capability for the
+TPU build: a **parameter-server process** owning the model on its device,
+and **worker processes** owning data shards on theirs, joined by a thin
+length-prefixed TCP protocol (the Netty-RPC analog; deliberately NOT
+``jax.distributed`` collectives -- XLA collectives are lockstep SPMD, and
+bounded-staleness asynchrony is precisely the regime where lockstep is
+wrong.  Spark's channel is an RPC mesh for the same reason).
+
+Semantics preserved from the single-process engine (solvers/asgd.py):
+
+- logical clock = number of merged gradients; a model handed to a worker is
+  stamped with the clock at send time; staleness at merge = clock - stamp;
+  accept iff ``staleness <= taw`` else drop (worker is re-served either way)
+  -- ``SparkASGDThread.scala:169,199-202``.
+- accept applies ``w -= gamma/sqrt(k/P+1)/parRecs * g`` on the PS device via
+  the SAME jitted ``make_asgd_apply`` executable the single-process updater
+  uses.
+- partial-barrier cohorts: with ``bucket_ratio > 0`` the PS releases PULL
+  requests in waves -- it holds arriving pulls until
+  ``floor(P * bucket_ratio)`` workers are simultaneously waiting, then
+  serves all of them the same model version (``ASYNCbarrier`` +
+  ``bucketRatio`` wait loop, ``SparkASGDThread.scala:230-234,282-283``).
+- straggler injection: workers apply the DelayModel locally after the PS
+  finishes calibration and broadcasts the measured average task time
+  (``SparkASGDThread.scala:121-138,244-249``).
+
+Wire protocol (one JSON header line + optional raw f32/npz payload, length
+prefixed): PULL -> MODEL(k, w) | PUSH(ts, g) -> ACK(accepted) |
+EVAL(W stack) -> LOSSES | DONE.  The PS cannot evaluate the loss trajectory
+itself (it holds no data), so at end-of-run each worker scores the snapshot
+stack against its shards and the PS sums -- the distributed analog of
+``optVars`` evaluation (``SparkASGDThread.scala:386-401``).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_HDR = struct.Struct("!I")  # 4-byte big-endian frame length
+
+
+# ------------------------------------------------------------------ framing
+def _send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    head = json.dumps(header).encode()
+    sock.sendall(_HDR.pack(len(head)) + head + _HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    (hlen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    header = json.loads(_recv_exact(sock, hlen))
+    (plen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    payload = _recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+# ----------------------------------------------------------------- PS side
+class ParameterServer:
+    """Driver-side PS: accept worker connections, run the updater semantics.
+
+    One handler thread per worker connection (the reference's RPC dispatcher
+    threads); the model/clock live behind one lock (single-writer updater
+    discipline -- the TPU build's answer to the reference's benign races,
+    SURVEY.md section 5).
+    """
+
+    def __init__(self, cfg, d: int, n: int, device=None, host: str = "0.0.0.0",
+                 port: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        from asyncframework_tpu.ops import steps
+
+        self.cfg = cfg
+        self.d, self.n = d, n
+        self.device = device if device is not None else jax.devices()[0]
+        self._apply = steps.make_asgd_apply(
+            cfg.gamma, cfg.batch_rate, n, cfg.num_workers
+        )
+        self._w = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
+        self._k_dev = jax.device_put(jnp.float32(0.0), self.device)
+        # warm the accept path before the clock starts (first-iteration
+        # blocking parity) -- donated dummies, never live state
+        zw = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
+        zg = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
+        zk = jax.device_put(jnp.float32(0.0), self.device)
+        self._apply(zw, zg, zk)
+
+        self._lock = threading.Lock()
+        self._w_host: Optional[np.ndarray] = None  # host cache per version
+        self._clock = 0          # merged gradients (ASYNCcontext.CurrentTime)
+        self._k = 0              # accepted updates
+        self.accepted = 0
+        self.dropped = 0
+        self.max_staleness = 0
+        self._snapshots: List[Tuple[float, object]] = []
+        self._t0: Optional[float] = None
+        self._done = threading.Event()
+        # calibration (SparkASGDThread.scala:174-183)
+        self._cal_ms = 0.0
+        self._cal_n = 0
+        self.avg_delay_ms = 0.0
+        self._pull_times: Dict[int, float] = {}
+        # cohort wave gate (ASYNCbarrier + bucketRatio)
+        self._wave_cv = threading.Condition()
+        self._waiting: List[int] = []
+        self._wave_id = 0
+
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.2)
+        self.port = self._srv.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+        self._eval_results: Dict[int, np.ndarray] = {}
+        self._eval_cv = threading.Condition()
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "ParameterServer":
+        self._t0 = time.monotonic()
+        with self._lock:
+            self._snapshots.append((0.0, np.asarray(self._w)))
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ps-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _now_ms(self) -> float:
+        return (time.monotonic() - self._t0) * 1e3
+
+    # ------------------------------------------------------------- protocol
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                header, payload = _recv_msg(conn)
+                op = header["op"]
+                if op == "PULL":
+                    self._handle_pull(conn, int(header["wid"]))
+                elif op == "PUSH":
+                    self._handle_push(conn, header, payload)
+                elif op == "SNAPSHOTS":
+                    # only meaningful once the run is done; the stack is
+                    # consistent either way (lock-copied)
+                    times, W = self.snapshot_stack()
+                    _send_msg(
+                        conn,
+                        {"op": "SNAPSHOTS", "times": times,
+                         "shape": list(W.shape)},
+                        np.ascontiguousarray(W, np.float32).tobytes(),
+                    )
+                elif op == "EVAL_RESULT":
+                    arr = np.frombuffer(payload, np.float64).copy()
+                    with self._eval_cv:
+                        self._eval_results[int(header["wid"])] = arr
+                        self._eval_cv.notify_all()
+                    _send_msg(conn, {"op": "ACK"})
+                elif op == "BYE":
+                    _send_msg(conn, {"op": "ACK"})
+                    return
+                else:
+                    _send_msg(conn, {"op": "ERR", "msg": f"bad op {op}"})
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def _handle_pull(self, conn: socket.socket, wid: int) -> None:
+        if self._done.is_set():
+            _send_msg(conn, {"op": "DONE"})
+            return
+        threshold = max(self.cfg.bucket_threshold, 1)
+        STARVATION_S = 1.0  # degraded-cohort release when peers are gone
+        with self._wave_cv:
+            self._waiting.append(wid)
+            my_wave = self._wave_id
+            if len(self._waiting) >= threshold:
+                # release the cohort: everyone currently waiting rides this
+                # wave (the partial barrier firing)
+                self._wave_id += 1
+                self._waiting.clear()
+                self._wave_cv.notify_all()
+            else:
+                t_enter = time.monotonic()
+                while (
+                    my_wave == self._wave_id
+                    and not self._done.is_set()
+                    and not self._stop.is_set()
+                ):
+                    self._wave_cv.wait(timeout=0.05)
+                    # starvation fallback: when fewer than threshold workers
+                    # are still alive the wave can never fill -- after a
+                    # full second of waiting, release whoever is here as a
+                    # degraded cohort (the reference's wait loop assumes
+                    # workers come back; dead ones never do)
+                    if (
+                        my_wave == self._wave_id
+                        and time.monotonic() - t_enter > STARVATION_S
+                    ):
+                        self._wave_id += 1
+                        self._waiting.clear()
+                        self._wave_cv.notify_all()
+                        break
+        if self._done.is_set():
+            _send_msg(conn, {"op": "DONE"})
+            return
+        with self._lock:
+            ts = self._clock
+            # one readback per model VERSION, not per pull: a whole cohort
+            # reads the same bytes
+            if self._w_host is None:
+                self._w_host = np.asarray(self._w)
+            w_host = self._w_host
+            self._pull_times[wid] = self._now_ms()
+            avg = self.avg_delay_ms
+        _send_msg(
+            conn,
+            {"op": "MODEL", "ts": ts, "avg_delay_ms": avg,
+             "calibrated": self._cal_n >= self.cfg.effective_calibration_iters()},
+            w_host.astype(np.float32).tobytes(),
+        )
+
+    def _handle_push(self, conn: socket.socket, header: dict,
+                     payload: bytes) -> None:
+        import jax
+
+        wid = int(header["wid"])
+        ts = int(header["ts"])
+        g_host = np.frombuffer(payload, np.float32)
+        do_snapshot = False
+        with self._lock:
+            staleness = self._clock - ts
+            self.max_staleness = max(self.max_staleness, staleness)
+            task_ms = self._now_ms() - self._pull_times.get(wid, self._now_ms())
+            if self._cal_n < self.cfg.effective_calibration_iters():
+                self._cal_ms += task_ms
+                self._cal_n += 1
+                if self._cal_n >= self.cfg.effective_calibration_iters():
+                    self.avg_delay_ms = self._cal_ms / max(self._cal_n, 1)
+            accepted = (
+                staleness <= self.cfg.taw
+                and self._k < self.cfg.num_iterations
+            )
+            if accepted:
+                g_dev = jax.device_put(g_host, self.device)
+                self._w, self._k_dev = self._apply(self._w, g_dev, self._k_dev)
+                self._w_host = None  # new version; next pull re-materializes
+                self._k += 1
+                self.accepted += 1
+                if self._k % self.cfg.printer_freq == 0:
+                    do_snapshot = True
+                if self._k >= self.cfg.num_iterations:
+                    self._done.set()
+            else:
+                self.dropped += 1
+            self._clock += 1
+            if do_snapshot:
+                # host copy NOW: the snapshot must pin this version (the PS
+                # has no immutable-handle trick across the wire anyway)
+                self._snapshots.append((self._now_ms(), np.asarray(self._w)))
+        with self._wave_cv:
+            self._wave_cv.notify_all()  # a wave may now meet its threshold
+        _send_msg(conn, {"op": "ACK", "accepted": bool(accepted),
+                         "done": self._done.is_set()})
+
+    # ------------------------------------------------------------ evaluation
+    def wait_done(self, timeout_s: float) -> bool:
+        return self._done.wait(timeout=timeout_s)
+
+    def snapshot_stack(self) -> Tuple[List[float], np.ndarray]:
+        with self._lock:
+            final = (self._now_ms(), np.asarray(self._w))
+            snaps = list(self._snapshots) + [final]
+        times = [t for (t, _w) in snaps]
+        W = np.stack([w for (_t, w) in snaps])
+        return times, W
+
+    def collect_eval(self, num_worker_procs: int, timeout_s: float
+                     ) -> Optional[np.ndarray]:
+        """Sum per-process snapshot losses pushed via EVAL_RESULT."""
+        deadline = time.monotonic() + timeout_s
+        with self._eval_cv:
+            while len(self._eval_results) < num_worker_procs:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._eval_cv.wait(timeout=min(left, 0.2))
+            total = None
+            for arr in self._eval_results.values():
+                total = arr if total is None else total + arr
+            return total
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._done.set()
+        with self._wave_cv:
+            self._wave_cv.notify_all()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# -------------------------------------------------------------- worker side
+class PSClient:
+    """One TCP connection to the PS (workers may hold several, one per
+    logical worker id, or share one -- the protocol is synchronous per
+    connection, like an RpcEndpointRef)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 120.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+
+    def pull(self, wid: int) -> Optional[Tuple[int, np.ndarray, float, bool]]:
+        """Returns (ts, w, avg_delay_ms, calibrated) or None when DONE."""
+        _send_msg(self.sock, {"op": "PULL", "wid": wid})
+        header, payload = _recv_msg(self.sock)
+        if header["op"] == "DONE":
+            return None
+        w = np.frombuffer(payload, np.float32)
+        return (int(header["ts"]), w, float(header["avg_delay_ms"]),
+                bool(header["calibrated"]))
+
+    def push(self, wid: int, ts: int, g: np.ndarray) -> Tuple[bool, bool]:
+        """Returns (accepted, run_done)."""
+        _send_msg(self.sock, {"op": "PUSH", "wid": wid, "ts": ts},
+                  np.asarray(g, np.float32).tobytes())
+        header, _ = _recv_msg(self.sock)
+        return bool(header.get("accepted")), bool(header.get("done"))
+
+    def snapshots(self) -> Tuple[List[float], np.ndarray]:
+        _send_msg(self.sock, {"op": "SNAPSHOTS"})
+        header, payload = _recv_msg(self.sock)
+        W = np.frombuffer(payload, np.float32).reshape(header["shape"])
+        return list(header["times"]), W
+
+    def send_eval(self, wid: int, losses: np.ndarray) -> None:
+        _send_msg(self.sock, {"op": "EVAL_RESULT", "wid": wid},
+                  np.asarray(losses, np.float64).tobytes())
+        _recv_msg(self.sock)
+
+    def bye(self) -> None:
+        try:
+            _send_msg(self.sock, {"op": "BYE"})
+            _recv_msg(self.sock)
+        except (ConnectionError, OSError):
+            pass
+        self.sock.close()
+
+
+def run_worker_process(
+    host: str,
+    port: int,
+    wids: List[int],
+    shards: Dict[int, object],
+    cfg,
+    d: int,
+    n: int,
+    eval_wid: Optional[int] = None,
+    deadline_s: float = 600.0,
+) -> Dict[int, int]:
+    """Worker-process main loop: one thread per owned logical worker, each
+    pulling models and pushing gradients until the PS says DONE.
+
+    ``shards``: wid -> Shard (device-resident, this process's chips).
+    Returns per-wid gradient counts.  When ``eval_wid`` is set, after DONE
+    this process scores the PS's snapshot stack over ALL its shards and
+    pushes one EVAL_RESULT (the distributed optVars evaluation).
+    """
+    import jax
+
+    from asyncframework_tpu.engine.straggler import DelayModel
+    from asyncframework_tpu.ops import steps
+
+    step = steps.make_asgd_worker_step(cfg.batch_rate, cfg.loss)
+    delay_model = DelayModel(cfg.coeff, cfg.num_workers, cfg.seed)
+    counts = {wid: 0 for wid in wids}
+    stop = threading.Event()
+    calibrated_once = threading.Event()
+
+    def worker_loop(wid: int) -> None:
+        cl = PSClient(host, port)
+        shard = shards[wid]
+        dev = shard.X.device
+        key = jax.device_put(
+            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid), dev
+        )
+        deadline = time.monotonic() + deadline_s
+        try:
+            while not stop.is_set() and time.monotonic() < deadline:
+                got = cl.pull(wid)
+                if got is None:
+                    break
+                ts, w_host, avg_ms, calibrated = got
+                if calibrated and not calibrated_once.is_set():
+                    delay_model.calibrate(avg_ms)
+                    calibrated_once.set()
+                dly = delay_model.delay_ms(wid) if calibrated else 0.0
+                if dly > 0:
+                    time.sleep(dly / 1e3)
+                w_dev = jax.device_put(w_host, dev)
+                g, new_key = step(shard.X, shard.y, w_dev, key)
+                key = new_key
+                g_host = np.asarray(g)  # the push IS a readback by design
+                counts[wid] += 1
+                _accepted, done = cl.push(wid, ts, g_host)
+                if done:
+                    break
+        finally:
+            cl.bye()
+
+    threads = [
+        threading.Thread(target=worker_loop, args=(w,), daemon=True)
+        for w in wids
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=deadline_s)
+    if eval_wid is not None:
+        # distributed optVars evaluation: score the PS's snapshot stack over
+        # this process's shards, push one summed loss vector
+        cl = PSClient(host, port)
+        try:
+            times, W = cl.snapshots()
+            losses = evaluate_snapshots_on_shards(shards, times, W, cfg.loss)
+            cl.send_eval(eval_wid, losses)
+        finally:
+            cl.bye()
+    return counts
+
+
+def evaluate_snapshots_on_shards(shards: Dict[int, object], times: List[float],
+                                 W: np.ndarray, loss: str = "least_squares"
+                                 ) -> np.ndarray:
+    """Per-snapshot loss SUMS over this process's shards (caller divides by
+    global N after summing across processes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from asyncframework_tpu.ops import steps
+
+    ev = steps.make_trajectory_loss_eval(loss)
+    total = np.zeros(W.shape[0], np.float64)
+    for shard in shards.values():
+        Wd = jax.device_put(jnp.asarray(W), shard.X.device)
+        total += np.asarray(ev(shard.X, shard.y, Wd), np.float64)
+    return total

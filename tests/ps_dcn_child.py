@@ -1,0 +1,92 @@
+"""Child process for the multi-process async-PS test (role via env).
+
+Role PS: bind the server, print its port on stdout (flushed), run the
+updater until done, print a result JSON line.
+Role WORKER: connect to the PS, run the owned logical workers' loops,
+evaluate the snapshot stack over the owned shards, print a JSON line.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from asyncframework_tpu.data.sharded import ShardedDataset
+from asyncframework_tpu.parallel import ps_dcn
+from asyncframework_tpu.solvers import SolverConfig
+
+N, D, NW = 4096, 24, 8
+NUM_ITER = 400
+
+
+def config() -> SolverConfig:
+    return SolverConfig(
+        num_workers=NW, num_iterations=NUM_ITER, gamma=1.2,
+        taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5, printer_freq=50,
+        seed=42, calibration_iters=20, run_timeout_s=120.0,
+    )
+
+
+def dataset(devices):
+    return ShardedDataset.generate_on_device(
+        N, D, NW, devices=devices, seed=11, noise=0.01
+    )
+
+
+def main() -> None:
+    role = os.environ["PS_ROLE"]
+    cfg = config()
+    if role == "ps":
+        ps = ps_dcn.ParameterServer(cfg, D, N, port=0).start()
+        print(json.dumps({"port": ps.port}), flush=True)
+        ok = ps.wait_done(timeout_s=120.0)
+        total = ps.collect_eval(
+            num_worker_procs=int(os.environ["PS_NUM_WORKER_PROCS"]),
+            timeout_s=60.0,
+        )
+        traj = None
+        if total is not None:
+            times, _W = ps.snapshot_stack()
+            traj = [[t, float(l) / N] for t, l in zip(times, total)]
+        print(json.dumps({
+            "role": "ps", "done": bool(ok), "accepted": ps.accepted,
+            "dropped": ps.dropped, "max_staleness": ps.max_staleness,
+            "trajectory": traj,
+        }), flush=True)
+        ps.stop()
+    else:
+        port = int(os.environ["PS_PORT"])
+        pid = int(os.environ["PS_WORKER_ID"])
+        nproc = int(os.environ["PS_NUM_WORKER_PROCS"])
+        devices = jax.devices()
+        ds = dataset(devices)
+        wids = [w for w in range(NW) if w % nproc == pid]
+        shards = {w: ds.shard(w) for w in wids}
+        # every worker process scores its OWN shards; the PS sums the
+        # per-process vectors -- together they cover the full dataset
+        counts = ps_dcn.run_worker_process(
+            "127.0.0.1", port, wids, shards, cfg, D, N,
+            eval_wid=wids[0], deadline_s=120.0,
+        )
+        print(json.dumps({
+            "role": "worker", "pid": pid,
+            "gradients": int(sum(counts.values())),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
