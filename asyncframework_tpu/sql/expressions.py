@@ -104,6 +104,109 @@ class Column:
 
     __hash__ = object.__hash__  # __eq__ is overridden for the DSL
 
+    # ------------------------------------------------- SQL predicate helpers
+    def isin(self, values) -> "Column":
+        """SQL ``IN``: membership against a literal list or a (subquery)
+        result array.  Device columns use a vectorized isin; string columns
+        fall back to host numpy."""
+
+        def fn(cols):
+            v = self(cols)
+            vals = list(values)
+            if isinstance(v, jnp.ndarray):
+                arr = jnp.asarray(vals)
+                return jnp.isin(v, arr)
+            import numpy as _np
+
+            return _np.isin(_np.asarray(v), _np.asarray(vals))
+
+        return Column(fn, f"({self.name} IN ...)")
+
+    def between(self, lo, hi) -> "Column":
+        """SQL ``BETWEEN lo AND hi`` (inclusive both ends)."""
+        return ((self >= lo) & (self <= hi)).alias(
+            f"({self.name} BETWEEN ...)"
+        )
+
+    def like(self, pattern: str) -> "Column":
+        """SQL ``LIKE``: ``%`` = any run, ``_`` = any one char; string
+        columns only (host-side regex -- strings never live in HBM)."""
+        import re as _re
+
+        rx = _re.compile(
+            "".join(
+                ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
+                for ch in pattern
+            )
+            + r"\Z"
+        )
+
+        def fn(cols):
+            import numpy as _np
+
+            v = _np.asarray(self(cols))
+            return _np.fromiter(
+                (rx.match(str(x)) is not None for x in v), bool, len(v)
+            )
+
+        return Column(fn, f"({self.name} LIKE {pattern!r})")
+
+    def cast(self, type_name: str) -> "Column":
+        """SQL ``CAST(x AS t)`` for t in int/bigint/float/double/string/
+        bool.  Numeric casts stay on device; string casts come to host."""
+        t = type_name.lower()
+
+        def fn(cols):
+            v = self(cols)
+            import numpy as _np
+
+            if t in ("int", "integer", "bigint", "long"):
+                if isinstance(v, jnp.ndarray):
+                    return v.astype(jnp.int32 if t in ("int", "integer")
+                                    else jnp.int64)
+                return _np.asarray(v).astype(
+                    _np.int32 if t in ("int", "integer") else _np.int64
+                )
+            if t in ("float", "double", "real"):
+                if isinstance(v, jnp.ndarray):
+                    return v.astype(jnp.float32 if t == "float"
+                                    else jnp.float64)
+                return _np.asarray(v, _np.float64 if t != "float"
+                                   else _np.float32)
+            if t in ("string", "varchar", "text"):
+                arr = _np.asarray(v)
+                if arr.dtype.kind in "iu":
+                    return _np.asarray([str(int(x)) for x in arr], object)
+                if arr.dtype.kind == "f":
+                    return _np.asarray([str(float(x)) for x in arr], object)
+                return arr.astype(object)
+            if t in ("bool", "boolean"):
+                if isinstance(v, jnp.ndarray):
+                    return v != 0
+                return _np.asarray(v).astype(bool)
+            raise ValueError(f"unsupported CAST target {type_name!r}")
+
+        return Column(fn, f"CAST({self.name} AS {t})")
+
+    def is_null(self) -> "Column":
+        """SQL ``IS NULL``: NaN for float columns, never-null otherwise
+        (the columnar store's documented null story)."""
+
+        def fn(cols):
+            v = self(cols)
+            import numpy as _np
+
+            if isinstance(v, jnp.ndarray):
+                return jnp.isnan(v) if jnp.issubdtype(
+                    v.dtype, jnp.floating
+                ) else jnp.zeros(v.shape, bool)
+            arr = _np.asarray(v)
+            if arr.dtype.kind == "f":
+                return _np.isnan(arr)
+            return _np.zeros(arr.shape, bool)
+
+        return Column(fn, f"({self.name} IS NULL)")
+
     def __repr__(self) -> str:  # pragma: no cover
         return f"Column<{self.name}>"
 
@@ -124,3 +227,190 @@ def col(name: str) -> Column:
 def lit(value) -> Column:
     """A literal broadcast against the frame's rows."""
     return Column(lambda cols: value, repr(value))
+
+
+class CaseBuilder:
+    """``when(cond, val).when(...).otherwise(default)`` -- SQL CASE WHEN.
+
+    Lowers to a right-folded ``jnp.where`` chain: one fused select kernel,
+    first matching branch wins (SQL semantics).
+    """
+
+    def __init__(self, branches):
+        self._branches = list(branches)
+
+    def when(self, cond: Column, value) -> "CaseBuilder":
+        v = value if isinstance(value, Column) else lit(value)
+        return CaseBuilder(self._branches + [(cond, v)])
+
+    def otherwise(self, value) -> Column:
+        default = value if isinstance(value, Column) else lit(value)
+        branches = self._branches
+
+        def fn(cols):
+            import numpy as _np
+
+            def is_texty(x):
+                if isinstance(x, str):
+                    return True
+                if isinstance(x, jnp.ndarray):
+                    return False
+                a = _np.asarray(x)
+                return a.dtype.kind in "OUS"
+
+            out = default(cols)
+            for cond, v in reversed(branches):
+                c = cond(cols)
+                val = v(cols)
+                if is_texty(val) or is_texty(out):
+                    # string branches select on host (strings never live in
+                    # HBM); result is an object column
+                    res = _np.where(_np.asarray(c), val, out)
+                    out = res.astype(object) if res.dtype.kind in "US" else res
+                elif isinstance(out, jnp.ndarray) or isinstance(
+                    val, jnp.ndarray
+                ) or isinstance(c, jnp.ndarray):
+                    out = jnp.where(c, val, out)
+                else:
+                    out = _np.where(_np.asarray(c), val, out)
+            return out
+
+        return Column(fn, "CASE")
+
+    def end(self) -> Column:
+        """CASE without ELSE: unmatched rows get NaN (the null story)."""
+        return self.otherwise(float("nan"))
+
+
+def when(cond: Column, value) -> CaseBuilder:
+    v = value if isinstance(value, Column) else lit(value)
+    return CaseBuilder([(cond, v)])
+
+
+def _host_str(v):
+    import numpy as np
+
+    return np.asarray(v, object)
+
+
+def _host_rows(args):
+    """Normalize evaluated args for a host string function: every arg
+    becomes a length-n host array (scalars/literals broadcast)."""
+    import numpy as np
+
+    arrs = [np.asarray(x) for x in args]
+    n = max((a.shape[0] for a in arrs if a.ndim > 0), default=1)
+    return n, [
+        a if a.ndim > 0 else np.asarray([a[()]] * n, object) for a in arrs
+    ]
+
+
+def _mk_math(jf):
+    return lambda args: jf(args[0])
+
+
+#: scalar function library (name -> impl over evaluated args); math runs on
+#: device via jnp, string functions on host (strings never live in HBM)
+FUNCTIONS: Dict[str, Callable] = {
+    "ABS": _mk_math(jnp.abs),
+    "SQRT": _mk_math(jnp.sqrt),
+    "EXP": _mk_math(jnp.exp),
+    "LN": _mk_math(jnp.log),
+    "LOG": _mk_math(jnp.log),
+    "LOG10": _mk_math(jnp.log10),
+    "FLOOR": _mk_math(jnp.floor),
+    "CEIL": _mk_math(jnp.ceil),
+    "CEILING": _mk_math(jnp.ceil),
+    "SIN": _mk_math(jnp.sin),
+    "COS": _mk_math(jnp.cos),
+    "SIGN": _mk_math(jnp.sign),
+    "POW": lambda a: jnp.power(a[0], a[1]),
+    "POWER": lambda a: jnp.power(a[0], a[1]),
+    "ROUND": lambda a: (
+        jnp.round(a[0], int(a[1])) if len(a) > 1 else jnp.round(a[0])
+    ),
+    "GREATEST": lambda a: __import__("functools").reduce(jnp.maximum, a),
+    "LEAST": lambda a: __import__("functools").reduce(jnp.minimum, a),
+    "COALESCE": lambda a: __import__("functools").reduce(
+        lambda x, y: jnp.where(jnp.isnan(x), y, x), a
+    ),
+    "UPPER": lambda a: _host_str([str(x).upper() for x in _host_str(a[0])]),
+    "LOWER": lambda a: _host_str([str(x).lower() for x in _host_str(a[0])]),
+    "LENGTH": lambda a: __import__("numpy").asarray(
+        [len(str(x)) for x in _host_str(a[0])], __import__("numpy").int32
+    ),
+    "TRIM": lambda a: _host_str([str(x).strip() for x in _host_str(a[0])]),
+    "CONCAT": lambda a: _concat(a),
+    "REPLACE": lambda a: _replace(a),
+    "SUBSTR": lambda a: _substr(a),
+    "SUBSTRING": lambda a: _substr(a),
+}
+
+
+def _concat(args):
+    n, arrs = _host_rows(args)
+    return _host_str(
+        ["".join(str(a[i]) for a in arrs) for i in range(n)]
+    )
+
+
+def _replace(args):
+    n, (s, old, new) = _host_rows(args)
+    return _host_str(
+        [str(s[i]).replace(str(old[i]), str(new[i])) for i in range(n)]
+    )
+
+
+def _substr(args):
+    n, arrs = _host_rows(args)
+    s, start = arrs[0], arrs[1]
+    length = arrs[2] if len(arrs) > 2 else None
+    out = []
+    for i in range(n):
+        lo = int(start[i]) - 1  # SQL substr is 1-based
+        out.append(
+            str(s[i])[lo : lo + int(length[i])] if length is not None
+            else str(s[i])[lo:]
+        )
+    return _host_str(out)
+
+
+def call_function(name: str, args) -> Column:
+    """Build a Column applying library function ``name`` to arg Columns.
+
+    The arg columns are evaluated, then the function body runs once over
+    whole arrays -- the scalar-function analog of whole-stage codegen.
+    CONCAT/REPLACE/SUBSTR treat scalar (literal) args as scalars.
+    """
+    fn = FUNCTIONS[name.upper()]
+
+    def run(cols):
+        return fn([a(cols) for a in args])
+
+    label = f"{name.lower()}({', '.join(a.name for a in args)})"
+    return Column(run, label)
+
+
+def udf_column(fn: Callable, args, name: str) -> Column:
+    """Row-wise python UDF (Spark ``spark.udf.register`` analog): evaluated
+    per row on host -- the same contract as the reference's python UDFs
+    (arbitrary python, no vectorization promises)."""
+    import numpy as np
+
+    def run(cols):
+        vals = [np.asarray(a(cols)) for a in args]
+        if not any(v.ndim > 0 for v in vals):
+            # all-literal call: return a scalar so the frame broadcasts it
+            # like any other literal expression
+            return fn(*[v[()] for v in vals])
+        n = max(len(v) for v in vals if v.ndim > 0)
+        rows = [
+            fn(*[v[i] if v.ndim > 0 else v[()] for v in vals])
+            for i in range(n)
+        ]
+        out = np.asarray(rows)
+        if out.dtype.kind in "US":
+            out = out.astype(object)
+        return out
+
+    return Column(run, f"{name}(...)")

@@ -154,29 +154,86 @@ class ColumnarFrame:
                 out[name] = np.asarray(arr)[idx]
         return ColumnarFrame(out)
 
-    def distinct(self) -> "ColumnarFrame":
-        """Row dedup (``Dataset.distinct`` parity): keeps the FIRST
-        occurrence of each distinct row, in first-seen order.  Vectorized:
-        columns pack into one structured array and ``np.unique`` finds the
-        first index of each distinct row; the row materialization is one
-        device gather."""
+    def _row_records(self) -> np.ndarray:
+        """Rows packed as one comparable structured array (shared by
+        distinct and the set operations).  Floats compare by bit pattern
+        (-0.0 normalized) so duplicate NaN rows collapse; object/string
+        columns compare by a stable per-value code."""
         arrays = []
         for i, c in enumerate(self._cols):
             a = np.asarray(self._cols[c])
             if a.dtype.kind == "f":
-                # NaN != NaN would keep duplicate NaN rows; compare floats
-                # by bit pattern instead (normalizing -0.0 first so the two
-                # zeros still collapse) -- matches Dataset.distinct/pandas
                 a = np.where(a == 0, 0.0, a).astype(a.dtype)
                 a = a.view(f"u{a.dtype.itemsize}")
+            elif a.dtype.kind == "O":
+                # structured dtypes reject object fields; encode as str
+                a = a.astype(str)
             arrays.append((f"f{i}", a))
         rec = np.empty(
             self._n, dtype=[(name, a.dtype) for name, a in arrays]
         )
         for name, a in arrays:
             rec[name] = a
-        _vals, idx = np.unique(rec, return_index=True)
+        return rec
+
+    def distinct(self) -> "ColumnarFrame":
+        """Row dedup (``Dataset.distinct`` parity): keeps the FIRST
+        occurrence of each distinct row, in first-seen order.  Vectorized:
+        columns pack into one structured array and ``np.unique`` finds the
+        first index of each distinct row; the row materialization is one
+        device gather."""
+        _vals, idx = np.unique(self._row_records(), return_index=True)
         return self._take(np.sort(idx))
+
+    # ------------------------------------------------------- set operations
+    def _aligned(self, other: "ColumnarFrame") -> "ColumnarFrame":
+        if list(other.columns) == list(self.columns):
+            return other
+        if set(other.columns) != set(self.columns):
+            raise ValueError(
+                f"set operation needs matching columns: {self.columns} "
+                f"vs {other.columns}"
+            )
+        return other.select(*self.columns)
+
+    def union_all(self, other: "ColumnarFrame") -> "ColumnarFrame":
+        """SQL UNION ALL: rows of self then rows of other (bag semantics).
+        Columns match by NAME (order-insensitive, like Spark's
+        unionByName).  Concatenation happens on host: the frame
+        constructor re-stages device columns anyway, so a device concat
+        would only add a readback."""
+        other = self._aligned(other)
+        out: Dict[str, object] = {}
+        for name in self.columns:
+            a = np.asarray(self._cols[name])
+            b = np.asarray(other._cols[name])
+            if a.dtype.kind == "O" or b.dtype.kind == "O":
+                out[name] = np.concatenate(
+                    [a.astype(object), b.astype(object)]
+                )
+            else:
+                out[name] = np.concatenate([a, b])
+        return ColumnarFrame(out)
+
+    def union(self, other: "ColumnarFrame") -> "ColumnarFrame":
+        """SQL UNION: concatenation + row dedup."""
+        return self.union_all(other).distinct()
+
+    def except_rows(self, other: "ColumnarFrame") -> "ColumnarFrame":
+        """SQL EXCEPT: distinct rows of self absent from other."""
+        other = self._aligned(other)
+        mine = self._row_records()
+        theirs = other._row_records()
+        keep = ~np.isin(mine, theirs)
+        return self._take(np.nonzero(keep)[0]).distinct()
+
+    def intersect_rows(self, other: "ColumnarFrame") -> "ColumnarFrame":
+        """SQL INTERSECT: distinct rows present in both."""
+        other = self._aligned(other)
+        mine = self._row_records()
+        theirs = other._row_records()
+        keep = np.isin(mine, theirs)
+        return self._take(np.nonzero(keep)[0]).distinct()
 
     # --------------------------------------------------------------- sorting
     def sort(self, by: str, ascending: bool = True) -> "ColumnarFrame":

@@ -67,17 +67,64 @@ def _to_column(values: List[str], name: str):
         return np.asarray(values, dtype=object)
 
 
+def _apply_pushdown(
+    cols: Dict[str, object],
+    select: Optional[Sequence[str]],
+    where,
+    mask=None,
+) -> ColumnarFrame:
+    """Shared reader pushdown (Optimizer.scala:38's data-source rules, in
+    spirit): the predicate filters HOST arrays before any device placement
+    -- the chip never receives pruned rows -- and the projection drops
+    unselected columns before the frame is built.  ``mask`` short-circuits
+    a predicate the caller already evaluated."""
+    if where is not None or mask is not None:
+        if mask is None:
+            mask = where(cols)
+        mask = np.asarray(mask, bool)
+        cols = {k: np.asarray(v)[mask] for k, v in cols.items()}
+    if select is not None:
+        missing = [c for c in select if c not in cols]
+        if missing:
+            raise KeyError(f"select columns not in source: {missing}")
+        cols = {c: cols[c] for c in select}
+    return ColumnarFrame(cols)
+
+
+def _needed_for_predicate(where, materialize, names):
+    """Discover the predicate's column set by evaluation: start empty,
+    materialize each column the evaluation KeyErrors on.  Columns the
+    predicate never touches are never parsed (projection pushdown reaches
+    through the predicate).  Returns ``(cols, mask)`` -- the successful
+    evaluation IS the row mask, so callers never re-evaluate."""
+    cols: Dict[str, object] = {}
+    while True:
+        try:
+            return cols, where(cols)
+        except KeyError as e:
+            name = e.args[0].split("'")[1] if "'" in str(e.args[0]) else None
+            if name is None or name in cols or name not in names:
+                raise
+            cols[name] = materialize(name)
+
+
 def read_csv(
     path: Union[str, Path],
     header: bool = True,
     columns: Optional[Sequence[str]] = None,
     delimiter: str = ",",
+    select: Optional[Sequence[str]] = None,
+    where=None,
 ) -> ColumnarFrame:
     """Load a CSV into a ColumnarFrame.
 
     Numeric columns (int/float inference per column) become device arrays;
     anything else stays a host string column.  ``columns`` overrides/provides
     names (required when ``header=False``).
+
+    Pushdown: ``select`` keeps only the named columns -- unselected columns
+    (beyond those the predicate needs) are never parsed or inferred at all;
+    ``where`` (a Column predicate) filters rows before device placement.
     """
     with open(path, newline="") as f:
         reader = _csv.reader(f, delimiter=delimiter)
@@ -99,15 +146,33 @@ def read_csv(
             raise ValueError(
                 f"{path}: row {i + 1} has {len(r)} fields, expected {width}"
             )
+    index = {name: j for j, name in enumerate(names)}
+
+    def materialize(name: str):
+        return _to_column([r[index[name]] for r in rows], name)
+
+    wanted = list(select) if select is not None else names
+    bad = [c for c in wanted if c not in index]
+    if bad:
+        raise KeyError(f"select columns not in source: {bad}")
     cols: Dict[str, object] = {}
-    for j, name in enumerate(names):
-        cols[name] = _to_column([r[j] for r in rows], name)
-    return ColumnarFrame(cols)
+    mask = None
+    if where is not None:
+        cols, mask = _needed_for_predicate(where, materialize, set(names))
+    for name in wanted:
+        if name not in cols:
+            cols[name] = materialize(name)
+    return _apply_pushdown(cols, wanted, where, mask=mask)
 
 
-def read_json(path: Union[str, Path]) -> ColumnarFrame:
+def read_json(
+    path: Union[str, Path],
+    select: Optional[Sequence[str]] = None,
+    where=None,
+) -> ColumnarFrame:
     """JSON-lines (one object per line) into a ColumnarFrame; the schema is
-    the union of keys, missing values become NaN/''."""
+    the union of keys, missing values become NaN/''.  ``select``/``where``
+    push projection and row filtering below device placement."""
     records = []
     with open(path) as f:
         for line in f:
@@ -148,13 +213,21 @@ def read_json(path: Union[str, Path]) -> ColumnarFrame:
             cols[name] = np.asarray(
                 ["" if v is None else str(v) for v in vals], dtype=object
             )
+    if select is not None or where is not None:
+        return _apply_pushdown(cols, select, where)
     return ColumnarFrame(cols)
 
 
 def read_parquet(
-    path: Union[str, Path], columns: Optional[Sequence[str]] = None
+    path: Union[str, Path],
+    columns: Optional[Sequence[str]] = None,
+    select: Optional[Sequence[str]] = None,
+    where=None,
 ) -> ColumnarFrame:
-    """Parquet into a ColumnarFrame via pyarrow."""
+    """Parquet into a ColumnarFrame via pyarrow.  ``select`` prunes columns
+    AT the pyarrow layer (true columnar projection: unselected column
+    chunks are never decoded, beyond what ``where`` needs); ``where``
+    filters rows before device placement."""
     try:
         import pyarrow.parquet as pq
     except ImportError as e:  # pragma: no cover - environment ships pyarrow
@@ -162,25 +235,44 @@ def read_parquet(
             "read_parquet requires pyarrow; install it or convert the data "
             "to CSV/JSON-lines for the native readers"
         ) from e
-    table = pq.read_table(path, columns=list(columns) if columns else None)
-    cols: Dict[str, object] = {}
-    for name in table.column_names:
-        arr = table.column(name).to_numpy(zero_copy_only=False)
+    def convert(arr: np.ndarray) -> np.ndarray:
         if arr.dtype == np.float64:
-            arr = arr.astype(np.float32)
-        elif arr.dtype == np.int64:
+            return arr.astype(np.float32)
+        if arr.dtype == np.int64:
             # downcast only when lossless; wide ints become host columns
             # (see _int_column -- silent int32 wraparound corrupts IDs)
             if len(arr) == 0 or (
                 arr.min() >= _I32[0] and arr.max() <= _I32[1]
             ):
-                arr = arr.astype(np.int32)
-            else:
-                arr = np.asarray([int(v) for v in arr], dtype=object)
-        elif not np.issubdtype(arr.dtype, np.number):
-            arr = arr.astype(object)
-        cols[name] = arr
-    return ColumnarFrame(cols)
+                return arr.astype(np.int32)
+            return np.asarray([int(v) for v in arr], dtype=object)
+        if not np.issubdtype(arr.dtype, np.number):
+            return arr.astype(object)
+        return arr
+
+    want = list(select) if select is not None else (
+        list(columns) if columns else None
+    )
+    schema_names = pq.read_schema(path).names
+
+    def materialize(name: str):
+        t = pq.read_table(path, columns=[name])
+        return convert(t.column(name).to_numpy(zero_copy_only=False))
+
+    cols: Dict[str, object] = {}
+    mask = None
+    if where is not None:
+        cols, mask = _needed_for_predicate(
+            where, materialize, set(schema_names)
+        )
+    remaining = [c for c in (want or schema_names) if c not in cols]
+    if remaining:
+        table = pq.read_table(path, columns=remaining)
+        for name in table.column_names:
+            cols[name] = convert(
+                table.column(name).to_numpy(zero_copy_only=False)
+            )
+    return _apply_pushdown(cols, want, where, mask=mask)
 
 
 def write_csv(frame: ColumnarFrame, path: Union[str, Path]) -> None:

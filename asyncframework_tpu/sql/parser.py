@@ -8,16 +8,23 @@ here the execution layer is the eager columnar frame (``sql/frame.py``)
 whose ops are already fused XLA kernels, so the front door reduces to:
 tokenize -> recursive-descent parse -> direct lowering.
 
-Supported surface (the queries the reference's examples actually run):
+Supported surface:
 
-    SELECT expr [AS name], ... | SELECT agg(expr), ...
-    FROM table [INNER|LEFT|RIGHT|FULL|SEMI|ANTI] JOIN table2 ON key
-    WHERE expr        -- arithmetic/comparison/AND/OR/NOT, strings, NULLs out
-    GROUP BY k        -- lowered to the device segment aggregates
+    [WITH name AS (query) [, ...]]
+    SELECT [DISTINCT] expr [AS name] | agg(expr) | fn(args) | wfn() OVER ..
+    FROM table | (query) [AS alias]
+         [INNER|LEFT|RIGHT|FULL|SEMI|ANTI] JOIN t2 ON key
+    WHERE expr     -- AND/OR/NOT, comparisons, BETWEEN, IN (list|subquery),
+                   -- LIKE, IS [NOT] NULL, CASE WHEN, CAST, scalar subqueries
+    GROUP BY k [HAVING expr]
     ORDER BY c [ASC|DESC]
     LIMIT n
+    query UNION [ALL] query | EXCEPT | INTERSECT   (left-associative)
 
-Aggregates: SUM, AVG, MEAN, MIN, MAX, COUNT(expr|*).
+Aggregates: SUM, AVG, MEAN, MIN, MAX, COUNT(expr|*).  Scalar functions:
+the ``expressions.FUNCTIONS`` library (ABS/SQRT/.../UPPER/SUBSTR/COALESCE)
+plus user UDFs via ``SQLContext.register_udf`` (row-wise python, the same
+contract as the reference's python UDFs).
 """
 
 from __future__ import annotations
@@ -27,7 +34,16 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from asyncframework_tpu.sql.expressions import Column, col, lit
+from asyncframework_tpu.sql.expressions import (
+    CaseBuilder,
+    Column,
+    FUNCTIONS,
+    call_function,
+    col,
+    lit,
+    udf_column,
+    when,
+)
 from asyncframework_tpu.sql.frame import ColumnarFrame
 
 _TOKEN = re.compile(
@@ -46,13 +62,17 @@ _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "ORDER", "BY", "LIMIT", "AS",
     "AND", "OR", "NOT", "JOIN", "ON", "INNER", "LEFT", "RIGHT", "FULL",
     "OUTER", "SEMI", "ANTI", "ASC", "DESC", "DISTINCT", "HAVING",
-    "OVER", "PARTITION",
+    "OVER", "PARTITION", "UNION", "ALL", "EXCEPT", "INTERSECT", "CASE",
+    "WHEN", "THEN", "ELSE", "END", "BETWEEN", "IN", "LIKE", "IS", "NULL",
+    "CAST", "WITH",
 }
 
 _WINDOW_ONLY_FNS = {
     "ROW_NUMBER": "row_number", "RANK": "rank", "DENSE_RANK": "dense_rank",
     "LAG": "lag", "LEAD": "lead",
 }
+
+_SET_OPS = {"UNION", "EXCEPT", "INTERSECT"}
 
 
 def tokenize(text: str) -> List[str]:
@@ -73,9 +93,11 @@ def tokenize(text: str) -> List[str]:
 
 
 class _Parser:
-    def __init__(self, tokens: List[str]):
+    def __init__(self, tokens: List[str], ctx: "SQLContext"):
         self.toks = tokens
         self.i = 0
+        self.ctx = ctx
+        self.local_tables: Dict[str, ColumnarFrame] = {}  # CTE scope
 
     # ------------------------------------------------------------- utilities
     def peek(self) -> Optional[str]:
@@ -84,6 +106,10 @@ class _Parser:
     def peek_upper(self) -> Optional[str]:
         t = self.peek()
         return t.upper() if t is not None else None
+
+    def peek2_upper(self) -> Optional[str]:
+        j = self.i + 1
+        return self.toks[j].upper() if j < len(self.toks) else None
 
     def next(self) -> str:
         t = self.peek()
@@ -109,6 +135,219 @@ class _Parser:
             raise ValueError(f"expected identifier, got {t!r}")
         return t
 
+    def _resolve_table(self, name: str) -> ColumnarFrame:
+        key = name.lower()
+        if key in self.local_tables:  # CTEs shadow registered tables
+            return self.local_tables[key]
+        return self.ctx.table(name)
+
+    # ------------------------------------------------------------ statements
+    def statement(self) -> ColumnarFrame:
+        """[WITH ...] set-expression -- the top-level entry."""
+        if self.accept("WITH"):
+            while True:
+                name = self.ident()
+                self.expect("AS")
+                self.expect("(")
+                sub = self._nested_statement()  # sees earlier CTEs
+                self.expect(")")
+                self.local_tables[name.lower()] = sub
+                if not self.accept(","):
+                    break
+        return self.set_expr()
+
+    def _nested_statement(self) -> ColumnarFrame:
+        """A statement inside a subquery/CTE body/derived table: its own
+        WITH names are SCOPED to it -- they must neither leak into nor
+        shadow the enclosing query's CTEs after it closes."""
+        saved = dict(self.local_tables)
+        try:
+            return self.statement()
+        finally:
+            self.local_tables = saved
+
+    def set_expr(self) -> ColumnarFrame:
+        left = self.select_core()
+        seen_set_op = False
+        while self.peek_upper() in _SET_OPS:
+            seen_set_op = True
+            op = self.next().upper()
+            keep_all = op == "UNION" and self.accept("ALL")
+            # a set-op operand may not consume ORDER BY/LIMIT: a trailing
+            # ORDER BY applies to the WHOLE set expression (standard SQL)
+            right = self.select_core(consume_order=False)
+            if op == "UNION":
+                left = left.union_all(right) if keep_all else left.union(right)
+            elif op == "EXCEPT":
+                left = left.except_rows(right)
+            else:
+                left = left.intersect_rows(right)
+        if seen_set_op:
+            if self.accept("ORDER"):
+                self.expect("BY")
+                by = self.ident()
+                ascending = not self.accept("DESC")
+                if ascending:
+                    self.accept("ASC")
+                if by not in left.columns:
+                    raise ValueError(f"ORDER BY {by!r}: not a result column")
+                left = left.sort(by, ascending=ascending)
+            if self.accept("LIMIT"):
+                left = _limit(left, int(self.next()))
+        return left
+
+    def select_core(self, consume_order: bool = True) -> ColumnarFrame:
+        if self.peek() == "(":
+            self.next()
+            f = self._nested_statement()
+            self.expect(")")
+            return f
+        self.expect("SELECT")
+        distinct = self.accept("DISTINCT")
+        items = self.select_items()
+        self.expect("FROM")
+        frame = self._from_item()
+
+        # joins
+        while True:
+            how = "inner"
+            if self.peek_upper() in ("INNER", "LEFT", "RIGHT", "FULL",
+                                     "SEMI", "ANTI"):
+                how = self.next().lower()
+                self.accept("OUTER")
+                self.expect("JOIN")
+            elif self.peek_upper() == "JOIN":
+                self.next()
+            else:
+                break
+            right = self._from_item()
+            self.expect("ON")
+            k1 = self.ident()
+            if self.peek() == ".":
+                self.next()
+                k1 = self.ident()
+            key = k1
+            if self.accept("="):
+                k2 = self.ident()
+                if self.peek() == ".":
+                    self.next()
+                    k2 = self.ident()
+                if k2 != k1:
+                    raise ValueError(
+                        f"equi-join keys must share a name: {k1!r} != {k2!r}"
+                    )
+            frame = frame.join(right, on=key, how=how)
+
+        if self.accept("WHERE"):
+            frame = frame.filter(self.expr())
+
+        group_key = None
+        having = None
+        if self.accept("GROUP"):
+            self.expect("BY")
+            group_key = self.ident()
+            if self.accept("HAVING"):
+                # HAVING filters the AGGREGATED result, so its expression
+                # references OUTPUT column names (the group key, aggregate
+                # labels like sum(v), or AS aliases)
+                having = self.expr()
+
+        order_by = None
+        ascending = True
+        if consume_order and self.accept("ORDER"):
+            self.expect("BY")
+            order_by = self.ident()
+            if self.accept("DESC"):
+                ascending = False
+            else:
+                self.accept("ASC")
+
+        limit = None
+        if consume_order and self.accept("LIMIT"):
+            limit = int(self.next())
+
+        if (
+            order_by is not None
+            and group_key is None
+            and not aggs_present(items)
+            and order_by in frame.columns
+        ):
+            # standard SQL: ORDER BY may reference an unprojected source
+            # column -- sorting the source BEFORE projecting covers both
+            # source columns and pass-through selections in one projection
+            # (projection preserves row order)
+            frame = frame.sort(order_by, ascending=ascending)
+            order_by = None
+        frame = self._project(frame, items, group_key)
+        if having is not None:
+            # HAVING may reference an aggregate by its CALL syntax (default
+            # label "fn(arg)") even when the SELECT aliased it -- bridge the
+            # default labels onto the aliased output columns for the filter,
+            # then drop the bridges
+            bridges = {}
+            for kind, it in items:
+                if kind != "agg":
+                    continue
+                fn, arg, out = it
+                default = (
+                    f"{fn}({arg})" if isinstance(arg, str)
+                    else ("count(*)" if arg is None else None)
+                )
+                if (
+                    default is not None
+                    and default != out
+                    and default not in frame.columns
+                    and out in frame.columns
+                ):
+                    bridges[default] = out
+            for default, out in bridges.items():
+                frame = frame.with_column(default, col(out))
+            frame = frame.filter(having)
+            if bridges:
+                frame = frame.select(
+                    *[c for c in frame.columns if c not in bridges]
+                )
+        if distinct:
+            frame = frame.distinct()
+        if order_by is not None:
+            if order_by not in frame.columns:
+                raise ValueError(
+                    f"ORDER BY {order_by!r}: not a result column"
+                    + ("" if group_key is None else
+                       " (aggregated queries sort by output columns only)")
+                )
+            frame = frame.sort(order_by, ascending=ascending)
+        if limit is not None:
+            frame = _limit(frame, limit)
+        return frame
+
+    def _from_item(self) -> ColumnarFrame:
+        """table name | ( query ) [AS alias] -- derived tables supported."""
+        if self.peek() == "(":
+            self.next()
+            f = self._nested_statement()
+            self.expect(")")
+            if self.accept("AS"):
+                self.ident()  # alias accepted; frames are flat, name unused
+            elif (
+                self.peek() is not None
+                and self.peek_upper() not in _KEYWORDS
+                and re.fullmatch(r"[A-Za-z_][A-Za-z_0-9]*", self.peek())
+            ):
+                self.next()  # bare alias
+            return f
+        return self._resolve_table(self.ident())
+
+    def _subquery_values(self) -> np.ndarray:
+        """A subquery used as a value source (IN / scalar): must produce
+        exactly one column."""
+        f = self._nested_statement()
+        if len(f.columns) != 1:
+            raise ValueError(
+                f"subquery must return one column, got {f.columns}"
+            )
+        return np.asarray(f[f.columns[0]])
+
     # ------------------------------------------------------------ expressions
     def expr(self) -> Column:
         return self._or()
@@ -126,22 +365,72 @@ class _Parser:
         return e
 
     def _not(self) -> Column:
-        if self.accept("NOT"):
+        if (
+            self.peek_upper() == "NOT"
+            and self.peek2_upper() not in ("BETWEEN", "IN", "LIKE")
+        ):
+            self.next()
             return ~self._not()
         return self._cmp()
 
     def _cmp(self) -> Column:
         e = self._add()
-        op = self.peek()
-        if op in ("=", "==", "!=", "<>", "<", "<=", ">", ">="):
+        negate = False
+        if (
+            self.peek_upper() == "NOT"
+            and self.peek2_upper() in ("BETWEEN", "IN", "LIKE")
+        ):
             self.next()
-            rhs = self._add()
-            if op in ("=", "=="):
-                return e == rhs
-            if op in ("!=", "<>"):
-                return e != rhs
-            return {"<": e < rhs, "<=": e <= rhs,
-                    ">": e > rhs, ">=": e >= rhs}[op]
+            negate = True
+        t = self.peek_upper()
+        if t == "BETWEEN":
+            self.next()
+            lo = self._add()
+            self.expect("AND")
+            hi = self._add()
+            e = e.between(lo, hi)
+        elif t == "IN":
+            self.next()
+            self.expect("(")
+            if self.peek_upper() in ("SELECT", "WITH"):
+                values = self._subquery_values()
+                self.expect(")")
+                e = e.isin(values.tolist())
+            else:
+                vals = []
+                while True:
+                    vals.append(self.expr()({}))  # literals evaluate closed
+                    if not self.accept(","):
+                        break
+                self.expect(")")
+                e = e.isin(vals)
+        elif t == "LIKE":
+            self.next()
+            pat = self.next()
+            if not pat.startswith("'"):
+                raise ValueError("LIKE needs a string literal pattern")
+            e = e.like(pat[1:-1].replace("''", "'"))
+        elif t == "IS":
+            self.next()
+            neg = self.accept("NOT")
+            self.expect("NULL")
+            e = e.is_null()
+            if neg:
+                e = ~e
+        else:
+            op = self.peek()
+            if op in ("=", "==", "!=", "<>", "<", "<=", ">", ">="):
+                self.next()
+                rhs = self._add()
+                if op in ("=", "=="):
+                    e = e == rhs
+                elif op in ("!=", "<>"):
+                    e = e != rhs
+                else:
+                    e = {"<": e < rhs, "<=": e <= rhs,
+                         ">": e > rhs, ">=": e >= rhs}[op]
+        if negate:
+            e = ~e
         return e
 
     def _add(self) -> Column:
@@ -169,15 +458,60 @@ class _Parser:
             return -self._unary()
         return self._primary()
 
+    def _case_expr(self) -> Column:
+        """CASE [base] WHEN v THEN r ... [ELSE d] END (searched + simple)."""
+        base = None
+        if self.peek_upper() != "WHEN":
+            base = self.expr()
+        builder: Optional[CaseBuilder] = None
+        while self.accept("WHEN"):
+            cond = self.expr()
+            if base is not None:
+                cond = base == cond
+            self.expect("THEN")
+            val = self.expr()
+            builder = (when(cond, val) if builder is None
+                       else builder.when(cond, val))
+        if builder is None:
+            raise ValueError("CASE needs at least one WHEN")
+        if self.accept("ELSE"):
+            out = builder.otherwise(self.expr())
+        else:
+            out = builder.end()
+        self.expect("END")
+        return out
+
     def _primary(self) -> Column:
         t = self.peek()
         if t is None:
             raise ValueError("unexpected end of expression")
         if t == "(":
             self.next()
+            if self.peek_upper() in ("SELECT", "WITH"):
+                # scalar subquery: one column, one row
+                values = self._subquery_values()
+                self.expect(")")
+                if values.shape[0] != 1:
+                    raise ValueError(
+                        "scalar subquery must return exactly one row, got "
+                        f"{values.shape[0]}"
+                    )
+                v = values[0]
+                return lit(v.item() if hasattr(v, "item") else v)
             e = self.expr()
             self.expect(")")
             return e
+        if t.upper() == "CASE":
+            self.next()
+            return self._case_expr()
+        if t.upper() == "CAST":
+            self.next()
+            self.expect("(")
+            e = self.expr()
+            self.expect("AS")
+            target = self.ident()
+            self.expect(")")
+            return e.cast(target)
         if re.fullmatch(r"\d+\.\d*|\.\d+|\d+", t):
             self.next()
             return lit(float(t) if ("." in t) else int(t))
@@ -199,6 +533,25 @@ class _Parser:
                 fn = "count"
             self.expect(")")
             return col(f"{fn}({arg})")
+        if (
+            self.i + 1 < len(self.toks)
+            and self.toks[self.i + 1] == "("
+            and (t.upper() in FUNCTIONS or t.lower() in self.ctx._udfs)
+        ):
+            name = self.next()
+            self.expect("(")
+            args: List[Column] = []
+            if self.peek() != ")":
+                while True:
+                    args.append(self.expr())
+                    if not self.accept(","):
+                        break
+            self.expect(")")
+            if name.lower() in self.ctx._udfs:
+                return udf_column(
+                    self.ctx._udfs[name.lower()], args, name.lower()
+                )
+            return call_function(name, args)
         name = self.ident()
         if name.upper() in _KEYWORDS:
             raise ValueError(f"unexpected keyword {name!r} in expression")
@@ -324,151 +677,6 @@ class _Parser:
         self.expect(")")
         return partition_by, order_by, ascending
 
-
-class SQLContext:
-    """Table registry + ``sql()`` entry point (SparkSession.sql analog)."""
-
-    def __init__(self):
-        self._tables: Dict[str, ColumnarFrame] = {}
-
-    def register(self, name: str, frame: ColumnarFrame) -> None:
-        """``createOrReplaceTempView`` analog."""
-        self._tables[name.lower()] = frame
-
-    def table(self, name: str) -> ColumnarFrame:
-        key = name.lower()
-        if key not in self._tables:
-            raise KeyError(
-                f"no table {name!r}; registered: {sorted(self._tables)}"
-            )
-        return self._tables[key]
-
-    # ----------------------------------------------------------------- query
-    def sql(self, text: str) -> ColumnarFrame:
-        p = _Parser(tokenize(text))
-        p.expect("SELECT")
-        distinct = p.accept("DISTINCT")
-        items = p.select_items()
-        p.expect("FROM")
-        frame = self.table(p.ident())
-
-        # joins
-        while True:
-            how = "inner"
-            if p.peek_upper() in ("INNER", "LEFT", "RIGHT", "FULL",
-                                  "SEMI", "ANTI"):
-                how = p.next().lower()
-                p.accept("OUTER")
-                p.expect("JOIN")
-            elif p.peek_upper() == "JOIN":
-                p.next()
-            else:
-                break
-            right = self.table(p.ident())
-            p.expect("ON")
-            k1 = p.ident()
-            if p.peek() == ".":
-                p.next()
-                k1 = p.ident()
-            key = k1
-            if p.accept("="):
-                k2 = p.ident()
-                if p.peek() == ".":
-                    p.next()
-                    k2 = p.ident()
-                if k2 != k1:
-                    raise ValueError(
-                        f"equi-join keys must share a name: {k1!r} != {k2!r}"
-                    )
-            frame = frame.join(right, on=key, how=how)
-
-        if p.accept("WHERE"):
-            frame = frame.filter(p.expr())
-
-        group_key = None
-        having = None
-        if p.accept("GROUP"):
-            p.expect("BY")
-            group_key = p.ident()
-            if p.accept("HAVING"):
-                # HAVING filters the AGGREGATED result, so its expression
-                # references OUTPUT column names (the group key, aggregate
-                # labels like sum(v), or AS aliases) -- the documented
-                # subset; raw-aggregate syntax inside HAVING is not re-parsed
-                having = p.expr()
-
-        order_by = None
-        ascending = True
-        if p.accept("ORDER"):
-            p.expect("BY")
-            order_by = p.ident()
-            if p.accept("DESC"):
-                ascending = False
-            else:
-                p.accept("ASC")
-
-        limit = None
-        if p.accept("LIMIT"):
-            limit = int(p.next())
-
-        if p.peek() is not None:
-            raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
-
-        if (
-            order_by is not None
-            and group_key is None
-            and not aggs_present(items)
-            and order_by in frame.columns
-        ):
-            # standard SQL: ORDER BY may reference an unprojected source
-            # column -- sorting the source BEFORE projecting covers both
-            # source columns and pass-through selections in one projection
-            # (projection preserves row order)
-            frame = frame.sort(order_by, ascending=ascending)
-            order_by = None
-        frame = self._project(frame, items, group_key)
-        if having is not None:
-            # HAVING may reference an aggregate by its CALL syntax (default
-            # label "fn(arg)") even when the SELECT aliased it -- bridge the
-            # default labels onto the aliased output columns for the filter,
-            # then drop the bridges
-            bridges = {}
-            for kind, it in items:
-                if kind != "agg":
-                    continue
-                fn, arg, out = it
-                default = (
-                    f"{fn}({arg})" if isinstance(arg, str)
-                    else ("count(*)" if arg is None else None)
-                )
-                if (
-                    default is not None
-                    and default != out
-                    and default not in frame.columns
-                    and out in frame.columns
-                ):
-                    bridges[default] = out
-            for default, out in bridges.items():
-                frame = frame.with_column(default, col(out))
-            frame = frame.filter(having)
-            if bridges:
-                frame = frame.select(
-                    *[c for c in frame.columns if c not in bridges]
-                )
-        if distinct:
-            frame = frame.distinct()
-        if order_by is not None:
-            if order_by not in frame.columns:
-                raise ValueError(
-                    f"ORDER BY {order_by!r}: not a result column"
-                    + ("" if group_key is None else
-                       " (aggregated queries sort by output columns only)")
-                )
-            frame = frame.sort(order_by, ascending=ascending)
-        if limit is not None:
-            frame = _limit(frame, limit)
-        return frame
-
     # ---------------------------------------------------------------- lowering
     def _project(self, frame, items, group_key):
         aggs = [it for kind, it in items if kind == "agg"]
@@ -546,6 +754,39 @@ class SQLContext:
             ]
             return frame.select(*sel)
         return frame.select(*[e.alias(name) for e, name in exprs])
+
+
+class SQLContext:
+    """Table registry + ``sql()`` entry point (SparkSession.sql analog)."""
+
+    def __init__(self):
+        self._tables: Dict[str, ColumnarFrame] = {}
+        self._udfs: Dict[str, Any] = {}
+
+    def register(self, name: str, frame: ColumnarFrame) -> None:
+        """``createOrReplaceTempView`` analog."""
+        self._tables[name.lower()] = frame
+
+    def register_udf(self, name: str, fn) -> None:
+        """Row-wise python UDF (``spark.udf.register`` analog): callable in
+        any expression position as ``name(args...)``."""
+        self._udfs[name.lower()] = fn
+
+    def table(self, name: str) -> ColumnarFrame:
+        key = name.lower()
+        if key not in self._tables:
+            raise KeyError(
+                f"no table {name!r}; registered: {sorted(self._tables)}"
+            )
+        return self._tables[key]
+
+    # ----------------------------------------------------------------- query
+    def sql(self, text: str) -> ColumnarFrame:
+        p = _Parser(tokenize(text), self)
+        frame = p.statement()
+        if p.peek() is not None:
+            raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
+        return frame
 
 
 def aggs_present(items) -> bool:

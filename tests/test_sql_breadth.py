@@ -1,0 +1,340 @@
+"""Round-3 SQL language breadth (VERDICT item 5), pandas-oracle tested:
+set operations, CASE WHEN, subqueries (scalar / IN / FROM), CTEs,
+LIKE/BETWEEN/CAST/IS NULL, the scalar function library, UDFs, and reader
+projection/predicate pushdown.
+
+Parity: AstBuilder.scala constructs + Optimizer.scala:38's data-source
+pruning rules (pushdown happens in the readers here -- the execution layer
+is eager, so reader-level pruning IS the optimizer surface that matters).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from asyncframework_tpu.sql import ColumnarFrame, SQLContext
+from asyncframework_tpu.sql.expressions import col, lit, when
+from asyncframework_tpu.sql.io import read_csv, read_parquet
+
+
+@pytest.fixture()
+def ctx():
+    c = SQLContext()
+    c.register("t", ColumnarFrame({
+        "k": np.asarray(["a", "b", "c", "d", "a"], object),
+        "v": np.asarray([1.0, 2.0, 3.0, 4.0, 5.0], np.float32),
+        "n": np.asarray([10, 20, 30, 40, 50], np.int32),
+    }))
+    c.register("u", ColumnarFrame({
+        "k": np.asarray(["a", "b", "x"], object),
+        "v": np.asarray([1.0, 9.0, 9.0], np.float32),
+        "n": np.asarray([10, 99, 99], np.int32),
+    }))
+    return c
+
+
+def pdf(frame) -> pd.DataFrame:
+    return pd.DataFrame({c: np.asarray(frame[c]) for c in frame.columns})
+
+
+class TestSetOps:
+    def test_union_all_and_union(self, ctx):
+        out = ctx.sql("SELECT k, v FROM t UNION ALL SELECT k, v FROM u")
+        a = pd.DataFrame({"k": ["a", "b", "c", "d", "a"],
+                          "v": [1.0, 2, 3, 4, 5]})
+        b = pd.DataFrame({"k": ["a", "b", "x"], "v": [1.0, 9, 9]})
+        want = pd.concat([a, b], ignore_index=True)
+        pd.testing.assert_frame_equal(
+            pdf(out), want, check_dtype=False
+        )
+        out2 = ctx.sql("SELECT k, v FROM t UNION SELECT k, v FROM u")
+        want2 = want.drop_duplicates()
+        assert sorted(map(tuple, pdf(out2).values.tolist())) == sorted(
+            map(tuple, want2.values.tolist())
+        )
+
+    def test_except_and_intersect(self, ctx):
+        out = ctx.sql("SELECT k, v FROM t EXCEPT SELECT k, v FROM u")
+        got = sorted(map(tuple, pdf(out).values.tolist()))
+        assert got == [("a", 5.0), ("b", 2.0), ("c", 3.0), ("d", 4.0)]
+        out2 = ctx.sql("SELECT k, v FROM t INTERSECT SELECT k, v FROM u")
+        assert sorted(map(tuple, pdf(out2).values.tolist())) == [("a", 1.0)]
+
+    def test_union_column_name_mismatch_rejected(self, ctx):
+        with pytest.raises(ValueError, match="matching columns"):
+            ctx.sql("SELECT k FROM t UNION SELECT v FROM u")
+
+
+class TestCaseWhen:
+    def test_searched_case(self, ctx):
+        out = ctx.sql(
+            "SELECT k, CASE WHEN v < 2 THEN 0 WHEN v < 4 THEN 1 "
+            "ELSE 2 END AS bucket FROM t"
+        )
+        v = np.array([1.0, 2, 3, 4, 5])
+        want = np.where(v < 2, 0, np.where(v < 4, 1, 2))
+        np.testing.assert_array_equal(np.asarray(out["bucket"]), want)
+
+    def test_simple_case(self, ctx):
+        out = ctx.sql(
+            "SELECT CASE k WHEN 'a' THEN 1 ELSE 0 END AS is_a FROM t"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["is_a"]), [1, 0, 0, 0, 1]
+        )
+
+    def test_case_without_else_yields_nan(self, ctx):
+        out = ctx.sql("SELECT CASE WHEN v > 4 THEN v END AS big FROM t")
+        got = np.asarray(out["big"])
+        assert np.isnan(got[:4]).all() and got[4] == 5.0
+
+    def test_case_in_where(self, ctx):
+        out = ctx.sql(
+            "SELECT k FROM t WHERE CASE WHEN v > 3 THEN 1 ELSE 0 END = 1"
+        )
+        assert list(np.asarray(out["k"])) == ["d", "a"]
+
+
+class TestSubqueries:
+    def test_scalar_subquery(self, ctx):
+        out = ctx.sql("SELECT k, v FROM t WHERE v > (SELECT AVG(v) FROM t)")
+        assert list(np.asarray(out["k"])) == ["d", "a"]
+
+    def test_in_subquery(self, ctx):
+        out = ctx.sql("SELECT k, v FROM t WHERE k IN (SELECT k FROM u)")
+        assert list(np.asarray(out["k"])) == ["a", "b", "a"]
+
+    def test_not_in_subquery(self, ctx):
+        out = ctx.sql("SELECT k FROM t WHERE k NOT IN (SELECT k FROM u)")
+        assert list(np.asarray(out["k"])) == ["c", "d"]
+
+    def test_from_subquery(self, ctx):
+        out = ctx.sql(
+            "SELECT k, doubled FROM "
+            "(SELECT k, v * 2 AS doubled FROM t WHERE v >= 3) big "
+            "ORDER BY doubled DESC"
+        )
+        assert list(np.asarray(out["doubled"])) == [10.0, 8.0, 6.0]
+
+    def test_in_literal_list(self, ctx):
+        out = ctx.sql("SELECT v FROM t WHERE k IN ('a', 'c')")
+        assert list(np.asarray(out["v"])) == [1.0, 3.0, 5.0]
+
+
+class TestCTE:
+    def test_single_cte(self, ctx):
+        out = ctx.sql(
+            "WITH big AS (SELECT k, v FROM t WHERE v > 2) "
+            "SELECT SUM(v) AS s FROM big"
+        )
+        assert float(np.asarray(out["s"])[0]) == 12.0
+
+    def test_chained_ctes_and_shadowing(self, ctx):
+        out = ctx.sql(
+            "WITH a AS (SELECT k, v FROM t WHERE v > 1), "
+            "     b AS (SELECT k, v FROM a WHERE v < 5) "
+            "SELECT k FROM b ORDER BY k"
+        )
+        assert list(np.asarray(out["k"])) == ["b", "c", "d"]
+        # 'a' shadowed any registered table only within that statement
+        with pytest.raises(KeyError):
+            ctx.sql("SELECT * FROM a")
+
+    def test_cte_with_set_op(self, ctx):
+        out = ctx.sql(
+            "WITH all_rows AS (SELECT k FROM t UNION SELECT k FROM u) "
+            "SELECT COUNT(*) AS c FROM "
+            "(SELECT k, 1 AS one FROM all_rows) x"
+        )
+        assert int(np.asarray(out["c"])[0]) == 5  # a b c d x
+
+
+class TestPredicates:
+    def test_between(self, ctx):
+        out = ctx.sql("SELECT k FROM t WHERE v BETWEEN 2 AND 4")
+        assert list(np.asarray(out["k"])) == ["b", "c", "d"]
+        out2 = ctx.sql("SELECT k FROM t WHERE v NOT BETWEEN 2 AND 4")
+        assert list(np.asarray(out2["k"])) == ["a", "a"]
+
+    def test_like(self, ctx):
+        c = SQLContext()
+        c.register("s", ColumnarFrame({
+            "name": np.asarray(
+                ["spark", "flink", "sparrow", "stork", "ray"], object
+            ),
+            "x": np.arange(5, dtype=np.int32),
+        }))
+        out = c.sql("SELECT name FROM s WHERE name LIKE 'spar%'")
+        assert list(np.asarray(out["name"])) == ["spark", "sparrow"]
+        out2 = c.sql("SELECT name FROM s WHERE name LIKE '_tork'")
+        assert list(np.asarray(out2["name"])) == ["stork"]
+        out3 = c.sql("SELECT name FROM s WHERE name NOT LIKE '%r%'")
+        assert list(np.asarray(out3["name"])) == ["flink"]
+
+    def test_cast(self, ctx):
+        out = ctx.sql("SELECT CAST(v AS int) AS vi FROM t")
+        assert list(np.asarray(out["vi"])) == [1, 2, 3, 4, 5]
+        out2 = ctx.sql("SELECT CAST(n AS string) AS ns FROM t LIMIT 2")
+        assert list(np.asarray(out2["ns"])) == ["10", "20"]
+
+    def test_is_null(self):
+        c = SQLContext()
+        c.register("m", ColumnarFrame({
+            "v": np.asarray([1.0, np.nan, 3.0], np.float32),
+            "i": np.asarray([1, 2, 3], np.int32),
+        }))
+        out = c.sql("SELECT i FROM m WHERE v IS NULL")
+        assert list(np.asarray(out["i"])) == [2]
+        out2 = c.sql("SELECT i FROM m WHERE v IS NOT NULL")
+        assert list(np.asarray(out2["i"])) == [1, 3]
+
+
+class TestFunctionsAndUDFs:
+    def test_math_functions(self, ctx):
+        out = ctx.sql(
+            "SELECT ABS(1 - v) AS a, SQRT(v) AS s, ROUND(v / 2) AS r FROM t"
+        )
+        v = np.array([1.0, 2, 3, 4, 5], np.float32)
+        np.testing.assert_allclose(np.asarray(out["a"]), np.abs(1 - v))
+        np.testing.assert_allclose(
+            np.asarray(out["s"]), np.sqrt(v), rtol=1e-6
+        )
+        np.testing.assert_allclose(np.asarray(out["r"]), np.round(v / 2))
+
+    def test_string_functions(self, ctx):
+        out = ctx.sql(
+            "SELECT UPPER(k) AS ku, LENGTH(k) AS kl, "
+            "CONCAT(k, '_', CAST(n AS string)) AS tag FROM t LIMIT 2"
+        )
+        assert list(np.asarray(out["ku"])) == ["A", "B"]
+        assert list(np.asarray(out["kl"])) == [1, 1]
+        assert list(np.asarray(out["tag"])) == ["a_10", "b_20"]
+
+    def test_substr_and_coalesce(self):
+        c = SQLContext()
+        c.register("s", ColumnarFrame({
+            "w": np.asarray(["hello", "world"], object),
+            "v": np.asarray([np.nan, 2.0], np.float32),
+        }))
+        out = c.sql("SELECT SUBSTR(w, 2, 3) AS mid, "
+                    "COALESCE(v, 0) AS v0 FROM s")
+        assert list(np.asarray(out["mid"])) == ["ell", "orl"]
+        np.testing.assert_allclose(np.asarray(out["v0"]), [0.0, 2.0])
+
+    def test_udf(self, ctx):
+        ctx.register_udf("plus_bang", lambda s: str(s) + "!")
+        ctx.register_udf("sq", lambda x: float(x) * float(x))
+        out = ctx.sql("SELECT plus_bang(k) AS kb, sq(v) AS v2 FROM t LIMIT 2")
+        assert list(np.asarray(out["kb"])) == ["a!", "b!"]
+        np.testing.assert_allclose(np.asarray(out["v2"]), [1.0, 4.0])
+
+    def test_udf_in_where(self, ctx):
+        ctx.register_udf("is_vowel", lambda s: s in "aeiou")
+        out = ctx.sql("SELECT k FROM t WHERE is_vowel(k)")
+        assert list(np.asarray(out["k"])) == ["a", "a"]
+
+
+class TestReaderPushdown:
+    def test_csv_projection_skips_unselected(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("a,b,junk\n1,x,zz\n2,y,zz\n3,z,zz\n")
+        out = read_csv(p, select=["a"])
+        assert out.columns == ["a"]
+        assert list(np.asarray(out["a"])) == [1, 2, 3]
+
+    def test_csv_predicate_filters_before_device(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("a,b\n1,x\n2,y\n3,z\n")
+        out = read_csv(p, select=["b"], where=col("a") >= 2)
+        assert out.columns == ["b"]
+        assert list(np.asarray(out["b"])) == ["y", "z"]
+        assert len(out) == 2  # rows pruned at read time
+
+    def test_csv_unknown_select_rejected(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("a\n1\n")
+        with pytest.raises(KeyError):
+            read_csv(p, select=["nope"])
+
+    def test_parquet_pushdown(self, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        p = tmp_path / "d.parquet"
+        pq.write_table(
+            pa.table({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0],
+                      "c": ["x", "y", "z"]}),
+            p,
+        )
+        out = read_parquet(p, select=["c"], where=col("b") > 1.5)
+        assert out.columns == ["c"]
+        assert list(np.asarray(out["c"])) == ["y", "z"]
+
+
+class TestPandasOracleEndToEnd:
+    def test_composed_query(self, ctx):
+        """Everything at once: CTE + CASE + function + set op + order."""
+        out = ctx.sql(
+            "WITH scored AS ("
+            "  SELECT k, CASE WHEN v >= 3 THEN 'hi' ELSE 'lo' END AS band,"
+            "         SQRT(v * v) AS av FROM t"
+            ") "
+            "SELECT band, av FROM scored WHERE band LIKE 'h%' "
+            "UNION ALL "
+            "SELECT band, av FROM scored WHERE av < 2 "
+            "ORDER BY av"
+        )
+        df = pd.DataFrame({"k": ["a", "b", "c", "d", "a"],
+                           "v": [1.0, 2, 3, 4, 5]})
+        df["band"] = np.where(df.v >= 3, "hi", "lo")
+        df["av"] = np.abs(df.v)
+        want = pd.concat([
+            df[df.band.str.startswith("h")][["band", "av"]],
+            df[df.av < 2][["band", "av"]],
+        ]).sort_values("av")
+        got = pdf(out)
+        np.testing.assert_allclose(got["av"], want["av"])
+        assert list(got["band"]) == list(want["band"])
+
+
+class TestReviewRegressions3:
+    def test_cte_scope_does_not_leak_from_subquery(self, ctx):
+        out = ctx.sql(
+            "WITH w AS (SELECT k, v FROM t WHERE v > 3) "
+            "SELECT k FROM "
+            "(WITH w AS (SELECT k, v FROM t WHERE v < 2) SELECT k, v FROM w) x "
+            "JOIN w ON k"
+        )
+        # outer JOIN w must see the OUTER CTE (v > 3): inner rows k='a'(v=1)
+        # intersected with outer {'d','a'} -> only 'a'
+        assert list(np.asarray(out["k"])) == ["a"]
+
+    def test_udf_all_literal_args_broadcasts(self, ctx):
+        ctx.register_udf("inc", lambda x: x + 1)
+        out = ctx.sql("SELECT k, inc(2) AS y FROM t")
+        assert len(out) == 5
+        assert list(np.asarray(out["y"])) == [3] * 5
+
+    def test_int_min_max_reduce(self):
+        from asyncframework_tpu.data.dataset import DistributedDataset
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        sched = JobScheduler(num_workers=2)
+        blocks = {
+            0: (np.asarray([1, 2, 1], np.int32),
+                np.asarray([5, 7, 3], np.int32)),
+            1: (np.asarray([2], np.int32), np.asarray([-9], np.int32)),
+        }
+        ds = DistributedDataset.from_array_pairs(sched, blocks)
+        got_max = {}
+        for row in ds.reduce_by_key("max").collect():
+            for k, v in zip(np.asarray(row[0]), np.asarray(row[1])):
+                got_max[int(k)] = int(v)
+        ds2 = DistributedDataset.from_array_pairs(sched, blocks)
+        got_min = {}
+        for row in ds2.reduce_by_key("min").collect():
+            for k, v in zip(np.asarray(row[0]), np.asarray(row[1])):
+                got_min[int(k)] = int(v)
+        sched.shutdown()
+        assert got_max == {1: 5, 2: 7}
+        assert got_min == {1: 3, 2: -9}
