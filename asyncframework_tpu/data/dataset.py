@@ -117,6 +117,34 @@ class DistributedDataset(PairOpsMixin, Generic[E]):
             {wid: (lambda p=list(pl): p) for wid, pl in payloads.items()},
         )
 
+    @classmethod
+    def from_array_pairs(
+        cls,
+        scheduler: JobScheduler,
+        blocks: Dict[int, Tuple],
+        devices: Optional[Sequence] = None,
+    ) -> "DistributedDataset":
+        """Column-format pair partitions for the DEVICE shuffle path: each
+        partition's payload is ONE element -- a ``(keys, values)`` pair of
+        device arrays on the partition's worker device.  ``reduce_by_key``
+        with a string op then shuffles entirely on device
+        (ops/shuffle.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        devs = list(devices) if devices is not None else jax.devices()
+        placed: Dict[int, List] = {}
+        for wid, (k, v) in blocks.items():
+            dev = devs[wid % len(devs)]
+            placed[wid] = [(
+                jax.device_put(jnp.asarray(k), dev),
+                jax.device_put(jnp.asarray(v), dev),
+            )]
+        return cls(
+            scheduler,
+            {wid: (lambda p=pl: p) for wid, pl in placed.items()},
+        )
+
     # ---------------------------------------------------------------- plumbing
     @property
     def num_partitions(self) -> int:

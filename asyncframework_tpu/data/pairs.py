@@ -191,11 +191,45 @@ class PairOpsMixin:
         )
 
     def reduce_by_key(
-        self, op: Callable[[V, V], V], num_partitions: Optional[int] = None
+        self, op, num_partitions: Optional[int] = None,
+        distinct_hint: Optional[int] = None,
     ):
         """``reduceByKey`` parity (map-side combine included, like the
-        reference's default)."""
+        reference's default).
+
+        ``op`` may be a callable (host path: arbitrary Python keys/values,
+        driver-routed) or one of ``'sum'|'max'|'min'`` with array-typed
+        partitions (``from_array_pairs``), which takes the DEVICE shuffle:
+        hash partitioning, the exchange (one ``lax.all_to_all`` over the
+        device mesh), and both reduces all run as jitted XLA
+        (ops/shuffle.py -- the SortShuffleManager-role data plane).
+        """
+        if isinstance(op, str):
+            return self._reduce_by_key_device(op, distinct_hint)
         return self.combine_by_key(lambda v: v, op, op, num_partitions)
+
+    def _reduce_by_key_device(self, op: str, distinct_hint=None):
+        from asyncframework_tpu.ops.shuffle import device_reduce_by_key
+
+        blocks = self._run_sync(lambda wid: (lambda w=wid: self._compute(w)))
+        parts = {}
+        for wid, payload in blocks.items():
+            payload = list(payload)
+            kv = payload[0] if len(payload) == 1 else None
+            if not (
+                isinstance(kv, tuple) and len(kv) == 2
+                and hasattr(kv[0], "shape") and hasattr(kv[1], "shape")
+            ):
+                raise ValueError(
+                    "device reduce_by_key needs array-pair partitions "
+                    "(build with from_array_pairs); got a generic payload -- "
+                    "pass a callable op for the host path"
+                )
+            parts[wid] = kv
+        out = device_reduce_by_key(parts, op=op, distinct_hint=distinct_hint)
+        return type(self).from_partitions(
+            self.scheduler, {pid: [kv] for pid, kv in out.items()}
+        )
 
     def fold_by_key(
         self,

@@ -1,0 +1,230 @@
+"""Device-side shuffle for array-typed pair data.
+
+Parity role: ``shuffle/sort/SortShuffleManager.scala:69`` -- the engine
+component that moves (key, value) records to their key's partition and
+reduces them there.  The reference sorts spill files and fetches blocks over
+TCP because its partitions live in different JVMs; the TPU build's pair ops
+normally route through the driver (data/pairs.py -- fine at control-plane
+sizes).  THIS module is the data-plane path for numeric-array payloads: the
+whole shuffle -- hash partitioning, bucketing, the exchange, and the
+reduce -- is jitted XLA, and the exchange is ONE ``lax.all_to_all`` over a
+device mesh (ICI, no host round-trip).
+
+Pipeline (per device, all inside one shard_map):
+
+1. map-side combine: sort local keys, segment-reduce duplicates (the
+   reference's map-side ``Aggregator``),
+2. bucket by target partition ``key mod P`` into a (P, cap) send buffer
+   (sentinel key -1 pads unused slots),
+3. ``all_to_all`` the buffers (tiled: row i of every sender lands on
+   device i),
+4. reduce-side: mask sentinels, sort received keys, segment-reduce into
+   the output partition (padded; hosts strip sentinels on materialize).
+
+Keys must be non-negative int32/int64 (word ids, user ids -- the shapes the
+data plane exists for); arbitrary Python keys stay on the host path.
+Single-device meshes skip the collective (everything is already local).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+SENTINEL = -1  # invalid-slot key; real keys must be >= 0
+
+_OPS = ("sum", "max", "min")
+
+
+def _identity(op: str, dtype):
+    """Reduction identity valid for the VALUE dtype (inf converted to an
+    int dtype is implementation-defined in XLA -- integers use iinfo
+    extremes instead)."""
+    if op == "sum":
+        return jnp.zeros((), dtype)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.min if op == "max" else info.max, dtype)
+    return jnp.asarray(-jnp.inf if op == "max" else jnp.inf, dtype)
+
+
+def _reduce_into(seg, vals, n: int, op: str):
+    init = jnp.full(n, _identity(op, vals.dtype), vals.dtype)
+    at = init.at[seg]
+    if op == "sum":
+        return at.add(vals, indices_are_sorted=True, mode="drop")
+    if op == "max":
+        return at.max(vals, indices_are_sorted=True, mode="drop")
+    return at.min(vals, indices_are_sorted=True, mode="drop")
+
+
+def _segment_reduce(keys: jax.Array, vals: jax.Array, op: str,
+                    out_cap: int) -> Tuple[jax.Array, jax.Array]:
+    """Sorted segment reduction with sentinel padding.
+
+    ``keys`` may contain SENTINEL entries (sorted to the FRONT as -1);
+    output: (out_keys, out_vals) with distinct keys leading, sentinel-padded
+    to ``out_cap``.
+    """
+    order = jnp.argsort(keys)
+    sk = keys[order]
+    sv = vals[order]
+    valid = sk != SENTINEL
+    # segment boundaries among VALID sorted keys
+    first = valid & jnp.concatenate(
+        [jnp.ones(1, bool), sk[1:] != sk[:-1]]
+    )
+    seg = jnp.cumsum(first) - 1  # -1 for leading invalid run; clamp below
+    seg = jnp.where(valid, seg, out_cap)  # invalid slots dropped by mode
+    out_vals = _reduce_into(seg, jnp.where(valid, sv, 0), out_cap, op)
+    out_keys = jnp.full(out_cap, SENTINEL, sk.dtype).at[seg].set(
+        sk, indices_are_sorted=True, mode="drop"
+    )
+    if op in ("max", "min"):
+        out_vals = jnp.where(
+            out_keys == SENTINEL, jnp.zeros((), out_vals.dtype), out_vals
+        )
+    return out_keys, out_vals
+
+
+def _bucket(keys: jax.Array, vals: jax.Array, p: int, cap: int):
+    """(P, cap) send buffers: row t holds this device's pairs for target
+    partition t = key mod P, sentinel-padded."""
+    t = jnp.where(keys == SENTINEL, p, keys % p)
+    order = jnp.argsort(t)
+    sk, sv, st = keys[order], vals[order], t[order]
+    counts = jnp.bincount(st, length=p + 1)[:p]
+    offsets = jnp.concatenate([jnp.zeros(1, counts.dtype),
+                               jnp.cumsum(counts)[:-1]])
+    col = jnp.arange(sk.shape[0]) - offsets[jnp.clip(st, 0, p - 1)]
+    ok = (st < p) & (col < cap)
+    # invalid entries scatter OUT OF BOUNDS and are dropped -- routing them
+    # to any real slot would race a valid entry's write (duplicate-index
+    # .set order is unspecified)
+    rows = jnp.where(ok, st, p)
+    cols = jnp.where(ok, col, 0)
+    bk = jnp.full((p, cap), SENTINEL, sk.dtype).at[rows, cols].set(
+        sk, mode="drop"
+    )
+    bv = jnp.zeros((p, cap), sv.dtype).at[rows, cols].set(sv, mode="drop")
+    return bk, bv
+
+
+def device_reduce_by_key(
+    parts: Dict[int, Tuple[jax.Array, jax.Array]],
+    op: str = "sum",
+    devices: Optional[Sequence] = None,
+    distinct_hint: Optional[int] = None,
+) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+    """All-device shuffle-reduce: ``{pid: (keys, vals)}`` ->
+    ``{pid: (unique_keys, reduced_vals)}`` with key-mod-P partitioning.
+
+    When the partitions sit on P distinct devices the exchange is one
+    ``lax.all_to_all`` inside a shard_map over a (P,) mesh; a shared/single
+    device skips the collective (the data never needed to move).  Returns
+    HOST arrays with sentinels stripped (the payload boundary).
+
+    ``distinct_hint``: an upper bound on distinct keys per partition block
+    (e.g. the vocabulary size for a word count).  It caps the post-combine
+    buffer sizes -- without it every stage sizes for the worst case (all
+    pairs distinct, all to one target).  Too small a hint DROPS overflow
+    keys; it is a capacity promise, not a suggestion.
+    """
+    if op not in _OPS:
+        raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
+    pids = sorted(parts)
+    p = len(pids)
+    if p == 0:
+        return {}
+    # pad local blocks to one common length so every device runs the same
+    # program (static shapes)
+    n_max = max(int(parts[pid][0].shape[0]) for pid in pids)
+    n_max = max(n_max, 1)
+    key_dt = jnp.asarray(parts[pids[0]][0]).dtype
+    val_dt = jnp.asarray(parts[pids[0]][1]).dtype
+    padded_k: List[jax.Array] = []
+    padded_v: List[jax.Array] = []
+    for pid in pids:
+        k, v = parts[pid]
+        k = jnp.asarray(k)
+        v = jnp.asarray(v)
+        pad = n_max - k.shape[0]
+        if pad:
+            k = jnp.concatenate([k, jnp.full(pad, SENTINEL, key_dt)])
+            v = jnp.concatenate([v, jnp.zeros(pad, val_dt)])
+        padded_k.append(k)
+        padded_v.append(v)
+
+    devs = []
+    for pid, k in zip(pids, padded_k):
+        devs.append(list(k.devices())[0] if hasattr(k, "devices") else None)
+    distinct = len(set(devs)) == p and None not in devs
+
+    # post-combine block size: worst case n_max, capped by the caller's
+    # distinct-keys promise
+    comb = n_max if distinct_hint is None else min(n_max, int(distinct_hint))
+    comb = max(comb, 1)
+    cap = comb  # worst case: every combined pair targets one partition
+    out_cap = p * cap
+
+    if distinct and p > 1:
+        mesh = Mesh(np.array([d for d in devs]), ("w",))
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P("w"), P("w")), out_specs=(P("w"), P("w")),
+        )
+        def shuffle(k, v):
+            k = k.reshape(-1)
+            v = v.reshape(-1)
+            ck, cv = _segment_reduce(k, v, op, comb)  # map-side combine
+            bk, bv = _bucket(ck, cv, p, cap)
+            rk = jax.lax.all_to_all(bk, "w", split_axis=0, concat_axis=0,
+                                    tiled=True)
+            rv = jax.lax.all_to_all(bv, "w", split_axis=0, concat_axis=0,
+                                    tiled=True)
+            ok, ov = _segment_reduce(rk.reshape(-1), rv.reshape(-1), op,
+                                     out_cap)
+            return ok[None, :], ov[None, :]
+
+        # assemble the global sharded views IN PLACE: every block is already
+        # on its own device, so this is metadata-only (no host round-trip)
+        sharding = jax.sharding.NamedSharding(mesh, P("w"))
+        gk = jax.make_array_from_single_device_arrays(
+            (p, n_max), sharding, [k.reshape(1, -1) for k in padded_k]
+        )
+        gv = jax.make_array_from_single_device_arrays(
+            (p, n_max), sharding, [v.reshape(1, -1) for v in padded_v]
+        )
+        ok, ov = shuffle(gk, gv)
+        ok_h = np.asarray(ok)
+        ov_h = np.asarray(ov)
+        out = {}
+        for i, pid in enumerate(pids):
+            keep = ok_h[i] != SENTINEL
+            out[pid] = (ok_h[i][keep], ov_h[i][keep])
+        return out
+
+    # shared-device (or host-backed) path: same kernels, no collective --
+    # bucketing still determines each pair's output partition
+    combined = [
+        _segment_reduce(k, v, op, comb)
+        for k, v in zip(padded_k, padded_v)
+    ]
+    buckets = [_bucket(ck, cv, p, cap) for ck, cv in combined]
+    out = {}
+    for i, pid in enumerate(pids):
+        rk = jnp.concatenate([bk[i] for bk, _bv in buckets])
+        rv = jnp.concatenate([bv[i] for _bk, bv in buckets])
+        ok, ov = _segment_reduce(rk, rv, op, out_cap)
+        ok_h = np.asarray(ok)
+        ov_h = np.asarray(ov)
+        keep = ok_h != SENTINEL
+        out[pid] = (ok_h[keep], ov_h[keep])
+    return out

@@ -5,6 +5,7 @@ range-partitioned sortByKey -- the half of the RDD API the round-1 verdict
 flagged as missing entirely.
 """
 
+import numpy as np
 import pytest
 
 from asyncframework_tpu.data.dataset import DistributedDataset
@@ -171,3 +172,124 @@ class TestSampleByKey:
         f = {k: 0.3 for k in range(5)}
         assert ds.sample_by_key(f, seed=9).collect() == \
             ds.sample_by_key(f, seed=9).collect()
+
+
+class TestDeviceShuffle:
+    """reduce_by_key over array-typed partitions: the jitted hash-partition
+    + all_to_all + segment-reduce data plane (ops/shuffle.py), checked
+    against the host (driver-routed) path on identical data."""
+
+    def _word_count_data(self, n, vocab, parts, seed=0):
+        rs = np.random.default_rng(seed)
+        keys = rs.integers(0, vocab, size=n).astype(np.int32)
+        vals = np.ones(n, np.float32)
+        per = n // parts
+        return {
+            w: (keys[w * per:(w + 1) * per], vals[w * per:(w + 1) * per])
+            for w in range(parts)
+        }
+
+    def _merged(self, ds):
+        out = {}
+        for row in ds.collect():
+            k_arr, v_arr = row
+            for k, v in zip(np.asarray(k_arr), np.asarray(v_arr)):
+                assert int(k) not in out, "key appears in two partitions"
+                out[int(k)] = float(v)
+        return out
+
+    def test_device_matches_host_wordcount(self, devices8=None):
+        import time as _time
+
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        sched = JobScheduler(num_workers=8)
+        blocks = self._word_count_data(200_000, 5_000, 8)
+        dev_ds = DistributedDataset.from_array_pairs(sched, blocks)
+        t0 = _time.monotonic()
+        dev_out = self._merged(dev_ds.reduce_by_key("sum"))
+        t_dev = _time.monotonic() - t0
+
+        pairs = [
+            (int(k), float(v))
+            for w in range(8)
+            for k, v in zip(*blocks[w])
+        ]
+        host_ds = DistributedDataset.from_list(sched, pairs)
+        t0 = _time.monotonic()
+        host_out = dict(
+            host_ds.reduce_by_key(lambda a, b: a + b).collect()
+        )
+        t_host = _time.monotonic() - t0
+        sched.shutdown()
+        assert dev_out.keys() == host_out.keys()
+        for k in host_out:
+            assert dev_out[k] == pytest.approx(host_out[k])
+        print(f"\n# shuffle 2e5 pairs: device {t_dev:.3f}s host {t_host:.3f}s "
+              f"({t_host / max(t_dev, 1e-9):.1f}x)")
+
+    @pytest.mark.parametrize("op,npop", [
+        ("sum", np.add.reduce), ("max", np.maximum.reduce),
+        ("min", np.minimum.reduce),
+    ])
+    def test_ops_against_numpy_oracle(self, op, npop):
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        sched = JobScheduler(num_workers=4)
+        rs = np.random.default_rng(3)
+        blocks = {
+            w: (rs.integers(0, 50, size=256).astype(np.int32),
+                rs.normal(size=256).astype(np.float32))
+            for w in range(4)
+        }
+        ds = DistributedDataset.from_array_pairs(sched, blocks)
+        got = self._merged(ds.reduce_by_key(op))
+        sched.shutdown()
+        want = {}
+        for w in range(4):
+            for k, v in zip(*blocks[w]):
+                want.setdefault(int(k), []).append(float(v))
+        for k, vs in want.items():
+            assert got[k] == pytest.approx(npop(vs), rel=1e-5), (k, op)
+
+    def test_partitioning_is_key_mod_p(self):
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        sched = JobScheduler(num_workers=4)
+        blocks = {
+            w: (np.arange(w * 8, w * 8 + 8, dtype=np.int32),
+                np.ones(8, np.float32))
+            for w in range(4)
+        }
+        ds = DistributedDataset.from_array_pairs(sched, blocks)
+        out = ds.reduce_by_key("sum")
+        for pid, payload in enumerate(
+            out._compute(w) for w in out.partition_ids()
+        ):
+            k_arr, _ = payload[0]
+            assert all(int(k) % 4 == pid for k in np.asarray(k_arr))
+        sched.shutdown()
+
+    def test_generic_payload_rejected_for_device_op(self):
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        sched = JobScheduler(num_workers=2)
+        ds = DistributedDataset.from_list(sched, [(1, 2.0), (1, 3.0)])
+        with pytest.raises(ValueError, match="from_array_pairs"):
+            ds.reduce_by_key("sum")
+        sched.shutdown()
+
+    def test_uneven_partitions_and_empty(self):
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        sched = JobScheduler(num_workers=3)
+        blocks = {
+            0: (np.asarray([5, 5, 7], np.int32),
+                np.asarray([1., 2., 3.], np.float32)),
+            1: (np.asarray([7], np.int32), np.asarray([10.], np.float32)),
+            2: (np.asarray([], np.int32), np.asarray([], np.float32)),
+        }
+        ds = DistributedDataset.from_array_pairs(sched, blocks)
+        got = self._merged(ds.reduce_by_key("sum"))
+        sched.shutdown()
+        assert got == {5: 3.0, 7: 13.0}
