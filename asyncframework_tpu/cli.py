@@ -234,13 +234,18 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
             f"unknown driver {args.driver!r}; one of "
             f"{sorted(set(DRIVER_ALIASES.values()))} (or reference class names)"
         )
-    # multi-host bring-up is env-driven (ASYNCTPU_COORDINATOR/...); a
-    # single-process invocation is a no-op
+    # Multi-host: the SPMD sgd-mllib driver joins a jax.distributed global
+    # mesh; the ASYNC drivers instead run the DCN parameter server
+    # (parallel/ps_dcn.py): process 0 IS the PS (the driver IS the server --
+    # now across the process boundary), processes 1..N-1 push tau-stamped
+    # gradients over the coordinator address's TCP channel.
+    if os.environ.get("ASYNCTPU_COORDINATOR") and driver == "asgd":
+        return run_asgd_cluster(args, conf)
     if multihost.ensure_initialized() and driver != "sgd-mllib":
         raise SystemExit(
-            "multi-process runs support the SPMD sgd-mllib driver (the mesh "
-            "spans hosts); the async parameter-server drivers are "
-            "single-host by design (the driver IS the server)"
+            "multi-process runs support the SPMD sgd-mllib driver (global "
+            "mesh) and the DCN parameter-server asgd driver; for asaga and "
+            "the sync drivers run single-process"
         )
     devices = jax.devices()
     if args.devices is not None:
@@ -347,6 +352,100 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         summary["report"] = args.report
     summary["trajectory"] = trajectory
     return summary
+
+
+def run_asgd_cluster(args, conf):
+    """Multi-process ASGD over the DCN parameter server.
+
+    Roles by ``ASYNCTPU_PROCESS_ID``: 0 = PS (binds the coordinator
+    address's port; owns the model + updater semantics), 1..N-1 = worker
+    processes (generate/load their shard slice locally, push gradients).
+    The PS prints the run summary; workers print a small role record.
+    """
+    import numpy as np
+
+    import jax
+
+    from asyncframework_tpu.parallel import ps_dcn
+    from asyncframework_tpu.solvers import SolverConfig
+
+    coord = os.environ["ASYNCTPU_COORDINATOR"]
+    host, port_s = coord.rsplit(":", 1)
+    nproc = int(os.environ.get("ASYNCTPU_NUM_PROCESSES", "1"))
+    pid = int(os.environ.get("ASYNCTPU_PROCESS_ID", "0"))
+    if nproc < 2:
+        raise SystemExit("DCN asgd needs >= 2 processes (PS + workers)")
+
+    cfg = SolverConfig(
+        num_workers=args.num_partitions,
+        num_iterations=args.num_iterations,
+        gamma=args.gamma,
+        taw=args.taw,
+        batch_rate=args.batch_rate,
+        bucket_ratio=args.bucket_ratio,
+        printer_freq=args.printer_freq,
+        coeff=args.coeff,
+        seed=args.seed,
+        loss=args.loss,
+    )
+    for key, field in CONF_TO_FIELD.items():
+        if conf.contains(key):
+            setattr(cfg, field, conf.get(key))
+
+    n_workers_procs = nproc - 1
+    if n_workers_procs > cfg.num_workers:
+        raise SystemExit(
+            f"DCN asgd: {n_workers_procs} worker processes but only "
+            f"{cfg.num_workers} logical workers; every worker process "
+            f"needs at least one partition"
+        )
+    if pid == 0:
+        ps = ps_dcn.ParameterServer(
+            cfg, args.d, args.N, host="0.0.0.0", port=int(port_s)
+        ).start()
+        ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
+        total = ps.collect_eval(n_workers_procs, timeout_s=120.0)
+        trajectory = []
+        if total is not None:
+            times, _W = ps.snapshot_stack()
+            trajectory = [
+                (t, float(l) / args.N) for t, l in zip(times, total)
+            ]
+        ps.stop()
+        return {
+            "driver": "asgd-dcn-ps",
+            "done": bool(ok),
+            "accepted": ps.accepted,
+            "dropped": ps.dropped,
+            "max_staleness": ps.max_staleness,
+            "final_objective": trajectory[-1][1] if trajectory else None,
+            "trajectory": trajectory,
+        }
+    # ---------------------------------------------------------- worker role
+    devices = jax.devices()
+    if args.devices is not None:
+        devices = devices[: args.devices]
+    X, _y = load_data(args, cfg, devices, need_host=False)
+    if getattr(X, "is_sparse", False):
+        raise SystemExit(
+            "DCN asgd currently runs dense shards (the worker wire format "
+            "ships dense gradients); drop --sparse or run single-process"
+        )
+    wids = [
+        w for w in range(cfg.num_workers)
+        if w % n_workers_procs == (pid - 1)
+    ]
+    shards = {w: X.shard(w) for w in wids}
+    counts = ps_dcn.run_worker_process(
+        host, int(port_s), wids, shards, cfg, args.d, args.N,
+        eval_wid=wids[0], deadline_s=cfg.run_timeout_s,
+    )
+    return {
+        "driver": "asgd-dcn-worker",
+        "process_id": pid,
+        "gradients": int(sum(counts.values())),
+        "trajectory": [],
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -11,9 +11,13 @@ process boundary here.
 Every process runs the stock CLI (``asyncframework_tpu.cli``) with the
 bring-up env vars set (``ASYNCTPU_COORDINATOR`` / ``ASYNCTPU_NUM_PROCESSES``
 / ``ASYNCTPU_PROCESS_ID``), so a recipe that works single-process works on
-the cluster unchanged -- multi-process supports the SPMD ``sgd-mllib``
-driver (the async parameter-server drivers are single-host by design; the
-driver IS the server).
+the cluster unchanged.  Two multi-process modes:
+
+- ``sgd-mllib``: SPMD over a ``jax.distributed`` global mesh (collectives
+  ride the loopback DCN);
+- ``asgd``: the DCN parameter server (``parallel/ps_dcn.py``) -- process 0
+  runs the PS (the driver IS the server, across the process boundary),
+  the rest push tau-stamped gradients to it over TCP.
 
 CLI: ``bin/async-cluster <N> [--devices-per-process K] -- <cli args...>``
 e.g. ``bin/async-cluster 2 -- sgd-mllib synthetic synthetic 64 4096 8 100
