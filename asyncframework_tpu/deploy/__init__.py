@@ -1,0 +1,12 @@
+"""Standalone cluster deploy: Master/Worker daemons + submission client.
+
+Parity: ``deploy/master/Master.scala:41`` / ``deploy/worker/Worker.scala:43``
+/ ``deploy/client/StandaloneAppClient.scala:44`` -- the reference's
+standalone resource manager.  See ``deploy/master.py`` for the design notes.
+"""
+
+from asyncframework_tpu.deploy.client import submit_app, wait_app, MasterClient
+from asyncframework_tpu.deploy.master import Master
+from asyncframework_tpu.deploy.worker import Worker
+
+__all__ = ["Master", "Worker", "MasterClient", "submit_app", "wait_app"]

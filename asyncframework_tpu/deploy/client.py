@@ -1,0 +1,64 @@
+"""Submission client: talk to a standalone Master.
+
+Parity: ``deploy/client/StandaloneAppClient.scala:44`` + the submit side of
+``SparkSubmit.scala:71`` -- register an application, learn its id, poll its
+state.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Dict, List, Optional
+
+from asyncframework_tpu.parallel.ps_dcn import _recv_msg, _send_msg
+
+
+class MasterClient:
+    def __init__(self, host: str, port: int):
+        self.addr = (host, int(port))
+
+    def _call(self, msg: dict) -> dict:
+        with socket.create_connection(self.addr, timeout=10) as s:
+            _send_msg(s, msg)
+            reply, _ = _recv_msg(s)
+        if reply.get("op") == "ERR":
+            raise RuntimeError(f"master error: {reply.get('msg')}")
+        return reply
+
+    def submit(self, argv: List[str], num_processes: int,
+               env: Optional[Dict[str, str]] = None) -> str:
+        reply = self._call({
+            "op": "SUBMIT_APP", "argv": list(argv),
+            "num_processes": int(num_processes), "env": env or {},
+        })
+        return reply["app_id"]
+
+    def status(self, app_id: str) -> dict:
+        return self._call({"op": "APP_STATUS", "app_id": app_id})
+
+    def workers(self) -> dict:
+        return self._call({"op": "LIST_WORKERS"})["workers"]
+
+    def kill(self, app_id: str) -> dict:
+        return self._call({"op": "KILL_APP", "app_id": app_id})
+
+
+def submit_app(master: str, argv: List[str], num_processes: int,
+               env: Optional[Dict[str, str]] = None) -> str:
+    host, port = master.rsplit(":", 1)
+    return MasterClient(host, int(port)).submit(argv, num_processes, env)
+
+
+def wait_app(master: str, app_id: str, timeout_s: float = 300.0) -> dict:
+    """Poll until the app reaches a terminal state (FINISHED/FAILED/LOST)."""
+    host, port = master.rsplit(":", 1)
+    cl = MasterClient(host, int(port))
+    deadline = time.monotonic() + timeout_s
+    st = {"state": "UNKNOWN"}  # non-positive timeout: loop never runs
+    while time.monotonic() < deadline:
+        st = cl.status(app_id)
+        if st["state"] in ("FINISHED", "FAILED", "LOST", "KILLED"):
+            return st
+        time.sleep(0.25)
+    raise TimeoutError(f"app {app_id} still {st['state']}")

@@ -1,0 +1,368 @@
+"""Standalone Master daemon: worker registry, app scheduling, recovery.
+
+Parity (studied, not copied): ``deploy/master/Master.scala:41`` -- workers
+REGISTER and heartbeat; applications are submitted with a requested process
+count; the master assigns processes to alive workers and tells each worker
+to launch an executor process; lost workers are detected by heartbeat
+timeout; master state survives restart through a persistence engine
+(``ZooKeeperPersistenceEngine.scala:34`` -- here a single-node
+atomic-rename JSON file fills the PersistenceEngine role; the interface
+point is the same, the consensus service is out of scope on one machine).
+
+TPU-first deltas: the wire is the same length-prefixed JSON/TCP framing as
+the DCN parameter server (``parallel/ps_dcn.py``) -- one transport for the
+whole control plane, no RPC mesh.  A launched app process receives the
+``ASYNCTPU_*`` env (coordinator address, process count, process id), so a
+scheduled app IS an ``async-cluster`` run placed by the master: SPMD jobs
+join a global mesh, ``asgd`` jobs form the PS + worker-pusher topology.
+
+Protocol (all messages carry ``op``):
+  worker -> master: REGISTER_WORKER {worker_id, host, port, cores}
+                    HEARTBEAT {worker_id}
+                    EXECUTOR_EXIT {worker_id, app_id, proc_id, returncode}
+  client -> master: SUBMIT_APP {argv, num_processes, env}
+                    APP_STATUS {app_id} | LIST_WORKERS | KILL_APP {app_id}
+  master -> worker: (reply to heartbeat) LAUNCH orders piggybacked
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from asyncframework_tpu.cluster import _free_port
+from asyncframework_tpu.parallel.ps_dcn import _recv_msg, _send_msg
+
+# NOTE on coordinator ports: _free_port binds-then-releases on the master's
+# host, so (a) another process could steal the port before the app binds it
+# (submit again on that rare failure) and (b) the probe assumes process 0
+# lands on a host where the port is equally free -- both acceptable for the
+# single-machine standalone story this layer targets.
+
+WORKER_TIMEOUT_S = 10.0
+
+
+class Master:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persistence_dir: Optional[str] = None,
+        worker_timeout_s: float = WORKER_TIMEOUT_S,
+    ):
+        self.host = host
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.2)
+        self.port = self._srv.getsockname()[1]
+        self._lock = threading.Lock()
+        # worker_id -> {host, port, cores, last_seen, alive}
+        self.workers: Dict[str, Dict] = {}
+        # app_id -> {argv, env, num_processes, state, assignments, exits}
+        self.apps: Dict[str, Dict] = {}
+        self._app_seq = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._worker_timeout_s = worker_timeout_s
+        if persistence_dir:
+            os.makedirs(persistence_dir, exist_ok=True)
+            self._persist_path = os.path.join(
+                persistence_dir, "master-state.json"
+            )
+        else:
+            self._persist_path = None
+        self._recover()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "Master":
+        t = threading.Thread(target=self._accept_loop, name="master-accept",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        t2 = threading.Thread(target=self._reaper_loop, name="master-reaper",
+                              daemon=True)
+        t2.start()
+        self._threads.append(t2)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ---------------------------------------------------------- persistence
+    def _persist(self) -> None:
+        """PersistenceEngine role: apps + registered workers survive a
+        master restart (atomic rename; heartbeats re-validate liveness)."""
+        if self._persist_path is None:
+            return
+        state = {
+            "workers": {
+                wid: {k: w[k] for k in ("host", "port", "cores")}
+                for wid, w in self.workers.items()
+            },
+            "apps": {
+                aid: {
+                    "argv": a["argv"], "env": a["env"],
+                    "num_processes": a["num_processes"],
+                    "state": a["state"],
+                }
+                for aid, a in self.apps.items()
+            },
+            "app_seq": self._app_seq,
+        }
+        tmp = self._persist_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self._persist_path)
+
+    def _recover(self) -> None:
+        if self._persist_path is None or not os.path.exists(
+            self._persist_path
+        ):
+            return
+        with open(self._persist_path) as f:
+            state = json.load(f)
+        now = time.monotonic()
+        for wid, w in state.get("workers", {}).items():
+            # recovered workers must re-heartbeat before they count as alive
+            self.workers[wid] = {
+                **w, "last_seen": now - self._worker_timeout_s, "alive": False
+            }
+        for aid, a in state.get("apps", {}).items():
+            self.apps[aid] = {
+                **a, "assignments": [], "exits": {},
+                # RUNNING apps lost their processes with the old master:
+                # surface that instead of pretending
+                "state": ("LOST" if a["state"] in ("RUNNING", "LAUNCHING")
+                          else a["state"]),
+            }
+        self._app_seq = int(state.get("app_seq", 0))
+
+    # -------------------------------------------------------------- serving
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+
+    def _reaper_loop(self) -> None:
+        """Worker-loss detection (the reference's CheckForWorkerTimeOut)."""
+        while not self._stop.wait(self._worker_timeout_s / 4):
+            now = time.monotonic()
+            with self._lock:
+                for wid, w in self.workers.items():
+                    if w["alive"] and now - w["last_seen"] > self._worker_timeout_s:
+                        w["alive"] = False
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                header, _payload = _recv_msg(conn)
+                # handler errors must come back as ERR replies -- letting
+                # them fall into the connection-error handler would close
+                # the socket without replying ("peer closed" at the client,
+                # with the real cause invisible)
+                try:
+                    reply = self._handle(header)
+                except Exception as e:  # noqa: BLE001 - reported to caller
+                    reply = {"op": "ERR",
+                             "msg": f"{type(e).__name__}: {e}"}
+                _send_msg(conn, reply)
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    # ------------------------------------------------------------- handlers
+    def _handle(self, msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "REGISTER_WORKER":
+            with self._lock:
+                self.workers[msg["worker_id"]] = {
+                    "host": msg["host"], "port": int(msg["port"]),
+                    "cores": int(msg.get("cores", 1)),
+                    "last_seen": time.monotonic(), "alive": True,
+                }
+                self._persist()
+            return {"op": "REGISTERED", "master": self.address}
+        if op == "HEARTBEAT":
+            with self._lock:
+                w = self.workers.get(msg["worker_id"])
+                if w is None:
+                    # reference parity: an unknown heartbeat asks the
+                    # worker to re-register (master may have restarted)
+                    return {"op": "RECONNECT"}
+                w["last_seen"] = time.monotonic()
+                w["alive"] = True
+            return {"op": "ACK"}
+        if op == "EXECUTOR_EXIT":
+            with self._lock:
+                app = self.apps.get(msg["app_id"])
+                if app is not None:
+                    app["exits"][str(msg["proc_id"])] = int(msg["returncode"])
+                    if (
+                        len(app["exits"]) >= app["num_processes"]
+                        and app["state"] in ("LAUNCHING", "RUNNING")
+                    ):
+                        # KILLED stays KILLED: the kill's terminations
+                        # produce nonzero exits that must not relabel it
+                        bad = [rc for rc in app["exits"].values() if rc]
+                        app["state"] = "FAILED" if bad else "FINISHED"
+                        self._persist()
+            return {"op": "ACK"}
+        if op == "SUBMIT_APP":
+            return self._submit(msg)
+        if op == "KILL_APP":
+            return self._kill(msg["app_id"])
+        if op == "APP_STATUS":
+            with self._lock:
+                app = self.apps.get(msg["app_id"])
+                if app is None:
+                    return {"op": "ERR", "msg": "no such app"}
+                # copies, not live references: serialization happens after
+                # the lock is released, racing EXECUTOR_EXIT mutations
+                return {
+                    "op": "APP", "app_id": msg["app_id"],
+                    "state": app["state"],
+                    "assignments": [dict(a) for a in app["assignments"]],
+                    "exits": dict(app["exits"]),
+                }
+        if op == "LIST_WORKERS":
+            with self._lock:
+                return {
+                    "op": "WORKERS",
+                    "workers": {
+                        wid: {"host": w["host"], "cores": w["cores"],
+                              "alive": w["alive"]}
+                        for wid, w in self.workers.items()
+                    },
+                }
+        return {"op": "ERR", "msg": f"bad op {op!r}"}
+
+    def _submit(self, msg: dict) -> dict:
+        """Schedule: round-robin the app's processes over alive workers
+        (spreadOutApps-style placement), then order launches."""
+        nproc = int(msg["num_processes"])
+        with self._lock:
+            alive = [
+                (wid, w) for wid, w in self.workers.items() if w["alive"]
+            ]
+            if not alive:
+                return {"op": "ERR", "msg": "no alive workers"}
+            self._app_seq += 1
+            app_id = f"app-{self._app_seq:04d}"
+            coord_port = _free_port()
+            coord = f"{alive[0][1]['host']}:{coord_port}"
+            assignments = []
+            for proc_id in range(nproc):
+                wid, w = alive[proc_id % len(alive)]
+                assignments.append({"proc_id": proc_id, "worker_id": wid})
+            self.apps[app_id] = {
+                "argv": list(msg["argv"]), "env": dict(msg.get("env") or {}),
+                "num_processes": nproc, "state": "LAUNCHING",
+                "assignments": assignments, "exits": {},
+            }
+            self._persist()
+            app = self.apps[app_id]
+        # order launches outside the lock (worker RPCs)
+        ok = True
+        for a in assignments:
+            w = self.workers[a["worker_id"]]
+            env = dict(app["env"])
+            env.update(
+                ASYNCTPU_COORDINATOR=coord,
+                ASYNCTPU_NUM_PROCESSES=str(nproc),
+                ASYNCTPU_PROCESS_ID=str(a["proc_id"]),
+            )
+            try:
+                with socket.create_connection(
+                    (w["host"], w["port"]), timeout=10
+                ) as ws:
+                    _send_msg(ws, {
+                        "op": "LAUNCH", "app_id": app_id,
+                        "proc_id": a["proc_id"], "argv": app["argv"],
+                        "env": env, "master": self.address,
+                    })
+                    _recv_msg(ws)
+            except (ConnectionError, OSError):
+                ok = False
+        if not ok:
+            # reclaim executors already launched: half an SPMD app would
+            # otherwise sit in distributed bring-up holding devices
+            self._order_kills(app_id, assignments)
+        with self._lock:
+            # only LAUNCHING -> RUNNING: a fast-exiting app may already have
+            # reached FINISHED/FAILED via EXECUTOR_EXIT, and stamping RUNNING
+            # over a terminal state would strand it forever
+            if app["state"] == "LAUNCHING":
+                app["state"] = "RUNNING" if ok else "FAILED"
+            self._persist()
+        return {"op": "SUBMITTED", "app_id": app_id, "coordinator": coord}
+
+    def _order_kills(self, app_id: str, assignments) -> None:
+        for a in assignments:
+            w = self.workers.get(a["worker_id"])
+            if w is None:
+                continue
+            try:
+                with socket.create_connection(
+                    (w["host"], w["port"]), timeout=10
+                ) as ws:
+                    _send_msg(ws, {"op": "KILL", "app_id": app_id})
+                    _recv_msg(ws)
+            except (ConnectionError, OSError):
+                continue  # worker gone; its procs die with it
+
+    def _kill(self, app_id: str) -> dict:
+        """KILL_APP: terminate every executor, mark the app KILLED."""
+        with self._lock:
+            app = self.apps.get(app_id)
+            if app is None:
+                return {"op": "ERR", "msg": "no such app"}
+            assignments = [dict(a) for a in app["assignments"]]
+        self._order_kills(app_id, assignments)
+        with self._lock:
+            if app["state"] in ("LAUNCHING", "RUNNING", "LOST"):
+                app["state"] = "KILLED"
+                self._persist()
+        return {"op": "KILLED", "app_id": app_id}
+
+
+def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser("async-master")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7077)
+    p.add_argument("--persistence-dir", default=None)
+    args = p.parse_args(argv)
+    m = Master(args.host, args.port, args.persistence_dir).start()
+    print(f"master listening on {m.address}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        m.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

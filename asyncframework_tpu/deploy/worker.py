@@ -1,0 +1,193 @@
+"""Standalone Worker daemon: registers with the master, launches executors.
+
+Parity (studied, not copied): ``deploy/worker/Worker.scala:43`` -- register
+with the master, heartbeat, receive LAUNCH orders, fork executor processes
+(here: ``python -m asyncframework_tpu.cli`` with the app's argv and the
+``ASYNCTPU_*`` env the master assigned), watch them, and report exits back.
+An unknown-worker heartbeat reply (master restarted) triggers
+re-registration, the reference's reconnect dance.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from asyncframework_tpu.parallel.ps_dcn import _recv_msg, _send_msg
+
+
+class Worker:
+    def __init__(
+        self,
+        master_host: str,
+        master_port: int,
+        worker_id: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        cores: int = 1,
+        heartbeat_s: float = 1.0,
+        launch_env_extra: Optional[Dict[str, str]] = None,
+    ):
+        self.master = (master_host, int(master_port))
+        self.worker_id = worker_id or f"worker-{os.getpid()}"
+        self.cores = cores
+        self.heartbeat_s = heartbeat_s
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.2)
+        self.host = host
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        # app_id -> live Popen list (pruned as executors exit)
+        self._procs: Dict[str, List[subprocess.Popen]] = {}
+        self._procs_lock = threading.Lock()
+        self._launch_env_extra = dict(launch_env_extra or {})
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "Worker":
+        self._register()
+        for fn, name in (
+            (self._serve_loop, "worker-serve"),
+            (self._heartbeat_loop, "worker-heartbeat"),
+        ):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._procs_lock:
+            live = [p for ps in self._procs.values() for p in ps]
+        for p in live:
+            if p.poll() is None:
+                p.terminate()
+
+    # ------------------------------------------------------- master contact
+    def _master_call(self, msg: dict) -> dict:
+        with socket.create_connection(self.master, timeout=10) as s:
+            _send_msg(s, msg)
+            reply, _ = _recv_msg(s)
+        return reply
+
+    def _register(self) -> None:
+        reply = self._master_call({
+            "op": "REGISTER_WORKER", "worker_id": self.worker_id,
+            "host": self.host, "port": self.port, "cores": self.cores,
+        })
+        if reply.get("op") != "REGISTERED":
+            raise RuntimeError(f"registration rejected: {reply}")
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                reply = self._master_call({
+                    "op": "HEARTBEAT", "worker_id": self.worker_id,
+                })
+                if reply.get("op") == "RECONNECT":
+                    self._register()  # master restarted; re-introduce
+            except (ConnectionError, OSError):
+                continue  # master gone; keep trying (HA window)
+
+    # --------------------------------------------------------------- orders
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg, _ = _recv_msg(conn)
+                if msg.get("op") == "LAUNCH":
+                    self._launch(msg)
+                    _send_msg(conn, {"op": "ACK"})
+                elif msg.get("op") == "KILL":
+                    with self._procs_lock:
+                        doomed = list(self._procs.get(msg["app_id"], ()))
+                    for p in doomed:
+                        if p.poll() is None:
+                            p.terminate()
+                    _send_msg(conn, {"op": "ACK", "killed": len(doomed)})
+                else:
+                    _send_msg(conn, {"op": "ERR", "msg": "bad op"})
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                conn.close()
+
+    def _launch(self, order: dict) -> None:
+        env = dict(os.environ)
+        env.update(order.get("env") or {})
+        env.update(self._launch_env_extra)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "asyncframework_tpu.cli", *order["argv"]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        with self._procs_lock:
+            self._procs.setdefault(order["app_id"], []).append(proc)
+
+        def watch() -> None:
+            # NOTE: output is buffered until exit (fine for the batch apps
+            # this layer schedules; a log-streaming executor is future work)
+            out, err = proc.communicate()
+            with self._procs_lock:
+                ps = self._procs.get(order["app_id"], [])
+                if proc in ps:
+                    ps.remove(proc)
+                if not ps:
+                    self._procs.pop(order["app_id"], None)
+            try:
+                self._master_call({
+                    "op": "EXECUTOR_EXIT", "worker_id": self.worker_id,
+                    "app_id": order["app_id"], "proc_id": order["proc_id"],
+                    "returncode": proc.returncode,
+                })
+            except (ConnectionError, OSError):
+                pass
+            if proc.returncode and err:
+                sys.stderr.write(
+                    f"[{self.worker_id}] app {order['app_id']} proc "
+                    f"{order['proc_id']} rc={proc.returncode}:\n"
+                    + "\n".join(err.splitlines()[-10:]) + "\n"
+                )
+            # process 0's stdout is the app's output (SPMD/PS convention)
+            if order["proc_id"] == 0 and out:
+                sys.stdout.write(out)
+                sys.stdout.flush()
+
+        threading.Thread(target=watch, daemon=True).start()
+
+
+def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
+    import argparse
+
+    p = argparse.ArgumentParser("async-worker")
+    p.add_argument("master", help="master address host:port")
+    p.add_argument("--cores", type=int, default=1)
+    p.add_argument("--worker-id", default=None)
+    args = p.parse_args(argv)
+    host, port = args.master.rsplit(":", 1)
+    w = Worker(host, int(port), worker_id=args.worker_id,
+               cores=args.cores).start()
+    print(f"worker {w.worker_id} on {w.host}:{w.port} -> {args.master}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        w.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

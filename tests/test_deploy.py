@@ -1,0 +1,184 @@
+"""Standalone Master/Worker deploy layer (SURVEY §2.4 "Deploy").
+
+Parity coverage: worker registration + heartbeat liveness
+(Master.scala:41), executor launch + exit reporting (Worker.scala:43),
+app lifecycle states, submission client (StandaloneAppClient.scala:44),
+worker-loss detection, and master-restart recovery through the
+file persistence engine (ZooKeeperPersistenceEngine.scala:34 role).
+"""
+
+import json
+import time
+
+import pytest
+
+from asyncframework_tpu.deploy import Master, MasterClient, Worker, wait_app
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    m = Master(persistence_dir=str(tmp_path), worker_timeout_s=2.0).start()
+    workers = [
+        Worker("127.0.0.1", m.port, worker_id=f"w{i}",
+               heartbeat_s=0.3,
+               launch_env_extra={"ASYNCTPU_FORCE_CPU": "1",
+                                 "JAX_PLATFORMS": "cpu"}).start()
+        for i in range(2)
+    ]
+    yield m, workers
+    for w in workers:
+        w.stop()
+    m.stop()
+
+
+class TestRegistryAndLiveness:
+    def test_register_and_list(self, rig):
+        m, _ = rig
+        cl = MasterClient("127.0.0.1", m.port)
+        ws = cl.workers()
+        assert set(ws) == {"w0", "w1"}
+        assert all(w["alive"] for w in ws.values())
+
+    def test_worker_loss_detected(self, rig):
+        m, workers = rig
+        workers[1].stop()
+        deadline = time.monotonic() + 10
+        cl = MasterClient("127.0.0.1", m.port)
+        while time.monotonic() < deadline:
+            ws = cl.workers()
+            if not ws["w1"]["alive"]:
+                break
+            time.sleep(0.2)
+        assert not cl.workers()["w1"]["alive"]
+        assert cl.workers()["w0"]["alive"]
+
+    def test_submit_with_no_workers_rejected(self, tmp_path):
+        m = Master(persistence_dir=str(tmp_path)).start()
+        try:
+            cl = MasterClient("127.0.0.1", m.port)
+            with pytest.raises(RuntimeError, match="no alive workers"):
+                cl.submit(["--quiet", "asgd"], 2)
+        finally:
+            m.stop()
+
+
+class TestAppLifecycle:
+    def test_spmd_app_runs_to_finished(self, rig):
+        m, _ = rig
+        cl = MasterClient("127.0.0.1", m.port)
+        # a 2-process SPMD recipe placed by the master: coordinator env is
+        # assigned by the scheduler, processes join over jax.distributed
+        app_id = cl.submit(
+            ["--quiet", "sgd-mllib", "synthetic", "synthetic",
+             "16", "512", "4", "20", "1.0", "0", "0.5", "0.5",
+             "10", "0", "42"],
+            num_processes=2,
+        )
+        st = wait_app(f"127.0.0.1:{m.port}", app_id, timeout_s=240.0)
+        assert st["state"] == "FINISHED", st
+        assert len(st["exits"]) == 2
+        assert all(rc == 0 for rc in st["exits"].values())
+
+    def test_asgd_ps_app_through_master(self, rig):
+        """The full standalone-cluster story: the master schedules a
+        3-process DCN asgd app (PS + 2 gradient-pushing workers) across
+        its registered worker daemons, and it runs to FINISHED."""
+        m, _ = rig
+        cl = MasterClient("127.0.0.1", m.port)
+        app_id = cl.submit(
+            ["--quiet", "asgd", "synthetic", "synthetic",
+             "16", "2048", "8", "200", "1.0", "2147483647", "0.3",
+             "0.5", "50", "0", "42"],
+            num_processes=3,
+        )
+        st = wait_app(f"127.0.0.1:{m.port}", app_id, timeout_s=240.0)
+        assert st["state"] == "FINISHED", st
+        assert len(st["exits"]) == 3
+
+    def test_failed_app_reported(self, rig):
+        m, _ = rig
+        cl = MasterClient("127.0.0.1", m.port)
+        app_id = cl.submit(["definitely-not-a-driver"], num_processes=1)
+        st = wait_app(f"127.0.0.1:{m.port}", app_id, timeout_s=120.0)
+        assert st["state"] == "FAILED"
+
+    def test_kill_app_reclaims_executors(self, rig):
+        """KILL_APP terminates the app's executor processes on every
+        worker and the app lands in KILLED (not FAILED: the terminations'
+        nonzero exits must not relabel it)."""
+        m, _ = rig
+        cl = MasterClient("127.0.0.1", m.port)
+        # 2-process DCN asgd with a huge iteration budget: runs for minutes
+        # unless killed
+        app_id = cl.submit(
+            ["--quiet", "asgd", "synthetic", "synthetic",
+             "16", "2048", "8", "5000000", "0.01", "2147483647", "0.3",
+             "0.5", "1000", "0", "42"],
+            num_processes=2,
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if cl.status(app_id)["state"] == "RUNNING":
+                break
+            time.sleep(0.2)
+        time.sleep(2.0)  # let the executors get properly underway
+        reply = cl.kill(app_id)
+        assert reply["op"] == "KILLED"
+        st = wait_app(f"127.0.0.1:{m.port}", app_id, timeout_s=60.0)
+        assert st["state"] == "KILLED"
+        # exit reports land asynchronously after the terminations
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = cl.status(app_id)
+            if len(st["exits"]) == 2:
+                break
+            time.sleep(0.2)
+        assert len(st["exits"]) == 2  # both executors reported their death
+        assert st["state"] == "KILLED"  # nonzero exits did not relabel it
+
+
+class TestMasterRecovery:
+    def test_state_survives_master_restart(self, tmp_path):
+        m = Master(persistence_dir=str(tmp_path), worker_timeout_s=2.0).start()
+        w = Worker("127.0.0.1", m.port, worker_id="w0",
+                   heartbeat_s=0.3).start()
+        cl = MasterClient("127.0.0.1", m.port)
+        assert "w0" in cl.workers()
+        port = m.port
+        m.stop()
+        time.sleep(0.2)
+        # new master on the SAME port recovers the registry from disk;
+        # the worker's heartbeat (or RECONNECT reply) re-validates it
+        m2 = Master(port=port, persistence_dir=str(tmp_path),
+                    worker_timeout_s=2.0).start()
+        try:
+            cl2 = MasterClient("127.0.0.1", m2.port)
+            ws = cl2.workers()
+            assert "w0" in ws  # recovered from the persistence engine
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if cl2.workers()["w0"]["alive"]:
+                    break
+                time.sleep(0.2)
+            assert cl2.workers()["w0"]["alive"]  # re-validated by heartbeat
+        finally:
+            w.stop()
+            m2.stop()
+
+    def test_running_apps_marked_lost_on_recovery(self, tmp_path):
+        state = {
+            "workers": {},
+            "apps": {"app-0001": {
+                "argv": ["x"], "env": {}, "num_processes": 2,
+                "state": "RUNNING",
+            }},
+            "app_seq": 1,
+        }
+        with open(f"{tmp_path}/master-state.json", "w") as f:
+            json.dump(state, f)
+        m2 = Master(persistence_dir=str(tmp_path)).start()
+        try:
+            cl = MasterClient("127.0.0.1", m2.port)
+            assert cl.status("app-0001")["state"] == "LOST"
+        finally:
+            m2.stop()
