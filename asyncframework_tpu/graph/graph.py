@@ -59,6 +59,71 @@ class Graph:
         if self.edge_attr is not None and self.edge_attr.shape[0] != self.src.shape[0]:
             raise ValueError("edge_attr first dim must equal num_edges")
 
+    @classmethod
+    def from_edge_ids(
+        cls,
+        src_ids,
+        dst_ids,
+        vertex_attr_by_id: Optional[dict] = None,
+        edge_attr=None,
+    ) -> "Graph":
+        """Build a graph from ARBITRARY vertex ids (sparse i64, hashes --
+        the ids GraphX accepts and pays a routing table for).
+
+        The dense relabeling is computed once on host (`np.unique` over the
+        edge endpoints) and remembered: ``vertex_ids[j]`` is the original id
+        of dense vertex ``j``, and every algorithm's per-vertex output can
+        be re-keyed with :meth:`original_ids`.  This is the routing table's
+        job done once at construction instead of per-superstep shuffle.
+        """
+        src_ids = np.asarray(src_ids)
+        dst_ids = np.asarray(dst_ids)
+        endpoints = np.concatenate([src_ids, dst_ids])
+        # ids supplied only through attributes become ISOLATED vertices
+        # (GraphX keeps the vertex set's extra ids; silently dropping an
+        # entity the caller named would corrupt per-vertex outputs)
+        universe = endpoints
+        if vertex_attr_by_id is not None:
+            universe = np.concatenate([
+                endpoints,
+                np.asarray(list(vertex_attr_by_id), endpoints.dtype),
+            ])
+        ids = np.unique(universe)
+        inv = np.searchsorted(ids, endpoints)
+        e = len(src_ids)
+        vattr = None
+        if vertex_attr_by_id is not None:
+            missing = [i for i in ids.tolist() if i not in vertex_attr_by_id]
+            if missing:
+                raise ValueError(
+                    f"vertex_attr_by_id missing ids (first few): "
+                    f"{missing[:5]}"
+                )
+            vattr = np.asarray([vertex_attr_by_id[i] for i in ids.tolist()])
+        g = cls(
+            inv[:e].astype(np.int32),
+            inv[e:].astype(np.int32),
+            num_vertices=int(len(ids)),
+            vertex_attr=vattr,
+            edge_attr=edge_attr,
+        )
+        g.vertex_ids = ids  # dense index -> original id
+        return g
+
+    def original_ids(self) -> np.ndarray:
+        """Original vertex id per dense index (identity for graphs built
+        with dense ids)."""
+        ids = getattr(self, "vertex_ids", None)
+        return ids if ids is not None else np.arange(self.num_vertices)
+
+    def _keep_ids(self, g: "Graph") -> "Graph":
+        """Views preserve the vertex DOMAIN, so the original-id mapping
+        carries over unchanged (derived graphs must re-key correctly)."""
+        ids = getattr(self, "vertex_ids", None)
+        if ids is not None:
+            g.vertex_ids = ids
+        return g
+
     @property
     def num_edges(self) -> int:
         return int(self.src.shape[0])
@@ -75,13 +140,16 @@ class Graph:
 
     # ---------------------------------------------------------------- views
     def reverse(self) -> "Graph":
-        return Graph(
+        return self._keep_ids(Graph(
             self.dst, self.src, self.num_vertices, self.vertex_attr,
             self.edge_attr,
-        )
+        ))
 
     def with_vertex_attr(self, attr) -> "Graph":
-        return Graph(self.src, self.dst, self.num_vertices, attr, self.edge_attr)
+        return self._keep_ids(
+            Graph(self.src, self.dst, self.num_vertices, attr,
+                  self.edge_attr)
+        )
 
     def map_vertices(self, f) -> "Graph":
         """``Graph.mapVertices`` parity: new vertex attributes from one
@@ -94,10 +162,10 @@ class Graph:
         """``Graph.mapEdges`` parity (vectorized over the edge array)."""
         if self.edge_attr is None:
             raise ValueError("graph has no edge_attr to map")
-        return Graph(
+        return self._keep_ids(Graph(
             self.src, self.dst, self.num_vertices, self.vertex_attr,
             f(self.edge_attr),
-        )
+        ))
 
     def subgraph(self, edge_mask=None, vertex_mask=None) -> "Graph":
         """``Graph.subgraph`` parity: keep edges passing ``edge_mask``
@@ -113,12 +181,12 @@ class Graph:
                 raise ValueError("vertex_mask must have num_vertices entries")
             keep = keep & vm[self.src] & vm[self.dst]
         idx = np.nonzero(np.asarray(keep))[0]
-        return Graph(
+        return self._keep_ids(Graph(
             np.asarray(self.src)[idx], np.asarray(self.dst)[idx],
             self.num_vertices, self.vertex_attr,
             None if self.edge_attr is None
             else np.asarray(self.edge_attr)[idx],
-        )
+        ))
 
     def aggregate_messages(self, send_msg, merge: str = "sum"):
         """``Graph.aggregateMessages`` parity -- THE GraphX primitive: per

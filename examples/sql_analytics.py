@@ -57,6 +57,29 @@ def main(n=4000, n_users=50):
     top = ranked.filter(col("rk") == 1)
     print(f"window fn: top purchase per user ({len(top)} rows, "
           f"max {float(np.asarray(top['amount']).max()):.1f})")
+
+    # round-3 surface: CTE + CASE + scalar subquery + UDF + set op
+    ctx.register_udf("short_tier", lambda t: str(t)[:1].upper())
+    bands = ctx.sql(
+        "WITH spend AS ("
+        "  SELECT user, SUM(amount) AS total FROM events GROUP BY user"
+        ") "
+        "SELECT short_tier(tier) AS t, "
+        "       CASE WHEN total > (SELECT AVG(total) FROM spend) "
+        "            THEN 'above' ELSE 'below' END AS band, "
+        "       total "
+        "FROM spend JOIN users ON user"
+    )
+    above = ctx.sql(
+        "SELECT user FROM (SELECT user, SUM(amount) AS total FROM events "
+        "GROUP BY user) s WHERE total BETWEEN 400 AND 10000 "
+        "UNION SELECT user FROM users WHERE tier LIKE 'p%'"
+    )
+    n_above = int(
+        np.asarray(bands["band"], object).tolist().count("above")
+    )
+    print(f"CASE/subquery: {n_above} users above mean spend; "
+          f"UNION of big-spenders and pro tier: {len(above)} users")
     return heavy
 
 

@@ -388,3 +388,81 @@ class TestGraphViews:
         np.testing.assert_allclose(np.asarray(g2.edge_attr), [6.0])
         with pytest.raises(ValueError):
             Graph.from_edges([(0, 1)]).map_vertices(lambda a: a)
+
+
+class TestArbitraryVertexIds:
+    """GraphX accepts arbitrary i64 vertex ids (and pays a routing table);
+    Graph.from_edge_ids does the relabeling once at construction."""
+
+    def test_pagerank_invariant_under_relabeling(self):
+        from asyncframework_tpu.graph import Graph
+        from asyncframework_tpu.graph.algorithms import pagerank
+
+        # a small dense-id graph and the SAME graph under huge sparse ids
+        src = np.asarray([0, 0, 1, 2, 3])
+        dst = np.asarray([1, 2, 2, 3, 0])
+        big = np.asarray(
+            [10_000_000_007, 42, 9_876_543_210_123, 7, 2**40], np.int64
+        )
+        g_dense = Graph(src, dst)
+        g_big = Graph.from_edge_ids(big[src], big[dst])
+        pr_dense = np.asarray(pagerank(g_dense, num_iterations=30))
+        pr_big = np.asarray(pagerank(g_big, num_iterations=30))
+        # re-key both by original id and compare
+        by_id_dense = {int(i): float(p) for i, p in
+                       zip(g_dense.original_ids(), pr_dense)}
+        by_id_big = {int(i): float(p) for i, p in
+                     zip(g_big.original_ids(), pr_big)}
+        assert set(by_id_big) == {int(big[i]) for i in range(4)}
+        for i in range(4):
+            assert by_id_big[int(big[i])] == pytest.approx(
+                by_id_dense[i], rel=1e-5
+            )
+
+    def test_vertex_attrs_by_id(self):
+        from asyncframework_tpu.graph import Graph
+
+        g = Graph.from_edge_ids(
+            np.asarray([100, 200], np.int64),
+            np.asarray([200, 300], np.int64),
+            vertex_attr_by_id={100: 1.0, 200: 2.0, 300: 3.0},
+        )
+        assert g.num_vertices == 3
+        ids = list(g.original_ids())
+        attrs = np.asarray(g.vertex_attr)
+        assert {int(i): float(a) for i, a in zip(ids, attrs)} == {
+            100: 1.0, 200: 2.0, 300: 3.0
+        }
+
+    def test_attr_only_id_becomes_isolated_vertex(self):
+        from asyncframework_tpu.graph import Graph
+
+        g = Graph.from_edge_ids(
+            np.asarray([1]), np.asarray([2]),
+            vertex_attr_by_id={1: 0.5, 2: 1.5, 9: 9.5},
+        )
+        assert g.num_vertices == 3  # vertex 9 kept as an isolate
+        by_id = dict(zip(g.original_ids().tolist(),
+                         np.asarray(g.vertex_attr).tolist()))
+        assert by_id[9] == 9.5
+
+    def test_views_preserve_original_ids(self):
+        from asyncframework_tpu.graph import Graph
+
+        g = Graph.from_edge_ids(
+            np.asarray([100, 200], np.int64), np.asarray([200, 300], np.int64)
+        )
+        want = g.original_ids().tolist()
+        assert g.reverse().original_ids().tolist() == want
+        assert g.subgraph(
+            edge_mask=np.asarray([True, False])
+        ).original_ids().tolist() == want
+
+    def test_missing_attr_id_rejected(self):
+        from asyncframework_tpu.graph import Graph
+
+        with pytest.raises(ValueError, match="missing ids"):
+            Graph.from_edge_ids(
+                np.asarray([1]), np.asarray([2]),
+                vertex_attr_by_id={1: 0.0},
+            )

@@ -114,6 +114,79 @@ class TestInProcess:
         ps.stop()
 
 
+class TestWorkerDeath:
+    def test_run_survives_a_killed_worker_group_mid_run(self, devices8):
+        """Multi-process fault tolerance: 5 of 8 workers die MID-RUN
+        (sockets dropped, no goodbye), leaving 3 survivors -- fewer than
+        the cohort threshold of 4 -- so completion additionally proves
+        the starvation fallback keeps waves flowing."""
+        import threading as th
+
+        cfg = make_cfg(num_iterations=60, bucket_ratio=0.5,
+                       printer_freq=20)
+        n, d = 4096, 24
+        ds = ShardedDataset.generate_on_device(n, d, 8, devices=devices8,
+                                               seed=11, noise=0.01)
+        ps = ps_dcn.ParameterServer(cfg, d, n, device=devices8[0],
+                                    port=0).start()
+        shards = {w: ds.shard(w) for w in range(3)}
+
+        doomed_stop = th.Event()
+        doomed_pushes = {"n": 0}
+
+        def doomed():
+            # workers 3..7 participate normally until killed mid-run
+            clients = {
+                wid: ps_dcn.PSClient("127.0.0.1", ps.port)
+                for wid in range(3, 8)
+            }
+            try:
+                while not doomed_stop.is_set():
+                    for wid, c in clients.items():
+                        got = c.pull(wid)
+                        if got is None or doomed_stop.is_set():
+                            return
+                        ts, _w_host, _avg, _cal = got
+                        c.push(wid, ts, np.zeros(d, np.float32))
+                        doomed_pushes["n"] += 1
+            except (ConnectionError, OSError):
+                return
+            finally:
+                for c in clients.values():
+                    try:
+                        c.sock.close()  # abrupt death, no BYE
+                    except OSError:
+                        pass
+
+        t_doomed = th.Thread(target=doomed, daemon=True)
+        t_doomed.start()
+
+        survivor_counts = {}
+
+        def survivors():
+            survivor_counts.update(ps_dcn.run_worker_process(
+                "127.0.0.1", ps.port, list(range(3)), shards, cfg, d, n,
+                deadline_s=180.0,
+            ))
+
+        t_surv = th.Thread(target=survivors, daemon=True)
+        t_surv.start()
+        # let the full 8-worker run get underway, then kill the group
+        deadline = time.monotonic() + 30
+        while doomed_pushes["n"] < 5 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        doomed_stop.set()
+        t_doomed.join(timeout=15)
+        assert doomed_pushes["n"] >= 5, "doomed group never participated"
+
+        t_surv.join(timeout=180)
+        done = ps.wait_done(timeout_s=30.0)
+        ps.stop()
+        assert done, "run did not finish after a worker group died mid-run"
+        assert ps.accepted == cfg.num_iterations
+        assert sum(survivor_counts.values()) > 0
+
+
 @pytest.mark.slow
 class TestMultiProcess:
     def test_two_worker_processes_converge(self):
