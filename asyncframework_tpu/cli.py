@@ -61,6 +61,7 @@ CONF_TO_FIELD: Dict[str, str] = {
     "async.allocation.idle.timeout.s": "allocation_idle_timeout_s",
     "async.heartbeat.timeout.ms": "heartbeat_timeout_ms",
     "async.max.slot.failures": "max_slot_failures",
+    "async.ui.port": "ui_port",
 }
 
 DRIVER_ALIASES: Dict[str, str] = {
@@ -239,7 +240,13 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
     # (parallel/ps_dcn.py): process 0 IS the PS (the driver IS the server --
     # now across the process boundary), processes 1..N-1 push tau-stamped
     # gradients over the coordinator address's TCP channel.
-    if os.environ.get("ASYNCTPU_COORDINATOR") and driver == "asgd":
+    if (
+        os.environ.get("ASYNCTPU_COORDINATOR")
+        and driver == "asgd"
+        and int(os.environ.get("ASYNCTPU_NUM_PROCESSES", "1")) > 1
+    ):
+        # a 1-process placement (e.g. a master-scheduled single-executor
+        # app) is just a normal single-process run; DCN mode needs peers
         return run_asgd_cluster(args, conf)
     if multihost.ensure_initialized() and driver != "sgd-mllib":
         raise SystemExit(

@@ -126,6 +126,20 @@ HEARTBEAT_TIMEOUT_S = ConfigEntry("async.heartbeat.timeout", 5.0, float,
                                   "Executor declared dead after this silence.")
 DRAIN_BATCH = ConfigEntry("async.drain.batch", 1, int,
                           "Queued gradients folded into one device dispatch.")
+UI_PORT = ConfigEntry("async.ui.port", -1, int,
+                      "Live dashboard HTTP port (0 = ephemeral, -1 = off) "
+                      "-- spark.ui.port analog.")
+RECEIVER_MAX_BUFFER = ConfigEntry(
+    "async.streaming.receiver.max.buffer", 0, int,
+    "Receiver bounded-buffer size (0 = unbounded) -- block generator cap.")
+RECEIVER_MAX_RATE = ConfigEntry(
+    "async.streaming.receiver.max.rate", 0.0, float,
+    "Receiver ingest cap, elements/sec (0 = unlimited) -- "
+    "spark.streaming.receiver.maxRate analog.")
+BACKPRESSURE = ConfigEntry(
+    "async.streaming.backpressure.enabled", False, bool,
+    "PID-estimated receiver rate control -- "
+    "spark.streaming.backpressure.enabled analog.")
 SPECULATION_QUANTILE = ConfigEntry(
     "async.speculation.quantile", 0.75, float,
     "Fraction of tasks that must finish before speculating.")

@@ -13,6 +13,7 @@ async parameter-server loop, not Spark's stage/RDD taxonomy.
 
 from __future__ import annotations
 
+import functools
 import queue
 import threading
 from dataclasses import asdict, dataclass, field
@@ -108,6 +109,7 @@ class Listener:
         pass
 
 
+@functools.lru_cache(maxsize=None)
 def _snake(name: str) -> str:
     out = []
     for i, c in enumerate(name):

@@ -63,7 +63,9 @@ class RunInstruments:
         self.ui = None
         self.live_state = None
         ui_port = getattr(cfg, "ui_port", None)
-        if ui_port is not None:
+        # None or negative = off (the conf registry's -1 sentinel); 0 = bind
+        # an ephemeral port
+        if ui_port is not None and ui_port >= 0:
             from asyncframework_tpu.metrics.live import (
                 LiveStateListener,
                 LiveUIServer,

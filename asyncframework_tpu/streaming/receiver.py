@@ -41,11 +41,22 @@ class ReceiverStream(DStream):
     """
 
     def __init__(self, ssc, wal=None, max_buffer: Optional[int] = None,
-                 overflow: str = "block", backpressure: bool = False,
+                 overflow: str = "block", backpressure: Optional[bool] = None,
                  max_rate: Optional[float] = None):
         super().__init__(ssc)
         if overflow not in ("block", "drop"):
             raise ValueError(f"overflow must be 'block' or 'drop', got {overflow!r}")
+        # unset kwargs fall back to the registered config entries (set via
+        # --conf / ASYNCTPU_* env -- the spark.streaming.* analogs)
+        from asyncframework_tpu import conf as _conf
+
+        _c = _conf.AsyncConf()
+        if max_buffer is None:
+            max_buffer = _c.get(_conf.RECEIVER_MAX_BUFFER) or None
+        if max_rate is None:
+            max_rate = _c.get(_conf.RECEIVER_MAX_RATE) or None
+        if backpressure is None:
+            backpressure = bool(_c.get(_conf.BACKPRESSURE))
         self._buf: List[Any] = []
         self._buf_lock = threading.Condition()
         self._wal = wal

@@ -182,3 +182,20 @@ class TestMasterRecovery:
             assert cl.status("app-0001")["state"] == "LOST"
         finally:
             m2.stop()
+
+
+class TestSingleProcessApp:
+    def test_one_process_asgd_runs_plain(self, rig):
+        """A 1-process asgd placement gets coordinator env from the master
+        but must run as a normal single-process solver (DCN mode needs
+        peers)."""
+        m, _ = rig
+        cl = MasterClient("127.0.0.1", m.port)
+        app_id = cl.submit(
+            ["--quiet", "asgd", "synthetic", "synthetic",
+             "16", "1024", "4", "100", "1.0", "2147483647", "0.3",
+             "0.5", "50", "0", "42"],
+            num_processes=1,
+        )
+        st = wait_app(f"127.0.0.1:{m.port}", app_id, timeout_s=240.0)
+        assert st["state"] == "FINISHED", st

@@ -625,3 +625,21 @@ class TestBackpressure:
         head = sum(seen[:3]) / max(len(seen[:3]), 1)
         tail = sum(seen[-3:]) / max(len(seen[-3:]), 1)
         assert tail < head, (seen, rx.current_rate)
+
+
+class TestBackpressureConf:
+    def test_env_configures_receiver_defaults(self, monkeypatch):
+        from asyncframework_tpu.streaming.context import StreamingContext
+        from asyncframework_tpu.streaming.receiver import ReceiverStream
+
+        monkeypatch.setenv("ASYNCTPU_ASYNC_STREAMING_RECEIVER_MAX_BUFFER", "7")
+        monkeypatch.setenv("ASYNCTPU_ASYNC_STREAMING_BACKPRESSURE_ENABLED",
+                           "true")
+        ssc = StreamingContext(batch_interval_ms=10, clock=ManualClock())
+        rx = ReceiverStream(ssc)
+        assert rx._max_buffer == 7
+        assert rx._estimator is not None
+        # explicit kwargs still beat the env-config defaults
+        rx2 = ReceiverStream(ssc, max_buffer=3, backpressure=False)
+        assert rx2._max_buffer == 3
+        assert rx2._estimator is None
