@@ -157,6 +157,11 @@ def parse_conf_overlays(pairs: List[str]) -> AsyncConf:
                 + ", ".join(sorted(known))
             )
         conf.set(k, v.strip())
+    # make the overlays visible to components that resolve conf defaults
+    # themselves (e.g. receiver backpressure knobs)
+    from asyncframework_tpu.conf import set_global_conf
+
+    set_global_conf(conf)
     return conf
 
 
@@ -240,14 +245,13 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
     # (parallel/ps_dcn.py): process 0 IS the PS (the driver IS the server --
     # now across the process boundary), processes 1..N-1 push tau-stamped
     # gradients over the coordinator address's TCP channel.
-    if (
-        os.environ.get("ASYNCTPU_COORDINATOR")
-        and driver == "asgd"
-        and int(os.environ.get("ASYNCTPU_NUM_PROCESSES", "1")) > 1
-    ):
+    if os.environ.get("ASYNCTPU_COORDINATOR") and driver == "asgd":
+        nproc = int(os.environ.get("ASYNCTPU_NUM_PROCESSES", "1"))
+        if nproc > 1:
+            return run_asgd_cluster(args, conf)
         # a 1-process placement (e.g. a master-scheduled single-executor
-        # app) is just a normal single-process run; DCN mode needs peers
-        return run_asgd_cluster(args, conf)
+        # app) is just a normal single-process run; DCN mode needs peers.
+        # ensure_initialized below also no-ops for nproc <= 1.
     if multihost.ensure_initialized() and driver != "sgd-mllib":
         raise SystemExit(
             "multi-process runs support the SPMD sgd-mllib driver (global "

@@ -44,6 +44,23 @@ def registry() -> Dict[str, ConfigEntry]:
     return dict(_REGISTRY)
 
 
+_GLOBAL_CONF: Optional["AsyncConf"] = None
+
+
+def set_global_conf(conf: Optional["AsyncConf"]) -> None:
+    """Install the process's effective configuration (the CLI does this
+    with its --conf overlays) so components constructed without an explicit
+    conf -- e.g. receivers resolving backpressure defaults -- see the same
+    values the run was submitted with."""
+    global _GLOBAL_CONF
+    _GLOBAL_CONF = conf
+
+
+def global_conf() -> "AsyncConf":
+    """The installed process conf, or a fresh one (env > defaults)."""
+    return _GLOBAL_CONF if _GLOBAL_CONF is not None else AsyncConf()
+
+
 class AsyncConf:
     """String/typed k/v configuration with precedence: explicit set > env
     (``ASYNCTPU_<KEY_UPPER_WITH_UNDERSCORES>``) > registered default."""

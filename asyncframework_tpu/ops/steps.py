@@ -285,41 +285,75 @@ def make_sparse_asgd_worker_step(batch_rate: float, d: int):
 
 
 def make_sparse_saga_worker_step(batch_rate: float, d: int):
-    """jit (cols, vals, y, w, alpha, key) -> (g, diff, mask, new_key).
+    """jit (cols, vals, y, w, alpha, key) ->
+    (g, diff_sel, idx, valid, c_sel, v_sel, new_key) -- COMPACTED.
 
-    Sparse ASAGA worker computation: ``diff`` are candidate history scalars,
-    ``g = sum_i mask_i (diff_i - alpha_i) x_i`` via scatter-add.
+    Sparse ASAGA worker computation with the same masked-row compaction as
+    the ASGD step: the Bernoulli-sampled row ids pack into a static-capacity
+    index vector and only those rows' cols/vals/history are touched (~b of
+    the full-shard gather/scatter volume).  ``diff_sel`` are the candidate
+    history scalars FOR THE SELECTED ROWS; ``idx``/``valid`` say where they
+    go; ``c_sel``/``v_sel`` (validity-zeroed) ride along so the updater's
+    exact table delta needs no second row gather.
     """
-    from asyncframework_tpu.ops.gradients import (
-        make_sparse_grad_sum,
-        sparse_residual,
-    )
-
-    grad_sum = make_sparse_grad_sum(d)
-
-    @jax.jit
-    def step(cols, vals, y, w, alpha, key):
-        key, sub = jax.random.split(key)
-        mask = jax.random.bernoulli(sub, batch_rate, (y.shape[0],)).astype(
-            vals.dtype
-        )
-        diff = sparse_residual(cols, vals, y, w)
-        g = grad_sum(cols, vals, mask * (diff - alpha))
-        return g, diff, mask, key
-
-    return step
-
-
-def make_sparse_table_delta(d: int):
-    """jit (cols, vals, diff, mask, alpha_cur) -> exact table delta (sparse
-    analog of :func:`make_saga_table_delta`)."""
     from asyncframework_tpu.ops.gradients import make_sparse_grad_sum
 
     grad_sum = make_sparse_grad_sum(d)
 
     @jax.jit
-    def delta(cols, vals, diff, mask, alpha_cur):
-        return grad_sum(cols, vals, mask * (diff - alpha_cur))
+    def step(cols, vals, y, w, alpha, key):
+        n_rows = y.shape[0]  # static at trace time
+        cap = sparse_step_capacity(batch_rate, n_rows)
+        key, sub = jax.random.split(key)
+        mask = jax.random.bernoulli(sub, batch_rate, (n_rows,))
+        (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
+        valid = (jnp.arange(cap) < jnp.sum(mask)).astype(vals.dtype)
+        c_sel = cols[idx]
+        v_sel = vals[idx] * valid[:, None]  # unfilled slots contribute 0
+        diff_sel = jnp.sum(v_sel * w[c_sel], axis=1) - y[idx] * valid
+        g = grad_sum(c_sel, v_sel, diff_sel - alpha[idx])
+        return g, diff_sel, idx, valid, c_sel, v_sel, key
+
+    return step
+
+
+def make_sparse_saga_commit():
+    """jit (alpha, diff_sel, idx, valid) -> alpha'.
+
+    Commit the accepted candidate scalars into the worker's history slice:
+    ``alpha[idx_j] <- diff_sel_j`` for valid slots.  Invalid (padding)
+    slots scatter OUT OF BOUNDS and are dropped -- routing them anywhere
+    real would race a valid write at the same index.  ``idx`` is ascending
+    (``jnp.nonzero`` order) with padding at the tail, so the scatter runs
+    with ``indices_are_sorted``.
+    """
+
+    @jax.jit
+    def commit(alpha, diff_sel, idx, valid):
+        n = alpha.shape[0]
+        tgt = jnp.where(valid > 0, idx, n)
+        return alpha.at[tgt].set(
+            diff_sel, indices_are_sorted=True, mode="drop"
+        )
+
+    return commit
+
+
+def make_sparse_table_delta(d: int):
+    """jit (c_sel, v_sel, diff_sel, alpha_cur, idx) -> exact table delta.
+
+    The compacted analog of :func:`make_saga_table_delta`: the change the
+    commit makes to the mean history gradient, computed against the CURRENT
+    table slice (``alpha_cur[idx]``) at commit time -- see the dense
+    variant's docstring for why dispatch-time history drifts.
+    """
+    from asyncframework_tpu.ops.gradients import make_sparse_grad_sum
+
+    grad_sum = make_sparse_grad_sum(d)
+
+    @jax.jit
+    def delta(c_sel, v_sel, diff_sel, alpha_cur, idx):
+        return grad_sum(c_sel, v_sel, diff_sel - alpha_cur[idx])
 
     return delta
 

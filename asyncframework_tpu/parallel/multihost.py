@@ -75,6 +75,12 @@ def ensure_initialized(
     )
     if coordinator_address is None and num_processes is None and not auto:
         return False  # single-process: nothing to do
+    if num_processes is not None and num_processes <= 1:
+        # an explicit 1-process "cluster" (e.g. a master-scheduled
+        # single-executor placement) is just a single process: spinning up
+        # the distributed service would bind the coordinator port and buy
+        # nothing
+        return False
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

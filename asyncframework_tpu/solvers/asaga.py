@@ -84,6 +84,8 @@ class ASAGA(FlopsAccountingMixin):
             self._step = steps.make_sparse_saga_worker_step(
                 config.batch_rate, self.ds.d
             )
+            self._sparse_compact = True  # flops = compacted rows, not n_p
+            self._commit = steps.make_sparse_saga_commit()
             self._table_delta = steps.make_sparse_table_delta(self.ds.d)
             self._eval = steps.make_sparse_trajectory_loss_eval()
         else:
@@ -222,7 +224,7 @@ class ASAGA(FlopsAccountingMixin):
                     res = ctx.collect_all(timeout=cfg.collect_timeout_s)
                 except queue.Empty:
                     continue
-                g, diff, mask = res.data
+                g = res.data[0]
                 task_ms = waiting.on_finish(res.worker_id, now_ms())
                 do_save = False
                 with state_lock:
@@ -235,23 +237,33 @@ class ASAGA(FlopsAccountingMixin):
                         with hot_lock:
                             alpha_cur = alpha[res.worker_id]
                             # a shard re-homed while this result was in
-                            # flight leaves diff/mask on the old device;
+                            # flight leaves the payload on the old device;
                             # normalize onto the slice's current home
-                            if diff.device != alpha_cur.device:
-                                diff = jax.device_put(diff, alpha_cur.device)
-                                mask = jax.device_put(mask, alpha_cur.device)
+                            home = alpha_cur.device
+                            payload = tuple(
+                                jax.device_put(a, home) if a.device != home
+                                else a
+                                for a in res.data[1:]
+                            )
                             # exact table delta (see make_saga_table_delta)
                             if self._sparse:
+                                diff, idx, valid, c_sel, v_sel = payload
                                 delta = self._table_delta(
-                                    shard.cols, shard.vals, diff, mask, alpha_cur
+                                    c_sel, v_sel, diff, alpha_cur, idx
+                                )
+                                alpha[res.worker_id] = self._commit(
+                                    alpha_cur, diff, idx, valid
                                 )
                             else:
+                                diff, mask = payload
                                 delta = self._table_delta(
                                     shard.X, diff, mask, alpha_cur
                                 )
-                            alpha[res.worker_id] = steps.saga_commit_history(
-                                alpha_cur, diff, mask
-                            )
+                                alpha[res.worker_id] = (
+                                    steps.saga_commit_history(
+                                        alpha_cur, diff, mask
+                                    )
+                                )
                         if g.device != self.driver_device:
                             g = jax.device_put(g, self.driver_device)
                         if delta.device != self.driver_device:
@@ -494,7 +506,7 @@ class ASAGA(FlopsAccountingMixin):
                 acc = None
                 for _ in range(nw):
                     res = self._collect_checked(ctx, waiter, cfg.run_timeout_s)
-                    g, diff, mask = res.data
+                    g = res.data[0]
                     flops += self._task_flops(res.worker_id)
                     task_ms = waiting.on_finish(res.worker_id, now_ms())
                     calibrator.record(k, task_ms)
@@ -505,14 +517,29 @@ class ASAGA(FlopsAccountingMixin):
                     with hot_lock:
                         alpha_cur = alpha[res.worker_id]
                         # a shard re-homed mid-round leaves this result's
-                        # diff/mask on the old device; commit on the slice's
-                        # current home
-                        if diff.device != alpha_cur.device:
-                            diff = jax.device_put(diff, alpha_cur.device)
-                            mask = jax.device_put(mask, alpha_cur.device)
-                        alpha[res.worker_id] = steps.saga_commit_history(
-                            alpha_cur, diff, mask
+                        # payload on the old device; commit on the slice's
+                        # current home.  The sync drain's commit needs only
+                        # diff/idx/valid -- never transfer the (cap, K)
+                        # c_sel/v_sel arrays it would just discard.
+                        home = alpha_cur.device
+                        needed = (
+                            res.data[1:4] if self._sparse else res.data[1:]
                         )
+                        payload = tuple(
+                            jax.device_put(a, home) if a.device != home
+                            else a
+                            for a in needed
+                        )
+                        if self._sparse:
+                            diff, idx, valid = payload
+                            alpha[res.worker_id] = self._commit(
+                                alpha_cur, diff, idx, valid
+                            )
+                        else:
+                            diff, mask = payload
+                            alpha[res.worker_id] = steps.saga_commit_history(
+                                alpha_cur, diff, mask
+                            )
                     if g.device != self.driver_device:
                         g = jax.device_put(g, self.driver_device)
                     acc = g if acc is None else steps.add_grads(acc, g)
@@ -603,18 +630,17 @@ class ASAGA(FlopsAccountingMixin):
             a0 = jax.device_put(jnp.zeros(shard.size, jnp.float32), dev)
             key = jax.device_put(jax.random.PRNGKey(0), dev)
             if self._sparse:
-                g, diff, mask, _ = self._step(
+                g, diff, idx, valid, c_sel, v_sel, _ = self._step(
                     shard.cols, shard.vals, shard.y, w0, a0, key
                 )
                 if not sync:
-                    delta = self._table_delta(
-                        shard.cols, shard.vals, diff, mask, a0
-                    )
+                    delta = self._table_delta(c_sel, v_sel, diff, a0, idx)
+                self._commit(a0, diff, idx, valid)
             else:
                 g, diff, mask, _ = self._step(shard.X, shard.y, w0, a0, key)
                 if not sync:
                     delta = self._table_delta(shard.X, diff, mask, a0)
-            steps.saga_commit_history(a0, diff, mask)
+                steps.saga_commit_history(a0, diff, mask)
         if g.device != drv:
             g = jax.device_put(g, drv)
         wd = jax.device_put(jnp.zeros(d, jnp.float32), drv)
@@ -655,15 +681,16 @@ class ASAGA(FlopsAccountingMixin):
             if key_local.device != dev:
                 key_local = jax.device_put(key_local, dev)
             if sparse:
-                g, diff, mask, new_key = step(
+                out = step(
                     shard.cols, shard.vals, shard.y, w_local, a_local, key_local
                 )
             else:
-                g, diff, mask, new_key = step(
-                    shard.X, shard.y, w_local, a_local, key_local
-                )
-            g.block_until_ready()
-            return g, diff, mask, new_key
+                out = step(shard.X, shard.y, w_local, a_local, key_local)
+            out[0].block_until_ready()
+            # (g, ...payload..., new_key) -- the payload arity differs
+            # between the dense (diff, mask) and compacted sparse
+            # (diff_sel, idx, valid, c_sel, v_sel) steps
+            return out
 
         return fn
 
@@ -687,14 +714,14 @@ class ASAGA(FlopsAccountingMixin):
         par_recs = int(self.cfg.batch_rate * self.ds.n / self.cfg.num_workers)
 
         def handler(wid: int, result):
-            g, diff, mask, new_key = result
+            *data, new_key = result
             # advance the key slot before merge_result marks the worker
             # available (see ASGD._handler for why)
             with key_lock:
                 worker_keys[wid] = new_key
             ctx.merge_result(
                 wid,
-                (g, diff, mask),
+                tuple(data),
                 submit_clock=submit_clock,
                 elapsed_ms=now_ms() - submit_wall,
                 batch_size=par_recs,

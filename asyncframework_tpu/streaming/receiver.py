@@ -47,10 +47,11 @@ class ReceiverStream(DStream):
         if overflow not in ("block", "drop"):
             raise ValueError(f"overflow must be 'block' or 'drop', got {overflow!r}")
         # unset kwargs fall back to the registered config entries (set via
-        # --conf / ASYNCTPU_* env -- the spark.streaming.* analogs)
+        # --conf overlays installed as the global conf, or ASYNCTPU_* env
+        # -- the spark.streaming.* analogs)
         from asyncframework_tpu import conf as _conf
 
-        _c = _conf.AsyncConf()
+        _c = _conf.global_conf()
         if max_buffer is None:
             max_buffer = _c.get(_conf.RECEIVER_MAX_BUFFER) or None
         if max_rate is None:

@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from asyncframework_tpu.data import (
     SparseShardedDataset,
@@ -74,6 +75,9 @@ class TestSparseOps:
         )
 
     def test_sparse_saga_step_matches_dense_formula(self, devices8):
+        """The compacted sparse SAGA step reproduces the dense masked
+        formula exactly: recover the selected-row mask from (idx, valid)
+        and compare gradient, candidate scalars, and the commit."""
         indptr, indices, values, y = small_sparse(64, 32, 0.2, seed=5)
         ds = SparseShardedDataset(indptr, indices, values, y, 32, 1, devices8[:1])
         s = ds.shard(0)
@@ -81,12 +85,35 @@ class TestSparseOps:
         w = rs.normal(size=(32,)).astype(np.float32)
         alpha = rs.normal(size=(64,)).astype(np.float32)
         step = steps.make_sparse_saga_worker_step(0.5, 32)
-        g, diff, mask, _ = step(s.cols, s.vals, s.y, w, alpha, jax.random.PRNGKey(0))
+        g, diff_sel, idx, valid, c_sel, v_sel, _ = step(
+            s.cols, s.vals, s.y, w, alpha, jax.random.PRNGKey(0)
+        )
+        idx_h = np.asarray(idx)
+        valid_h = np.asarray(valid)
+        sel = idx_h[valid_h > 0]
+        m = np.zeros(64, np.float32)
+        m[sel] = 1.0
         X, _ = densify(ds)
-        np.testing.assert_allclose(np.asarray(diff), X @ w - y, rtol=1e-4, atol=1e-5)
-        m = np.asarray(mask)
-        expect = X.T @ (m * ((X @ w - y) - alpha))
+        full_diff = X @ w - y
+        # candidate scalars for the selected rows match the dense residual
+        np.testing.assert_allclose(
+            np.asarray(diff_sel)[valid_h > 0], full_diff[sel],
+            rtol=1e-4, atol=1e-5,
+        )
+        expect = X.T @ (m * (full_diff - alpha))
         np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-3, atol=1e-3)
+        # the commit writes exactly the selected rows
+        commit = steps.make_sparse_saga_commit()
+        a2 = np.asarray(commit(jnp.asarray(alpha), diff_sel, idx, valid))
+        want = np.where(m > 0, full_diff, alpha)
+        np.testing.assert_allclose(a2, want, rtol=1e-4, atol=1e-5)
+        # and the exact table delta equals the dense formulation
+        delta = steps.make_sparse_table_delta(32)(
+            c_sel, v_sel, diff_sel, jnp.asarray(alpha), idx
+        )
+        np.testing.assert_allclose(
+            np.asarray(delta), expect, rtol=1e-3, atol=1e-3
+        )
 
 
 class TestSparseSolvers:

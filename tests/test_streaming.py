@@ -643,3 +643,23 @@ class TestBackpressureConf:
         rx2 = ReceiverStream(ssc, max_buffer=3, backpressure=False)
         assert rx2._max_buffer == 3
         assert rx2._estimator is None
+
+    def test_programmatic_conf_configures_receiver(self):
+        from asyncframework_tpu.conf import (
+            AsyncConf,
+            set_global_conf,
+        )
+        from asyncframework_tpu.streaming.context import StreamingContext
+        from asyncframework_tpu.streaming.receiver import ReceiverStream
+
+        conf = AsyncConf()
+        conf.set("async.streaming.receiver.max.buffer", "11")
+        conf.set("async.streaming.backpressure.enabled", "true")
+        set_global_conf(conf)
+        try:
+            ssc = StreamingContext(batch_interval_ms=10, clock=ManualClock())
+            rx = ReceiverStream(ssc)
+            assert rx._max_buffer == 11
+            assert rx._estimator is not None
+        finally:
+            set_global_conf(None)
