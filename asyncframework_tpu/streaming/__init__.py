@@ -1,9 +1,14 @@
 from asyncframework_tpu.streaming.dstream import DStream
 from asyncframework_tpu.streaming.context import StreamingContext
-from asyncframework_tpu.streaming.receiver import ReceiverStream, SocketTextStream
+from asyncframework_tpu.streaming.receiver import (
+    ReceiverStream,
+    SocketTextStream,
+    TextFileStream,
+)
 from asyncframework_tpu.streaming.wal import WriteAheadLog
 
 __all__ = [
     "DStream", "StreamingContext", "ReceiverStream", "SocketTextStream",
+    "TextFileStream",
     "WriteAheadLog",
 ]

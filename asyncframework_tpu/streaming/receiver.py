@@ -171,6 +171,72 @@ class ReceiverStream(DStream):
         return batch
 
 
+class TextFileStream(ReceiverStream):
+    """``ssc.textFileStream(dir)`` analog: watch a directory; each interval's
+    batch is the lines of files that APPEARED since the last interval.
+
+    Parity: ``streaming/.../dstream/FileInputDStream.scala`` -- files are
+    selected by presence (new path not seen before), read once, and never
+    re-read on modification (the reference's rename-into-place contract:
+    writers must move complete files in).  Hidden/partial conventions
+    honored: names starting with ``.`` or ending in ``.tmp`` are ignored.
+    """
+
+    def __init__(self, ssc, directory, wal=None):
+        # a polled source, not a push receiver: the buffer/rate-limit
+        # machinery does not apply (compute() reads the filesystem
+        # directly), so those kwargs are deliberately not accepted
+        super().__init__(ssc, wal=wal)
+        import os
+
+        self.directory = str(directory)
+        self._seen: set = set()
+        # files already present at stream construction belong to the past
+        # (FileInputDStream ignores pre-existing files by mod-time window;
+        # presence-at-start is the equivalent contract here)
+        if os.path.isdir(self.directory):
+            self._seen.update(os.listdir(self.directory))
+
+    def compute(self, time_ms: int) -> Any:
+        import os
+
+        batch: List[Any] = []
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            # directory missing/replaced/forbidden this interval: an empty
+            # batch, never a dead job-generator thread
+            names = []
+        for name in names:
+            if name in self._seen:
+                continue
+            if name.startswith(".") or name.endswith(".tmp"):
+                continue
+            path = os.path.join(self.directory, name)
+            if not os.path.isfile(path):
+                continue
+            try:
+                # utf-8 with replacement, like SocketTextStream: a stray
+                # undecodable byte must not kill the stream
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    lines = [line.rstrip("\n") for line in f]
+            except OSError:
+                continue  # transient (perms/NFS): retried next interval
+            # mark seen only AFTER a successful read -- a transient open
+            # failure must not permanently drop the file's data
+            self._seen.add(name)
+            batch.extend(lines)
+        # remember-window analog: names no longer present cannot recur
+        # except as NEW files (the rename-into-place contract), so prune
+        # them -- _seen stays bounded by the directory's live population
+        self._seen.intersection_update(names)
+        if not batch:
+            return EMPTY
+        if self._wal is not None:
+            self._wal.append(time_ms, batch)
+        return batch
+
+
 class SocketTextStream(ReceiverStream):
     """``ssc.socketTextStream(host, port)`` analog: newline-delimited UTF-8
     lines from a TCP connection; each interval's batch is the list of lines

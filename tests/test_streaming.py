@@ -663,3 +663,81 @@ class TestBackpressureConf:
             assert rx._estimator is not None
         finally:
             set_global_conf(None)
+
+
+class TestTextFileStream:
+    """FileInputDStream parity: new files per interval, pre-existing and
+    hidden/partial files ignored, each file read exactly once."""
+
+    def test_new_files_batched_per_interval(self, tmp_path):
+        from asyncframework_tpu.streaming import StreamingContext, TextFileStream
+
+        (tmp_path / "old.txt").write_text("pre-existing\n")
+        ssc = StreamingContext(batch_interval_ms=10, clock=ManualClock())
+        fs = TextFileStream(ssc, tmp_path)
+        got = []
+        fs.foreach_batch(lambda _t, b: got.append(list(b)))
+
+        assert ssc.generate_batch(10) == 0  # nothing new yet
+        (tmp_path / "a.txt").write_text("l1\nl2\n")
+        (tmp_path / ".hidden").write_text("nope\n")
+        (tmp_path / "part.tmp").write_text("nope\n")
+        assert ssc.generate_batch(20) == 1
+        assert got == [["l1", "l2"]]
+        # same file never re-read; a fresh file lands in the next batch
+        (tmp_path / "b.txt").write_text("l3\n")
+        ssc.generate_batch(30)
+        assert got == [["l1", "l2"], ["l3"]]
+
+    def test_wal_records_file_batches(self, tmp_path):
+        from asyncframework_tpu.streaming import (
+            StreamingContext,
+            TextFileStream,
+            WriteAheadLog,
+        )
+
+        wal = WriteAheadLog(str(tmp_path / "wal"))
+        ssc = StreamingContext(batch_interval_ms=10, clock=ManualClock())
+        fs = TextFileStream(ssc, tmp_path / "in", wal=wal)
+        fs.foreach_batch(lambda _t, b: None)
+        (tmp_path / "in").mkdir()
+        (tmp_path / "in" / "x.txt").write_text("hello\n")
+        ssc.generate_batch(10)
+        assert [b for (_t, b) in wal.replay()] == [["hello"]]
+
+    def test_transient_failures_and_pruning(self, tmp_path, monkeypatch):
+        from asyncframework_tpu.streaming import StreamingContext, TextFileStream
+
+        ssc = StreamingContext(batch_interval_ms=10, clock=ManualClock())
+        fs = TextFileStream(ssc, tmp_path / "gone")
+        got = []
+        fs.foreach_batch(lambda _t, b: got.append(list(b)))
+        ssc.generate_batch(10)  # missing directory: empty, no crash
+        (tmp_path / "gone").mkdir()
+        bad = tmp_path / "gone" / "bad.txt"
+        bad.write_bytes(b"caf\xe9\n")  # not valid utf-8
+        ssc.generate_batch(20)
+        assert got and "caf" in got[0][0]  # replacement, not a dead thread
+        # transient open failure is retried: simulate via a flaky open
+        flaky = tmp_path / "gone" / "flaky.txt"
+        flaky.write_text("later\n")
+        real_open = open
+        calls = {"n": 0}
+
+        def flaky_open(path, *a, **kw):
+            if str(path).endswith("flaky.txt") and calls["n"] == 0:
+                calls["n"] += 1
+                raise PermissionError("transient")
+            return real_open(path, *a, **kw)
+
+        import builtins
+
+        monkeypatch.setattr(builtins, "open", flaky_open)
+        ssc.generate_batch(30)   # open fails once; file NOT marked seen
+        ssc.generate_batch(40)   # retried successfully
+        monkeypatch.undo()
+        assert ["later"] in got
+        # pruning: a deleted name leaves _seen
+        bad.unlink()
+        ssc.generate_batch(50)
+        assert "bad.txt" not in fs._seen
