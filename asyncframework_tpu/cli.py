@@ -245,17 +245,17 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
     # (parallel/ps_dcn.py): process 0 IS the PS (the driver IS the server --
     # now across the process boundary), processes 1..N-1 push tau-stamped
     # gradients over the coordinator address's TCP channel.
-    if os.environ.get("ASYNCTPU_COORDINATOR") and driver == "asgd":
+    if os.environ.get("ASYNCTPU_COORDINATOR") and driver in ("asgd", "asaga"):
         nproc = int(os.environ.get("ASYNCTPU_NUM_PROCESSES", "1"))
         if nproc > 1:
-            return run_asgd_cluster(args, conf)
+            return run_async_cluster(args, conf, algo=driver)
         # a 1-process placement (e.g. a master-scheduled single-executor
         # app) is just a normal single-process run; DCN mode needs peers.
         # ensure_initialized below also no-ops for nproc <= 1.
     if multihost.ensure_initialized() and driver != "sgd-mllib":
         raise SystemExit(
             "multi-process runs support the SPMD sgd-mllib driver (global "
-            "mesh) and the DCN parameter-server asgd driver; for asaga and "
+            "mesh) and the DCN parameter-server asgd/asaga drivers; for "
             "the sync drivers run single-process"
         )
     devices = jax.devices()
@@ -365,13 +365,14 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
     return summary
 
 
-def run_asgd_cluster(args, conf):
-    """Multi-process ASGD over the DCN parameter server.
+def run_async_cluster(args, conf, algo: str = "asgd"):
+    """Multi-process ASGD/ASAGA over the DCN parameter server.
 
     Roles by ``ASYNCTPU_PROCESS_ID``: 0 = PS (binds the coordinator
-    address's port; owns the model + updater semantics), 1..N-1 = worker
-    processes (generate/load their shard slice locally, push gradients).
-    The PS prints the run summary; workers print a small role record.
+    address's port; owns the model + updater semantics -- and for ASAGA the
+    scalar-history table and sampling), 1..N-1 = worker processes
+    (generate/load their shard slice locally, push gradients).  The PS
+    prints the run summary; workers print a small role record.
     """
     import numpy as np
 
@@ -385,7 +386,7 @@ def run_asgd_cluster(args, conf):
     nproc = int(os.environ.get("ASYNCTPU_NUM_PROCESSES", "1"))
     pid = int(os.environ.get("ASYNCTPU_PROCESS_ID", "0"))
     if nproc < 2:
-        raise SystemExit("DCN asgd needs >= 2 processes (PS + workers)")
+        raise SystemExit(f"DCN {algo} needs >= 2 processes (PS + workers)")
 
     cfg = SolverConfig(
         num_workers=args.num_partitions,
@@ -406,13 +407,18 @@ def run_asgd_cluster(args, conf):
     n_workers_procs = nproc - 1
     if n_workers_procs > cfg.num_workers:
         raise SystemExit(
-            f"DCN asgd: {n_workers_procs} worker processes but only "
+            f"DCN {algo}: {n_workers_procs} worker processes but only "
             f"{cfg.num_workers} logical workers; every worker process "
             f"needs at least one partition"
         )
     if pid == 0:
+        ckpt_path = None
+        if args.checkpoint_dir:
+            os.makedirs(args.checkpoint_dir, exist_ok=True)
+            ckpt_path = os.path.join(args.checkpoint_dir, f"ps_{algo}.npz")
         ps = ps_dcn.ParameterServer(
-            cfg, args.d, args.N, host="0.0.0.0", port=int(port_s)
+            cfg, args.d, args.N, host="0.0.0.0", port=int(port_s), algo=algo,
+            checkpoint_path=ckpt_path,
         ).start()
         ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
         total = ps.collect_eval(n_workers_procs, timeout_s=120.0)
@@ -424,11 +430,12 @@ def run_asgd_cluster(args, conf):
             ]
         ps.stop()
         return {
-            "driver": "asgd-dcn-ps",
+            "driver": f"{algo}-dcn-ps",
             "done": bool(ok),
             "accepted": ps.accepted,
             "dropped": ps.dropped,
             "max_staleness": ps.max_staleness,
+            "resumed_from": ps.resumed_from_k,
             "final_objective": trajectory[-1][1] if trajectory else None,
             "trajectory": trajectory,
         }
@@ -437,11 +444,6 @@ def run_asgd_cluster(args, conf):
     if args.devices is not None:
         devices = devices[: args.devices]
     X, _y = load_data(args, cfg, devices, need_host=False)
-    if getattr(X, "is_sparse", False):
-        raise SystemExit(
-            "DCN asgd currently runs dense shards (the worker wire format "
-            "ships dense gradients); drop --sparse or run single-process"
-        )
     wids = [
         w for w in range(cfg.num_workers)
         if w % n_workers_procs == (pid - 1)
@@ -449,10 +451,10 @@ def run_asgd_cluster(args, conf):
     shards = {w: X.shard(w) for w in wids}
     counts = ps_dcn.run_worker_process(
         host, int(port_s), wids, shards, cfg, args.d, args.N,
-        eval_wid=wids[0], deadline_s=cfg.run_timeout_s,
+        eval_wid=wids[0], deadline_s=cfg.run_timeout_s, algo=algo,
     )
     return {
-        "driver": "asgd-dcn-worker",
+        "driver": f"{algo}-dcn-worker",
         "process_id": pid,
         "gradients": int(sum(counts.values())),
         "trajectory": [],

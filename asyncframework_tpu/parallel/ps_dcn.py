@@ -37,11 +37,28 @@ EVAL(W stack) -> LOSSES | DONE.  The PS cannot evaluate the loss trajectory
 itself (it holds no data), so at end-of-run each worker scores the snapshot
 stack against its shards and the PS sums -- the distributed analog of
 ``optVars`` evaluation (``SparkASGDThread.scala:386-401``).
+
+Extensions past the ASGD-dense core:
+
+- **ASAGA** (``algo="asaga"``): the PS owns the per-sample scalar-history
+  table and the sampling (``ScalarMap`` + ``sampledMap``,
+  ``SparkASAGAThread.scala:114,280-294``).  PULL carries the worker's shard
+  size; MODEL ships capacity-padded ``(idx, alpha[idx])`` with the model;
+  PUSH returns the gradient plus candidate scalars, which the PS commits
+  only on accept (the driver-controlled ScalarMap merge) before the
+  three-term update ``w -= gamma*(g/parRecs + alpha_bar)``,
+  ``alpha_bar += g/N`` (``:210-213``).
+- **Sparse gradients** (``enc="sparse"``): rcv1-class pushes ship
+  ``(idx u32, val f32)`` pairs when that beats the dense ``d*4`` bytes; the
+  PS scatters into dense before its (dense) apply.  Workers decide per push
+  -- a near-dense gradient goes dense.
 """
 
 from __future__ import annotations
 
+import io
 import json
+import os
 import socket
 import struct
 import threading
@@ -88,26 +105,58 @@ class ParameterServer:
     """
 
     def __init__(self, cfg, d: int, n: int, device=None, host: str = "0.0.0.0",
-                 port: int = 0):
+                 port: int = 0, algo: str = "asgd",
+                 checkpoint_path: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
         from asyncframework_tpu.ops import steps
 
+        if algo not in ("asgd", "asaga"):
+            raise ValueError(f"unknown PS algo {algo!r}")
         self.cfg = cfg
         self.d, self.n = d, n
+        self.algo = algo
+        self.checkpoint_path = checkpoint_path
+        self.resumed_from_k: Optional[int] = None
         self.device = device if device is not None else jax.devices()[0]
-        self._apply = steps.make_asgd_apply(
-            cfg.gamma, cfg.batch_rate, n, cfg.num_workers
-        )
         self._w = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
         self._k_dev = jax.device_put(jnp.float32(0.0), self.device)
-        # warm the accept path before the clock starts (first-iteration
-        # blocking parity) -- donated dummies, never live state
         zw = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
         zg = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
-        zk = jax.device_put(jnp.float32(0.0), self.device)
-        self._apply(zw, zg, zk)
+        if algo == "asaga":
+            # ScalarMap semantics (SparkASAGAThread.scala:114,280-294): the
+            # PS owns the per-sample history table AND the sampling -- it
+            # draws each worker's Bernoulli(b) rows, ships (idx, alpha[idx])
+            # with the model, and commits returned scalars only on accept.
+            # delta == g is EXACT here (unlike the single-process engine,
+            # which recomputes the delta -- see make_saga_table_delta): a
+            # worker's samples live in its own shard, no other worker can
+            # touch those table entries, and the per-connection pull->push
+            # protocol serializes the worker against its own commits, so the
+            # alpha the gradient was built against IS the alpha at commit.
+            # donate_g=False: the same device buffer is passed as g and delta.
+            self._apply = steps.make_saga_apply(
+                cfg.gamma, cfg.batch_rate, n, cfg.num_workers, donate_g=False
+            )
+            self._ab = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
+            self._table: Dict[int, np.ndarray] = {}   # wid -> shard scalars
+            self._rngs: Dict[int, np.random.Generator] = {}
+            self._pending_idx: Dict[int, np.ndarray] = {}  # outstanding pull
+            # guards table/rng structure + contents against the checkpoint
+            # writer's iteration (lock order: _lock -> _saga_lock); pulls
+            # hold it WITHOUT _lock so sampling never queues the apply path
+            self._saga_lock = threading.Lock()
+            zab = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
+            self._apply(zw, zab, zg, zg)
+        else:
+            self._apply = steps.make_asgd_apply(
+                cfg.gamma, cfg.batch_rate, n, cfg.num_workers
+            )
+            # warm the accept path before the clock starts (first-iteration
+            # blocking parity) -- donated dummies, never live state
+            zk = jax.device_put(jnp.float32(0.0), self.device)
+            self._apply(zw, zg, zk)
 
         self._lock = threading.Lock()
         self._w_host: Optional[np.ndarray] = None  # host cache per version
@@ -115,6 +164,7 @@ class ParameterServer:
         self._k = 0              # accepted updates
         self.accepted = 0
         self.dropped = 0
+        self.push_bytes = 0      # wire payload bytes received via PUSH
         self.max_staleness = 0
         self._snapshots: List[Tuple[float, object]] = []
         self._t0: Optional[float] = None
@@ -129,6 +179,10 @@ class ParameterServer:
         self._waiting: List[int] = []
         self._wave_id = 0
 
+        self._elapsed_offset_ms = 0.0  # wall already spent before a resume
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            self._restore(checkpoint_path)
+
         self._srv = socket.create_server((host, port))
         self._srv.settimeout(0.2)
         self.port = self._srv.getsockname()[1]
@@ -140,14 +194,111 @@ class ParameterServer:
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "ParameterServer":
-        self._t0 = time.monotonic()
+        self._t0 = time.monotonic() - self._elapsed_offset_ms / 1e3
         with self._lock:
-            self._snapshots.append((0.0, np.asarray(self._w)))
+            if self.resumed_from_k is None:
+                self._snapshots.append((0.0, np.asarray(self._w)))
+            if self._k >= self.cfg.num_iterations:
+                self._done.set()  # checkpoint was already past the finish
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ps-accept", daemon=True
         )
         self._accept_thread.start()
         return self
+
+    # ---------------------------------------------------------- checkpointing
+    def _checkpoint_state(self) -> dict:
+        """Snapshot everything a restarted PS needs, caller holds the lock.
+        ``_pending_idx`` is deliberately NOT saved: in-flight pulls die with
+        the process, and a post-restart push referencing one is dropped
+        (stale by construction)."""
+        meta = {
+            "algo": self.algo,
+            "clock": self._clock,
+            "k": self._k,
+            "accepted": self.accepted,
+            "dropped": self.dropped,
+            "push_bytes": self.push_bytes,
+            "max_staleness": self.max_staleness,
+            "cal_ms": self._cal_ms,
+            "cal_n": self._cal_n,
+            "avg_delay_ms": self.avg_delay_ms,
+            "elapsed_ms": self._now_ms() if self._t0 is not None else 0.0,
+            "snap_times": [t for (t, _w) in self._snapshots],
+        }
+        arrays = {"w": np.asarray(self._w, np.float32)}
+        if self._snapshots:
+            arrays["snap_stack"] = np.stack(
+                [np.asarray(w) for (_t, w) in self._snapshots]
+            )
+        if self.algo == "asaga":
+            arrays["ab"] = np.asarray(self._ab, np.float32)
+            with self._saga_lock:  # consistent table + RNG capture
+                for wid, table in self._table.items():
+                    arrays[f"table_{wid}"] = table.copy()
+                meta["rng_states"] = {
+                    str(wid): rng.bit_generator.state
+                    for wid, rng in self._rngs.items()
+                }
+        return {"meta": meta, "arrays": arrays}
+
+    def save_checkpoint(self) -> None:
+        """Atomic on-disk PS checkpoint (Master.scala:41 recovery semantics
+        applied to the run itself, per SURVEY section 7 stage 5: model +
+        history table + RNG + clock).  Serialize under the lock, write
+        outside it."""
+        if not self.checkpoint_path:
+            return
+        with self._lock:
+            state = self._checkpoint_state()
+        buf = io.BytesIO()
+        np.savez(buf, __meta__=json.dumps(state["meta"]), **state["arrays"])
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.checkpoint_path)
+
+    def _restore(self, path: str) -> None:
+        import jax
+
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            if meta["algo"] != self.algo:
+                raise ValueError(
+                    f"checkpoint algo {meta['algo']!r} != PS algo "
+                    f"{self.algo!r}"
+                )
+            self._w = jax.device_put(z["w"], self.device)
+            self._w_host = None
+            self._clock = int(meta["clock"])
+            self._k = int(meta["k"])
+            self.accepted = int(meta["accepted"])
+            self.dropped = int(meta["dropped"])
+            self.push_bytes = int(meta["push_bytes"])
+            self.max_staleness = int(meta["max_staleness"])
+            self._cal_ms = float(meta["cal_ms"])
+            self._cal_n = int(meta["cal_n"])
+            self.avg_delay_ms = float(meta["avg_delay_ms"])
+            self._elapsed_offset_ms = float(meta["elapsed_ms"])
+            if "snap_stack" in z:
+                stack = z["snap_stack"]
+                self._snapshots = [
+                    (t, stack[i].copy())
+                    for i, t in enumerate(meta["snap_times"])
+                ]
+            if self.algo == "asaga":
+                self._ab = jax.device_put(z["ab"], self.device)
+                self._table = {
+                    int(k.split("_", 1)[1]): z[k].copy()
+                    for k in z.files if k.startswith("table_")
+                }
+                for wid_s, state in meta.get("rng_states", {}).items():
+                    rng = np.random.default_rng()
+                    rng.bit_generator.state = state
+                    self._rngs[int(wid_s)] = rng
+        self.resumed_from_k = self._k
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -173,7 +324,7 @@ class ParameterServer:
                 header, payload = _recv_msg(conn)
                 op = header["op"]
                 if op == "PULL":
-                    self._handle_pull(conn, int(header["wid"]))
+                    self._handle_pull(conn, header)
                 elif op == "PUSH":
                     self._handle_push(conn, header, payload)
                 elif op == "SNAPSHOTS":
@@ -202,7 +353,8 @@ class ParameterServer:
         finally:
             conn.close()
 
-    def _handle_pull(self, conn: socket.socket, wid: int) -> None:
+    def _handle_pull(self, conn: socket.socket, header: dict) -> None:
+        wid = int(header["wid"])
         if self._done.is_set():
             _send_msg(conn, {"op": "DONE"})
             return
@@ -241,6 +393,39 @@ class ParameterServer:
         if self._done.is_set():
             _send_msg(conn, {"op": "DONE"})
             return
+        extra_hdr: dict = {}
+        extra_payload = b""
+        if self.algo == "asaga":
+            # PS-side seeded sampling (the reference driver's sampledMap
+            # draw): per-wid RNG chain, Bernoulli(b) over the worker's
+            # shard rows, padded to the static step capacity.  Deliberately
+            # OUTSIDE the global lock: per-wid state (rng/table/pending) is
+            # only ever touched by this wid's connection thread (pull and
+            # push are serialized per connection, and no push can arrive
+            # before this MODEL is sent), and O(n_p) sampling must not
+            # queue other workers' pulls or the push/apply hot path.
+            from asyncframework_tpu.ops.steps import sparse_step_capacity
+
+            n_p = int(header["n_p"])
+            with self._saga_lock:  # vs the checkpoint writer's snapshot
+                table = self._table.get(wid)
+                if table is None or table.shape[0] != n_p:
+                    table = np.zeros(n_p, np.float32)
+                    self._table[wid] = table
+                rng = self._rngs.get(wid)
+                if rng is None:
+                    rng = np.random.default_rng([self.cfg.seed, wid])
+                    self._rngs[wid] = rng
+                cap = sparse_step_capacity(self.cfg.batch_rate, n_p)
+                idx = np.nonzero(rng.random(n_p) < self.cfg.batch_rate)[0]
+                if idx.size > cap:  # ~1e-9/draw: drop the excess (parity
+                    idx = idx[:cap]  # with the device steps' capacity rule)
+                idx_pad = np.zeros(cap, np.uint32)
+                idx_pad[: idx.size] = idx
+                alpha_sel = table[idx_pad].astype(np.float32)
+                self._pending_idx[wid] = idx.astype(np.int64)
+            extra_hdr = {"cap": cap, "n_valid": int(idx.size)}
+            extra_payload = idx_pad.tobytes() + alpha_sel.tobytes()
         with self._lock:
             ts = self._clock
             # one readback per model VERSION, not per pull: a whole cohort
@@ -253,8 +438,10 @@ class ParameterServer:
         _send_msg(
             conn,
             {"op": "MODEL", "ts": ts, "avg_delay_ms": avg,
-             "calibrated": self._cal_n >= self.cfg.effective_calibration_iters()},
-            w_host.astype(np.float32).tobytes(),
+             "calibrated":
+                 self._cal_n >= self.cfg.effective_calibration_iters(),
+             **extra_hdr},
+            w_host.astype(np.float32).tobytes() + extra_payload,
         )
 
     def _handle_push(self, conn: socket.socket, header: dict,
@@ -263,9 +450,26 @@ class ParameterServer:
 
         wid = int(header["wid"])
         ts = int(header["ts"])
-        g_host = np.frombuffer(payload, np.float32)
+        diff = None
+        if header.get("enc") == "sparse":
+            # (idx, val) pair gradient (rcv1-class): scatter into dense on
+            # host -- the PS's apply path is dense either way
+            nnz = int(header["nnz"])
+            idx_g = np.frombuffer(payload[: 4 * nnz], np.uint32)
+            val_g = np.frombuffer(payload[4 * nnz: 8 * nnz], np.float32)
+            g_host = np.zeros(self.d, np.float32)
+            g_host[idx_g] = val_g
+            if self.algo == "asaga":
+                diff = np.frombuffer(payload[8 * nnz:], np.float32)
+        else:
+            raw = np.frombuffer(payload, np.float32)
+            if self.algo == "asaga":
+                g_host, diff = raw[: self.d], raw[self.d:]
+            else:
+                g_host = raw
         do_snapshot = False
         with self._lock:
+            self.push_bytes += len(payload)
             staleness = self._clock - ts
             self.max_staleness = max(self.max_staleness, staleness)
             task_ms = self._now_ms() - self._pull_times.get(wid, self._now_ms())
@@ -274,13 +478,37 @@ class ParameterServer:
                 self._cal_n += 1
                 if self._cal_n >= self.cfg.effective_calibration_iters():
                     self.avg_delay_ms = self._cal_ms / max(self._cal_n, 1)
-            accepted = (
-                staleness <= self.cfg.taw
-                and self._k < self.cfg.num_iterations
-            )
+            if self.algo == "asaga":
+                # ASAGA's filter quirk: accept iff k - staleness <= taw
+                # (SparkASAGAThread.scala:184; the ASGD driver tests
+                # staleness <= taw).  A push whose pull-time sample the PS
+                # no longer holds (restart) cannot commit -- drop it.
+                idx = self._pending_idx.pop(wid, None)
+                accepted = (
+                    self._k - staleness <= self.cfg.taw
+                    and self._k < self.cfg.num_iterations
+                    and idx is not None
+                )
+            else:
+                accepted = (
+                    staleness <= self.cfg.taw
+                    and self._k < self.cfg.num_iterations
+                )
             if accepted:
                 g_dev = jax.device_put(g_host, self.device)
-                self._w, self._k_dev = self._apply(self._w, g_dev, self._k_dev)
+                if self.algo == "asaga":
+                    # three-term update + alpha_bar advance (delta == g is
+                    # exact over DCN; see __init__); then the ScalarMap
+                    # merge -- commit this push's candidate scalars
+                    self._w, self._ab = self._apply(
+                        self._w, self._ab, g_dev, g_dev
+                    )
+                    with self._saga_lock:  # vs checkpoint table copies
+                        self._table[wid][idx] = diff[: idx.size]
+                else:
+                    self._w, self._k_dev = self._apply(
+                        self._w, g_dev, self._k_dev
+                    )
                 self._w_host = None  # new version; next pull re-materializes
                 self._k += 1
                 self.accepted += 1
@@ -299,6 +527,10 @@ class ParameterServer:
             self._wave_cv.notify_all()  # a wave may now meet its threshold
         _send_msg(conn, {"op": "ACK", "accepted": bool(accepted),
                          "done": self._done.is_set()})
+        if do_snapshot:
+            # printer_freq cadence, after the ACK: only THIS worker's next
+            # message waits behind the disk write
+            self.save_checkpoint()
 
     # ------------------------------------------------------------ evaluation
     def wait_done(self, timeout_s: float) -> bool:
@@ -346,6 +578,7 @@ class PSClient:
 
     def __init__(self, host: str, port: int, timeout_s: float = 120.0):
         self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self.bytes_pushed = 0  # payload bytes shipped by push/push_saga
 
     def pull(self, wid: int) -> Optional[Tuple[int, np.ndarray, float, bool]]:
         """Returns (ts, w, avg_delay_ms, calibrated) or None when DONE."""
@@ -357,12 +590,62 @@ class PSClient:
         return (int(header["ts"]), w, float(header["avg_delay_ms"]),
                 bool(header["calibrated"]))
 
-    def push(self, wid: int, ts: int, g: np.ndarray) -> Tuple[bool, bool]:
-        """Returns (accepted, run_done)."""
-        _send_msg(self.sock, {"op": "PUSH", "wid": wid, "ts": ts},
-                  np.asarray(g, np.float32).tobytes())
+    @staticmethod
+    def _sparse_grad_enc(g: np.ndarray) -> Optional[Tuple[int, bytes]]:
+        """(idx u32, val f32) pair encoding when it beats the dense d*4
+        bytes (rcv1-class gradients touch only the sampled rows' columns);
+        None when dense is smaller."""
+        (nz,) = np.nonzero(g)
+        if nz.size * 8 >= g.shape[0] * 4:
+            return None
+        return nz.size, (nz.astype(np.uint32).tobytes()
+                         + g[nz].astype(np.float32).tobytes())
+
+    def push(self, wid: int, ts: int, g: np.ndarray,
+             sparse: bool = False, diff: Optional[np.ndarray] = None
+             ) -> Tuple[bool, bool]:
+        """Returns (accepted, run_done).  ``diff`` (ASAGA candidate history
+        scalars) rides after the gradient when given."""
+        g = np.asarray(g, np.float32)
+        enc = self._sparse_grad_enc(g) if sparse else None
+        if enc is not None:
+            nnz, payload = enc
+            hdr = {"op": "PUSH", "wid": wid, "ts": ts,
+                   "enc": "sparse", "nnz": nnz}
+        else:
+            hdr, payload = {"op": "PUSH", "wid": wid, "ts": ts}, g.tobytes()
+        if diff is not None:
+            payload += np.asarray(diff, np.float32).tobytes()
+        self.bytes_pushed += len(payload)
+        _send_msg(self.sock, hdr, payload)
         header, _ = _recv_msg(self.sock)
         return bool(header.get("accepted")), bool(header.get("done"))
+
+    def pull_saga(self, wid: int, n_p: int) -> Optional[
+        Tuple[int, np.ndarray, np.ndarray, np.ndarray, int, float, bool]
+    ]:
+        """ASAGA pull: the PS samples this worker's rows and ships their
+        current history scalars with the model (the reference's sampledMap).
+        Returns (ts, w, idx, alpha_sel, n_valid, avg_delay_ms, calibrated)
+        or None when DONE."""
+        _send_msg(self.sock, {"op": "PULL", "wid": wid, "n_p": n_p})
+        header, payload = _recv_msg(self.sock)
+        if header["op"] == "DONE":
+            return None
+        cap = int(header["cap"])
+        d4 = len(payload) - 8 * cap
+        w = np.frombuffer(payload[:d4], np.float32)
+        idx = np.frombuffer(payload[d4: d4 + 4 * cap], np.uint32)
+        alpha_sel = np.frombuffer(payload[d4 + 4 * cap:], np.float32)
+        return (int(header["ts"]), w, idx, alpha_sel, int(header["n_valid"]),
+                float(header["avg_delay_ms"]), bool(header["calibrated"]))
+
+    def push_saga(self, wid: int, ts: int, g: np.ndarray, diff: np.ndarray,
+                  sparse: bool = False) -> Tuple[bool, bool]:
+        """ASAGA push: gradient + candidate history scalars for the sampled
+        rows (committed by the PS only on accept).  Returns (accepted, done).
+        """
+        return self.push(wid, ts, g, sparse=sparse, diff=diff)
 
     def snapshots(self) -> Tuple[List[float], np.ndarray]:
         _send_msg(self.sock, {"op": "SNAPSHOTS"})
@@ -394,6 +677,7 @@ def run_worker_process(
     n: int,
     eval_wid: Optional[int] = None,
     deadline_s: float = 600.0,
+    algo: str = "asgd",
 ) -> Dict[int, int]:
     """Worker-process main loop: one thread per owned logical worker, each
     pulling models and pushing gradients until the PS says DONE.
@@ -402,48 +686,142 @@ def run_worker_process(
     Returns per-wid gradient counts.  When ``eval_wid`` is set, after DONE
     this process scores the PS's snapshot stack over ALL its shards and
     pushes one EVAL_RESULT (the distributed optVars evaluation).
+
+    ``algo="asaga"``: the PS samples and ships (idx, alpha) with each model
+    (it owns the history table); the worker runs the history-corrected
+    gradient step and pushes candidate scalars back with the gradient.
     """
     import jax
 
     from asyncframework_tpu.engine.straggler import DelayModel
     from asyncframework_tpu.ops import steps
 
-    step = steps.make_asgd_worker_step(cfg.batch_rate, cfg.loss)
+    sparse = any(hasattr(s, "cols") for s in shards.values())
+    if algo == "asaga":
+        step = (steps.make_saga_dcn_sparse_worker_step(d) if sparse
+                else steps.make_saga_dcn_worker_step())
+    else:
+        step = (steps.make_sparse_asgd_worker_step(cfg.batch_rate, d)
+                if sparse
+                else steps.make_asgd_worker_step(cfg.batch_rate, cfg.loss))
     delay_model = DelayModel(cfg.coeff, cfg.num_workers, cfg.seed)
     counts = {wid: 0 for wid in wids}
     stop = threading.Event()
     calibrated_once = threading.Event()
 
-    def worker_loop(wid: int) -> None:
-        cl = PSClient(host, port)
+    def shard_dev(shard):
+        return (shard.cols if sparse else shard.X).device
+
+    def run_step(shard, w_dev, key):
+        """Dense/sparse ASGD: (g, new_key)."""
+        if sparse:
+            return step(shard.cols, shard.vals, shard.y, w_dev, key)
+        return step(shard.X, shard.y, w_dev, key)
+
+    def run_saga_step(shard, w_dev, idx_dev, alpha_dev, n_valid):
+        """Dense/sparse DCN-ASAGA: (g, diff_sel)."""
+        if sparse:
+            return step(shard.cols, shard.vals, shard.y, w_dev, idx_dev,
+                        alpha_dev, n_valid)
+        return step(shard.X, shard.y, w_dev, idx_dev, alpha_dev, n_valid)
+
+    # warm every owned shard's executable BEFORE the first pull
+    # (first-iteration-blocking parity): without this, compile skew across
+    # worker threads lets fast workers drive the run to done while slow ones
+    # are still in XLA -- their first push then lands post-done and drops
+    import jax.numpy as jnp
+
+    warmed = set()
+    for wid in wids:
         shard = shards[wid]
-        dev = shard.X.device
-        key = jax.device_put(
-            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid), dev
-        )
+        dev = shard_dev(shard)
+        n_p = int(shard.y.shape[0])
+        shape = (shard.cols if sparse else shard.X).shape
+        if (shape, dev) in warmed:
+            continue
+        warmed.add((shape, dev))
+        w0 = jax.device_put(jnp.zeros(d, jnp.float32), dev)
+        if algo == "asaga":
+            cap = steps.sparse_step_capacity(cfg.batch_rate, n_p)
+            g0, _ = run_saga_step(
+                shard, w0,
+                jax.device_put(jnp.zeros(cap, jnp.int32), dev),
+                jax.device_put(jnp.zeros(cap, jnp.float32), dev),
+                np.int32(0),
+            )
+        else:
+            key0 = jax.device_put(jax.random.PRNGKey(0), dev)
+            g0, _ = run_step(shard, w0, key0)
+        g0.block_until_ready()
+
+    def worker_loop(wid: int) -> None:
+        shard = shards[wid]
+        dev = shard_dev(shard)
+        key = None
+        if algo != "asaga":  # ASAGA samples PS-side; workers need no chain
+            key = jax.device_put(
+                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid), dev
+            )
         deadline = time.monotonic() + deadline_s
+        cl: Optional[PSClient] = None
         try:
             while not stop.is_set() and time.monotonic() < deadline:
-                got = cl.pull(wid)
-                if got is None:
-                    break
-                ts, w_host, avg_ms, calibrated = got
-                if calibrated and not calibrated_once.is_set():
-                    delay_model.calibrate(avg_ms)
-                    calibrated_once.set()
-                dly = delay_model.delay_ms(wid) if calibrated else 0.0
-                if dly > 0:
-                    time.sleep(dly / 1e3)
-                w_dev = jax.device_put(w_host, dev)
-                g, new_key = step(shard.X, shard.y, w_dev, key)
-                key = new_key
-                g_host = np.asarray(g)  # the push IS a readback by design
-                counts[wid] += 1
-                _accepted, done = cl.push(wid, ts, g_host)
-                if done:
-                    break
+                try:
+                    if cl is None:
+                        cl = PSClient(host, port)
+                    if algo == "asaga":
+                        got = cl.pull_saga(wid, int(shard.y.shape[0]))
+                    else:
+                        got = cl.pull(wid)
+                    if got is None:
+                        break
+                    if algo == "asaga":
+                        (ts, w_host, idx, alpha_sel, n_valid, avg_ms,
+                         calibrated) = got
+                    else:
+                        ts, w_host, avg_ms, calibrated = got
+                    if calibrated and not calibrated_once.is_set():
+                        delay_model.calibrate(avg_ms)
+                        calibrated_once.set()
+                    dly = delay_model.delay_ms(wid) if calibrated else 0.0
+                    if dly > 0:
+                        time.sleep(dly / 1e3)
+                    w_dev = jax.device_put(w_host, dev)
+                    counts[wid] += 1
+                    if algo == "asaga":
+                        g, diff = run_saga_step(
+                            shard, w_dev,
+                            jax.device_put(idx.astype(np.int32), dev),
+                            jax.device_put(alpha_sel, dev),
+                            np.int32(n_valid),
+                        )
+                        _accepted, done = cl.push_saga(
+                            wid, ts, np.asarray(g), np.asarray(diff),
+                            sparse=sparse,
+                        )
+                    else:
+                        g, new_key = run_step(shard, w_dev, key)
+                        key = new_key
+                        g_host = np.asarray(g)  # the push IS the readback
+                        _accepted, done = cl.push(wid, ts, g_host,
+                                                  sparse=sparse)
+                    if done:
+                        break
+                except (ConnectionError, OSError):
+                    # PS restart (checkpoint/resume) or a transient DCN
+                    # fault: drop the socket, back off, reconnect, re-pull.
+                    # The in-flight result is lost by design -- the restarted
+                    # PS has no pending state for it anyway.
+                    if cl is not None:
+                        try:
+                            cl.sock.close()
+                        except OSError:
+                            pass
+                        cl = None
+                    time.sleep(0.2)
         finally:
-            cl.bye()
+            if cl is not None:
+                cl.bye()
 
     threads = [
         threading.Thread(target=worker_loop, args=(w,), daemon=True)
@@ -476,9 +854,15 @@ def evaluate_snapshots_on_shards(shards: Dict[int, object], times: List[float],
 
     from asyncframework_tpu.ops import steps
 
-    ev = steps.make_trajectory_loss_eval(loss)
+    ev_dense = steps.make_trajectory_loss_eval(loss)
+    ev_sparse = steps.make_sparse_trajectory_loss_eval()
     total = np.zeros(W.shape[0], np.float64)
     for shard in shards.values():
-        Wd = jax.device_put(jnp.asarray(W), shard.X.device)
-        total += np.asarray(ev(shard.X, shard.y, Wd), np.float64)
+        if hasattr(shard, "cols"):
+            Wd = jax.device_put(jnp.asarray(W), shard.cols.device)
+            part = ev_sparse(shard.cols, shard.vals, shard.y, Wd)
+        else:
+            Wd = jax.device_put(jnp.asarray(W), shard.X.device)
+            part = ev_dense(shard.X, shard.y, Wd)
+        total += np.asarray(part, np.float64)
     return total

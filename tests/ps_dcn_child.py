@@ -35,7 +35,8 @@ NUM_ITER = 400
 
 def config() -> SolverConfig:
     return SolverConfig(
-        num_workers=NW, num_iterations=NUM_ITER, gamma=1.2,
+        num_workers=NW, num_iterations=NUM_ITER,
+        gamma=float(os.environ.get("PS_GAMMA", "1.2")),
         taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5, printer_freq=50,
         seed=42, calibration_iters=20, run_timeout_s=120.0,
     )
@@ -49,9 +50,14 @@ def dataset(devices):
 
 def main() -> None:
     role = os.environ["PS_ROLE"]
+    algo = os.environ.get("PS_ALGO", "asgd")
     cfg = config()
     if role == "ps":
-        ps = ps_dcn.ParameterServer(cfg, D, N, port=0).start()
+        ps = ps_dcn.ParameterServer(
+            cfg, D, N, port=int(os.environ.get("PS_BIND_PORT", "0")),
+            algo=algo,
+            checkpoint_path=os.environ.get("PS_CHECKPOINT") or None,
+        ).start()
         print(json.dumps({"port": ps.port}), flush=True)
         ok = ps.wait_done(timeout_s=120.0)
         total = ps.collect_eval(
@@ -65,6 +71,7 @@ def main() -> None:
         print(json.dumps({
             "role": "ps", "done": bool(ok), "accepted": ps.accepted,
             "dropped": ps.dropped, "max_staleness": ps.max_staleness,
+            "resumed_from": ps.resumed_from_k,
             "trajectory": traj,
         }), flush=True)
         ps.stop()
@@ -80,7 +87,7 @@ def main() -> None:
         # per-process vectors -- together they cover the full dataset
         counts = ps_dcn.run_worker_process(
             "127.0.0.1", port, wids, shards, cfg, D, N,
-            eval_wid=wids[0], deadline_s=120.0,
+            eval_wid=wids[0], deadline_s=120.0, algo=algo,
         )
         print(json.dumps({
             "role": "worker", "pid": pid,
