@@ -6,7 +6,9 @@ standalone resource manager.  See ``deploy/master.py`` for the design notes.
 """
 
 from asyncframework_tpu.deploy.client import submit_app, wait_app, MasterClient
+from asyncframework_tpu.deploy.leader import FileLeaderElection
 from asyncframework_tpu.deploy.master import Master
 from asyncframework_tpu.deploy.worker import Worker
 
-__all__ = ["Master", "Worker", "MasterClient", "submit_app", "wait_app"]
+__all__ = ["Master", "Worker", "MasterClient", "submit_app", "wait_app",
+           "FileLeaderElection"]

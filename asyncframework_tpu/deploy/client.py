@@ -15,16 +15,41 @@ from asyncframework_tpu.parallel.ps_dcn import _recv_msg, _send_msg
 
 
 class MasterClient:
-    def __init__(self, host: str, port: int):
-        self.addr = (host, int(port))
+    def __init__(self, host: str, port: int,
+                 standby_masters: Optional[List[str]] = None):
+        self._addrs = [(host, int(port))]
+        for addr in standby_masters or []:
+            h, p = addr.rsplit(":", 1)
+            self._addrs.append((h, int(p)))
+        self._mi = 0
+
+    @property
+    def addr(self):
+        return self._addrs[self._mi]
 
     def _call(self, msg: dict) -> dict:
-        with socket.create_connection(self.addr, timeout=10) as s:
-            _send_msg(s, msg)
-            reply, _ = _recv_msg(s)
-        if reply.get("op") == "ERR":
-            raise RuntimeError(f"master error: {reply.get('msg')}")
-        return reply
+        """RPC to the active master; rotates to a standby on connection
+        failure or a STANDBY reply (reference parity: StandaloneAppClient
+        tries every master URL)."""
+        last_err: Optional[Exception] = None
+        for _ in range(len(self._addrs)):
+            try:
+                with socket.create_connection(self.addr, timeout=10) as s:
+                    _send_msg(s, msg)
+                    reply, _ = _recv_msg(s)
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                self._mi = (self._mi + 1) % len(self._addrs)
+                continue
+            if reply.get("op") == "STANDBY":
+                self._mi = (self._mi + 1) % len(self._addrs)
+                continue
+            if reply.get("op") == "ERR":
+                raise RuntimeError(f"master error: {reply.get('msg')}")
+            return reply
+        raise ConnectionError(
+            f"no active master among {self._addrs}"
+        ) from last_err
 
     def submit(self, argv: List[str], num_processes: int,
                env: Optional[Dict[str, str]] = None) -> str:
@@ -44,20 +69,34 @@ class MasterClient:
         return self._call({"op": "KILL_APP", "app_id": app_id})
 
 
+def _client(master: str) -> MasterClient:
+    """``master`` may be a comma-separated HA list: primary,standby,..."""
+    primary, *standbys = master.split(",")
+    host, port = primary.rsplit(":", 1)
+    return MasterClient(host, int(port), standby_masters=standbys)
+
+
 def submit_app(master: str, argv: List[str], num_processes: int,
                env: Optional[Dict[str, str]] = None) -> str:
-    host, port = master.rsplit(":", 1)
-    return MasterClient(host, int(port)).submit(argv, num_processes, env)
+    return _client(master).submit(argv, num_processes, env)
 
 
 def wait_app(master: str, app_id: str, timeout_s: float = 300.0) -> dict:
-    """Poll until the app reaches a terminal state (FINISHED/FAILED/LOST)."""
-    host, port = master.rsplit(":", 1)
-    cl = MasterClient(host, int(port))
+    """Poll until the app reaches a terminal state (FINISHED/FAILED/LOST).
+
+    Rides through a master failover: during the takeover window every
+    configured master refuses or answers STANDBY for a few hundred ms --
+    the poll keeps retrying until the deadline (the Worker daemon's
+    heartbeat loop does the same)."""
+    cl = _client(master)
     deadline = time.monotonic() + timeout_s
     st = {"state": "UNKNOWN"}  # non-positive timeout: loop never runs
     while time.monotonic() < deadline:
-        st = cl.status(app_id)
+        try:
+            st = cl.status(app_id)
+        except (ConnectionError, OSError):
+            time.sleep(0.25)
+            continue
         if st["state"] in ("FINISHED", "FAILED", "LOST", "KILLED"):
             return st
         time.sleep(0.25)

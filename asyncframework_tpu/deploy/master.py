@@ -53,6 +53,7 @@ class Master:
         port: int = 0,
         persistence_dir: Optional[str] = None,
         worker_timeout_s: float = WORKER_TIMEOUT_S,
+        ha: bool = False,
     ):
         self.host = host
         self._srv = socket.create_server((host, port))
@@ -74,7 +75,23 @@ class Master:
             )
         else:
             self._persist_path = None
-        self._recover()
+        # HA: masters race for the flock lease; only the winner recovers
+        # state and serves -- standbys answer STANDBY until they win
+        # (ZooKeeperLeaderElectionAgent.scala:26 role; see deploy/leader.py)
+        if ha and self._persist_path is None:
+            raise ValueError("ha masters need a persistence_dir (the lease "
+                             "file and shared state live there)")
+        self.election = None
+        if ha:
+            from asyncframework_tpu.deploy.leader import FileLeaderElection
+
+            self.election = FileLeaderElection(
+                os.path.join(persistence_dir, "master.lock")
+            )
+            self.active = False
+        else:
+            self.active = True
+            self._recover()
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Master":
@@ -86,10 +103,28 @@ class Master:
                               daemon=True)
         t2.start()
         self._threads.append(t2)
+        if self.election is not None:
+            t3 = threading.Thread(target=self._election_loop,
+                                  name="master-election", daemon=True)
+            t3.start()
+            self._threads.append(t3)
         return self
+
+    def _election_loop(self) -> None:
+        if not self.election.acquire_blocking(self._stop,
+                                              holder=self.address):
+            return
+        with self._lock:
+            # takeover recovery: worker daemons and their executors are
+            # still alive (only the old MASTER died), so RUNNING apps stay
+            # RUNNING -- the workers' EXECUTOR_EXIT reports will land here
+            self._recover(takeover=True)
+            self.active = True
 
     def stop(self) -> None:
         self._stop.set()
+        if self.election is not None:
+            self.election.release()
         try:
             self._srv.close()
         except OSError:
@@ -115,6 +150,11 @@ class Master:
                     "argv": a["argv"], "env": a["env"],
                     "num_processes": a["num_processes"],
                     "state": a["state"],
+                    # exits persist too: an HA takeover that reset them
+                    # could never complete an app whose executors partly
+                    # exited before the failover (the worker's ACKed report
+                    # is never resent)
+                    "exits": dict(a["exits"]),
                 }
                 for aid, a in self.apps.items()
             },
@@ -125,7 +165,7 @@ class Master:
             json.dump(state, f)
         os.replace(tmp, self._persist_path)
 
-    def _recover(self) -> None:
+    def _recover(self, takeover: bool = False) -> None:
         if self._persist_path is None or not os.path.exists(
             self._persist_path
         ):
@@ -139,12 +179,17 @@ class Master:
                 **w, "last_seen": now - self._worker_timeout_s, "alive": False
             }
         for aid, a in state.get("apps", {}).items():
+            # cold restart: RUNNING apps lost their master mid-flight with
+            # no standby watching -- surface LOST instead of pretending.
+            # HA takeover: the executors belong to live worker daemons that
+            # are about to rotate their heartbeats here, so the app is
+            # still RUNNING and its exits will arrive.
+            st = a["state"]
+            if st in ("RUNNING", "LAUNCHING"):
+                st = "RUNNING" if takeover else "LOST"
             self.apps[aid] = {
-                **a, "assignments": [], "exits": {},
-                # RUNNING apps lost their processes with the old master:
-                # surface that instead of pretending
-                "state": ("LOST" if a["state"] in ("RUNNING", "LAUNCHING")
-                          else a["state"]),
+                **a, "assignments": [],
+                "exits": dict(a.get("exits") or {}), "state": st,
             }
         self._app_seq = int(state.get("app_seq", 0))
 
@@ -191,6 +236,10 @@ class Master:
     # ------------------------------------------------------------- handlers
     def _handle(self, msg: dict) -> dict:
         op = msg.get("op")
+        if not self.active:
+            # standby: refuse everything until the lease is won (reference
+            # parity: standby masters reject RPCs with a not-leader error)
+            return {"op": "STANDBY", "master": self.address}
         if op == "REGISTER_WORKER":
             with self._lock:
                 self.workers[msg["worker_id"]] = {
@@ -223,7 +272,10 @@ class Master:
                         # produce nonzero exits that must not relabel it
                         bad = [rc for rc in app["exits"].values() if rc]
                         app["state"] = "FAILED" if bad else "FINISHED"
-                        self._persist()
+                    # persist EVERY exit, not just the terminal one: the
+                    # worker never resends an ACKed report, so a standby
+                    # recovering mid-app must find partial exits on disk
+                    self._persist()
             return {"op": "ACK"}
         if op == "SUBMIT_APP":
             return self._submit(msg)
@@ -351,9 +403,15 @@ def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=7077)
     p.add_argument("--persistence-dir", default=None)
+    p.add_argument("--ha", action="store_true",
+                   help="race for the persistence-dir lease; serve as "
+                        "standby until won (kill the active master and "
+                        "this one takes over)")
     args = p.parse_args(argv)
-    m = Master(args.host, args.port, args.persistence_dir).start()
-    print(f"master listening on {m.address}", flush=True)
+    m = Master(args.host, args.port, args.persistence_dir,
+               ha=args.ha).start()
+    print(f"master listening on {m.address}"
+          + (" (ha)" if args.ha else ""), flush=True)
     try:
         while True:
             time.sleep(1)

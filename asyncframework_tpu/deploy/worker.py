@@ -32,8 +32,17 @@ class Worker:
         cores: int = 1,
         heartbeat_s: float = 1.0,
         launch_env_extra: Optional[Dict[str, str]] = None,
+        standby_masters: Optional[List[str]] = None,
     ):
-        self.master = (master_host, int(master_port))
+        # HA: the reference's workers take every master URL
+        # (spark://h1:7077,h2:7077) and talk to whichever is leader; here
+        # the list is [primary] + standby_masters and _master_call rotates
+        # on connection failure or a STANDBY reply
+        self._masters = [(master_host, int(master_port))]
+        for addr in standby_masters or []:
+            h, p = addr.rsplit(":", 1)
+            self._masters.append((h, int(p)))
+        self._mi = 0  # index of the master believed active
         self.worker_id = worker_id or f"worker-{os.getpid()}"
         self.cores = cores
         self.heartbeat_s = heartbeat_s
@@ -72,10 +81,22 @@ class Worker:
 
     # ------------------------------------------------------- master contact
     def _master_call(self, msg: dict) -> dict:
-        with socket.create_connection(self.master, timeout=10) as s:
-            _send_msg(s, msg)
-            reply, _ = _recv_msg(s)
-        return reply
+        """One RPC to the active master, rotating through the configured
+        masters on connection failure or a STANDBY reply.  Raises
+        ConnectionError when no configured master is active."""
+        for _ in range(len(self._masters)):
+            addr = self._masters[self._mi]
+            try:
+                with socket.create_connection(addr, timeout=10) as s:
+                    _send_msg(s, msg)
+                    reply, _ = _recv_msg(s)
+                if reply.get("op") != "STANDBY":
+                    return reply
+            except (ConnectionError, OSError):
+                pass
+            self._mi = (self._mi + 1) % len(self._masters)
+        raise ConnectionError("no active master among "
+                              f"{[f'{h}:{p}' for h, p in self._masters]}")
 
     def _register(self) -> None:
         reply = self._master_call({
@@ -146,14 +167,21 @@ class Worker:
                     ps.remove(proc)
                 if not ps:
                     self._procs.pop(order["app_id"], None)
-            try:
-                self._master_call({
-                    "op": "EXECUTOR_EXIT", "worker_id": self.worker_id,
-                    "app_id": order["app_id"], "proc_id": order["proc_id"],
-                    "returncode": proc.returncode,
-                })
-            except (ConnectionError, OSError):
-                pass
+            # the exit report must survive a master failover window: a
+            # standby needs a few hundred ms to win the lease and recover,
+            # and a lost report strands the app in RUNNING forever
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and not self._stop.is_set():
+                try:
+                    self._master_call({
+                        "op": "EXECUTOR_EXIT", "worker_id": self.worker_id,
+                        "app_id": order["app_id"],
+                        "proc_id": order["proc_id"],
+                        "returncode": proc.returncode,
+                    })
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(0.5)
             if proc.returncode and err:
                 sys.stderr.write(
                     f"[{self.worker_id}] app {order['app_id']} proc "
@@ -172,13 +200,15 @@ def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
     import argparse
 
     p = argparse.ArgumentParser("async-worker")
-    p.add_argument("master", help="master address host:port")
+    p.add_argument("master", help="master address(es) host:port[,host:port]"
+                                  " -- first is primary, rest standbys")
     p.add_argument("--cores", type=int, default=1)
     p.add_argument("--worker-id", default=None)
     args = p.parse_args(argv)
-    host, port = args.master.rsplit(":", 1)
+    primary, *standbys = args.master.split(",")
+    host, port = primary.rsplit(":", 1)
     w = Worker(host, int(port), worker_id=args.worker_id,
-               cores=args.cores).start()
+               cores=args.cores, standby_masters=standbys).start()
     print(f"worker {w.worker_id} on {w.host}:{w.port} -> {args.master}",
           flush=True)
     try:
