@@ -19,27 +19,49 @@ import jax.numpy as jnp
 
 
 class Column:
-    """A lazy expression evaluated against a dict of named arrays."""
+    """A lazy expression evaluated against a dict of named arrays.
 
-    def __init__(self, fn: Callable[[Dict[str, Any]], Any], name: str):
+    Optimizer metadata (sql/plan.py reads it, never requires it):
+    ``refs`` -- the frozenset of column names the expression reads (None =
+    unknown, blocks plan rewrites); ``volatile`` -- evaluation has effects
+    or non-determinism (UDFs), blocking both movement and folding.
+    """
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any], name: str,
+                 refs: "frozenset | None" = None, volatile: bool = False):
         self._fn = fn
         self.name = name
+        self.refs = refs
+        self.volatile = volatile
 
     def __call__(self, columns: Dict[str, Any]):
         return self._fn(columns)
 
     def alias(self, name: str) -> "Column":
-        return Column(self._fn, name)
+        out = Column(self._fn, name, refs=self.refs, volatile=self.volatile)
+        out._and_parts = getattr(self, "_and_parts", None)
+        return out
 
     # ------------------------------------------------------------- operators
     def _binop(self, other, op, sym: str, reflect: bool = False) -> "Column":
         other_c = other if isinstance(other, Column) else lit(other)
         a, b = (other_c, self) if reflect else (self, other_c)
+        refs = _union_refs(a, b)
+        volatile = a.volatile or b.volatile
+        label = f"({a.name} {sym} {b.name})"
+        if refs == frozenset() and not volatile:
+            # constant folding (Optimizer.scala:38 ConstantFolding, done at
+            # construction): a ref-free pure tree evaluates once, now
+            try:
+                v = op(a({}), b({}))
+                return Column(lambda cols: v, label, refs=frozenset())
+            except Exception:
+                pass  # fold failed (e.g. div by zero): stay lazy
 
         def fn(cols):
             return op(a(cols), b(cols))
 
-        return Column(fn, f"({a.name} {sym} {b.name})")
+        return Column(fn, label, refs=refs, volatile=volatile)
 
     def __add__(self, o):
         return self._binop(o, operator.add, "+")
@@ -69,7 +91,8 @@ class Column:
         return self._binop(o, operator.mod, "%")
 
     def __neg__(self):
-        return Column(lambda cols: -self(cols), f"(-{self.name})")
+        return Column(lambda cols: -self(cols), f"(-{self.name})",
+                      refs=self.refs, volatile=self.volatile)
 
     # comparisons produce boolean columns
     def __eq__(self, o):  # type: ignore[override]
@@ -92,14 +115,22 @@ class Column:
 
     # boolean logic (use & | ~ like Spark/pandas)
     def __and__(self, o):
-        return self._binop(o, jnp.logical_and, "AND")
+        out = self._binop(o, jnp.logical_and, "AND")
+        # record the conjunction shape for the optimizer's conjunct split
+        # (plan.split_conjuncts) -- but NOT on a folded-to-constant result:
+        # splitting it back into pre-fold sides would undo the fold
+        if out.refs != frozenset() or out.volatile:
+            other_c = o if isinstance(o, Column) else lit(o)
+            out._and_parts = (self, other_c)
+        return out
 
     def __or__(self, o):
         return self._binop(o, jnp.logical_or, "OR")
 
     def __invert__(self):
         return Column(
-            lambda cols: jnp.logical_not(self(cols)), f"(NOT {self.name})"
+            lambda cols: jnp.logical_not(self(cols)), f"(NOT {self.name})",
+            refs=self.refs, volatile=self.volatile,
         )
 
     __hash__ = object.__hash__  # __eq__ is overridden for the DSL
@@ -120,7 +151,8 @@ class Column:
 
             return _np.isin(_np.asarray(v), _np.asarray(vals))
 
-        return Column(fn, f"({self.name} IN ...)")
+        return Column(fn, f"({self.name} IN ...)",
+                      refs=self.refs, volatile=self.volatile)
 
     def between(self, lo, hi) -> "Column":
         """SQL ``BETWEEN lo AND hi`` (inclusive both ends)."""
@@ -149,7 +181,8 @@ class Column:
                 (rx.match(str(x)) is not None for x in v), bool, len(v)
             )
 
-        return Column(fn, f"({self.name} LIKE {pattern!r})")
+        return Column(fn, f"({self.name} LIKE {pattern!r})",
+                      refs=self.refs, volatile=self.volatile)
 
     def cast(self, type_name: str) -> "Column":
         """SQL ``CAST(x AS t)`` for t in int/bigint/float/double/string/
@@ -186,7 +219,8 @@ class Column:
                 return _np.asarray(v).astype(bool)
             raise ValueError(f"unsupported CAST target {type_name!r}")
 
-        return Column(fn, f"CAST({self.name} AS {t})")
+        return Column(fn, f"CAST({self.name} AS {t})",
+                      refs=self.refs, volatile=self.volatile)
 
     def is_null(self) -> "Column":
         """SQL ``IS NULL``: NaN for float columns, never-null otherwise
@@ -205,10 +239,21 @@ class Column:
                 return _np.isnan(arr)
             return _np.zeros(arr.shape, bool)
 
-        return Column(fn, f"({self.name} IS NULL)")
+        return Column(fn, f"({self.name} IS NULL)",
+                      refs=self.refs, volatile=self.volatile)
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Column<{self.name}>"
+
+
+def _union_refs(*cols: Column):
+    """Union of child ref-sets; None (unknown) poisons the union."""
+    out = frozenset()
+    for c in cols:
+        if c.refs is None:
+            return None
+        out |= c.refs
+    return out
 
 
 def col(name: str) -> Column:
@@ -221,12 +266,12 @@ def col(name: str) -> Column:
             )
         return cols[name]
 
-    return Column(fn, name)
+    return Column(fn, name, refs=frozenset({name}))
 
 
 def lit(value) -> Column:
     """A literal broadcast against the frame's rows."""
-    return Column(lambda cols: value, repr(value))
+    return Column(lambda cols: value, repr(value), refs=frozenset())
 
 
 class CaseBuilder:
@@ -275,7 +320,9 @@ class CaseBuilder:
                     out = _np.where(_np.asarray(c), val, out)
             return out
 
-        return Column(fn, "CASE")
+        parts = [default] + [x for cond, v in branches for x in (cond, v)]
+        return Column(fn, "CASE", refs=_union_refs(*parts),
+                      volatile=any(p.volatile for p in parts))
 
     def end(self) -> Column:
         """CASE without ELSE: unmatched rows get NaN (the null story)."""
@@ -388,7 +435,8 @@ def call_function(name: str, args) -> Column:
         return fn([a(cols) for a in args])
 
     label = f"{name.lower()}({', '.join(a.name for a in args)})"
-    return Column(run, label)
+    return Column(run, label, refs=_union_refs(*args),
+                  volatile=any(a.volatile for a in args))
 
 
 def udf_column(fn: Callable, args, name: str) -> Column:
@@ -413,4 +461,7 @@ def udf_column(fn: Callable, args, name: str) -> Column:
             out = out.astype(object)
         return out
 
-    return Column(run, f"{name}(...)")
+    # volatile: arbitrary python may have effects/non-determinism, so the
+    # optimizer must neither move nor fold UDF calls
+    return Column(run, f"{name}(...)", refs=_union_refs(*args),
+                  volatile=True)

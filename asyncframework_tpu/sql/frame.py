@@ -302,8 +302,32 @@ class ColumnarFrame:
             return ColumnarFrame({c: j._cols[c] for c in order})
         if how not in ("inner", "left", "full", "semi", "anti"):
             raise ValueError(
-                "how must be one of inner/left/right/full/semi/anti"
+                "how must be one of inner/left/full/semi/anti (right is "
+                "rewritten above)"
             )
+        if how == "inner" and len(other) >= 4 * len(self) and len(
+            other
+        ) > 1024:
+            # build-side selection (SortShuffleManager/hash-join build-side
+            # role): index the SMALLER side -- sorting the big side costs
+            # R log R, this swap makes it L log L + R log L.  Inner joins
+            # are symmetric; the rename dance preserves the left-keeps-bare
+            # column convention (row order is right-major after the swap --
+            # SQL promises none).
+            collide = [
+                c for c in self.columns if c != on and c in other.columns
+            ]
+            lf = self.rename({c: f"__swap__{c}" for c in collide})
+            j = other.join(lf, on, "inner")
+            j = j.rename(
+                {c: f"{c}_right" for c in collide}
+                | {f"__swap__{c}": c for c in collide}
+            )
+            order = [on] + [c for c in self.columns if c != on] + [
+                c for c in j.columns
+                if c not in self.columns and c != on
+            ]
+            return ColumnarFrame({c: j._cols[c] for c in order})
         lk = np.asarray(self._cols[on])
         rk = np.asarray(other._cols[on])
         if how in ("semi", "anti"):

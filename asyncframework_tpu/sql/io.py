@@ -275,6 +275,61 @@ def read_parquet(
     return _apply_pushdown(cols, want, where, mask=mask)
 
 
+class LazyTable:
+    """A registered-but-unread data source: the optimizer pushes projection
+    and predicates into ``reader(select=, where=)`` so unneeded columns are
+    never parsed and filtered rows never reach the device (the
+    datasource-v2 pushdown role, ``Optimizer.scala:38`` data-source rules).
+    """
+
+    def __init__(self, name: str, reader, schema: Optional[List[str]] = None):
+        self.name = name
+        self.reader = reader
+        self.schema = schema
+
+    def materialize(self) -> ColumnarFrame:
+        """Full read -- the compatibility path for direct ``ctx.table()``
+        callers that expect an eager frame."""
+        return self.reader(select=None, where=None)
+
+
+def lazy_csv(name: str, path: Union[str, Path], **kw) -> LazyTable:
+    with open(path, newline="") as f:
+        first = f.readline().strip()
+    schema = (
+        first.split(kw.get("delimiter", ",")) if kw.get("header", True)
+        else list(kw.get("columns") or [])
+    ) or None
+
+    def reader(select=None, where=None):
+        return read_csv(path, select=select, where=where, **kw)
+
+    return LazyTable(name, reader, schema)
+
+
+def lazy_json(name: str, path: Union[str, Path]) -> LazyTable:
+    # JSON-lines schema is the union of keys -- unknown without a full
+    # scan, so pruning is disabled (predicate pushdown still applies)
+    def reader(select=None, where=None):
+        return read_json(path, select=select, where=where)
+
+    return LazyTable(name, reader, None)
+
+
+def lazy_parquet(name: str, path: Union[str, Path]) -> LazyTable:
+    try:
+        import pyarrow.parquet as pq
+
+        schema = list(pq.read_schema(path).names)
+    except Exception:
+        schema = None
+
+    def reader(select=None, where=None):
+        return read_parquet(path, select=select, where=where)
+
+    return LazyTable(name, reader, schema)
+
+
 def write_csv(frame: ColumnarFrame, path: Union[str, Path]) -> None:
     """Round-trip writer (tests / interchange)."""
     names = frame.columns

@@ -45,6 +45,8 @@ from asyncframework_tpu.sql.expressions import (
     when,
 )
 from asyncframework_tpu.sql.frame import ColumnarFrame
+from asyncframework_tpu.sql.io import LazyTable, lazy_csv, lazy_json, lazy_parquet
+from asyncframework_tpu.sql import plan as _plan
 
 _TOKEN = re.compile(
     r"""\s*(?:
@@ -135,11 +137,17 @@ class _Parser:
             raise ValueError(f"expected identifier, got {t!r}")
         return t
 
-    def _resolve_table(self, name: str) -> ColumnarFrame:
+    def _resolve_table(self, name: str):
+        """Raw registry entry: an eager frame or a LazyTable (kept lazy so
+        the optimizer can push work into its reader)."""
         key = name.lower()
         if key in self.local_tables:  # CTEs shadow registered tables
             return self.local_tables[key]
-        return self.ctx.table(name)
+        if key not in self.ctx._tables:
+            raise KeyError(
+                f"no table {name!r}; registered: {sorted(self.ctx._tables)}"
+            )
+        return self.ctx._tables[key]
 
     # ------------------------------------------------------------ statements
     def statement(self) -> ColumnarFrame:
@@ -206,9 +214,9 @@ class _Parser:
         distinct = self.accept("DISTINCT")
         items = self.select_items()
         self.expect("FROM")
-        frame = self._from_item()
+        node = self._from_item()
 
-        # joins
+        # joins (plan nodes: the optimizer decides where filters execute)
         while True:
             how = "inner"
             if self.peek_upper() in ("INNER", "LEFT", "RIGHT", "FULL",
@@ -236,10 +244,12 @@ class _Parser:
                     raise ValueError(
                         f"equi-join keys must share a name: {k1!r} != {k2!r}"
                     )
-            frame = frame.join(right, on=key, how=how)
+            node = _plan.Join(node, right, on=key, how=how)
 
+        where_pred = None
         if self.accept("WHERE"):
-            frame = frame.filter(self.expr())
+            where_pred = self.expr()
+            node = _plan.Filter(node, where_pred)
 
         group_key = None
         having = None
@@ -265,6 +275,14 @@ class _Parser:
         limit = None
         if consume_order and self.accept("LIMIT"):
             limit = int(self.next())
+
+        # rewrite the FROM/JOIN/WHERE core before executing: predicate
+        # pushdown (through joins, into readers) + projection pruning
+        # (Optimizer.scala:38 role; see sql/plan.py)
+        node = _plan.optimize(
+            node, _required_source_columns(items, group_key, order_by)
+        )
+        frame = _plan.execute(node)
 
         if (
             order_by is not None
@@ -321,8 +339,10 @@ class _Parser:
             frame = _limit(frame, limit)
         return frame
 
-    def _from_item(self) -> ColumnarFrame:
-        """table name | ( query ) [AS alias] -- derived tables supported."""
+    def _from_item(self) -> "_plan.Node":
+        """table name | ( query ) [AS alias] -> a plan Scan node.  Derived
+        tables execute eagerly (their own statement already optimized);
+        registered lazy sources stay lazy so pushdown reaches the reader."""
         if self.peek() == "(":
             self.next()
             f = self._nested_statement()
@@ -335,8 +355,12 @@ class _Parser:
                 and re.fullmatch(r"[A-Za-z_][A-Za-z_0-9]*", self.peek())
             ):
                 self.next()  # bare alias
-            return f
-        return self._resolve_table(self.ident())
+            return _plan.Scan("(subquery)", frame=f)
+        name = self.ident()
+        t = self._resolve_table(name)
+        if isinstance(t, LazyTable):
+            return _plan.Scan(name, reader=t.reader, schema=t.schema)
+        return _plan.Scan(name, frame=t)
 
     def _subquery_values(self) -> np.ndarray:
         """A subquery used as a value source (IN / scalar): must produce
@@ -772,13 +796,26 @@ class SQLContext:
         any expression position as ``name(args...)``."""
         self._udfs[name.lower()] = fn
 
+    def register_csv(self, name: str, path, **kw) -> None:
+        """Register a CSV as a LAZY table: queries push projection and
+        predicates into the reader, so unused columns are never parsed and
+        filtered rows never reach the device."""
+        self._tables[name.lower()] = lazy_csv(name, path, **kw)
+
+    def register_json(self, name: str, path) -> None:
+        self._tables[name.lower()] = lazy_json(name, path)
+
+    def register_parquet(self, name: str, path) -> None:
+        self._tables[name.lower()] = lazy_parquet(name, path)
+
     def table(self, name: str) -> ColumnarFrame:
         key = name.lower()
         if key not in self._tables:
             raise KeyError(
                 f"no table {name!r}; registered: {sorted(self._tables)}"
             )
-        return self._tables[key]
+        t = self._tables[key]
+        return t.materialize() if isinstance(t, LazyTable) else t
 
     # ----------------------------------------------------------------- query
     def sql(self, text: str) -> ColumnarFrame:
@@ -791,6 +828,39 @@ class SQLContext:
 
 def aggs_present(items) -> bool:
     return any(kind == "agg" for kind, _ in items)
+
+
+def _required_source_columns(items, group_key, order_by):
+    """Transitive set of SOURCE columns the select list needs, for the
+    optimizer's pruning pass.  None = unknown (star, COUNT(*), or an
+    expression whose refs can't be inferred) -- pruning disabled."""
+    names = set()
+    for kind, it in items:
+        if kind == "star":
+            return None
+        if kind == "agg":
+            _fn, arg, _out = it
+            if arg is None:
+                return None  # COUNT(*) touches an arbitrary device column
+            if isinstance(arg, str):
+                names.add(arg)
+            elif arg.refs is None:
+                return None
+            else:
+                names |= set(arg.refs)
+        elif kind == "window":
+            _wfn, warg, _off, (pby, oby, _asc), _out = it
+            names |= {c for c in (warg, pby, oby) if c}
+        else:
+            e, _out = it
+            if e.refs is None:
+                return None
+            names |= set(e.refs)
+    if group_key is not None:
+        names.add(group_key)
+    if order_by is not None:
+        names.add(order_by)
+    return names
 
 
 def _agg_spec(frame: ColumnarFrame, aggs):

@@ -1,0 +1,407 @@
+"""Logical plan + optimizer above the eager frame layer.
+
+Parity (studied, not copied): ``sql/catalyst/.../optimizer/Optimizer.scala:38``
+-- the reference's rule-based optimizer over catalyst logical plans, plus the
+planner entry ``AstBuilder.scala``.  The reference needs hundreds of rules
+because its execution is lazy whole-query codegen onto a shuffle engine; the
+TPU build executes eagerly on fused columnar kernels, so the rules that pay
+for themselves here are the DATA-MOVEMENT rules:
+
+- **PushFilterThroughJoin**: a conjunct referencing only one join side
+  filters that side before the join's index build + gathers (safe sides
+  depend on join type; see ``_push_filters``).
+- **PushFilterIntoScan / through Aggregate**: predicates travel into the
+  reader (rows never reach the device) or below a GROUP BY when they only
+  reference the group key.
+- **PruneColumns**: the transitive closure of referenced columns shrinks
+  every scan -- a reader-backed scan never parses unused columns.
+- **Constant folding** happens at expression-construction time
+  (``expressions.Column._binop``: const x const folds to a literal), so by
+  the time a plan exists, ``WHERE x > 1 + 2`` is already ``x > 3``; the
+  plan-level fold handles the degenerate all-constant predicate (drop the
+  Filter / empty relation).
+- **Join build-side selection** is an execution-time rule (``frame.join``
+  sorts the smaller side); the plan records sizes when known.
+
+The plan is deliberately tiny: Scan / Filter / Project / Join / Aggregate
+over a tree, built by the SQL parser's FROM/JOIN/WHERE/GROUP BY core and
+executed straight onto ``ColumnarFrame`` ops after rewriting.  Plan shape is
+a public artifact (``explain()``) so tests assert rewrites structurally.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from asyncframework_tpu.sql.expressions import Column
+from asyncframework_tpu.sql.frame import ColumnarFrame
+
+
+# ------------------------------------------------------------------- nodes
+@dataclass
+class Node:
+    def children(self) -> List["Node"]:
+        return []
+
+    def explain(self, depth: int = 0) -> str:
+        pad = "  " * depth
+        lines = [pad + self._label()]
+        for c in self.children():
+            lines.append(c.explain(depth + 1))
+        return "\n".join(lines)
+
+    def _label(self) -> str:  # pragma: no cover - overridden
+        return type(self).__name__
+
+
+@dataclass
+class Scan(Node):
+    """A named table: either an in-memory frame or a lazy reader-backed
+    source that accepts (select, where) pushdown."""
+
+    name: str
+    frame: Optional[ColumnarFrame] = None
+    reader: Optional[Callable[..., ColumnarFrame]] = None  # (select=, where=)
+    schema: Optional[List[str]] = None  # known columns (for pruning)
+    pushed_where: Optional[Column] = None
+    pushed_select: Optional[List[str]] = None
+
+    def _label(self) -> str:
+        bits = [f"Scan({self.name}"]
+        if self.pushed_select is not None:
+            bits.append(f", select={self.pushed_select}")
+        if self.pushed_where is not None:
+            bits.append(f", where={self.pushed_where.name}")
+        bits.append(")")
+        return "".join(bits)
+
+    def columns(self) -> Optional[List[str]]:
+        if self.pushed_select is not None:
+            return list(self.pushed_select)
+        if self.frame is not None:
+            return list(self.frame.columns)
+        return list(self.schema) if self.schema is not None else None
+
+
+@dataclass
+class Filter(Node):
+    child: Node
+    predicate: Column
+
+    def children(self):
+        return [self.child]
+
+    def _label(self):
+        return f"Filter({self.predicate.name})"
+
+
+@dataclass
+class Project(Node):
+    child: Node
+    cols: List[str]
+
+    def children(self):
+        return [self.child]
+
+    def _label(self):
+        return f"Project({self.cols})"
+
+
+@dataclass
+class Join(Node):
+    left: Node
+    right: Node
+    on: str
+    how: str = "inner"
+
+    def children(self):
+        return [self.left, self.right]
+
+    def _label(self):
+        return f"Join(on={self.on}, how={self.how})"
+
+
+@dataclass
+class Aggregate(Node):
+    child: Node
+    key: str
+    # out name -> (column name, fn); built by the parser's _agg_spec
+    spec: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+    def children(self):
+        return [self.child]
+
+    def _label(self):
+        return f"Aggregate(key={self.key}, aggs={list(self.spec)})"
+
+
+# --------------------------------------------------------------- utilities
+def split_conjuncts(pred: Column) -> List[Column]:
+    """Flatten a top-level AND chain (recorded at construction by
+    ``Column.__and__``) into its conjuncts."""
+    parts = getattr(pred, "_and_parts", None)
+    if not parts:
+        return [pred]
+    out: List[Column] = []
+    for p in parts:
+        out.extend(split_conjuncts(p))
+    return out
+
+
+def and_all(preds: Sequence[Column]) -> Optional[Column]:
+    it = list(preds)
+    if not it:
+        return None
+    acc = it[0]
+    for p in it[1:]:
+        acc = acc & p
+    return acc
+
+
+def node_columns(node: Node) -> Optional[List[str]]:
+    """Output columns of a plan node, None when unknown (opaque source)."""
+    if isinstance(node, Scan):
+        return node.columns()
+    if isinstance(node, Filter):
+        return node_columns(node.child)
+    if isinstance(node, Project):
+        return list(node.cols)
+    if isinstance(node, Aggregate):
+        return [node.key] + list(node.spec)
+    if isinstance(node, Join):
+        lc = node_columns(node.left)
+        rc = node_columns(node.right)
+        if lc is None or rc is None:
+            return None
+        if node.how in ("semi", "anti"):
+            return list(lc)
+        out = list(lc)
+        for c in rc:
+            if c == node.on:
+                continue
+            out.append(c if c not in out else f"{c}_right")
+        return out
+    return None
+
+
+# -------------------------------------------------------------- optimizer
+def optimize(plan: Node, required: Optional[Sequence[str]] = None) -> Node:
+    """Rule pipeline: fold degenerate predicates, push filters down, prune
+    columns.  ``required`` is the set of columns the consumer needs (select
+    items + order keys ...); None = keep everything."""
+    plan = _fold_trivial_filters(plan)
+    plan = _push_filters(plan)
+    plan = _prune_columns(plan, set(required) if required is not None
+                          else None)
+    return plan
+
+
+def _fold_trivial_filters(node: Node) -> Node:
+    """A predicate with no column references is a constant: True drops the
+    Filter, False empties the relation (kept as a Filter on an impossible
+    mask -- the executor handles it; correctness over cleverness)."""
+    if isinstance(node, Filter):
+        child = _fold_trivial_filters(node.child)
+        keep: List[Column] = []
+        for c in split_conjuncts(node.predicate):
+            if not getattr(c, "refs", None) and not getattr(
+                c, "volatile", False
+            ):
+                try:
+                    val = c({})
+                except Exception:  # can't fold: keep it
+                    keep.append(c)
+                    continue
+                if np.ndim(val) == 0 and bool(val):
+                    continue  # tautology: drop
+                keep.append(c)  # contradiction or odd shape: keep for exec
+            else:
+                keep.append(c)
+        pred = and_all(keep)
+        return child if pred is None else Filter(child, pred)
+    for name, child in _child_fields(node):
+        setattr(node, name, _fold_trivial_filters(child))
+    return node
+
+
+def _child_fields(node: Node) -> List[Tuple[str, Node]]:
+    if isinstance(node, (Filter, Project, Aggregate)):
+        return [("child", node.child)]
+    if isinstance(node, Join):
+        return [("left", node.left), ("right", node.right)]
+    return []
+
+
+def _push_filters(node: Node) -> Node:
+    if isinstance(node, Filter):
+        child = _push_filters(node.child)
+        remaining: List[Column] = []
+        for conj in split_conjuncts(node.predicate):
+            child, pushed = _push_one(child, conj)
+            if not pushed:
+                remaining.append(conj)
+        pred = and_all(remaining)
+        node = child if pred is None else Filter(child, pred)
+        return node
+    for name, child in _child_fields(node):
+        setattr(node, name, _push_filters(child))
+    return node
+
+
+def _push_one(node: Node, conj: Column) -> Tuple[Node, bool]:
+    """Try to sink one conjunct into ``node``; returns (new node, pushed?).
+    Volatile predicates (UDFs) and host-evaluated constructs never move --
+    a moved side effect changes observable behavior."""
+    refs = getattr(conj, "refs", None)
+    if refs is None or getattr(conj, "volatile", False):
+        return node, False
+    if isinstance(node, Scan):
+        if node.reader is not None:
+            # into the reader: rows are filtered before device placement
+            node.pushed_where = (
+                conj if node.pushed_where is None
+                else node.pushed_where & conj
+            )
+            return node, True
+        # in-memory frame: a Filter directly above the scan is as far down
+        # as the predicate can travel; still a win when above sat a join
+        return Filter(node, conj), True
+    if isinstance(node, Filter):
+        child, pushed = _push_one(node.child, conj)
+        if pushed:
+            node.child = child
+            return node, True
+        return node, False
+    if isinstance(node, Project):
+        if set(refs) <= set(node.cols):
+            node.child, pushed = _ensure_pushed(node.child, conj)
+            return node, True
+        return node, False
+    if isinstance(node, Aggregate):
+        # only group-key predicates commute with aggregation
+        if set(refs) <= {node.key}:
+            node.child, _ = _ensure_pushed(node.child, conj)
+            return node, True
+        return node, False
+    if isinstance(node, Join):
+        lc, rc = node_columns(node.left), node_columns(node.right)
+        # which sides may receive pushdown without changing semantics:
+        #  inner: both; left/semi/anti: left only; right: right only;
+        #  full: neither (filters see NULL-extended rows)
+        allow_left = node.how in ("inner", "left", "semi", "anti")
+        allow_right = node.how in ("inner", "right")
+        if allow_left and lc is not None and set(refs) <= set(lc):
+            node.left, _ = _ensure_pushed(node.left, conj)
+            return node, True
+        if allow_right and rc is not None and set(refs) <= set(rc):
+            node.right, _ = _ensure_pushed(node.right, conj)
+            return node, True
+        return node, False
+    return node, False
+
+
+def _ensure_pushed(node: Node, conj: Column) -> Tuple[Node, bool]:
+    """Sink ``conj`` into ``node``, wrapping in a Filter when it cannot go
+    deeper (the push must not be lost)."""
+    new, pushed = _push_one(node, conj)
+    if pushed:
+        return new, True
+    return Filter(new, conj), True
+
+
+def _prune_columns(node: Node, required: Optional[set]) -> Node:
+    """Top-down: shrink every scan to the transitive closure of columns the
+    plan above it uses.  ``required=None`` disables pruning (unknown
+    consumer)."""
+    if isinstance(node, Scan):
+        if required is None:
+            return node
+        cols = node.columns()
+        want = [c for c in (cols or [])
+                if c in required] if cols is not None else None
+        if want is not None and not want and cols:
+            # nothing referenced (SELECT 1 FROM t): keep one column so the
+            # source's ROW COUNT survives -- a zero-column read would
+            # collapse the relation
+            want = cols[:1]
+        if node.reader is not None:
+            # predicate columns are discovered by the reader itself
+            # (sql/io.py _needed_for_predicate), so pushed_select only
+            # needs the plan's requirements
+            node.pushed_select = want
+        elif node.frame is not None and want is not None and set(
+            want
+        ) != set(cols):
+            if want:
+                return Project(node, want)
+        return node
+    if isinstance(node, Filter):
+        child_req = None
+        if required is not None:
+            child_req = set(required) | set(
+                getattr(node.predicate, "refs", set()) or set()
+            )
+            # un-inferable refs (None) poison pruning below this node
+            if getattr(node.predicate, "refs", None) is None:
+                child_req = None
+        node.child = _prune_columns(node.child, child_req)
+        return node
+    if isinstance(node, Project):
+        node.child = _prune_columns(
+            node.child,
+            set(node.cols) if required is not None else None,
+        )
+        return node
+    if isinstance(node, Aggregate):
+        child_req = None
+        if required is not None:
+            child_req = {node.key} | {
+                colname for (colname, _fn) in node.spec.values()
+            }
+        node.child = _prune_columns(node.child, child_req)
+        return node
+    if isinstance(node, Join):
+        if required is None:
+            node.left = _prune_columns(node.left, None)
+            node.right = _prune_columns(node.right, None)
+            return node
+        req = set(required) | {node.on}
+        # a suffixed output column c_right requires right-side c -- AND the
+        # left-side c must survive too: the _right suffix only exists while
+        # the names collide, so pruning the left copy would silently rename
+        # the right column to bare c and break the consumer's reference
+        base = {c[: -len("_right")] for c in required if
+                c.endswith("_right")}
+        node.left = _prune_columns(node.left, req | base)
+        node.right = _prune_columns(node.right, req | base)
+        return node
+    return node
+
+
+# --------------------------------------------------------------- execution
+def execute(node: Node) -> ColumnarFrame:
+    if isinstance(node, Scan):
+        if node.reader is not None:
+            return node.reader(
+                select=node.pushed_select, where=node.pushed_where
+            )
+        assert node.frame is not None
+        return node.frame
+    if isinstance(node, Filter):
+        return execute(node.child).filter(node.predicate)
+    if isinstance(node, Project):
+        return execute(node.child).select(*node.cols)
+    if isinstance(node, Aggregate):
+        frame = execute(node.child)
+        gb = frame.groupby(node.key)
+        if not node.spec:
+            return gb.count()
+        return gb.agg(**node.spec)
+    if isinstance(node, Join):
+        return execute(node.left).join(
+            execute(node.right), on=node.on, how=node.how
+        )
+    raise TypeError(f"unknown plan node {type(node).__name__}")
