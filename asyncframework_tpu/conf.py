@@ -181,3 +181,8 @@ HEARTBEAT_TIMEOUT_MS = ConfigEntry(
 MAX_SLOT_FAILURES = ConfigEntry(
     "async.max.slot.failures", 2, int,
     "Repeated executor deaths on a slot before its shard re-homes.")
+SHUFFLE_SPILL_BYTES = ConfigEntry(
+    "async.shuffle.spill.bytes", 256 * 1024 * 1024, int,
+    "Driver-side shuffle routing buffer bound; past it routed entries "
+    "spill to disk runs (0 = unbounded) -- "
+    "SortShuffleManager/UnifiedMemoryManager role.")

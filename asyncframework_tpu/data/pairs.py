@@ -135,13 +135,29 @@ class PairOpsMixin:
         num_partitions: Optional[int] = None,
         partition_func: Callable[[Any, int], int] = hash_partition,
     ):
-        """``partitionBy`` parity: route each pair to its key's partition."""
+        """``partitionBy`` parity: route each pair to its key's partition.
+
+        The ROUTING buffer is memory-bounded (past
+        ``async.shuffle.spill.bytes`` it spills to disk runs,
+        data/spill.py), which halves peak residency during the route: the
+        input lists and the full routed copy never coexist.  The OUTPUT
+        partitions are in-memory payloads -- like every dataset in this
+        architecture -- so partitioning N pairs still ends with N pairs
+        resident; ops that shrink (combine_by_key) or stream per-partition
+        (sort) get the full benefit of the bound."""
+        from asyncframework_tpu.data.spill import (
+            SpillingRouter,
+            configured_spill_bytes,
+        )
+
         p = self._resolve_p(num_partitions)
         per = self._run_sync(lambda wid: (lambda w=wid: self._compute(w)))
-        routed: Dict[int, List[Tuple[Any, Any]]] = {i: [] for i in range(p)}
-        for wid in sorted(per):
-            for kv in per[wid]:
-                routed[partition_func(kv[0], p)].append(kv)
+        with SpillingRouter(p, configured_spill_bytes(),
+                            label="partition_by") as router:
+            for wid in sorted(per):
+                for kv in per[wid]:
+                    router.add(partition_func(kv[0], p), kv)
+            routed = {i: router.partition_list(i) for i in range(p)}
         return type(self).from_partitions(self.scheduler, routed)
 
     def combine_by_key(
@@ -170,22 +186,35 @@ class PairOpsMixin:
 
             return run
 
+        from asyncframework_tpu.data.spill import (
+            SpillingRouter,
+            configured_spill_bytes,
+        )
+
         combined = self._run_sync(local_combine)
-        routed: Dict[int, List[Tuple[Any, Any]]] = {i: [] for i in range(p)}
+        router = SpillingRouter(p, configured_spill_bytes(),
+                                label="combine_by_key")
         for wid in sorted(combined):
             for k, c in combined[wid]:
-                routed[hash_partition(k, p)].append((k, c))
+                router.add(hash_partition(k, p), (k, c))
 
         def reduce_side(pid: int):
-            def run(entries=routed[pid]):
+            def run(r=router, i=pid):
+                # reduce-side merge streams this partition's entries out of
+                # the spill runs + memory tail -- never the whole shuffle
                 acc: Dict[Any, Any] = {}
-                for k, c in entries:
+                for k, c in r.partition(i):
                     acc[k] = merge_combiners(acc[k], c) if k in acc else c
                 return list(acc.items())
 
             return run
 
-        merged = self._run_job_dict({pid: reduce_side(pid) for pid in range(p)})
+        try:
+            merged = self._run_job_dict(
+                {pid: reduce_side(pid) for pid in range(p)}
+            )
+        finally:
+            router.close()
         return type(self).from_partitions(
             self.scheduler, {pid: merged[pid] for pid in range(p)}
         )
@@ -364,21 +393,31 @@ class PairOpsMixin:
             t = bisect.bisect_right(bounds, k)
             return t if ascending else p - 1 - t
 
-        routed: Dict[int, List[Tuple[Any, Any]]] = {i: [] for i in range(p)}
+        from asyncframework_tpu.data.spill import (
+            SpillingRouter,
+            configured_spill_bytes,
+        )
+
+        router = SpillingRouter(p, configured_spill_bytes(),
+                                label="sort_by_key")
         for kv in all_pairs:
-            routed[target(kv[0])].append(kv)
+            router.add(target(kv[0]), kv)
 
         def sort_partition(pid: int):
-            def run(entries=routed[pid]):
+            def run(r=router, i=pid):
                 return sorted(
-                    entries, key=lambda kv: kv[0], reverse=not ascending
+                    r.partition(i), key=lambda kv: kv[0],
+                    reverse=not ascending
                 )
 
             return run
 
-        merged = self._run_job_dict(
-            {pid: sort_partition(pid) for pid in range(p)}
-        )
+        try:
+            merged = self._run_job_dict(
+                {pid: sort_partition(pid) for pid in range(p)}
+            )
+        finally:
+            router.close()
         return type(self).from_partitions(
             self.scheduler, {pid: merged[pid] for pid in range(p)}
         )

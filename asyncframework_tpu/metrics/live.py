@@ -44,6 +44,12 @@ _ACTIVE: List["LiveUIServer"] = []
 _ACTIVE_LOCK = threading.Lock()
 
 
+def _shuffle_totals() -> Dict[str, int]:
+    from asyncframework_tpu.data.spill import shuffle_totals
+
+    return shuffle_totals()
+
+
 def active_servers() -> List["LiveUIServer"]:
     with _ACTIVE_LOCK:
         return list(_ACTIVE)
@@ -147,6 +153,9 @@ class LiveStateListener(Listener):
                 "speculative_launches": self.speculative_launches,
                 "last_objective": self.last_objective,
                 "workers": {str(k): dict(v) for k, v in self.workers.items()},
+                # driver-side shuffle accounting (SortShuffleManager /
+                # UnifiedMemoryManager observability role)
+                "shuffle": _shuffle_totals(),
             }
 
 

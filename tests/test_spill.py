@@ -1,0 +1,159 @@
+"""Shuffle spill + size accounting (VERDICT r3 item 7).
+
+Parity targets: ``SortShuffleManager.scala:69`` (disk runs past the memory
+grant), ``UnifiedMemoryManager.scala:47`` (byte accounting).  Done-criterion:
+a shuffle larger than the configured bound completes WITH spill files and
+byte-identical results.
+"""
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.conf import AsyncConf, set_global_conf
+from asyncframework_tpu.data.spill import (
+    SpillingRouter,
+    _reset_totals,
+    shuffle_totals,
+)
+
+
+@pytest.fixture()
+def bounded_conf():
+    # ~64 KB bound: a few thousand routed pairs force multiple spills
+    set_global_conf(AsyncConf({"async.shuffle.spill.bytes": 64 * 1024}))
+    yield
+    set_global_conf(None)
+
+
+class TestSpillingRouter:
+    def test_no_spill_under_bound(self):
+        r = SpillingRouter(4, memory_bytes=1 << 30)
+        for i in range(100):
+            r.add(i % 4, (i, i * 2))
+        assert r.spill_count == 0
+        assert [kv for kv in r.partition(1)] == [
+            (i, i * 2) for i in range(100) if i % 4 == 1
+        ]
+        r.close()
+
+    def test_spills_past_bound_and_preserves_order(self):
+        r = SpillingRouter(4, memory_bytes=16 * 1024)
+        n = 5000
+        for i in range(n):
+            r.add(i % 4, (i, float(i)))
+        assert r.spill_count >= 2, "bound never triggered a spill"
+        assert r.bytes_spilled > 0
+        for pid in range(4):
+            got = r.partition_list(pid)
+            assert got == [(i, float(i)) for i in range(n) if i % 4 == pid]
+        r.close()
+
+    def test_unbounded_zero_disables(self):
+        r = SpillingRouter(2, memory_bytes=0)
+        for i in range(10_000):
+            r.add(i % 2, (i, i))
+        assert r.spill_count == 0
+        r.close()
+
+    def test_totals_accumulate(self):
+        _reset_totals()
+        r = SpillingRouter(2, memory_bytes=8 * 1024)
+        for i in range(3000):
+            r.add(i % 2, ("k%d" % i, i))
+        r.partition_list(0)
+        r.close()
+        t = shuffle_totals()
+        assert t["shuffles"] >= 1
+        assert t["records_routed"] == 3000
+        assert t["spill_count"] == r.spill_count > 0
+        assert t["bytes_spilled"] == r.bytes_spilled > 0
+        assert t["bytes_in_memory_peak"] > 0
+
+    def test_spill_files_removed_on_close(self, tmp_path):
+        r = SpillingRouter(2, memory_bytes=4 * 1024)
+        for i in range(2000):
+            r.add(i % 2, (i, i))
+        assert r.spill_count > 0
+        tmp = r._tmp.name
+        import os
+
+        assert os.path.isdir(tmp)
+        r.close()
+        assert not os.path.isdir(tmp)
+
+
+class TestShuffleOpsSpill:
+    """The real pair ops produce identical results with a tiny bound."""
+
+    def _dataset(self, sched, n=4000):
+        from asyncframework_tpu.data.dataset import DistributedDataset
+
+        rs = np.random.default_rng(0)
+        keys = rs.integers(0, 50, n)
+        return DistributedDataset.from_list(
+            sched, [(int(k), 1) for k in keys], num_partitions=8
+        ), keys
+
+    def test_reduce_by_key_spilled_matches_unspilled(self, bounded_conf):
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        sched = JobScheduler(num_workers=8)
+        try:
+            ds, keys = self._dataset(sched)
+            out = dict(ds.reduce_by_key(lambda a, b: a + b).collect())
+            t = shuffle_totals()
+            expect = {int(k): int(c) for k, c in zip(
+                *np.unique(keys, return_counts=True)
+            )}
+            assert out == expect
+            # with map-side combine the routed entries are small; the word
+            # count below proves the spill actually fires on real ops
+        finally:
+            sched.shutdown()
+
+    def test_word_count_with_spills_correct(self, bounded_conf):
+        """group_by_key (no map-side shrink per partition beyond combine)
+        over enough pairs to overflow a 64 KB bound: spills happen AND the
+        result matches the unbounded run."""
+        from asyncframework_tpu.data.dataset import DistributedDataset
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        _reset_totals()
+        sched = JobScheduler(num_workers=8)
+        try:
+            rs = np.random.default_rng(1)
+            pairs = [(f"w{int(k):03d}", 1) for k in rs.integers(0, 200, 20_000)]
+            ds = DistributedDataset.from_list(sched, pairs, num_partitions=8)
+            routed = ds.partition_by(8)
+            out = dict(
+                routed.reduce_by_key(lambda a, b: a + b).collect()
+            )
+            t = shuffle_totals()
+            assert t["spill_count"] > 0, "bound never forced a spill"
+            assert t["bytes_spilled"] > 0
+            from collections import Counter
+
+            expect = Counter(k for k, _ in pairs)
+            assert out == dict(expect)
+        finally:
+            sched.shutdown()
+
+    def test_sort_by_key_spilled_global_order(self, bounded_conf):
+        from asyncframework_tpu.data.dataset import DistributedDataset
+        from asyncframework_tpu.engine.scheduler import JobScheduler
+
+        _reset_totals()
+        sched = JobScheduler(num_workers=8)
+        try:
+            rs = np.random.default_rng(2)
+            vals = rs.permutation(10_000)
+            ds = DistributedDataset.from_list(
+                sched, [(int(v), int(v) * 3) for v in vals],
+                num_partitions=8,
+            )
+            srt = ds.sort_by_key(num_partitions=8)
+            got = srt.collect()
+            assert [k for k, _ in got] == sorted(int(v) for v in vals)
+            assert shuffle_totals()["spill_count"] > 0
+        finally:
+            sched.shutdown()
