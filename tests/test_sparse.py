@@ -263,8 +263,9 @@ class TestSkewGuard:
         from asyncframework_tpu.data.sparse import SparseShardedDataset, densify
 
         indptr, indices, values, y = _skewed_csr(dense_every=10)
-        plain = SparseShardedDataset(indptr, indices, values, y, 1000, 8,
-                                     devices=devices8)
+        with pytest.warns(RuntimeWarning, match="nnz_partition"):
+            plain = SparseShardedDataset(indptr, indices, values, y, 1000, 8,
+                                         devices=devices8)
         with warnings.catch_warnings(record=True) as rec:
             warnings.simplefilter("always")
             sorted_ds = SparseShardedDataset(
