@@ -5,10 +5,11 @@ from asyncframework_tpu.streaming.receiver import (
     SocketTextStream,
     TextFileStream,
 )
+from asyncframework_tpu.streaming.log import DirectLogStream, LogTopic
 from asyncframework_tpu.streaming.wal import WriteAheadLog
 
 __all__ = [
     "DStream", "StreamingContext", "ReceiverStream", "SocketTextStream",
     "TextFileStream",
-    "WriteAheadLog",
+    "WriteAheadLog", "LogTopic", "DirectLogStream",
 ]

@@ -1,0 +1,152 @@
+"""File-backed replayable log (VERDICT r3 missing-item 6).
+
+Parity target: the reference's direct Kafka stream
+(DirectKafkaInputDStream.scala) -- offset-tracked ranged reads from a
+durable log, commits after outputs, replay from the last commit on
+failure.  The capability (exactly-once-ish ingest) without the Kafka
+dependency.
+"""
+
+import json
+import os
+
+import pytest
+
+from asyncframework_tpu.streaming import (
+    DirectLogStream,
+    LogTopic,
+    StreamingContext,
+)
+from asyncframework_tpu.utils.clock import ManualClock
+
+
+class TestLogTopic:
+    def test_append_read_roundtrip(self, tmp_path):
+        t = LogTopic(str(tmp_path / "t"))
+        offs = [t.append({"i": i}) for i in range(10)]
+        assert offs == list(range(10))
+        vals, nxt = t.read(0)
+        assert vals == [{"i": i} for i in range(10)]
+        assert nxt == 10
+        vals, nxt = t.read(7, max_records=2)
+        assert vals == [{"i": 7}, {"i": 8}] and nxt == 9
+
+    def test_segment_rollover_and_reopen(self, tmp_path):
+        path = str(tmp_path / "t")
+        t = LogTopic(path, segment_bytes=256)  # tiny: force many segments
+        t.append_many([f"v{i:04d}" for i in range(200)])
+        assert len([f for f in os.listdir(path) if f.endswith(".log")]) > 1
+        # a fresh instance (restart) rebuilds offsets by scanning segments
+        t2 = LogTopic(path, segment_bytes=256)
+        assert t2.end_offset() == 200
+        vals, nxt = t2.read(150)
+        assert vals == [f"v{i:04d}" for i in range(150, 200)]
+        # appends continue with contiguous offsets across the reopen
+        first, end = t2.append_many(["tail"])
+        assert (first, end) == (200, 201)
+
+    def test_read_past_end_empty(self, tmp_path):
+        t = LogTopic(str(tmp_path / "t"))
+        t.append(1)
+        vals, nxt = t.read(5)
+        assert vals == [] and nxt == 5
+
+    def test_live_tail_across_instances(self, tmp_path):
+        """A consumer instance must see records appended by a DIFFERENT
+        producer instance after the consumer was constructed -- the live
+        tail a direct stream exists for."""
+        path = str(tmp_path / "t")
+        consumer = LogTopic(path)
+        assert consumer.read(0) == ([], 0)
+        producer = LogTopic(path)
+        producer.append_many(["a", "b"])
+        vals, nxt = consumer.read(0)
+        assert vals == ["a", "b"] and nxt == 2
+        # and across a segment roll by the other instance
+        producer2 = LogTopic(path, segment_bytes=64)
+        producer2.append_many([f"x{i}" for i in range(30)])
+        vals, nxt = consumer.read(nxt)
+        assert vals == [f"x{i}" for i in range(30)] and nxt == 32
+        assert consumer.end_offset() == 32
+
+    def test_consumer_groups_independent(self, tmp_path):
+        t = LogTopic(str(tmp_path / "t"))
+        t.commit_offset("a", 7)
+        assert t.committed_offset("a") == 7
+        assert t.committed_offset("b") == 0
+
+
+class TestDirectLogStream:
+    def _ssc(self):
+        return StreamingContext(batch_interval_ms=100, clock=ManualClock())
+
+    def test_batches_commit_and_resume(self, tmp_path):
+        path = str(tmp_path / "t")
+        topic = LogTopic(path)
+        topic.append_many(list(range(25)))
+        seen = []
+        ssc = self._ssc()
+        ds = DirectLogStream(ssc, topic, group="g", max_per_batch=10)
+        ds.foreach_batch(lambda t, b: seen.append(list(b)))
+        for i in range(1, 4):
+            ssc.generate_batch(i * 100)
+        assert seen == [list(range(10)), list(range(10, 20)),
+                        list(range(20, 25))]
+        assert topic.committed_offset("g") == 25
+
+        # restart: a new context + stream on the same group resumes past
+        # everything committed
+        topic.append_many([100, 101])
+        seen2 = []
+        ssc2 = self._ssc()
+        ds2 = DirectLogStream(ssc2, LogTopic(path), group="g")
+        ds2.foreach_batch(lambda t, b: seen2.append(list(b)))
+        ssc2.generate_batch(100)
+        assert seen2 == [[100, 101]]
+
+    def test_failed_output_replays_interval(self, tmp_path):
+        """The exactly-once-ish contract: an interval whose output raises
+        commits nothing, so the same records re-emit after restart."""
+        path = str(tmp_path / "t")
+        LogTopic(path).append_many(["a", "b", "c"])
+        ssc = self._ssc()
+        ds = DirectLogStream(ssc, path, group="g")
+        boom = {"n": 0}
+
+        def failing(_t, _b):
+            boom["n"] += 1
+            raise RuntimeError("output failed")
+
+        ds.foreach_batch(failing)
+        with pytest.raises(RuntimeError):
+            ssc.generate_batch(100)
+        assert boom["n"] == 1
+        assert LogTopic(path).committed_offset("g") == 0  # no commit
+
+        seen = []
+        ssc2 = self._ssc()
+        ds2 = DirectLogStream(ssc2, path, group="g")
+        ds2.foreach_batch(lambda t, b: seen.append(list(b)))
+        ssc2.generate_batch(100)
+        assert seen == [["a", "b", "c"]]  # replayed in full
+        assert LogTopic(path).committed_offset("g") == 3
+
+    def test_empty_interval_emits_nothing(self, tmp_path):
+        ssc = self._ssc()
+        ds = DirectLogStream(ssc, str(tmp_path / "t"), group="g")
+        seen = []
+        ds.foreach_batch(lambda t, b: seen.append(b))
+        assert ssc.generate_batch(100) == 0
+        assert seen == []
+
+    def test_transform_chain(self, tmp_path):
+        """The log source composes with the DStream graph like any input."""
+        path = str(tmp_path / "t")
+        LogTopic(path).append_many([1, 2, 3, 4, 5])
+        ssc = self._ssc()
+        out = []
+        (DirectLogStream(ssc, path)
+            .map_batch(lambda b: [x * 10 for x in b])
+            .foreach_batch(lambda t, b: out.append(b)))
+        ssc.generate_batch(100)
+        assert out == [[10, 20, 30, 40, 50]]
