@@ -249,6 +249,23 @@ def sparse_step_capacity(batch_rate: float, n_rows: int) -> int:
     return min(cap, n_rows)
 
 
+def _sparse_compacted_gradient(cols, vals, y, w, sub, batch_rate, grad_sum):
+    """Shared core of the compacted sparse least-squares step: Bernoulli(b)
+    sample packed to static capacity, only those rows gathered/scattered.
+    ONE definition, used by the engine worker step AND the fused rounds --
+    the fused path's sampling-parity claim depends on these staying
+    bit-identical."""
+    n_rows = y.shape[0]  # static at trace time
+    cap = sparse_step_capacity(batch_rate, n_rows)
+    mask = jax.random.bernoulli(sub, batch_rate, (n_rows,))
+    (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
+    valid = (jnp.arange(cap) < jnp.sum(mask)).astype(vals.dtype)
+    c_sel = cols[idx]
+    v_sel = vals[idx] * valid[:, None]  # unfilled slots contribute 0
+    r = jnp.sum(v_sel * w[c_sel], axis=1) - y[idx] * valid
+    return grad_sum(c_sel, v_sel, r)
+
+
 def make_sparse_asgd_worker_step(batch_rate: float, d: int):
     """jit (cols, vals, y, w, key) -> (g_sum (d,), new_key).
 
@@ -270,16 +287,11 @@ def make_sparse_asgd_worker_step(batch_rate: float, d: int):
 
     @jax.jit
     def step(cols, vals, y, w, key):
-        n_rows = y.shape[0]  # static at trace time
-        cap = sparse_step_capacity(batch_rate, n_rows)
         key, sub = jax.random.split(key)
-        mask = jax.random.bernoulli(sub, batch_rate, (n_rows,))
-        (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
-        valid = (jnp.arange(cap) < jnp.sum(mask)).astype(vals.dtype)
-        c_sel = cols[idx]
-        v_sel = vals[idx] * valid[:, None]  # unfilled slots contribute 0
-        r = jnp.sum(v_sel * w[c_sel], axis=1) - y[idx] * valid
-        return grad_sum(c_sel, v_sel, r), key
+        g = _sparse_compacted_gradient(
+            cols, vals, y, w, sub, batch_rate, grad_sum
+        )
+        return g, key
 
     return step
 
@@ -383,6 +395,7 @@ def make_fused_asgd_rounds(
     shards,
     loss: str = "least_squares",
     rounds_per_call: int = 16,
+    sparse_d: "int | None" = None,
 ):
     """jit (w, k, keys (nw,2)) -> (w', k', keys', W_snap (R, d)) -- R full
     cohort rounds with ZERO host involvement (the device-resident accept
@@ -400,7 +413,8 @@ def make_fused_asgd_rounds(
     recipe-matched fast path for the reference's own headline runs, which
     all use ``taw = inf`` (``README.md:64``).
 
-    ``shards``: list of (X, y) device arrays, all resident on the SAME
+    ``shards``: list of (X, y) dense -- or, with ``sparse_d`` set, of
+    (cols, vals, y) padded-ELL -- device arrays, all resident on the SAME
     device (the PS chip); per-worker PRNG chains ride in ``keys`` exactly
     as the engine keeps them, so sampling parity per worker is preserved.
     """
@@ -412,10 +426,28 @@ def make_fused_asgd_rounds(
         raise ValueError(f"unknown loss {loss!r}")
     nw = len(shards)
     par_recs = batch_rate * n / nw
+    sp_grad_sum = None
+    if sparse_d is not None:
+        if loss != "least_squares":
+            raise ValueError(
+                "sparse fused rounds support least_squares only (the "
+                "compacted residual is least-squares); got " + loss
+            )
+        from asyncframework_tpu.ops.gradients import make_sparse_grad_sum
 
-    def one_gradient(X, y, w, key):
-        n_rows = X.shape[0]
+        sp_grad_sum = make_sparse_grad_sum(sparse_d)
+
+    def one_gradient(shard, w, key):
         key, sub = jax.random.split(key)
+        if sparse_d is not None:
+            # the SAME compacted core the engine worker step runs
+            cols, vals, y = shard
+            g = _sparse_compacted_gradient(
+                cols, vals, y, w, sub, batch_rate, sp_grad_sum
+            )
+            return g, key
+        X, y = shard
+        n_rows = X.shape[0]
         if batch_rate > 0.5:
             mask = jax.random.bernoulli(
                 sub, batch_rate, (n_rows,)
@@ -431,8 +463,8 @@ def make_fused_asgd_rounds(
         w, k, keys = carry
         gs = []
         new_keys = []
-        for i, (X, y) in enumerate(shards):  # static unroll over workers
-            g, nk = one_gradient(X, y, w, keys[i])
+        for i, shard in enumerate(shards):  # static unroll over workers
+            g, nk = one_gradient(shard, w, keys[i])
             gs.append(g)
             new_keys.append(nk)
         G = jnp.stack(gs)

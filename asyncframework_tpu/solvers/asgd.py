@@ -446,8 +446,9 @@ class ASGD(FlopsAccountingMixin):
         Scope guard: this is the fast path for exactly the reference's
         headline recipes (``taw = inf``, no straggler injection); anything
         needing the runtime -- finite taw, speculation, fault tolerance,
-        dynamic allocation -- runs the engine path.  See
-        ``steps.make_fused_asgd_rounds`` for the semantics argument.
+        dynamic allocation -- runs the engine path.  Dense and padded-ELL
+        sparse shards both fuse.  See ``steps.make_fused_asgd_rounds`` for
+        the semantics argument.
         """
         cfg = self.cfg
         nw = cfg.num_workers
@@ -461,31 +462,32 @@ class ASGD(FlopsAccountingMixin):
                 "run_fused cannot inject stragglers (no host between "
                 "updates); use run()"
             )
-        if self._sparse:
-            raise ValueError("run_fused currently covers dense shards")
         d = self.ds.d
         drv = self.driver_device
         shards = []
         for wid in range(nw):
             shard = self._recovery.shard(wid)
-            X, y = shard.X, shard.y
-            if X.device != drv:  # all shards ride the PS device
-                X = jax.device_put(X, drv)
-                y = jax.device_put(y, drv)
-            shards.append((X, y))
+            if self._sparse:
+                parts = (shard.cols, shard.vals, shard.y)
+            else:
+                parts = (shard.X, shard.y)
+            if parts[0].device != drv:  # all shards ride the PS device
+                parts = tuple(jax.device_put(a, drv) for a in parts)
+            shards.append(parts)
+        sparse_d = d if self._sparse else None
         total_rounds = max(1, -(-cfg.num_iterations // nw))
         chunk = min(16, total_rounds)
         full, rem = divmod(total_rounds, chunk)
         run_rounds = steps.make_fused_asgd_rounds(
             cfg.gamma, cfg.batch_rate, self.ds.n, shards,
-            loss=cfg.loss, rounds_per_call=chunk,
+            loss=cfg.loss, rounds_per_call=chunk, sparse_d=sparse_d,
         )
         # exact round budget: the tail that doesn't fill a chunk runs its
         # own scan length (at most 2 compiled executables total)
         run_tail = (
             steps.make_fused_asgd_rounds(
                 cfg.gamma, cfg.batch_rate, self.ds.n, shards,
-                loss=cfg.loss, rounds_per_call=rem,
+                loss=cfg.loss, rounds_per_call=rem, sparse_d=sparse_d,
             ) if rem else None
         )
         w = jax.device_put(jnp.zeros(d, jnp.float32), drv)

@@ -330,7 +330,7 @@ def run_child(config_name: str) -> None:
     # lax.scan on the PS chip).  Recorded ALONGSIDE the engine number, both
     # labeled -- the engine path stays the metric of record.
     fused = None
-    if not cfg["sparse"] and os.environ.get("BENCH_FUSED", "1") != "0":
+    if os.environ.get("BENCH_FUSED", "1") != "0":
         try:
             fres = ASGD(ds, None, scfg, devices=devices).run_fused()
             f_initial = fres.trajectory[0][1]

@@ -63,6 +63,35 @@ class TestFusedASGD:
             ASGD(planted, None, make_cfg(coeff=1.0),
                  devices=[devices8[0]]).run_fused()
 
+    def test_sparse_fused_matches_engine_band(self, devices8):
+        """rcv1-class shards fuse too -- the dataset whose per-update host
+        floor made its baseline unreachable through the engine loop.  Same
+        engine-band parity contract as the dense test: a drifted validity
+        mask or scaling in the fused sparse step would converge somewhere
+        else."""
+        from asyncframework_tpu.data.sparse import SparseShardedDataset
+
+        ds = SparseShardedDataset.generate_on_device(
+            4096, 512, 12, 8, devices=[devices8[0]] * 8, seed=9, noise=0.01
+        )
+        cfg = make_cfg(gamma=0.05 * 512, num_iterations=400)
+        fused = ASGD(ds, None, cfg, devices=[devices8[0]]).run_fused()
+        engine = ASGD(ds, None, cfg, devices=[devices8[0]]).run()
+        f_first, f_last = fused.trajectory[0][1], fused.trajectory[-1][1]
+        e_last = engine.trajectory[-1][1]
+        assert f_last < f_first * 0.1, fused.trajectory[-3:]
+        assert f_last < max(e_last * 3.0, 1e-8), (f_last, e_last)
+        assert fused.extras["fused"] is True
+
+    def test_sparse_fused_rejects_logistic(self, devices8):
+        from asyncframework_tpu.ops import steps
+
+        with pytest.raises(ValueError, match="least_squares"):
+            steps.make_fused_asgd_rounds(
+                1.0, 0.3, 100, [(None, None, None)], loss="logistic",
+                sparse_d=16,
+            )
+
     def test_deterministic_per_seed(self, devices8, planted):
         cfg = make_cfg(num_iterations=80)
         a = ASGD(planted, None, cfg, devices=[devices8[0]]).run_fused()
