@@ -483,6 +483,66 @@ def make_fused_asgd_rounds(
     return run_rounds
 
 
+def make_fused_saga_rounds(
+    gamma: float,
+    batch_rate: float,
+    n: int,
+    shards,
+    rounds_per_call: int = 16,
+):
+    """jit (w, ab, alphas, keys) -> (w', ab', alphas', keys', W_snap) --
+    R full ASAGA cohort rounds fused on one device (the ASAGA face of the
+    device-resident accept loop; see :func:`make_fused_asgd_rounds` for
+    the taw=inf semantics argument).
+
+    Per round: every worker computes its history-corrected gradient
+    ``g_i = X_i^T (mask_i * (diff_i - alpha_i))`` against the round-start
+    model and its OWN (current) history slice; the accepts then fold
+    sequentially -- ``w <- w - gamma*(g_j/parRecs + ab); ab <- ab + g_j/N``
+    (``SparkASAGAThread.scala:210-213``) -- and each worker's candidate
+    scalars commit into its slice.  ``delta == g`` is exact here for the
+    same reason as the DCN PS: slices are worker-disjoint and one wave
+    carries one result per worker, so the alpha a gradient was computed
+    against IS the alpha at commit.  Least-squares only (the scalar
+    history compression requires it, like the solver).
+    """
+    nw = len(shards)
+    par_recs = batch_rate * n / nw
+
+    def round_fn(carry, _x):
+        w, ab, alphas, keys = carry
+        gs = []
+        new_alphas = []
+        new_keys = []
+        for i, (X, y) in enumerate(shards):  # static unroll over workers
+            key, sub = jax.random.split(keys[i])
+            mask = jax.random.bernoulli(
+                sub, batch_rate, (X.shape[0],)
+            ).astype(jnp.float32)
+            diff = least_squares_residual(X, y, w)
+            g = mm_f32(X.T, mask * (diff - alphas[i]))
+            gs.append(g)
+            # commit the wave's candidate scalars into the slice
+            new_alphas.append(jnp.where(mask > 0, diff, alphas[i]))
+            new_keys.append(key)
+        # sequential accept fold (ab advances between the nw applies)
+        w2, ab2 = w, ab
+        for g in gs:
+            w2 = w2 - (gamma / par_recs) * g - gamma * ab2
+            ab2 = ab2 + g / n
+        return (w2, ab2, tuple(new_alphas), jnp.stack(new_keys)), w2
+
+    @jax.jit
+    def run_rounds(w, ab, alphas, keys):
+        (w2, ab2, alphas2, keys2), W_snap = jax.lax.scan(
+            round_fn, (w, ab, tuple(alphas), keys), None,
+            length=rounds_per_call,
+        )
+        return w2, ab2, alphas2, keys2, W_snap
+
+    return run_rounds
+
+
 def make_saga_dcn_worker_step():
     """jit (X, y, w, idx, alpha_sel, n_valid) -> (g, diff_sel).
 

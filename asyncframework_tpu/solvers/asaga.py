@@ -403,6 +403,102 @@ class ASAGA(FlopsAccountingMixin):
             },
         )
 
+    # ----------------------------------------------------------------- fused
+    def run_fused(self) -> TrainResult:
+        """Device-resident ASAGA (the taw=inf fast path; semantics in
+        ``steps.make_fused_saga_rounds``, scope guards as in
+        ``ASGD.run_fused``).  Dense shards; the history slices live as
+        scan carry, so the whole table stays in HBM across rounds."""
+        cfg = self.cfg
+        nw = cfg.num_workers
+        if cfg.taw < 2**31 - 1:
+            raise ValueError(
+                "run_fused is the taw=inf fast path; finite taw needs the "
+                "engine's filter -- use run()"
+            )
+        if cfg.coeff != 0.0:
+            raise ValueError(
+                "run_fused cannot inject stragglers (no host between "
+                "updates); use run()"
+            )
+        if self._sparse:
+            raise ValueError(
+                "fused ASAGA currently covers dense shards (sparse keeps "
+                "the engine path)"
+            )
+        d = self.ds.d
+        drv = self.driver_device
+        shards = []
+        for wid in range(nw):
+            shard = self._recovery.shard(wid)
+            X, y = shard.X, shard.y
+            if X.device != drv:
+                X, y = jax.device_put(X, drv), jax.device_put(y, drv)
+            shards.append((X, y))
+        total_rounds = max(1, -(-cfg.num_iterations // nw))
+
+        def make_runner(length):
+            rr = steps.make_fused_saga_rounds(
+                cfg.gamma, cfg.batch_rate, self.ds.n, shards,
+                rounds_per_call=length,
+            )
+
+            def run(carry):
+                w, ab, alphas, keys = carry
+                w, ab, alphas, keys, W_snap = rr(w, ab, alphas, keys)
+                return (w, ab, alphas, keys), W_snap
+
+            return run
+
+        w = jax.device_put(jnp.zeros(d, jnp.float32), drv)
+        ab = jax.device_put(jnp.zeros(d, jnp.float32), drv)
+        alphas = tuple(
+            jax.device_put(jnp.zeros(X.shape[0], jnp.float32), drv)
+            for (X, _y) in shards
+        )
+        keys = jax.device_put(jnp.stack([
+            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid)
+            for wid in range(nw)
+        ]), drv)
+        from asyncframework_tpu.solvers.base import run_fused_plan
+
+        ((w, ab, alphas, keys), snapshots, start_wall,
+         done_rounds) = run_fused_plan(
+            make_runner, (w, ab, alphas, keys), total_rounds, nw,
+            cfg.printer_freq, w_of=lambda c: c[0],
+        )
+        final_w = np.asarray(w)  # fence BEFORE elapsed
+        elapsed = time.monotonic() - start_wall
+        accepted = done_rounds * nw
+        snapshots.append((elapsed * 1e3, w))
+        traj = self._evaluate_trajectory(snapshots)
+        flops = sum(
+            self._task_flops(wid) for wid in range(nw)
+        ) * done_rounds
+        return TrainResult(
+            final_w=final_w,
+            trajectory=traj,
+            elapsed_s=elapsed,
+            accepted=accepted,
+            dropped=0,
+            rounds=done_rounds,
+            max_staleness=nw - 1,
+            avg_delay_ms=0.0,
+            updates_per_sec=accepted / elapsed if elapsed > 0 else 0.0,
+            total_flops=flops,
+            waiting_time_ms={},
+            extras={
+                "fused": True,
+                "rounds_per_call": min(16, total_rounds),
+                "alpha_bar": np.asarray(ab),
+                # final history slices (engine parity: run() exposes
+                # extras["alpha"]), and what the invariant test checks
+                "alpha": {
+                    wid: np.asarray(a) for wid, a in enumerate(alphas)
+                },
+            },
+        )
+
     # ------------------------------------------------------------------- sync
     def run_sync(self) -> TrainResult:
         """SparkASAGASync parity: drain all workers per round, merge all

@@ -476,46 +476,32 @@ class ASGD(FlopsAccountingMixin):
             shards.append(parts)
         sparse_d = d if self._sparse else None
         total_rounds = max(1, -(-cfg.num_iterations // nw))
-        chunk = min(16, total_rounds)
-        full, rem = divmod(total_rounds, chunk)
-        run_rounds = steps.make_fused_asgd_rounds(
-            cfg.gamma, cfg.batch_rate, self.ds.n, shards,
-            loss=cfg.loss, rounds_per_call=chunk, sparse_d=sparse_d,
-        )
-        # exact round budget: the tail that doesn't fill a chunk runs its
-        # own scan length (at most 2 compiled executables total)
-        run_tail = (
-            steps.make_fused_asgd_rounds(
+
+        def make_runner(length):
+            rr = steps.make_fused_asgd_rounds(
                 cfg.gamma, cfg.batch_rate, self.ds.n, shards,
-                loss=cfg.loss, rounds_per_call=rem, sparse_d=sparse_d,
-            ) if rem else None
-        )
+                loss=cfg.loss, rounds_per_call=length, sparse_d=sparse_d,
+            )
+
+            def run(carry):
+                w, k, keys = carry
+                w, k, keys, W_snap = rr(w, k, keys)
+                return (w, k, keys), W_snap
+
+            return run
+
         w = jax.device_put(jnp.zeros(d, jnp.float32), drv)
         k = jax.device_put(jnp.float32(0.0), drv)
-        keys = jnp.stack([
+        keys = jax.device_put(jnp.stack([
             jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid)
             for wid in range(nw)
-        ])
-        keys = jax.device_put(keys, drv)
-        # warm outside the clock (first-iteration blocking parity)
-        _ = run_rounds(w, k, keys)
-        if run_tail is not None:
-            _ = run_tail(w, k, keys)
-        start_wall = time.monotonic()
-        snapshots: List[Tuple[float, jax.Array]] = [(0.0, w)]
-        done_rounds = 0
-        snap_every = max(1, cfg.printer_freq // nw)
-        plan = [(run_rounds, chunk)] * full + (
-            [(run_tail, rem)] if rem else []
+        ]), drv)
+        from asyncframework_tpu.solvers.base import run_fused_plan
+
+        (w, k, keys), snapshots, start_wall, done_rounds = run_fused_plan(
+            make_runner, (w, k, keys), total_rounds, nw, cfg.printer_freq,
+            w_of=lambda c: c[0],
         )
-        for runner, length in plan:
-            w, k, keys, W_snap = runner(w, k, keys)
-            t_ms = (time.monotonic() - start_wall) * 1e3
-            for j in range(0, length, snap_every):
-                # chunk timestamps interpolate dispatch-side; the final
-                # fence below keeps elapsed honest
-                snapshots.append((t_ms, W_snap[j]))
-            done_rounds += length
         final_w = np.asarray(w)  # fence BEFORE elapsed (axon lazy-complete)
         elapsed = time.monotonic() - start_wall
         accepted = done_rounds * nw
@@ -536,7 +522,8 @@ class ASGD(FlopsAccountingMixin):
             updates_per_sec=accepted / elapsed if elapsed > 0 else 0.0,
             total_flops=flops,
             waiting_time_ms={},
-            extras={"fused": True, "rounds_per_call": chunk},
+            extras={"fused": True,
+                    "rounds_per_call": min(16, total_rounds)},
         )
 
     # ------------------------------------------------------------------ sync

@@ -74,6 +74,47 @@ def resolve_dataset(X, y, num_workers: int, devices):
     return ShardedDataset(X, y, num_workers, devices)
 
 
+def run_fused_plan(make_runner, carry, total_rounds: int, nw: int,
+                   printer_freq: int, w_of, chunk_cap: int = 16):
+    """Shared chunk/warm-up/snapshot/timing machinery of the fused
+    device-resident solvers (ASGD.run_fused / ASAGA.run_fused) -- ONE
+    definition so their benchmark numbers stay comparable.
+
+    ``make_runner(length)`` builds a jitted callable ``carry -> (carry,
+    W_snap)`` running ``length`` rounds; ``w_of(carry)`` extracts the model
+    handle.  The full-chunk and remainder executables are BOTH warmed and
+    **fenced** (``jax.block_until_ready``) before the clock starts --
+    unfenced warm-up dispatches would still be executing at ``start_wall``
+    and serialize the first timed chunk behind them, understating the
+    fused rate.  Returns ``(carry, snapshots, start_wall, done_rounds)``;
+    the caller fences the final model (``np.asarray``) before taking
+    elapsed, as everywhere else.
+    """
+    import jax as _jax
+
+    chunk = min(chunk_cap, total_rounds)
+    full, rem = divmod(total_rounds, chunk)
+    runner = make_runner(chunk)
+    tail = make_runner(rem) if rem else None
+    _jax.block_until_ready(runner(carry))
+    if tail is not None:
+        _jax.block_until_ready(tail(carry))
+    start_wall = time.monotonic()
+    snapshots: List[Tuple[float, object]] = [(0.0, w_of(carry))]
+    snap_every = max(1, printer_freq // nw)
+    done = 0
+    plan = [(runner, chunk)] * full + ([(tail, rem)] if rem else [])
+    for r, length in plan:
+        carry, W_snap = r(carry)
+        # chunk timestamps are dispatch-side; the caller's final fence
+        # keeps elapsed honest
+        t_ms = (time.monotonic() - start_wall) * 1e3
+        for j in range(0, length, snap_every):
+            snapshots.append((t_ms, W_snap[j]))
+        done += length
+    return carry, snapshots, start_wall, done
+
+
 class FlopsAccountingMixin:
     """Shared counted-flops accounting for the async solvers.
 

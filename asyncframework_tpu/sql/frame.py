@@ -437,43 +437,106 @@ def _mask_fill(v, keep_mask: np.ndarray):
     return np.where(keep_mask, v, np.zeros_like(v))
 
 
+def _factorize_sorted(keys: np.ndarray):
+    """(sorted uniques, codes) -- the group coding.
+
+    ``pd.factorize`` (hashtable, O(n)) + a k-sized sort/remap replaces
+    ``np.unique(return_inverse=True)`` (full n log n sort): measured 6x
+    faster on 2M int keys and 47x on 2M string keys -- the coding was the
+    whole gap to pandas in the round-3 GROUP BY benchmark.  Output
+    contract unchanged: uniques ascend.
+    """
+    try:
+        import pandas as pd
+    except ImportError:          # pragma: no cover - image ships pandas
+        return np.unique(keys, return_inverse=True)
+    # use_na_sentinel=False: NaN keys get their OWN group code instead of
+    # the -1 sentinel (which remap[codes] would wrap into an arbitrary
+    # real group, silently mis-aggregating NaN rows).  np.unique semantics
+    # preserved: one NaN group, sorted last.
+    try:
+        codes, uniques = pd.factorize(keys, use_na_sentinel=False)
+    except TypeError:            # pragma: no cover - older pandas kwarg
+        codes, uniques = pd.factorize(keys, na_sentinel=None)
+    uniques = np.asarray(uniques)
+    order = np.argsort(uniques, kind="stable")
+    remap = np.empty(len(uniques), np.int64)
+    remap[order] = np.arange(len(uniques))
+    return uniques[order], remap[codes]
+
+
 class GroupedFrame:
-    """groupBy(...).agg(...) via host key dictionary + device segment ops."""
+    """groupBy(...).agg(...): host hash coding + segment reductions.
+
+    Engine routing by backend: on an accelerator the reductions are XLA
+    segment ops on device (one fused scatter-add per aggregate, data never
+    leaves HBM); on the CPU backend the same reductions run as host
+    ``bincount``/``reduceat`` -- a jax dispatch per aggregate costs more
+    than the reduction itself there (ROUND3.md's 17x gap to pandas was
+    coding + CPU-backend dispatch overhead, not the math).
+    """
 
     def __init__(self, frame: ColumnarFrame, key: str):
         self._frame = frame
         self._key = key
         keys = np.asarray(frame[key])
-        self._uniques, self._codes = np.unique(keys, return_inverse=True)
+        self._uniques, self._codes = _factorize_sorted(keys)
+
+    def _host_agg(self, v: np.ndarray, fn: str, n_seg: int):
+        codes = self._codes
+        # float results cast back to the column dtype so the host and
+        # accelerator engines produce IDENTICAL schemas (the device path
+        # accumulates/returns in v.dtype)
+        if fn == "sum":
+            out = np.bincount(codes, weights=v, minlength=n_seg)
+            return out.astype(v.dtype)
+        if fn == "count":
+            return np.bincount(codes, minlength=n_seg).astype(np.int32)
+        if fn == "mean":
+            s = np.bincount(codes, weights=v, minlength=n_seg)
+            c = np.bincount(codes, minlength=n_seg)
+            return (s / c).astype(
+                v.dtype if v.dtype.kind == "f" else np.float64
+            )
+        # min/max: sort-based segment reduce (ufunc.at is near-serial)
+        order = np.argsort(codes, kind="stable")
+        bounds = np.searchsorted(codes[order], np.arange(n_seg), "left")
+        red = np.minimum if fn == "min" else np.maximum
+        return red.reduceat(np.asarray(v)[order], bounds)
 
     def agg(self, **spec) -> ColumnarFrame:
         """``gb.agg(total=("v", "sum"), avg=("v", "mean"), n=("v", "count"))``
         -> one row per group, first column the group key."""
         n_seg = len(self._uniques)
-        codes = jnp.asarray(self._codes)
         out: Dict[str, object] = {self._key: self._uniques}
+        codes_dev = None
         for name, (colname, fn) in spec.items():
             v = self._frame[colname]
             if not isinstance(v, jnp.ndarray):
                 raise TypeError(
                     f"aggregate over host column {colname!r} unsupported"
                 )
+            if fn not in _AGGS:
+                raise ValueError(f"unknown aggregate {fn!r}; use {_AGGS}")
+            if v.device.platform == "cpu":
+                out[name] = self._host_agg(np.asarray(v), fn, n_seg)
+                continue
+            if codes_dev is None:
+                codes_dev = jnp.asarray(self._codes)
             if fn == "sum":
-                out[name] = jax.ops.segment_sum(v, codes, n_seg)
+                out[name] = jax.ops.segment_sum(v, codes_dev, n_seg)
             elif fn == "count":
                 out[name] = jax.ops.segment_sum(
-                    jnp.ones_like(v, jnp.int32), codes, n_seg
+                    jnp.ones_like(v, jnp.int32), codes_dev, n_seg
                 )
             elif fn == "mean":
-                s = jax.ops.segment_sum(v, codes, n_seg)
-                c = jax.ops.segment_sum(jnp.ones_like(v), codes, n_seg)
+                s = jax.ops.segment_sum(v, codes_dev, n_seg)
+                c = jax.ops.segment_sum(jnp.ones_like(v), codes_dev, n_seg)
                 out[name] = s / c
             elif fn == "min":
-                out[name] = jax.ops.segment_min(v, codes, n_seg)
+                out[name] = jax.ops.segment_min(v, codes_dev, n_seg)
             elif fn == "max":
-                out[name] = jax.ops.segment_max(v, codes, n_seg)
-            else:
-                raise ValueError(f"unknown aggregate {fn!r}; use {_AGGS}")
+                out[name] = jax.ops.segment_max(v, codes_dev, n_seg)
         return ColumnarFrame(out)
 
     def count(self) -> ColumnarFrame:

@@ -97,3 +97,40 @@ class TestFusedASGD:
         a = ASGD(planted, None, cfg, devices=[devices8[0]]).run_fused()
         b = ASGD(planted, None, cfg, devices=[devices8[0]]).run_fused()
         assert np.allclose(a.final_w, b.final_w)
+
+
+class TestFusedASAGA:
+    def test_matches_engine_band_and_history_invariant(
+        self, devices8, planted
+    ):
+        from asyncframework_tpu.solvers import ASAGA
+
+        cfg = make_cfg(gamma=0.35, num_iterations=320)
+        fused = ASAGA(planted, None, cfg, devices=[devices8[0]]).run_fused()
+        engine = ASAGA(planted, None, cfg, devices=[devices8[0]]).run()
+        f_first, f_last = fused.trajectory[0][1], fused.trajectory[-1][1]
+        e_last = engine.trajectory[-1][1]
+        assert f_last < f_first * 0.05, fused.trajectory[-3:]
+        assert f_last < max(e_last * 3.0, 1e-8), (f_last, e_last)
+        assert fused.extras["fused"] is True
+        # THE invariant: alpha_bar == (1/N) sum_i X_i^T alpha_i exactly
+        # (delta == g is exact in a full wave) -- a dead commit path would
+        # leave the table at zero while alpha_bar drifts, failing this
+        ab = fused.extras["alpha_bar"]
+        acc = np.zeros_like(ab, dtype=np.float64)
+        for wid, a in fused.extras["alpha"].items():
+            X = np.asarray(planted.shard(wid).X)
+            acc += X.T @ a
+        acc /= planted.n
+        assert any(np.any(a != 0) for a in fused.extras["alpha"].values())
+        np.testing.assert_allclose(ab, acc, rtol=2e-3, atol=2e-5)
+
+    def test_guards(self, devices8, planted):
+        from asyncframework_tpu.solvers import ASAGA
+
+        with pytest.raises(ValueError, match="taw"):
+            ASAGA(planted, None, make_cfg(gamma=0.35, taw=1),
+                  devices=[devices8[0]]).run_fused()
+        with pytest.raises(ValueError, match="straggler"):
+            ASAGA(planted, None, make_cfg(gamma=0.35, coeff=2.0),
+                  devices=[devices8[0]]).run_fused()

@@ -231,6 +231,36 @@ class TestPruningEdgeCases:
         assert split_conjuncts(e) == [e]
 
 
+class TestGroupCoding:
+    def test_nan_keys_form_their_own_group(self):
+        """pd.factorize's -1 NaN sentinel must not wrap into a real group
+        (remap[-1] would): NaN keys aggregate into their own group, sorted
+        last, like np.unique gave."""
+        f = ColumnarFrame({
+            "k": np.asarray([1.0, np.nan, 2.0, np.nan, 1.0], np.float32),
+            "v": np.asarray([10.0, 1.0, 20.0, 2.0, 30.0], np.float32),
+        })
+        out = f.groupby("k").agg(s=("v", "sum"))
+        ks = np.asarray(out["k"])
+        ss = np.asarray(out["s"])
+        assert len(ks) == 3
+        assert np.isnan(ks[-1])  # NaN group exists, sorted last
+        assert ss[np.where(ks == 1.0)[0][0]] == 40.0
+        assert ss[np.where(ks == 2.0)[0][0]] == 20.0
+        assert ss[-1] == 3.0  # the NaN rows' own sum
+
+    def test_host_agg_dtype_matches_device_contract(self):
+        f = ColumnarFrame({
+            "k": np.asarray([1, 1, 2], np.int32),
+            "v": np.asarray([1.0, 2.0, 3.0], np.float32),
+        })
+        out = f.groupby("k").agg(s=("v", "sum"), m=("v", "mean"),
+                                 c=("v", "count"))
+        assert np.asarray(out["s"]).dtype == np.float32
+        assert np.asarray(out["m"]).dtype == np.float32
+        assert np.asarray(out["c"]).dtype == np.int32
+
+
 class TestConstantFolding:
     def test_tautology_dropped(self):
         plan = Filter(Scan("a", frame=frame_a()), lit(1) < lit(2))
