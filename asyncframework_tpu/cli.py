@@ -15,7 +15,9 @@ Here the same recipe is::
 Driver names accept both the reference class names (``SparkASGDThread``,
 ``SparkASGDSync``, ``SparkASAGAThread``, ``SparkASAGASync``,
 ``SparkSGDMLLIB``) and short forms (``asgd``, ``asgd-sync``, ``asaga``,
-``asaga-sync``, ``sgd-mllib``).  ``--conf key=value`` overlays any registered
+``asaga-sync``, ``sgd-mllib``), plus the device-resident fast paths
+``asgd-fused`` / ``asaga-fused`` (taw=inf recipes fused into on-device
+scan rounds; single-process, no runtime flags -- see ``ASGD.run_fused``).  ``--conf key=value`` overlays any registered
 :class:`~asyncframework_tpu.conf.ConfigEntry` (CLI > conf file > env >
 default precedence, like ``spark-submit --conf``).
 
@@ -75,6 +77,9 @@ DRIVER_ALIASES: Dict[str, str] = {
     "asaga-sync": "asaga-sync",
     "sparksgdmllib": "sgd-mllib",
     "sgd-mllib": "sgd-mllib",
+    # the device-resident fast path (taw=inf recipes; see ASGD.run_fused)
+    "asgd-fused": "asgd-fused",
+    "asaga-fused": "asaga-fused",
 }
 
 POSITIONAL = [
@@ -255,25 +260,64 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
     if multihost.ensure_initialized() and driver != "sgd-mllib":
         raise SystemExit(
             "multi-process runs support the SPMD sgd-mllib driver (global "
-            "mesh) and the DCN parameter-server asgd/asaga drivers; for "
-            "the sync drivers run single-process"
+            "mesh) and the DCN parameter-server asgd/asaga drivers; the "
+            "sync and fused drivers run single-process"
         )
     devices = jax.devices()
     if args.devices is not None:
         devices = devices[: args.devices]
 
-    if args.checkpoint_dir and (driver.endswith("-sync") or driver == "sgd-mllib"):
+    # drivers without the async engine runtime (no updater thread, no
+    # executor pool): one predicate, every runtime-flag guard below uses it
+    no_runtime = (
+        driver.endswith("-sync") or driver.endswith("-fused")
+        or driver == "sgd-mllib"
+    )
+    fused = driver.endswith("-fused")
+    if args.checkpoint_dir and no_runtime:
         raise SystemExit(
-            "--checkpoint-dir is supported by the async drivers only "
-            "(asgd, asaga); sync and sgd-mllib runs do not checkpoint"
+            "--checkpoint-dir is supported by the async engine drivers "
+            "only (asgd, asaga); sync/fused/sgd-mllib runs do not "
+            "checkpoint"
         )
 
     if args.report and not args.event_log:
         raise SystemExit("--report requires --event-log (it renders the log)")
-    if args.stale_read is not None and (
-        driver.endswith("-sync") or driver == "sgd-mllib"
-    ):
-        raise SystemExit("--stale-read applies to the async drivers only")
+    if args.stale_read is not None and no_runtime:
+        raise SystemExit(
+            "--stale-read applies to the async engine drivers only"
+        )
+    if fused:
+        # fail BEFORE the (possibly large) dataset is loaded onto device,
+        # and as a clean usage error -- run_fused's own guards would
+        # surface as tracebacks after the load
+        if args.taw < 2**31 - 1:
+            raise SystemExit(
+                "fused drivers are the taw=inf fast path (the reference's "
+                "headline recipes); finite taw needs the engine's tau "
+                "filter -- use asgd/asaga"
+            )
+        if args.coeff != 0.0:
+            raise SystemExit(
+                "fused drivers cannot inject stragglers (no host between "
+                "updates); use asgd/asaga"
+            )
+        if driver.startswith("asaga") and getattr(args, "sparse", False):
+            raise SystemExit(
+                "fused ASAGA covers dense shards; sparse ASAGA runs the "
+                "engine path (asaga)"
+            )
+        for flag, name in (
+            (args.speculation, "--speculation"),
+            (args.dynamic_allocation, "--dynamic-allocation"),
+            (args.ui_port is not None, "--ui-port"),
+            (args.metrics_csv, "--metrics-csv"),
+        ):
+            if flag:
+                raise SystemExit(
+                    f"{name} needs the async engine runtime; the fused "
+                    "drivers run a closed on-device loop -- use asgd/asaga"
+                )
 
     cfg = SolverConfig(
         num_workers=args.num_partitions,
@@ -339,7 +383,22 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
     else:
         solver_cls = ASGD if driver.startswith("asgd") else ASAGA
         solver = solver_cls(X, y, cfg, devices=devices)
-        res = solver.run_sync() if driver.endswith("-sync") else solver.run()
+        if driver.endswith("-sync"):
+            res = solver.run_sync()
+        elif driver.endswith("-fused"):
+            res = solver.run_fused()
+            if args.event_log:
+                # the fused loop has no per-task events; log the trajectory
+                # so --event-log/--report keep working (same fallback as
+                # the fused-scan sgd-mllib baseline)
+                from asyncframework_tpu.solvers.instrumentation import (
+                    log_trajectory,
+                )
+
+                log_trajectory(args.event_log, res.trajectory,
+                               cfg.printer_freq)
+        else:
+            res = solver.run()
         trajectory = res.trajectory
         summary = {
             "driver": driver,

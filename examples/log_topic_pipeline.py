@@ -1,0 +1,60 @@
+"""Replayable log ingest: exactly-once-ish stream processing.
+
+Direct-stream parity (DirectKafkaInputDStream semantics without a broker):
+a producer appends events to a durable on-disk LogTopic; the consumer reads
+offset ranges per interval and commits its offset only after the interval's
+outputs ran.  Kill the pipeline mid-stream and restart it: committed
+batches never replay, the in-flight one does.
+"""
+
+import tempfile
+
+import numpy as np
+
+from asyncframework_tpu.streaming import (
+    DirectLogStream,
+    LogTopic,
+    StreamingContext,
+)
+from asyncframework_tpu.utils.clock import ManualClock
+
+
+def main(n_events=600, per_batch=200):
+    tmp = tempfile.mkdtemp(prefix="log-topic-")
+    rs = np.random.default_rng(7)
+
+    # producer side: durable appends (another process could do this)
+    topic = LogTopic(tmp, segment_bytes=16 * 1024)
+    topic.append_many([
+        {"user": int(u), "amount": round(float(a), 2)}
+        for u, a in zip(rs.integers(0, 50, n_events),
+                        rs.gamma(2.0, 10.0, n_events))
+    ])
+
+    # consumer side: per-interval revenue, offsets committed after output
+    ssc = StreamingContext(batch_interval_ms=100, clock=ManualClock())
+    revenue = []
+    (
+        DirectLogStream(ssc, tmp, group="billing", max_per_batch=per_batch)
+        .map_batch(lambda evs: round(sum(e["amount"] for e in evs), 2))
+        .foreach_batch(lambda t, total: revenue.append(total))
+    )
+    interval = 0
+    while LogTopic(tmp).committed_offset("billing") < n_events:
+        interval += 1
+        ssc.generate_batch(interval * 100)
+
+    # a RESTARTED consumer on the same group sees nothing left to replay
+    ssc2 = StreamingContext(batch_interval_ms=100, clock=ManualClock())
+    replayed = []
+    DirectLogStream(ssc2, tmp, group="billing").foreach_batch(
+        lambda t, b: replayed.append(b)
+    )
+    ssc2.generate_batch(100)
+    return revenue, replayed
+
+
+if __name__ == "__main__":
+    rev, rep = main()
+    print(f"per-interval revenue: {rev}")
+    print(f"replayed after restart: {rep} (committed consumption)")

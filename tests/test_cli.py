@@ -45,6 +45,23 @@ class TestDrivers:
         assert np.isfinite(summary["final_objective"])
         assert not traj_lines  # --quiet
 
+    @pytest.mark.parametrize("name,gamma", [
+        ("asgd-fused", 1.0), ("asaga-fused", 0.3),
+    ])
+    def test_fused_drivers_run(self, capsys, name, gamma):
+        summary, _ = run_cli(
+            capsys, recipe(name, iters=32, gamma=gamma, extra=("--quiet",))
+        )
+        assert summary["driver"] == name
+        assert summary["accepted"] >= 32
+        assert summary["dropped"] == 0
+        assert np.isfinite(summary["final_objective"])
+
+    def test_fused_rejects_checkpoint_dir(self, tmp_path):
+        with pytest.raises(SystemExit, match="checkpoint"):
+            cli.main(recipe("asgd-fused", iters=5,
+                            extra=("--checkpoint-dir", str(tmp_path))))
+
     def test_sgd_mllib_driver(self, capsys, tmp_path):
         # mllib baseline needs host arrays -> write a real libsvm file
         rs = np.random.default_rng(0)

@@ -51,6 +51,15 @@ def test_ring_attention_example():
     assert np.isfinite(np.asarray(out)).all()
 
 
+def test_log_topic_example():
+    import log_topic_pipeline
+
+    revenue, replayed = log_topic_pipeline.main(n_events=600, per_batch=200)
+    assert len(revenue) == 3          # 600 events / 200 per batch
+    assert all(r > 0 for r in revenue)
+    assert replayed == []             # committed offsets: nothing replays
+
+
 def test_sql_example():
     import sql_pipeline
 
