@@ -236,15 +236,32 @@ class ColumnarFrame:
         return self._take(np.nonzero(keep)[0]).distinct()
 
     # --------------------------------------------------------------- sorting
-    def sort(self, by: str, ascending: bool = True) -> "ColumnarFrame":
-        keys = np.asarray(self._cols[by])
-        order = np.argsort(keys, kind="stable")
-        if not ascending:
-            order = order[::-1]
-        return self._take(order)
+    def sort(self, by, ascending=True) -> "ColumnarFrame":
+        """Stable sort by one column or a list (``ORDER BY c1, c2 ...``
+        parity); ``ascending`` may be one bool or one per column."""
+        cols = [by] if isinstance(by, str) else list(by)
+        asc = ([ascending] * len(cols) if isinstance(ascending, bool)
+               else list(ascending))
+        if len(asc) != len(cols):
+            raise ValueError("one ascending flag per sort column")
+        if len(cols) == 1 and asc[0]:
+            order = np.argsort(np.asarray(self._cols[cols[0]]),
+                               kind="stable")
+            return self._take(order)
+        # multi-column / descending: lexsort over per-column sort codes
+        # (codes negate cleanly for DESC even on string columns, and a
+        # stable code sort == a stable value sort)
+        lex_keys = []
+        for c, a in zip(reversed(cols), reversed(asc)):
+            arr = np.asarray(self._cols[c])
+            _u, codes = _factorize_sorted(arr)
+            lex_keys.append(codes if a else -codes)
+        return self._take(np.lexsort(lex_keys))
 
     # -------------------------------------------------------------- grouping
-    def groupby(self, key: str) -> "GroupedFrame":
+    def groupby(self, key) -> "GroupedFrame":
+        """``key``: one column name or a list of them (multi-key grouping,
+        ``Dataset.groupBy(col1, col2, ...)`` parity)."""
         return GroupedFrame(self, key)
 
     def agg(self, **spec) -> Dict[str, float]:
@@ -476,11 +493,60 @@ class GroupedFrame:
     coding + CPU-backend dispatch overhead, not the math).
     """
 
-    def __init__(self, frame: ColumnarFrame, key: str):
+    def __init__(self, frame: ColumnarFrame, key):
         self._frame = frame
-        self._key = key
-        keys = np.asarray(frame[key])
-        self._uniques, self._codes = _factorize_sorted(keys)
+        self._keys = [key] if isinstance(key, str) else list(key)
+        self._key = self._keys[0]  # back-compat for single-key callers
+        if len(self._keys) == 1:
+            keys = np.asarray(frame[self._keys[0]])
+            self._uniques, self._codes = _factorize_sorted(keys)
+            self._key_columns = {self._keys[0]: self._uniques}
+        else:
+            # multi-key: factorize each key (sorted), combine the codes
+            # into one integer (row-major over per-key cardinalities), and
+            # factorize THAT -- integer work end-to-end, so string keys
+            # pay the hashtable once each, never a tuple sort.  Group
+            # order is lexicographic over the key list, like np.unique
+            # over a record array would give.
+            per_u = []
+            per_c = []
+            card_product = 1
+            for k in self._keys:
+                u, c = _factorize_sorted(np.asarray(frame[k]))
+                per_u.append(u)
+                per_c.append(c)
+                card_product *= max(len(u), 1)
+            if card_product < 2**62:
+                combined = None
+                for u, c in zip(per_u, per_c):
+                    combined = c if combined is None else (
+                        combined * len(u) + c
+                    )
+                occupied, self._codes = np.unique(
+                    combined, return_inverse=True
+                )
+                rem = occupied
+                key_cols = {}
+                for k, u in zip(reversed(self._keys), reversed(per_u)):
+                    rem, idx = np.divmod(rem, len(u))
+                    key_cols[k] = u[idx]
+            else:
+                # cardinality product would overflow int64 (wrapped codes
+                # from distinct tuples could collide and silently MERGE
+                # groups): sort the per-key code columns as one record
+                # array instead -- slower, never wrong
+                rec = np.empty(len(per_c[0]), dtype=[
+                    (f"f{i}", np.int64) for i in range(len(per_c))
+                ])
+                for i, c in enumerate(per_c):
+                    rec[f"f{i}"] = c
+                occ_rec, self._codes = np.unique(rec, return_inverse=True)
+                key_cols = {
+                    k: u[occ_rec[f"f{i}"]]
+                    for i, (k, u) in enumerate(zip(self._keys, per_u))
+                }
+            self._key_columns = {k: key_cols[k] for k in self._keys}
+            self._uniques = self._key_columns[self._keys[0]]
 
     def _host_agg(self, v: np.ndarray, fn: str, n_seg: int):
         codes = self._codes
@@ -508,7 +574,7 @@ class GroupedFrame:
         """``gb.agg(total=("v", "sum"), avg=("v", "mean"), n=("v", "count"))``
         -> one row per group, first column the group key."""
         n_seg = len(self._uniques)
-        out: Dict[str, object] = {self._key: self._uniques}
+        out: Dict[str, object] = dict(self._key_columns)
         codes_dev = None
         for name, (colname, fn) in spec.items():
             v = self._frame[colname]
@@ -541,4 +607,4 @@ class GroupedFrame:
 
     def count(self) -> ColumnarFrame:
         counts = np.bincount(self._codes, minlength=len(self._uniques))
-        return ColumnarFrame({self._key: self._uniques, "count": counts})
+        return ColumnarFrame({**self._key_columns, "count": counts})

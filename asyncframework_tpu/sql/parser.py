@@ -16,8 +16,8 @@ Supported surface:
          [INNER|LEFT|RIGHT|FULL|SEMI|ANTI] JOIN t2 ON key
     WHERE expr     -- AND/OR/NOT, comparisons, BETWEEN, IN (list|subquery),
                    -- LIKE, IS [NOT] NULL, CASE WHEN, CAST, scalar subqueries
-    GROUP BY k [HAVING expr]
-    ORDER BY c [ASC|DESC]
+    GROUP BY k [, k2 ...] [HAVING expr]
+    ORDER BY c [ASC|DESC] [, c2 ...]
     LIMIT n
     query UNION [ALL] query | EXCEPT | INTERSECT   (left-associative)
 
@@ -193,16 +193,29 @@ class _Parser:
         if seen_set_op:
             if self.accept("ORDER"):
                 self.expect("BY")
-                by = self.ident()
-                ascending = not self.accept("DESC")
-                if ascending:
-                    self.accept("ASC")
-                if by not in left.columns:
-                    raise ValueError(f"ORDER BY {by!r}: not a result column")
-                left = left.sort(by, ascending=ascending)
+                by, asc = self._order_list()
+                missing = [c for c in by if c not in left.columns]
+                if missing:
+                    raise ValueError(
+                        f"ORDER BY {missing[0]!r}: not a result column"
+                    )
+                left = left.sort(by, ascending=asc)
             if self.accept("LIMIT"):
                 left = _limit(left, int(self.next()))
         return left
+
+    def _order_list(self):
+        """Parse ``c [ASC|DESC] [, c2 ...]`` after ORDER BY."""
+        cols, asc = [], []
+        while True:
+            cols.append(self.ident())
+            if self.accept("DESC"):
+                asc.append(False)
+            else:
+                self.accept("ASC")
+                asc.append(True)
+            if not self.accept(","):
+                return cols, asc
 
     def select_core(self, consume_order: bool = True) -> ColumnarFrame:
         if self.peek() == "(":
@@ -255,22 +268,22 @@ class _Parser:
         having = None
         if self.accept("GROUP"):
             self.expect("BY")
-            group_key = self.ident()
+            group_key = [self.ident()]
+            while self.accept(","):
+                group_key.append(self.ident())
+            if len(group_key) == 1:
+                group_key = group_key[0]
             if self.accept("HAVING"):
                 # HAVING filters the AGGREGATED result, so its expression
                 # references OUTPUT column names (the group key, aggregate
                 # labels like sum(v), or AS aliases)
                 having = self.expr()
 
-        order_by = None
-        ascending = True
+        order_by = None       # list of columns when present
+        ascending = True      # list of per-column flags when present
         if consume_order and self.accept("ORDER"):
             self.expect("BY")
-            order_by = self.ident()
-            if self.accept("DESC"):
-                ascending = False
-            else:
-                self.accept("ASC")
+            order_by, ascending = self._order_list()
 
         limit = None
         if consume_order and self.accept("LIMIT"):
@@ -288,7 +301,7 @@ class _Parser:
             order_by is not None
             and group_key is None
             and not aggs_present(items)
-            and order_by in frame.columns
+            and all(c in frame.columns for c in order_by)
         ):
             # standard SQL: ORDER BY may reference an unprojected source
             # column -- sorting the source BEFORE projecting covers both
@@ -296,6 +309,7 @@ class _Parser:
             # (projection preserves row order)
             frame = frame.sort(order_by, ascending=ascending)
             order_by = None
+        source_frame = frame  # for ORDER BY columns mixing source + alias
         frame = self._project(frame, items, group_key)
         if having is not None:
             # HAVING may reference an aggregate by its CALL syntax (default
@@ -328,13 +342,38 @@ class _Parser:
         if distinct:
             frame = frame.distinct()
         if order_by is not None:
-            if order_by not in frame.columns:
+            missing = [c for c in order_by if c not in frame.columns]
+            borrowed = []
+            if (
+                missing
+                and group_key is None
+                and not aggs_present(items)
+                and not distinct
+                and all(c in source_frame.columns for c in missing)
+                and len(source_frame) == len(frame)
+            ):
+                # ORDER BY mixing SELECT aliases with unprojected source
+                # columns: projection preserved row order, so the missing
+                # columns ride along for the sort and drop after
+                from asyncframework_tpu.sql.frame import ColumnarFrame as _CF
+
+                cols = {c: frame[c] for c in frame.columns}
+                for c in missing:
+                    cols[c] = source_frame[c]
+                frame = _CF(cols)
+                borrowed = missing
+                missing = []
+            if missing:
                 raise ValueError(
-                    f"ORDER BY {order_by!r}: not a result column"
+                    f"ORDER BY {missing[0]!r}: not a result column"
                     + ("" if group_key is None else
                        " (aggregated queries sort by output columns only)")
                 )
             frame = frame.sort(order_by, ascending=ascending)
+            if borrowed:
+                frame = frame.select(
+                    *[c for c in frame.columns if c not in borrowed]
+                )
         if limit is not None:
             frame = _limit(frame, limit)
         return frame
@@ -746,11 +785,12 @@ class _Parser:
                     "SELECT * is not valid with GROUP BY; name the "
                     "group key and aggregates explicitly"
                 )
+            keys = group_key if isinstance(group_key, list) else [group_key]
             for e, name in exprs:
-                if name != group_key:
+                if name not in keys:
                     raise ValueError(
                         "non-aggregate select item "
-                        f"{name!r} must be the GROUP BY key"
+                        f"{name!r} must be a GROUP BY key"
                     )
             frame, spec = _agg_spec(frame, aggs)
             gb = frame.groupby(group_key)
@@ -857,9 +897,13 @@ def _required_source_columns(items, group_key, order_by):
                 return None
             names |= set(e.refs)
     if group_key is not None:
-        names.add(group_key)
+        names.update(
+            group_key if isinstance(group_key, list) else [group_key]
+        )
     if order_by is not None:
-        names.add(order_by)
+        names.update(
+            order_by if isinstance(order_by, list) else [order_by]
+        )
     return names
 
 

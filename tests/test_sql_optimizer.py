@@ -231,6 +231,125 @@ class TestPruningEdgeCases:
         assert split_conjuncts(e) == [e]
 
 
+class TestMultiKeyGroupBy:
+    def test_frame_two_keys(self):
+        f = ColumnarFrame({
+            "a": np.asarray([1, 1, 2, 2, 1], np.int32),
+            "b": np.asarray(["x", "y", "x", "x", "x"], object),
+            "v": np.asarray([1.0, 2.0, 3.0, 4.0, 5.0], np.float32),
+        })
+        out = f.groupby(["a", "b"]).agg(s=("v", "sum"), n=("v", "count"))
+        rows = sorted(out.collect())
+        assert rows == [(1, "x", 6.0, 2), (1, "y", 2.0, 1),
+                        (2, "x", 7.0, 2)]
+        # lexicographic group order over (a, b)
+        assert [tuple(r[:2]) for r in out.collect()] == [
+            (1, "x"), (1, "y"), (2, "x"),
+        ]
+
+    def test_frame_count_multi(self):
+        f = ColumnarFrame({
+            "a": np.asarray([1, 1, 2], np.int32),
+            "b": np.asarray([0, 1, 0], np.int32),
+        })
+        out = f.groupby(["a", "b"]).count()
+        assert sorted(out.collect()) == [(1, 0, 1), (1, 1, 1), (2, 0, 1)]
+
+    def test_sql_group_by_two_keys(self):
+        f = ColumnarFrame({
+            "region": np.asarray(["e", "w", "e", "w", "e"], object),
+            "year": np.asarray([1, 1, 2, 2, 1], np.int32),
+            "amt": np.asarray([10.0, 20.0, 30.0, 40.0, 50.0], np.float32),
+        })
+        out = sql(
+            "SELECT region, year, SUM(amt) AS total FROM t "
+            "GROUP BY region, year", t=f,
+        )
+        assert sorted(out.collect()) == [
+            ("e", 1, 60.0), ("e", 2, 30.0), ("w", 1, 20.0), ("w", 2, 40.0),
+        ]
+
+    def test_sql_non_key_select_rejected(self):
+        f = ColumnarFrame({
+            "a": np.asarray([1], np.int32),
+            "b": np.asarray([2], np.int32),
+            "v": np.asarray([1.0], np.float32),
+        })
+        with pytest.raises(ValueError, match="GROUP BY key"):
+            sql("SELECT b, SUM(v) AS s FROM t GROUP BY a", t=f)
+
+    def test_matches_pandas_on_random_data(self):
+        import pandas as pd
+
+        rs = np.random.default_rng(3)
+        a = rs.integers(0, 7, 5000).astype(np.int32)
+        b = rs.integers(0, 11, 5000).astype(np.int32)
+        v = rs.normal(size=5000).astype(np.float32)
+        f = ColumnarFrame({"a": a, "b": b, "v": v})
+        out = f.groupby(["a", "b"]).agg(s=("v", "sum"))
+        got = {(int(r[0]), int(r[1])): r[2] for r in out.collect()}
+        expect = pd.DataFrame({"a": a, "b": b, "v": v}).groupby(
+            ["a", "b"]
+        )["v"].sum()
+        assert set(got) == set(expect.index)
+        for key, val in expect.items():
+            assert abs(got[key] - val) < 1e-2, key
+
+
+class TestMultiColumnOrderBy:
+    def test_two_columns_mixed_direction(self):
+        f = ColumnarFrame({
+            "a": np.asarray([2, 1, 2, 1], np.int32),
+            "b": np.asarray([1.0, 2.0, 3.0, 4.0], np.float32),
+        })
+        out = sql("SELECT a, b FROM t ORDER BY a ASC, b DESC", t=f)
+        assert out.collect() == [(1, 4.0), (1, 2.0), (2, 3.0), (2, 1.0)]
+
+    def test_group_by_then_order_by_two_outputs(self):
+        f = ColumnarFrame({
+            "region": np.asarray(["w", "e", "w", "e"], object),
+            "year": np.asarray([2, 2, 1, 1], np.int32),
+            "amt": np.asarray([1.0, 2.0, 3.0, 4.0], np.float32),
+        })
+        out = sql(
+            "SELECT region, year, SUM(amt) AS t FROM t "
+            "GROUP BY region, year ORDER BY region DESC, year", t=f,
+        )
+        assert out.collect() == [
+            ("w", 1, 3.0), ("w", 2, 1.0), ("e", 1, 4.0), ("e", 2, 2.0),
+        ]
+
+    def test_order_by_mixes_alias_and_source_column(self):
+        f = ColumnarFrame({
+            "a": np.asarray([1, 2, 3, 4], np.int32),
+            "b": np.asarray([0, 1, 0, 1], np.int32),
+        })
+        out = sql("SELECT a AS x FROM t ORDER BY b, x DESC", t=f)
+        assert out.columns == ["x"]
+        assert [x for (x,) in out.collect()] == [3, 1, 4, 2]
+
+    def test_set_op_order_by_two_columns(self):
+        f = ColumnarFrame({
+            "a": np.asarray([2, 1], np.int32),
+            "b": np.asarray([1.0, 2.0], np.float32),
+        })
+        g = ColumnarFrame({
+            "a": np.asarray([1, 2], np.int32),
+            "b": np.asarray([9.0, 1.0], np.float32),
+        })
+        out = sql("SELECT a, b FROM t UNION SELECT a, b FROM u "
+                  "ORDER BY a, b DESC", t=f, u=g)
+        assert out.collect() == [(1, 9.0), (1, 2.0), (2, 1.0)]
+
+    def test_frame_sort_string_desc(self):
+        f = ColumnarFrame({
+            "k": np.asarray(["b", "a", "c"], object),
+            "v": np.asarray([1, 2, 3], np.int32),
+        })
+        out = f.sort(["k"], ascending=[False])
+        assert [r[0] for r in out.collect()] == ["c", "b", "a"]
+
+
 class TestGroupCoding:
     def test_nan_keys_form_their_own_group(self):
         """pd.factorize's -1 NaN sentinel must not wrap into a real group
