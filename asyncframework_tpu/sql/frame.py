@@ -159,16 +159,10 @@ class ColumnarFrame:
         distinct and the set operations).  Floats compare by bit pattern
         (-0.0 normalized) so duplicate NaN rows collapse; object/string
         columns compare by a stable per-value code."""
-        arrays = []
-        for i, c in enumerate(self._cols):
-            a = np.asarray(self._cols[c])
-            if a.dtype.kind == "f":
-                a = np.where(a == 0, 0.0, a).astype(a.dtype)
-                a = a.view(f"u{a.dtype.itemsize}")
-            elif a.dtype.kind == "O":
-                # structured dtypes reject object fields; encode as str
-                a = a.astype(str)
-            arrays.append((f"f{i}", a))
+        arrays = [
+            (f"f{i}", _comparable_column(np.asarray(self._cols[c])))
+            for i, c in enumerate(self._cols)
+        ]
         rec = np.empty(
             self._n, dtype=[(name, a.dtype) for name, a in arrays]
         )
@@ -285,9 +279,11 @@ class ColumnarFrame:
 
     # ----------------------------------------------------------------- joins
     def join(
-        self, other: "ColumnarFrame", on: str, how: str = "inner"
+        self, other: "ColumnarFrame", on: Union[str, List[str]],
+        how: str = "inner"
     ) -> "ColumnarFrame":
-        """Equi-join on column ``on``;
+        """Equi-join on column ``on`` -- one name or a list (multi-key:
+        the sides are packed into comparable key records);
         ``how`` in ('inner', 'left', 'right', 'full', 'semi', 'anti').
 
         Index build is a host-side sort/searchsorted (keys may be strings);
@@ -298,13 +294,15 @@ class ColumnarFrame:
         ``semi``/``anti`` return only left columns: rows with >=1 match /
         rows with none (no duplication), like Spark's LeftSemi/LeftAnti.
         """
+        keys = [on] if isinstance(on, str) else list(on)
         if how == "right":
             # a right join IS a left join with the frames swapped.  Colliding
             # names must still follow the left-keeps-bare convention, so
             # left's collisions are parked under temp names through the swap
             # and the pair is renamed back afterwards.
             collide = [
-                c for c in self.columns if c != on and c in other.columns
+                c for c in self.columns
+                if c not in keys and c in other.columns
             ]
             lf = self.rename({c: f"__swap__{c}" for c in collide})
             j = other.join(lf, on, "left")
@@ -312,9 +310,9 @@ class ColumnarFrame:
                 {c: f"{c}_right" for c in collide}
                 | {f"__swap__{c}": c for c in collide}
             )
-            order = [on] + [c for c in self.columns if c != on] + [
+            order = keys + [c for c in self.columns if c not in keys] + [
                 c for c in j.columns
-                if c not in self.columns and c != on
+                if c not in self.columns and c not in keys
             ]
             return ColumnarFrame({c: j._cols[c] for c in order})
         if how not in ("inner", "left", "full", "semi", "anti"):
@@ -332,7 +330,8 @@ class ColumnarFrame:
             # column convention (row order is right-major after the swap --
             # SQL promises none).
             collide = [
-                c for c in self.columns if c != on and c in other.columns
+                c for c in self.columns
+                if c not in keys and c in other.columns
             ]
             lf = self.rename({c: f"__swap__{c}" for c in collide})
             j = other.join(lf, on, "inner")
@@ -340,13 +339,16 @@ class ColumnarFrame:
                 {c: f"{c}_right" for c in collide}
                 | {f"__swap__{c}": c for c in collide}
             )
-            order = [on] + [c for c in self.columns if c != on] + [
+            order = keys + [c for c in self.columns if c not in keys] + [
                 c for c in j.columns
-                if c not in self.columns and c != on
+                if c not in self.columns and c not in keys
             ]
             return ColumnarFrame({c: j._cols[c] for c in order})
-        lk = np.asarray(self._cols[on])
-        rk = np.asarray(other._cols[on])
+        if len(keys) == 1:
+            lk = np.asarray(self._cols[keys[0]])
+            rk = np.asarray(other._cols[keys[0]])
+        else:
+            lk, rk = _pack_join_keys(self, other, keys)
         if how in ("semi", "anti"):
             r_sorted = np.sort(rk)
             s = np.searchsorted(r_sorted, lk, "left")
@@ -383,7 +385,7 @@ class ColumnarFrame:
         for name in self.columns:
             out[name] = left_taken._cols[name]
         for name in other.columns:
-            if name == on:
+            if name in keys:
                 continue
             out_name = name if name not in out else f"{name}_right"
             right_src[out_name] = name
@@ -416,8 +418,10 @@ class ColumnarFrame:
                 none = np.zeros(len(miss), bool)
                 for name in list(out):
                     cur = out[name]
-                    if name == on:
-                        extra = rk[miss]  # key survives from the right side
+                    if name in keys:
+                        # key survives from the right side (per column --
+                        # rk may be a packed record array)
+                        extra = np.asarray(other._cols[name])[miss]
                     elif name in right_src:
                         src = other._cols[right_src[name]]
                         extra = (
@@ -442,6 +446,52 @@ class ColumnarFrame:
                             [np.asarray(cur), np.asarray(extra)]
                         )
         return ColumnarFrame(out)
+
+
+def _comparable_column(a: np.ndarray) -> np.ndarray:
+    """ONE definition of the comparability normalization (shared by
+    ``_row_records`` and the multi-key join pack): floats by normalized
+    bit pattern (-0.0 collapsed), object columns as strings."""
+    if a.dtype.kind == "f":
+        a = np.where(a == 0, 0.0, a).astype(a.dtype)
+        return a.view(f"u{a.dtype.itemsize}")
+    if a.dtype.kind == "O":
+        # structured dtypes reject object fields; encode as str
+        return a.astype(str)
+    return a
+
+
+def _pack_join_keys(left: "ColumnarFrame", right: "ColumnarFrame", keys):
+    """Both sides' key columns packed as ONE comparable structured array
+    each (multi-key equi-join).  Per-key dtypes are unified across the two
+    frames FIRST (string widths, numeric promotion) so record comparisons
+    are well-defined, then each column runs the shared
+    :func:`_comparable_column` normalization."""
+    fields = []
+    l_cols, r_cols = [], []
+    for i, k in enumerate(keys):
+        a = np.asarray(left._cols[k])
+        b = np.asarray(right._cols[k])
+        if a.dtype.kind in "OUS" or b.dtype.kind in "OUS":
+            a = _comparable_column(a.astype(object))
+            b = _comparable_column(b.astype(object))
+            width = max(a.dtype.itemsize, b.dtype.itemsize) // 4
+            dt = np.dtype(f"U{max(width, 1)}")
+            a, b = a.astype(dt), b.astype(dt)
+        else:
+            dt = np.promote_types(a.dtype, b.dtype)
+            a = _comparable_column(a.astype(dt))
+            b = _comparable_column(b.astype(dt))
+            dt = a.dtype
+        fields.append((f"f{i}", dt))
+        l_cols.append(a)
+        r_cols.append(b)
+    lrec = np.empty(len(left), dtype=fields)
+    rrec = np.empty(len(right), dtype=fields)
+    for (nm, _dt), a, b in zip(fields, l_cols, r_cols):
+        lrec[nm] = a
+        rrec[nm] = b
+    return lrec, rrec
 
 
 def _mask_fill(v, keep_mask: np.ndarray):

@@ -204,6 +204,31 @@ class _Parser:
                 left = _limit(left, int(self.next()))
         return left
 
+    def _join_key(self) -> str:
+        """One equi-join key: ``k`` | ``t.k`` | ``k = k`` | ``t1.k = t2.k``
+        (the two sides must share the column name)."""
+        k1 = self.ident()
+        if self.peek() == ".":
+            self.next()
+            k1 = self.ident()
+        if self.peek() in ("<", "<=", ">", ">=", "!=", "<>"):
+            # say it plainly instead of a downstream KeyError/trailing-token
+            raise ValueError(
+                f"ON supports equi-join conjuncts only (k = k); "
+                f"{k1!r} {self.peek()} ... is not an equi-join -- express "
+                "range conditions in WHERE"
+            )
+        if self.accept("="):
+            k2 = self.ident()
+            if self.peek() == ".":
+                self.next()
+                k2 = self.ident()
+            if k2 != k1:
+                raise ValueError(
+                    f"equi-join keys must share a name: {k1!r} != {k2!r}"
+                )
+        return k1
+
     def _order_list(self):
         """Parse ``c [ASC|DESC] [, c2 ...]`` after ORDER BY."""
         cols, asc = [], []
@@ -243,21 +268,14 @@ class _Parser:
                 break
             right = self._from_item()
             self.expect("ON")
-            k1 = self.ident()
-            if self.peek() == ".":
-                self.next()
-                k1 = self.ident()
-            key = k1
-            if self.accept("="):
-                k2 = self.ident()
-                if self.peek() == ".":
-                    self.next()
-                    k2 = self.ident()
-                if k2 != k1:
-                    raise ValueError(
-                        f"equi-join keys must share a name: {k1!r} != {k2!r}"
-                    )
-            node = _plan.Join(node, right, on=key, how=how)
+            join_keys = [self._join_key()]
+            while self.accept("AND"):
+                join_keys.append(self._join_key())
+            node = _plan.Join(
+                node, right,
+                on=join_keys[0] if len(join_keys) == 1 else join_keys,
+                how=how,
+            )
 
         where_pred = None
         if self.accept("WHERE"):

@@ -114,11 +114,15 @@ class Project(Node):
 class Join(Node):
     left: Node
     right: Node
-    on: str
+    on: "str | List[str]"
     how: str = "inner"
 
     def children(self):
         return [self.left, self.right]
+
+    def keys(self) -> List[str]:
+        """The equi-join key list (``on`` normalized once, here)."""
+        return [self.on] if isinstance(self.on, str) else list(self.on)
 
     def _label(self):
         return f"Join(on={self.on}, how={self.how})"
@@ -178,9 +182,10 @@ def node_columns(node: Node) -> Optional[List[str]]:
             return None
         if node.how in ("semi", "anti"):
             return list(lc)
+        keys = node.keys()
         out = list(lc)
         for c in rc:
-            if c == node.on:
+            if c in keys:
                 continue
             out.append(c if c not in out else f"{c}_right")
         return out
@@ -368,7 +373,7 @@ def _prune_columns(node: Node, required: Optional[set]) -> Node:
             node.left = _prune_columns(node.left, None)
             node.right = _prune_columns(node.right, None)
             return node
-        req = set(required) | {node.on}
+        req = set(required) | set(node.keys())
         # a suffixed output column c_right requires right-side c -- AND the
         # left-side c must survive too: the _right suffix only exists while
         # the names collide, so pruning the left copy would silently rename

@@ -296,6 +296,87 @@ class TestMultiKeyGroupBy:
             assert abs(got[key] - val) < 1e-2, key
 
 
+class TestMultiKeyJoin:
+    def _frames(self):
+        ta = ColumnarFrame({
+            "a": np.asarray([1, 1, 2, 3], np.int32),
+            "b": np.asarray(["x", "y", "x", "z"], object),
+            "v": np.asarray([10.0, 20.0, 30.0, 40.0], np.float32),
+        })
+        tb = ColumnarFrame({
+            "a": np.asarray([1, 2, 2, 9], np.int32),
+            "b": np.asarray(["x", "x", "q", "z"], object),
+            "w": np.asarray([0.1, 0.2, 0.3, 0.4], np.float32),
+        })
+        return ta, tb
+
+    def test_inner_two_keys(self):
+        ta, tb = self._frames()
+        j = ta.join(tb, on=["a", "b"], how="inner")
+        assert sorted(j.collect()) == [
+            (1, "x", 10.0, pytest.approx(0.1)),
+            (2, "x", 30.0, pytest.approx(0.2)),
+        ]
+
+    def test_left_two_keys_fills(self):
+        ta, tb = self._frames()
+        j = ta.join(tb, on=["a", "b"], how="left")
+        rows = {(r[0], r[1]): r[3] for r in j.collect()}
+        assert rows[(1, "x")] == pytest.approx(0.1)
+        assert np.isnan(rows[(1, "y")]) and np.isnan(rows[(3, "z")])
+
+    def test_semi_anti_two_keys(self):
+        ta, tb = self._frames()
+        semi = ta.join(tb, on=["a", "b"], how="semi")
+        anti = ta.join(tb, on=["a", "b"], how="anti")
+        assert sorted((r[0], r[1]) for r in semi.collect()) == [
+            (1, "x"), (2, "x"),
+        ]
+        assert sorted((r[0], r[1]) for r in anti.collect()) == [
+            (1, "y"), (3, "z"),
+        ]
+
+    def test_full_two_keys_includes_right_misses(self):
+        ta, tb = self._frames()
+        j = ta.join(tb, on=["a", "b"], how="full")
+        keys = sorted((int(r[0]), r[1]) for r in j.collect())
+        assert (2, "q") in keys and (9, "z") in keys  # right-only rows
+
+    def test_sql_on_and_chain(self):
+        ta, tb = self._frames()
+        out = sql(
+            "SELECT a, b, v, w FROM ta JOIN tb ON a = a AND b = b "
+            "ORDER BY a", ta=ta, tb=tb,
+        )
+        assert [r[0] for r in out.collect()] == [1, 2]
+
+    def test_matches_pandas_merge(self):
+        import pandas as pd
+
+        rs = np.random.default_rng(5)
+        ta = ColumnarFrame({
+            "a": rs.integers(0, 6, 300).astype(np.int32),
+            "b": rs.integers(0, 4, 300).astype(np.int32),
+            "v": np.arange(300, dtype=np.float32),
+        })
+        tb = ColumnarFrame({
+            "a": rs.integers(0, 6, 200).astype(np.int32),
+            "b": rs.integers(0, 4, 200).astype(np.int32),
+            "w": np.arange(200, dtype=np.float32),
+        })
+        j = ta.join(tb, on=["a", "b"], how="inner")
+        pj = pd.merge(
+            pd.DataFrame(ta.to_dict()), pd.DataFrame(tb.to_dict()),
+            on=["a", "b"], how="inner",
+        )
+        assert len(j) == len(pj)
+        got = sorted(map(tuple, np.asarray(j.collect())))
+        exp = sorted(map(tuple, pj[["a", "b", "v", "w"]].itertuples(
+            index=False, name=None
+        )))
+        assert got == [tuple(map(float, t)) for t in exp]
+
+
 class TestMultiColumnOrderBy:
     def test_two_columns_mixed_direction(self):
         f = ColumnarFrame({
