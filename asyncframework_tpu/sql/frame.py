@@ -112,7 +112,7 @@ class ColumnarFrame:
         name: str,
         fn: str,
         arg: Optional[str] = None,
-        partition_by: Optional[str] = None,
+        partition_by: Union[str, List[str], None] = None,
         order_by: Optional[str] = None,
         ascending: bool = True,
         offset: int = 1,
@@ -120,7 +120,8 @@ class ColumnarFrame:
     ) -> "ColumnarFrame":
         """Add a window-function column (Spark ``Window.partitionBy(...)``
         analog): row_number/rank/dense_rank, lag/lead, and running or
-        whole-partition sum/mean/min/max/count.  See ``sql/window.py``."""
+        whole-partition sum/mean/min/max/count; ``partition_by`` may be a
+        list (multi-key partitions).  See ``sql/window.py``."""
         from asyncframework_tpu.sql.window import window_column
 
         out = dict(self._cols)
@@ -532,6 +533,80 @@ def _factorize_sorted(keys: np.ndarray):
     return uniques[order], remap[codes]
 
 
+def multikey_partition_codes(frame, keys) -> np.ndarray:
+    """Per-row partition codes for a multi-key grouping: EQUALITY only (no
+    dense re-coding, no per-group key values) -- the window PARTITION BY
+    need.  In the common case this is just the row-major combined integer;
+    the int64-overflow fallback re-codes through a record array."""
+    per_u = []
+    per_c = []
+    card_product = 1
+    for k in keys:
+        u, c = _factorize_sorted(np.asarray(frame[k]))
+        per_u.append(u)
+        per_c.append(c)
+        card_product *= max(len(u), 1)
+    if card_product < 2**62:
+        combined = None
+        for u, c in zip(per_u, per_c):
+            combined = c if combined is None else combined * len(u) + c
+        return combined
+    # overflow: wrapped codes from distinct tuples could collide and
+    # silently MERGE partitions -- re-code through a record array
+    rec = np.empty(len(per_c[0]), dtype=[
+        (f"f{i}", np.int64) for i in range(len(per_c))
+    ])
+    for i, c in enumerate(per_c):
+        rec[f"f{i}"] = c
+    _occ, codes = np.unique(rec, return_inverse=True)
+    return codes
+
+
+def multikey_group_codes(frame, keys):
+    """(codes, {key: per-group values}) for a multi-key grouping.
+
+    Factorize each key (sorted), combine the codes into one integer
+    (row-major over per-key cardinalities), and factorize THAT -- integer
+    work end-to-end, so string keys pay the hashtable once each, never a
+    tuple sort.  Group order is lexicographic over the key list, like
+    ``np.unique`` over a record array would give.
+    """
+    per_u = []
+    per_c = []
+    card_product = 1
+    for k in keys:
+        u, c = _factorize_sorted(np.asarray(frame[k]))
+        per_u.append(u)
+        per_c.append(c)
+        card_product *= max(len(u), 1)
+    if card_product < 2**62:
+        combined = None
+        for u, c in zip(per_u, per_c):
+            combined = c if combined is None else combined * len(u) + c
+        occupied, codes = np.unique(combined, return_inverse=True)
+        rem = occupied
+        key_cols = {}
+        for k, u in zip(reversed(keys), reversed(per_u)):
+            rem, idx = np.divmod(rem, len(u))
+            key_cols[k] = u[idx]
+    else:
+        # cardinality product would overflow int64 (wrapped codes from
+        # distinct tuples could collide and silently MERGE groups): sort
+        # the per-key code columns as one record array instead -- slower,
+        # never wrong
+        rec = np.empty(len(per_c[0]), dtype=[
+            (f"f{i}", np.int64) for i in range(len(per_c))
+        ])
+        for i, c in enumerate(per_c):
+            rec[f"f{i}"] = c
+        occ_rec, codes = np.unique(rec, return_inverse=True)
+        key_cols = {
+            k: u[occ_rec[f"f{i}"]]
+            for i, (k, u) in enumerate(zip(keys, per_u))
+        }
+    return codes, {k: key_cols[k] for k in keys}
+
+
 class GroupedFrame:
     """groupBy(...).agg(...): host hash coding + segment reductions.
 
@@ -552,50 +627,9 @@ class GroupedFrame:
             self._uniques, self._codes = _factorize_sorted(keys)
             self._key_columns = {self._keys[0]: self._uniques}
         else:
-            # multi-key: factorize each key (sorted), combine the codes
-            # into one integer (row-major over per-key cardinalities), and
-            # factorize THAT -- integer work end-to-end, so string keys
-            # pay the hashtable once each, never a tuple sort.  Group
-            # order is lexicographic over the key list, like np.unique
-            # over a record array would give.
-            per_u = []
-            per_c = []
-            card_product = 1
-            for k in self._keys:
-                u, c = _factorize_sorted(np.asarray(frame[k]))
-                per_u.append(u)
-                per_c.append(c)
-                card_product *= max(len(u), 1)
-            if card_product < 2**62:
-                combined = None
-                for u, c in zip(per_u, per_c):
-                    combined = c if combined is None else (
-                        combined * len(u) + c
-                    )
-                occupied, self._codes = np.unique(
-                    combined, return_inverse=True
-                )
-                rem = occupied
-                key_cols = {}
-                for k, u in zip(reversed(self._keys), reversed(per_u)):
-                    rem, idx = np.divmod(rem, len(u))
-                    key_cols[k] = u[idx]
-            else:
-                # cardinality product would overflow int64 (wrapped codes
-                # from distinct tuples could collide and silently MERGE
-                # groups): sort the per-key code columns as one record
-                # array instead -- slower, never wrong
-                rec = np.empty(len(per_c[0]), dtype=[
-                    (f"f{i}", np.int64) for i in range(len(per_c))
-                ])
-                for i, c in enumerate(per_c):
-                    rec[f"f{i}"] = c
-                occ_rec, self._codes = np.unique(rec, return_inverse=True)
-                key_cols = {
-                    k: u[occ_rec[f"f{i}"]]
-                    for i, (k, u) in enumerate(zip(self._keys, per_u))
-                }
-            self._key_columns = {k: key_cols[k] for k in self._keys}
+            self._codes, self._key_columns = multikey_group_codes(
+                frame, self._keys
+            )
             self._uniques = self._key_columns[self._keys[0]]
 
     def _host_agg(self, v: np.ndarray, fn: str, n_seg: int):

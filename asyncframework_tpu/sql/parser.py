@@ -747,7 +747,11 @@ class _Parser:
         ascending = True
         if self.accept("PARTITION"):
             self.expect("BY")
-            partition_by = self.ident()
+            partition_by = [self.ident()]
+            while self.accept(","):
+                partition_by.append(self.ident())
+            if len(partition_by) == 1:
+                partition_by = partition_by[0]
         if self.accept("ORDER"):
             self.expect("BY")
             order_by = self.ident()
@@ -908,7 +912,9 @@ def _required_source_columns(items, group_key, order_by):
                 names |= set(arg.refs)
         elif kind == "window":
             _wfn, warg, _off, (pby, oby, _asc), _out = it
-            names |= {c for c in (warg, pby, oby) if c}
+            names |= {c for c in (warg, oby) if c}
+            if pby:
+                names.update([pby] if isinstance(pby, str) else pby)
         else:
             e, _out = it
             if e.refs is None:

@@ -33,7 +33,7 @@ def window_column(
     frame,
     fn: str,
     arg: Optional[str],
-    partition_by: Optional[str],
+    partition_by: "str | list | None",
     order_by: Optional[str],
     ascending: bool = True,
     offset: int = 1,
@@ -57,11 +57,16 @@ def window_column(
         if fn in _RANKING or fn == "count":
             return np.empty(0, np.int64)
         return np.empty(0, np.float64)
-    part = (
-        np.asarray(frame[partition_by])
-        if partition_by is not None
-        else np.zeros(n, np.int8)
-    )
+    if partition_by is None:
+        part = np.zeros(n, np.int8)
+    elif isinstance(partition_by, str):
+        part = np.asarray(frame[partition_by])
+    else:
+        # multi-key PARTITION BY: one combined code per row -- equality
+        # only, no dense re-coding (the groupby path's extra work)
+        from asyncframework_tpu.sql.frame import multikey_partition_codes
+
+        part = multikey_partition_codes(frame, list(partition_by))
     okey = np.asarray(frame[order_by]) if order_by is not None else None
 
     # contiguous partitions; stable within-partition order

@@ -377,6 +377,39 @@ class TestMultiKeyJoin:
         assert got == [tuple(map(float, t)) for t in exp]
 
 
+class TestMultiKeyWindowPartition:
+    def test_row_number_over_two_keys(self):
+        f = ColumnarFrame({
+            "a": np.asarray([1, 1, 1, 2, 2], np.int32),
+            "b": np.asarray(["x", "x", "y", "x", "x"], object),
+            "v": np.asarray([5.0, 3.0, 9.0, 2.0, 7.0], np.float32),
+        })
+        out = sql(
+            "SELECT a, b, v, ROW_NUMBER() OVER "
+            "(PARTITION BY a, b ORDER BY v) AS rn FROM t", t=f,
+        )
+        got = {(r[0], r[1], r[2]): r[3] for r in out.collect()}
+        assert got[(1, "x", 3.0)] == 1 and got[(1, "x", 5.0)] == 2
+        assert got[(1, "y", 9.0)] == 1
+        assert got[(2, "x", 2.0)] == 1 and got[(2, "x", 7.0)] == 2
+
+    def test_sum_over_two_key_partition_matches_pandas(self):
+        import pandas as pd
+
+        rs = np.random.default_rng(4)
+        a = rs.integers(0, 5, 400).astype(np.int32)
+        b = rs.integers(0, 3, 400).astype(np.int32)
+        v = rs.normal(size=400).astype(np.float32)
+        f = ColumnarFrame({"a": a, "b": b, "v": v})
+        out = f.with_window("s", "sum", "v", partition_by=["a", "b"])
+        exp = pd.DataFrame({"a": a, "b": b, "v": v}).groupby(
+            ["a", "b"]
+        )["v"].transform("sum")
+        np.testing.assert_allclose(
+            np.asarray(out["s"]), exp.values, rtol=1e-4
+        )
+
+
 class TestMultiColumnOrderBy:
     def test_two_columns_mixed_direction(self):
         f = ColumnarFrame({
