@@ -16,8 +16,10 @@ Driver names accept both the reference class names (``SparkASGDThread``,
 ``SparkASGDSync``, ``SparkASAGAThread``, ``SparkASAGASync``,
 ``SparkSGDMLLIB``) and short forms (``asgd``, ``asgd-sync``, ``asaga``,
 ``asaga-sync``, ``sgd-mllib``), plus the device-resident fast paths
-``asgd-fused`` / ``asaga-fused`` (taw=inf recipes fused into on-device
-scan rounds; single-process, no runtime flags -- see ``ASGD.run_fused``).  ``--conf key=value`` overlays any registered
+``asgd-fused`` / ``asaga-fused`` (recipes whose tau filter provably never
+fires, fused into on-device scan rounds -- asgd: taw >= numPart-1; asaga:
+taw >= numIter; single-process, no runtime flags -- see
+``ASGD.run_fused``).  ``--conf key=value`` overlays any registered
 :class:`~asyncframework_tpu.conf.ConfigEntry` (CLI > conf file > env >
 default precedence, like ``spark-submit --conf``).
 
@@ -288,20 +290,7 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
             "--stale-read applies to the async engine drivers only"
         )
     if fused:
-        # fail BEFORE the (possibly large) dataset is loaded onto device,
-        # and as a clean usage error -- run_fused's own guards would
-        # surface as tracebacks after the load
-        if args.taw < 2**31 - 1:
-            raise SystemExit(
-                "fused drivers are the taw=inf fast path (the reference's "
-                "headline recipes); finite taw needs the engine's tau "
-                "filter -- use asgd/asaga"
-            )
-        if args.coeff != 0.0:
-            raise SystemExit(
-                "fused drivers cannot inject stragglers (no host between "
-                "updates); use asgd/asaga"
-            )
+        # flag guards use raw args (overlays cannot change flags)
         if driver.startswith("asaga") and getattr(args, "sparse", False):
             raise SystemExit(
                 "fused ASAGA covers dense shards; sparse ASAGA runs the "
@@ -344,6 +333,32 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
     for key, field in CONF_TO_FIELD.items():
         if conf.contains(key):
             setattr(cfg, field, conf.get(key))
+
+    if fused:
+        # numeric guards run AFTER the overlays (a --conf async.taw /
+        # async.num.workers rewrite must be what is judged) and BEFORE the
+        # (possibly large) dataset loads -- run_fused's own checks would
+        # surface as tracebacks after the load.  Thresholds differ by
+        # family: ASGD's staleness filter is wave-bounded (taw >= nw-1
+        # never fires); ASAGA's quirk binds on iteration count (taw >=
+        # num_iterations never fires) -- see each solver's run_fused.
+        if driver.startswith("asgd") and cfg.taw < cfg.num_workers - 1:
+            raise SystemExit(
+                "asgd-fused admits taw >= num_workers-1 (its wave "
+                "staleness never exceeds that); a tighter taw needs the "
+                "engine's tau filter -- use asgd"
+            )
+        if driver.startswith("asaga") and cfg.taw < cfg.num_iterations:
+            raise SystemExit(
+                "asaga-fused requires taw >= num_iterations (the ASAGA "
+                "filter quirk binds on iteration count); a tighter taw "
+                "needs the engine -- use asaga"
+            )
+        if cfg.coeff != 0.0:
+            raise SystemExit(
+                "fused drivers cannot inject stragglers (no host between "
+                "updates); use asgd/asaga"
+            )
 
     X, y = load_data(args, cfg, devices, need_host=(driver == "sgd-mllib"))
     t0 = time.monotonic()

@@ -411,10 +411,19 @@ class ASAGA(FlopsAccountingMixin):
         scan carry, so the whole table stays in HBM across rounds."""
         cfg = self.cfg
         nw = cfg.num_workers
-        if cfg.taw < 2**31 - 1:
+        if cfg.taw < cfg.num_iterations:
+            # ASAGA's preserved acceptance quirk fires on the ITERATION
+            # COUNT, not staleness: accept iff k - staleness <= taw
+            # (SparkASAGAThread.scala:184; the updater at run()). A finite
+            # taw therefore changes which of the k = 0..num_iterations-1
+            # updates the engine accepts, and only taw >= num_iterations
+            # guarantees the filter never fires -- unlike ASGD, whose
+            # staleness-based filter is bounded by the wave (nw-1).
             raise ValueError(
-                "run_fused is the taw=inf fast path; finite taw needs the "
-                "engine's filter -- use run()"
+                "fused ASAGA requires taw >= num_iterations (the ASAGA "
+                "filter quirk `k - staleness <= taw` binds on iteration "
+                "count); a tighter taw needs the engine's filter -- use "
+                "run()"
             )
         if cfg.coeff != 0.0:
             raise ValueError(

@@ -63,6 +63,19 @@ class TestFusedASGD:
             ASGD(planted, None, make_cfg(coeff=1.0),
                  devices=[devices8[0]]).run_fused()
 
+    def test_finite_taw_admitted_when_filter_cannot_fire(
+        self, devices8, planted
+    ):
+        """ASGD taw=64 >= nw-1=7: the fused wave's staleness never exceeds
+        nw-1, so it is a valid bounded-staleness execution -- and it lands
+        in the engine band for the same recipe."""
+        cfg = make_cfg(taw=64, num_iterations=240)
+        fused = ASGD(planted, None, cfg, devices=[devices8[0]]).run_fused()
+        engine = ASGD(planted, None, cfg, devices=[devices8[0]]).run()
+        assert fused.accepted >= 240
+        f_last = fused.trajectory[-1][1]
+        assert f_last < max(engine.trajectory[-1][1] * 3.0, 1e-8)
+
     def test_sparse_fused_matches_engine_band(self, devices8):
         """rcv1-class shards fuse too -- the dataset whose per-update host
         floor made its baseline unreachable through the engine loop.  Same
@@ -128,8 +141,12 @@ class TestFusedASAGA:
     def test_guards(self, devices8, planted):
         from asyncframework_tpu.solvers import ASAGA
 
-        with pytest.raises(ValueError, match="taw"):
-            ASAGA(planted, None, make_cfg(gamma=0.35, taw=1),
+        # ASAGA's filter quirk binds on ITERATION COUNT (k - staleness <=
+        # taw), so even a taw far above nw-1 is rejected when it is below
+        # num_iterations -- the engine would drop updates past k ~ taw
+        with pytest.raises(ValueError, match="num_iterations"):
+            ASAGA(planted, None,
+                  make_cfg(gamma=0.35, taw=64, num_iterations=320),
                   devices=[devices8[0]]).run_fused()
         with pytest.raises(ValueError, match="straggler"):
             ASAGA(planted, None, make_cfg(gamma=0.35, coeff=2.0),
