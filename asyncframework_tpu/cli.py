@@ -291,11 +291,6 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         )
     if fused:
         # flag guards use raw args (overlays cannot change flags)
-        if driver.startswith("asaga") and getattr(args, "sparse", False):
-            raise SystemExit(
-                "fused ASAGA covers dense shards; sparse ASAGA runs the "
-                "engine path (asaga)"
-            )
         for flag, name in (
             (args.speculation, "--speculation"),
             (args.dynamic_allocation, "--dynamic-allocation"),

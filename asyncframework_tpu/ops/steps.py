@@ -296,6 +296,38 @@ def make_sparse_asgd_worker_step(batch_rate: float, d: int):
     return step
 
 
+def _sparse_saga_compacted(cols, vals, y, w, alpha, sub, batch_rate,
+                           grad_sum):
+    """Shared core of the compacted sparse ASAGA worker computation
+    (sampling, gather, candidate scalars, history-corrected gradient).
+    ONE definition, used by the engine worker step AND the fused rounds --
+    the fused path's sampling-parity claim depends on these staying
+    bit-identical (same discipline as :func:`_sparse_compacted_gradient`).
+    """
+    n_rows = y.shape[0]  # static at trace time
+    cap = sparse_step_capacity(batch_rate, n_rows)
+    mask = jax.random.bernoulli(sub, batch_rate, (n_rows,))
+    (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
+    valid = (jnp.arange(cap) < jnp.sum(mask)).astype(vals.dtype)
+    c_sel = cols[idx]
+    v_sel = vals[idx] * valid[:, None]  # unfilled slots contribute 0
+    diff_sel = jnp.sum(v_sel * w[c_sel], axis=1) - y[idx] * valid
+    g = grad_sum(c_sel, v_sel, diff_sel - alpha[idx])
+    return g, diff_sel, idx, valid, c_sel, v_sel
+
+
+def _sparse_saga_commit_expr(alpha, diff_sel, idx, valid):
+    """The ScalarMap commit as a traceable expression (shared by the
+    jitted engine commit and the fused scan): ``alpha[idx_j] <- diff_sel_j``
+    for valid slots; padding slots scatter OUT OF BOUNDS and drop --
+    routing them anywhere real would race a valid write at the same index.
+    ``idx`` is ascending (``jnp.nonzero`` order) with padding at the tail,
+    so the scatter runs with ``indices_are_sorted``."""
+    n = alpha.shape[0]
+    tgt = jnp.where(valid > 0, idx, n)
+    return alpha.at[tgt].set(diff_sel, indices_are_sorted=True, mode="drop")
+
+
 def make_sparse_saga_worker_step(batch_rate: float, d: int):
     """jit (cols, vals, y, w, alpha, key) ->
     (g, diff_sel, idx, valid, c_sel, v_sel, new_key) -- COMPACTED.
@@ -314,39 +346,22 @@ def make_sparse_saga_worker_step(batch_rate: float, d: int):
 
     @jax.jit
     def step(cols, vals, y, w, alpha, key):
-        n_rows = y.shape[0]  # static at trace time
-        cap = sparse_step_capacity(batch_rate, n_rows)
         key, sub = jax.random.split(key)
-        mask = jax.random.bernoulli(sub, batch_rate, (n_rows,))
-        (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
-        valid = (jnp.arange(cap) < jnp.sum(mask)).astype(vals.dtype)
-        c_sel = cols[idx]
-        v_sel = vals[idx] * valid[:, None]  # unfilled slots contribute 0
-        diff_sel = jnp.sum(v_sel * w[c_sel], axis=1) - y[idx] * valid
-        g = grad_sum(c_sel, v_sel, diff_sel - alpha[idx])
+        g, diff_sel, idx, valid, c_sel, v_sel = _sparse_saga_compacted(
+            cols, vals, y, w, alpha, sub, batch_rate, grad_sum
+        )
         return g, diff_sel, idx, valid, c_sel, v_sel, key
 
     return step
 
 
 def make_sparse_saga_commit():
-    """jit (alpha, diff_sel, idx, valid) -> alpha'.
-
-    Commit the accepted candidate scalars into the worker's history slice:
-    ``alpha[idx_j] <- diff_sel_j`` for valid slots.  Invalid (padding)
-    slots scatter OUT OF BOUNDS and are dropped -- routing them anywhere
-    real would race a valid write at the same index.  ``idx`` is ascending
-    (``jnp.nonzero`` order) with padding at the tail, so the scatter runs
-    with ``indices_are_sorted``.
-    """
+    """jit (alpha, diff_sel, idx, valid) -> alpha'; see
+    :func:`_sparse_saga_commit_expr` for the semantics."""
 
     @jax.jit
     def commit(alpha, diff_sel, idx, valid):
-        n = alpha.shape[0]
-        tgt = jnp.where(valid > 0, idx, n)
-        return alpha.at[tgt].set(
-            diff_sel, indices_are_sorted=True, mode="drop"
-        )
+        return _sparse_saga_commit_expr(alpha, diff_sel, idx, valid)
 
     return commit
 
@@ -489,6 +504,7 @@ def make_fused_saga_rounds(
     n: int,
     shards,
     rounds_per_call: int = 16,
+    sparse_d: "int | None" = None,
 ):
     """jit (w, ab, alphas, keys) -> (w', ab', alphas', keys', W_snap) --
     R full ASAGA cohort rounds fused on one device (the ASAGA face of the
@@ -505,16 +521,44 @@ def make_fused_saga_rounds(
     carries one result per worker, so the alpha a gradient was computed
     against IS the alpha at commit.  Least-squares only (the scalar
     history compression requires it, like the solver).
+
+    ``sparse_d``: padded-ELL shards as (cols, vals, y) tuples -- the
+    worker computation mirrors the engine's compacted sparse SAGA step
+    (sampled rows gathered; candidate scalars committed by a scatter
+    whose padding slots drop out of bounds; see
+    make_sparse_saga_worker_step / make_sparse_saga_commit).
     """
     nw = len(shards)
     par_recs = batch_rate * n / nw
+    sp_grad_sum = None
+    if sparse_d is not None:
+        from asyncframework_tpu.ops.gradients import make_sparse_grad_sum
+
+        sp_grad_sum = make_sparse_grad_sum(sparse_d)
+
+    def one_sparse(shard, w, alpha, key):
+        # the SAME compacted core + commit the engine worker step runs
+        cols, vals, y = shard
+        key, sub = jax.random.split(key)
+        g, diff_sel, idx, valid, _c, _v = _sparse_saga_compacted(
+            cols, vals, y, w, alpha, sub, batch_rate, sp_grad_sum
+        )
+        alpha2 = _sparse_saga_commit_expr(alpha, diff_sel, idx, valid)
+        return g, alpha2, key
 
     def round_fn(carry, _x):
         w, ab, alphas, keys = carry
         gs = []
         new_alphas = []
         new_keys = []
-        for i, (X, y) in enumerate(shards):  # static unroll over workers
+        for i, shard in enumerate(shards):  # static unroll over workers
+            if sparse_d is not None:
+                g, a2, key = one_sparse(shard, w, alphas[i], keys[i])
+                gs.append(g)
+                new_alphas.append(a2)
+                new_keys.append(key)
+                continue
+            X, y = shard
             key, sub = jax.random.split(keys[i])
             mask = jax.random.bernoulli(
                 sub, batch_rate, (X.shape[0],)

@@ -405,10 +405,11 @@ class ASAGA(FlopsAccountingMixin):
 
     # ----------------------------------------------------------------- fused
     def run_fused(self) -> TrainResult:
-        """Device-resident ASAGA (the taw=inf fast path; semantics in
+        """Device-resident ASAGA (semantics in
         ``steps.make_fused_saga_rounds``, scope guards as in
-        ``ASGD.run_fused``).  Dense shards; the history slices live as
-        scan carry, so the whole table stays in HBM across rounds."""
+        ``ASGD.run_fused`` plus the ASAGA taw quirk below).  Dense and
+        padded-ELL sparse shards; the history slices live as scan carry,
+        so the whole table stays in HBM across rounds."""
         cfg = self.cfg
         nw = cfg.num_workers
         if cfg.taw < cfg.num_iterations:
@@ -430,26 +431,25 @@ class ASAGA(FlopsAccountingMixin):
                 "run_fused cannot inject stragglers (no host between "
                 "updates); use run()"
             )
-        if self._sparse:
-            raise ValueError(
-                "fused ASAGA currently covers dense shards (sparse keeps "
-                "the engine path)"
-            )
         d = self.ds.d
         drv = self.driver_device
         shards = []
         for wid in range(nw):
             shard = self._recovery.shard(wid)
-            X, y = shard.X, shard.y
-            if X.device != drv:
-                X, y = jax.device_put(X, drv), jax.device_put(y, drv)
-            shards.append((X, y))
+            if self._sparse:
+                parts = (shard.cols, shard.vals, shard.y)
+            else:
+                parts = (shard.X, shard.y)
+            if parts[0].device != drv:
+                parts = tuple(jax.device_put(a, drv) for a in parts)
+            shards.append(parts)
+        sparse_d = d if self._sparse else None
         total_rounds = max(1, -(-cfg.num_iterations // nw))
 
         def make_runner(length):
             rr = steps.make_fused_saga_rounds(
                 cfg.gamma, cfg.batch_rate, self.ds.n, shards,
-                rounds_per_call=length,
+                rounds_per_call=length, sparse_d=sparse_d,
             )
 
             def run(carry):
@@ -462,8 +462,10 @@ class ASAGA(FlopsAccountingMixin):
         w = jax.device_put(jnp.zeros(d, jnp.float32), drv)
         ab = jax.device_put(jnp.zeros(d, jnp.float32), drv)
         alphas = tuple(
-            jax.device_put(jnp.zeros(X.shape[0], jnp.float32), drv)
-            for (X, _y) in shards
+            jax.device_put(
+                jnp.zeros(parts[-1].shape[0], jnp.float32), drv
+            )
+            for parts in shards
         )
         keys = jax.device_put(jnp.stack([
             jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid)

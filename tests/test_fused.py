@@ -138,6 +138,39 @@ class TestFusedASAGA:
         assert any(np.any(a != 0) for a in fused.extras["alpha"].values())
         np.testing.assert_allclose(ab, acc, rtol=2e-3, atol=2e-5)
 
+    def test_sparse_fused_asaga_matches_engine_band(self, devices8):
+        """The last cell of the fused matrix: sparse ASAGA.  Same
+        engine-band parity contract; the in-scan commit mirrors the
+        engine's compacted scatter (padding slots dropped)."""
+        from asyncframework_tpu.data.sparse import SparseShardedDataset
+        from asyncframework_tpu.solvers import ASAGA
+
+        ds = SparseShardedDataset.generate_on_device(
+            4096, 512, 12, 8, devices=[devices8[0]] * 8, seed=9, noise=0.01
+        )
+        cfg = make_cfg(gamma=1.5, num_iterations=400)
+        fused = ASAGA(ds, None, cfg, devices=[devices8[0]]).run_fused()
+        engine = ASAGA(ds, None, cfg, devices=[devices8[0]]).run()
+        f_first, f_last = fused.trajectory[0][1], fused.trajectory[-1][1]
+        e_last = engine.trajectory[-1][1]
+        assert f_last < f_first * 0.1, fused.trajectory[-3:]
+        assert f_last < max(e_last * 3.0, 1e-8), (f_last, e_last)
+        # THE invariant, sparse form: alpha_bar == (1/N) sum_i A_i^T
+        # alpha_i with A_i densified from the padded-ELL shard -- a dead
+        # or wrong in-scan commit fails this
+        ab = fused.extras["alpha_bar"]
+        acc = np.zeros_like(ab, dtype=np.float64)
+        for wid, a in fused.extras["alpha"].items():
+            shard = ds.shard(wid)
+            cols = np.asarray(shard.cols)
+            vals = np.asarray(shard.vals)
+            # np.add.at: fancy += would drop duplicate columns within a
+            # row (a real col-0 feature collides with padding zeros)
+            np.add.at(acc, cols.ravel(), (vals * a[:, None]).ravel())
+        acc /= ds.n
+        assert any(np.any(a != 0) for a in fused.extras["alpha"].values())
+        np.testing.assert_allclose(ab, acc, rtol=5e-3, atol=5e-5)
+
     def test_guards(self, devices8, planted):
         from asyncframework_tpu.solvers import ASAGA
 
