@@ -77,6 +77,12 @@ _WINDOW_ONLY_FNS = {
 _SET_OPS = {"UNION", "EXCEPT", "INTERSECT"}
 
 
+class _NotPlannable(Exception):
+    """Internal: this SELECT shape needs the eager lowering (HAVING label
+    bridges, ORDER BY borrowing unprojected source columns, ...).  The
+    parser rewinds and re-parses eagerly; never escapes the parser."""
+
+
 def tokenize(text: str) -> List[str]:
     out: List[str] = []
     pos = 0
@@ -151,31 +157,54 @@ class _Parser:
 
     # ------------------------------------------------------------ statements
     def statement(self) -> ColumnarFrame:
-        """[WITH ...] set-expression -- the top-level entry."""
+        """[WITH ...] set-expression -- the top-level entry.  Builds the
+        full logical plan (CTEs as execute-once Shared nodes, derived
+        tables lazy), optimizes, executes."""
+        node = self.statement_plan()
+        node = _plan.optimize(node, None)
+        return _plan.execute(node)
+
+    def statement_plan(self) -> "_plan.Node":
         if self.accept("WITH"):
             while True:
                 name = self.ident()
                 self.expect("AS")
                 self.expect("(")
-                sub = self._nested_statement()  # sees earlier CTEs
+                sub = self._nested_statement_plan()  # sees earlier CTEs
                 self.expect(")")
-                self.local_tables[name.lower()] = sub
+                # every FROM reference shares this instance: the body
+                # executes at most once per statement (InlineCTE's
+                # with-materialization side; single-use bodies inline in
+                # plan.optimize so rewrites cross them)
+                self.local_tables[name.lower()] = _plan.Shared(
+                    sub, name=name.lower()
+                )
                 if not self.accept(","):
                     break
-        return self.set_expr()
+        return self.set_expr_plan()
 
-    def _nested_statement(self) -> ColumnarFrame:
+    def _nested_statement_plan(self) -> "_plan.Node":
         """A statement inside a subquery/CTE body/derived table: its own
         WITH names are SCOPED to it -- they must neither leak into nor
         shadow the enclosing query's CTEs after it closes."""
         saved = dict(self.local_tables)
         try:
-            return self.statement()
+            return self.statement_plan()
         finally:
             self.local_tables = saved
 
-    def set_expr(self) -> ColumnarFrame:
-        left = self.select_core()
+    def _nested_statement(self) -> ColumnarFrame:
+        """A statement in VALUE position (IN (...) / scalar subquery):
+        plan, optimize, execute now -- its result folds into the enclosing
+        expression as data.  Shared CTE boundaries stay intact
+        (inline_shared=False) so executing one here populates the
+        statement-wide cache instead of running a private inlined copy."""
+        node = self._nested_statement_plan()
+        node = _plan.optimize(node, None, inline_shared=False)
+        return _plan.execute(node)
+
+    def set_expr_plan(self) -> "_plan.Node":
+        left = self._select_plan()
         seen_set_op = False
         while self.peek_upper() in _SET_OPS:
             seen_set_op = True
@@ -183,26 +212,40 @@ class _Parser:
             keep_all = op == "UNION" and self.accept("ALL")
             # a set-op operand may not consume ORDER BY/LIMIT: a trailing
             # ORDER BY applies to the WHOLE set expression (standard SQL)
-            right = self.select_core(consume_order=False)
-            if op == "UNION":
-                left = left.union_all(right) if keep_all else left.union(right)
-            elif op == "EXCEPT":
-                left = left.except_rows(right)
-            else:
-                left = left.intersect_rows(right)
+            right = self._select_plan(consume_order=False)
+            opname = ("union_all" if keep_all else
+                      "union" if op == "UNION" else
+                      "except" if op == "EXCEPT" else "intersect")
+            left = _plan.SetOp(left, right, op=opname)
         if seen_set_op:
             if self.accept("ORDER"):
                 self.expect("BY")
                 by, asc = self._order_list()
-                missing = [c for c in by if c not in left.columns]
-                if missing:
-                    raise ValueError(
-                        f"ORDER BY {missing[0]!r}: not a result column"
-                    )
-                left = left.sort(by, ascending=asc)
+                cols = _plan.node_columns(left)
+                if cols is not None:
+                    missing = [c for c in by if c not in cols]
+                    if missing:
+                        raise ValueError(
+                            f"ORDER BY {missing[0]!r}: not a result column"
+                        )
+                left = _plan.Sort(left, by, asc)
             if self.accept("LIMIT"):
-                left = _limit(left, int(self.next()))
+                left = _plan.Limit(left, int(self.next()))
         return left
+
+    def _select_plan(self, consume_order: bool = True) -> "_plan.Node":
+        """One select-core as a plan node; falls back to the eager lowering
+        (rewinding the token stream) for the shapes the plan builder
+        declines."""
+        start = self.i
+        saved_locals = dict(self.local_tables)
+        try:
+            return self._try_select_plan(consume_order)
+        except _NotPlannable:
+            self.i = start
+            self.local_tables = saved_locals
+            frame = self._select_eager(consume_order)
+            return _plan.Scan("(eager)", frame=frame)
 
     def _join_key(self) -> str:
         """One equi-join key: ``k`` | ``t.k`` | ``k = k`` | ``t1.k = t2.k``
@@ -242,19 +285,16 @@ class _Parser:
             if not self.accept(","):
                 return cols, asc
 
-    def select_core(self, consume_order: bool = True) -> ColumnarFrame:
-        if self.peek() == "(":
-            self.next()
-            f = self._nested_statement()
-            self.expect(")")
-            return f
+    def _parse_select_clauses(self, consume_order: bool = True) -> dict:
+        """The select-core clause grammar, shared by the plan builder and
+        the eager fallback (ONE definition: the fallback re-parses the same
+        language).  Starts at SELECT; the FROM/JOIN/WHERE core arrives as a
+        plan node."""
         self.expect("SELECT")
         distinct = self.accept("DISTINCT")
         items = self.select_items()
         self.expect("FROM")
         node = self._from_item()
-
-        # joins (plan nodes: the optimizer decides where filters execute)
         while True:
             how = "inner"
             if self.peek_upper() in ("INNER", "LEFT", "RIGHT", "FULL",
@@ -276,12 +316,8 @@ class _Parser:
                 on=join_keys[0] if len(join_keys) == 1 else join_keys,
                 how=how,
             )
-
-        where_pred = None
         if self.accept("WHERE"):
-            where_pred = self.expr()
-            node = _plan.Filter(node, where_pred)
-
+            node = _plan.Filter(node, self.expr())
         group_key = None
         having = None
         if self.accept("GROUP"):
@@ -296,16 +332,183 @@ class _Parser:
                 # references OUTPUT column names (the group key, aggregate
                 # labels like sum(v), or AS aliases)
                 having = self.expr()
-
-        order_by = None       # list of columns when present
-        ascending = True      # list of per-column flags when present
+        order_by = None
+        ascending = True
         if consume_order and self.accept("ORDER"):
             self.expect("BY")
             order_by, ascending = self._order_list()
-
         limit = None
         if consume_order and self.accept("LIMIT"):
             limit = int(self.next())
+        return dict(
+            node=node, items=items, distinct=distinct,
+            group_key=group_key, having=having,
+            order_by=order_by, ascending=ascending, limit=limit,
+        )
+
+    def _try_select_plan(self, consume_order: bool = True) -> "_plan.Node":
+        """Parse one select-core into a COMPLETE plan (projection, windows,
+        aggregation, HAVING, DISTINCT, ORDER BY, LIMIT all as nodes), so
+        the optimizer's rewrites cross every clause and derived tables stay
+        lazy.  Raises _NotPlannable for shapes only the eager path lowers."""
+        if self.peek() == "(":
+            self.next()
+            node = self._nested_statement_plan()
+            self.expect(")")
+            return node
+        c = self._parse_select_clauses(consume_order)
+        return self._build_select_plan(
+            c["node"], c["items"], c["distinct"], c["group_key"],
+            c["having"], c["order_by"], c["ascending"], c["limit"],
+        )
+
+    def _build_select_plan(self, node, items, distinct, group_key, having,
+                           order_by, ascending, limit) -> "_plan.Node":
+        aggs = [it for kind, it in items if kind == "agg"]
+        exprs = [it for kind, it in items if kind == "expr"]
+        has_star = any(kind == "star" for kind, _ in items)
+        windows = [it for kind, it in items if kind == "window"]
+
+        # pre-projection source sort (standard SQL: ORDER BY may reference
+        # an unprojected source column; projection preserves row order) --
+        # same precedence as the eager path
+        core_cols = _plan.node_columns(node)
+        if (
+            order_by is not None
+            and group_key is None
+            and not aggs
+        ):
+            if core_cols is None:
+                raise _NotPlannable("unknown core schema under ORDER BY")
+            if all(c in core_cols for c in order_by):
+                node = _plan.Sort(node, list(order_by), list(ascending))
+                order_by = None
+
+        if windows:
+            if group_key is not None or aggs:
+                raise ValueError(
+                    "window functions cannot mix with GROUP BY aggregates"
+                )
+            node = _plan.Window(node, list(windows))
+            if has_star:
+                extra = [(e, out) for (e, out, _bare) in exprs]
+                if extra:
+                    node = _plan.Compute(node, extra, star=True)
+            else:
+                plist = []
+                passthrough = set()
+                for kind, it in items:
+                    if kind == "expr":
+                        e, out, bare = it
+                        plist.append((e, out))
+                        if bare is not None and bare == out:
+                            passthrough.add(out)
+                    elif kind == "window":
+                        out = it[4]
+                        plist.append((col(out), out))
+                        passthrough.add(out)
+                node = _plan.Compute(node, plist, star=False,
+                                     passthrough=frozenset(passthrough))
+        elif group_key is not None:
+            if has_star:
+                raise ValueError(
+                    "SELECT * is not valid with GROUP BY; name the "
+                    "group key and aggregates explicitly"
+                )
+            keys = group_key if isinstance(group_key, list) else [group_key]
+            for _e, out, _bare in exprs:
+                if out not in keys:
+                    raise ValueError(
+                        "non-aggregate select item "
+                        f"{out!r} must be a GROUP BY key"
+                    )
+            node, spec = self._plan_agg_spec(node, aggs)
+            node = _plan.Aggregate(node, group_key, spec)
+            if having is not None:
+                out_cols = _plan.node_columns(node)
+                refs = getattr(having, "refs", None)
+                if (
+                    refs is None or out_cols is None
+                    or not set(refs) <= set(out_cols)
+                ):
+                    # references an aggregate by call-syntax label while the
+                    # SELECT aliased it: the eager path bridges the labels
+                    raise _NotPlannable("HAVING label bridge")
+                node = _plan.Filter(node, having)
+        elif aggs:
+            if exprs or has_star:
+                raise ValueError(
+                    "mixing aggregates and plain columns needs GROUP BY"
+                )
+            node, spec = self._plan_agg_spec(node, aggs)
+            node = _plan.Aggregate(node, None, spec)
+        else:
+            if has_star:
+                extra = [(e, out) for (e, out, _bare) in exprs]
+                if extra:
+                    node = _plan.Compute(node, extra, star=True)
+            else:
+                plist = [(e, out) for (e, out, _bare) in exprs]
+                passthrough = frozenset(
+                    out for (_e, out, bare) in exprs
+                    if bare is not None and bare == out
+                )
+                node = _plan.Compute(node, plist, star=False,
+                                     passthrough=passthrough)
+
+        if distinct:
+            node = _plan.Distinct(node)
+        if order_by is not None:
+            out_cols = _plan.node_columns(node)
+            if out_cols is None or not all(
+                c in out_cols for c in order_by
+            ):
+                # ORDER BY mixing output aliases with unprojected source
+                # columns: the eager path borrows them for the sort
+                raise _NotPlannable("ORDER BY outside result columns")
+            node = _plan.Sort(node, list(order_by), list(ascending))
+        if limit is not None:
+            node = _plan.Limit(node, limit)
+        return node
+
+    def _plan_agg_spec(self, node, aggs):
+        """Plan analog of ``_agg_spec``: Column-expression arguments
+        materialize as temp columns via a star Compute below the
+        Aggregate; COUNT(*) carries colname None, resolved at execution."""
+        spec = {}
+        temps = []
+        for i, (fn, arg, out) in enumerate(aggs):
+            if arg is None:
+                spec[out] = (None, fn)
+            elif isinstance(arg, Column):
+                tmp = f"__agg_{i}"
+                temps.append((arg, tmp))
+                spec[out] = (tmp, fn)
+            else:
+                spec[out] = (arg, fn)
+        if temps:
+            node = _plan.Compute(node, temps, star=True)
+        return node, spec
+
+    def _select_eager(self, consume_order: bool = True) -> ColumnarFrame:
+        """The eager lowering for shapes the plan builder declines (HAVING
+        label bridges, ORDER BY borrowing unprojected source columns).
+        Clause grammar is the SHARED ``_parse_select_clauses`` -- the
+        fallback parses the same language by construction."""
+        if self.peek() == "(":
+            self.next()
+            f = self._nested_statement()
+            self.expect(")")
+            return f
+        c = self._parse_select_clauses(consume_order)
+        node = c["node"]
+        items = c["items"]
+        distinct = c["distinct"]
+        group_key = c["group_key"]
+        having = c["having"]
+        order_by = c["order_by"]
+        ascending = c["ascending"]
+        limit = c["limit"]
 
         # rewrite the FROM/JOIN/WHERE core before executing: predicate
         # pushdown (through joins, into readers) + projection pruning
@@ -397,12 +600,14 @@ class _Parser:
         return frame
 
     def _from_item(self) -> "_plan.Node":
-        """table name | ( query ) [AS alias] -> a plan Scan node.  Derived
-        tables execute eagerly (their own statement already optimized);
-        registered lazy sources stay lazy so pushdown reaches the reader."""
+        """table name | ( query ) [AS alias] -> a plan node.  Derived
+        tables stay LAZY (their sub-plan joins the enclosing plan, so
+        pushdown/pruning cross the boundary); CTE references return the
+        statement's execute-once Shared node; registered lazy sources stay
+        lazy so pushdown reaches the reader."""
         if self.peek() == "(":
             self.next()
-            f = self._nested_statement()
+            sub = self._nested_statement_plan()
             self.expect(")")
             if self.accept("AS"):
                 self.ident()  # alias accepted; frames are flat, name unused
@@ -412,9 +617,11 @@ class _Parser:
                 and re.fullmatch(r"[A-Za-z_][A-Za-z_0-9]*", self.peek())
             ):
                 self.next()  # bare alias
-            return _plan.Scan("(subquery)", frame=f)
+            return sub
         name = self.ident()
         t = self._resolve_table(name)
+        if isinstance(t, _plan.Shared):
+            return t  # the SAME node every reference: body runs once
         if isinstance(t, LazyTable):
             return _plan.Scan(name, reader=t.reader, schema=t.schema)
         return _plan.Scan(name, frame=t)
@@ -645,7 +852,9 @@ class _Parser:
     # --------------------------------------------------------------- clauses
     def select_items(self) -> List[Tuple[str, Any]]:
         """[(kind, payload)]: ('star', None) | ('agg', (fn, colname, out))
-        | ('expr', (Column, out))."""
+        | ('expr', (Column, out, bare)) -- ``bare`` is the source column
+        name when the expression is a bare reference, else None
+        | ('window', (fn, arg, offset, spec, out))."""
         items: List[Tuple[str, Any]] = []
         while True:
             if self.peek() == "*":
@@ -726,14 +935,17 @@ class _Parser:
                 start = self.i
                 e = self.expr()
                 out = e.name
+                bare = None  # the SOURCE column name when e is a bare ref
                 # a bare column reference keeps its own name
                 if self.i == start + 1:
                     out = self.toks[start]
+                    bare = out
                 elif self.i == start + 3 and self.toks[start + 1] == ".":
                     out = self.toks[start + 2]
+                    bare = out
                 if self.accept("AS"):
                     out = self.ident()
-                items.append(("expr", (e, out)))
+                items.append(("expr", (e, out, bare)))
             if not self.accept(","):
                 return items
 
@@ -765,7 +977,7 @@ class _Parser:
     # ---------------------------------------------------------------- lowering
     def _project(self, frame, items, group_key):
         aggs = [it for kind, it in items if kind == "agg"]
-        exprs = [(e, name) for kind, (e, name) in (
+        exprs = [(e, name) for kind, (e, name, _bare) in (
             (k, v) for k, v in items if k == "expr"
         )]
         has_star = any(kind == "star" for kind, _ in items)
@@ -887,6 +1099,17 @@ class SQLContext:
             raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
         return frame
 
+    def explain(self, text: str) -> str:
+        """The OPTIMIZED logical plan for a statement, as text -- the
+        public plan-shape artifact (``Dataset.explain`` analog).  Value
+        subqueries (IN (...) / scalar) still execute during planning;
+        FROM-position relations do not."""
+        p = _Parser(tokenize(text), self)
+        node = p.statement_plan()
+        if p.peek() is not None:
+            raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
+        return _plan.optimize(node, None).explain()
+
 
 def aggs_present(items) -> bool:
     return any(kind == "agg" for kind, _ in items)
@@ -916,7 +1139,7 @@ def _required_source_columns(items, group_key, order_by):
             if pby:
                 names.update([pby] if isinstance(pby, str) else pby)
         else:
-            e, _out = it
+            e = it[0]
             if e.refs is None:
                 return None
             names |= set(e.refs)
@@ -957,7 +1180,7 @@ def _any_device_column(frame: ColumnarFrame) -> str:
 
 
 def _limit(frame: ColumnarFrame, n: int) -> ColumnarFrame:
-    return frame._take(np.arange(min(n, len(frame))))
+    return _plan.limit_frame(frame, n)
 
 
 def self_rest(p: _Parser) -> str:

@@ -23,10 +23,29 @@ for themselves here are the DATA-MOVEMENT rules:
 - **Join build-side selection** is an execution-time rule (``frame.join``
   sorts the smaller side); the plan records sizes when known.
 
-The plan is deliberately tiny: Scan / Filter / Project / Join / Aggregate
-over a tree, built by the SQL parser's FROM/JOIN/WHERE/GROUP BY core and
-executed straight onto ``ColumnarFrame`` ops after rewriting.  Plan shape is
-a public artifact (``explain()``) so tests assert rewrites structurally.
+Round 5 extends the plan PAST the FROM/JOIN/WHERE core (VERDICT r4 #3/#4):
+
+- **Compute / Window / Sort / Limit / Distinct / SetOp** nodes cover the
+  full SELECT shape, so pushdown and pruning cross projection, window
+  functions (predicates on PARTITION BY keys sink below the window),
+  UNION ALL (pruning and predicates reach both branches), ORDER BY and
+  DISTINCT -- the ``Optimizer.scala:38`` batches that rewrite whole
+  queries rather than just the join core.
+- **Join reordering** (``joins.scala:37`` ``ReorderJoin`` role): inner-join
+  chains re-order greedily by estimated size -- smallest relation first,
+  then the smallest relation connected by a join key -- so a badly written
+  3-table star query builds its indexes on the small sides.  Rebuilds are
+  guarded: unknown schemas, colliding non-key columns, or ``_right``
+  suffixes in the output keep the written order.
+- **Shared** is an execute-once CTE body (``CostBasedJoinReorder``'s
+  sibling concern ``InlineCTE``): every reference holds the SAME node, the
+  frame caches on first execution; single-use Shared nodes inline (as a
+  structural clone, so consumer-specific pruning never mutates the stored
+  body) and multi-use ones stay opaque boundaries.
+
+The plan remains a tree executed straight onto ``ColumnarFrame`` ops after
+rewriting.  Plan shape is a public artifact (``explain()``) so tests assert
+rewrites structurally.
 """
 
 from __future__ import annotations
@@ -130,16 +149,149 @@ class Join(Node):
 
 @dataclass
 class Aggregate(Node):
+    """GROUP BY (``key``: one name, a list, or None for whole-frame scalar
+    aggregates).  ``spec``: out name -> (column name | None for COUNT(*),
+    fn); built by the parser."""
+
     child: Node
-    key: str
-    # out name -> (column name, fn); built by the parser's _agg_spec
-    spec: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    key: "str | List[str] | None"
+    spec: Dict[str, Tuple[Optional[str], str]] = field(default_factory=dict)
+
+    def children(self):
+        return [self.child]
+
+    def group_keys(self) -> List[str]:
+        if self.key is None:
+            return []
+        return [self.key] if isinstance(self.key, str) else list(self.key)
+
+    def _label(self):
+        return f"Aggregate(key={self.key}, aggs={list(self.spec)})"
+
+
+@dataclass
+class Compute(Node):
+    """Projection with expressions (the SELECT list).  ``star`` keeps every
+    child column and appends non-colliding aliased expressions (the
+    parser's ``SELECT *, expr AS x`` contract).  ``passthrough`` names the
+    outputs that are bare same-named source columns -- predicates on them
+    may sink below."""
+
+    child: Node
+    exprs: List[Tuple[Column, str]] = field(default_factory=list)
+    star: bool = False
+    passthrough: frozenset = frozenset()
 
     def children(self):
         return [self.child]
 
     def _label(self):
-        return f"Aggregate(key={self.key}, aggs={list(self.spec)})"
+        outs = [o for _e, o in self.exprs]
+        return f"Compute({'*, ' if self.star else ''}{outs})"
+
+
+@dataclass
+class Window(Node):
+    """Window-function columns appended to the child.  ``items``:
+    [(fn, arg, offset, (partition_by, order_by, ascending), out)] -- the
+    parser's window payload verbatim."""
+
+    child: Node
+    items: List[Tuple] = field(default_factory=list)
+
+    def children(self):
+        return [self.child]
+
+    def partition_keys(self) -> Optional[set]:
+        """Intersection of every item's PARTITION BY key set; None when any
+        item is unpartitioned (nothing can sink below a global window)."""
+        acc: Optional[set] = None
+        for _fn, _arg, _off, (pby, _oby, _asc), _out in self.items:
+            if not pby:
+                return None
+            keys = {pby} if isinstance(pby, str) else set(pby)
+            acc = keys if acc is None else (acc & keys)
+        return acc
+
+    def outputs(self) -> List[str]:
+        return [it[4] for it in self.items]
+
+    def _label(self):
+        return f"Window({self.outputs()})"
+
+
+@dataclass
+class Sort(Node):
+    child: Node
+    by: List[str] = field(default_factory=list)
+    ascending: List[bool] = field(default_factory=list)
+
+    def children(self):
+        return [self.child]
+
+    def _label(self):
+        bits = [f"{c}{'' if a else ' DESC'}"
+                for c, a in zip(self.by, self.ascending)]
+        return f"Sort({bits})"
+
+
+@dataclass
+class Limit(Node):
+    child: Node
+    n: int = 0
+
+    def children(self):
+        return [self.child]
+
+    def _label(self):
+        return f"Limit({self.n})"
+
+
+@dataclass
+class Distinct(Node):
+    child: Node
+
+    def children(self):
+        return [self.child]
+
+    def _label(self):
+        return "Distinct"
+
+
+@dataclass
+class SetOp(Node):
+    """union | union_all | except | intersect.  Output columns are the left
+    side's (``union_all`` matches by name, Spark unionByName)."""
+
+    left: Node
+    right: Node
+    op: str = "union_all"
+
+    def children(self):
+        return [self.left, self.right]
+
+    def _label(self):
+        return f"SetOp({self.op})"
+
+
+@dataclass
+class Shared(Node):
+    """Execute-once CTE body: every FROM reference holds the SAME instance
+    and the frame caches on first execution.  Multi-referenced Shared nodes
+    are opaque to consumer-specific rewrites (pruning); single-use ones are
+    inlined as clones by ``optimize``."""
+
+    child: Node
+    name: str = "cte"
+    _cache: Optional[ColumnarFrame] = field(
+        default=None, repr=False, compare=False
+    )
+
+    def children(self):
+        return [self.child]
+
+    def _label(self):
+        return f"Shared({self.name})"
 
 
 # --------------------------------------------------------------- utilities
@@ -169,12 +321,35 @@ def node_columns(node: Node) -> Optional[List[str]]:
     """Output columns of a plan node, None when unknown (opaque source)."""
     if isinstance(node, Scan):
         return node.columns()
-    if isinstance(node, Filter):
+    if isinstance(node, (Filter, Limit, Distinct)):
+        return node_columns(node.child)
+    if isinstance(node, Sort):
+        return node_columns(node.child)
+    if isinstance(node, Shared):
         return node_columns(node.child)
     if isinstance(node, Project):
         return list(node.cols)
     if isinstance(node, Aggregate):
-        return [node.key] + list(node.spec)
+        return node.group_keys() + list(node.spec)
+    if isinstance(node, Compute):
+        outs = [o for _e, o in node.exprs]
+        if not node.star:
+            return outs
+        child_cols = node_columns(node.child)
+        if child_cols is None:
+            return None
+        return list(child_cols) + [o for o in outs if o not in child_cols]
+    if isinstance(node, Window):
+        child_cols = node_columns(node.child)
+        if child_cols is None:
+            return None
+        out = list(child_cols)
+        for o in node.outputs():
+            if o not in out:
+                out.append(o)
+        return out
+    if isinstance(node, SetOp):
+        return node_columns(node.left)
     if isinstance(node, Join):
         lc = node_columns(node.left)
         rc = node_columns(node.right)
@@ -193,15 +368,93 @@ def node_columns(node: Node) -> Optional[List[str]]:
 
 
 # -------------------------------------------------------------- optimizer
-def optimize(plan: Node, required: Optional[Sequence[str]] = None) -> Node:
-    """Rule pipeline: fold degenerate predicates, push filters down, prune
-    columns.  ``required`` is the set of columns the consumer needs (select
-    items + order keys ...); None = keep everything."""
+def optimize(plan: Node, required: Optional[Sequence[str]] = None,
+             inline_shared: bool = True) -> Node:
+    """Rule pipeline: inline single-use CTEs, fold degenerate predicates,
+    push filters down, reorder inner-join chains, prune columns.
+    ``required`` is the set of columns the consumer needs (select items +
+    order keys ...); None = keep everything (Compute nodes re-seed the
+    requirement below themselves).  ``inline_shared=False`` keeps every
+    Shared boundary intact -- value-position subqueries use it so a CTE
+    they execute populates the statement-wide cache instead of running a
+    private inlined copy (the execute-once contract)."""
+    counts: Dict[int, int] = {}
+    _count_shared(plan, counts, set())
+    plan = _inline_shared(plan, counts, inline_shared)
     plan = _fold_trivial_filters(plan)
     plan = _push_filters(plan)
+    plan = _reorder_joins(plan, set())
     plan = _prune_columns(plan, set(required) if required is not None
                           else None)
     return plan
+
+
+def _count_shared(node: Node, counts: Dict[int, int], seen: set) -> None:
+    if isinstance(node, Shared):
+        counts[id(node)] = counts.get(id(node), 0) + 1
+        if id(node) in seen:
+            return  # count each REFERENCE, but walk the body once
+        seen.add(id(node))
+    for _name, child in _child_fields(node):
+        _count_shared(child, counts, seen)
+
+
+def _inline_shared(node: Node, counts: Dict[int, int],
+                   allow: bool = True) -> Node:
+    """Already-executed Shared bodies substitute their cached frame (a
+    value-position subquery may have run them during parse); single-use
+    un-executed bodies inline as structural CLONES so the consumer's
+    pushdown/pruning can cross them without mutating the parser-held body
+    (a fallback re-parse may reference the same Shared again)."""
+    if isinstance(node, Shared):
+        if node._cache is not None:
+            return Scan(node.name, frame=node._cache)
+        if allow and counts.get(id(node), 0) <= 1:
+            return _inline_shared(clone_plan(node.child), counts, allow)
+    for name, child in _child_fields(node):
+        setattr(node, name, _inline_shared(child, counts, allow))
+    return node
+
+
+def clone_plan(node: Node) -> Node:
+    """Structural copy of the plan tree: nodes are rebuilt, leaf payloads
+    (frames, readers, Column expressions) are shared -- they are immutable
+    to the optimizer.  Shared nodes keep their IDENTITY (cloning one would
+    defeat its execute-once cache)."""
+    if isinstance(node, Shared):
+        return node
+    if isinstance(node, Scan):
+        return Scan(node.name, frame=node.frame, reader=node.reader,
+                    schema=list(node.schema) if node.schema else node.schema,
+                    pushed_where=node.pushed_where,
+                    pushed_select=(list(node.pushed_select)
+                                   if node.pushed_select else
+                                   node.pushed_select))
+    if isinstance(node, Filter):
+        return Filter(clone_plan(node.child), node.predicate)
+    if isinstance(node, Project):
+        return Project(clone_plan(node.child), list(node.cols))
+    if isinstance(node, Join):
+        return Join(clone_plan(node.left), clone_plan(node.right),
+                    on=node.on, how=node.how)
+    if isinstance(node, Aggregate):
+        return Aggregate(clone_plan(node.child), node.key, dict(node.spec))
+    if isinstance(node, Compute):
+        return Compute(clone_plan(node.child), list(node.exprs),
+                       star=node.star, passthrough=node.passthrough)
+    if isinstance(node, Window):
+        return Window(clone_plan(node.child), list(node.items))
+    if isinstance(node, Sort):
+        return Sort(clone_plan(node.child), list(node.by),
+                    list(node.ascending))
+    if isinstance(node, Limit):
+        return Limit(clone_plan(node.child), node.n)
+    if isinstance(node, Distinct):
+        return Distinct(clone_plan(node.child))
+    if isinstance(node, SetOp):
+        return SetOp(clone_plan(node.left), clone_plan(node.right),
+                     op=node.op)
+    return node  # pragma: no cover - unknown node: share it
 
 
 def _fold_trivial_filters(node: Node) -> Node:
@@ -233,9 +486,10 @@ def _fold_trivial_filters(node: Node) -> Node:
 
 
 def _child_fields(node: Node) -> List[Tuple[str, Node]]:
-    if isinstance(node, (Filter, Project, Aggregate)):
+    if isinstance(node, (Filter, Project, Aggregate, Compute, Window, Sort,
+                         Limit, Distinct, Shared)):
         return [("child", node.child)]
-    if isinstance(node, Join):
+    if isinstance(node, (Join, SetOp)):
         return [("left", node.left), ("right", node.right)]
     return []
 
@@ -287,8 +541,55 @@ def _push_one(node: Node, conj: Column) -> Tuple[Node, bool]:
         return node, False
     if isinstance(node, Aggregate):
         # only group-key predicates commute with aggregation
-        if set(refs) <= {node.key}:
+        if node.key is not None and set(refs) <= set(node.group_keys()):
             node.child, _ = _ensure_pushed(node.child, conj)
+            return node, True
+        return node, False
+    if isinstance(node, (Sort, Distinct)):
+        # filtering commutes with a stable sort and with row dedup
+        node.child, _ = _ensure_pushed(node.child, conj)
+        return node, True
+    if isinstance(node, Limit):
+        return node, False  # filtering before LIMIT changes which rows win
+    if isinstance(node, Shared):
+        return node, False  # multi-consumer boundary
+    if isinstance(node, Window):
+        # safe only when the conjunct references PARTITION BY keys of EVERY
+        # window item: whole partitions then filter together, leaving each
+        # surviving partition's window values unchanged
+        pkeys = node.partition_keys()
+        outs = set(node.outputs())
+        if pkeys is not None and set(refs) <= pkeys and not (
+            set(refs) & outs
+        ):
+            node.child, _ = _ensure_pushed(node.child, conj)
+            return node, True
+        return node, False
+    if isinstance(node, Compute):
+        # a predicate sinks below a projection when every referenced name
+        # passes through unchanged (bare same-named source column, or a
+        # star-projected child column no expression overrides)
+        outs = {o for _e, o in node.exprs}
+        if all(
+            (r in node.passthrough) or (node.star and r not in outs)
+            for r in refs
+        ):
+            node.child, _ = _ensure_pushed(node.child, conj)
+            return node, True
+        return node, False
+    if isinstance(node, SetOp):
+        # a row-value predicate filters each branch identically; valid for
+        # UNION [ALL] / INTERSECT / EXCEPT because membership and dedup
+        # compare whole rows the (non-volatile) predicate already
+        # determines uniformly.  union_all matches columns BY NAME, so a
+        # name-resolved predicate means the same thing on both sides.
+        lc, rc = node_columns(node.left), node_columns(node.right)
+        if (
+            lc is not None and rc is not None
+            and set(refs) <= set(lc) and set(refs) <= set(rc)
+        ):
+            node.left, _ = _ensure_pushed(node.left, conj)
+            node.right, _ = _ensure_pushed(node.right, conj)
             return node, True
         return node, False
     if isinstance(node, Join):
@@ -355,19 +656,105 @@ def _prune_columns(node: Node, required: Optional[set]) -> Node:
         node.child = _prune_columns(node.child, child_req)
         return node
     if isinstance(node, Project):
+        if required is not None:
+            # narrow to what the consumer needs (keeps pruning alive below
+            # the join-reorder's column-order-restoring wrapper); keep one
+            # column so the row count survives
+            want = [c for c in node.cols if c in required]
+            if want:
+                node.cols = want
         node.child = _prune_columns(
             node.child,
             set(node.cols) if required is not None else None,
         )
         return node
     if isinstance(node, Aggregate):
-        child_req = None
-        if required is not None:
-            child_req = {node.key} | {
-                colname for (colname, _fn) in node.spec.values()
-            }
+        # aggregation defines its inputs exactly (keys + agg columns), so
+        # it RE-SEEDS the requirement even under an unknown consumer
+        child_req: Optional[set] = set(node.group_keys())
+        for colname, _fn in node.spec.values():
+            if colname is None:  # COUNT(*): touches an arbitrary column
+                child_req = None
+                break
+            child_req.add(colname)
         node.child = _prune_columns(node.child, child_req)
         return node
+    if isinstance(node, Compute):
+        if required is not None and not node.star:
+            kept = [(e, o) for e, o in node.exprs if o in required]
+            if kept:
+                node.exprs = kept
+        refs: set = set()
+        unknown = False
+        for e, _o in node.exprs:
+            if getattr(e, "refs", None) is None:
+                unknown = True
+                break
+            refs |= set(e.refs)
+        if node.star:
+            child_cols = node_columns(node.child)
+            if required is None or unknown or child_cols is None:
+                child_req = None
+            else:
+                child_req = (set(required) | refs) & set(child_cols)
+        else:
+            child_req = None if unknown else refs
+        node.child = _prune_columns(node.child, child_req)
+        return node
+    if isinstance(node, Window):
+        child_req = None
+        if required is not None:
+            child_req = set(required) - set(node.outputs())
+            for _fn, arg, _off, (pby, oby, _asc), _out in node.items:
+                child_req |= {c for c in (arg, oby) if c}
+                if pby:
+                    child_req.update(
+                        [pby] if isinstance(pby, str) else pby
+                    )
+        node.child = _prune_columns(node.child, child_req)
+        return node
+    if isinstance(node, Sort):
+        child_req = (None if required is None
+                     else set(required) | set(node.by))
+        node.child = _prune_columns(node.child, child_req)
+        return node
+    if isinstance(node, Limit):
+        node.child = _prune_columns(node.child, required)
+        return node
+    if isinstance(node, Distinct):
+        # row identity depends on EVERY column: the child keeps its full
+        # output (deeper scans still prune to that full set)
+        cols = node_columns(node.child)
+        node.child = _prune_columns(
+            node.child, set(cols) if cols is not None else None
+        )
+        return node
+    if isinstance(node, SetOp):
+        lc, rc = node_columns(node.left), node_columns(node.right)
+        if (
+            node.op == "union_all" and required is not None
+            and lc is not None and rc is not None and set(lc) == set(rc)
+        ):
+            # bag semantics never compare whole rows, so pruning crosses
+            # UNION ALL; both sides prune to the SAME name set to keep the
+            # by-name alignment intact
+            req2 = set(required) & set(lc)
+            if not req2:
+                req2 = {lc[0]}
+            node.left = _prune_columns(node.left, req2)
+            node.right = _prune_columns(node.right, req2)
+        else:
+            # distinct set ops compare whole rows: children keep their
+            # full outputs
+            node.left = _prune_columns(
+                node.left, set(lc) if lc is not None else None
+            )
+            node.right = _prune_columns(
+                node.right, set(rc) if rc is not None else None
+            )
+        return node
+    if isinstance(node, Shared):
+        return node  # multi-consumer boundary: no per-consumer pruning
     if isinstance(node, Join):
         if required is None:
             node.left = _prune_columns(node.left, None)
@@ -386,6 +773,136 @@ def _prune_columns(node: Node, required: Optional[set]) -> Node:
     return node
 
 
+# --------------------------------------------------------- join reordering
+_FILTER_SELECTIVITY = 0.25  # per-conjunct row-survival guess (no stats)
+
+
+def _estimate_rows(node: Node) -> Optional[float]:
+    """Row-count estimate for join ordering; None = unknown.  In-memory
+    frames are exact; filters decay by a fixed per-conjunct selectivity
+    (the reference's ``CostBasedJoinReorder`` uses real stats -- this build
+    has live frame sizes, which already decide the common star shapes)."""
+    if isinstance(node, Scan):
+        if node.frame is not None:
+            return float(len(node.frame))
+        return None  # lazy reader: size unknown until read
+    if isinstance(node, Filter):
+        base = _estimate_rows(node.child)
+        if base is None:
+            return None
+        k = len(split_conjuncts(node.predicate))
+        return max(base * (_FILTER_SELECTIVITY ** k), 1.0)
+    if isinstance(node, (Project, Compute, Window, Sort, Distinct)):
+        return _estimate_rows(node.child)
+    if isinstance(node, Limit):
+        base = _estimate_rows(node.child)
+        return float(node.n) if base is None else min(base, float(node.n))
+    if isinstance(node, Shared):
+        if node._cache is not None:
+            return float(len(node._cache))
+        return _estimate_rows(node.child)
+    if isinstance(node, Aggregate):
+        base = _estimate_rows(node.child)
+        return None if base is None else max(base * 0.1, 1.0)
+    return None
+
+
+def _reorder_joins(node: Node, done: set) -> Node:
+    """Greedy reorder of maximal inner-join chains (``ReorderJoin``,
+    ``joins.scala:37``): start from the smallest estimated relation, then
+    repeatedly join the smallest relation CONNECTED by a declared key.
+    Constraint-set equivalence holds because every pair of chain relations
+    sharing a column shares only declared keys (checked; otherwise the
+    written order stands), so any connected order enforces the same
+    equalities.  Output column order is restored with a Project when the
+    rebuild permutes it."""
+    if (
+        isinstance(node, Join) and node.how == "inner"
+        and id(node) not in done
+    ):
+        rebuilt = _reorder_chain(node)
+        for j in _walk_inner_joins(rebuilt):
+            done.add(id(j))
+        node = rebuilt
+    for name, child in _child_fields(node):
+        setattr(node, name, _reorder_joins(child, done))
+    return node
+
+
+def _walk_inner_joins(node: Node) -> List[Join]:
+    out: List[Join] = []
+    if isinstance(node, Project):  # the column-order restoring wrapper
+        node = node.child
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, Join) and n.how == "inner":
+            out.append(n)
+            stack.extend([n.left, n.right])
+    return out
+
+
+def _reorder_chain(top: Join) -> Node:
+    leaves: List[Node] = []
+    key_order: List[str] = []
+
+    def collect(n: Node) -> None:
+        if isinstance(n, Join) and n.how == "inner":
+            collect(n.left)
+            collect(n.right)
+            for k in n.keys():
+                if k not in key_order:
+                    key_order.append(k)
+        else:
+            leaves.append(n)
+
+    collect(top)
+    if len(leaves) < 3:
+        return top  # 2-way join: build-side selection already handles it
+    cols = [node_columns(l) for l in leaves]
+    if any(c is None for c in cols):
+        return top
+    orig_cols = node_columns(top)
+    if orig_cols is None or any(c.endswith("_right") for c in orig_cols):
+        return top  # suffixed collisions: order decides naming; keep it
+    keyset = set(key_order)
+    for i in range(len(leaves)):
+        for j in range(i + 1, len(leaves)):
+            if (set(cols[i]) & set(cols[j])) - keyset:
+                return top  # non-key shared column: semantics order-bound
+    sizes = [_estimate_rows(l) for l in leaves]
+    if all(s is None for s in sizes):
+        return top  # no signal to order by
+    inf = float("inf")
+    szs = [inf if s is None else s for s in sizes]
+    remaining = list(range(len(leaves)))
+    start = min(remaining, key=lambda i: (szs[i], i))
+    order = [start]
+    remaining.remove(start)
+    acc_cols = set(cols[start])
+    steps: List[Tuple[int, List[str]]] = []
+    while remaining:
+        cands = [i for i in remaining if set(cols[i]) & acc_cols & keyset]
+        if not cands:
+            return top  # disconnected under this start: keep written order
+        nxt = min(cands, key=lambda i: (szs[i], i))
+        jk = [k for k in key_order if k in cols[nxt] and k in acc_cols]
+        steps.append((nxt, jk))
+        acc_cols |= set(cols[nxt])
+        remaining.remove(nxt)
+        order.append(nxt)
+    if order == list(range(len(leaves))):
+        return top  # already in the greedy order: keep the original tree
+    new: Node = leaves[order[0]]
+    for leaf_idx, jk in steps:
+        new = Join(new, leaves[leaf_idx],
+                   on=jk[0] if len(jk) == 1 else jk, how="inner")
+    new_cols = node_columns(new)
+    if new_cols != orig_cols:
+        new = Project(new, list(orig_cols))
+    return new
+
+
 # --------------------------------------------------------------- execution
 def execute(node: Node) -> ColumnarFrame:
     if isinstance(node, Scan):
@@ -401,12 +918,90 @@ def execute(node: Node) -> ColumnarFrame:
         return execute(node.child).select(*node.cols)
     if isinstance(node, Aggregate):
         frame = execute(node.child)
+        spec = _resolve_count_star(frame, node.spec)
+        if node.key is None:  # whole-frame scalar aggregates: one row
+            scalars = frame.agg(**spec)
+            return ColumnarFrame(
+                {k: np.asarray([v]) for k, v in scalars.items()}
+            )
         gb = frame.groupby(node.key)
-        if not node.spec:
+        if not spec:
             return gb.count()
-        return gb.agg(**node.spec)
+        return gb.agg(**spec)
+    if isinstance(node, Compute):
+        frame = execute(node.child)
+        if node.star:
+            if not node.exprs:
+                return frame
+            sel = list(frame.columns) + [
+                e.alias(o) for e, o in node.exprs if o not in frame.columns
+            ]
+            return frame.select(*sel)
+        return frame.select(*[e.alias(o) for e, o in node.exprs])
+    if isinstance(node, Window):
+        frame = execute(node.child)
+        for fn, arg, offset, (pby, oby, asc), out in node.items:
+            frame = frame.with_window(
+                out, fn, arg, partition_by=pby, order_by=oby,
+                ascending=asc, offset=offset,
+            )
+        return frame
+    if isinstance(node, Sort):
+        frame = execute(node.child)
+        missing = [c for c in node.by if c not in frame.columns]
+        if missing:  # schema was unknown at parse: say it plainly here
+            raise ValueError(
+                f"ORDER BY {missing[0]!r}: not a result column"
+            )
+        return frame.sort(node.by, ascending=node.ascending)
+    if isinstance(node, Limit):
+        return limit_frame(execute(node.child), node.n)
+    if isinstance(node, Distinct):
+        return execute(node.child).distinct()
+    if isinstance(node, SetOp):
+        left = execute(node.left)
+        right = execute(node.right)
+        if node.op == "union_all":
+            return left.union_all(right)
+        if node.op == "union":
+            return left.union(right)
+        if node.op == "except":
+            return left.except_rows(right)
+        if node.op == "intersect":
+            return left.intersect_rows(right)
+        raise ValueError(f"unknown set op {node.op!r}")
+    if isinstance(node, Shared):
+        if node._cache is None:
+            node._cache = execute(node.child)
+        return node._cache
     if isinstance(node, Join):
         return execute(node.left).join(
             execute(node.right), on=node.on, how=node.how
         )
     raise TypeError(f"unknown plan node {type(node).__name__}")
+
+
+def limit_frame(frame: ColumnarFrame, n: int) -> ColumnarFrame:
+    """LIMIT n: the first n rows (one definition, shared by the plan
+    executor and the parser's eager path)."""
+    return frame._take(np.arange(min(n, len(frame))))
+
+
+def _resolve_count_star(frame: ColumnarFrame, spec):
+    """COUNT(*) entries carry colname None; resolve to any device column at
+    execution (the parser's ``_any_device_column`` contract)."""
+    if not any(colname is None for colname, _fn in spec.values()):
+        return spec
+    import jax.numpy as jnp
+
+    anycol = None
+    for name in frame.columns:
+        if isinstance(frame[name], jnp.ndarray):
+            anycol = name
+            break
+    if anycol is None:
+        raise ValueError("COUNT(*) needs at least one numeric column")
+    return {
+        out: ((anycol, fn) if colname is None else (colname, fn))
+        for out, (colname, fn) in spec.items()
+    }

@@ -38,6 +38,13 @@ Output: ONE json line {"metric", "value", "unit", "vs_baseline", "configs",
 MINIMUM of the three per-config median ratios (the conservative claim: every
 dataset beats its reference estimate by at least this factor); gflops/mfu =
 achieved compute rate of the flop-heaviest config (mnist8m).
+
+If the TPU backend is unavailable (probe subprocesses fail/hang), the
+payload carries `skipped` per config AND a labeled `fallback` block: the
+same engine hot path on the host CPU backend at reduced scale, marked
+not-TPU.  The fallback never stands in for the metric of record -- it exists
+so a dead tunnel round still produces a non-null liveness artifact
+(VERDICT r4 #1).  Disable with BENCH_FALLBACK=0.
 """
 
 import faulthandler
@@ -101,6 +108,21 @@ if os.environ.get("BENCH_SCALE") == "small":
             n=20_000, d=128, gamma=0.05 * 128, iters=600,
             nnz=(8 if _c["sparse"] else None),
         )
+
+# BENCH_SCALE=fallback: moderate shapes for the labeled CPU fallback pass --
+# big enough that engine rates mean something, small enough to finish on a
+# host CPU backend inside the child budget.  These numbers are NEVER the
+# metric of record; they exist so a dead TPU tunnel still yields a labeled
+# partial artifact instead of three nulls (VERDICT r4 #1).
+if os.environ.get("BENCH_SCALE") == "fallback":
+    _FB = {
+        "epsilon": dict(n=60_000, d=1_024, gamma=0.05 * 1_024, iters=1_500),
+        "mnist8m": dict(n=200_000, d=784, gamma=0.05 * 784, iters=1_500),
+        "rcv1": dict(n=60_000, d=8_192, gamma=0.05 * 8_192, nnz=32,
+                     iters=600, printer_freq=25),
+    }
+    for _name, _c in CONFIGS.items():
+        _c.update(_FB[_name])
 
 
 def emit(payload: dict) -> None:
@@ -438,6 +460,61 @@ def median_or_none(xs):
     return round(statistics.median(xs), 3) if xs else None
 
 
+def run_fallback(names, deadline) -> dict:
+    """Labeled CPU fallback when the TPU backend is dead (VERDICT r4 #1):
+    run the SAME engine hot path on the host CPU backend at reduced scale so
+    the round's artifact carries real engine rates instead of nulls.  Every
+    field is marked not-TPU; these numbers never stand in for the metric of
+    record."""
+    env = dict(os.environ)
+    env["BENCH_PLATFORM"] = "cpu"
+    env["BENCH_SCALE"] = "fallback"
+    env["BENCH_FUSED"] = env.get("BENCH_FUSED", "1")
+    alive, note = probe_backend(env)
+    block = {
+        "platform": "cpu",
+        "warning": "NOT TPU -- host CPU backend at reduced scale; "
+                   "engine+fused rates for liveness evidence only",
+        "configs": {},
+    }
+    if not alive:
+        block["warning"] = f"cpu fallback probe failed too: {note}"
+        return block
+    for name in names:
+        if time.monotonic() > deadline:
+            block["configs"][name] = {"ok": False,
+                                      "skipped": "budget exhausted"}
+            continue
+        t0 = time.monotonic()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--config", name],
+                capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            block["configs"][name] = {"ok": False, "note": "child timed out"}
+            continue
+        sys.stderr.write(out.stderr)
+        line = next((l for l in reversed(out.stdout.splitlines())
+                     if l.startswith("{")), None)
+        if line is None:
+            block["configs"][name] = {"ok": False,
+                                      "note": f"no JSON (rc={out.returncode})"}
+            continue
+        rec = json.loads(line)
+        print(f"# fallback {name}: {line} "
+              f"({time.monotonic() - t0:.0f}s wall)", file=sys.stderr)
+        keep = {k: rec.get(k) for k in (
+            "ok", "t_hit", "k_hit", "updates_per_sec", "accepted",
+            "elapsed_s", "gflops", "kernel_gflops", "kernel_ms_per_update",
+            "fused", "note",
+        )}
+        block["configs"][name] = keep
+    return block
+
+
 def run_parent() -> None:
     names = [
         s for s in os.environ.get(
@@ -597,6 +674,8 @@ def run_parent() -> None:
     }
     if skip_note is not None:
         payload["note"] = skip_note
+        if os.environ.get("BENCH_FALLBACK", "1") != "0":
+            payload["fallback"] = run_fallback(names, deadline)
     emit(payload)
 
 

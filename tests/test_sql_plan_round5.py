@@ -1,0 +1,507 @@
+"""Round-5 SQL plan work (VERDICT r4 #3/#4): join reordering, CTE
+memoization, and the logical plan extended past the FROM/JOIN/WHERE core
+(Sort / Limit / Window / SetOp / Distinct nodes with pushdown + pruning
+rules crossing them).
+
+Parity targets: ``sql/catalyst/.../optimizer/joins.scala:37`` (ReorderJoin)
+and ``CostBasedJoinReorder.scala:35`` for the ordering;
+``Optimizer.scala:38`` batches for the clause-crossing rewrites; InlineCTE
+for the execute-once/inline split.  Structural assertions use the public
+``explain`` artifact; every rewrite is also checked result-equivalent
+against the unoptimized plan.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.sql import ColumnarFrame, col, sql
+from asyncframework_tpu.sql.parser import SQLContext
+from asyncframework_tpu.sql.plan import (
+    Compute,
+    Distinct,
+    Filter,
+    Join,
+    Limit,
+    Scan,
+    SetOp,
+    Shared,
+    Sort,
+    Window,
+    clone_plan,
+    execute,
+    node_columns,
+    optimize,
+)
+
+
+def _frames_star(n_fact=3000, n_keys=50, dim_keys=(0, 1), seed=0):
+    """Two fact tables sharing key k, plus a tiny dimension restricted to
+    ``dim_keys`` -- the shape where written-order F1 JOIN F2 builds a huge
+    intermediate and greedy D-first stays small."""
+    rs = np.random.default_rng(seed)
+    f1 = ColumnarFrame({
+        "k": rs.integers(0, n_keys, n_fact).astype(np.int32),
+        "x": rs.normal(size=n_fact).astype(np.float32),
+    })
+    f2 = ColumnarFrame({
+        "k": rs.integers(0, n_keys, n_fact).astype(np.int32),
+        "y": rs.normal(size=n_fact).astype(np.float32),
+    })
+    d = ColumnarFrame({
+        "k": np.asarray(dim_keys, np.int32),
+        "z": np.arange(len(dim_keys), dtype=np.float32),
+    })
+    return f1, f2, d
+
+
+class TestJoinReorder:
+    def test_small_relation_moves_first(self):
+        f1, f2, d = _frames_star()
+        ctx = SQLContext()
+        ctx.register("f1", f1)
+        ctx.register("f2", f2)
+        ctx.register("d", d)
+        txt = ctx.explain(
+            "SELECT k, x, y, z FROM f1 JOIN f2 ON k JOIN d ON k"
+        )
+        # greedy order: d (2 rows) first, then the facts
+        assert txt.index("Scan(d") < txt.index("Scan(f1")
+        assert txt.index("Scan(f1") < txt.index("Scan(f2")
+
+    def test_reorder_result_equivalent(self):
+        f1, f2, d = _frames_star(n_fact=400, n_keys=10)
+        plan = Join(
+            Join(Scan("f1", frame=f1), Scan("f2", frame=f2), on="k"),
+            Scan("d", frame=d), on="k",
+        )
+        naive = execute(clone_plan(plan))
+        opt_plan = optimize(plan, required=None)
+        opt = execute(opt_plan)
+        assert sorted(naive.columns) == sorted(opt.columns)
+        key = lambda f: sorted(
+            tuple(round(float(v), 4) for v in row) for row in (
+                zip(*[np.asarray(f[c]).tolist() for c in naive.columns])
+            )
+        )
+        assert key(naive) == key(opt)
+
+    def test_column_order_preserved_by_project_wrap(self):
+        f1, f2, d = _frames_star(n_fact=100, n_keys=5)
+        plan = Join(
+            Join(Scan("f1", frame=f1), Scan("f2", frame=f2), on="k"),
+            Scan("d", frame=d), on="k",
+        )
+        orig_cols = node_columns(clone_plan(plan))
+        out = execute(optimize(plan, required=None))
+        assert out.columns == orig_cols
+
+    def test_filtered_relation_estimate_reorders(self):
+        # an unfiltered small-ish table vs a filtered big one: the filter's
+        # selectivity decay should pull the filtered scan forward
+        rs = np.random.default_rng(1)
+        big = ColumnarFrame({
+            "k": rs.integers(0, 20, 2000).astype(np.int32),
+            "x": rs.normal(size=2000).astype(np.float32),
+        })
+        mid = ColumnarFrame({
+            "k": rs.integers(0, 20, 500).astype(np.int32),
+            "w": rs.normal(size=500).astype(np.float32),
+        })
+        d = ColumnarFrame({
+            "k": np.asarray([3], np.int32),
+            "z": np.asarray([1.0], np.float32),
+        })
+        out = sql(
+            "SELECT k, x, w, z FROM big JOIN mid ON k JOIN d ON k "
+            "WHERE x > 100", big=big, mid=mid, d=d,
+        )
+        assert len(out) == 0  # x > 100 empties it; shape checked above all
+
+    def test_left_join_chain_not_reordered(self):
+        f1, f2, d = _frames_star(n_fact=50, n_keys=5)
+        ctx = SQLContext()
+        ctx.register("f1", f1)
+        ctx.register("f2", f2)
+        ctx.register("d", d)
+        txt = ctx.explain(
+            "SELECT k, x, y, z FROM f1 LEFT JOIN f2 ON k LEFT JOIN d ON k"
+        )
+        # outer joins are order-sensitive: written order stands
+        assert txt.index("Scan(f1") < txt.index("Scan(f2")
+        assert txt.index("Scan(f2") < txt.index("Scan(d")
+
+    def test_nonkey_collision_keeps_written_order(self):
+        # both facts carry a non-key column "x": reordering could change
+        # which side receives the _right suffix -- must keep written order
+        rs = np.random.default_rng(2)
+        f1 = ColumnarFrame({
+            "k": rs.integers(0, 5, 50).astype(np.int32),
+            "x": rs.normal(size=50).astype(np.float32),
+        })
+        f2 = ColumnarFrame({
+            "k": rs.integers(0, 5, 50).astype(np.int32),
+            "x": rs.normal(size=50).astype(np.float32),
+        })
+        d = ColumnarFrame({
+            "k": np.asarray([1], np.int32),
+            "z": np.asarray([9.0], np.float32),
+        })
+        plan = Join(
+            Join(Scan("f1", frame=f1), Scan("f2", frame=f2), on="k"),
+            Scan("d", frame=d), on="k",
+        )
+        expect = execute(clone_plan(plan))
+        got = execute(optimize(plan, required=None))
+        assert got.columns == expect.columns  # x / x_right naming intact
+
+    @pytest.mark.slow
+    def test_star_query_measured_win(self):
+        """The VERDICT's done-criterion: a measured win on a badly written
+        3-table star query.  Written order builds a ~12M-row intermediate;
+        greedy builds ~hundreds."""
+        f1, f2, d = _frames_star(n_fact=25_000, n_keys=50)
+        plan_bad = Join(
+            Join(Scan("f1", frame=f1), Scan("f2", frame=f2), on="k"),
+            Scan("d", frame=d), on="k",
+        )
+        plan_opt = optimize(clone_plan(plan_bad), required=None)
+        # warm both paths once at small scale implicitly via earlier tests;
+        # time medians of 3
+        def med(fn):
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                fn()
+                ts.append(time.perf_counter() - t0)
+            return sorted(ts)[1]
+
+        t_naive = med(lambda: execute(clone_plan(plan_bad)))
+        t_opt = med(lambda: execute(clone_plan(plan_opt)))
+        # the intermediate-size gap is ~4 orders of magnitude; demand 2x
+        # to stay robust on noisy CI
+        assert t_opt * 2 < t_naive, (t_opt, t_naive)
+
+
+class TestCTEMemoization:
+    def _counting_ctx(self):
+        ctx = SQLContext()
+        calls = {"n": 0}
+
+        def bump(x):
+            calls["n"] += 1
+            return x
+
+        ctx.register_udf("bump", bump)
+        ctx.register("t", ColumnarFrame({
+            "a": np.asarray([1.0, 2.0, 3.0], np.float32),
+        }))
+        return ctx, calls
+
+    def test_twice_referenced_cte_executes_once(self):
+        ctx, calls = self._counting_ctx()
+        out = ctx.sql(
+            "WITH c AS (SELECT bump(a) AS a FROM t) "
+            "SELECT a FROM c UNION ALL SELECT a FROM c"
+        )
+        assert len(out) == 6
+        assert calls["n"] == 3  # 3 rows, ONE body execution
+
+    def test_self_join_cte_executes_once(self):
+        ctx, calls = self._counting_ctx()
+        out = ctx.sql(
+            "WITH c AS (SELECT bump(a) AS a FROM t) "
+            "SELECT a FROM c JOIN c ON a"
+        )
+        assert len(out) == 3
+        assert calls["n"] == 3
+
+    def test_unreferenced_cte_never_executes(self):
+        ctx, calls = self._counting_ctx()
+        out = ctx.sql(
+            "WITH c AS (SELECT bump(a) AS a FROM t) SELECT a FROM t"
+        )
+        assert len(out) == 3
+        assert calls["n"] == 0
+
+    def test_single_use_cte_inlines_for_pushdown(self, tmp_path):
+        path = tmp_path / "t.csv"
+        path.write_text("a,b,unused\n1,10,0\n2,20,0\n3,30,0\n")
+        ctx = SQLContext()
+        ctx.register_csv("t", str(path))
+        txt = ctx.explain(
+            "WITH c AS (SELECT a, b, unused FROM t) "
+            "SELECT a FROM c WHERE b > 15"
+        )
+        # inlined: the predicate and the pruned projection reached the
+        # reader scan -- no Shared boundary in the way
+        assert "Shared" not in txt
+        assert "where=" in txt
+        out = ctx.sql(
+            "WITH c AS (SELECT a, b, unused FROM t) "
+            "SELECT a FROM c WHERE b > 15"
+        )
+        assert sorted(a for (a,) in out.collect()) == [2, 3]
+
+    def test_multi_use_cte_is_boundary(self):
+        ctx = SQLContext()
+        ctx.register("t", ColumnarFrame({
+            "a": np.asarray([1, 2, 3], np.int32),
+            "b": np.asarray([10.0, 20.0, 30.0], np.float32),
+        }))
+        txt = ctx.explain(
+            "WITH c AS (SELECT a, b FROM t) "
+            "SELECT a FROM c WHERE a > 1 UNION ALL SELECT a FROM c"
+        )
+        assert txt.count("Shared(c)") == 2  # same body, two references
+
+    def test_cte_in_subquery_and_from_executes_once(self):
+        # the IN-subquery runs at parse time; it must populate the
+        # statement-wide Shared cache, not a private inlined copy
+        ctx, calls = self._counting_ctx()
+        out = ctx.sql(
+            "WITH c AS (SELECT bump(a) AS a FROM t) "
+            "SELECT a FROM c WHERE a IN (SELECT a FROM c)"
+        )
+        assert sorted(a for (a,) in out.collect()) == [1.0, 2.0, 3.0]
+        assert calls["n"] == 3  # ONE body execution across both positions
+
+    @pytest.mark.slow
+    def test_twice_referenced_cte_measured_win(self):
+        """VERDICT done-criterion: measured win on a twice-referenced CTE
+        (body = an aggregation over 2M rows; memoized = one execution)."""
+        rs = np.random.default_rng(7)
+        n = 2_000_000
+        ctx = SQLContext()
+        ctx.register("big", ColumnarFrame({
+            "k": rs.integers(0, 1000, n).astype(np.int32),
+            "v": rs.normal(size=n).astype(np.float32),
+        }))
+        q_body = "SELECT k, SUM(v) AS s FROM big GROUP BY k"
+        two_ref = (f"WITH c AS ({q_body}) "
+                   "SELECT s FROM c UNION ALL SELECT s FROM c")
+
+        def run_once():
+            return ctx.sql(two_ref)
+
+        def run_naive():
+            # the pre-memoization equivalent: execute the body twice
+            a = ctx.sql(q_body)
+            b = ctx.sql(q_body)
+            return a.select("s").union_all(b.select("s"))
+
+        def med(fn):
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                fn()
+                ts.append(time.perf_counter() - t0)
+            return sorted(ts)[1]
+
+        med(run_once)  # warm caches
+        t_memo = med(run_once)
+        t_naive = med(run_naive)
+        assert t_memo * 1.4 < t_naive, (t_memo, t_naive)
+        assert len(run_once()) == 2000
+
+
+class TestWindowNode:
+    def _ctx(self):
+        ctx = SQLContext()
+        ctx.register("t", ColumnarFrame({
+            "k": np.asarray([1, 1, 2, 2, 2, 3], np.int32),
+            "v": np.asarray([5.0, 3.0, 9.0, 2.0, 7.0, 1.0], np.float32),
+        }))
+        return ctx
+
+    def test_partition_key_predicate_sinks_below_window(self):
+        ctx = self._ctx()
+        q = ("SELECT k, v, rn FROM (SELECT k, v, ROW_NUMBER() OVER "
+             "(PARTITION BY k ORDER BY v) AS rn FROM t) WHERE k = 2")
+        txt = ctx.explain(q)
+        assert "Window" in txt
+        # the Filter ended up BELOW the Window node (deeper indentation,
+        # later in the pre-order text)
+        assert txt.index("Window") < txt.index("Filter")
+        out = ctx.sql(q)
+        got = {(r[0], r[1]): r[2] for r in out.collect()}
+        # rn computed over the FULL k=2 partition, post-filter identical
+        assert got[(2, 2.0)] == 1 and got[(2, 7.0)] == 2 and got[(2, 9.0)] == 3
+
+    def test_non_partition_predicate_stays_above_window(self):
+        ctx = self._ctx()
+        q = ("SELECT k, v, rn FROM (SELECT k, v, ROW_NUMBER() OVER "
+             "(PARTITION BY k ORDER BY v) AS rn FROM t) WHERE v > 4")
+        txt = ctx.explain(q)
+        assert txt.index("Filter") < txt.index("Window")
+        out = ctx.sql(q)
+        got = {(r[0], r[1]): r[2] for r in out.collect()}
+        # rn reflects the FULL partitions: (2, 7.0) is rank 2 of k=2 even
+        # though 2.0 was filtered from the result
+        assert got[(2, 7.0)] == 2
+        assert got[(1, 5.0)] == 2
+
+    def test_window_output_predicate_stays_above(self):
+        ctx = self._ctx()
+        q = ("SELECT k, v, rn FROM (SELECT k, v, ROW_NUMBER() OVER "
+             "(PARTITION BY k ORDER BY v) AS rn FROM t) WHERE rn = 1")
+        txt = ctx.explain(q)
+        assert txt.index("Filter") < txt.index("Window")
+        out = ctx.sql(q)
+        assert sorted((r[0], r[1]) for r in out.collect()) == [
+            (1, 3.0), (2, 2.0), (3, 1.0),
+        ]
+
+    def test_window_pruning_keeps_inputs(self, tmp_path):
+        path = tmp_path / "t.csv"
+        path.write_text("k,v,unused\n1,5,0\n1,3,0\n2,9,0\n")
+        ctx = SQLContext()
+        ctx.register_csv("t", str(path))
+        txt = ctx.explain(
+            "SELECT rn FROM (SELECT k, v, ROW_NUMBER() OVER "
+            "(PARTITION BY k ORDER BY v) AS rn FROM t)"
+        )
+        assert "unused" not in txt.split("Scan")[1]  # pruned from the scan
+        out = ctx.sql(
+            "SELECT rn FROM (SELECT k, v, ROW_NUMBER() OVER "
+            "(PARTITION BY k ORDER BY v) AS rn FROM t)"
+        )
+        assert sorted(r for (r,) in out.collect()) == [1, 1, 2]
+
+
+class TestSetOpNode:
+    def _csv_ctx(self, tmp_path):
+        p1 = tmp_path / "t1.csv"
+        p1.write_text("a,b,unused\n1,10,0\n2,20,0\n")
+        p2 = tmp_path / "t2.csv"
+        p2.write_text("a,b,unused\n3,30,0\n4,40,0\n")
+        ctx = SQLContext()
+        ctx.register_csv("t1", str(p1))
+        ctx.register_csv("t2", str(p2))
+        return ctx
+
+    def test_pruning_crosses_union_all(self, tmp_path):
+        ctx = self._csv_ctx(tmp_path)
+        q = ("SELECT a FROM (SELECT * FROM t1 UNION ALL SELECT * FROM t2)")
+        txt = ctx.explain(q)
+        # both reader scans pruned to the single required column
+        assert txt.count("select=['a']") == 2
+        out = ctx.sql(q)
+        assert sorted(a for (a,) in out.collect()) == [1, 2, 3, 4]
+
+    def test_predicate_pushes_into_both_branches(self, tmp_path):
+        ctx = self._csv_ctx(tmp_path)
+        q = ("SELECT a FROM (SELECT * FROM t1 UNION ALL SELECT * FROM t2) "
+             "WHERE a > 1")
+        txt = ctx.explain(q)
+        assert txt.count("where=") == 2  # reached BOTH readers
+        out = ctx.sql(q)
+        assert sorted(a for (a,) in out.collect()) == [2, 3, 4]
+
+    def test_distinct_setop_children_not_pruned(self, tmp_path):
+        ctx = self._csv_ctx(tmp_path)
+        q = "SELECT a FROM (SELECT * FROM t1 UNION SELECT * FROM t2)"
+        txt = ctx.explain(q)
+        # UNION (distinct) compares whole rows: scans keep all columns
+        assert "select=['a']" not in txt
+        out = ctx.sql(q)
+        assert sorted(a for (a,) in out.collect()) == [1, 2, 3, 4]
+
+    def test_predicate_pushes_through_except_and_intersect(self):
+        f = ColumnarFrame({"a": np.asarray([1, 2, 3, 4], np.int32)})
+        g = ColumnarFrame({"a": np.asarray([3, 4, 5], np.int32)})
+        out = sql(
+            "SELECT a FROM (SELECT a FROM t EXCEPT SELECT a FROM u) "
+            "WHERE a > 1", t=f, u=g,
+        )
+        assert sorted(a for (a,) in out.collect()) == [2]
+        out = sql(
+            "SELECT a FROM (SELECT a FROM t INTERSECT SELECT a FROM u) "
+            "WHERE a > 3", t=f, u=g,
+        )
+        assert sorted(a for (a,) in out.collect()) == [4]
+
+
+class TestSortLimitDistinctNodes:
+    def test_order_limit_become_plan_nodes(self):
+        ctx = SQLContext()
+        ctx.register("t", ColumnarFrame({
+            "a": np.asarray([3, 1, 2], np.int32),
+        }))
+        txt = ctx.explain("SELECT a FROM t ORDER BY a DESC LIMIT 2")
+        assert "Limit(2)" in txt and "Sort" in txt
+        out = ctx.sql("SELECT a FROM t ORDER BY a DESC LIMIT 2")
+        assert [a for (a,) in out.collect()] == [3, 2]
+
+    def test_filter_pushes_through_derived_sort(self, tmp_path):
+        path = tmp_path / "t.csv"
+        path.write_text("a,b\n3,1\n1,2\n2,3\n")
+        ctx = SQLContext()
+        ctx.register_csv("t", str(path))
+        q = ("SELECT a FROM (SELECT a, b FROM t ORDER BY b) WHERE a > 1")
+        txt = ctx.explain(q)
+        assert "where=" in txt  # crossed the Sort into the reader
+        out = ctx.sql(q)
+        assert [a for (a,) in out.collect()] == [3, 2]  # b-order kept
+
+    def test_filter_blocked_by_limit(self):
+        f = ColumnarFrame({"a": np.asarray([5, 1, 4, 2], np.int32)})
+        q = ("SELECT a FROM (SELECT a FROM t ORDER BY a LIMIT 2) "
+             "WHERE a > 1")
+        ctx = SQLContext()
+        ctx.register("t", f)
+        txt = ctx.explain(q)
+        assert txt.index("Filter") < txt.index("Limit")
+        out = ctx.sql(q)
+        # LIMIT 2 keeps [1, 2]; filter then keeps [2] -- NOT [2, 4]
+        assert [a for (a,) in out.collect()] == [2]
+
+    def test_distinct_node_and_filter_pushes_through(self):
+        f = ColumnarFrame({
+            "a": np.asarray([1, 1, 2, 3, 3], np.int32),
+        })
+        ctx = SQLContext()
+        ctx.register("t", f)
+        txt = ctx.explain(
+            "SELECT a FROM (SELECT DISTINCT a FROM t) WHERE a > 1"
+        )
+        assert "Distinct" in txt
+        assert txt.index("Distinct") < txt.index("Filter")
+        out = ctx.sql(
+            "SELECT a FROM (SELECT DISTINCT a FROM t) WHERE a > 1"
+        )
+        assert sorted(a for (a,) in out.collect()) == [2, 3]
+
+
+class TestDerivedTableLaziness:
+    def test_pushdown_crosses_derived_table(self, tmp_path):
+        path = tmp_path / "t.csv"
+        path.write_text("k,v,unused\n1,10,0\n2,20,0\n3,30,0\n")
+        ctx = SQLContext()
+        ctx.register_csv("t", str(path))
+        q = "SELECT k FROM (SELECT k, v, unused FROM t) WHERE v > 15"
+        txt = ctx.explain(q)
+        assert "where=" in txt and "unused" not in txt.split("Scan")[1]
+        out = ctx.sql(q)
+        assert sorted(k for (k,) in out.collect()) == [2, 3]
+
+    def test_aliased_derived_column_blocks_push(self):
+        # SELECT a AS x ... WHERE x > 1: x does not exist below the
+        # projection under that name; the filter stays above (correctness)
+        f = ColumnarFrame({"a": np.asarray([1, 2, 3], np.int32)})
+        out = sql(
+            "SELECT x FROM (SELECT a AS x FROM t) WHERE x > 1", t=f,
+        )
+        assert sorted(x for (x,) in out.collect()) == [2, 3]
+
+    def test_eager_fallback_still_works(self):
+        # ORDER BY mixing an alias with an unprojected source column is the
+        # eager path's borrowed-column shape; it must still run via plan
+        # fallback
+        f = ColumnarFrame({
+            "a": np.asarray([1, 2, 3, 4], np.int32),
+            "b": np.asarray([0, 1, 0, 1], np.int32),
+        })
+        out = sql("SELECT a AS x FROM t ORDER BY b, x DESC", t=f)
+        assert [x for (x,) in out.collect()] == [3, 1, 4, 2]
