@@ -52,10 +52,15 @@ class MasterClient:
         ) from last_err
 
     def submit(self, argv: List[str], num_processes: int,
-               env: Optional[Dict[str, str]] = None) -> str:
+               env: Optional[Dict[str, str]] = None,
+               supervise: bool = False) -> str:
+        """``supervise``: the reference's ``spark-submit --supervise`` --
+        a worker daemon relaunches an executor that exits nonzero (bounded
+        restarts), instead of reporting the failure."""
         reply = self._call({
             "op": "SUBMIT_APP", "argv": list(argv),
             "num_processes": int(num_processes), "env": env or {},
+            "supervise": bool(supervise),
         })
         return reply["app_id"]
 

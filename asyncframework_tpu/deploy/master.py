@@ -328,6 +328,7 @@ class Master:
                 "argv": list(msg["argv"]), "env": dict(msg.get("env") or {}),
                 "num_processes": nproc, "state": "LAUNCHING",
                 "assignments": assignments, "exits": {},
+                "supervise": bool(msg.get("supervise")),
             }
             self._persist()
             app = self.apps[app_id]
@@ -349,6 +350,7 @@ class Master:
                         "op": "LAUNCH", "app_id": app_id,
                         "proc_id": a["proc_id"], "argv": app["argv"],
                         "env": env, "master": self.address,
+                        "supervise": app.get("supervise", False),
                     })
                     _recv_msg(ws)
             except (ConnectionError, OSError):

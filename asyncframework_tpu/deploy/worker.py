@@ -54,7 +54,9 @@ class Worker:
         # app_id -> live Popen list (pruned as executors exit)
         self._procs: Dict[str, List[subprocess.Popen]] = {}
         self._procs_lock = threading.Lock()
+        self._killed: set = set()  # apps killed by order: never supervise
         self._launch_env_extra = dict(launch_env_extra or {})
+        self.max_supervised_restarts = 3
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Worker":
@@ -133,6 +135,7 @@ class Worker:
                     _send_msg(conn, {"op": "ACK"})
                 elif msg.get("op") == "KILL":
                     with self._procs_lock:
+                        self._killed.add(msg["app_id"])
                         doomed = list(self._procs.get(msg["app_id"], ()))
                     for p in doomed:
                         if p.poll() is None:
@@ -154,6 +157,7 @@ class Worker:
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True,
         )
+        proc.async_proc_id = order["proc_id"]  # introspection (tests, UI)
         with self._procs_lock:
             self._procs.setdefault(order["app_id"], []).append(proc)
 
@@ -167,6 +171,28 @@ class Worker:
                     ps.remove(proc)
                 if not ps:
                     self._procs.pop(order["app_id"], None)
+                app_killed = order["app_id"] in self._killed
+            if (
+                proc.returncode
+                and order.get("supervise")
+                and not app_killed
+                and not self._stop.is_set()
+                and order.get("_restarts", 0) < self.max_supervised_restarts
+            ):
+                # spark-submit --supervise parity (DriverRunner's restart
+                # loop): relaunch with the SAME order -- env carries the
+                # coordinator address, so a restarted PS rebinds its port
+                # and the surviving peers reconnect.  No EXECUTOR_EXIT for
+                # a supervised death: the master sees one continuous life.
+                order2 = dict(order, _restarts=order.get("_restarts", 0) + 1)
+                sys.stderr.write(
+                    f"[{self.worker_id}] supervising app {order['app_id']} "
+                    f"proc {order['proc_id']}: rc={proc.returncode}, "
+                    f"restart {order2['_restarts']}/"
+                    f"{self.max_supervised_restarts}\n"
+                )
+                self._launch(order2)
+                return
             # the exit report must survive a master failover window: a
             # standby needs a few hundred ms to win the lease and recover,
             # and a lost report strands the app in RUNNING forever

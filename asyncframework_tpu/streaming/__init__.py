@@ -6,10 +6,12 @@ from asyncframework_tpu.streaming.receiver import (
     TextFileStream,
 )
 from asyncframework_tpu.streaming.log import DirectLogStream, LogTopic
+from asyncframework_tpu.streaming.log_net import LogTopicServer, RemoteLogTopic
 from asyncframework_tpu.streaming.wal import WriteAheadLog
 
 __all__ = [
     "DStream", "StreamingContext", "ReceiverStream", "SocketTextStream",
     "TextFileStream",
     "WriteAheadLog", "LogTopic", "DirectLogStream",
+    "LogTopicServer", "RemoteLogTopic",
 ]

@@ -234,7 +234,9 @@ class DirectLogStream(DStream):
     def __init__(self, ssc, topic, group: str = "default",
                  max_per_batch: Optional[int] = None):
         super().__init__(ssc)
-        self.topic = topic if isinstance(topic, LogTopic) else LogTopic(topic)
+        # a string is a local topic directory; anything else (LogTopic,
+        # RemoteLogTopic, ...) just needs the read/commit surface
+        self.topic = LogTopic(topic) if isinstance(topic, str) else topic
         self.group = group
         self.max_per_batch = max_per_batch
         self._next = self.topic.committed_offset(group)

@@ -1,0 +1,289 @@
+"""Network-attached LogTopic: broker-less streaming source over the DCN
+framing.
+
+Parity (studied, not copied): the reference's modern streaming connector
+consumes a REMOTE broker service --
+``external/kafka-0-10/.../DirectKafkaInputDStream.scala`` talks the Kafka
+wire protocol to fetch offset ranges and commit group offsets.  The TPU
+build's :class:`~asyncframework_tpu.streaming.log.LogTopic` already gives
+the direct-stream capability (offset-addressed replayable log,
+commit-after-output) but only same-filesystem; this module serves it over
+the framework's OWN length-prefixed TCP framing (the same channel the
+parameter server and the deploy daemons use -- ``parallel/ps_dcn.py``), so
+producers and consumers run on other hosts with no external broker
+dependency:
+
+- :class:`LogTopicServer` -- one process owning the on-disk topics (the
+  single-writer-per-partition discipline the file-backed class documents
+  becomes a *server guarantee*); serves APPEND / READ / END / COMMIT /
+  COMMITTED over TCP, one handler thread per connection.
+- :class:`RemoteLogTopic` -- a client with the LogTopic consumer/producer
+  surface (``read``/``end_offset``/``append_many``/``commit_offset``/
+  ``committed_offset``), so :class:`DirectLogStream` drives it unchanged:
+  offsets commit server-side strictly after outputs, and a restarted
+  consumer (even in a new process) replays from the server's last commit.
+
+Record payloads remain JSON -- replay never executes code (the WAL's trust
+posture), and the wire never carries pickles.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Any, Iterable, List, Optional, Tuple
+
+from asyncframework_tpu.parallel.ps_dcn import _recv_msg, _send_msg
+from asyncframework_tpu.streaming.log import LogTopic
+
+
+class LogTopicServer:
+    """Serve a directory of :class:`LogTopic` logs over TCP.
+
+    Topics are auto-created on first reference (``<root>/<name>/``).  All
+    appends for a topic funnel through this process's single LogTopic
+    instance, which serializes them -- remote producers get the
+    single-writer discipline for free.
+    """
+
+    def __init__(self, root: str, host: str = "0.0.0.0", port: int = 0,
+                 segment_bytes: int = 64 * 1024 * 1024):
+        self.root = root
+        self.segment_bytes = segment_bytes
+        self._topics: dict = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> Tuple[str, int]:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="log-topic-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self.host, self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def serve_forever(self) -> None:
+        self.start()
+        while not self._stop.is_set():
+            time.sleep(0.2)
+
+    # -------------------------------------------------------------- serving
+    def _topic(self, name: str) -> LogTopic:
+        if not name or "/" in name or name.startswith("."):
+            raise ValueError(f"bad topic name {name!r}")
+        with self._lock:
+            t = self._topics.get(name)
+            if t is None:
+                import os
+
+                t = LogTopic(os.path.join(self.root, name),
+                             segment_bytes=self.segment_bytes)
+                self._topics[name] = t
+            return t
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return  # socket closed by stop()
+            threading.Thread(
+                target=self._handle, args=(conn,),
+                name="log-topic-conn", daemon=True,
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                header, payload = _recv_msg(conn)
+                try:
+                    reply, body = self._dispatch(header, payload)
+                except Exception as e:  # a bad request must not kill the
+                    reply, body = (     # connection, let alone the server
+                        {"op": "ERR",
+                         "error": f"{type(e).__name__}: {e}"}, b"",
+                    )
+                _send_msg(conn, reply, body)
+        except (ConnectionError, OSError):
+            pass  # client went away; its offsets are on disk
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, header: dict, payload: bytes
+                  ) -> Tuple[dict, bytes]:
+        op = header.get("op")
+        if op == "APPEND":
+            topic = self._topic(header["topic"])
+            values = json.loads(payload.decode("utf-8"))
+            first, nxt = topic.append_many(values)
+            return {"op": "APPENDED", "first": first, "next": nxt}, b""
+        if op == "READ":
+            topic = self._topic(header["topic"])
+            records, nxt = topic.read(
+                int(header["offset"]), header.get("max")
+            )
+            body = json.dumps(records).encode("utf-8")
+            return {"op": "RECORDS", "next": nxt}, body
+        if op == "END":
+            topic = self._topic(header["topic"])
+            return {"op": "END", "end": topic.end_offset()}, b""
+        if op == "COMMIT":
+            topic = self._topic(header["topic"])
+            topic.commit_offset(header["group"], int(header["offset"]))
+            return {"op": "COMMITTED", "ok": True}, b""
+        if op == "COMMITTED":
+            topic = self._topic(header["topic"])
+            off = topic.committed_offset(header["group"])
+            return {"op": "OFFSET", "offset": off}, b""
+        raise ValueError(f"unknown op {op!r}")
+
+
+class RemoteLogTopic:
+    """Client-side LogTopic surface over the topic server's TCP protocol.
+
+    Offers the subset :class:`DirectLogStream` and producers use --
+    ``read``/``end_offset``/``append``/``append_many``/``commit_offset``/
+    ``committed_offset`` -- with connect retry + reconnect-on-error backoff
+    (the same stance DCN workers take toward a restarting PS)."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 connect_timeout_s: float = 10.0, retries: int = 5):
+        self.host, self.port, self.topic = host, int(port), topic
+        self.connect_timeout_s = connect_timeout_s
+        self.retries = retries
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- transport
+    def _connect(self) -> socket.socket:
+        deadline = time.monotonic() + self.connect_timeout_s
+        delay = 0.05
+        while True:
+            try:
+                s = socket.create_connection(
+                    (self.host, self.port), timeout=10.0
+                )
+                s.settimeout(60.0)
+                return s
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    def _call(self, header: dict, payload: bytes = b""
+              ) -> Tuple[dict, bytes]:
+        with self._lock:
+            last: Optional[Exception] = None
+            for _attempt in range(self.retries):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_msg(self._sock, header, payload)
+                    reply, body = _recv_msg(self._sock)
+                    if reply.get("op") == "ERR":
+                        raise RuntimeError(
+                            f"topic server: {reply.get('error')}"
+                        )
+                    return reply, body
+                except (ConnectionError, OSError) as e:
+                    last = e  # server restarted: reconnect and retry
+                    try:
+                        if self._sock is not None:
+                            self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    time.sleep(0.1)
+            raise ConnectionError(
+                f"topic server {self.host}:{self.port} unreachable"
+            ) from last
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    # ------------------------------------------------------------ producing
+    def append(self, value: Any) -> int:
+        return self.append_many([value])[0]
+
+    def append_many(self, values: Iterable[Any]) -> Tuple[int, int]:
+        body = json.dumps(list(values)).encode("utf-8")
+        reply, _ = self._call({"op": "APPEND", "topic": self.topic}, body)
+        return reply["first"], reply["next"]
+
+    # ------------------------------------------------------------ consuming
+    def end_offset(self) -> int:
+        reply, _ = self._call({"op": "END", "topic": self.topic})
+        return reply["end"]
+
+    def read(self, offset: int, max_records: Optional[int] = None
+             ) -> Tuple[List[Any], int]:
+        reply, body = self._call({
+            "op": "READ", "topic": self.topic,
+            "offset": int(offset), "max": max_records,
+        })
+        return json.loads(body.decode("utf-8")), reply["next"]
+
+    def committed_offset(self, group: str) -> int:
+        reply, _ = self._call({
+            "op": "COMMITTED", "topic": self.topic, "group": group,
+        })
+        return reply["offset"]
+
+    def commit_offset(self, group: str, offset: int) -> None:
+        self._call({
+            "op": "COMMIT", "topic": self.topic,
+            "group": group, "offset": int(offset),
+        })
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """``python -m asyncframework_tpu.streaming.log_net --root DIR
+    [--host H] [--port P]`` -- run a topic server (prints
+    ``LISTENING host port`` once bound, the daemons' handshake line)."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(description="LogTopic network server")
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--segment-bytes", type=int, default=64 * 1024 * 1024)
+    args = ap.parse_args(argv)
+    srv = LogTopicServer(args.root, host=args.host, port=args.port,
+                         segment_bytes=args.segment_bytes)
+    host, port = srv.start()
+    print(f"LISTENING {host} {port}", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.stop()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

