@@ -121,6 +121,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use only the first N jax devices")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the per-snapshot trajectory lines")
+    p.add_argument("--master", default=None,
+                   metavar="HOST:PORT[,HOST:PORT...]",
+                   help="submit to a standalone master daemon (first addr "
+                        "primary, rest standbys) instead of running locally "
+                        "-- spark-submit --master parity")
+    p.add_argument("--processes", type=int, default=1,
+                   help="executor processes for a --master submission")
+    p.add_argument("--supervise", action="store_true",
+                   help="worker daemons restart failed executors "
+                        "(spark-submit --supervise parity; --master only)")
+    p.add_argument("--no-wait", action="store_true",
+                   help="return after submission without waiting for a "
+                        "terminal state (cluster deploy-mode)")
+    p.add_argument("--wait-timeout", type=float, default=600.0,
+                   help="--master wait budget in seconds")
     p.add_argument("--event-log", default=None,
                    help="write a JSONL event log (.gz = compressed) of the run")
     p.add_argument("--report", default=None,
@@ -530,6 +545,48 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
     }
 
 
+_CLUSTER_ONLY_FLAGS = {"--master": 1, "--processes": 1,
+                       "--wait-timeout": 1, "--supervise": 0, "--no-wait": 0}
+
+
+def _submit_to_master(args, argv: Optional[List[str]]) -> int:
+    """spark-submit --master parity: ship the recipe argv (cluster-only
+    flags stripped) to the standalone master daemon; by default wait for a
+    terminal state and exit 0 only on FINISHED."""
+    from asyncframework_tpu.deploy.client import _client, wait_app
+
+    raw = list(sys.argv[1:] if argv is None else argv)
+    submit_argv: List[str] = []
+    i = 0
+    while i < len(raw):
+        tok = raw[i]
+        flag = tok.split("=", 1)[0]
+        if flag in _CLUSTER_ONLY_FLAGS:
+            i += 1
+            if _CLUSTER_ONLY_FLAGS[flag] and "=" not in tok:
+                i += 1  # consume the flag's value token
+            continue
+        submit_argv.append(tok)
+        i += 1
+    cl = _client(args.master)
+    app_id = cl.submit(submit_argv, num_processes=args.processes,
+                       supervise=args.supervise)
+    print(json.dumps({"app_id": app_id, "master": args.master,
+                      "num_processes": args.processes,
+                      "supervise": bool(args.supervise)}))
+    if args.no_wait:
+        return 0
+    try:
+        st = wait_app(args.master, app_id, timeout_s=args.wait_timeout)
+    except TimeoutError:
+        print(json.dumps({"app_id": app_id, "state": "TIMEOUT",
+                          "wait_timeout_s": args.wait_timeout}))
+        return 1
+    print(json.dumps({"app_id": app_id, "state": st["state"],
+                      "exits": st["exits"]}))
+    return 0 if st["state"] == "FINISHED" else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     if os.environ.get("ASYNCTPU_FORCE_CPU"):
         # the local-cluster launcher's test-rig mode: the env var alone
@@ -539,6 +596,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
     args = build_parser().parse_args(argv)
+    if args.master:
+        return _submit_to_master(args, argv)
     conf = parse_conf_overlays(args.conf)
     summary = run_driver(args, conf)
     trajectory = summary.pop("trajectory")

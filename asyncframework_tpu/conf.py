@@ -186,3 +186,9 @@ SHUFFLE_SPILL_BYTES = ConfigEntry(
     "Driver-side shuffle routing buffer bound; past it routed entries "
     "spill to disk runs (0 = unbounded) -- "
     "SortShuffleManager/UnifiedMemoryManager role.")
+SHUFFLE_DATA_PLANE = ConfigEntry(
+    "async.shuffle.data.plane", "auto", str,
+    "Array-pair reduce_by_key route: 'device' (jitted all_to_all shuffle), "
+    "'host' (vectorized numpy sort/bincount), or 'auto' -- device on "
+    "accelerator backends, host on CPU (the measured winner per rig; see "
+    "ops/shuffle.py).")

@@ -228,17 +228,44 @@ class PairOpsMixin:
 
         ``op`` may be a callable (host path: arbitrary Python keys/values,
         driver-routed) or one of ``'sum'|'max'|'min'`` with array-typed
-        partitions (``from_array_pairs``), which takes the DEVICE shuffle:
-        hash partitioning, the exchange (one ``lax.all_to_all`` over the
-        device mesh), and both reduces all run as jitted XLA
-        (ops/shuffle.py -- the SortShuffleManager-role data plane).
+        partitions (``from_array_pairs``), which takes the ARRAY data
+        plane.  The route is the measured winner per backend
+        (``async.shuffle.data.plane``, default ``auto``):
+
+        - accelerator backends -> the DEVICE shuffle: hash partitioning,
+          one ``lax.all_to_all`` exchange, jitted segment reduces
+          (ops/shuffle.py -- the SortShuffleManager-role data plane);
+        - CPU backend -> the vectorized HOST shuffle (numpy
+          bincount/sort+reduceat).  Rig measurements (ROUND5.md): on 10M
+          pairs the host-vectorized path is ~10x the driver-routed dict
+          path, while the device path's collective is EMULATED on CPU and
+          loses to both -- so ``auto`` only takes the device route when a
+          real accelerator backs it.
         """
         if isinstance(op, str):
-            return self._reduce_by_key_device(op, distinct_hint)
+            from asyncframework_tpu.conf import (
+                SHUFFLE_DATA_PLANE,
+                global_conf,
+            )
+
+            plane = str(global_conf().get(SHUFFLE_DATA_PLANE))
+            if plane not in ("auto", "host", "device"):
+                raise ValueError(
+                    f"async.shuffle.data.plane={plane!r}: must be "
+                    "'auto', 'host', or 'device'"
+                )
+            if plane == "auto":
+                import jax
+
+                plane = ("host" if jax.default_backend() == "cpu"
+                         else "device")
+            if plane == "host":
+                return self._reduce_by_key_arrays("host", op)
+            return self._reduce_by_key_arrays("device", op, distinct_hint)
         return self.combine_by_key(lambda v: v, op, op, num_partitions)
 
-    def _reduce_by_key_device(self, op: str, distinct_hint=None):
-        from asyncframework_tpu.ops.shuffle import device_reduce_by_key
+    def _reduce_by_key_arrays(self, plane: str, op: str, distinct_hint=None):
+        from asyncframework_tpu.ops import shuffle as _shuffle
 
         blocks = self._run_sync(lambda wid: (lambda w=wid: self._compute(w)))
         parts = {}
@@ -255,7 +282,12 @@ class PairOpsMixin:
                     "pass a callable op for the host path"
                 )
             parts[wid] = kv
-        out = device_reduce_by_key(parts, op=op, distinct_hint=distinct_hint)
+        if plane == "host":
+            out = _shuffle.host_reduce_by_key(parts, op=op)
+        else:
+            out = _shuffle.device_reduce_by_key(
+                parts, op=op, distinct_hint=distinct_hint
+            )
         return type(self).from_partitions(
             self.scheduler, {pid: [kv] for pid, kv in out.items()}
         )

@@ -54,6 +54,7 @@ class Master:
         persistence_dir: Optional[str] = None,
         worker_timeout_s: float = WORKER_TIMEOUT_S,
         ha: bool = False,
+        ui_port: Optional[int] = None,
     ):
         self.host = host
         self._srv = socket.create_server((host, port))
@@ -92,6 +93,8 @@ class Master:
         else:
             self.active = True
             self._recover()
+        self._ui_port = ui_port
+        self._ui = None
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Master":
@@ -108,7 +111,32 @@ class Master:
                                   name="master-election", daemon=True)
             t3.start()
             self._threads.append(t3)
+        if self._ui_port is not None:
+            self._ui = MasterUIServer(self, port=self._ui_port)
         return self
+
+    def status_snapshot(self) -> Dict:
+        """Cluster state for the web UI / ops tooling (MasterPage role)."""
+        with self._lock:
+            return {
+                "address": self.address,
+                "active": self.active,
+                "workers": {
+                    wid: {"host": w["host"], "cores": w["cores"],
+                          "alive": w["alive"]}
+                    for wid, w in self.workers.items()
+                },
+                "apps": {
+                    app_id: {
+                        "state": a["state"],
+                        "num_processes": a["num_processes"],
+                        "supervise": a.get("supervise", False),
+                        "exits": dict(a["exits"]),
+                        "argv": list(a["argv"])[:6],
+                    }
+                    for app_id, a in self.apps.items()
+                },
+            }
 
     def _election_loop(self) -> None:
         if not self.election.acquire_blocking(self._stop,
@@ -125,6 +153,8 @@ class Master:
         self._stop.set()
         if self.election is not None:
             self.election.release()
+        if self._ui is not None:
+            self._ui.stop()
         try:
             self._srv.close()
         except OSError:
@@ -397,6 +427,88 @@ class Master:
         return {"op": "KILLED", "app_id": app_id}
 
 
+_UI_HTML = """<!doctype html><html><head><title>async master</title>
+<meta http-equiv="refresh" content="2">
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 10px;text-align:left}
+.ok{color:#070}.bad{color:#b00}</style></head><body>
+<h2>async master <span id="addr"></span></h2>
+<h3>workers</h3><table id="w"><tr><th>id</th><th>host</th><th>cores</th>
+<th>alive</th></tr></table>
+<h3>applications</h3><table id="a"><tr><th>id</th><th>state</th>
+<th>procs</th><th>supervise</th><th>exits</th><th>argv</th></tr></table>
+<script>
+// textContent only: app argv and worker hosts are CLIENT-supplied strings
+// and must never be interpreted as markup in the operator's browser
+function row(tbl, cells, cls) {
+ const r = tbl.insertRow();
+ cells.forEach((v, i) => {
+  const c = r.insertCell();
+  c.textContent = String(v);
+  if (cls && cls[i]) c.className = cls[i];
+ });
+}
+fetch('/api/status').then(r=>r.json()).then(s=>{
+ document.getElementById('addr').textContent=
+   s.address+(s.active?' (active)':' (standby)');
+ const w=document.getElementById('w');
+ for(const [id,x] of Object.entries(s.workers))
+  row(w, [id, x.host, x.cores, x.alive],
+      [null, null, null, x.alive ? 'ok' : 'bad']);
+ const a=document.getElementById('a');
+ for(const [id,x] of Object.entries(s.apps))
+  row(a, [id, x.state, x.num_processes, x.supervise,
+          JSON.stringify(x.exits), x.argv.join(' ')]);
+});
+</script></body></html>"""
+
+
+class MasterUIServer:
+    """Master web page (``deploy/master/ui/MasterPage.scala`` role): the
+    cluster's workers and applications over plain HTTP -- ``/api/status``
+    JSON plus an auto-refreshing HTML table at ``/``."""
+
+    def __init__(self, master: "Master", port: int = 0,
+                 host: str = "127.0.0.1"):
+        import http.server
+        import json as _json
+
+        outer = master
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code, body, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path == "/api/status":
+                    body = _json.dumps(outer.status_snapshot()).encode()
+                    self._send(200, body, "application/json")
+                elif self.path in ("/", "/index.html"):
+                    self._send(200, _UI_HTML.encode(), "text/html")
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+            def log_message(self, *a):  # quiet: no stderr per request
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="master-ui", daemon=True).start()
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+
 def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
     import argparse
     import sys
@@ -409,11 +521,14 @@ def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
                    help="race for the persistence-dir lease; serve as "
                         "standby until won (kill the active master and "
                         "this one takes over)")
+    p.add_argument("--ui-port", type=int, default=None,
+                   help="serve the master status page on this port")
     args = p.parse_args(argv)
     m = Master(args.host, args.port, args.persistence_dir,
-               ha=args.ha).start()
+               ha=args.ha, ui_port=args.ui_port).start()
     print(f"master listening on {m.address}"
-          + (" (ha)" if args.ha else ""), flush=True)
+          + (" (ha)" if args.ha else "")
+          + (f" ui:{m._ui.port}" if m._ui is not None else ""), flush=True)
     try:
         while True:
             time.sleep(1)

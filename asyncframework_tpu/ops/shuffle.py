@@ -23,7 +23,16 @@ Pipeline (per device, all inside one shard_map):
 
 Keys must be non-negative int32/int64 (word ids, user ids -- the shapes the
 data plane exists for); arbitrary Python keys stay on the host path.
-Single-device meshes skip the collective (everything is already local).
+Single-device meshes skip the collective and run ONE fused
+sort + segment-reduce over the concatenated blocks (round 5: a single
+dispatch -- on a tunneled chip the per-dispatch RTT dominates the old
+per-partition multi-stage pipeline).
+
+:func:`host_reduce_by_key` is the vectorized HOST twin (numpy
+bincount / sort+reduceat) for CPU backends, where round 3 measured the
+emulated collective losing 2.4-9x to host execution.  The dispatch rule
+lives in ``data/pairs.py`` (``async.shuffle.data.plane``); measured
+crossover on this rig is recorded in ROUND5.md.
 """
 
 from __future__ import annotations
@@ -116,6 +125,62 @@ def _bucket(keys: jax.Array, vals: jax.Array, p: int, cap: int):
     return bk, bv
 
 
+def host_reduce_by_key(
+    parts: Dict[int, Tuple[np.ndarray, np.ndarray]],
+    op: str = "sum",
+) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+    """Vectorized host shuffle-reduce: the same contract as
+    :func:`device_reduce_by_key` (key-mod-P output partitioning) computed
+    with numpy -- ``bincount`` when the key range is dense enough, else one
+    stable sort + ``reduceat``.  The CPU-backend winner: ~10x the
+    driver-routed dict path and well ahead of the EMULATED collective on
+    10M pairs (ROUND5.md)."""
+    if op not in _OPS:
+        raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
+    pids = sorted(parts)
+    p = len(pids)
+    if p == 0:
+        return {}
+    ks = np.concatenate([np.asarray(parts[pid][0]) for pid in pids])
+    vs = np.concatenate([np.asarray(parts[pid][1]) for pid in pids])
+    if ks.size == 0:
+        return {pid: (ks[:0], vs[:0]) for pid in pids}
+    uk = uv = None
+    if op == "sum" and ks.dtype.kind in "iu":
+        kmax = int(ks.max())
+        # dense-enough key space: one bincount beats the sort.  Bound the
+        # count/sum temporaries by the INPUT size (not a multiple of it):
+        # a sparse 40M-key space over 10M pairs would otherwise allocate
+        # ~640 MB of scratch where the sort path needs none
+        if kmax + 1 <= max(ks.size, 1 << 20):
+            present = np.bincount(ks, minlength=kmax + 1) > 0
+            sums = np.bincount(ks, weights=vs, minlength=kmax + 1)
+            uk = np.nonzero(present)[0].astype(ks.dtype)
+            uv = sums[uk].astype(vs.dtype, copy=False)
+    if uk is None:
+        order = np.argsort(ks, kind="stable")
+        sk, sv = ks[order], vs[order]
+        first = np.ones(sk.size, bool)
+        first[1:] = sk[1:] != sk[:-1]
+        idx = np.nonzero(first)[0]
+        uk = sk[idx]
+        red = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
+        uv = red.reduceat(sv, idx).astype(vs.dtype, copy=False)
+    t = uk % p
+    order2 = np.argsort(t, kind="stable")
+    st, suk, suv = t[order2], uk[order2], uv[order2]
+    bounds = np.searchsorted(st, np.arange(p + 1))
+    return {
+        pid: (suk[bounds[i]:bounds[i + 1]], suv[bounds[i]:bounds[i + 1]])
+        for i, pid in enumerate(pids)
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("op", "out_cap"))
+def _segment_reduce_kernel(keys, vals, op, out_cap):
+    return _segment_reduce(keys, vals, op, out_cap)
+
+
 def device_reduce_by_key(
     parts: Dict[int, Tuple[jax.Array, jax.Array]],
     op: str = "sum",
@@ -142,27 +207,14 @@ def device_reduce_by_key(
     p = len(pids)
     if p == 0:
         return {}
-    # pad local blocks to one common length so every device runs the same
-    # program (static shapes)
     n_max = max(int(parts[pid][0].shape[0]) for pid in pids)
     n_max = max(n_max, 1)
     key_dt = jnp.asarray(parts[pids[0]][0]).dtype
     val_dt = jnp.asarray(parts[pids[0]][1]).dtype
-    padded_k: List[jax.Array] = []
-    padded_v: List[jax.Array] = []
-    for pid in pids:
-        k, v = parts[pid]
-        k = jnp.asarray(k)
-        v = jnp.asarray(v)
-        pad = n_max - k.shape[0]
-        if pad:
-            k = jnp.concatenate([k, jnp.full(pad, SENTINEL, key_dt)])
-            v = jnp.concatenate([v, jnp.zeros(pad, val_dt)])
-        padded_k.append(k)
-        padded_v.append(v)
 
     devs = []
-    for pid, k in zip(pids, padded_k):
+    for pid in pids:
+        k = jnp.asarray(parts[pid][0])
         devs.append(list(k.devices())[0] if hasattr(k, "devices") else None)
     distinct = len(set(devs)) == p and None not in devs
 
@@ -174,6 +226,20 @@ def device_reduce_by_key(
     out_cap = p * cap
 
     if distinct and p > 1:
+        # pad local blocks to one common length so every device runs the
+        # same program (static shapes)
+        padded_k: List[jax.Array] = []
+        padded_v: List[jax.Array] = []
+        for pid in pids:
+            k, v = parts[pid]
+            k = jnp.asarray(k)
+            v = jnp.asarray(v)
+            pad = n_max - k.shape[0]
+            if pad:
+                k = jnp.concatenate([k, jnp.full(pad, SENTINEL, key_dt)])
+                v = jnp.concatenate([v, jnp.zeros(pad, val_dt)])
+            padded_k.append(k)
+            padded_v.append(v)
         mesh = Mesh(np.array([d for d in devs]), ("w",))
 
         @functools.partial(
@@ -211,20 +277,25 @@ def device_reduce_by_key(
             out[pid] = (ok_h[i][keep], ov_h[i][keep])
         return out
 
-    # shared-device (or host-backed) path: same kernels, no collective --
-    # bucketing still determines each pair's output partition
-    combined = [
-        _segment_reduce(k, v, op, comb)
-        for k, v in zip(padded_k, padded_v)
-    ]
-    buckets = [_bucket(ck, cv, p, cap) for ck, cv in combined]
-    out = {}
-    for i, pid in enumerate(pids):
-        rk = jnp.concatenate([bk[i] for bk, _bv in buckets])
-        rv = jnp.concatenate([bv[i] for _bk, bv in buckets])
-        ok, ov = _segment_reduce(rk, rv, op, out_cap)
-        ok_h = np.asarray(ok)
-        ov_h = np.asarray(ov)
-        keep = ok_h != SENTINEL
-        out[pid] = (ok_h[keep], ov_h[keep])
-    return out
+    # shared-device (or host-backed) path: the blocks already live
+    # together, so the whole shuffle is ONE fused sort + segment-reduce
+    # over the concatenated pairs (single dispatch; round 3's
+    # per-partition pipeline paid ~3 kernel launches x P, which a tunneled
+    # chip turns into milliseconds of RTT each), then a tiny host split of
+    # the distinct set by key mod P
+    n_total = sum(int(parts[pid][0].shape[0]) for pid in pids)
+    if n_total == 0:
+        empty_k = np.empty(0, np.dtype(key_dt))
+        empty_v = np.empty(0, np.dtype(val_dt))
+        return {pid: (empty_k, empty_v) for pid in pids}
+    gk = jnp.concatenate([jnp.asarray(parts[pid][0]) for pid in pids])
+    gv = jnp.concatenate([jnp.asarray(parts[pid][1]) for pid in pids])
+    cap_global = (n_total if distinct_hint is None
+                  else min(n_total, int(distinct_hint) * p))
+    ok, ov = _segment_reduce_kernel(gk, gv, op=op, out_cap=cap_global)
+    ok_h = np.asarray(ok)
+    ov_h = np.asarray(ov)
+    keep = ok_h != SENTINEL
+    uk, uv = ok_h[keep], ov_h[keep]
+    t = uk % p
+    return {pid: (uk[t == i], uv[t == i]) for i, pid in enumerate(pids)}

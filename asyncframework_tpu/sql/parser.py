@@ -66,7 +66,7 @@ _KEYWORDS = {
     "OUTER", "SEMI", "ANTI", "ASC", "DESC", "DISTINCT", "HAVING",
     "OVER", "PARTITION", "UNION", "ALL", "EXCEPT", "INTERSECT", "CASE",
     "WHEN", "THEN", "ELSE", "END", "BETWEEN", "IN", "LIKE", "IS", "NULL",
-    "CAST", "WITH",
+    "CAST", "WITH", "EXPLAIN",
 }
 
 _WINDOW_ONLY_FNS = {
@@ -1094,6 +1094,12 @@ class SQLContext:
     # ----------------------------------------------------------------- query
     def sql(self, text: str) -> ColumnarFrame:
         p = _Parser(tokenize(text), self)
+        if p.accept("EXPLAIN"):
+            # SQL-surface EXPLAIN (Spark's `EXPLAIN SELECT ...`): the
+            # optimized plan as a one-column frame, without executing the
+            # FROM-position relations
+            lines = self._explain_parser(p).splitlines()
+            return ColumnarFrame({"plan": np.asarray(lines, object)})
         frame = p.statement()
         if p.peek() is not None:
             raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
@@ -1104,7 +1110,12 @@ class SQLContext:
         public plan-shape artifact (``Dataset.explain`` analog).  Value
         subqueries (IN (...) / scalar) still execute during planning;
         FROM-position relations do not."""
-        p = _Parser(tokenize(text), self)
+        return self._explain_parser(_Parser(tokenize(text), self))
+
+    @staticmethod
+    def _explain_parser(p: "_Parser") -> str:
+        """Plan text from an already-positioned parser (one pipeline for
+        both ``explain()`` and ``EXPLAIN SELECT ...``)."""
         node = p.statement_plan()
         if p.peek() is not None:
             raise ValueError(f"trailing SQL tokens: {self_rest(p)}")

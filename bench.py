@@ -512,7 +512,72 @@ def run_fallback(names, deadline) -> dict:
             "fused", "note",
         )}
         block["configs"][name] = keep
+    try:
+        block["microbench"] = _fallback_microbench(env)
+    except Exception as e:  # evidence-only: never fail the artifact on it
+        block["microbench"] = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
     return block
+
+
+def _fallback_microbench(env: dict) -> dict:
+    """Small rig microbenches for the fallback artifact: the 2M-pair
+    wordcount through the dispatch-routed shuffle plane, and GROUP BY vs
+    pandas -- the CPU-measurable halves of the round-5 perf story, captured
+    in a driver artifact instead of round-log prose."""
+    code = r"""
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from asyncframework_tpu.ops.shuffle import host_reduce_by_key
+from asyncframework_tpu.sql import ColumnarFrame
+
+out = {}
+rs = np.random.default_rng(1)
+n, vocab, P = 2_000_000, 100_000, 8
+keys = rs.integers(0, vocab, size=n).astype(np.int32)
+vals = np.ones(n, np.float32)
+per = n // P
+blocks = {w: (keys[w*per:(w+1)*per], vals[w*per:(w+1)*per])
+          for w in range(P)}
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    host_reduce_by_key(blocks, op="sum")
+    ts.append(time.perf_counter() - t0)
+out["wordcount_2m_host_vectorized_s"] = round(sorted(ts)[1], 4)
+
+k = rs.integers(0, 1000, size=2_000_000).astype(np.int64)
+v = rs.normal(size=2_000_000).astype(np.float32)
+f = ColumnarFrame({"k": k, "v": v})
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    f.groupby("k").agg(s=("v", "sum"))
+    ts.append(time.perf_counter() - t0)
+out["groupby_2m_s"] = round(sorted(ts)[1], 4)
+try:
+    import pandas as pd
+    df = pd.DataFrame({"k": k, "v": v})
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        df.groupby("k")["v"].sum()
+        ts.append(time.perf_counter() - t0)
+    out["groupby_2m_pandas_s"] = round(sorted(ts)[1], 4)
+except Exception:
+    pass
+print(json.dumps(out))
+"""
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    line = next((l for l in reversed(res.stdout.splitlines())
+                 if l.startswith("{")), None)
+    if line is None:
+        return {"error": f"rc={res.returncode}: {res.stderr[-200:]}"}
+    return json.loads(line)
 
 
 def run_parent() -> None:

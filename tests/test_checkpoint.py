@@ -158,6 +158,7 @@ class TestSolverResume:
             ASAGA(X, y, resume_cfg(tmp_path, 60, gamma=0.5),
                   devices=devices8).run()
 
+    @pytest.mark.slow
     def test_asgd_resume_noop_when_complete(self, devices8, tmp_path):
         X, y, _ = make_regression(1024, 16, seed=4)
         ASGD(X, y, resume_cfg(tmp_path, 60), devices=devices8).run()

@@ -82,6 +82,7 @@ class TestHostileInput:
                 codec.decompress(c, backend=backend)
 
     @pytest.mark.parametrize("backend", BACKENDS)
+    @pytest.mark.slow
     def test_fuzz_never_crashes(self, backend):
         rs = np.random.default_rng(1)
         for _ in range(200):

@@ -137,6 +137,58 @@ class TestAppLifecycle:
         assert st["state"] == "KILLED"  # nonzero exits did not relabel it
 
 
+class TestSubmitCLIMasterMode:
+    def test_cli_master_submit_waits_to_finished(self, rig, capsys):
+        """spark-submit --master parity: the SAME CLI surface ships the
+        recipe to the daemon master, waits, and exits 0 on FINISHED."""
+        import json as _json
+
+        from asyncframework_tpu.cli import main as cli_main
+
+        m, _ = rig
+        rc = cli_main([
+            "--master", f"127.0.0.1:{m.port}", "--processes", "2",
+            "--supervise", "--quiet",
+            "sgd-mllib", "synthetic", "synthetic",
+            "16", "512", "4", "20", "1.0", "0", "0.5", "0.5",
+            "10", "0", "42",
+        ])
+        assert rc == 0
+        lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        sub = _json.loads(lines[0])
+        fin = _json.loads(lines[-1])
+        assert sub["supervise"] is True and sub["num_processes"] == 2
+        assert fin["state"] == "FINISHED"
+        assert all(rc == 0 for rc in fin["exits"].values())
+        # the master recorded the supervise flag on the app
+        assert m.apps[sub["app_id"]]["supervise"] is True
+
+
+class TestMasterUI:
+    def test_status_page_and_api(self, tmp_path):
+        import json as _json
+        import urllib.request
+
+        from asyncframework_tpu.deploy import Master, Worker
+
+        m = Master(persistence_dir=str(tmp_path), ui_port=0).start()
+        w = Worker("127.0.0.1", m.port, worker_id="w0",
+                   heartbeat_s=0.3).start()
+        try:
+            base = f"http://127.0.0.1:{m._ui.port}"
+            with urllib.request.urlopen(base + "/api/status", timeout=5) as r:
+                st = _json.loads(r.read())
+            assert st["active"] is True
+            assert "w0" in st["workers"]
+            with urllib.request.urlopen(base + "/", timeout=5) as r:
+                html = r.read().decode()
+            assert "async master" in html
+        finally:
+            w.stop()
+            m.stop()
+
+
 class TestMasterRecovery:
     def test_state_survives_master_restart(self, tmp_path):
         m = Master(persistence_dir=str(tmp_path), worker_timeout_s=2.0).start()
@@ -184,6 +236,7 @@ class TestMasterRecovery:
             m2.stop()
 
 
+@pytest.mark.slow
 class TestStandbyFailover:
     def test_kill_active_master_standby_takes_over_app_finishes(
         self, tmp_path

@@ -60,6 +60,24 @@ def test_log_topic_example():
     assert replayed == []             # committed offsets: nothing replays
 
 
+def test_network_topic_example(capsys):
+    import network_topic_stream
+
+    network_topic_stream.main(n_events=400, per_batch=100)
+    out = capsys.readouterr().out
+    assert "consumed exactly once" in out
+
+
+def test_sql_explain_example(capsys):
+    import sql_explain_optimizer
+
+    sql_explain_optimizer.main()
+    out = capsys.readouterr().out
+    assert "Scan(dim)" in out                 # reorder visible
+    assert "SetOp(union_all)" in out
+    assert out.count("Shared(s)") == 2        # execute-once CTE
+
+
 def test_sql_example():
     import sql_pipeline
 
@@ -82,6 +100,7 @@ def test_sparse_asgd_example():
     assert res.accepted == 60
 
 
+@pytest.mark.slow
 def test_staleness_experiment_example():
     import staleness_experiment
 

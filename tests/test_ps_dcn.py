@@ -179,6 +179,7 @@ class TestASAGAInProcess:
         )
 
 
+@pytest.mark.slow
 class TestSparseDCN:
     """rcv1-shaped shards over the DCN wire (VERDICT r3 item 4): sparse
     worker steps + (idx, val) pair PUSH encoding with wire bytes well under

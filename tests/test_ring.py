@@ -84,6 +84,7 @@ class TestRingAttention:
         assert ring_attention(qb, kb, vb, sp_mesh).dtype == jnp.bfloat16
 
     @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.slow
     def test_gradients_match_reference(self, rng, sp_mesh, causal):
         """Differentiability through ppermute + fori_loop (training path)."""
         q, k, v = make_qkv(rng, b=1, t=32, h=4, d=8)

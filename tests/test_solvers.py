@@ -296,6 +296,7 @@ class TestMiniBatchSGD2D:
 class TestBF16AndFlops:
     """bf16-with-f32-accumulate data path + counted-flops instrumentation."""
 
+    @pytest.mark.slow
     def test_bf16_dataset_converges(self, devices8):
         from asyncframework_tpu.data.sharded import ShardedDataset
 

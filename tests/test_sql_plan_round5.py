@@ -474,6 +474,30 @@ class TestSortLimitDistinctNodes:
         assert sorted(a for (a,) in out.collect()) == [2, 3]
 
 
+class TestExplainStatement:
+    def test_explain_returns_plan_frame(self):
+        ctx = SQLContext()
+        ctx.register("t", ColumnarFrame({
+            "a": np.asarray([1, 2, 3], np.int32),
+            "b": np.asarray([1.0, 2.0, 3.0], np.float32),
+        }))
+        out = ctx.sql("EXPLAIN SELECT a FROM t WHERE b > 1 ORDER BY a")
+        assert out.columns == ["plan"]
+        txt = "\n".join(np.asarray(out["plan"]).tolist())
+        assert "Sort" in txt and "Compute" in txt and "Filter" in txt
+
+    def test_explain_matches_context_explain(self):
+        ctx = SQLContext()
+        ctx.register("t", ColumnarFrame({
+            "a": np.asarray([1, 2], np.int32),
+        }))
+        q = "SELECT a FROM t LIMIT 1"
+        via_stmt = "\n".join(
+            np.asarray(ctx.sql("EXPLAIN " + q)["plan"]).tolist()
+        )
+        assert via_stmt == ctx.explain(q)
+
+
 class TestDerivedTableLaziness:
     def test_pushdown_crosses_derived_table(self, tmp_path):
         path = tmp_path / "t.csv"
