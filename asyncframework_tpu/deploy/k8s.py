@@ -1,0 +1,324 @@
+"""Kubernetes adapter: render the standalone cluster as k8s manifests.
+
+Parity (studied, not copied): the reference's k8s resource manager
+(``resource-managers/kubernetes/.../submit/KubernetesClientApplication.scala:90,188``
+-- ``Client.run`` builds a driver pod spec from the submission and creates
+it via the API; ``DriverConfigOrchestrator.scala`` assembles the spec
+steps).  Same capability here, re-shaped for this runtime: the cluster's
+own daemons (master with HA + flock lease, workers, topic server) ARE the
+long-lived services, so the adapter's job is to **render deterministic
+manifests** that place them on a cluster, plus a Job spec per application
+submission that runs the stock ``--master`` CLI against the master
+Service.  Rendering is pure (dict -> YAML via pyyaml), testable without a
+cluster, and applied with plain ``kubectl apply -f`` -- this build
+deliberately has no API-server client: zero-egress environments and the
+operator's existing kubectl auth make "generate, then apply" the honest
+interface (the reference's in-process fabric8 client exists because
+spark-submit must watch the driver pod; our `--wait` polling rides the
+master protocol instead).
+
+Rendered topology:
+
+- ``master``: Deployment (1 replica, or N with ``--ha`` sharing a PVC for
+  the lease + persistence) + a Service exposing the RPC and UI ports.
+- ``workers``: Deployment with ``replicas`` pods of ``bin/async-worker``
+  pointed at the master Service (heartbeat re-registration makes pod
+  churn safe; supervised executors restart inside the pod).
+- ``topic-server`` (optional): Deployment + Service for the network
+  streaming source.
+- per-app **Job**: one pod running ``bin/async-submit --master ...`` with
+  the recipe argv; ``backoffLimit: 0`` (the daemons own retries via
+  ``--supervise``, a failed submission should surface, not loop).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import yaml
+
+DEFAULT_IMAGE = "asyncframework-tpu:latest"
+RPC_PORT = 7077
+UI_PORT = 8080
+
+
+def _meta(name: str, app: str, namespace: str) -> dict:
+    return {
+        "name": name,
+        "namespace": namespace,
+        "labels": {"app.kubernetes.io/part-of": "asyncframework-tpu",
+                   "app.kubernetes.io/component": app},
+    }
+
+
+def _container(name: str, image: str, command: List[str],
+               ports: Optional[List[int]] = None,
+               resources: Optional[dict] = None,
+               volume_mounts: Optional[List[dict]] = None) -> dict:
+    c: dict = {"name": name, "image": image, "command": command}
+    if ports:
+        c["ports"] = [{"containerPort": p} for p in ports]
+    if resources:
+        c["resources"] = resources
+    if volume_mounts:
+        c["volumeMounts"] = volume_mounts
+    return c
+
+
+def render_master(namespace: str = "default", image: str = DEFAULT_IMAGE,
+                  ha_replicas: int = 1, pvc: str = "async-master-state",
+                  ui: bool = True) -> List[dict]:
+    """Master Deployment + Service (+ PVC when HA).  HA replicas share the
+    persistence PVC; the flock lease elects exactly one active master and
+    standbys answer STANDBY until takeover (deploy/leader.py)."""
+    if ha_replicas < 1:
+        raise ValueError("ha_replicas must be >= 1")
+    cmd = ["python", "-m", "asyncframework_tpu.deploy.master",
+           "--host", "0.0.0.0", "--port", str(RPC_PORT),
+           "--persistence-dir", "/state"]
+    if ha_replicas > 1:
+        cmd.append("--ha")
+    if ui:
+        cmd += ["--ui-port", str(UI_PORT)]
+    objs: List[dict] = []
+    objs.append({
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": _meta(pvc, "master", namespace),
+        "spec": {
+            # HA standbys on other nodes need a shared filesystem for the
+            # flock lease + recovery state (the ZooKeeper-ensemble role)
+            "accessModes": ["ReadWriteMany" if ha_replicas > 1
+                            else "ReadWriteOnce"],
+            "resources": {"requests": {"storage": "1Gi"}},
+        },
+    })
+    objs.append({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta("async-master", "master", namespace),
+        "spec": {
+            "replicas": ha_replicas,
+            "selector": {"matchLabels": {"app": "async-master"}},
+            "template": {
+                "metadata": {"labels": {"app": "async-master"}},
+                "spec": {
+                    "containers": [_container(
+                        "master", image, cmd,
+                        ports=[RPC_PORT] + ([UI_PORT] if ui else []),
+                        volume_mounts=[{"name": "state",
+                                        "mountPath": "/state"}],
+                    )],
+                    "volumes": [{
+                        "name": "state",
+                        "persistentVolumeClaim": {"claimName": pvc},
+                    }],
+                },
+            },
+        },
+    })
+    ports = [{"name": "rpc", "port": RPC_PORT, "targetPort": RPC_PORT}]
+    if ui:
+        ports.append({"name": "ui", "port": UI_PORT, "targetPort": UI_PORT})
+    objs.append({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": _meta("async-master", "master", namespace),
+        "spec": {"selector": {"app": "async-master"}, "ports": ports},
+    })
+    return objs
+
+
+def render_workers(replicas: int, namespace: str = "default",
+                   image: str = DEFAULT_IMAGE, cores: int = 1,
+                   resources: Optional[dict] = None) -> List[dict]:
+    """Worker Deployment: each pod runs one worker daemon registered to the
+    master Service.  Pod churn is safe -- heartbeats re-register and the
+    master reaps the dead (Worker.scala's reconnect dance)."""
+    if replicas < 1:
+        raise ValueError("replicas must be >= 1")
+    cmd = ["python", "-m", "asyncframework_tpu.deploy.worker",
+           f"async-master:{RPC_PORT}", "--cores", str(cores)]
+    return [{
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta("async-workers", "worker", namespace),
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": "async-worker"}},
+            "template": {
+                "metadata": {"labels": {"app": "async-worker"}},
+                "spec": {"containers": [_container(
+                    "worker", image, cmd,
+                    resources=resources or {
+                        "limits": {"google.com/tpu": 1},
+                    },
+                )]},
+            },
+        },
+    }]
+
+
+def render_topic_server(namespace: str = "default",
+                        image: str = DEFAULT_IMAGE,
+                        port: int = 9092,
+                        pvc: str = "async-topics") -> List[dict]:
+    """Network LogTopic server (the broker-less streaming source) with a
+    PVC for the durable segments."""
+    return [
+        {
+            "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": _meta(pvc, "topic-server", namespace),
+            "spec": {"accessModes": ["ReadWriteOnce"],
+                     "resources": {"requests": {"storage": "10Gi"}}},
+        },
+        {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": _meta("async-topic-server", "topic-server",
+                              namespace),
+            "spec": {
+                "replicas": 1,  # single-writer discipline IS the server
+                "selector": {"matchLabels": {"app": "async-topic-server"}},
+                "template": {
+                    "metadata": {"labels": {"app": "async-topic-server"}},
+                    "spec": {
+                        "containers": [_container(
+                            "topic-server", image,
+                            ["python", "-m",
+                             "asyncframework_tpu.streaming.log_net",
+                             "--root", "/topics", "--host", "0.0.0.0",
+                             "--port", str(port)],
+                            ports=[port],
+                            volume_mounts=[{"name": "topics",
+                                            "mountPath": "/topics"}],
+                        )],
+                        "volumes": [{
+                            "name": "topics",
+                            "persistentVolumeClaim": {"claimName": pvc},
+                        }],
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": _meta("async-topic-server", "topic-server",
+                              namespace),
+            "spec": {"selector": {"app": "async-topic-server"},
+                     "ports": [{"name": "log", "port": port,
+                                "targetPort": port}]},
+        },
+    ]
+
+
+def render_app_job(name: str, argv: List[str], num_processes: int,
+                   namespace: str = "default", image: str = DEFAULT_IMAGE,
+                   supervise: bool = True,
+                   wait_timeout_s: float = 3600.0) -> List[dict]:
+    """One application as a k8s Job: the pod runs the stock ``--master``
+    CLI against the master Service and exits 0 only on FINISHED -- the
+    ``KubernetesClientApplication.Client.run`` role with the submission
+    CLI as the driver process."""
+    if not name or not argv:
+        raise ValueError("app job needs a name and a recipe argv")
+    cmd = ["python", "-m", "asyncframework_tpu.cli",
+           "--master", f"async-master:{RPC_PORT}",
+           "--processes", str(num_processes),
+           "--wait-timeout", str(wait_timeout_s)]
+    if supervise:
+        cmd.append("--supervise")
+    cmd += list(argv)
+    return [{
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": _meta(f"async-app-{name}", "app", namespace),
+        "spec": {
+            # the daemons own retries (--supervise); a failed SUBMISSION
+            # should surface, not loop
+            "backoffLimit": 0,
+            "template": {
+                "metadata": {"labels": {"app": f"async-app-{name}"}},
+                "spec": {
+                    "restartPolicy": "Never",
+                    "containers": [_container("submit", image, cmd)],
+                },
+            },
+        },
+    }]
+
+
+def render_cluster(workers: int, namespace: str = "default",
+                   image: str = DEFAULT_IMAGE, ha_replicas: int = 1,
+                   cores: int = 1, topic_server: bool = False
+                   ) -> Dict[str, str]:
+    """The whole standalone topology as {filename: yaml} -- apply with
+    ``kubectl apply -f <dir>``."""
+    out = {
+        "master.yaml": to_yaml(render_master(
+            namespace, image, ha_replicas=ha_replicas
+        )),
+        "workers.yaml": to_yaml(render_workers(
+            workers, namespace, image, cores=cores
+        )),
+    }
+    if topic_server:
+        out["topic-server.yaml"] = to_yaml(
+            render_topic_server(namespace, image)
+        )
+    return out
+
+
+def to_yaml(objs: List[dict]) -> str:
+    return "---\n".join(
+        yaml.safe_dump(o, sort_keys=False, default_flow_style=False)
+        for o in objs
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m asyncframework_tpu.deploy.k8s render --out DIR
+    --workers N [--ha N] [--image I] [--topic-server]`` and
+    ``... app --name n --processes P -- <recipe argv>``."""
+    import argparse
+    import os
+    import sys
+
+    ap = argparse.ArgumentParser("async-k8s")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("render", help="render the cluster manifests")
+    r.add_argument("--out", required=True)
+    r.add_argument("--workers", type=int, required=True)
+    r.add_argument("--ha", type=int, default=1, metavar="REPLICAS")
+    r.add_argument("--image", default=DEFAULT_IMAGE)
+    r.add_argument("--cores", type=int, default=1)
+    r.add_argument("--namespace", default="default")
+    r.add_argument("--topic-server", action="store_true")
+    a = sub.add_parser("app", help="render one application Job")
+    a.add_argument("--out", required=True)
+    a.add_argument("--name", required=True)
+    a.add_argument("--processes", type=int, default=2)
+    a.add_argument("--image", default=DEFAULT_IMAGE)
+    a.add_argument("--namespace", default="default")
+    a.add_argument("--no-supervise", action="store_true")
+    a.add_argument("argv", nargs="+", help="recipe argv after --")
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    if args.cmd == "render":
+        files = render_cluster(
+            args.workers, namespace=args.namespace, image=args.image,
+            ha_replicas=args.ha, cores=args.cores,
+            topic_server=args.topic_server,
+        )
+    else:
+        files = {f"app-{args.name}.yaml": to_yaml(render_app_job(
+            args.name, args.argv, args.processes,
+            namespace=args.namespace, image=args.image,
+            supervise=not args.no_supervise,
+        ))}
+    for fname, text in files.items():
+        path = os.path.join(args.out, fname)
+        with open(path, "w") as f:
+            f.write(text)
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""Kubernetes adapter (SURVEY §2.4 "Resource managers" row): manifest
+rendering for the standalone daemons + per-app submission Jobs.
+
+Parity bar: ``resource-managers/kubernetes/.../submit/
+KubernetesClientApplication.scala:90,188`` -- the reference builds driver
+pod specs from submissions; this build renders the equivalent specs as
+apply-able YAML (generate-then-kubectl, no in-process API client).
+Rendering is pure, so every property is testable without a cluster.
+"""
+
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from asyncframework_tpu.deploy import k8s
+
+
+def _load_all(text):
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+class TestMasterRendering:
+    def test_single_master(self):
+        objs = k8s.render_master()
+        kinds = [o["kind"] for o in objs]
+        assert kinds == ["PersistentVolumeClaim", "Deployment", "Service"]
+        dep = objs[1]
+        assert dep["spec"]["replicas"] == 1
+        cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert "--ha" not in cmd
+        assert "--persistence-dir" in cmd
+        svc = objs[2]
+        ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
+        assert ports == {"rpc": k8s.RPC_PORT, "ui": k8s.UI_PORT}
+
+    def test_ha_masters_share_rwx_state(self):
+        objs = k8s.render_master(ha_replicas=3)
+        pvc, dep, _svc = objs
+        assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+        assert dep["spec"]["replicas"] == 3
+        cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert "--ha" in cmd
+        mounts = dep["spec"]["template"]["spec"]["containers"][0][
+            "volumeMounts"
+        ]
+        assert mounts[0]["mountPath"] == "/state"
+
+    def test_bad_replicas_rejected(self):
+        with pytest.raises(ValueError):
+            k8s.render_master(ha_replicas=0)
+
+
+class TestWorkerRendering:
+    def test_workers_point_at_master_service(self):
+        (dep,) = k8s.render_workers(8, cores=2)
+        assert dep["spec"]["replicas"] == 8
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert f"async-master:{k8s.RPC_PORT}" in c["command"]
+        assert c["command"][c["command"].index("--cores") + 1] == "2"
+        assert c["resources"] == {"limits": {"google.com/tpu": 1}}
+
+    def test_custom_resources_pass_through(self):
+        (dep,) = k8s.render_workers(
+            2, resources={"limits": {"cpu": "4"}}
+        )
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert c["resources"] == {"limits": {"cpu": "4"}}
+
+
+class TestAppJob:
+    def test_job_runs_master_cli_with_supervise(self):
+        (job,) = k8s.render_app_job(
+            "eps", ["--quiet", "asgd", "synthetic", "synthetic", "16",
+                    "4096", "8", "400", "1.0", "2147483647", "0.3", "0.5",
+                    "50", "0", "42"],
+            num_processes=3,
+        )
+        assert job["kind"] == "Job"
+        assert job["spec"]["backoffLimit"] == 0
+        spec = job["spec"]["template"]["spec"]
+        assert spec["restartPolicy"] == "Never"
+        cmd = spec["containers"][0]["command"]
+        assert "--master" in cmd and f"async-master:{k8s.RPC_PORT}" in cmd
+        assert "--supervise" in cmd
+        assert cmd[cmd.index("--processes") + 1] == "3"
+        assert cmd[-1] == "42"  # recipe argv rides verbatim at the tail
+
+    def test_empty_argv_rejected(self):
+        with pytest.raises(ValueError):
+            k8s.render_app_job("x", [], 2)
+
+
+class TestClusterBundle:
+    def test_bundle_parses_and_covers_topology(self):
+        files = k8s.render_cluster(4, ha_replicas=2, topic_server=True)
+        assert set(files) == {"master.yaml", "workers.yaml",
+                              "topic-server.yaml"}
+        for text in files.values():
+            objs = _load_all(text)  # valid YAML, k8s-shaped
+            for o in objs:
+                assert {"apiVersion", "kind", "metadata", "spec"} <= set(o)
+                assert o["metadata"]["labels"][
+                    "app.kubernetes.io/part-of"
+                ] == "asyncframework-tpu"
+        ts = _load_all(files["topic-server.yaml"])
+        assert ts[1]["spec"]["replicas"] == 1  # single-writer discipline
+
+    def test_cli_render_writes_files(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "asyncframework_tpu.deploy.k8s",
+             "render", "--out", str(tmp_path), "--workers", "3",
+             "--ha", "2", "--topic-server"],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 0, out.stderr
+        written = sorted(p.name for p in tmp_path.iterdir())
+        assert written == ["master.yaml", "topic-server.yaml",
+                           "workers.yaml"]
+        for p in tmp_path.iterdir():
+            assert _load_all(p.read_text())
+
+    def test_cli_app_job(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "asyncframework_tpu.deploy.k8s",
+             "app", "--out", str(tmp_path), "--name", "eps",
+             "--processes", "3", "--",
+             "--quiet", "asgd", "synthetic", "synthetic", "16", "4096",
+             "8", "400", "1.0", "2147483647", "0.3", "0.5", "50", "0",
+             "42"],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 0, out.stderr
+        (job,) = _load_all((tmp_path / "app-eps.yaml").read_text())
+        assert job["kind"] == "Job"
