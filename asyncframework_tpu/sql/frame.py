@@ -149,10 +149,7 @@ class ColumnarFrame:
     def _take(self, idx: np.ndarray) -> "ColumnarFrame":
         out: Dict[str, object] = {}
         for name, arr in self._cols.items():
-            if isinstance(arr, jnp.ndarray):
-                out[name] = jnp.take(arr, jnp.asarray(idx), axis=0)
-            else:
-                out[name] = np.asarray(arr)[idx]
+            out[name] = _gather(arr, idx)
         return ColumnarFrame(out)
 
     def _row_records(self) -> np.ndarray:
@@ -351,16 +348,12 @@ class ColumnarFrame:
         else:
             lk, rk = _pack_join_keys(self, other, keys)
         if how in ("semi", "anti"):
-            r_sorted = np.sort(rk)
-            s = np.searchsorted(r_sorted, lk, "left")
-            e = np.searchsorted(r_sorted, lk, "right")
-            keep = (e > s) if how == "semi" else (e == s)
+            _s, cnt = _match_table(np.sort(rk), rk, lk)
+            keep = (cnt > 0) if how == "semi" else (cnt == 0)
             return self._take(np.where(keep)[0])
         r_order = np.argsort(rk, kind="stable")
         rk_sorted = rk[r_order]
-        start = np.searchsorted(rk_sorted, lk, "left")
-        end = np.searchsorted(rk_sorted, lk, "right")
-        counts = end - start
+        start, counts = _match_table(rk_sorted, rk, lk)
         matched = counts > 0
         # expand: for left row i with c matches, right rows r_order[start_i..]
         keep_left = how in ("left", "full")
@@ -392,10 +385,7 @@ class ColumnarFrame:
             right_src[out_name] = name
             src = other._cols[name]
             if len(rk):
-                if isinstance(src, jnp.ndarray):
-                    v = jnp.take(src, jnp.asarray(right_idx), axis=0)
-                else:
-                    v = np.asarray(src)[right_idx]
+                v = _gather(src, right_idx)
             else:  # no rows to gather from: build fill directly
                 v = (
                     jnp.zeros((total,), src.dtype)
@@ -425,11 +415,7 @@ class ColumnarFrame:
                         extra = np.asarray(other._cols[name])[miss]
                     elif name in right_src:
                         src = other._cols[right_src[name]]
-                        extra = (
-                            jnp.take(src, jnp.asarray(miss), axis=0)
-                            if isinstance(src, jnp.ndarray)
-                            else np.asarray(src)[miss]
-                        )
+                        extra = _gather(src, miss)
                     else:  # left-only column: all fills
                         src = self._cols[name]
                         extra = _mask_fill(
@@ -447,6 +433,45 @@ class ColumnarFrame:
                             [np.asarray(cur), np.asarray(extra)]
                         )
         return ColumnarFrame(out)
+
+
+def _gather(src, idx):
+    """Row gather routed by backend: ``jnp.take`` keeps device columns on
+    an accelerator; on the CPU backend numpy fancy indexing is 4-6x faster
+    (measured, ROUND5.md) and the frame constructor re-stages the result."""
+    if isinstance(src, jnp.ndarray):
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return np.asarray(src)[np.asarray(idx)]
+        return jnp.take(src, jnp.asarray(idx), axis=0)
+    return np.asarray(src)[idx]
+
+
+def _match_table(rk_sorted: np.ndarray, rk: np.ndarray, lk: np.ndarray):
+    """(start, count) of each left key's match run in the sorted right
+    keys.  Dense-enough integer keys take the O(1)-per-probe bincount
+    table (two binary-search passes over 2M probes cost ~1.3 s; the table
+    lookups ~70 ms -- ROUND5.md); anything else binary-searches."""
+    if (
+        lk.dtype.kind in "iu" and rk.dtype.kind in "iu"
+        and lk.size and rk.size
+    ):
+        lo = min(int(lk.min()), int(rk.min()))
+        hi = max(int(lk.max()), int(rk.max()))
+        span = hi - lo + 1
+        if span <= max(lk.size + rk.size, 1 << 20):
+            counts_per_key = np.bincount(rk - lo, minlength=span)
+            start_per_key = np.concatenate([
+                np.zeros(1, np.intp),
+                np.cumsum(counts_per_key)[:-1],
+            ])
+            probe = lk - lo
+            return (start_per_key[probe].astype(np.intp),
+                    counts_per_key[probe].astype(np.intp))
+    start = np.searchsorted(rk_sorted, lk, "left")
+    end = np.searchsorted(rk_sorted, lk, "right")
+    return start, end - start
 
 
 def _comparable_column(a: np.ndarray) -> np.ndarray:
@@ -496,12 +521,17 @@ def _pack_join_keys(left: "ColumnarFrame", right: "ColumnarFrame", keys):
 
 
 def _mask_fill(v, keep_mask: np.ndarray):
-    """NULL emulation for non-matching join rows: floats NaN, other device
-    dtypes 0, host columns the dtype's zero value."""
+    """NULL emulation for non-matching join rows: floats NaN (device OR
+    host-staged numpy -- the CPU gather path returns numpy for device
+    columns), other numeric dtypes 0, host string/object columns the
+    dtype's zero value."""
     if isinstance(v, jnp.ndarray) and jnp.issubdtype(v.dtype, jnp.floating):
         return jnp.where(jnp.asarray(keep_mask), v, jnp.nan)
     if isinstance(v, jnp.ndarray):
         return jnp.where(jnp.asarray(keep_mask), v, 0)
+    v = np.asarray(v)
+    if v.dtype.kind == "f":
+        return np.where(keep_mask, v, np.nan)
     return np.where(keep_mask, v, np.zeros_like(v))
 
 
