@@ -427,14 +427,37 @@ class _Parser:
             if having is not None:
                 out_cols = _plan.node_columns(node)
                 refs = getattr(having, "refs", None)
-                if (
-                    refs is None or out_cols is None
-                    or not set(refs) <= set(out_cols)
-                ):
-                    # references an aggregate by call-syntax label while the
-                    # SELECT aliased it: the eager path bridges the labels
-                    raise _NotPlannable("HAVING label bridge")
-                node = _plan.Filter(node, having)
+                if refs is None or out_cols is None:
+                    raise _NotPlannable("HAVING refs unknown")
+                missing = set(refs) - set(out_cols)
+                if missing:
+                    # HAVING references an aggregate by its CALL-syntax
+                    # default label while the SELECT aliased it: bridge the
+                    # labels onto the aliased outputs, filter, drop the
+                    # bridges (as plan nodes -- no eager fallback)
+                    bridges = {}
+                    for fn, arg, out in aggs:
+                        default = (
+                            f"{fn}({arg})" if isinstance(arg, str)
+                            else ("count(*)" if arg is None else None)
+                        )
+                        if (
+                            default is not None and default != out
+                            and default in missing and out in out_cols
+                        ):
+                            bridges[default] = out
+                    if missing - set(bridges):
+                        raise _NotPlannable("HAVING unknown columns")
+                    node = _plan.Compute(
+                        node,
+                        [(col(out), default)
+                         for default, out in bridges.items()],
+                        star=True,
+                    )
+                    node = _plan.Filter(node, having)
+                    node = _plan.Project(node, list(out_cols))
+                else:
+                    node = _plan.Filter(node, having)
         elif aggs:
             if exprs or has_star:
                 raise ValueError(
@@ -460,13 +483,32 @@ class _Parser:
             node = _plan.Distinct(node)
         if order_by is not None:
             out_cols = _plan.node_columns(node)
-            if out_cols is None or not all(
-                c in out_cols for c in order_by
+            if out_cols is None:
+                raise _NotPlannable("unknown output schema under ORDER BY")
+            missing = [c for c in order_by if c not in out_cols]
+            if not missing:
+                node = _plan.Sort(node, list(order_by), list(ascending))
+            elif (
+                group_key is None and not aggs and not distinct
+                and core_cols is not None
+                and all(c in core_cols for c in missing)
+                and isinstance(node, _plan.Compute) and not node.star
             ):
                 # ORDER BY mixing output aliases with unprojected source
-                # columns: the eager path borrows them for the sort
+                # columns: borrow the source columns THROUGH the projection
+                # for the sort, then drop them (projection preserves row
+                # order, so the borrowed values stay row-aligned)
+                final_cols = [o for _e, o in node.exprs]
+                node.exprs = list(node.exprs) + [
+                    (col(c), c) for c in missing
+                ]
+                node.passthrough = frozenset(
+                    set(node.passthrough) | set(missing)
+                )
+                node = _plan.Sort(node, list(order_by), list(ascending))
+                node = _plan.Project(node, final_cols)
+            else:
                 raise _NotPlannable("ORDER BY outside result columns")
-            node = _plan.Sort(node, list(order_by), list(ascending))
         if limit is not None:
             node = _plan.Limit(node, limit)
         return node

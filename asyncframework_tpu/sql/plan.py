@@ -386,7 +386,34 @@ def optimize(plan: Node, required: Optional[Sequence[str]] = None,
     plan = _reorder_joins(plan, set())
     plan = _prune_columns(plan, set(required) if required is not None
                           else None)
+    plan = _collapse_computes(plan)
     return plan
+
+
+def _collapse_computes(node: Node) -> Node:
+    """Adjacent projections fuse (CollapseProject role): a Compute whose
+    every item is a bare pass-through of the child Compute's output
+    substitutes the child's expressions directly -- one select pass
+    instead of two (a derived table re-projected by its consumer)."""
+    for name, child in _child_fields(node):
+        setattr(node, name, _collapse_computes(child))
+    if (
+        isinstance(node, Compute) and not node.star
+        and isinstance(node.child, Compute) and not node.child.star
+    ):
+        inner = node.child
+        inner_map = {o: e for e, o in inner.exprs}
+        if all(
+            o in node.passthrough and o in inner_map
+            for _e, o in node.exprs
+        ):
+            new_exprs = [(inner_map[o], o) for _e, o in node.exprs]
+            pt = frozenset(
+                o for _e, o in node.exprs if o in inner.passthrough
+            )
+            return Compute(inner.child, new_exprs, star=False,
+                           passthrough=pt)
+    return node
 
 
 def _count_shared(node: Node, counts: Dict[int, int], seen: set) -> None:

@@ -353,6 +353,15 @@ class TestWindowNode:
             (1, 3.0), (2, 2.0), (3, 1.0),
         ]
 
+    def test_adjacent_computes_collapse(self):
+        ctx = self._ctx()
+        q = ("SELECT k, v, rn FROM (SELECT k, v, ROW_NUMBER() OVER "
+             "(PARTITION BY k ORDER BY v) AS rn FROM t)")
+        txt = ctx.explain(q)
+        assert txt.count("Compute") == 1  # outer re-projection fused away
+        out = ctx.sql(q)
+        assert out.columns == ["k", "v", "rn"]
+
     def test_window_pruning_keeps_inputs(self, tmp_path):
         path = tmp_path / "t.csv"
         path.write_text("k,v,unused\n1,5,0\n1,3,0\n2,9,0\n")
@@ -519,13 +528,38 @@ class TestDerivedTableLaziness:
         )
         assert sorted(x for (x,) in out.collect()) == [2, 3]
 
-    def test_eager_fallback_still_works(self):
-        # ORDER BY mixing an alias with an unprojected source column is the
-        # eager path's borrowed-column shape; it must still run via plan
-        # fallback
+    def test_borrowed_order_by_is_planned(self):
+        # ORDER BY mixing an alias with an unprojected source column used
+        # to be an eager-fallback shape; round 5 plans it (borrow through
+        # the Compute, Sort, drop via Project)
         f = ColumnarFrame({
             "a": np.asarray([1, 2, 3, 4], np.int32),
             "b": np.asarray([0, 1, 0, 1], np.int32),
         })
         out = sql("SELECT a AS x FROM t ORDER BY b, x DESC", t=f)
+        assert out.columns == ["x"]
         assert [x for (x,) in out.collect()] == [3, 1, 4, 2]
+        ctx = SQLContext()
+        ctx.register("t", f)
+        txt = ctx.explain("SELECT a AS x FROM t ORDER BY b, x DESC")
+        assert "(eager)" not in txt  # no fallback Scan
+        assert txt.index("Project") < txt.index("Sort")
+        assert txt.index("Sort") < txt.index("Compute")
+
+    def test_having_label_bridge_is_planned(self):
+        f = ColumnarFrame({
+            "k": np.asarray([1, 1, 2, 2], np.int32),
+            "v": np.asarray([10.0, 20.0, 1.0, 2.0], np.float32),
+        })
+        ctx = SQLContext()
+        ctx.register("t", f)
+        # HAVING references the aggregate by CALL syntax while the SELECT
+        # aliases it -- previously the eager bridge, now plan nodes
+        q = ("SELECT k, SUM(v) AS total FROM t GROUP BY k "
+             "HAVING SUM(v) > 25")
+        txt = ctx.explain(q)
+        assert "(eager)" not in txt
+        assert txt.index("Filter") < txt.index("Aggregate")
+        out = ctx.sql(q)
+        assert out.columns == ["k", "total"]  # bridge column dropped
+        assert out.collect() == [(1, 30.0)]
