@@ -66,7 +66,7 @@ _KEYWORDS = {
     "OUTER", "SEMI", "ANTI", "ASC", "DESC", "DISTINCT", "HAVING",
     "OVER", "PARTITION", "UNION", "ALL", "EXCEPT", "INTERSECT", "CASE",
     "WHEN", "THEN", "ELSE", "END", "BETWEEN", "IN", "LIKE", "IS", "NULL",
-    "CAST", "WITH", "EXPLAIN",
+    "CAST", "WITH", "EXPLAIN", "CREATE", "REPLACE", "TEMP", "VIEW", "DROP",
 }
 
 _WINDOW_ONLY_FNS = {
@@ -1136,6 +1136,8 @@ class SQLContext:
     # ----------------------------------------------------------------- query
     def sql(self, text: str) -> ColumnarFrame:
         p = _Parser(tokenize(text), self)
+        if p.peek_upper() in ("CREATE", "DROP"):
+            return self._ddl(p)
         if p.accept("EXPLAIN"):
             # SQL-surface EXPLAIN (Spark's `EXPLAIN SELECT ...`): the
             # optimized plan as a one-column frame, without executing the
@@ -1153,6 +1155,48 @@ class SQLContext:
         subqueries (IN (...) / scalar) still execute during planning;
         FROM-position relations do not."""
         return self._explain_parser(_Parser(tokenize(text), self))
+
+    def _ddl(self, p: "_Parser") -> ColumnarFrame:
+        """View DDL (the SQL-surface form of ``createOrReplaceTempView``):
+        ``CREATE [OR REPLACE] [TEMP] VIEW name AS <statement>`` registers
+        the statement's RESULT under the name; ``DROP VIEW [IF EXISTS]
+        name`` unregisters.  Returns a one-row status frame."""
+        import numpy as np
+
+        if p.accept("CREATE"):
+            replace = False
+            if p.accept("OR"):
+                p.expect("REPLACE")
+                replace = True
+            p.accept("TEMP")
+            p.expect("VIEW")
+            name = p.ident()
+            p.expect("AS")
+            if name.lower() in self._tables and not replace:
+                raise ValueError(
+                    f"view {name!r} exists; use CREATE OR REPLACE VIEW"
+                )
+            frame = p.statement()
+            if p.peek() is not None:
+                raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
+            self.register(name, frame)
+            return ColumnarFrame({"view": np.asarray([name], object)})
+        p.expect("DROP")
+        p.expect("VIEW")
+        if_exists = False
+        if p.peek_upper() == "IF":
+            p.next()
+            p.expect("EXISTS")
+            if_exists = True
+        name = p.ident()
+        if p.peek() is not None:
+            raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
+        if name.lower() not in self._tables:
+            if not if_exists:
+                raise KeyError(f"no view {name!r}")
+        else:
+            del self._tables[name.lower()]
+        return ColumnarFrame({"view": np.asarray([name], object)})
 
     @staticmethod
     def _explain_parser(p: "_Parser") -> str:

@@ -483,6 +483,43 @@ class TestSortLimitDistinctNodes:
         assert sorted(a for (a,) in out.collect()) == [2, 3]
 
 
+class TestViewDDL:
+    def _ctx(self):
+        ctx = SQLContext()
+        ctx.register("t", ColumnarFrame({
+            "k": np.asarray([1, 1, 2], np.int32),
+            "v": np.asarray([10.0, 20.0, 5.0], np.float32),
+        }))
+        return ctx
+
+    def test_create_view_then_query(self):
+        ctx = self._ctx()
+        out = ctx.sql(
+            "CREATE VIEW sums AS SELECT k, SUM(v) AS s FROM t GROUP BY k"
+        )
+        assert out.collect() == [("sums",)]
+        got = ctx.sql("SELECT s FROM sums WHERE k = 1")
+        assert [s for (s,) in got.collect()] == [30.0]
+
+    def test_create_without_replace_rejects_existing(self):
+        ctx = self._ctx()
+        ctx.sql("CREATE VIEW x AS SELECT k FROM t")
+        with pytest.raises(ValueError, match="OR REPLACE"):
+            ctx.sql("CREATE VIEW x AS SELECT v FROM t")
+        ctx.sql("CREATE OR REPLACE VIEW x AS SELECT v FROM t")
+        assert ctx.sql("SELECT * FROM x").columns == ["v"]
+
+    def test_drop_view(self):
+        ctx = self._ctx()
+        ctx.sql("CREATE TEMP VIEW x AS SELECT k FROM t")
+        ctx.sql("DROP VIEW x")
+        with pytest.raises(KeyError):
+            ctx.sql("SELECT * FROM x")
+        ctx.sql("DROP VIEW IF EXISTS x")  # no error
+        with pytest.raises(KeyError):
+            ctx.sql("DROP VIEW x")
+
+
 class TestExplainStatement:
     def test_explain_returns_plan_frame(self):
         ctx = SQLContext()
