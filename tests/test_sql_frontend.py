@@ -74,6 +74,53 @@ class TestReaders:
         with pytest.raises(ValueError, match="row 2"):
             read_csv(p)
 
+    def test_csv_extra_field_row_rejected(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("a,b\n1,2\n3,4,5\n")
+        with pytest.raises(ValueError, match="row 2"):
+            read_csv(p)
+
+    def test_fast_path_matches_python_path(self, tmp_path):
+        """The pandas-C fast path must reproduce the python csv path's
+        inference EXACTLY: missing-cell handling, int32 downcast, wide-int
+        host columns, float32 rounding, string preservation."""
+        from asyncframework_tpu.sql import io as sqlio
+
+        body = (
+            "i,f,s,m,wide,neg\n"
+            "1,0.1,tag0,,99999999999,-3\n"
+            "2,2.5,,7,88888888888,+4\n"
+            "3,nan,x y,9,77777777777,0\n"
+        )
+        p = tmp_path / "t.csv"
+        p.write_text(body)
+        fast = sqlio._read_csv_fast(str(p), True, None, ",", None, None)
+        # quoting forces the python path on an equivalent file (quotes
+        # around a value that needs none parse away identically)
+        p2 = tmp_path / "t2.csv"
+        p2.write_text(body.replace("tag0", '"tag0"'))
+        slow = read_csv(p2)
+        assert fast.columns == slow.columns
+        for c in fast.columns:
+            a, b = np.asarray(fast[c]), np.asarray(slow[c])
+            assert a.dtype == b.dtype, (c, a.dtype, b.dtype)
+            if a.dtype.kind == "f":
+                np.testing.assert_array_equal(
+                    np.isnan(a), np.isnan(b)
+                )
+                np.testing.assert_array_equal(
+                    a[~np.isnan(a)], b[~np.isnan(b)]
+                )
+            else:
+                assert list(a) == list(b), c
+        # dtypes follow the documented rules
+        assert np.asarray(fast["i"]).dtype == np.int32
+        assert np.asarray(fast["f"]).dtype == np.float32
+        assert np.asarray(fast["s"]).dtype == object
+        assert np.asarray(fast["m"]).dtype == np.float32  # nullable narrow
+        assert np.asarray(fast["wide"]).dtype == object   # > 2**31 ids
+        assert list(np.asarray(fast["s"])) == ["tag0", "", "x y"]
+
 
 class TestSQLQueries:
     def test_group_by_sum_matches_pandas(self, csv_path):
