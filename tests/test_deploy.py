@@ -200,9 +200,19 @@ class TestMasterRecovery:
         m.stop()
         time.sleep(0.2)
         # new master on the SAME port recovers the registry from disk;
-        # the worker's heartbeat (or RECONNECT reply) re-validates it
-        m2 = Master(port=port, persistence_dir=str(tmp_path),
-                    worker_timeout_s=2.0).start()
+        # the worker's heartbeat (or RECONNECT reply) re-validates it.
+        # The old listener can take a beat to release the port under a
+        # loaded host -- retry the rebind briefly (real restarts do too).
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                m2 = Master(port=port, persistence_dir=str(tmp_path),
+                            worker_timeout_s=2.0).start()
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.3)
         try:
             cl2 = MasterClient("127.0.0.1", m2.port)
             ws = cl2.workers()
