@@ -192,3 +192,40 @@ SHUFFLE_DATA_PLANE = ConfigEntry(
     "'host' (vectorized numpy sort/bincount), or 'auto' -- device on "
     "accelerator backends, host on CPU (the measured winner per rig; see "
     "ops/shuffle.py).")
+# ------------------------------------------------------------- net plane
+# The shared robustness layer (net/retry.py, net/session.py, net/faults.py):
+# every DCN client (PS workers, remote topics, deploy daemons) resolves its
+# retry policy from these, and every server sizes its dedup window from
+# them -- one set of knobs for the whole control + data plane.
+NET_RETRY_MAX_ATTEMPTS = ConfigEntry(
+    "async.net.retry.max.attempts", 5, int,
+    "Attempts per logical op before the retry layer gives up.")
+NET_RETRY_BASE_MS = ConfigEntry(
+    "async.net.retry.base.ms", 50.0, float,
+    "Backoff floor (decorrelated jitter draws start here).")
+NET_RETRY_MAX_MS = ConfigEntry(
+    "async.net.retry.max.ms", 2000.0, float,
+    "Backoff cap per sleep.")
+NET_RETRY_ATTEMPT_TIMEOUT_S = ConfigEntry(
+    "async.net.retry.attempt.timeout.s", 120.0, float,
+    "Per-attempt socket timeout clients apply to their connections.")
+NET_RETRY_DEADLINE_S = ConfigEntry(
+    "async.net.retry.deadline.s", 0.0, float,
+    "Overall deadline across attempts (0 = attempts bound alone).")
+NET_BREAKER_THRESHOLD = ConfigEntry(
+    "async.net.breaker.threshold", 5, int,
+    "Consecutive failures that open an endpoint's circuit breaker.")
+NET_BREAKER_COOLDOWN_S = ConfigEntry(
+    "async.net.breaker.cooldown.s", 1.0, float,
+    "Open-state fail-fast window before the half-open probe.")
+NET_DEDUP_WINDOW = ConfigEntry(
+    "async.net.dedup.window", 128, int,
+    "Applied (sid, seq) ops each server remembers per client session "
+    "(exactly-once-applied retry dedup).")
+NET_FAULT_SCHEDULE = ConfigEntry(
+    "async.net.fault.schedule", "", str,
+    "Deterministic fault schedule as inline JSON or @/path/to/file "
+    "(net/faults.py); empty = injection off.")
+NET_FAULT_SEED = ConfigEntry(
+    "async.net.fault.seed", 0, int,
+    "Seed chaos runs hand to retry policies so backoff walks replay.")

@@ -79,7 +79,9 @@ def render_master(namespace: str = "default", image: str = DEFAULT_IMAGE,
     if ha_replicas > 1:
         cmd.append("--ha")
     if ui:
-        cmd += ["--ui-port", str(UI_PORT)]
+        # --ui-host 0.0.0.0: the Service can only route to the UI port if
+        # the page binds beyond the pod's loopback
+        cmd += ["--ui-port", str(UI_PORT), "--ui-host", "0.0.0.0"]
     objs: List[dict] = []
     objs.append({
         "apiVersion": "v1", "kind": "PersistentVolumeClaim",

@@ -35,7 +35,13 @@ import time
 from typing import Dict, List, Optional
 
 from asyncframework_tpu.cluster import _free_port
-from asyncframework_tpu.parallel.ps_dcn import _recv_msg, _send_msg
+from asyncframework_tpu.net import DedupWindow
+from asyncframework_tpu.net.frame import recv_msg as _recv_msg
+from asyncframework_tpu.net.frame import send_msg as _send_msg
+
+#: ops that mutate master state: a retried SUBMIT_APP must not schedule the
+#: app twice, a retried KILL_APP is answered from cache (net/session.py)
+_MUTATING_OPS = frozenset({"SUBMIT_APP", "KILL_APP"})
 
 # NOTE on coordinator ports: _free_port binds-then-releases on the master's
 # host, so (a) another process could steal the port before the app binds it
@@ -55,6 +61,7 @@ class Master:
         worker_timeout_s: float = WORKER_TIMEOUT_S,
         ha: bool = False,
         ui_port: Optional[int] = None,
+        ui_host: str = "127.0.0.1",
     ):
         self.host = host
         self._srv = socket.create_server((host, port))
@@ -94,7 +101,11 @@ class Master:
             self.active = True
             self._recover()
         self._ui_port = ui_port
+        self._ui_host = ui_host
         self._ui = None
+        from asyncframework_tpu.conf import NET_DEDUP_WINDOW, global_conf
+
+        self._dedup = DedupWindow(window=global_conf().get(NET_DEDUP_WINDOW))
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Master":
@@ -112,8 +123,14 @@ class Master:
             t3.start()
             self._threads.append(t3)
         if self._ui_port is not None:
-            self._ui = MasterUIServer(self, port=self._ui_port)
+            self._ui = MasterUIServer(self, port=self._ui_port,
+                                      host=self._ui_host)
         return self
+
+    @property
+    def dedup_hits(self) -> int:
+        """Retried mutating RPCs answered from the dedup window."""
+        return self._dedup.hits
 
     def status_snapshot(self) -> Dict:
         """Cluster state for the web UI / ops tooling (MasterPage role)."""
@@ -252,11 +269,24 @@ class Master:
                 # them fall into the connection-error handler would close
                 # the socket without replying ("peer closed" at the client,
                 # with the real cause invisible)
+                cached = (self._dedup.check(header)
+                          if header.get("op") in _MUTATING_OPS else None)
+                if cached is not None:
+                    # duplicate of an applied mutation (reply lost on the
+                    # wire): re-answer from cache -- one SUBMIT_APP retry
+                    # storm must still schedule exactly one app
+                    _send_msg(conn, cached[0])
+                    continue
                 try:
                     reply = self._handle(header)
                 except Exception as e:  # noqa: BLE001 - reported to caller
                     reply = {"op": "ERR",
                              "msg": f"{type(e).__name__}: {e}"}
+                if (header.get("op") in _MUTATING_OPS
+                        and reply.get("op") not in ("ERR", "STANDBY")):
+                    # STANDBY is a routing answer, not an outcome; caching
+                    # it would pin a client to the loser after failover
+                    self._dedup.record(header, reply)
                 _send_msg(conn, reply)
         except (ConnectionError, OSError):
             return
@@ -523,9 +553,19 @@ def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
                         "this one takes over)")
     p.add_argument("--ui-port", type=int, default=None,
                    help="serve the master status page on this port")
+    p.add_argument("--ui-host", default=None,
+                   help="bind address for the status page (default "
+                        "0.0.0.0 when --ui-port is set: a UI you asked "
+                        "for is a UI you can reach from off-box)")
     args = p.parse_args(argv)
+    from asyncframework_tpu.net import faults
+
+    faults.maybe_install_from_conf()  # chaos runs configure daemons by env
+    ui_host = args.ui_host
+    if ui_host is None:
+        ui_host = "0.0.0.0" if args.ui_port is not None else "127.0.0.1"
     m = Master(args.host, args.port, args.persistence_dir,
-               ha=args.ha, ui_port=args.ui_port).start()
+               ha=args.ha, ui_port=args.ui_port, ui_host=ui_host).start()
     print(f"master listening on {m.address}"
           + (" (ha)" if args.ha else "")
           + (f" ui:{m._ui.port}" if m._ui is not None else ""), flush=True)

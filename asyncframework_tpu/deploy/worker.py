@@ -18,7 +18,10 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from asyncframework_tpu.parallel.ps_dcn import _recv_msg, _send_msg
+from asyncframework_tpu.net import RetryPolicy
+from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.net.frame import recv_msg as _recv_msg
+from asyncframework_tpu.net.frame import send_msg as _send_msg
 
 
 class Worker:
@@ -57,6 +60,36 @@ class Worker:
         self._killed: set = set()  # apps killed by order: never supervise
         self._launch_env_extra = dict(launch_env_extra or {})
         self.max_supervised_restarts = 3
+        # master RPCs ride the shared retry policy; rotation across the HA
+        # master list is the per-attempt body, so "no active master" is a
+        # retryable condition with real backoff instead of a bare raise
+        self._retry = RetryPolicy.from_conf()
+
+    def _master_call(self, msg: dict,
+                     retry: "RetryPolicy" = None) -> dict:
+        """One RPC to the active master under the retry policy, rotating
+        through the configured masters each attempt (STANDBY replies and
+        connection failures both rotate).  Raises ConnectionError (via
+        RetryError) when no configured master turns active in budget."""
+
+        def attempt() -> dict:
+            for _ in range(len(self._masters)):
+                addr = self._masters[self._mi]
+                try:
+                    with _frame.connect(addr, timeout=10) as s:
+                        _send_msg(s, msg)
+                        reply, _ = _recv_msg(s)
+                    if reply.get("op") != "STANDBY":
+                        return reply
+                except (ConnectionError, OSError):
+                    pass
+                self._mi = (self._mi + 1) % len(self._masters)
+            raise ConnectionError(
+                "no active master among "
+                f"{[f'{h}:{p}' for h, p in self._masters]}"
+            )
+
+        return (retry or self._retry).call(attempt)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Worker":
@@ -82,24 +115,6 @@ class Worker:
                 p.terminate()
 
     # ------------------------------------------------------- master contact
-    def _master_call(self, msg: dict) -> dict:
-        """One RPC to the active master, rotating through the configured
-        masters on connection failure or a STANDBY reply.  Raises
-        ConnectionError when no configured master is active."""
-        for _ in range(len(self._masters)):
-            addr = self._masters[self._mi]
-            try:
-                with socket.create_connection(addr, timeout=10) as s:
-                    _send_msg(s, msg)
-                    reply, _ = _recv_msg(s)
-                if reply.get("op") != "STANDBY":
-                    return reply
-            except (ConnectionError, OSError):
-                pass
-            self._mi = (self._mi + 1) % len(self._masters)
-        raise ConnectionError("no active master among "
-                              f"{[f'{h}:{p}' for h, p in self._masters]}")
-
     def _register(self) -> None:
         reply = self._master_call({
             "op": "REGISTER_WORKER", "worker_id": self.worker_id,
@@ -195,19 +210,27 @@ class Worker:
                 return
             # the exit report must survive a master failover window: a
             # standby needs a few hundred ms to win the lease and recover,
-            # and a lost report strands the app in RUNNING forever
-            deadline = time.monotonic() + 30.0
-            while time.monotonic() < deadline and not self._stop.is_set():
-                try:
-                    self._master_call({
+            # and a lost report strands the app in RUNNING forever -- so
+            # this call gets a much deeper retry budget than the default
+            try:
+                self._master_call(
+                    {
                         "op": "EXECUTOR_EXIT", "worker_id": self.worker_id,
                         "app_id": order["app_id"],
                         "proc_id": order["proc_id"],
                         "returncode": proc.returncode,
-                    })
-                    break
-                except (ConnectionError, OSError):
-                    time.sleep(0.5)
+                    },
+                    retry=RetryPolicy.from_conf(
+                        max_attempts=120, deadline_s=30.0, max_ms=500.0,
+                        # a stopped worker must not keep dialing the master
+                        # for the rest of the budget: classify transport
+                        # errors as non-retryable once stop() has run
+                        classify=lambda e: (isinstance(e, OSError)
+                                            and not self._stop.is_set()),
+                    ),
+                )
+            except (ConnectionError, OSError):
+                pass  # budget spent; the app stays RUNNING (operator-visible)
             if proc.returncode and err:
                 sys.stderr.write(
                     f"[{self.worker_id}] app {order['app_id']} proc "
@@ -231,6 +254,9 @@ def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
     p.add_argument("--cores", type=int, default=1)
     p.add_argument("--worker-id", default=None)
     args = p.parse_args(argv)
+    from asyncframework_tpu.net import faults
+
+    faults.maybe_install_from_conf()  # chaos runs configure daemons by env
     primary, *standbys = args.master.split(",")
     host, port = primary.rsplit(":", 1)
     w = Worker(host, int(port), worker_id=args.worker_id,

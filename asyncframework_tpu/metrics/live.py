@@ -50,6 +50,12 @@ def _shuffle_totals() -> Dict[str, int]:
     return shuffle_totals()
 
 
+def _net_totals() -> Dict[str, int]:
+    from asyncframework_tpu.net import net_totals
+
+    return net_totals()
+
+
 def active_servers() -> List["LiveUIServer"]:
     with _ACTIVE_LOCK:
         return list(_ACTIVE)
@@ -156,6 +162,10 @@ class LiveStateListener(Listener):
                 # driver-side shuffle accounting (SortShuffleManager /
                 # UnifiedMemoryManager observability role)
                 "shuffle": _shuffle_totals(),
+                # DCN robustness counters (net/): retries taken, breaker
+                # trips, dedup hits, faults fired -- the failure-handling
+                # subsystem's health at a glance
+                "net": _net_totals(),
             }
 
 

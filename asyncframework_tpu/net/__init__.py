@@ -1,0 +1,36 @@
+"""Shared network robustness layer for the DCN control + data plane.
+
+One framing (``frame``), one retry/backoff policy with circuit breakers
+(``retry``), exactly-once-applied client sessions with server-side dedup
+windows (``session``), and deterministic schedule-driven fault injection
+(``faults``).  The parameter server, the streaming topic server, and all
+three standalone deploy daemons route through this package -- failure
+handling is a subsystem here, not folklore at call sites.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from asyncframework_tpu.net.retry import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryError,
+    RetryPolicy,
+)
+from asyncframework_tpu.net.session import (  # noqa: F401
+    ClientSession,
+    DedupWindow,
+)
+
+
+def net_totals() -> Dict[str, int]:
+    """Process-wide robustness counters (surfaced in the live UI next to
+    the shuffle totals): retries taken, give-ups, breaker trips, dedup
+    hits, faults fired."""
+    from asyncframework_tpu.net import faults, retry, session
+
+    out = dict(retry.retry_totals())
+    out["dedup_hits"] = session.dedup_hits_total()
+    out["faults_fired"] = faults.faults_fired_total()
+    return out

@@ -1,0 +1,250 @@
+"""Deterministic, schedule-driven network fault injection.
+
+The DCN plane's chaos story was "kill -9 and hope": real, but neither
+replayable nor precise.  This module is the network-layer sibling of
+``engine/straggler.py``'s compute delays -- faults are *scheduled*, keyed
+by ``(endpoint, op, nth-occurrence)``, and a run with the same schedule
+and the same client-side op sequence fires the same faults at the same
+protocol points, so a chaos result can be replayed bit-for-bit.
+
+Fault kinds (where in the exchange they bite):
+
+- ``connect_refused``  -- the dial itself fails (daemon not up / port
+  blackholed).  Nothing was sent.
+- ``cut_mid_frame``    -- the request frame is truncated on the wire and
+  the connection dies.  The server never applied the op.
+- ``stall_read``       -- the request was delivered (and applied!) but the
+  reply never arrives; the client's read times out.
+- ``drop_reply``       -- the request was delivered and applied; the reply
+  is lost.  The classic duplicate-generator: a naive client re-sends.
+
+``stall_read`` and ``drop_reply`` are the cases that make bare retry
+UNSAFE and are exactly what ``net/session.py``'s dedup windows exist for.
+
+Hook points live in ``net/frame.py`` (:func:`connect`, :func:`send_msg`,
+:func:`recv_msg`); installation is process-global (:func:`install` /
+:func:`clear` / the :func:`injected` context manager), with
+:func:`maybe_install_from_conf` for daemons configured via
+``async.net.fault.schedule``.  Endpoint patterns: exact ``host:port``,
+``*:port`` (any host), or ``*`` (any endpoint).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+CONNECT_REFUSED = "connect_refused"
+CUT_MID_FRAME = "cut_mid_frame"
+STALL_READ = "stall_read"
+DROP_REPLY = "drop_reply"
+
+KINDS = (CONNECT_REFUSED, CUT_MID_FRAME, STALL_READ, DROP_REPLY)
+
+#: the pseudo-op a ``connect_refused`` event matches (the dial has no header)
+CONNECT_OP = "CONNECT"
+
+_totals_lock = threading.Lock()
+_faults_fired = 0
+
+
+def faults_fired_total() -> int:
+    """Process-wide count of injected faults (metrics/live UI)."""
+    with _totals_lock:
+        return _faults_fired
+
+
+def _bump_fired() -> None:
+    global _faults_fired
+    with _totals_lock:
+        _faults_fired += 1
+
+
+@dataclass
+class FaultEvent:
+    """One scheduled fault: fires on the ``nth`` matching occurrence of
+    ``op`` toward ``endpoint`` (1-based; each event fires exactly once)."""
+
+    endpoint: str
+    op: str
+    nth: int
+    kind: str
+    _count: int = field(default=0, repr=False)
+    fired: bool = field(default=False, repr=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {KINDS}")
+        if self.nth < 1:
+            raise ValueError("nth is 1-based and must be >= 1")
+
+    def matches(self, endpoint: str, op: str) -> bool:
+        if self.op != "*" and self.op != op:
+            return False
+        pat = self.endpoint
+        if pat == "*" or pat == endpoint:
+            return True
+        if pat.startswith("*:"):
+            return endpoint.rsplit(":", 1)[-1] == pat[2:]
+        return False
+
+
+@dataclass
+class FaultSchedule:
+    """A replayable list of :class:`FaultEvent`, plus the seed chaos runs
+    hand to their retry policies (one number pins the whole run)."""
+
+    events: List[FaultEvent] = field(default_factory=list)
+    seed: int = 0
+
+    def add(self, endpoint: str, op: str, nth: int, kind: str
+            ) -> "FaultSchedule":
+        self.events.append(FaultEvent(endpoint, op, nth, kind))
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "events": [
+                {"endpoint": e.endpoint, "op": e.op,
+                 "nth": e.nth, "kind": e.kind}
+                for e in self.events
+            ],
+        })
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultSchedule":
+        raw = json.loads(text)
+        sched = cls(seed=int(raw.get("seed", 0)))
+        for e in raw.get("events", []):
+            sched.add(e["endpoint"], e["op"], int(e["nth"]), e["kind"])
+        return sched
+
+
+class FaultInjector:
+    """Evaluates a :class:`FaultSchedule` against the live op stream.
+
+    Each event keeps its own occurrence counter, so matching is
+    deterministic per (endpoint, op) stream regardless of what other
+    endpoints are doing.  ``fired`` is the journal a replay asserts
+    against."""
+
+    def __init__(self, schedule: FaultSchedule):
+        self.schedule = schedule
+        self._lock = threading.Lock()
+        # id(sock) -> (weakref(sock), kind) for that socket's next recv;
+        # the weakref guards against CPython id() reuse handing a stale
+        # fault to an unrelated future socket
+        self._armed: Dict[int, Tuple[weakref.ref, str]] = {}
+        self.fired: List[Dict] = []
+
+    # ------------------------------------------------------------- matching
+    def _fire(self, endpoint: str, op: str) -> Optional[str]:
+        """Count this occurrence against every live matching event; return
+        the kind of the first event whose ``nth`` is reached."""
+        with self._lock:
+            hit: Optional[FaultEvent] = None
+            for ev in self.schedule.events:
+                if ev.fired or not ev.matches(endpoint, op):
+                    continue
+                ev._count += 1
+                if hit is None and ev._count == ev.nth:
+                    ev.fired = True
+                    hit = ev
+            if hit is None:
+                return None
+            self.fired.append({"endpoint": endpoint, "op": op,
+                               "nth": hit.nth, "kind": hit.kind})
+        _bump_fired()
+        return hit.kind
+
+    # ----------------------------------------------------------- hook sites
+    def check_connect(self, endpoint: str) -> None:
+        kind = self._fire(endpoint, CONNECT_OP)
+        if kind == CONNECT_REFUSED:
+            raise ConnectionRefusedError(
+                f"fault-injected: connection refused to {endpoint}"
+            )
+
+    def check_send(self, endpoint: str, op: str) -> Optional[str]:
+        return self._fire(endpoint, op)
+
+    def arm(self, sock, kind: str) -> None:
+        with self._lock:
+            self._armed[id(sock)] = (weakref.ref(sock), kind)
+
+    def disarm(self, sock) -> Optional[str]:
+        with self._lock:
+            entry = self._armed.pop(id(sock), None)
+        if entry is None:
+            return None
+        ref, kind = entry
+        return kind if ref() is sock else None
+
+    # -------------------------------------------------------------- reports
+    def remaining(self) -> List[FaultEvent]:
+        with self._lock:
+            return [e for e in self.schedule.events if not e.fired]
+
+
+_active_lock = threading.Lock()
+_active: Optional[FaultInjector] = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _active
+
+
+def install(injector: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    """Install (or, with None, clear) the process's fault injector."""
+    global _active
+    with _active_lock:
+        _active = injector
+    return injector
+
+
+def clear() -> None:
+    install(None)
+
+
+class injected:
+    """``with faults.injected(schedule) as inj: ...`` -- scoped install."""
+
+    def __init__(self, schedule: FaultSchedule):
+        self.injector = FaultInjector(schedule)
+
+    def __enter__(self) -> FaultInjector:
+        install(self.injector)
+        return self.injector
+
+    def __exit__(self, *exc) -> None:
+        clear()
+
+
+def maybe_install_from_conf(conf=None) -> Optional[FaultInjector]:
+    """Daemon entry points call this: when ``async.net.fault.schedule`` is
+    set (inline JSON, or ``@/path/to/file``), install the injector so a
+    subprocess chaos run needs no code changes -- just conf/env."""
+    from asyncframework_tpu.conf import (
+        NET_FAULT_SCHEDULE,
+        NET_FAULT_SEED,
+        global_conf,
+    )
+
+    conf = conf if conf is not None else global_conf()
+    text = str(conf.get(NET_FAULT_SCHEDULE) or "").strip()
+    if not text:
+        return None
+    if text.startswith("@"):
+        with open(text[1:]) as f:
+            text = f.read()
+    sched = FaultSchedule.from_json(text)
+    if "seed" not in json.loads(text):
+        # a schedule without its own seed inherits the conf seed, so one
+        # env var can re-pin a whole daemon fleet's chaos run
+        sched.seed = int(conf.get(NET_FAULT_SEED))
+    return install(FaultInjector(sched))

@@ -1,0 +1,117 @@
+"""Length-prefixed JSON/payload framing: the one wire format of the DCN
+control + data plane.
+
+This is the framing that ``parallel/ps_dcn.py`` introduced and every other
+networked layer (the topic server, the standalone master/worker/client
+daemons) imported from it.  It now lives here so the robustness layer can
+wrap ONE choke point: every frame sent or received anywhere in the
+framework passes through :func:`send_msg` / :func:`recv_msg` /
+:func:`connect`, and each consults the process's active
+:class:`~asyncframework_tpu.net.faults.FaultInjector` (when installed) --
+the network-plane sibling of ``engine/straggler.py``'s compute delays.
+
+Frame layout (unchanged): ``!I``-prefixed JSON header line, then an
+``!I``-prefixed raw payload (possibly empty).  The header always carries
+``op``; mutating ops may carry ``sid``/``seq`` (see ``net/session.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+from asyncframework_tpu.net import faults
+
+_HDR = struct.Struct("!I")  # 4-byte big-endian frame length
+
+
+def endpoint_of(sock: socket.socket) -> str:
+    """The remote peer as ``host:port`` (fault-schedule addressing)."""
+    try:
+        host, port = sock.getpeername()[:2]
+        return f"{host}:{port}"
+    except OSError:
+        return "?:?"
+
+
+def connect(addr: Tuple[str, int], timeout: Optional[float] = 10.0
+            ) -> socket.socket:
+    """``socket.create_connection`` with the fault hook: an armed
+    connection-refused event fires here, before any real dial."""
+    endpoint = f"{addr[0]}:{int(addr[1])}"
+    inj = faults.active()
+    if inj is not None:
+        inj.check_connect(endpoint)
+    return socket.create_connection(addr, timeout=timeout)
+
+
+def send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    head = json.dumps(header).encode()
+    data = _HDR.pack(len(head)) + head + _HDR.pack(len(payload)) + payload
+    inj = faults.active()
+    if inj is not None:
+        kind = inj.check_send(endpoint_of(sock), str(header.get("op", "")))
+        if kind == faults.CUT_MID_FRAME:
+            # a prefix of the frame goes out, then the connection dies: the
+            # peer sees a short frame + EOF, the sender sees a reset.  The
+            # request was NOT applied.
+            sock.sendall(data[: max(1, len(data) // 3)])
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"fault-injected: mid-frame cut to {endpoint_of(sock)}"
+            )
+        if kind in (faults.STALL_READ, faults.DROP_REPLY):
+            # the request itself goes through (the peer WILL apply it); the
+            # fault fires on this socket's next recv.  Arm only AFTER the
+            # send succeeds -- a failed send never reaches the peer, and a
+            # stale armed entry could fire on an unrelated future socket
+            sock.sendall(data)
+            inj.arm(sock, kind)
+            return
+    sock.sendall(data)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg_raw(sock: socket.socket) -> Tuple[dict, bytes]:
+    (hlen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
+    header = json.loads(recv_exact(sock, hlen))
+    (plen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
+    payload = recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    inj = faults.active()
+    if inj is not None:
+        kind = inj.disarm(sock)
+        if kind == faults.STALL_READ:
+            # the reply never arrives within the attempt window; the unread
+            # bytes stay in the kernel buffer, so the caller MUST drop this
+            # connection (the retry layer does)
+            raise socket.timeout(
+                f"fault-injected: stalled read from {endpoint_of(sock)}"
+            )
+        if kind == faults.DROP_REPLY:
+            # the peer applied the op and replied -- the reply is lost on
+            # the wire.  Read and discard it so the injection point is
+            # exactly "applied but unacknowledged".
+            _recv_msg_raw(sock)
+            raise ConnectionError(
+                f"fault-injected: reply dropped after apply "
+                f"({endpoint_of(sock)})"
+            )
+    return _recv_msg_raw(sock)

@@ -154,7 +154,22 @@ def host_reduce_by_key(
         # ~640 MB of scratch where the sort path needs none
         if kmax + 1 <= max(ks.size, 1 << 20):
             present = np.bincount(ks, minlength=kmax + 1) > 0
-            sums = np.bincount(ks, weights=vs, minlength=kmax + 1)
+            inexact = False
+            if vs.dtype.kind in "iu":
+                # bincount's float64 weight sums silently round integer
+                # totals past 2^53.  |any key's sum| <= max|v| * n, so only
+                # cross to exact accumulation when that bound can round --
+                # wordcount-shaped inputs (small values, many pairs) keep
+                # the fast bincount path
+                bound = max(abs(int(vs.min())), abs(int(vs.max()))) * ks.size
+                inexact = bound >= (1 << 53)
+            if inexact:
+                # exact int64 accumulation (np.add.at is slower than
+                # bincount, but correctness beats speed past the boundary)
+                sums = np.zeros(kmax + 1, np.int64)
+                np.add.at(sums, ks, vs.astype(np.int64, copy=False))
+            else:
+                sums = np.bincount(ks, weights=vs, minlength=kmax + 1)
             uk = np.nonzero(present)[0].astype(ks.dtype)
             uv = sums[uk].astype(vs.dtype, copy=False)
     if uk is None:

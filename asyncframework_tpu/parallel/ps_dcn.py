@@ -60,38 +60,22 @@ import io
 import json
 import os
 import socket
-import struct
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-_HDR = struct.Struct("!I")  # 4-byte big-endian frame length
-
+from asyncframework_tpu.net import ClientSession, DedupWindow, RetryPolicy
+from asyncframework_tpu.net import frame as _frame
 
 # ------------------------------------------------------------------ framing
-def _send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
-    head = json.dumps(header).encode()
-    sock.sendall(_HDR.pack(len(head)) + head + _HDR.pack(len(payload)) + payload)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
-    (hlen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    header = json.loads(_recv_exact(sock, hlen))
-    (plen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    payload = _recv_exact(sock, plen) if plen else b""
-    return header, payload
+# The framing moved to net/frame.py (one choke point for the whole control
+# + data plane, with fault-injection hooks); these aliases keep the
+# historical import site alive for everything that learned it here.
+_send_msg = _frame.send_msg
+_recv_exact = _frame.recv_exact
+_recv_msg = _frame.recv_msg
 
 
 # ----------------------------------------------------------------- PS side
@@ -191,6 +175,11 @@ class ParameterServer:
         self._eval_results: Dict[int, np.ndarray] = {}
         self._eval_cv = threading.Condition()
         self._stop = threading.Event()
+        # exactly-once-applied PUSH: a retried (sid, seq) re-sends the
+        # cached ACK instead of merging the gradient twice (net/session.py)
+        from asyncframework_tpu.conf import NET_DEDUP_WINDOW, global_conf
+
+        self._dedup = DedupWindow(window=global_conf().get(NET_DEDUP_WINDOW))
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "ParameterServer":
@@ -326,7 +315,13 @@ class ParameterServer:
                 if op == "PULL":
                     self._handle_pull(conn, header)
                 elif op == "PUSH":
-                    self._handle_push(conn, header, payload)
+                    cached = self._dedup.check(header)
+                    if cached is not None:
+                        # duplicate of an already-applied push (the ACK was
+                        # lost on the wire): re-send it, merge nothing
+                        _send_msg(conn, cached[0])
+                    else:
+                        self._handle_push(conn, header, payload)
                 elif op == "SNAPSHOTS":
                     # only meaningful once the run is done; the stack is
                     # consistent either way (lock-copied)
@@ -525,8 +520,12 @@ class ParameterServer:
                 self._snapshots.append((self._now_ms(), np.asarray(self._w)))
         with self._wave_cv:
             self._wave_cv.notify_all()  # a wave may now meet its threshold
-        _send_msg(conn, {"op": "ACK", "accepted": bool(accepted),
-                         "done": self._done.is_set()})
+        ack = {"op": "ACK", "accepted": bool(accepted),
+               "done": self._done.is_set()}
+        # record BEFORE sending: if the ACK is lost mid-send the retry must
+        # already find the (sid, seq) applied
+        self._dedup.record(header, ack)
+        _send_msg(conn, ack)
         if do_snapshot:
             # printer_freq cadence, after the ACK: only THIS worker's next
             # message waits behind the disk write
@@ -559,6 +558,12 @@ class ParameterServer:
                 total = arr if total is None else total + arr
             return total
 
+    @property
+    def dedup_hits(self) -> int:
+        """Retried PUSHes answered from the dedup window (each one is a
+        gradient that would have merged twice before net/session.py)."""
+        return self._dedup.hits
+
     def stop(self) -> None:
         self._stop.set()
         self._done.set()
@@ -574,16 +579,70 @@ class ParameterServer:
 class PSClient:
     """One TCP connection to the PS (workers may hold several, one per
     logical worker id, or share one -- the protocol is synchronous per
-    connection, like an RpcEndpointRef)."""
+    connection, like an RpcEndpointRef).
 
-    def __init__(self, host: str, port: int, timeout_s: float = 120.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+    Transport faults are the retry layer's problem now: every RPC routes
+    through a :class:`~asyncframework_tpu.net.RetryPolicy` (backoff +
+    jitter + per-endpoint circuit breaker), reconnecting between attempts.
+    Mutating ops (PUSH) are stamped with this client's session ``(sid,
+    seq)`` so a retry after a lost ACK is answered from the PS's dedup
+    window instead of merging the gradient twice."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 120.0,
+                 retry: Optional[RetryPolicy] = None,
+                 session: Optional[ClientSession] = None):
+        self.host, self.port = host, int(port)
+        self.endpoint = f"{host}:{self.port}"
+        self.retry = retry if retry is not None else RetryPolicy.from_conf(
+            attempt_timeout_s=timeout_s
+        )
+        self.session = session if session is not None else ClientSession()
+        self._sock: Optional[socket.socket] = None
         self.bytes_pushed = 0  # payload bytes shipped by push/push_saga
+        # eager first dial (historical behavior: constructing a client to a
+        # dead PS raises) -- but through the policy, so a PS mid-restart is
+        # ridden out instead of surfaced
+        self._call_raw(connect_only=True)
+
+    @property
+    def sock(self) -> Optional[socket.socket]:
+        return self._sock
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call_raw(self, header: Optional[dict] = None, payload: bytes = b"",
+                  connect_only: bool = False) -> Tuple[dict, bytes]:
+        """One stamped-or-not request/reply under the retry policy.  The
+        header is REUSED verbatim across attempts -- a stamped op keeps its
+        (sid, seq) so the server can dedup."""
+
+        def attempt() -> Tuple[dict, bytes]:
+            try:
+                if self._sock is None:
+                    self._sock = _frame.connect(
+                        (self.host, self.port),
+                        timeout=self.retry.attempt_timeout_s,
+                    )
+                if connect_only:
+                    return {}, b""
+                _send_msg(self._sock, header, payload)
+                return _recv_msg(self._sock)
+            except OSError:
+                # dead/poisoned connection: never reuse it for the retry
+                self._drop_sock()
+                raise
+
+        return self.retry.call(attempt, endpoint=self.endpoint)
 
     def pull(self, wid: int) -> Optional[Tuple[int, np.ndarray, float, bool]]:
         """Returns (ts, w, avg_delay_ms, calibrated) or None when DONE."""
-        _send_msg(self.sock, {"op": "PULL", "wid": wid})
-        header, payload = _recv_msg(self.sock)
+        header, payload = self._call_raw({"op": "PULL", "wid": wid})
         if header["op"] == "DONE":
             return None
         w = np.frombuffer(payload, np.float32)
@@ -617,8 +676,9 @@ class PSClient:
         if diff is not None:
             payload += np.asarray(diff, np.float32).tobytes()
         self.bytes_pushed += len(payload)
-        _send_msg(self.sock, hdr, payload)
-        header, _ = _recv_msg(self.sock)
+        # stamp ONCE: retries re-send the same (sid, seq), so a push whose
+        # ACK was lost is answered from the PS dedup window, not re-applied
+        header, _ = self._call_raw(self.session.stamp(hdr), payload)
         return bool(header.get("accepted")), bool(header.get("done"))
 
     def pull_saga(self, wid: int, n_p: int) -> Optional[
@@ -628,8 +688,9 @@ class PSClient:
         current history scalars with the model (the reference's sampledMap).
         Returns (ts, w, idx, alpha_sel, n_valid, avg_delay_ms, calibrated)
         or None when DONE."""
-        _send_msg(self.sock, {"op": "PULL", "wid": wid, "n_p": n_p})
-        header, payload = _recv_msg(self.sock)
+        header, payload = self._call_raw(
+            {"op": "PULL", "wid": wid, "n_p": n_p}
+        )
         if header["op"] == "DONE":
             return None
         cap = int(header["cap"])
@@ -648,23 +709,22 @@ class PSClient:
         return self.push(wid, ts, g, sparse=sparse, diff=diff)
 
     def snapshots(self) -> Tuple[List[float], np.ndarray]:
-        _send_msg(self.sock, {"op": "SNAPSHOTS"})
-        header, payload = _recv_msg(self.sock)
+        header, payload = self._call_raw({"op": "SNAPSHOTS"})
         W = np.frombuffer(payload, np.float32).reshape(header["shape"])
         return list(header["times"]), W
 
     def send_eval(self, wid: int, losses: np.ndarray) -> None:
-        _send_msg(self.sock, {"op": "EVAL_RESULT", "wid": wid},
-                  np.asarray(losses, np.float64).tobytes())
-        _recv_msg(self.sock)
+        self._call_raw(self.session.stamp({"op": "EVAL_RESULT", "wid": wid}),
+                       np.asarray(losses, np.float64).tobytes())
 
     def bye(self) -> None:
         try:
-            _send_msg(self.sock, {"op": "BYE"})
-            _recv_msg(self.sock)
+            if self._sock is not None:
+                _send_msg(self._sock, {"op": "BYE"})
+                _recv_msg(self._sock)
         except (ConnectionError, OSError):
             pass
-        self.sock.close()
+        self._drop_sock()
 
 
 def run_worker_process(
@@ -769,6 +829,11 @@ def run_worker_process(
                 try:
                     if cl is None:
                         cl = PSClient(host, port)
+                    # per-RPC transport faults (reconnect, backoff, jitter,
+                    # breaker) are the client's RetryPolicy's problem now;
+                    # PUSH retries are exactly-once-applied via the PS
+                    # dedup window, so nothing here needs to reason about
+                    # "did my gradient land"
                     if algo == "asaga":
                         got = cl.pull_saga(wid, int(shard.y.shape[0]))
                     else:
@@ -808,16 +873,12 @@ def run_worker_process(
                     if done:
                         break
                 except (ConnectionError, OSError):
-                    # PS restart (checkpoint/resume) or a transient DCN
-                    # fault: drop the socket, back off, reconnect, re-pull.
-                    # The in-flight result is lost by design -- the restarted
-                    # PS has no pending state for it anyway.
-                    if cl is not None:
-                        try:
-                            cl.sock.close()
-                        except OSError:
-                            pass
-                        cl = None
+                    # the RPC's whole retry budget is spent (RetryError) or
+                    # the endpoint's breaker is open (CircuitOpenError): the
+                    # PS is restarting from checkpoint or the DCN is down
+                    # for longer than one policy window.  Pace and re-enter
+                    # -- the client reconnects lazily, and a restarted PS
+                    # has no pending state for the lost round anyway.
                     time.sleep(0.2)
         finally:
             if cl is not None:

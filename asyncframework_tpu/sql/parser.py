@@ -1102,10 +1102,17 @@ class SQLContext:
     def __init__(self):
         self._tables: Dict[str, ColumnarFrame] = {}
         self._udfs: Dict[str, Any] = {}
+        # names created by CREATE VIEW DDL: DROP VIEW may only remove
+        # these -- a base table registered via register()/register_csv/...
+        # must survive a stray DROP VIEW (it would silently delete data
+        # the caller still holds a name for)
+        self._views: set = set()
 
     def register(self, name: str, frame: ColumnarFrame) -> None:
-        """``createOrReplaceTempView`` analog."""
+        """``createOrReplaceTempView`` analog (registers a BASE table: not
+        droppable via DROP VIEW)."""
         self._tables[name.lower()] = frame
+        self._views.discard(name.lower())
 
     def register_udf(self, name: str, fn) -> None:
         """Row-wise python UDF (``spark.udf.register`` analog): callable in
@@ -1117,12 +1124,15 @@ class SQLContext:
         predicates into the reader, so unused columns are never parsed and
         filtered rows never reach the device."""
         self._tables[name.lower()] = lazy_csv(name, path, **kw)
+        self._views.discard(name.lower())
 
     def register_json(self, name: str, path) -> None:
         self._tables[name.lower()] = lazy_json(name, path)
+        self._views.discard(name.lower())
 
     def register_parquet(self, name: str, path) -> None:
         self._tables[name.lower()] = lazy_parquet(name, path)
+        self._views.discard(name.lower())
 
     def table(self, name: str) -> ColumnarFrame:
         key = name.lower()
@@ -1180,6 +1190,7 @@ class SQLContext:
             if p.peek() is not None:
                 raise ValueError(f"trailing SQL tokens: {self_rest(p)}")
             self.register(name, frame)
+            self._views.add(name.lower())
             return ColumnarFrame({"view": np.asarray([name], object)})
         p.expect("DROP")
         p.expect("VIEW")
@@ -1194,8 +1205,16 @@ class SQLContext:
         if name.lower() not in self._tables:
             if not if_exists:
                 raise KeyError(f"no view {name!r}")
+        elif name.lower() not in self._views:
+            # IF EXISTS excuses absence, never the wrong object kind: the
+            # name is a registered BASE table, and DROP VIEW deleting it
+            # would destroy data the caller never created through SQL
+            raise ValueError(
+                f"{name!r} is a base table, not a view; DROP VIEW refuses"
+            )
         else:
             del self._tables[name.lower()]
+            self._views.discard(name.lower())
         return ColumnarFrame({"view": np.asarray([name], object)})
 
     @staticmethod

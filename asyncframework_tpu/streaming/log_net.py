@@ -35,8 +35,20 @@ import threading
 import time
 from typing import Any, Iterable, List, Optional, Tuple
 
-from asyncframework_tpu.parallel.ps_dcn import _recv_msg, _send_msg
+from asyncframework_tpu.net import (
+    ClientSession,
+    DedupWindow,
+    RetryError,
+    RetryPolicy,
+)
+from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.net.frame import recv_msg as _recv_msg
+from asyncframework_tpu.net.frame import send_msg as _send_msg
 from asyncframework_tpu.streaming.log import LogTopic
+
+#: ops that mutate server state and therefore ride the (sid, seq) dedup
+#: window -- a retried APPEND must never append twice (round-5 ADVICE bug)
+_MUTATING_OPS = frozenset({"APPEND", "COMMIT"})
 
 
 class LogTopicServer:
@@ -61,6 +73,15 @@ class LogTopicServer:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
+        from asyncframework_tpu.conf import NET_DEDUP_WINDOW, global_conf
+
+        self._dedup = DedupWindow(window=global_conf().get(NET_DEDUP_WINDOW))
+
+    @property
+    def dedup_hits(self) -> int:
+        """Retried mutating ops answered from cache (each one is a record
+        that would have been appended twice before net/session.py)."""
+        return self._dedup.hits
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> Tuple[str, int]:
@@ -111,6 +132,13 @@ class LogTopicServer:
         try:
             while True:
                 header, payload = _recv_msg(conn)
+                if header.get("op") in _MUTATING_OPS:
+                    cached = self._dedup.check(header)
+                    if cached is not None:
+                        # duplicate of an applied op (reply was lost):
+                        # re-send the cached reply, touch no topic
+                        _send_msg(conn, cached[0], cached[1])
+                        continue
                 try:
                     reply, body = self._dispatch(header, payload)
                 except Exception as e:  # a bad request must not kill the
@@ -118,6 +146,11 @@ class LogTopicServer:
                         {"op": "ERR",
                          "error": f"{type(e).__name__}: {e}"}, b"",
                     )
+                if (header.get("op") in _MUTATING_OPS
+                        and reply.get("op") != "ERR"):
+                    # record BEFORE sending: a reply lost mid-send must
+                    # already count as applied for the retry
+                    self._dedup.record(header, reply, body)
                 _send_msg(conn, reply, body)
         except (ConnectionError, OSError):
             pass  # client went away; its offsets are on disk
@@ -161,61 +194,73 @@ class RemoteLogTopic:
 
     Offers the subset :class:`DirectLogStream` and producers use --
     ``read``/``end_offset``/``append``/``append_many``/``commit_offset``/
-    ``committed_offset`` -- with connect retry + reconnect-on-error backoff
-    (the same stance DCN workers take toward a restarting PS)."""
+    ``committed_offset``.  Transport faults route through the shared
+    :class:`~asyncframework_tpu.net.RetryPolicy` (backoff + jitter +
+    per-endpoint breaker), and mutating ops carry this client's session
+    ``(sid, seq)`` -- the server's dedup window makes a retried APPEND
+    exactly-once-applied while the server lives (the round-5
+    duplicate-record bug closed structurally).  The window is in-memory:
+    a retry that straddles a server RESTART is at-least-once again, the
+    same edge the pre-dedup client always had."""
 
     def __init__(self, host: str, port: int, topic: str,
-                 connect_timeout_s: float = 10.0, retries: int = 5):
+                 connect_timeout_s: float = 10.0, retries: int = 5,
+                 retry: Optional[RetryPolicy] = None,
+                 session: Optional[ClientSession] = None):
         self.host, self.port, self.topic = host, int(port), topic
         self.connect_timeout_s = connect_timeout_s
         self.retries = retries
+        self.endpoint = f"{host}:{int(port)}"
+        # legacy knobs map onto the policy: ``retries`` bounds attempts,
+        # ``connect_timeout_s`` bounds the overall deadline (the old
+        # _connect loop's deadline role)
+        self.retry = retry if retry is not None else RetryPolicy.from_conf(
+            max_attempts=max(1, int(retries)),
+            deadline_s=float(connect_timeout_s) + 60.0,
+            attempt_timeout_s=60.0,
+        )
+        self.session = session if session is not None else ClientSession()
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- transport
-    def _connect(self) -> socket.socket:
-        deadline = time.monotonic() + self.connect_timeout_s
-        delay = 0.05
-        while True:
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
             try:
-                s = socket.create_connection(
-                    (self.host, self.port), timeout=10.0
-                )
-                s.settimeout(60.0)
-                return s
+                self._sock.close()
             except OSError:
-                if time.monotonic() > deadline:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 1.0)
+                pass
+            self._sock = None
 
     def _call(self, header: dict, payload: bytes = b""
               ) -> Tuple[dict, bytes]:
+        if header.get("op") in _MUTATING_OPS:
+            # stamp once per logical op; every retry re-sends this header
+            header = self.session.stamp(header)
+
+        def attempt() -> Tuple[dict, bytes]:
+            try:
+                if self._sock is None:
+                    s = _frame.connect((self.host, self.port), timeout=10.0)
+                    s.settimeout(self.retry.attempt_timeout_s)
+                    self._sock = s
+                _send_msg(self._sock, header, payload)
+                reply, body = _recv_msg(self._sock)
+            except OSError:
+                self._drop_sock()  # server restarted: reconnect on retry
+                raise
+            if reply.get("op") == "ERR":
+                # protocol error: deterministic, NOT retryable
+                raise RuntimeError(f"topic server: {reply.get('error')}")
+            return reply, body
+
         with self._lock:
-            last: Optional[Exception] = None
-            for _attempt in range(self.retries):
-                try:
-                    if self._sock is None:
-                        self._sock = self._connect()
-                    _send_msg(self._sock, header, payload)
-                    reply, body = _recv_msg(self._sock)
-                    if reply.get("op") == "ERR":
-                        raise RuntimeError(
-                            f"topic server: {reply.get('error')}"
-                        )
-                    return reply, body
-                except (ConnectionError, OSError) as e:
-                    last = e  # server restarted: reconnect and retry
-                    try:
-                        if self._sock is not None:
-                            self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                    time.sleep(0.1)
-            raise ConnectionError(
-                f"topic server {self.host}:{self.port} unreachable"
-            ) from last
+            try:
+                return self.retry.call(attempt, endpoint=self.endpoint)
+            except RetryError as e:
+                raise ConnectionError(
+                    f"topic server {self.host}:{self.port} unreachable"
+                ) from e
 
     def close(self) -> None:
         with self._lock:
@@ -274,6 +319,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--segment-bytes", type=int, default=64 * 1024 * 1024)
     args = ap.parse_args(argv)
+    from asyncframework_tpu.net import faults
+
+    faults.maybe_install_from_conf()  # chaos runs configure daemons by env
     srv = LogTopicServer(args.root, host=args.host, port=args.port,
                          segment_bytes=args.segment_bytes)
     host, port = srv.start()

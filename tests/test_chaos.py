@@ -1,0 +1,285 @@
+"""Seeded chaos fabric for the DCN plane (ISSUE 1 acceptance).
+
+The old soak story was "kill -9 and hope"; this suite drives the SAME
+failure modes through ``net/faults.py``'s deterministic schedules instead:
+connection-refused at the dial, requests cut mid-frame, replies stalled,
+and the retry-poison case -- replies dropped strictly AFTER the server
+applied the op.  Every run asserts the exactly-once ledger (server-side
+dedup counters) and the flagship run replays byte-identically.
+
+Determinism discipline: chaos legs run ONE client op-stream per endpoint
+(single DCN worker, serial topic/master clients), because the schedule
+keys on (endpoint, op, nth-occurrence) and a deterministic nth needs a
+deterministic op order.  Multi-worker chaos stays in the (slow-marked)
+kill -9 soak, which asserts liveness rather than bytes.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.data.sharded import ShardedDataset
+from asyncframework_tpu.net import faults, retry
+from asyncframework_tpu.net import frame as frame_mod
+from asyncframework_tpu.net.faults import (
+    CONNECT_OP,
+    CONNECT_REFUSED,
+    CUT_MID_FRAME,
+    DROP_REPLY,
+    STALL_READ,
+    FaultSchedule,
+)
+from asyncframework_tpu.parallel import ps_dcn
+from asyncframework_tpu.solvers import SolverConfig
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_net_state():
+    """Breakers are process-global by endpoint and ephemeral ports recycle;
+    chaos runs must neither inherit nor leak trip state (or schedules)."""
+    retry.reset_breakers()
+    faults.clear()
+    yield
+    retry.reset_breakers()
+    faults.clear()
+
+
+def make_cfg(**kw):
+    defaults = dict(
+        num_workers=1, num_iterations=30, gamma=1.2, taw=2**31 - 1,
+        batch_rate=0.3, bucket_ratio=0.0, printer_freq=10, seed=42,
+        calibration_iters=4, run_timeout_s=60.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+def _chaos_asgd_run(devices, extra_events=None):
+    """One single-worker ASGD-over-DCN run under a seeded schedule hitting
+    the PS with all four fault kinds.  Returns the replay fingerprint."""
+    cfg = make_cfg()
+    n, d = 256, 8
+    ds = ShardedDataset.generate_on_device(n, d, 1, devices=devices[:1],
+                                           seed=11, noise=0.01)
+    ps = ps_dcn.ParameterServer(cfg, d, n, device=devices[0], port=0).start()
+    ep = f"127.0.0.1:{ps.port}"
+    sched = FaultSchedule(seed=7)
+    sched.add(ep, CONNECT_OP, 1, CONNECT_REFUSED)   # first dial refused
+    sched.add(ep, "PULL", 3, STALL_READ)            # model reply stalls
+    sched.add(ep, "PUSH", 2, CUT_MID_FRAME)         # gradient cut on wire
+    sched.add(ep, "PUSH", 5, DROP_REPLY)            # applied, ACK eaten
+    for ev in extra_events or ():
+        sched.add(ep, *ev)
+    try:
+        with faults.injected(sched) as inj:
+            counts = ps_dcn.run_worker_process(
+                "127.0.0.1", ps.port, [0], {0: ds.shard(0)}, cfg, d, n,
+                deadline_s=60.0,
+            )
+            assert ps.wait_done(timeout_s=5.0)
+        _times, W = ps.snapshot_stack()
+        fired = tuple((e["op"], e["nth"], e["kind"]) for e in inj.fired)
+        return {
+            "final_w": W[-1].tobytes(),
+            "accepted": ps.accepted,
+            "dropped": ps.dropped,
+            "max_staleness": ps.max_staleness,
+            "dedup_hits": ps.dedup_hits,
+            "counts": dict(counts),
+            "fired": fired,
+            "remaining": len(inj.remaining()),
+        }
+    finally:
+        ps.stop()
+
+
+class _FakeWorkerDaemon:
+    """ACKs the master's LAUNCH/KILL orders without forking anything --
+    the master leg of the chaos fabric needs a schedulable worker, not a
+    real executor."""
+
+    def __init__(self):
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self._srv.settimeout(0.2)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = threading.Event()
+        self.launches = []
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg, _ = frame_mod.recv_msg(conn)
+                if msg.get("op") == "LAUNCH":
+                    self.launches.append(msg["app_id"])
+                frame_mod.send_msg(conn, {"op": "ACK"})
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class TestChaosAcceptance:
+    def test_chaos_fabric_across_ps_topic_and_master(self, devices8,
+                                                     tmp_path):
+        """The acceptance run: one seeded schedule with >=1
+        connection-refused, >=1 mid-frame cut, and >=1
+        reply-dropped-after-apply spread across the PS, the topic server,
+        and the master -- ASGD completes with correct final state and the
+        dedup ledgers show zero duplicated APPENDs / PUSHes / SUBMITs."""
+        from asyncframework_tpu.deploy.client import MasterClient
+        from asyncframework_tpu.deploy.master import Master
+        from asyncframework_tpu.streaming.log_net import (
+            LogTopicServer,
+            RemoteLogTopic,
+        )
+
+        # --- PS leg: the full four-kind schedule, run to completion
+        out = _chaos_asgd_run(devices8)
+        assert out["remaining"] == 0, "every scheduled fault must fire"
+        assert out["accepted"] == 30
+        assert out["dedup_hits"] == 1     # exactly the DROP_REPLY push
+        kinds = {k for (_op, _n, k) in out["fired"]}
+        assert kinds == {CONNECT_REFUSED, STALL_READ, CUT_MID_FRAME,
+                         DROP_REPLY}
+        # the run actually descended (correct final state, not just "done")
+        assert np.isfinite(
+            np.frombuffer(out["final_w"], np.float32)
+        ).all()
+
+        # --- topic leg: drop the APPENDED reply after apply, cut the
+        # retry mid-frame, refuse one reconnect dial -- the log must hold
+        # each record exactly once (the round-5 duplicate-APPEND bug)
+        srv = LogTopicServer(str(tmp_path / "topics"), host="127.0.0.1")
+        srv.start()
+        tep = f"127.0.0.1:{srv.port}"
+        tsched = (FaultSchedule(seed=7)
+                  .add(tep, "APPEND", 1, DROP_REPLY)
+                  .add(tep, CONNECT_OP, 2, CONNECT_REFUSED)
+                  .add(tep, "APPEND", 2, CUT_MID_FRAME))
+        try:
+            with faults.injected(tsched) as inj:
+                t = RemoteLogTopic("127.0.0.1", srv.port, "orders")
+                first, nxt = t.append_many([{"i": i} for i in range(10)])
+                assert (first, nxt) == (0, 10)
+                first2, nxt2 = t.append_many([{"i": i} for i in range(10, 20)])
+                assert (first2, nxt2) == (10, 20)
+                records, _ = t.read(0)
+                t.close()
+            assert inj.remaining() == []
+            assert [r["i"] for r in records] == list(range(20))
+            assert srv.dedup_hits == 1  # the dropped-reply APPEND's retry
+        finally:
+            srv.stop()
+
+        # --- master leg: SUBMITTED reply dropped after the app was
+        # scheduled; the retried SUBMIT must be answered from the dedup
+        # window -- exactly one app, same app_id
+        master = Master(port=0)
+        fake = _FakeWorkerDaemon()
+        try:
+            master.start()
+            mep = f"127.0.0.1:{master.port}"
+            # register the fake worker through the real protocol
+            with frame_mod.connect((master.host, master.port)) as s:
+                frame_mod.send_msg(s, {
+                    "op": "REGISTER_WORKER", "worker_id": "fw-1",
+                    "host": fake.host, "port": fake.port, "cores": 1,
+                })
+                reply, _ = frame_mod.recv_msg(s)
+            assert reply["op"] == "REGISTERED"
+            msched = FaultSchedule(seed=7).add(
+                mep, "SUBMIT_APP", 1, DROP_REPLY)
+            with faults.injected(msched) as inj:
+                cl = MasterClient(master.host, master.port)
+                app_id = cl.submit(["--quiet", "noop"], num_processes=1)
+            assert inj.remaining() == []
+            assert list(master.apps) == [app_id]  # exactly one app
+            assert master.dedup_hits == 1
+            assert fake.launches == [app_id]      # launched exactly once
+        finally:
+            fake.stop()
+            master.stop()
+
+    def test_chaos_replay_is_byte_identical(self, devices8):
+        """Same schedule, same seeds -> same fired-fault journal, same
+        accept/drop/staleness ledger, byte-identical final weights."""
+        a = _chaos_asgd_run(devices8)
+        retry.reset_breakers()
+        b = _chaos_asgd_run(devices8)
+        assert a["fired"] == b["fired"]
+        assert (a["accepted"], a["dropped"], a["max_staleness"],
+                a["dedup_hits"]) == (b["accepted"], b["dropped"],
+                                     b["max_staleness"], b["dedup_hits"])
+        assert a["counts"] == b["counts"]
+        assert a["final_w"] == b["final_w"]
+
+
+class TestHeartbeatShardRecoveryChaos:
+    def test_ps_cut_mid_wave_replays_same_ledger(self, devices8):
+        """A PULL cut mid-frame while the cohort wave is forming, plus a
+        stalled wave reply: the degraded run must reach the same
+        accepted/dropped/max-staleness counts on replay (MULTICHIP-style
+        determinism)."""
+        extra = [("PULL", 5, CUT_MID_FRAME), ("PULL", 7, STALL_READ)]
+        a = _chaos_asgd_run(devices8, extra_events=extra)
+        retry.reset_breakers()
+        b = _chaos_asgd_run(devices8, extra_events=extra)
+        assert a["remaining"] == b["remaining"] == 0
+        assert (a["accepted"], a["dropped"], a["max_staleness"]) == \
+               (b["accepted"], b["dropped"], b["max_staleness"])
+        assert a["final_w"] == b["final_w"]
+
+    def test_heartbeat_loss_and_recovery_deterministic_under_faults(
+            self, devices8):
+        """Engine-plane failure handling keeps working (and stays
+        deterministic) while a network fault injector is live: a killed
+        executor is declared lost by the HeartbeatMonitor and its shard
+        re-homes to the same adopter on every run.  The pending network
+        events must NOT fire -- the engine plane never touches the DCN
+        framing."""
+        from asyncframework_tpu.engine import JobScheduler, ShardRecovery
+        from asyncframework_tpu.engine import plan_reassignment
+        from asyncframework_tpu.engine.heartbeat import HeartbeatMonitor
+
+        def run_once():
+            with faults.injected(FaultSchedule().add(
+                    "*", "PUSH", 1, CUT_MID_FRAME)) as inj:
+                ds = ShardedDataset.generate_on_device(
+                    64, 4, 4, devices=devices8[:4], seed=5)
+                rec = ShardRecovery(ds, devices8[:4])
+                js = JobScheduler(num_workers=4)
+                lost = []
+                try:
+                    mon = HeartbeatMonitor(js.pool, on_executor_lost=lost.append,
+                                           timeout_ms=1000.0)
+                    js.pool.executors[1].kill()
+                    js.pool.executors[3].kill()
+                    flagged = mon.check_once()
+                    plan = plan_reassignment(range(4), dead=flagged)
+                    rec.apply(plan)
+                    owners = {sid: rec.owner(sid) for sid in range(4)}
+                finally:
+                    js.shutdown()
+                assert inj.fired == []  # engine plane is DCN-fault-proof
+                return tuple(sorted(flagged)), tuple(sorted(plan.moves.items())), \
+                    tuple(sorted(owners.items()))
+
+        assert run_once() == run_once()

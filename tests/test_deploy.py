@@ -188,6 +188,26 @@ class TestMasterUI:
             w.stop()
             m.stop()
 
+    def test_ui_host_is_configurable(self, tmp_path):
+        """ISSUE 1 satellite: the UI used to hard-bind 127.0.0.1 -- a k8s
+        Service could never route to it.  ``ui_host`` must reach the HTTP
+        server's actual bind address."""
+        from asyncframework_tpu.deploy import Master
+
+        m = Master(persistence_dir=str(tmp_path), ui_port=0,
+                   ui_host="0.0.0.0").start()
+        try:
+            assert m._ui._httpd.server_address[0] == "0.0.0.0"
+            # still reachable over loopback (0.0.0.0 covers it)
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{m._ui.port}/api/status", timeout=5
+            ) as r:
+                assert r.status == 200
+        finally:
+            m.stop()
+
 
 class TestMasterRecovery:
     def test_state_survives_master_restart(self, tmp_path):

@@ -34,6 +34,9 @@ class TestMasterRendering:
         svc = objs[2]
         ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
         assert ports == {"rpc": k8s.RPC_PORT, "ui": k8s.UI_PORT}
+        # the UI must bind beyond pod loopback or the Service's ui port
+        # routes to nothing (ISSUE 1 satellite)
+        assert cmd[cmd.index("--ui-host") + 1] == "0.0.0.0"
 
     def test_ha_masters_share_rwx_state(self):
         objs = k8s.render_master(ha_replicas=3)

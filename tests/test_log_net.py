@@ -204,6 +204,36 @@ class TestTwoProcess:
         ssc2.generate_batch(100)
         assert seen2 == [[10, 11, 12]]
 
+    def test_retried_append_after_dropped_reply_is_exactly_once(
+            self, server):
+        """Regression (ISSUE 1 satellite, round-5 ADVICE): _call used to
+        re-send APPEND after a lost reply and the topic grew duplicate
+        records.  With (sid, seq) dedup the retry is answered from the
+        server's window -- the log length must equal the records produced.
+        """
+        from asyncframework_tpu.net import faults, retry
+
+        retry.reset_breakers()
+        # the server binds 0.0.0.0; the client's peername says 127.0.0.1 --
+        # match by port, which is what identifies the endpoint here
+        sched = faults.FaultSchedule().add(
+            f"*:{server.port}", "APPEND", 1, faults.DROP_REPLY)
+        try:
+            with faults.injected(sched) as inj:
+                t = RemoteLogTopic(server.host, server.port, "dedup")
+                first, nxt = t.append_many([{"i": i} for i in range(5)])
+                t.close()
+            assert inj.remaining() == []          # the fault really fired
+            assert (first, nxt) == (0, 5)         # retry saw the SAME offsets
+            check = RemoteLogTopic(server.host, server.port, "dedup")
+            assert check.end_offset() == 5        # 5 records, not 10
+            records, _ = check.read(0)
+            assert [r["i"] for r in records] == list(range(5))
+            check.close()
+            assert server.dedup_hits == 1
+        finally:
+            faults.clear()
+
     def test_server_restart_client_reconnects(self, tmp_path):
         root = str(tmp_path / "topics")
 

@@ -1,0 +1,324 @@
+"""Unit tests for the shared robustness layer (asyncframework_tpu/net/):
+retry policy + decorrelated jitter + deadline, per-endpoint circuit
+breakers, exactly-once client sessions / dedup windows, and the
+deterministic fault-schedule machinery (ISSUE 1 tentpole)."""
+
+import socket
+import threading
+
+import pytest
+
+from asyncframework_tpu.conf import AsyncConf
+from asyncframework_tpu.net import faults, retry, session
+from asyncframework_tpu.net.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryError,
+    RetryPolicy,
+)
+from asyncframework_tpu.net.session import ClientSession, DedupWindow
+
+
+@pytest.fixture(autouse=True)
+def _clean_net_state():
+    retry.reset_breakers()
+    faults.clear()
+    yield
+    retry.reset_breakers()
+    faults.clear()
+
+
+def no_sleep_policy(**kw):
+    kw.setdefault("sleep", lambda s: None)
+    return RetryPolicy(**kw)
+
+
+class TestRetryPolicy:
+    def test_first_success_no_retry(self):
+        calls = []
+        out = no_sleep_policy().call(lambda: calls.append(1) or "ok")
+        assert out == "ok" and len(calls) == 1
+
+    def test_retries_transport_errors_then_succeeds(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ConnectionResetError("boom")
+            return 7
+
+        assert no_sleep_policy(max_attempts=5).call(flaky) == 7
+        assert len(attempts) == 3
+
+    def test_gives_up_with_retry_error_chaining_cause(self):
+        def dead():
+            raise ConnectionRefusedError("nope")
+
+        with pytest.raises(RetryError) as ei:
+            no_sleep_policy(max_attempts=3).call(dead)
+        assert isinstance(ei.value.__cause__, ConnectionRefusedError)
+        # RetryError IS a ConnectionError: old call sites need no new
+        # except clauses
+        assert isinstance(ei.value, ConnectionError)
+
+    def test_non_transport_errors_surface_immediately(self):
+        attempts = []
+
+        def bad_request():
+            attempts.append(1)
+            raise RuntimeError("protocol error")
+
+        with pytest.raises(RuntimeError):
+            no_sleep_policy(max_attempts=5).call(bad_request)
+        assert len(attempts) == 1
+
+    def test_socket_timeout_is_retryable(self):
+        attempts = []
+
+        def stalls_once():
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise socket.timeout("stalled")
+            return "late"
+
+        assert no_sleep_policy().call(stalls_once) == "late"
+
+    def test_backoff_walk_is_seeded_and_bounded(self):
+        p = RetryPolicy(base_ms=50.0, max_ms=400.0, seed=7)
+        gen = p.backoffs_ms()
+        walk = [next(gen) for _ in range(20)]
+        gen2 = RetryPolicy(base_ms=50.0, max_ms=400.0, seed=7).backoffs_ms()
+        assert walk == [next(gen2) for _ in range(20)]  # replayable
+        assert all(50.0 <= b <= 400.0 for b in walk)
+        other = RetryPolicy(base_ms=50.0, max_ms=400.0, seed=8).backoffs_ms()
+        assert walk != [next(other) for _ in range(20)]
+
+    def test_overall_deadline_stops_before_max_attempts(self):
+        attempts = []
+        # deadline already passed after the first failure -> no 2nd attempt
+        p = no_sleep_policy(max_attempts=100, deadline_s=1e-9)
+
+        def dead():
+            attempts.append(1)
+            raise ConnectionError("x")
+
+        with pytest.raises(RetryError):
+            p.call(dead)
+        assert len(attempts) == 1
+
+    def test_on_retry_hook_sees_attempt_and_error(self):
+        seen = []
+
+        def flaky():
+            if len(seen) < 2:
+                raise ConnectionError("x")
+            return 1
+
+        no_sleep_policy().call(
+            flaky, on_retry=lambda a, e: seen.append((a, type(e))))
+        assert seen == [(1, ConnectionError), (2, ConnectionError)]
+
+    def test_counters_accumulate(self):
+        retry.reset_retry_totals()
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ConnectionError("x")
+            return 1
+
+        no_sleep_policy().call(flaky)
+        with pytest.raises(RetryError):
+            no_sleep_policy(max_attempts=2).call(
+                lambda: (_ for _ in ()).throw(ConnectionError("y")))
+        t = retry.retry_totals()
+        assert t["retries"] == 2 + 1 and t["giveups"] == 1
+
+    def test_from_conf_reads_registered_entries(self):
+        conf = AsyncConf({
+            "async.net.retry.max.attempts": 9,
+            "async.net.retry.base.ms": "10",
+            "async.net.breaker.threshold": 3,
+        })
+        p = RetryPolicy.from_conf(conf)
+        assert p.max_attempts == 9
+        assert p.base_ms == 10.0
+        assert p.breaker_threshold == 3
+        assert p.max_ms == 2000.0  # registered default
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_fails_fast(self):
+        t = [0.0]
+        br = CircuitBreaker(threshold=3, cooldown_s=10.0,
+                            clock=lambda: t[0])
+        for _ in range(2):
+            assert not br.record_failure()
+            assert br.allow()
+        assert br.record_failure()  # third consecutive -> trip
+        assert not br.allow() and br.open
+
+    def test_half_open_probe_closes_on_success(self):
+        t = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=lambda: t[0])
+        br.record_failure()
+        assert not br.allow()
+        t[0] = 5.1  # cooldown over: half-open probe allowed
+        assert br.allow()
+        br.record_success()
+        assert br.allow() and not br.open
+
+    def test_half_open_probe_reopens_on_failure(self):
+        t = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=lambda: t[0])
+        br.record_failure()
+        t[0] = 5.1
+        assert br.allow()
+        br.record_failure()  # probe failed
+        assert not br.allow()  # open again, fresh cooldown from t=5.1
+        t[0] = 9.0
+        assert not br.allow()
+        t[0] = 10.3
+        assert br.allow()
+
+    def test_policy_fails_fast_while_endpoint_open(self):
+        p = no_sleep_policy(max_attempts=2, breaker_threshold=2,
+                            breaker_cooldown_s=60.0)
+
+        def dead():
+            raise ConnectionError("x")
+
+        with pytest.raises(RetryError):
+            p.call(dead, endpoint="1.2.3.4:9")
+        # the two failures tripped the shared breaker: next call does not
+        # even run fn
+        ran = []
+        with pytest.raises(CircuitOpenError):
+            p.call(lambda: ran.append(1), endpoint="1.2.3.4:9")
+        assert ran == []
+        # a different endpoint is unaffected
+        assert p.call(lambda: "ok", endpoint="5.6.7.8:9") == "ok"
+
+    def test_breakers_shared_per_endpoint_across_policies(self):
+        a = no_sleep_policy(max_attempts=1, breaker_threshold=1,
+                            breaker_cooldown_s=60.0)
+        b = no_sleep_policy(max_attempts=1, breaker_threshold=1,
+                            breaker_cooldown_s=60.0)
+        with pytest.raises(RetryError):
+            a.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                   endpoint="ps:1")
+        with pytest.raises(CircuitOpenError):
+            b.call(lambda: "never", endpoint="ps:1")
+
+
+class TestSessionDedup:
+    def test_stamp_monotonic_and_thread_safe(self):
+        s = ClientSession(sid="abc")
+        seen = []
+
+        def mint():
+            for _ in range(200):
+                seen.append(s.stamp({"op": "X"})["seq"])
+
+        ts = [threading.Thread(target=mint) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(seen) == list(range(1, 801))  # no seq ever reused
+
+    def test_duplicate_returns_cached_reply_without_reapply(self):
+        w = DedupWindow(window=8)
+        h = ClientSession(sid="s1").stamp({"op": "APPEND"})
+        assert w.check(h) is None  # first time: apply
+        w.record(h, {"op": "APPENDED", "first": 3}, b"body")
+        assert w.check(h) == ({"op": "APPENDED", "first": 3}, b"body")
+        assert w.hits == 1
+
+    def test_unstamped_headers_pass_through(self):
+        w = DedupWindow()
+        assert w.check({"op": "APPEND"}) is None
+        w.record({"op": "APPEND"}, {"op": "APPENDED"})  # no-op
+        assert w.check({"op": "APPEND"}) is None
+        assert w.hits == 0
+
+    def test_window_evicts_oldest_seq(self):
+        w = DedupWindow(window=2)
+        s = ClientSession(sid="s")
+        hs = [s.stamp({"op": "A"}) for _ in range(3)]
+        for h in hs:
+            w.record(h, {"op": "OK", "seq": h["seq"]})
+        assert w.check(hs[0]) is None      # evicted
+        assert w.check(hs[1]) is not None  # still inside the window
+        assert w.check(hs[2]) is not None
+
+    def test_sessions_evict_lru(self):
+        w = DedupWindow(window=4, max_sessions=2)
+        ha = ClientSession(sid="a").stamp({"op": "A"})
+        hb = ClientSession(sid="b").stamp({"op": "A"})
+        hc = ClientSession(sid="c").stamp({"op": "A"})
+        for h in (ha, hb, hc):
+            w.record(h, {"op": "OK"})
+        assert w.check(ha) is None      # LRU session dropped
+        assert w.check(hc) is not None
+
+
+class TestFaultSchedule:
+    def test_json_round_trip(self):
+        sched = (faults.FaultSchedule(seed=9)
+                 .add("127.0.0.1:77", "PUSH", 2, faults.DROP_REPLY)
+                 .add("*", faults.CONNECT_OP, 1, faults.CONNECT_REFUSED))
+        back = faults.FaultSchedule.from_json(sched.to_json())
+        assert back.seed == 9
+        assert [(e.endpoint, e.op, e.nth, e.kind) for e in back.events] == [
+            ("127.0.0.1:77", "PUSH", 2, faults.DROP_REPLY),
+            ("*", faults.CONNECT_OP, 1, faults.CONNECT_REFUSED),
+        ]
+
+    def test_bad_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.FaultEvent("*", "PUSH", 1, "meteor_strike")
+
+    def test_nth_occurrence_matching_fires_once(self):
+        sched = faults.FaultSchedule().add(
+            "h:1", "PUSH", 3, faults.CUT_MID_FRAME)
+        inj = faults.FaultInjector(sched)
+        assert inj.check_send("h:1", "PUSH") is None
+        assert inj.check_send("h:1", "PULL") is None  # other op: no count
+        assert inj.check_send("h:2", "PUSH") is None  # other endpoint
+        assert inj.check_send("h:1", "PUSH") is None
+        assert inj.check_send("h:1", "PUSH") == faults.CUT_MID_FRAME
+        assert inj.check_send("h:1", "PUSH") is None  # fired exactly once
+        assert inj.fired == [{"endpoint": "h:1", "op": "PUSH", "nth": 3,
+                              "kind": faults.CUT_MID_FRAME}]
+        assert inj.remaining() == []
+
+    def test_wildcard_port_pattern(self):
+        sched = faults.FaultSchedule().add(
+            "*:7077", "SUBMIT_APP", 1, faults.DROP_REPLY)
+        inj = faults.FaultInjector(sched)
+        assert inj.check_send("10.0.0.9:7078", "SUBMIT_APP") is None
+        assert (inj.check_send("10.0.0.9:7077", "SUBMIT_APP")
+                == faults.DROP_REPLY)
+
+    def test_connect_refused_raises_at_dial(self):
+        sched = faults.FaultSchedule().add(
+            "h:5", faults.CONNECT_OP, 1, faults.CONNECT_REFUSED)
+        inj = faults.FaultInjector(sched)
+        with pytest.raises(ConnectionRefusedError):
+            inj.check_connect("h:5")
+        inj.check_connect("h:5")  # second dial: clean
+
+    def test_install_from_conf_inline_json(self):
+        sched = faults.FaultSchedule(seed=3).add(
+            "*", "PUSH", 1, faults.STALL_READ)
+        conf = AsyncConf({"async.net.fault.schedule": sched.to_json()})
+        inj = faults.maybe_install_from_conf(conf)
+        try:
+            assert inj is faults.active()
+            assert inj.schedule.seed == 3
+        finally:
+            faults.clear()
+        assert faults.maybe_install_from_conf(AsyncConf()) is None

@@ -369,6 +369,25 @@ class TestDeviceShuffle:
                     k, op,
                 )
 
+    def test_int64_sums_exact_beyond_2p53(self):
+        """ISSUE 1 satellite: the bincount path summed via float64 weights,
+        silently rounding integer totals past 2^53.  At the 2^60 boundary
+        the sum must be bit-exact (int64 accumulation via np.add.at)."""
+        from asyncframework_tpu.ops.shuffle import host_reduce_by_key
+
+        big = np.int64(2**60 + 1)
+        keys = np.asarray([0, 0, 1], np.int64)
+        vals = np.asarray([big, big, 5], np.int64)
+        out = host_reduce_by_key({0: (keys, vals)}, op="sum")
+        got = {int(k): int(v) for k, v in zip(*out[0])}
+        # 2^61 + 2 is NOT float64-representable; exact accumulation is
+        assert got == {0: 2**61 + 2, 1: 5}
+        # sparse keyspace (sort + reduceat route) stays exact too
+        keys2 = np.asarray([2**40, 2**40, 7], np.int64)
+        out2 = host_reduce_by_key({0: (keys2, vals)}, op="sum")
+        got2 = {int(k): int(v) for k, v in zip(*out2[0])}
+        assert got2 == {2**40: 2**61 + 2, 7: 5}
+
     def test_host_vectorized_sparse_keyspace_uses_sort_path(self):
         # keys sparse in a huge range: bincount would explode; the sort +
         # reduceat route must produce identical results

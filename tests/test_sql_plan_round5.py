@@ -519,6 +519,25 @@ class TestViewDDL:
         with pytest.raises(KeyError):
             ctx.sql("DROP VIEW x")
 
+    def test_drop_view_refuses_base_tables(self):
+        """ISSUE 1 satellite: DROP VIEW used to delete ANY registered name
+        -- including base tables the caller registered via register() --
+        silently unregistering real data.  Only CREATE VIEW names drop."""
+        ctx = self._ctx()  # 't' is a register()ed base table
+        with pytest.raises(ValueError, match="base table"):
+            ctx.sql("DROP VIEW t")
+        # IF EXISTS excuses absence, never the wrong object kind
+        with pytest.raises(ValueError, match="base table"):
+            ctx.sql("DROP VIEW IF EXISTS t")
+        assert ctx.table("t") is not None  # still queryable
+        # a name re-registered as a base table loses its view-ness
+        ctx.sql("CREATE VIEW v AS SELECT k FROM t")
+        ctx.sql("DROP VIEW v")  # fine while it is a view
+        ctx.sql("CREATE VIEW v2 AS SELECT k FROM t")
+        ctx.register("v2", ctx.table("t"))  # now a base table
+        with pytest.raises(ValueError, match="base table"):
+            ctx.sql("DROP VIEW v2")
+
 
 class TestExplainStatement:
     def test_explain_returns_plan_frame(self):
