@@ -46,6 +46,28 @@ _MANIFEST_KEY = "__manifest__"
 _ARR_PREFIX = "arr:"  # namespaces array keys away from the manifest entry
 
 
+def durable_replace(tmp_path, final_path) -> None:
+    """Crash- AND power-loss-durable atomic rename: fsync the data file,
+    ``os.replace`` it onto the final name, then fsync the parent directory
+    so the rename itself is on disk.  Without the directory fsync a host
+    power loss after a "completed" save can roll the directory entry back
+    to the old (or no) file even though the data blocks were flushed --
+    the classic rename-durability hole.  One definition so the PS
+    checkpoint, the step-numbered manager, and the master's persistence
+    engine cannot drift on the discipline."""
+    fd = os.open(tmp_path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp_path, final_path)
+    dfd = os.open(os.path.dirname(os.path.abspath(final_path)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def _pid_alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)
@@ -183,17 +205,7 @@ class CheckpointManager:
         # fsync data before the rename and the directory after it, so a power
         # loss can never leave a truncated ckpt-<step>.npz behind the atomic
         # name swap (same discipline as native/kvstore.cc kv_compact)
-        fd = os.open(tmp, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-        os.replace(tmp, final)  # atomic, even over an existing same-step file
-        dfd = os.open(self.directory, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        durable_replace(tmp, final)
         self._gc()
         return final
 

@@ -496,15 +496,27 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
             f"needs at least one partition"
         )
     if pid == 0:
+        from asyncframework_tpu.conf import ELASTIC_ENABLED
+
         ckpt_path = None
         if args.checkpoint_dir:
             os.makedirs(args.checkpoint_dir, exist_ok=True)
             ckpt_path = os.path.join(args.checkpoint_dir, f"ps_{algo}.npz")
+        sup = None
+        if conf.get(ELASTIC_ENABLED):
+            from asyncframework_tpu.parallel.supervisor import (
+                ElasticSupervisor,
+            )
+
+            sup = ElasticSupervisor.from_conf(cfg.num_workers, conf)
         ps = ps_dcn.ParameterServer(
             cfg, args.d, args.N, host="0.0.0.0", port=int(port_s), algo=algo,
-            checkpoint_path=ckpt_path,
+            checkpoint_path=ckpt_path, supervisor=sup,
         ).start()
         ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
+        if not ok:
+            # progress-aware diagnostic: who went silent, who contributed
+            print(ok.diagnostic, file=sys.stderr)
         total = ps.collect_eval(n_workers_procs, timeout_s=120.0)
         trajectory = []
         if total is not None:
@@ -520,6 +532,7 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
             "dropped": ps.dropped,
             "max_staleness": ps.max_staleness,
             "resumed_from": ps.resumed_from_k,
+            "recovery": sup.counters() if sup is not None else None,
             "final_objective": trajectory[-1][1] if trajectory else None,
             "trajectory": trajectory,
         }
@@ -536,6 +549,10 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
     counts = ps_dcn.run_worker_process(
         host, int(port_s), wids, shards, cfg, args.d, args.N,
         eval_wid=wids[0], deadline_s=cfg.run_timeout_s, algo=algo,
+        # every worker process holds the full (deterministic) dataset, so
+        # it can materialize ANY shard on adoption orders from the PS
+        shard_factory=X.shard,
+        proc_token=f"dcn-{os.getpid()}-p{pid}",
     )
     return {
         "driver": f"{algo}-dcn-worker",

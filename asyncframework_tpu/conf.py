@@ -229,3 +229,22 @@ NET_FAULT_SCHEDULE = ConfigEntry(
 NET_FAULT_SEED = ConfigEntry(
     "async.net.fault.seed", 0, int,
     "Seed chaos runs hand to retry policies so backoff walks replay.")
+# ---------------------------------------------------------- elastic plane
+# The process-level membership supervisor (parallel/supervisor.py): worker
+# death detection, shard adoption, rejoin, degraded-cohort clamping for
+# the multi-process DCN training path.
+ELASTIC_ENABLED = ConfigEntry(
+    "async.elastic.enabled", True, bool,
+    "Run the DCN parameter server with the elastic membership supervisor "
+    "(worker-death detection + shard adoption + rejoin).")
+ELASTIC_DEAD_AFTER_S = ConfigEntry(
+    "async.elastic.dead.after.s", 5.0, float,
+    "Silence past this declares a worker dead (local process exit is "
+    "detected immediately via its registered pid).")
+ELASTIC_CHECK_INTERVAL_S = ConfigEntry(
+    "async.elastic.check.interval.s", 0.5, float,
+    "Supervisor monitor scan period.")
+ELASTIC_BOOT_GRACE_S = ConfigEntry(
+    "async.elastic.boot.grace.s", 10.0, float,
+    "Never-contacted shards are not handed out for adoption before this "
+    "much run time has passed (covers slow worker bring-up/compile).")

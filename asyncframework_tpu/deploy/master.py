@@ -210,7 +210,11 @@ class Master:
         tmp = self._persist_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f)
-        os.replace(tmp, self._persist_path)
+        # fsync file + rename + fsync directory: an HA takeover after host
+        # power loss must see the registry the dead master believed it had
+        from asyncframework_tpu.checkpoint import durable_replace
+
+        durable_replace(tmp, self._persist_path)
 
     def _recover(self, takeover: bool = False) -> None:
         if self._persist_path is None or not os.path.exists(

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 
@@ -37,18 +37,29 @@ class ReassignmentPlan:
 
 
 def plan_reassignment(
-    all_workers: Sequence[int], dead: Sequence[int]
+    all_workers: Sequence, dead: Sequence[int],
+    load: Optional[Dict] = None,
 ) -> ReassignmentPlan:
     """Round-robin dead workers' shards over survivors, least-loaded first.
 
     Deterministic: survivors are visited in ascending id order, dead shards
     in ascending id order, so every host computes the same plan.
+
+    ``load`` (optional) is the survivors' CURRENT shard count -- the
+    multi-process supervisor re-plans incrementally as membership keeps
+    changing, so a survivor that already adopted shards must weigh
+    heavier than a fresh one.  Default (None) is the single-shot policy:
+    every survivor owns exactly its own shard.  Survivor ids need not be
+    worker ints -- the DCN supervisor plans over process tokens.
     """
     dead_set = set(dead)
     survivors = sorted(w for w in all_workers if w not in dead_set)
     if not survivors:
         raise RuntimeError("no surviving workers to adopt shards")
-    load = {w: 1 for w in survivors}  # own shard
+    if load is None:
+        load = {w: 1 for w in survivors}  # own shard
+    else:
+        load = {w: int(load.get(w, 0)) for w in survivors}
     moves: Dict[int, int] = {}
     for d in sorted(dead_set):
         target = min(survivors, key=lambda w: (load[w], w))

@@ -56,6 +56,12 @@ def _net_totals() -> Dict[str, int]:
     return net_totals()
 
 
+def _recovery_totals() -> Dict[str, int]:
+    from asyncframework_tpu.parallel.supervisor import recovery_totals
+
+    return recovery_totals()
+
+
 def active_servers() -> List["LiveUIServer"]:
     with _ACTIVE_LOCK:
         return list(_ACTIVE)
@@ -166,6 +172,10 @@ class LiveStateListener(Listener):
                 # trips, dedup hits, faults fired -- the failure-handling
                 # subsystem's health at a glance
                 "net": _net_totals(),
+                # elastic-plane counters (parallel/supervisor.py): workers
+                # declared dead, shards adopted by survivors, rejoins,
+                # surrogate releases, PS checkpoint resumes
+                "recovery": _recovery_totals(),
             }
 
 

@@ -66,7 +66,13 @@ def _bump_fired() -> None:
 @dataclass
 class FaultEvent:
     """One scheduled fault: fires on the ``nth`` matching occurrence of
-    ``op`` toward ``endpoint`` (1-based; each event fires exactly once)."""
+    ``op`` toward ``endpoint`` (1-based; each event fires exactly once).
+
+    ``op`` may be an exact op, ``*`` (any), or a ``|``-alternation such as
+    ``"PUSH|PUSH_SAGA"`` -- one event covering a protocol family (the DCN
+    ASAGA ops ride their own verbs so schedules can tell the two solvers'
+    streams apart, but a schedule aimed at "any gradient push" should not
+    need two events with independent counters)."""
 
     endpoint: str
     op: str
@@ -83,7 +89,7 @@ class FaultEvent:
             raise ValueError("nth is 1-based and must be >= 1")
 
     def matches(self, endpoint: str, op: str) -> bool:
-        if self.op != "*" and self.op != op:
+        if self.op != "*" and op not in self.op.split("|"):
             return False
         pat = self.endpoint
         if pat == "*" or pat == endpoint:

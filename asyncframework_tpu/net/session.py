@@ -26,6 +26,7 @@ keep the old at-least-once semantics.
 
 from __future__ import annotations
 
+import base64
 import threading
 import uuid
 from collections import OrderedDict
@@ -131,3 +132,37 @@ class DedupWindow:
             while len(ops) > self.window:
                 ops.popitem(last=False)
             self.recorded += 1
+
+    # ------------------------------------------------------- checkpointing
+    # A window is in-memory, so a bare server restart empties it and a
+    # retry spanning the restart silently re-applies (the old at-least-once
+    # edge).  A server that checkpoints its own state persists the window
+    # WITH it: state()/load_state() round-trip the (sid, seq) -> reply map
+    # through JSON, keeping exactly-once-applied true ACROSS a kill -9 +
+    # restart-from-checkpoint as long as the window and the applied state
+    # are captured under the same lock (the PS does).
+
+    def state(self) -> dict:
+        """JSON-serializable snapshot of every session's applied window
+        (LRU order preserved -- dicts keep insertion order)."""
+        with self._lock:
+            return {
+                "sessions": {
+                    sid: [
+                        [seq, hdr,
+                         base64.b64encode(payload).decode("ascii")]
+                        for seq, (hdr, payload) in ops.items()
+                    ]
+                    for sid, ops in self._sessions.items()
+                }
+            }
+
+    def load_state(self, state: Optional[dict]) -> None:
+        """Replace this window's contents with a :meth:`state` snapshot."""
+        with self._lock:
+            self._sessions.clear()
+            for sid, entries in (state or {}).get("sessions", {}).items():
+                ops: OrderedDict = OrderedDict()
+                for seq, hdr, payload_b64 in entries:
+                    ops[int(seq)] = (hdr, base64.b64decode(payload_b64))
+                self._sessions[str(sid)] = ops

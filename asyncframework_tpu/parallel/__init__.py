@@ -9,3 +9,7 @@ from asyncframework_tpu.parallel.ring import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from asyncframework_tpu.parallel.supervisor import (  # noqa: F401
+    ElasticSupervisor,
+    recovery_totals,
+)

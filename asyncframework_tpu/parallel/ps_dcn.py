@@ -68,6 +68,8 @@ import numpy as np
 
 from asyncframework_tpu.net import ClientSession, DedupWindow, RetryPolicy
 from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.parallel import supervisor as supervisor_mod
+from asyncframework_tpu.parallel.supervisor import ElasticSupervisor
 
 # ------------------------------------------------------------------ framing
 # The framing moved to net/frame.py (one choke point for the whole control
@@ -76,6 +78,30 @@ from asyncframework_tpu.net import frame as _frame
 _send_msg = _frame.send_msg
 _recv_exact = _frame.recv_exact
 _recv_msg = _frame.recv_msg
+
+
+class WaitDone:
+    """Result of :meth:`ParameterServer.wait_done`: truthy iff the run
+    finished; otherwise carries the per-worker progress diagnostic (old
+    callers that only truth-test keep working, new callers can print WHY
+    the run did not finish)."""
+
+    __slots__ = ("done", "diagnostic")
+
+    def __init__(self, done: bool, diagnostic: Optional[str]):
+        self.done = bool(done)
+        self.diagnostic = diagnostic
+
+    def __bool__(self) -> bool:
+        return self.done
+
+    def __repr__(self) -> str:
+        return "WaitDone(done)" if self.done else (
+            f"WaitDone(not done)\n{self.diagnostic}"
+        )
+
+    def __str__(self) -> str:
+        return "done" if self.done else (self.diagnostic or "not done")
 
 
 # ----------------------------------------------------------------- PS side
@@ -90,7 +116,8 @@ class ParameterServer:
 
     def __init__(self, cfg, d: int, n: int, device=None, host: str = "0.0.0.0",
                  port: int = 0, algo: str = "asgd",
-                 checkpoint_path: Optional[str] = None):
+                 checkpoint_path: Optional[str] = None,
+                 supervisor: Optional[ElasticSupervisor] = None):
         import jax
         import jax.numpy as jnp
 
@@ -163,6 +190,25 @@ class ParameterServer:
         self._waiting: List[int] = []
         self._wave_id = 0
 
+        # elastic membership (parallel/supervisor.py); None = the classic
+        # fixed-membership PS (old callers see no behavior change)
+        self.supervisor = supervisor
+        # per-worker ledgers, tracked unconditionally: they feed wait_done's
+        # progress diagnostic AND the acceptance coverage assert (every
+        # shard's samples contributed), and they survive a PS restart
+        self._last_contact: Dict[int, float] = {}
+        self.pushes_by_wid: Dict[int, int] = {}
+        self.accepted_by_wid: Dict[int, int] = {}
+        self.membership_rejects = 0  # pushes from deposed shard servers
+        # exactly-once-applied PUSH: a retried (sid, seq) re-sends the
+        # cached ACK instead of merging the gradient twice (net/session.py).
+        # Constructed BEFORE a restore so a checkpointed window lands here
+        # -- that is what keeps retries exactly-once ACROSS a kill -9 +
+        # restart, not just across a lost reply.
+        from asyncframework_tpu.conf import NET_DEDUP_WINDOW, global_conf
+
+        self._dedup = DedupWindow(window=global_conf().get(NET_DEDUP_WINDOW))
+
         self._elapsed_offset_ms = 0.0  # wall already spent before a resume
         if checkpoint_path and os.path.exists(checkpoint_path):
             self._restore(checkpoint_path)
@@ -172,14 +218,11 @@ class ParameterServer:
         self.port = self._srv.getsockname()[1]
         self._threads: List[threading.Thread] = []
         self._accept_thread: Optional[threading.Thread] = None
+        self._ckpt_thread: Optional[threading.Thread] = None
+        self._ckpt_trigger = threading.Event()
         self._eval_results: Dict[int, np.ndarray] = {}
         self._eval_cv = threading.Condition()
         self._stop = threading.Event()
-        # exactly-once-applied PUSH: a retried (sid, seq) re-sends the
-        # cached ACK instead of merging the gradient twice (net/session.py)
-        from asyncframework_tpu.conf import NET_DEDUP_WINDOW, global_conf
-
-        self._dedup = DedupWindow(window=global_conf().get(NET_DEDUP_WINDOW))
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "ParameterServer":
@@ -189,10 +232,22 @@ class ParameterServer:
                 self._snapshots.append((0.0, np.asarray(self._w)))
             if self._k >= self.cfg.num_iterations:
                 self._done.set()  # checkpoint was already past the finish
+                if self.supervisor is not None:
+                    self.supervisor.freeze()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ps-accept", daemon=True
         )
         self._accept_thread.start()
+        if self.checkpoint_path:
+            # async checkpoint writer: the push handler only SIGNALS the
+            # cadence; serialization happens under the lock on this thread
+            # and the disk write happens off every worker's request path
+            self._ckpt_thread = threading.Thread(
+                target=self._ckpt_loop, name="ps-checkpoint", daemon=True
+            )
+            self._ckpt_thread.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
         return self
 
     # ---------------------------------------------------------- checkpointing
@@ -214,6 +269,19 @@ class ParameterServer:
             "avg_delay_ms": self.avg_delay_ms,
             "elapsed_ms": self._now_ms() if self._t0 is not None else 0.0,
             "snap_times": [t for (t, _w) in self._snapshots],
+            # session dedup windows ride the checkpoint: a PUSH applied in
+            # this life and retried against the NEXT life must be answered
+            # from cache, not merged again.  Captured under the same lock
+            # as the model, so window and weights can never disagree about
+            # which pushes are "in".
+            "dedup": self._dedup.state(),
+            "pushes_by_wid": {
+                str(w): c for w, c in self.pushes_by_wid.items()
+            },
+            "accepted_by_wid": {
+                str(w): c for w, c in self.accepted_by_wid.items()
+            },
+            "membership_rejects": self.membership_rejects,
         }
         arrays = {"w": np.asarray(self._w, np.float32)}
         if self._snapshots:
@@ -245,9 +313,24 @@ class ParameterServer:
         tmp = self.checkpoint_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(buf.getvalue())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.checkpoint_path)
+        # fsync file + rename + fsync directory: the save survives host
+        # power loss, not just process death (checkpoint.durable_replace)
+        from asyncframework_tpu.checkpoint import durable_replace
+
+        durable_replace(tmp, self.checkpoint_path)
+
+    def _ckpt_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._ckpt_trigger.wait(timeout=0.2):
+                continue
+            self._ckpt_trigger.clear()
+            try:
+                self.save_checkpoint()
+            except Exception:  # noqa: BLE001 - the writer must outlive
+                # any one failed save (disk hiccup, transient device
+                # fault): a dead checkpoint thread would silently void
+                # the restart guarantees for the rest of the run
+                pass
 
     def _restore(self, path: str) -> None:
         import jax
@@ -287,7 +370,18 @@ class ParameterServer:
                     rng = np.random.default_rng()
                     rng.bit_generator.state = state
                     self._rngs[int(wid_s)] = rng
+            self._dedup.load_state(meta.get("dedup"))
+            self.pushes_by_wid = {
+                int(w): int(c)
+                for w, c in meta.get("pushes_by_wid", {}).items()
+            }
+            self.accepted_by_wid = {
+                int(w): int(c)
+                for w, c in meta.get("accepted_by_wid", {}).items()
+            }
+            self.membership_rejects = int(meta.get("membership_rejects", 0))
         self.resumed_from_k = self._k
+        supervisor_mod.bump_total("ps_resumes")
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -312,9 +406,12 @@ class ParameterServer:
             while not self._stop.is_set():
                 header, payload = _recv_msg(conn)
                 op = header["op"]
-                if op == "PULL":
+                # PULL_SAGA/PUSH_SAGA are the same handlers under their own
+                # verbs so fault schedules (net/faults.py) can target the
+                # ASAGA stream without also counting ASGD ops
+                if op in ("PULL", "PULL_SAGA"):
                     self._handle_pull(conn, header)
-                elif op == "PUSH":
+                elif op in ("PUSH", "PUSH_SAGA"):
                     cached = self._dedup.check(header)
                     if cached is not None:
                         # duplicate of an already-applied push (the ACK was
@@ -322,6 +419,18 @@ class ParameterServer:
                         _send_msg(conn, cached[0])
                     else:
                         self._handle_push(conn, header, payload)
+                elif op == "HELLO":
+                    # a worker process introducing itself (elastic plane):
+                    # proc token + logical worker ids + pid/host
+                    if self.supervisor is not None:
+                        self.supervisor.register(
+                            str(header.get("proc")),
+                            [int(w) for w in header.get("wids", [])],
+                            pid=header.get("pid"),
+                            host=header.get("host"),
+                        )
+                    _send_msg(conn, {"op": "WELCOME",
+                                     "elastic": self.supervisor is not None})
                 elif op == "SNAPSHOTS":
                     # only meaningful once the run is done; the stack is
                     # consistent either way (lock-copied)
@@ -348,22 +457,48 @@ class ParameterServer:
         finally:
             conn.close()
 
+    def _release_wave_locked(self) -> None:
+        """Fire the partial barrier: everyone currently waiting rides this
+        wave.  Caller holds ``_wave_cv``."""
+        self._wave_id += 1
+        self._waiting.clear()
+        self._wave_cv.notify_all()
+
+    def _cohort_threshold(self) -> int:
+        """Partial-barrier ``b``, clamped to live membership: when the
+        supervisor knows only L workers are alive, a wave of min(b, L)
+        keeps flowing immediately instead of leaning on the starvation
+        fallback every round (ASAP's membership-as-staleness stance)."""
+        threshold = max(self.cfg.bucket_threshold, 1)
+        if self.supervisor is not None:
+            threshold = max(1, min(threshold,
+                                   self.supervisor.live_worker_count()))
+        return threshold
+
     def _handle_pull(self, conn: socket.socket, header: dict) -> None:
         wid = int(header["wid"])
+        proc = header.get("proc")
+        with self._lock:
+            if self._t0 is not None:
+                self._last_contact[wid] = self._now_ms()
+        sup = self.supervisor
+        if sup is not None:
+            if not sup.owns(proc, wid):
+                # a deposed surrogate (the real owner rejoined): stand down
+                _send_msg(conn, {"op": "RELEASED"})
+                return
+            sup.touch(wid, proc)
+            sup.ack_adoption(proc, wid)
         if self._done.is_set():
             _send_msg(conn, {"op": "DONE"})
             return
-        threshold = max(self.cfg.bucket_threshold, 1)
         STARVATION_S = 1.0  # degraded-cohort release when peers are gone
         with self._wave_cv:
             self._waiting.append(wid)
             my_wave = self._wave_id
-            if len(self._waiting) >= threshold:
-                # release the cohort: everyone currently waiting rides this
-                # wave (the partial barrier firing)
-                self._wave_id += 1
-                self._waiting.clear()
-                self._wave_cv.notify_all()
+            if len(self._waiting) >= self._cohort_threshold():
+                # the partial barrier fires
+                self._release_wave_locked()
             else:
                 t_enter = time.monotonic()
                 while (
@@ -372,6 +507,14 @@ class ParameterServer:
                     and not self._stop.is_set()
                 ):
                     self._wave_cv.wait(timeout=0.05)
+                    # membership may have shrunk while we waited: the
+                    # clamped threshold can release this wave NOW
+                    if (
+                        my_wave == self._wave_id
+                        and len(self._waiting) >= self._cohort_threshold()
+                    ):
+                        self._release_wave_locked()
+                        break
                     # starvation fallback: when fewer than threshold workers
                     # are still alive the wave can never fill -- after a
                     # full second of waiting, release whoever is here as a
@@ -381,9 +524,7 @@ class ParameterServer:
                         my_wave == self._wave_id
                         and time.monotonic() - t_enter > STARVATION_S
                     ):
-                        self._wave_id += 1
-                        self._waiting.clear()
-                        self._wave_cv.notify_all()
+                        self._release_wave_locked()
                         break
         if self._done.is_set():
             _send_msg(conn, {"op": "DONE"})
@@ -430,6 +571,13 @@ class ParameterServer:
             w_host = self._w_host
             self._pull_times[wid] = self._now_ms()
             avg = self.avg_delay_ms
+        if sup is not None:
+            # adoption orders ride the PULL reply (no extra RTT, no side
+            # channel): re-delivered until the adopter's first pull FOR the
+            # orphan lands, so a lost reply cannot lose a shard
+            orders = sup.orders_for(proc)
+            if orders:
+                extra_hdr["adopt"] = orders
         _send_msg(
             conn,
             {"op": "MODEL", "ts": ts, "avg_delay_ms": avg,
@@ -445,6 +593,21 @@ class ParameterServer:
 
         wid = int(header["wid"])
         ts = int(header["ts"])
+        proc = header.get("proc")
+        sup = self.supervisor
+        if sup is not None and not sup.owns(proc, wid):
+            # membership-stale push: the shard was re-homed (rejoin deposed
+            # this surrogate) -- drop it like any other too-stale gradient,
+            # but do not tick the merge clock (nothing was considered)
+            with self._lock:
+                self.membership_rejects += 1
+                ack = {"op": "ACK", "accepted": False, "released": True,
+                       "done": self._done.is_set()}
+                self._dedup.record(header, ack)
+            _send_msg(conn, ack)
+            return
+        if sup is not None:
+            sup.touch(wid, proc)
         diff = None
         if header.get("enc") == "sparse":
             # (idx, val) pair gradient (rcv1-class): scatter into dense on
@@ -465,6 +628,9 @@ class ParameterServer:
         do_snapshot = False
         with self._lock:
             self.push_bytes += len(payload)
+            if self._t0 is not None:
+                self._last_contact[wid] = self._now_ms()
+            self.pushes_by_wid[wid] = self.pushes_by_wid.get(wid, 0) + 1
             staleness = self._clock - ts
             self.max_staleness = max(self.max_staleness, staleness)
             task_ms = self._now_ms() - self._pull_times.get(wid, self._now_ms())
@@ -507,10 +673,17 @@ class ParameterServer:
                 self._w_host = None  # new version; next pull re-materializes
                 self._k += 1
                 self.accepted += 1
+                self.accepted_by_wid[wid] = (
+                    self.accepted_by_wid.get(wid, 0) + 1
+                )
                 if self._k % self.cfg.printer_freq == 0:
                     do_snapshot = True
                 if self._k >= self.cfg.num_iterations:
                     self._done.set()
+                    if sup is not None:
+                        # run complete: pin membership -- post-done silence
+                        # (evaluation, teardown) is not death
+                        sup.freeze()
             else:
                 self.dropped += 1
             self._clock += 1
@@ -518,22 +691,92 @@ class ParameterServer:
                 # host copy NOW: the snapshot must pin this version (the PS
                 # has no immutable-handle trick across the wire anyway)
                 self._snapshots.append((self._now_ms(), np.asarray(self._w)))
+            ack = {"op": "ACK", "accepted": bool(accepted),
+                   "done": self._done.is_set()}
+            # record INSIDE the lock, before sending: (1) a retry after a
+            # lost ACK must find the (sid, seq) applied; (2) the checkpoint
+            # writer serializes state under this same lock, so a saved
+            # model can never be missing the dedup entry of a push it
+            # already contains (that gap would re-apply the push after a
+            # restart)
+            self._dedup.record(header, ack)
         with self._wave_cv:
             self._wave_cv.notify_all()  # a wave may now meet its threshold
-        ack = {"op": "ACK", "accepted": bool(accepted),
-               "done": self._done.is_set()}
-        # record BEFORE sending: if the ACK is lost mid-send the retry must
-        # already find the (sid, seq) applied
-        self._dedup.record(header, ack)
         _send_msg(conn, ack)
         if do_snapshot:
-            # printer_freq cadence, after the ACK: only THIS worker's next
-            # message waits behind the disk write
-            self.save_checkpoint()
+            # printer_freq cadence: signal the async checkpoint thread --
+            # nobody's next message waits behind the disk write
+            self._ckpt_trigger.set()
 
     # ------------------------------------------------------------ evaluation
-    def wait_done(self, timeout_s: float) -> bool:
-        return self._done.wait(timeout=timeout_s)
+    def wait_done(self, timeout_s: float,
+                  progress_timeout_s: Optional[float] = None) -> "WaitDone":
+        """Progress-aware wait for the run to finish.
+
+        Returns a truthy :class:`WaitDone` on completion.  On timeout --
+        or, with ``progress_timeout_s``, as soon as NO worker has contacted
+        the PS and the merge clock has not moved for that long -- returns a
+        falsy ``WaitDone`` carrying the per-worker last-contact +
+        contribution-bitmap diagnostic instead of a bare ``False``, so a
+        stalled run names its silent workers instead of hanging mute for
+        the full timeout.
+        """
+        deadline = time.monotonic() + timeout_s
+        last_progress = time.monotonic()
+        seen_clock = -1
+        seen_contact = -1.0
+        while True:
+            left = deadline - time.monotonic()
+            if self._done.wait(timeout=max(0.0, min(0.2, left))):
+                return WaitDone(True, None)
+            now = time.monotonic()
+            with self._lock:
+                clock = self._clock
+                contact = max(self._last_contact.values(), default=-1.0)
+            if clock != seen_clock or contact != seen_contact:
+                seen_clock, seen_contact = clock, contact
+                last_progress = now
+            stalled = (
+                progress_timeout_s is not None
+                and now - last_progress > progress_timeout_s
+            )
+            if stalled or now >= deadline:
+                return WaitDone(False, self.progress_diagnostic(
+                    stalled="stalled" if stalled else "timeout"
+                ))
+
+    def progress_diagnostic(self, stalled: str = "timeout") -> str:
+        """Per-worker last-contact ages, push/accept counts, and the
+        contribution bitmap -- everything needed to see WHO went silent."""
+        with self._lock:
+            now = self._now_ms() if self._t0 is not None else 0.0
+            k, clock = self._k, self._clock
+            contact = dict(self._last_contact)
+            pushes = dict(self.pushes_by_wid)
+            accepted = dict(self.accepted_by_wid)
+        member = (self.supervisor.membership()
+                  if self.supervisor is not None else {})
+        nw = self.cfg.num_workers
+        bitmap = "".join(
+            "1" if accepted.get(w, 0) > 0 else "0" for w in range(nw)
+        )
+        lines = [
+            f"PS {stalled}: k={k}/{self.cfg.num_iterations} "
+            f"clock={clock} contributed-bitmap={bitmap}",
+        ]
+        for w in range(nw):
+            age = contact.get(w)
+            age_s = "never" if age is None else f"{now - age:8.0f}ms ago"
+            extra = ""
+            m = member.get(w)
+            if m:
+                extra = f" state={m['state']} owner={m['owner']}"
+            lines.append(
+                f"  wid {w:3d}: last-contact {age_s:>14}  "
+                f"pushes={pushes.get(w, 0):<6d} "
+                f"accepted={accepted.get(w, 0):<6d}{extra}"
+            )
+        return "\n".join(lines)
 
     def snapshot_stack(self) -> Tuple[List[float], np.ndarray]:
         with self._lock:
@@ -545,10 +788,26 @@ class ParameterServer:
 
     def collect_eval(self, num_worker_procs: int, timeout_s: float
                      ) -> Optional[np.ndarray]:
-        """Sum per-process snapshot losses pushed via EVAL_RESULT."""
+        """Sum per-process snapshot losses pushed via EVAL_RESULT.
+
+        With the supervisor, the expected count is clamped to processes
+        that were still ALIVE when the run finished: a crashed worker's
+        EVAL never comes, but its adopted shards are scored by their
+        adopter -- the union still covers the full dataset, so waiting
+        for the dead process would only trade the objective for a
+        timeout."""
         deadline = time.monotonic() + timeout_s
         with self._eval_cv:
-            while len(self._eval_results) < num_worker_procs:
+            while True:
+                expected = num_worker_procs
+                if self.supervisor is not None:
+                    # clamp only when processes actually registered (an
+                    # unelastic client set leaves the roster empty)
+                    live = self.supervisor.live_proc_count()
+                    if live > 0:
+                        expected = min(expected, live)
+                if len(self._eval_results) >= expected:
+                    break
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return None
@@ -567,6 +826,8 @@ class ParameterServer:
     def stop(self) -> None:
         self._stop.set()
         self._done.set()
+        if self.supervisor is not None:
+            self.supervisor.stop()
         with self._wave_cv:
             self._wave_cv.notify_all()
         try:
@@ -590,13 +851,20 @@ class PSClient:
 
     def __init__(self, host: str, port: int, timeout_s: float = 120.0,
                  retry: Optional[RetryPolicy] = None,
-                 session: Optional[ClientSession] = None):
+                 session: Optional[ClientSession] = None,
+                 proc: Optional[str] = None):
         self.host, self.port = host, int(port)
         self.endpoint = f"{host}:{self.port}"
         self.retry = retry if retry is not None else RetryPolicy.from_conf(
             attempt_timeout_s=timeout_s
         )
         self.session = session if session is not None else ClientSession()
+        # elastic membership: the worker PROCESS token stamped on every
+        # PULL/PUSH so the PS supervisor knows who serves which shard;
+        # None = classic fixed-membership client
+        self.proc = proc
+        self.released = False    # the PS deposed this client's wid
+        self._orders: List[int] = []  # adoption orders from PULL replies
         self._sock: Optional[socket.socket] = None
         self.bytes_pushed = 0  # payload bytes shipped by push/push_saga
         # eager first dial (historical behavior: constructing a client to a
@@ -640,11 +908,44 @@ class PSClient:
 
         return self.retry.call(attempt, endpoint=self.endpoint)
 
+    def _proc_hdr(self, hdr: dict) -> dict:
+        if self.proc is not None:
+            hdr["proc"] = self.proc
+        return hdr
+
+    def _note_orders(self, header: dict) -> None:
+        if "adopt" in header:
+            self._orders.extend(int(w) for w in header["adopt"])
+
+    def take_orders(self) -> List[int]:
+        """Adoption orders received so far (drained)."""
+        out, self._orders = self._orders, []
+        return out
+
+    def hello(self, proc: str, wids: List[int],
+              pid: Optional[int] = None) -> dict:
+        """Introduce this worker process to the PS (elastic registration;
+        a fixed-membership PS just says WELCOME and ignores it)."""
+        import socket as _socket
+
+        header, _ = self._call_raw({
+            "op": "HELLO", "proc": proc, "wids": [int(w) for w in wids],
+            "pid": pid, "host": _socket.gethostname(),
+        })
+        return header
+
     def pull(self, wid: int) -> Optional[Tuple[int, np.ndarray, float, bool]]:
-        """Returns (ts, w, avg_delay_ms, calibrated) or None when DONE."""
-        header, payload = self._call_raw({"op": "PULL", "wid": wid})
+        """Returns (ts, w, avg_delay_ms, calibrated); None when DONE or
+        when this client's wid was RELEASED (check ``self.released``)."""
+        header, payload = self._call_raw(
+            self._proc_hdr({"op": "PULL", "wid": wid})
+        )
+        if header["op"] == "RELEASED":
+            self.released = True
+            return None
         if header["op"] == "DONE":
             return None
+        self._note_orders(header)
         w = np.frombuffer(payload, np.float32)
         return (int(header["ts"]), w, float(header["avg_delay_ms"]),
                 bool(header["calibrated"]))
@@ -666,19 +967,26 @@ class PSClient:
         """Returns (accepted, run_done).  ``diff`` (ASAGA candidate history
         scalars) rides after the gradient when given."""
         g = np.asarray(g, np.float32)
+        # ASAGA pushes ride their own verb so fault schedules can tell the
+        # two solvers' streams apart (the PS treats both identically)
+        op = "PUSH_SAGA" if diff is not None else "PUSH"
         enc = self._sparse_grad_enc(g) if sparse else None
         if enc is not None:
             nnz, payload = enc
-            hdr = {"op": "PUSH", "wid": wid, "ts": ts,
+            hdr = {"op": op, "wid": wid, "ts": ts,
                    "enc": "sparse", "nnz": nnz}
         else:
-            hdr, payload = {"op": "PUSH", "wid": wid, "ts": ts}, g.tobytes()
+            hdr, payload = {"op": op, "wid": wid, "ts": ts}, g.tobytes()
         if diff is not None:
             payload += np.asarray(diff, np.float32).tobytes()
         self.bytes_pushed += len(payload)
         # stamp ONCE: retries re-send the same (sid, seq), so a push whose
         # ACK was lost is answered from the PS dedup window, not re-applied
-        header, _ = self._call_raw(self.session.stamp(hdr), payload)
+        header, _ = self._call_raw(
+            self.session.stamp(self._proc_hdr(hdr)), payload
+        )
+        if header.get("released"):
+            self.released = True
         return bool(header.get("accepted")), bool(header.get("done"))
 
     def pull_saga(self, wid: int, n_p: int) -> Optional[
@@ -689,10 +997,14 @@ class PSClient:
         Returns (ts, w, idx, alpha_sel, n_valid, avg_delay_ms, calibrated)
         or None when DONE."""
         header, payload = self._call_raw(
-            {"op": "PULL", "wid": wid, "n_p": n_p}
+            self._proc_hdr({"op": "PULL_SAGA", "wid": wid, "n_p": n_p})
         )
+        if header["op"] == "RELEASED":
+            self.released = True
+            return None
         if header["op"] == "DONE":
             return None
+        self._note_orders(header)
         cap = int(header["cap"])
         d4 = len(payload) - 8 * cap
         w = np.frombuffer(payload[:d4], np.float32)
@@ -738,6 +1050,8 @@ def run_worker_process(
     eval_wid: Optional[int] = None,
     deadline_s: float = 600.0,
     algo: str = "asgd",
+    shard_factory=None,
+    proc_token: Optional[str] = None,
 ) -> Dict[int, int]:
     """Worker-process main loop: one thread per owned logical worker, each
     pulling models and pushing gradients until the PS says DONE.
@@ -750,12 +1064,23 @@ def run_worker_process(
     ``algo="asaga"``: the PS samples and ships (idx, alpha) with each model
     (it owns the history table); the worker runs the history-corrected
     gradient step and pushes candidate scalars back with the gradient.
+
+    Elastic plane (``parallel/supervisor.py``): this process HELLOs the PS
+    with ``proc_token`` + its wids + pid, and every PULL/PUSH carries the
+    token.  When the PS's supervisor re-homes a dead peer's shard here, the
+    adoption order arrives on a PULL reply; ``shard_factory(wid)`` builds
+    the orphan shard locally (datasets are seed-deterministic or disk-
+    loadable, the DCN analog of lineage recomputation) and a fresh loop
+    thread starts serving it.  A thread whose wid is reclaimed by a
+    rejoining process is told RELEASED and stands down.  With
+    ``shard_factory=None`` adoption orders are ignored (classic behavior).
     """
     import jax
 
     from asyncframework_tpu.engine.straggler import DelayModel
     from asyncframework_tpu.ops import steps
 
+    proc_token = proc_token or f"{socket.gethostname()}-{os.getpid()}"
     sparse = any(hasattr(s, "cols") for s in shards.values())
     if algo == "asaga":
         step = (steps.make_saga_dcn_sparse_worker_step(d) if sparse
@@ -768,6 +1093,11 @@ def run_worker_process(
     counts = {wid: 0 for wid in wids}
     stop = threading.Event()
     calibrated_once = threading.Event()
+    # elastic adoption bookkeeping: which wids this process serves (own +
+    # adopted), and every loop thread ever started (joined at the end)
+    group_lock = threading.Lock()
+    active_wids = set(wids)
+    threads: List[threading.Thread] = []
 
     def shard_dev(shard):
         return (shard.cols if sparse else shard.X).device
@@ -814,6 +1144,28 @@ def run_worker_process(
             g0, _ = run_step(shard, w0, key0)
         g0.block_until_ready()
 
+    def adopt(orphan: int) -> None:
+        """Adoption order from the PS: materialize the dead peer's shard
+        locally and start serving it (idempotent -- orders are re-delivered
+        until the first pull for the orphan lands)."""
+        with group_lock:
+            if orphan in active_wids:
+                return
+            active_wids.add(orphan)
+        try:
+            built = shard_factory(orphan)  # device placement: off the lock
+        except Exception:
+            with group_lock:
+                active_wids.discard(orphan)
+            return
+        with group_lock:
+            # shared-dict writes under the lock: the end-of-run eval reads
+            # `shards` under it too, and a late adoption racing that read
+            # must not blow up the iteration
+            shards[orphan] = built
+            counts.setdefault(orphan, 0)
+        spawn(orphan)
+
     def worker_loop(wid: int) -> None:
         shard = shards[wid]
         dev = shard_dev(shard)
@@ -828,7 +1180,7 @@ def run_worker_process(
             while not stop.is_set() and time.monotonic() < deadline:
                 try:
                     if cl is None:
-                        cl = PSClient(host, port)
+                        cl = PSClient(host, port, proc=proc_token)
                     # per-RPC transport faults (reconnect, backoff, jitter,
                     # breaker) are the client's RetryPolicy's problem now;
                     # PUSH retries are exactly-once-applied via the PS
@@ -839,7 +1191,10 @@ def run_worker_process(
                     else:
                         got = cl.pull(wid)
                     if got is None:
-                        break
+                        break  # DONE, or this wid was RELEASED to a rejoiner
+                    if shard_factory is not None:
+                        for orphan in cl.take_orders():
+                            adopt(orphan)
                     if algo == "asaga":
                         (ts, w_host, idx, alpha_sel, n_valid, avg_ms,
                          calibrated) = got
@@ -882,23 +1237,53 @@ def run_worker_process(
                     time.sleep(0.2)
         finally:
             if cl is not None:
+                if cl.released:
+                    # the wid was reclaimed by a rejoiner: forget it so a
+                    # LATER re-adoption (rejoiner dies again) can restart
+                    # a loop here instead of finding the wid "active"
+                    with group_lock:
+                        active_wids.discard(wid)
                 cl.bye()
 
-    threads = [
-        threading.Thread(target=worker_loop, args=(w,), daemon=True)
-        for w in wids
-    ]
-    for t in threads:
+    def spawn(w: int) -> None:
+        t = threading.Thread(target=worker_loop, args=(w,), daemon=True)
+        with group_lock:
+            threads.append(t)
         t.start()
-    for t in threads:
-        t.join(timeout=deadline_s)
+
+    # introduce this process to the PS before serving: the supervisor
+    # learns the proc token, wids, and pid (local-exit detection); a
+    # rejoining process's HELLO is also what deposes its surrogate.  A
+    # fixed-membership PS just says WELCOME.
+    try:
+        hello_cl = PSClient(host, port, proc=proc_token)
+        hello_cl.hello(proc_token, wids, pid=os.getpid())
+        hello_cl.bye()
+    except (ConnectionError, OSError):
+        pass  # PS mid-restart: the loops' retry path will find it
+
+    for w in wids:
+        spawn(w)
+    join_deadline = time.monotonic() + deadline_s
+    while time.monotonic() < join_deadline:
+        with group_lock:
+            snapshot = list(threads)
+        if all(not t.is_alive() for t in snapshot):
+            break
+        time.sleep(0.05)
     if eval_wid is not None:
         # distributed optVars evaluation: score the PS's snapshot stack over
-        # this process's shards, push one summed loss vector
+        # this process's shards, push one summed loss vector.  Only shards
+        # this process still SERVES count -- an adopted shard whose owner
+        # rejoined (RELEASED) is evaluated by its real owner, and summing
+        # it here too would double-count its loss.
         cl = PSClient(host, port)
         try:
             times, W = cl.snapshots()
-            losses = evaluate_snapshots_on_shards(shards, times, W, cfg.loss)
+            with group_lock:
+                served = {w: s for w, s in shards.items()
+                          if w in active_wids}
+            losses = evaluate_snapshots_on_shards(served, times, W, cfg.loss)
             cl.send_eval(eval_wid, losses)
         finally:
             cl.bye()

@@ -49,6 +49,7 @@ from asyncframework_tpu.solvers.base import (
     TrainResult,
     WaitingTimeTable,
     check_hbm_plan,
+    collect_checked,
     resolve_dataset,
 )
 from asyncframework_tpu.solvers.instrumentation import (
@@ -610,8 +611,13 @@ class ASGD(FlopsAccountingMixin):
                     fns, self._handler(ctx, ts, now_ms, worker_keys, key_lock)
                 )
                 acc = None
+                reported = set()
                 for _ in range(nw):
-                    res = self._collect_checked(ctx, waiter, cfg.run_timeout_s)
+                    res = self._collect_checked(
+                        ctx, waiter, cfg.run_timeout_s,
+                        pool=sched.pool, cohort=cohort, collected=reported,
+                    )
+                    reported.add(res.worker_id)
                     g = res.data
                     flops += self._task_flops(res.worker_id)
                     task_ms = waiting.on_finish(res.worker_id, now_ms())
@@ -669,18 +675,20 @@ class ASGD(FlopsAccountingMixin):
         )
 
     # ---------------------------------------------------------------- helpers
-    @staticmethod
-    def _collect_checked(ctx: AsyncContext, waiter, timeout_s: float):
-        """Blocking collect that surfaces a job abort instead of hanging."""
-        deadline = time.monotonic() + timeout_s
-        while True:
-            if waiter.failed is not None:
-                raise RuntimeError("job aborted during drain") from waiter.failed
-            try:
-                return ctx.collect_all(timeout=0.1)
-            except queue.Empty:
-                if time.monotonic() > deadline:
-                    raise TimeoutError("sync drain timed out")
+    def _collect_checked(self, ctx: AsyncContext, waiter, timeout_s: float,
+                         pool=None, cohort=None, collected=None):
+        """Shared fail-fast drain (solvers/base.py): surfaces job aborts,
+        and -- given the pool -- aborts promptly with the per-worker
+        liveness diagnostic when a cohort executor dies unreplaced,
+        instead of hanging for the full run timeout."""
+        grace = (
+            4.0 * self.cfg.heartbeat_interval_s + 2.0
+            if self.cfg.heartbeat else 0.5
+        )
+        return collect_checked(
+            ctx, waiter, timeout_s, pool=pool, cohort=cohort,
+            dead_grace_s=grace, collected=collected,
+        )
 
     def _shard_device(self, wid: int):
         return self.devices[wid % len(self.devices)]

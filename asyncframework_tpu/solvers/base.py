@@ -10,6 +10,7 @@ mode, calibration override).
 from __future__ import annotations
 
 import math
+import queue
 import threading
 import time
 from dataclasses import dataclass, field
@@ -19,6 +20,69 @@ import numpy as np
 
 from asyncframework_tpu.checkpoint import CheckpointManager
 from asyncframework_tpu.data.sharded import ShardedDataset
+
+
+class DeadWorkerError(RuntimeError):
+    """A synchronous drain can never complete: a cohort worker's executor
+    is dead and nothing will replace it.  Carries the per-worker liveness
+    diagnostic (who is dead, last-heartbeat ages, who already reported)."""
+
+
+def dead_worker_diagnostic(pool, dead: Dict[int, float],
+                           collected: Optional[set] = None) -> str:
+    """Per-worker liveness table for the fail-fast abort message."""
+    collected = collected or set()
+    lines = [
+        "synchronous drain cannot complete: "
+        f"executor(s) {sorted(dead)} dead with no replacement"
+    ]
+    for wid, ex in sorted(pool.executors.items()):
+        age = ex._clock.now_ms() - ex.last_heartbeat_ms
+        lines.append(
+            f"  wid {wid:3d}: {'DEAD' if not ex.alive else 'alive':5s} "
+            f"last-heartbeat {age:8.0f}ms ago  busy={ex.busy!s:5s} "
+            f"reported={'yes' if wid in collected else 'no'}"
+        )
+    return "\n".join(lines)
+
+
+def collect_checked(ctx, waiter, timeout_s: float, pool=None,
+                    cohort=None, dead_grace_s: float = 1.0,
+                    collected: Optional[set] = None):
+    """Blocking collect that surfaces a job abort instead of hanging --
+    and, when given the executor ``pool``, fails FAST with a per-worker
+    liveness diagnostic when a cohort executor dies and stays dead past
+    ``dead_grace_s`` (nobody will ever deliver its result), instead of
+    sitting out the full ``timeout_s``.  With the heartbeat monitor
+    running, a killed executor is replaced within the grace window and
+    its entry here self-clears; with monitoring off, this is the only
+    thing standing between a SIGKILLed worker and a silent full-timeout
+    hang of the synchronous barrier."""
+    deadline = time.monotonic() + timeout_s
+    dead_since: Dict[int, float] = {}
+    while True:
+        if waiter.failed is not None:
+            raise RuntimeError("job aborted during drain") from waiter.failed
+        try:
+            return ctx.collect_all(timeout=0.1)
+        except queue.Empty:
+            now = time.monotonic()
+            if pool is not None and not pool.closed:
+                watch = cohort if cohort is not None else list(pool.executors)
+                for wid in watch:
+                    ex = pool.executors.get(wid)
+                    if (ex is not None and not ex.alive
+                            and not ex.shutdown_requested):
+                        first = dead_since.setdefault(wid, now)
+                        if now - first > dead_grace_s:
+                            raise DeadWorkerError(dead_worker_diagnostic(
+                                pool, dead_since, collected
+                            ))
+                    else:
+                        # replaced (heartbeat path) or healthy again
+                        dead_since.pop(wid, None)
+            if now > deadline:
+                raise TimeoutError("sync drain timed out")
 
 
 def check_hbm_plan(X, cfg: "SolverConfig", devices, history_table: bool) -> None:

@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Nightly chaos sweep: run the deterministic chaos suite across N seeds.
+
+Each seed re-pins every fault schedule and retry-jitter walk in the suite
+(``tests/test_chaos.py`` reads ``ASYNC_CHAOS_SEED``), so a sweep covers N
+*distinct* deterministic fault interleavings -- any seed that fails is a
+one-command repro:
+
+    ASYNC_CHAOS_SEED=<seed> pytest -m chaos tests/test_chaos.py
+
+Usage:
+    bin/chaos_sweep.py                  # 5 seeds, chaos suite only
+    bin/chaos_sweep.py -n 20 --base-seed 100
+    bin/chaos_sweep.py --soak           # also the kill -9 soak tests
+    bin/chaos_sweep.py -k saga          # filter tests per pytest -k
+
+Prints a per-seed pass/fail table; exits non-zero iff any seed failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_seed(seed: int, args) -> dict:
+    env = dict(os.environ)
+    env["ASYNC_CHAOS_SEED"] = str(seed)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    marker = "chaos or soak" if args.soak else "chaos"
+    cmd = [
+        sys.executable, "-m", "pytest", "tests/test_chaos.py",
+        "tests/test_net_retry.py", "-q", "-m", marker,
+        "-p", "no:cacheprovider",
+    ]
+    if args.soak:
+        cmd.insert(cmd.index("-q"), "tests/test_deploy_soak.py")
+        cmd.insert(cmd.index("-q"), "tests/test_ps_dcn.py")
+    if args.keyword:
+        cmd += ["-k", args.keyword]
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        cmd, cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=args.timeout,
+    )
+    elapsed = time.monotonic() - t0
+    tail = proc.stdout.strip().splitlines()
+    summary = tail[-1] if tail else ""
+    return {
+        "seed": seed,
+        "ok": proc.returncode == 0,
+        "elapsed_s": elapsed,
+        "summary": summary,
+        "output": proc.stdout,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Run the chaos suite across N seeds; per-seed table."
+    )
+    ap.add_argument("-n", "--seeds", type=int, default=5,
+                    help="number of seeds to sweep (default 5)")
+    ap.add_argument("--base-seed", type=int, default=7,
+                    help="first seed (default 7, the suite's default)")
+    ap.add_argument("--soak", action="store_true",
+                    help="include the slow kill -9 soak tests")
+    ap.add_argument("-k", dest="keyword", default=None,
+                    help="pytest -k expression forwarded to each run")
+    ap.add_argument("--timeout", type=float, default=1800.0,
+                    help="per-seed timeout in seconds (default 1800)")
+    ap.add_argument("--show-failures", action="store_true",
+                    help="dump full pytest output of failing seeds")
+    args = ap.parse_args()
+
+    results = []
+    for i in range(args.seeds):
+        seed = args.base_seed + i
+        print(f"[chaos-sweep] seed {seed} ...", flush=True)
+        try:
+            results.append(run_seed(seed, args))
+        except subprocess.TimeoutExpired:
+            results.append({
+                "seed": seed, "ok": False, "elapsed_s": args.timeout,
+                "summary": "TIMEOUT", "output": "",
+            })
+
+    width = max(len(r["summary"]) for r in results) if results else 0
+    print()
+    print(f"{'seed':>6}  {'result':6}  {'time':>8}  summary")
+    print("-" * (26 + width))
+    for r in results:
+        status = "PASS" if r["ok"] else "FAIL"
+        print(f"{r['seed']:>6}  {status:6}  {r['elapsed_s']:7.1f}s  "
+              f"{r['summary']}")
+    failed = [r for r in results if not r["ok"]]
+    print("-" * (26 + width))
+    print(f"[chaos-sweep] {len(results) - len(failed)}/{len(results)} "
+          f"seeds passed")
+    if failed:
+        print("repro: ASYNC_CHAOS_SEED=<seed> pytest -m chaos "
+              "tests/test_chaos.py")
+        if args.show_failures:
+            for r in failed:
+                print(f"\n===== seed {r['seed']} output =====\n{r['output']}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

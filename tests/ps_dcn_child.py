@@ -4,6 +4,13 @@ Role PS: bind the server, print its port on stdout (flushed), run the
 updater until done, print a result JSON line.
 Role WORKER: connect to the PS, run the owned logical workers' loops,
 evaluate the snapshot stack over the owned shards, print a JSON line.
+
+Elastic-plane knobs (tests/test_supervisor.py): ``PS_ELASTIC=1`` runs the
+PS with an ElasticSupervisor (``PS_DEAD_AFTER_S`` tunes death detection);
+``PS_WIDS=4,5,6,7`` pins a worker process to explicit logical workers
+(instead of the modulo split); ``PS_EVAL=0`` disables the post-run
+snapshot evaluation (a worker destined to be SIGKILLed must not hold the
+eval slot); ``PS_NUM_ITER`` overrides the iteration budget.
 """
 
 import json
@@ -30,7 +37,7 @@ from asyncframework_tpu.parallel import ps_dcn
 from asyncframework_tpu.solvers import SolverConfig
 
 N, D, NW = 4096, 24, 8
-NUM_ITER = 400
+NUM_ITER = int(os.environ.get("PS_NUM_ITER", "400"))
 
 
 def config() -> SolverConfig:
@@ -53,10 +60,22 @@ def main() -> None:
     algo = os.environ.get("PS_ALGO", "asgd")
     cfg = config()
     if role == "ps":
+        sup = None
+        if os.environ.get("PS_ELASTIC") == "1":
+            from asyncframework_tpu.parallel.supervisor import (
+                ElasticSupervisor,
+            )
+
+            sup = ElasticSupervisor(
+                NW,
+                dead_after_s=float(os.environ.get("PS_DEAD_AFTER_S", "2.0")),
+                check_interval_s=0.2,
+            )
         ps = ps_dcn.ParameterServer(
             cfg, D, N, port=int(os.environ.get("PS_BIND_PORT", "0")),
             algo=algo,
             checkpoint_path=os.environ.get("PS_CHECKPOINT") or None,
+            supervisor=sup,
         ).start()
         print(json.dumps({"port": ps.port}), flush=True)
         ok = ps.wait_done(timeout_s=120.0)
@@ -72,6 +91,11 @@ def main() -> None:
             "role": "ps", "done": bool(ok), "accepted": ps.accepted,
             "dropped": ps.dropped, "max_staleness": ps.max_staleness,
             "resumed_from": ps.resumed_from_k,
+            "accepted_by_wid": {
+                str(w): c for w, c in ps.accepted_by_wid.items()
+            },
+            "recovery": sup.counters() if sup is not None else None,
+            "diagnostic": None if ok else str(ok),
             "trajectory": traj,
         }), flush=True)
         ps.stop()
@@ -81,13 +105,18 @@ def main() -> None:
         nproc = int(os.environ["PS_NUM_WORKER_PROCS"])
         devices = jax.devices()
         ds = dataset(devices)
-        wids = [w for w in range(NW) if w % nproc == pid]
+        if os.environ.get("PS_WIDS"):
+            wids = [int(w) for w in os.environ["PS_WIDS"].split(",")]
+        else:
+            wids = [w for w in range(NW) if w % nproc == pid]
         shards = {w: ds.shard(w) for w in wids}
         # every worker process scores its OWN shards; the PS sums the
         # per-process vectors -- together they cover the full dataset
         counts = ps_dcn.run_worker_process(
             "127.0.0.1", port, wids, shards, cfg, D, N,
-            eval_wid=wids[0], deadline_s=120.0, algo=algo,
+            eval_wid=None if os.environ.get("PS_EVAL") == "0" else wids[0],
+            deadline_s=120.0, algo=algo,
+            shard_factory=ds.shard, proc_token=f"child-{os.getpid()}",
         )
         print(json.dumps({
             "role": "worker", "pid": pid,

@@ -14,8 +14,11 @@ deterministic op order.  Multi-worker chaos stays in the (slow-marked)
 kill -9 soak, which asserts liveness rather than bytes.
 """
 
+import json
+import os
 import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -35,6 +38,10 @@ from asyncframework_tpu.parallel import ps_dcn
 from asyncframework_tpu.solvers import SolverConfig
 
 pytestmark = pytest.mark.chaos
+
+# One knob pins the whole suite's schedules + retry jitter; the nightly
+# sweep (bin/chaos_sweep.py) runs the suite across a seed range via env.
+CHAOS_SEED = int(os.environ.get("ASYNC_CHAOS_SEED", "7"))
 
 
 @pytest.fixture(autouse=True)
@@ -67,7 +74,7 @@ def _chaos_asgd_run(devices, extra_events=None):
                                            seed=11, noise=0.01)
     ps = ps_dcn.ParameterServer(cfg, d, n, device=devices[0], port=0).start()
     ep = f"127.0.0.1:{ps.port}"
-    sched = FaultSchedule(seed=7)
+    sched = FaultSchedule(seed=CHAOS_SEED)
     sched.add(ep, CONNECT_OP, 1, CONNECT_REFUSED)   # first dial refused
     sched.add(ep, "PULL", 3, STALL_READ)            # model reply stalls
     sched.add(ep, "PUSH", 2, CUT_MID_FRAME)         # gradient cut on wire
@@ -85,6 +92,50 @@ def _chaos_asgd_run(devices, extra_events=None):
         fired = tuple((e["op"], e["nth"], e["kind"]) for e in inj.fired)
         return {
             "final_w": W[-1].tobytes(),
+            "accepted": ps.accepted,
+            "dropped": ps.dropped,
+            "max_staleness": ps.max_staleness,
+            "dedup_hits": ps.dedup_hits,
+            "counts": dict(counts),
+            "fired": fired,
+            "remaining": len(inj.remaining()),
+        }
+    finally:
+        ps.stop()
+
+
+def _chaos_asaga_run(devices, extra_events=None):
+    """Single-worker DCN-ASAGA under a schedule keyed on the SAGA verbs
+    (PULL_SAGA/PUSH_SAGA ride their own ops precisely so schedules can
+    target them).  Exercises the PS-owned sampling + history-table commit
+    under every fault kind; returns the replay fingerprint."""
+    cfg = make_cfg(gamma=0.35)
+    n, d = 256, 8
+    ds = ShardedDataset.generate_on_device(n, d, 1, devices=devices[:1],
+                                           seed=11, noise=0.01)
+    ps = ps_dcn.ParameterServer(cfg, d, n, device=devices[0], port=0,
+                                algo="asaga").start()
+    ep = f"127.0.0.1:{ps.port}"
+    sched = FaultSchedule(seed=CHAOS_SEED)
+    sched.add(ep, CONNECT_OP, 1, CONNECT_REFUSED)     # first dial refused
+    sched.add(ep, "PULL_SAGA", 3, STALL_READ)         # sampled reply stalls
+    sched.add(ep, "PUSH_SAGA", 2, CUT_MID_FRAME)      # gradient+scalars cut
+    sched.add(ep, "PUSH_SAGA", 5, DROP_REPLY)         # applied, ACK eaten
+    for ev in extra_events or ():
+        sched.add(ep, *ev)
+    try:
+        with faults.injected(sched) as inj:
+            counts = ps_dcn.run_worker_process(
+                "127.0.0.1", ps.port, [0], {0: ds.shard(0)}, cfg, d, n,
+                deadline_s=60.0, algo="asaga",
+            )
+            assert ps.wait_done(timeout_s=5.0)
+        _times, W = ps.snapshot_stack()
+        fired = tuple((e["op"], e["nth"], e["kind"]) for e in inj.fired)
+        table = ps._table.get(0)
+        return {
+            "final_w": W[-1].tobytes(),
+            "table": table.tobytes() if table is not None else b"",
             "accepted": ps.accepted,
             "dropped": ps.dropped,
             "max_staleness": ps.max_staleness,
@@ -170,7 +221,7 @@ class TestChaosAcceptance:
         srv = LogTopicServer(str(tmp_path / "topics"), host="127.0.0.1")
         srv.start()
         tep = f"127.0.0.1:{srv.port}"
-        tsched = (FaultSchedule(seed=7)
+        tsched = (FaultSchedule(seed=CHAOS_SEED)
                   .add(tep, "APPEND", 1, DROP_REPLY)
                   .add(tep, CONNECT_OP, 2, CONNECT_REFUSED)
                   .add(tep, "APPEND", 2, CUT_MID_FRAME))
@@ -205,7 +256,7 @@ class TestChaosAcceptance:
                 })
                 reply, _ = frame_mod.recv_msg(s)
             assert reply["op"] == "REGISTERED"
-            msched = FaultSchedule(seed=7).add(
+            msched = FaultSchedule(seed=CHAOS_SEED).add(
                 mep, "SUBMIT_APP", 1, DROP_REPLY)
             with faults.injected(msched) as inj:
                 cl = MasterClient(master.host, master.port)
@@ -283,3 +334,110 @@ class TestHeartbeatShardRecoveryChaos:
                     tuple(sorted(owners.items()))
 
         assert run_once() == run_once()
+
+
+class TestSagaChaos:
+    """PR 1 left the ASAGA wire untested under faults; the SAGA ops now
+    ride their own verbs (PULL_SAGA/PUSH_SAGA) so schedules can hit them
+    without counting ASGD traffic."""
+
+    def test_saga_ops_survive_all_four_fault_kinds(self, devices8):
+        out = _chaos_asaga_run(devices8)
+        assert out["remaining"] == 0, "every scheduled fault must fire"
+        assert out["accepted"] == 30
+        # exactly the DROP_REPLY push answered from the dedup window: the
+        # retried gradient+scalars were NOT committed twice
+        assert out["dedup_hits"] == 1
+        ops = {op for (op, _n, _k) in out["fired"] if op != CONNECT_OP}
+        assert ops == {"PULL_SAGA", "PUSH_SAGA"}
+        kinds = {k for (_op, _n, k) in out["fired"]}
+        assert kinds == {CONNECT_REFUSED, STALL_READ, CUT_MID_FRAME,
+                         DROP_REPLY}
+        assert np.isfinite(np.frombuffer(out["final_w"], np.float32)).all()
+        assert np.any(np.frombuffer(out["table"], np.float32) != 0.0)
+
+    def test_saga_chaos_replay_is_byte_identical(self, devices8):
+        """Same schedule, same seeds -> same fired journal, same ledger,
+        byte-identical final weights AND history table (the PS-side RNG
+        chain advanced identically through the faults)."""
+        a = _chaos_asaga_run(devices8)
+        retry.reset_breakers()
+        b = _chaos_asaga_run(devices8)
+        assert a["fired"] == b["fired"]
+        assert (a["accepted"], a["dropped"], a["max_staleness"],
+                a["dedup_hits"]) == (b["accepted"], b["dropped"],
+                                     b["max_staleness"], b["dedup_hits"])
+        assert a["counts"] == b["counts"]
+        assert a["final_w"] == b["final_w"]
+        assert a["table"] == b["table"]
+
+    def test_op_alternation_matches_either_saga_or_dense_push(self):
+        ev_sched = FaultSchedule().add("*", "PUSH|PUSH_SAGA", 2, DROP_REPLY)
+        ev = ev_sched.events[0]
+        assert ev.matches("h:1", "PUSH") and ev.matches("h:1", "PUSH_SAGA")
+        assert not ev.matches("h:1", "PULL_SAGA")
+
+
+class TestReplyDropSpansPSRestart:
+    def test_push_retry_across_restart_applied_exactly_once(
+            self, devices8, tmp_path):
+        """The case PR 1 explicitly left open (its dedup windows were
+        in-memory): a PUSH is applied, its reply is DROPPED by the fault
+        injector, the PS is killed (nothing flushed past its cadence
+        checkpoint) and restarted from that checkpoint -- the retried
+        (sid, seq) PUSH must be answered from the RESTORED dedup window,
+        applied exactly once across both lives."""
+        from asyncframework_tpu.net.session import ClientSession
+
+        cfg = make_cfg(printer_freq=1)   # checkpoint after every accept
+        n, d = 256, 8
+        ckpt = str(tmp_path / "ps.npz")
+        ps1 = ps_dcn.ParameterServer(cfg, d, n, device=devices8[0], port=0,
+                                     checkpoint_path=ckpt).start()
+        ep = f"127.0.0.1:{ps1.port}"
+        sess = ClientSession()
+        hdr = sess.stamp({"op": "PUSH", "wid": 0, "ts": 0})
+        g = np.full(d, 0.25, np.float32).tobytes()
+        sched = FaultSchedule(seed=CHAOS_SEED).add(ep, "PUSH", 1, DROP_REPLY)
+        with faults.injected(sched) as inj:
+            s = frame_mod.connect(("127.0.0.1", ps1.port))
+            frame_mod.send_msg(s, hdr, g)
+            with pytest.raises((ConnectionError, OSError)):
+                frame_mod.recv_msg(s)   # applied server-side; reply eaten
+            s.close()
+            assert inj.remaining() == []
+        # wait for the cadence checkpoint that CONTAINS the applied push
+        # (model k=1 and its dedup entry captured under one lock)
+        deadline = time.monotonic() + 30
+        meta = None
+        while time.monotonic() < deadline:
+            if os.path.exists(ckpt):
+                try:
+                    with np.load(ckpt, allow_pickle=False) as z:
+                        meta = json.loads(str(z["__meta__"]))
+                    if meta["k"] >= 1 and meta.get("dedup", {}).get(
+                            "sessions"):
+                        break
+                except (OSError, ValueError, KeyError):
+                    pass
+            time.sleep(0.02)
+        assert meta is not None and meta["k"] == 1, meta
+        w1 = np.asarray(ps1._w).copy()
+        ps1.stop()   # kill -9 analog: nothing beyond the checkpoint
+
+        ps2 = ps_dcn.ParameterServer(cfg, d, n, device=devices8[0], port=0,
+                                     checkpoint_path=ckpt).start()
+        try:
+            assert ps2.resumed_from_k == 1
+            # the retry, spanning the restart: same (sid, seq), same bytes
+            s2 = frame_mod.connect(("127.0.0.1", ps2.port))
+            frame_mod.send_msg(s2, hdr, g)
+            ack, _ = frame_mod.recv_msg(s2)
+            s2.close()
+            assert ack["op"] == "ACK" and ack["accepted"] is True
+            assert ps2.dedup_hits == 1          # answered from the window
+            assert ps2.accepted == 1            # applied exactly once
+            assert ps2._clock == 1              # not even a merge tick
+            np.testing.assert_array_equal(np.asarray(ps2._w), w1)
+        finally:
+            ps2.stop()

@@ -51,6 +51,7 @@ def _find_proc(workers, app_id, proc_id):
 
 
 @pytest.mark.slow
+@pytest.mark.soak
 class TestClusterSoak:
     def test_soak_master_failover_ps_kill9_worker_kill9(
         self, tmp_path, capsys

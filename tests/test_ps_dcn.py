@@ -299,6 +299,7 @@ class TestWorkerDeath:
 
 
 @pytest.mark.slow
+@pytest.mark.soak
 @pytest.mark.parametrize("algo", ["asgd", "asaga"])
 class TestPSCheckpointResume:
     def test_kill9_ps_midrun_restart_resumes_and_converges(
