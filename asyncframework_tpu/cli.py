@@ -66,6 +66,7 @@ CONF_TO_FIELD: Dict[str, str] = {
     "async.heartbeat.timeout.ms": "heartbeat_timeout_ms",
     "async.max.slot.failures": "max_slot_failures",
     "async.ui.port": "ui_port",
+    "async.trace.sample": "trace_sample",
 }
 
 DRIVER_ALIASES: Dict[str, str] = {
@@ -146,6 +147,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ui-port", type=int, default=None, metavar="PORT",
                    help="serve a live run dashboard on this HTTP port "
                         "during the run (0 = ephemeral; SparkUI parity)")
+    p.add_argument("--trace-sample", type=float, default=None,
+                   metavar="RATE",
+                   help="distributed-trace sampling rate per update "
+                        "lifecycle (1 = every update, 0 = off; default "
+                        "async.trace.sample = 1/64).  Spans land in the "
+                        "event log / live UI; inspect with bin/async-trace")
     p.add_argument("--speculation", action="store_true",
                    help="launch speculative copies of straggling tasks")
     p.add_argument("--dynamic-allocation", action="store_true",
@@ -334,6 +341,7 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
         event_log=args.event_log,
         metrics_csv=args.metrics_csv,
         ui_port=args.ui_port,
+        trace_sample=args.trace_sample,
         speculation=args.speculation,
         dynamic_allocation=args.dynamic_allocation,
         stale_read_offset=args.stale_read,
@@ -381,6 +389,7 @@ def run_driver(args, conf: AsyncConf) -> Dict[str, object]:
             gamma=cfg.gamma, batch_rate=cfg.batch_rate,
             num_iterations=cfg.num_iterations, loss=cfg.loss,
             seed=cfg.seed, snapshot_every=cfg.printer_freq,
+            trace_sample=cfg.trace_sample,
         )
         mesh = make_mesh(n_mesh, devices=devices)
         w, losses, snaps = sgd.run(Xh, yh, mesh=mesh)
@@ -509,33 +518,79 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
             )
 
             sup = ElasticSupervisor.from_conf(cfg.num_workers, conf)
-        ps = ps_dcn.ParameterServer(
-            cfg, args.d, args.N, host="0.0.0.0", port=int(port_s), algo=algo,
-            checkpoint_path=ckpt_path, supervisor=sup,
-        ).start()
-        ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
-        if not ok:
-            # progress-aware diagnostic: who went silent, who contributed
-            print(ok.diagnostic, file=sys.stderr)
-        total = ps.collect_eval(n_workers_procs, timeout_s=120.0)
-        trajectory = []
-        if total is not None:
-            times, _W = ps.snapshot_stack()
-            trajectory = [
-                (t, float(l) / args.N) for t, l in zip(times, total)
-            ]
-        ps.stop()
-        return {
-            "driver": f"{algo}-dcn-ps",
-            "done": bool(ok),
-            "accepted": ps.accepted,
-            "dropped": ps.dropped,
-            "max_staleness": ps.max_staleness,
-            "resumed_from": ps.resumed_from_k,
-            "recovery": sup.counters() if sup is not None else None,
-            "final_objective": trajectory[-1][1] if trajectory else None,
-            "trajectory": trajectory,
-        }
+        # PS-side observability spine: merges + trace spans (the PS's own
+        # server-side stages plus the spans workers piggyback on PUSH) flow
+        # bus -> event log -> live UI, same as the single-process solvers
+        bus = writer = ui = live_state = None
+        # cluster cfg is built from the recipe's positional args; the
+        # observability flags live on argparse (plus conf overlays)
+        ui_port = args.ui_port
+        if ui_port is None and conf.contains("async.ui.port"):
+            ui_port = int(conf.get("async.ui.port"))
+        want_ui = ui_port is not None and ui_port >= 0
+        if args.event_log or want_ui:
+            from asyncframework_tpu.metrics.bus import ListenerBus
+            from asyncframework_tpu.metrics.eventlog import EventLogWriter
+
+            bus = ListenerBus()
+            if args.event_log:
+                writer = EventLogWriter(args.event_log)
+                bus.add_listener(writer)
+            if want_ui:
+                from asyncframework_tpu.metrics.live import (
+                    LiveStateListener,
+                    LiveUIServer,
+                )
+
+                live_state = LiveStateListener(cfg.num_workers)
+                bus.add_listener(live_state)
+                ui = LiveUIServer(live_state, port=ui_port).start()
+            bus.start()
+        try:
+            ps = ps_dcn.ParameterServer(
+                cfg, args.d, args.N, host="0.0.0.0", port=int(port_s),
+                algo=algo, checkpoint_path=ckpt_path, supervisor=sup,
+                bus=bus,
+            ).start()
+            ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
+            if not ok:
+                # progress-aware diagnostic: who went silent, who
+                # contributed
+                print(ok.diagnostic, file=sys.stderr)
+            total = ps.collect_eval(n_workers_procs, timeout_s=120.0)
+            trajectory = []
+            if total is not None:
+                times, _W = ps.snapshot_stack()
+                trajectory = [
+                    (t, float(l) / args.N) for t, l in zip(times, total)
+                ]
+            ps.stop()
+            summary = {
+                "driver": f"{algo}-dcn-ps",
+                "done": bool(ok),
+                "accepted": ps.accepted,
+                "dropped": ps.dropped,
+                "max_staleness": ps.max_staleness,
+                "resumed_from": ps.resumed_from_k,
+                "recovery": sup.counters() if sup is not None else None,
+                "trace_spans": ps.trace_spans,
+                "final_objective": trajectory[-1][1] if trajectory else None,
+                "trajectory": trajectory,
+            }
+            if ui is not None:
+                summary["ui_port"] = ui.port
+            return summary
+        finally:
+            # teardown on EVERY path: a crash between start() and the
+            # summary must still seal the event log (a .gz without its end
+            # marker forces every later read through the torn-tail path)
+            # and stop the UI/bus threads
+            if ui is not None:
+                ui.stop()
+            if bus is not None:
+                bus.stop()
+            if writer is not None:
+                writer.close()
     # ---------------------------------------------------------- worker role
     devices = jax.devices()
     if args.devices is not None:
@@ -616,6 +671,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.master:
         return _submit_to_master(args, argv)
     conf = parse_conf_overlays(args.conf)
+    if args.trace_sample is not None:
+        # install in the process conf too: the DCN worker/PS paths resolve
+        # their recorders from async.trace.sample, not SolverConfig
+        conf.set("async.trace.sample", args.trace_sample)
     summary = run_driver(args, conf)
     trajectory = summary.pop("trajectory")
     if not args.quiet:

@@ -229,6 +229,23 @@ NET_FAULT_SCHEDULE = ConfigEntry(
 NET_FAULT_SEED = ConfigEntry(
     "async.net.fault.seed", 0, int,
     "Seed chaos runs hand to retry policies so backoff walks replay.")
+# ------------------------------------------------------------ trace plane
+# Distributed tracing for the async update loop (metrics/trace.py): spans
+# are sampled per update lifecycle, propagated over the wire as an optional
+# frame-header field, and folded into per-stage latency histograms.
+TRACE_SAMPLE = ConfigEntry(
+    "async.trace.sample", 1.0 / 64.0, float,
+    "Per-update trace sampling rate (1 = every update, 0 = tracing off; "
+    "counter-based per worker, so the first update is always sampled when "
+    "> 0 and runs of any length yield >= 1 trace).  This default governs "
+    "the DCN plane (PSClient/ParameterServer), whose stages are network-"
+    "dominated; the in-process engine traces only on explicit opt-in "
+    "(SolverConfig.trace_sample / --trace-sample) because its updater "
+    "thread is itself the measured hot path.")
+TRACE_BUFFER = ConfigEntry(
+    "async.trace.buffer", 512, int,
+    "Completed-span ring-buffer capacity per worker process (bounded, "
+    "lock-light; oldest spans dropped, counted).")
 # ---------------------------------------------------------- elastic plane
 # The process-level membership supervisor (parallel/supervisor.py): worker
 # death detection, shard adoption, rejoin, degraded-cohort clamping for

@@ -45,10 +45,15 @@ def shuffle_totals() -> Dict[str, int]:
         return dict(_TOTALS)
 
 
-def _reset_totals() -> None:  # tests only
+def reset_shuffle_totals() -> None:
+    """Zero the process-wide counters (per-run isolation; see
+    ``asyncframework_tpu.metrics.reset_totals``)."""
     with _TOTALS_LOCK:
         for k in _TOTALS:
             _TOTALS[k] = 0
+
+
+_reset_totals = reset_shuffle_totals  # historical test-suite alias
 
 
 def _estimate_bytes(kv: Tuple[Any, Any]) -> int:

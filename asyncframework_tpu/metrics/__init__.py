@@ -21,6 +21,7 @@ from asyncframework_tpu.metrics.bus import (
     ModelSnapshot,
     RoundSubmitted,
     TaskEnd,
+    TraceSpan,
     WorkerLost,
 )
 from asyncframework_tpu.metrics.eventlog import EventLogReader, EventLogWriter
@@ -33,6 +34,32 @@ from asyncframework_tpu.metrics.system import (
     JsonlSink,
     MetricsSystem,
 )
+from asyncframework_tpu.metrics.trace import (
+    Span,
+    TraceAggregator,
+    TraceContext,
+    TraceRecorder,
+)
+
+
+def reset_totals() -> None:
+    """Zero EVERY process-global observability counter (net, recovery,
+    shuffle, dedup/fault totals, the global trace aggregator) so
+    back-to-back runs in one process -- tests, notebooks, long-lived
+    daemons -- start from a clean slate instead of inheriting the previous
+    run's counts.  The live UI additionally captures per-run deltas at
+    listener construction, so calling this between runs is belt-and-braces
+    rather than required for the dashboard."""
+    from asyncframework_tpu.data.spill import reset_shuffle_totals
+    from asyncframework_tpu.metrics import trace as _trace
+    from asyncframework_tpu.net import reset_net_totals
+    from asyncframework_tpu.parallel.supervisor import reset_recovery_totals
+
+    reset_net_totals()
+    reset_recovery_totals()
+    reset_shuffle_totals()
+    _trace.reset_aggregator()
+
 
 __all__ = [
     "Event",
@@ -54,4 +81,10 @@ __all__ = [
     "CsvSink",
     "JsonlSink",
     "render_report",
+    "TraceSpan",
+    "Span",
+    "TraceAggregator",
+    "TraceContext",
+    "TraceRecorder",
+    "reset_totals",
 ]

@@ -93,11 +93,32 @@ class SpeculativeLaunch(Event):
     worker_id: int
 
 
+@dataclass(frozen=True)
+class TraceSpan(Event):
+    """One completed lifecycle-stage span of a traced update
+    (metrics/trace.py): pull.wait / pull.rtt / compute / push.wait /
+    push.rtt / merge.queue / merge.apply.  ``start_ms`` is wall-clock epoch
+    milliseconds (cross-process comparable; ``time_ms`` stays the posting
+    process's run-relative clock like every other event)."""
+
+    stage: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    worker_id: int
+    model_version: int
+    start_ms: float
+    dur_ms: float
+    staleness: Optional[int] = None
+    staleness_ms: Optional[float] = None
+    accepted: Optional[bool] = None
+
+
 EVENT_TYPES: Dict[str, Type[Event]] = {
     cls.__name__: cls
     for cls in (
         JobStart, JobEnd, TaskEnd, RoundSubmitted, GradientMerged,
-        ModelSnapshot, WorkerLost, ShardMoved, SpeculativeLaunch,
+        ModelSnapshot, WorkerLost, ShardMoved, SpeculativeLaunch, TraceSpan,
     )
 }
 

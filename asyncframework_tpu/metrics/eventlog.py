@@ -71,25 +71,34 @@ def _jsonable(o):
 
 
 class EventLogReader:
-    """Replays a JSONL event log into typed events + summary statistics."""
+    """Replays a JSONL event log into typed events + summary statistics.
+
+    After a replay (or :meth:`summary`), ``truncated_records`` counts the
+    torn records that were skipped in tolerant mode -- the kill -9 world's
+    crash-mid-write forensics: a writer SIGKILLed between ``write`` and
+    ``flush`` leaves a partial final line (or a gzip stream without its end
+    marker), and the whole valid prefix must still replay.
+    """
 
     def __init__(self, path: Union[str, Path]):
         self.path = Path(path)
+        self.truncated_records = 0
 
-    @staticmethod
-    def _lines(f) -> Iterator[str]:
+    def _lines(self, f) -> Iterator[str]:
         """Line iteration tolerating a crash-torn tail: a writer that died
         before close() leaves a gzip stream without its end marker; every
         fully-flushed line before the tear still replays."""
         try:
             yield from f
         except EOFError:
+            self.truncated_records += 1
             return
 
     def replay(self, strict: bool = True) -> Iterator[Event]:
-        """Yield events; with ``strict=False`` a torn tail (crash mid-write)
-        ends the replay at the last valid line instead of raising -- the
-        history server's inspect-a-dead-run case."""
+        """Yield events; with ``strict=False`` a torn record (crash
+        mid-write) is skipped and counted in ``truncated_records`` instead
+        of raising -- the history server's inspect-a-dead-run case."""
+        self.truncated_records = 0
         with _open_log(self.path, "r") as f:
             for line in self._lines(f):
                 line = line.strip()
@@ -100,7 +109,12 @@ class EventLogReader:
                 except json.JSONDecodeError:
                     if strict:
                         raise
-                    return  # torn tail: the valid prefix stands
+                    # torn record: skip-and-count; the flush-per-event
+                    # writer can only tear the final line, but counting
+                    # (rather than stopping) also survives a foreign tool
+                    # concatenating logs
+                    self.truncated_records += 1
+                    continue
                 name = rec.pop("event", None)
                 cls = EVENT_TYPES.get(name)
                 if cls is None:
@@ -133,7 +147,7 @@ class EventLogReader:
         failures = 0
         lost: List[int] = []
         trajectory: List[tuple] = []
-        for ev in self.replay():
+        for ev in self.replay(strict=False):
             if isinstance(ev, RoundSubmitted):
                 n_rounds += 1
             elif isinstance(ev, GradientMerged):
@@ -157,20 +171,28 @@ class EventLogReader:
             "workers_lost": lost,
             "task_failures": failures,
             "trajectory": trajectory,
+            # torn records skipped by the tolerant replay (crash mid-write)
+            "truncated_records": self.truncated_records,
         }
         if staleness:
+            from asyncframework_tpu.metrics.system import Histogram
+
             s = sorted(staleness)
             out["staleness"] = {
                 "max": s[-1],
                 "mean": sum(s) / len(s),
-                "p50": s[len(s) // 2],
-                "p95": s[min(len(s) - 1, int(0.95 * len(s)))],
+                # nearest-rank, same rule as Histogram.snapshot (the old
+                # int(q*n) indexing reported max as p95 for small logs)
+                "p50": Histogram._pct(s, 0.50),
+                "p95": Histogram._pct(s, 0.95),
             }
         if task_ms:
+            from asyncframework_tpu.metrics.system import Histogram
+
             t = sorted(task_ms)
             out["task_ms"] = {
                 "mean": sum(t) / len(t),
-                "p50": t[len(t) // 2],
+                "p50": Histogram._pct(t, 0.50),
                 "max": t[-1],
             }
         return out

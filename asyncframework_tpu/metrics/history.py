@@ -32,24 +32,25 @@ def _is_event_log(p: Path) -> bool:
 
 
 def _scan(path: Path):
-    """ONE tolerant replay: (events, merges, jobs) -- the same pass feeds
-    both the index row and the report render.  A torn tail (crash
-    mid-write) keeps the valid prefix (``strict=False``); only a file that
-    yields nothing readable at all is flagged unreadable."""
+    """ONE tolerant replay: (events, merges, jobs, truncated) -- the same
+    pass feeds both the index row and the report render.  A torn record
+    (crash mid-write) is skipped and counted (``strict=False``); only a
+    file that yields nothing readable at all is flagged unreadable."""
     events = []
     merges = jobs = 0
+    reader = EventLogReader(path)
     try:
-        for ev in EventLogReader(path).replay(strict=False):
+        for ev in reader.replay(strict=False):
             events.append(ev)
             if isinstance(ev, GradientMerged):
                 merges += 1
             elif isinstance(ev, JobStart):
                 jobs += 1
     except Exception:
-        return None, -1, -1  # foreign/binary file: listed, unreadable
+        return None, -1, -1, 0  # foreign/binary file: listed, unreadable
     if not events:
-        return None, -1, -1
-    return events, merges, jobs
+        return None, -1, -1, reader.truncated_records
+    return events, merges, jobs, reader.truncated_records
 
 
 def build_history(
@@ -79,7 +80,7 @@ def build_history(
         # "run.jsonl.gz" must not collide, and "index.jsonl" must not
         # render onto the index itself
         report_name = f"{p.name}.html"
-        events, merges, jobs = _scan(p)
+        events, merges, jobs, truncated = _scan(p)
         if events is not None:
             try:
                 render_report(
@@ -93,6 +94,9 @@ def build_history(
         if events is not None:
             link = f'<a href="{html.escape(report_name)}">{html.escape(stem)}</a>'
             status = f"{merges} updates, {jobs} jobs"
+            if truncated:
+                # crash-mid-write forensics: the run died with a torn tail
+                status += f", {truncated} truncated record(s) skipped"
         else:
             link = html.escape(stem)
             status = "unreadable"

@@ -35,8 +35,10 @@ from asyncframework_tpu.metrics.bus import (
     RoundSubmitted,
     ShardMoved,
     SpeculativeLaunch,
+    TraceSpan,
     WorkerLost,
 )
+from asyncframework_tpu.metrics.trace import Span, TraceAggregator
 
 #: running servers by most-recent-first (tests and tools discover ephemeral
 #: ports here; entries are removed on stop)
@@ -67,6 +69,14 @@ def active_servers() -> List["LiveUIServer"]:
         return list(_ACTIVE)
 
 
+def _delta(cur: Dict[str, int], base: Dict[str, int]) -> Dict[str, int]:
+    """Per-run view of a process-global counter dict: subtract the values
+    captured when THIS run's listener was built, so a second run in the
+    same process does not inherit the first run's counts.  A key the
+    baseline never saw passes through raw."""
+    return {k: v - base.get(k, 0) for k, v in cur.items()}
+
+
 class LiveStateListener(Listener):
     """Folds bus events into the dashboard snapshot (AppStatusStore role)."""
 
@@ -93,6 +103,15 @@ class LiveStateListener(Listener):
             for w in range(num_workers)
         }
         self._queue_depth_fn: Optional[Callable[[], int]] = None
+        # per-run trace view: TraceSpan events folded into this listener's
+        # OWN aggregator (the process-global one keeps accumulating for
+        # tools; the dashboard shows this run only)
+        self._trace = TraceAggregator()
+        # per-run delta baselines for the process-global counter panels: a
+        # second run's dashboard must not inherit the first run's counts
+        self._base_shuffle = _shuffle_totals()
+        self._base_net = _net_totals()
+        self._base_recovery = _recovery_totals()
 
     def register_queue_depth(self, fn: Callable[[], int]) -> None:
         self._queue_depth_fn = fn
@@ -139,6 +158,17 @@ class LiveStateListener(Listener):
                 self.speculative_launches += 1
             elif isinstance(event, ModelSnapshot):
                 self.last_objective = event.objective
+            elif isinstance(event, TraceSpan):
+                self._trace.add(Span(
+                    stage=event.stage, trace_id=event.trace_id,
+                    span_id=event.span_id, parent_id=event.parent_id,
+                    worker_id=event.worker_id,
+                    model_version=event.model_version,
+                    start_ms=event.start_ms, dur_ms=event.dur_ms,
+                    staleness=event.staleness,
+                    staleness_ms=event.staleness_ms,
+                    accepted=event.accepted,
+                ))
 
     # ------------------------------------------------------------- snapshot
     def snapshot(self) -> Dict:
@@ -166,16 +196,21 @@ class LiveStateListener(Listener):
                 "last_objective": self.last_objective,
                 "workers": {str(k): dict(v) for k, v in self.workers.items()},
                 # driver-side shuffle accounting (SortShuffleManager /
-                # UnifiedMemoryManager observability role)
-                "shuffle": _shuffle_totals(),
+                # UnifiedMemoryManager observability role); per-run delta
+                # of the process-global totals
+                "shuffle": _delta(_shuffle_totals(), self._base_shuffle),
                 # DCN robustness counters (net/): retries taken, breaker
                 # trips, dedup hits, faults fired -- the failure-handling
-                # subsystem's health at a glance
-                "net": _net_totals(),
+                # subsystem's health at a glance (per-run delta)
+                "net": _delta(_net_totals(), self._base_net),
                 # elastic-plane counters (parallel/supervisor.py): workers
                 # declared dead, shards adopted by survivors, rejoins,
-                # surrogate releases, PS checkpoint resumes
-                "recovery": _recovery_totals(),
+                # surrogate releases, PS checkpoint resumes (per-run delta)
+                "recovery": _delta(_recovery_totals(), self._base_recovery),
+                # distributed-trace section (metrics/trace.py): per-stage
+                # latency p50/p95/p99 and staleness in versions AND ms,
+                # folded from this run's TraceSpan events
+                "trace": self._trace.snapshot(),
             }
 
 

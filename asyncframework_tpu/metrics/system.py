@@ -63,6 +63,17 @@ class Histogram:
             self.count += 1
             self._vals.append(v)  # deque(maxlen) evicts the oldest in O(1)
 
+    @staticmethod
+    def _pct(vals: List[float], q: float) -> float:
+        """Nearest-rank percentile: the smallest value whose cumulative
+        share is >= q.  The old ``int(q * n)`` indexing returned the MAX
+        for p95 at any n <= 20 (int(0.95 * 20) == 19) -- every small-n
+        histogram overstated its tail."""
+        import math
+
+        n = len(vals)
+        return vals[min(n - 1, max(0, math.ceil(q * n) - 1))]
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             vals = sorted(self._vals)
@@ -74,9 +85,9 @@ class Histogram:
             "min": vals[0],
             "max": vals[-1],
             "mean": sum(vals) / n,
-            "p50": vals[n // 2],
-            "p95": vals[min(n - 1, int(0.95 * n))],
-            "p99": vals[min(n - 1, int(0.99 * n))],
+            "p50": self._pct(vals, 0.50),
+            "p95": self._pct(vals, 0.95),
+            "p99": self._pct(vals, 0.99),
         }
 
 

@@ -34,3 +34,15 @@ def net_totals() -> Dict[str, int]:
     out["dedup_hits"] = session.dedup_hits_total()
     out["faults_fired"] = faults.faults_fired_total()
     return out
+
+
+def reset_net_totals() -> None:
+    """Zero every process-wide net counter (retries/giveups/breaker trips,
+    dedup hits, faults fired) so back-to-back runs in one process start
+    from a clean slate.  Breaker *state* is left alone -- see
+    ``retry.reset_breakers`` for that."""
+    from asyncframework_tpu.net import faults, retry, session
+
+    retry.reset_retry_totals()
+    session.reset_dedup_hits_total()
+    faults.reset_faults_fired_total()

@@ -57,6 +57,14 @@ def faults_fired_total() -> int:
         return _faults_fired
 
 
+def reset_faults_fired_total() -> None:
+    """Zero the process-wide counter (per-run isolation; see
+    ``asyncframework_tpu.metrics.reset_totals``)."""
+    global _faults_fired
+    with _totals_lock:
+        _faults_fired = 0
+
+
 def _bump_fired() -> None:
     global _faults_fired
     with _totals_lock:

@@ -12,7 +12,13 @@ the network-plane sibling of ``engine/straggler.py``'s compute delays.
 
 Frame layout (unchanged): ``!I``-prefixed JSON header line, then an
 ``!I``-prefixed raw payload (possibly empty).  The header always carries
-``op``; mutating ops may carry ``sid``/``seq`` (see ``net/session.py``).
+``op``; mutating ops may carry ``sid``/``seq`` (see ``net/session.py``),
+and a frame sent while a trace context is installed on the calling thread
+(``metrics/trace.py``) carries it as an optional ``tc`` entry -- the wire
+propagation of distributed tracing, stamped here at the one choke point so
+every PULL/PUSH/PULL_SAGA/PUSH_SAGA, topic, and master op is covered.
+With tracing off nothing consults the clock and frames are byte-identical
+to the pre-trace wire.
 """
 
 from __future__ import annotations
@@ -22,6 +28,7 @@ import socket
 import struct
 from typing import Optional, Tuple
 
+from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import faults
 
 _HDR = struct.Struct("!I")  # 4-byte big-endian frame length
@@ -48,6 +55,11 @@ def connect(addr: Tuple[str, int], timeout: Optional[float] = 10.0
 
 
 def send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    tc = _trace.wire_header()
+    if tc is not None and "tc" not in header:
+        # copy, never mutate: retries re-send the caller's header verbatim
+        # (dedup stamps), and the ambient context at retry time still wins
+        header = dict(header, tc=tc)
     head = json.dumps(header).encode()
     data = _HDR.pack(len(head)) + head + _HDR.pack(len(payload)) + payload
     inj = faults.active()

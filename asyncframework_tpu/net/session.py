@@ -42,6 +42,14 @@ def dedup_hits_total() -> int:
         return _dedup_hits_total
 
 
+def reset_dedup_hits_total() -> None:
+    """Zero the process-wide counter (per-run isolation; see
+    ``asyncframework_tpu.metrics.reset_totals``)."""
+    global _dedup_hits_total
+    with _totals_lock:
+        _dedup_hits_total = 0
+
+
 def _bump_hits() -> None:
     global _dedup_hits_total
     with _totals_lock:

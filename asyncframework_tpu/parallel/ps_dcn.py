@@ -66,6 +66,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import ClientSession, DedupWindow, RetryPolicy
 from asyncframework_tpu.net import frame as _frame
 from asyncframework_tpu.parallel import supervisor as supervisor_mod
@@ -117,7 +118,8 @@ class ParameterServer:
     def __init__(self, cfg, d: int, n: int, device=None, host: str = "0.0.0.0",
                  port: int = 0, algo: str = "asgd",
                  checkpoint_path: Optional[str] = None,
-                 supervisor: Optional[ElasticSupervisor] = None):
+                 supervisor: Optional[ElasticSupervisor] = None,
+                 bus=None):
         import jax
         import jax.numpy as jnp
 
@@ -208,6 +210,24 @@ class ParameterServer:
         from asyncframework_tpu.conf import NET_DEDUP_WINDOW, global_conf
 
         self._dedup = DedupWindow(window=global_conf().get(NET_DEDUP_WINDOW))
+
+        # distributed tracing (metrics/trace.py): server-side spans for
+        # traced updates (the frame carried a ``tc`` header) plus spans
+        # piggybacked on PUSH/BYE are folded into the process-global
+        # aggregator and -- when a ListenerBus is given -- posted as
+        # TraceSpan events (-> event log -> live UI -> history server), so
+        # a worker's spans survive its death.
+        self.bus = bus
+        self._trace_agg = _trace.aggregator()
+        self.trace_spans = 0  # spans folded (own + piggybacked)
+        # folds happen on per-connection handler threads, outside _lock by
+        # design (telemetry must not queue the apply path) -- the counter
+        # needs its own lock like every other process counter.  Piggyback
+        # folds dedup by span_id (bounded LRU) -- see _fold_wire_spans.
+        self._trace_lock = threading.Lock()
+        from collections import OrderedDict as _OD
+
+        self._seen_span_ids: "_OD[str, None]" = _OD()
 
         self._elapsed_offset_ms = 0.0  # wall already spent before a resume
         if checkpoint_path and os.path.exists(checkpoint_path):
@@ -400,6 +420,41 @@ class ParameterServer:
     def _now_ms(self) -> float:
         return (time.monotonic() - self._t0) * 1e3
 
+    # -------------------------------------------------------------- tracing
+    def _bus_time_ms(self) -> float:
+        return self._now_ms() if self._t0 is not None else 0.0
+
+    def _fold_span(self, span: "_trace.Span") -> None:
+        """One span into the aggregator + (when attached) the event bus."""
+        with self._trace_lock:
+            self.trace_spans += 1
+        self._trace_agg.add(span)
+        if self.bus is not None:
+            self.bus.post(_trace.span_event(span, self._bus_time_ms()))
+
+    def _fold_wire_spans(self, wire_spans) -> None:
+        """Spans piggybacked on a worker's PUSH/BYE header.
+
+        Deduped by span_id: the (sid, seq) window covers same-stamp
+        retries, but a push that was DELIVERED and then spent its whole
+        retry budget re-queues its piggyback onto the next push under a
+        fresh stamp -- without this, exactly the fault windows tracing
+        exists to explain would double-count their spans."""
+        if not wire_spans:
+            return
+        for d in wire_spans:
+            try:
+                span = _trace.Span.from_wire(d)
+                with self._trace_lock:
+                    if span.span_id in self._seen_span_ids:
+                        continue
+                    self._seen_span_ids[span.span_id] = None
+                    while len(self._seen_span_ids) > 8192:
+                        self._seen_span_ids.popitem(last=False)
+                self._fold_span(span)
+            except Exception:  # noqa: BLE001 - junk from the wire must not
+                pass           # kill the connection handler
+
     # ------------------------------------------------------------- protocol
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
@@ -448,6 +503,9 @@ class ParameterServer:
                         self._eval_cv.notify_all()
                     _send_msg(conn, {"op": "ACK"})
                 elif op == "BYE":
+                    # a departing worker's last completed spans (push.rtt
+                    # of its final traced update has no later PUSH to ride)
+                    self._fold_wire_spans(header.get("spans"))
                     _send_msg(conn, {"op": "ACK"})
                     return
                 else:
@@ -492,6 +550,12 @@ class ParameterServer:
         if self._done.is_set():
             _send_msg(conn, {"op": "DONE"})
             return
+        # traced update: time spent in the partial-barrier wave gate below
+        # is THE server-side pull latency (pull.wait).  Untraced pulls (no
+        # tc header -- sampling off or unsampled update) do no trace work.
+        tc = _trace.TraceContext.from_wire(header["tc"]) \
+            if "tc" in header else None
+        t_wait0 = _trace.now_ms() if tc is not None else 0.0
         STARVATION_S = 1.0  # degraded-cohort release when peers are gone
         with self._wave_cv:
             self._waiting.append(wid)
@@ -526,6 +590,7 @@ class ParameterServer:
                     ):
                         self._release_wave_locked()
                         break
+        t_wait1 = _trace.now_ms() if tc is not None else 0.0
         if self._done.is_set():
             _send_msg(conn, {"op": "DONE"})
             return
@@ -571,6 +636,16 @@ class ParameterServer:
             w_host = self._w_host
             self._pull_times[wid] = self._now_ms()
             avg = self.avg_delay_ms
+        if tc is not None:
+            # exactly the wave-gate wait (barrier cost), not the model
+            # readback; folded here because the served version ts is only
+            # known under the lock
+            self._fold_span(_trace.Span(
+                stage=_trace.PULL_WAIT, trace_id=tc.trace_id,
+                span_id=_trace._new_id(8), parent_id=tc.span_id,
+                worker_id=wid, model_version=ts, start_ms=t_wait0,
+                dur_ms=max(0.0, t_wait1 - t_wait0),
+            ))
         if sup is not None:
             # adoption orders ride the PULL reply (no extra RTT, no side
             # channel): re-delivered until the adopter's first pull FOR the
@@ -594,6 +669,13 @@ class ParameterServer:
         wid = int(header["wid"])
         ts = int(header["ts"])
         proc = header.get("proc")
+        # completed client-side spans ride the PUSH header (the piggyback
+        # that makes spans survive worker death); fold them before any
+        # drop path so a membership-stale push still delivers its telemetry
+        self._fold_wire_spans(header.get("spans"))
+        tc = _trace.TraceContext.from_wire(header["tc"]) \
+            if "tc" in header else None
+        t_queue0 = _trace.now_ms() if tc is not None else 0.0
         sup = self.supervisor
         if sup is not None and not sup.owns(proc, wid):
             # membership-stale push: the shard was re-homed (rejoin deposed
@@ -627,6 +709,8 @@ class ParameterServer:
                 g_host = raw
         do_snapshot = False
         with self._lock:
+            # merge.queue: decode + wait for the single-writer model lock
+            t_apply0 = _trace.now_ms() if tc is not None else 0.0
             self.push_bytes += len(payload)
             if self._t0 is not None:
                 self._last_contact[wid] = self._now_ms()
@@ -700,6 +784,37 @@ class ParameterServer:
             # already contains (that gap would re-apply the push after a
             # restart)
             self._dedup.record(header, ack)
+            k_at_merge = self._k  # for the bus event: the clock THIS
+            # push's accept/drop was judged against, captured under the
+            # same lock (a later push may advance _k before we post)
+        if tc is not None:
+            # staleness in TIME (ASAP's quantity): age of the model basis
+            # this gradient was computed on = now - that version's pull.
+            # merge.queue covers decode+lock wait; merge.apply covers the
+            # tau filter + apply dispatch under the lock.
+            t_done = _trace.now_ms()
+            self._fold_span(_trace.Span(
+                stage=_trace.MERGE_QUEUE, trace_id=tc.trace_id,
+                span_id=_trace._new_id(8), parent_id=tc.span_id,
+                worker_id=wid, model_version=ts, start_ms=t_queue0,
+                dur_ms=max(0.0, t_apply0 - t_queue0),
+            ))
+            self._fold_span(_trace.Span(
+                stage=_trace.MERGE_APPLY, trace_id=tc.trace_id,
+                span_id=_trace._new_id(8), parent_id=tc.span_id,
+                worker_id=wid, model_version=ts, start_ms=t_apply0,
+                dur_ms=max(0.0, t_done - t_apply0),
+                staleness=int(staleness), staleness_ms=float(task_ms),
+                accepted=bool(accepted),
+            ))
+        if self.bus is not None:
+            from asyncframework_tpu.metrics.bus import GradientMerged
+
+            self.bus.post(GradientMerged(
+                self._bus_time_ms(), worker_id=wid,
+                staleness=int(staleness), accepted=bool(accepted),
+                iteration=k_at_merge,
+            ))
         with self._wave_cv:
             self._wave_cv.notify_all()  # a wave may now meet its threshold
         _send_msg(conn, ack)
@@ -852,13 +967,19 @@ class PSClient:
     def __init__(self, host: str, port: int, timeout_s: float = 120.0,
                  retry: Optional[RetryPolicy] = None,
                  session: Optional[ClientSession] = None,
-                 proc: Optional[str] = None):
+                 proc: Optional[str] = None,
+                 recorder: Optional["_trace.TraceRecorder"] = None):
         self.host, self.port = host, int(port)
         self.endpoint = f"{host}:{self.port}"
         self.retry = retry if retry is not None else RetryPolicy.from_conf(
             attempt_timeout_s=timeout_s
         )
         self.session = session if session is not None else ClientSession()
+        # distributed tracing: completed spans from this process's recorder
+        # piggyback on PUSH (and BYE) headers -- the PS folds them into its
+        # event stream, so spans survive this worker's death.  None =
+        # tracing off for this client, zero extra wire bytes.
+        self.recorder = recorder
         # elastic membership: the worker PROCESS token stamped on every
         # PULL/PUSH so the PS supervisor knows who serves which shard;
         # None = classic fixed-membership client
@@ -934,11 +1055,31 @@ class PSClient:
         })
         return header
 
-    def pull(self, wid: int) -> Optional[Tuple[int, np.ndarray, float, bool]]:
+    def _traced_call(self, tr, stage: str, header: dict,
+                     payload: bytes = b"") -> Tuple[dict, bytes]:
+        """One RPC under an optional update trace: installs the ambient
+        context (frame.send_msg stamps the ``tc`` header from it) for the
+        call's duration and records the client-observed round-trip span.
+        With ``tr=None`` this is exactly ``_call_raw``."""
+        if tr is None:
+            return self._call_raw(header, payload)
+        token = tr.rpc_begin(stage)
+        try:
+            out = self._call_raw(header, payload)
+        except BaseException:
+            _trace.set_current(None)  # never leak the context on failure
+            raise
+        tr.rpc_end(token)
+        return out
+
+    def pull(self, wid: int, tr=None
+             ) -> Optional[Tuple[int, np.ndarray, float, bool]]:
         """Returns (ts, w, avg_delay_ms, calibrated); None when DONE or
-        when this client's wid was RELEASED (check ``self.released``)."""
-        header, payload = self._call_raw(
-            self._proc_hdr({"op": "PULL", "wid": wid})
+        when this client's wid was RELEASED (check ``self.released``).
+        ``tr`` (an UpdateTrace) records this pull's round trip as a
+        pull.rtt span and propagates the trace context on the wire."""
+        header, payload = self._traced_call(
+            tr, _trace.PULL_RTT, self._proc_hdr({"op": "PULL", "wid": wid})
         )
         if header["op"] == "RELEASED":
             self.released = True
@@ -946,6 +1087,8 @@ class PSClient:
         if header["op"] == "DONE":
             return None
         self._note_orders(header)
+        if tr is not None:
+            tr.set_model_version(int(header["ts"]))
         w = np.frombuffer(payload, np.float32)
         return (int(header["ts"]), w, float(header["avg_delay_ms"]),
                 bool(header["calibrated"]))
@@ -962,10 +1105,14 @@ class PSClient:
                          + g[nz].astype(np.float32).tobytes())
 
     def push(self, wid: int, ts: int, g: np.ndarray,
-             sparse: bool = False, diff: Optional[np.ndarray] = None
-             ) -> Tuple[bool, bool]:
+             sparse: bool = False, diff: Optional[np.ndarray] = None,
+             tr=None) -> Tuple[bool, bool]:
         """Returns (accepted, run_done).  ``diff`` (ASAGA candidate history
-        scalars) rides after the gradient when given."""
+        scalars) rides after the gradient when given.  ``tr`` records this
+        push's encode time (push.wait) and round trip (push.rtt); any
+        completed spans in the client's recorder piggyback on the header
+        either way."""
+        t_enc0 = _trace.now_ms() if tr is not None else 0.0
         g = np.asarray(g, np.float32)
         # ASAGA pushes ride their own verb so fault schedules can tell the
         # two solvers' streams apart (the PS treats both identically)
@@ -980,24 +1127,47 @@ class PSClient:
         if diff is not None:
             payload += np.asarray(diff, np.float32).tobytes()
         self.bytes_pushed += len(payload)
+        if tr is not None:
+            tr.add(_trace.PUSH_WAIT, t_enc0, _trace.now_ms())
+        spans: List[dict] = []
+        if self.recorder is not None:
+            # the PUSH piggyback: completed spans (a previous traced
+            # update's push.rtt, this one's pull.rtt/compute/push.wait)
+            # ship in the header -- one drain per logical push; retries
+            # re-send the same header, and the PS dedup window keeps a
+            # re-applied push from double-folding them
+            spans = self.recorder.drain_wire()
+            if spans:
+                hdr["spans"] = spans
         # stamp ONCE: retries re-send the same (sid, seq), so a push whose
         # ACK was lost is answered from the PS dedup window, not re-applied
-        header, _ = self._call_raw(
-            self.session.stamp(self._proc_hdr(hdr)), payload
-        )
+        try:
+            header, _ = self._traced_call(
+                tr, _trace.PUSH_RTT,
+                self.session.stamp(self._proc_hdr(hdr)), payload,
+            )
+        except BaseException:
+            if spans and self.recorder is not None:
+                # the whole retry budget is spent (PS down longer than one
+                # policy window): put the undelivered piggyback back so it
+                # rides the next push/BYE instead of vanishing -- these
+                # spans describe exactly the fault window being traced
+                self.recorder.requeue(spans)
+            raise
         if header.get("released"):
             self.released = True
         return bool(header.get("accepted")), bool(header.get("done"))
 
-    def pull_saga(self, wid: int, n_p: int) -> Optional[
+    def pull_saga(self, wid: int, n_p: int, tr=None) -> Optional[
         Tuple[int, np.ndarray, np.ndarray, np.ndarray, int, float, bool]
     ]:
         """ASAGA pull: the PS samples this worker's rows and ships their
         current history scalars with the model (the reference's sampledMap).
         Returns (ts, w, idx, alpha_sel, n_valid, avg_delay_ms, calibrated)
         or None when DONE."""
-        header, payload = self._call_raw(
-            self._proc_hdr({"op": "PULL_SAGA", "wid": wid, "n_p": n_p})
+        header, payload = self._traced_call(
+            tr, _trace.PULL_RTT,
+            self._proc_hdr({"op": "PULL_SAGA", "wid": wid, "n_p": n_p}),
         )
         if header["op"] == "RELEASED":
             self.released = True
@@ -1005,6 +1175,8 @@ class PSClient:
         if header["op"] == "DONE":
             return None
         self._note_orders(header)
+        if tr is not None:
+            tr.set_model_version(int(header["ts"]))
         cap = int(header["cap"])
         d4 = len(payload) - 8 * cap
         w = np.frombuffer(payload[:d4], np.float32)
@@ -1014,11 +1186,11 @@ class PSClient:
                 float(header["avg_delay_ms"]), bool(header["calibrated"]))
 
     def push_saga(self, wid: int, ts: int, g: np.ndarray, diff: np.ndarray,
-                  sparse: bool = False) -> Tuple[bool, bool]:
+                  sparse: bool = False, tr=None) -> Tuple[bool, bool]:
         """ASAGA push: gradient + candidate history scalars for the sampled
         rows (committed by the PS only on accept).  Returns (accepted, done).
         """
-        return self.push(wid, ts, g, sparse=sparse, diff=diff)
+        return self.push(wid, ts, g, sparse=sparse, diff=diff, tr=tr)
 
     def snapshots(self) -> Tuple[List[float], np.ndarray]:
         header, payload = self._call_raw({"op": "SNAPSHOTS"})
@@ -1032,7 +1204,14 @@ class PSClient:
     def bye(self) -> None:
         try:
             if self._sock is not None:
-                _send_msg(self._sock, {"op": "BYE"})
+                hdr: dict = {"op": "BYE"}
+                if self.recorder is not None:
+                    # last drain: the final traced update's push.rtt has no
+                    # later PUSH to ride, so it leaves with the goodbye
+                    spans = self.recorder.drain_wire()
+                    if spans:
+                        hdr["spans"] = spans
+                _send_msg(self._sock, hdr)
                 _recv_msg(self._sock)
         except (ConnectionError, OSError):
             pass
@@ -1081,6 +1260,12 @@ def run_worker_process(
     from asyncframework_tpu.ops import steps
 
     proc_token = proc_token or f"{socket.gethostname()}-{os.getpid()}"
+    # distributed tracing (metrics/trace.py): one sampling recorder + span
+    # ring per worker process, shared by its loop threads.  With
+    # async.trace.sample = 0 the recorder is None and the hot path does no
+    # tracing work at all (and frames stay byte-identical).
+    _rec = _trace.TraceRecorder()
+    recorder = _rec if _rec.enabled else None
     sparse = any(hasattr(s, "cols") for s in shards.values())
     if algo == "asaga":
         step = (steps.make_saga_dcn_sparse_worker_step(d) if sparse
@@ -1180,16 +1365,24 @@ def run_worker_process(
             while not stop.is_set() and time.monotonic() < deadline:
                 try:
                     if cl is None:
-                        cl = PSClient(host, port, proc=proc_token)
+                        cl = PSClient(host, port, proc=proc_token,
+                                      recorder=recorder)
+                    # per-update sampling decision: a traced update's RPCs
+                    # carry the trace context on the wire and its lifecycle
+                    # spans (pull.rtt/compute/push.wait/push.rtt) land in
+                    # the recorder ring for the PUSH piggyback
+                    tr = (recorder.start_update(wid)
+                          if recorder is not None else None)
                     # per-RPC transport faults (reconnect, backoff, jitter,
                     # breaker) are the client's RetryPolicy's problem now;
                     # PUSH retries are exactly-once-applied via the PS
                     # dedup window, so nothing here needs to reason about
                     # "did my gradient land"
                     if algo == "asaga":
-                        got = cl.pull_saga(wid, int(shard.y.shape[0]))
+                        got = cl.pull_saga(wid, int(shard.y.shape[0]),
+                                           tr=tr)
                     else:
-                        got = cl.pull(wid)
+                        got = cl.pull(wid, tr=tr)
                     if got is None:
                         break  # DONE, or this wid was RELEASED to a rejoiner
                     if shard_factory is not None:
@@ -1203,6 +1396,10 @@ def run_worker_process(
                     if calibrated and not calibrated_once.is_set():
                         delay_model.calibrate(avg_ms)
                         calibrated_once.set()
+                    # compute span: straggler delay + host->device put +
+                    # gradient step + device->host readback -- everything
+                    # between the pull reply and the push encode
+                    t_c0 = _trace.now_ms() if tr is not None else 0.0
                     dly = delay_model.delay_ms(wid) if calibrated else 0.0
                     if dly > 0:
                         time.sleep(dly / 1e3)
@@ -1215,16 +1412,22 @@ def run_worker_process(
                             jax.device_put(alpha_sel, dev),
                             np.int32(n_valid),
                         )
+                        g_host = np.asarray(g)
+                        diff_host = np.asarray(diff)
+                        if tr is not None:
+                            tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
                         _accepted, done = cl.push_saga(
-                            wid, ts, np.asarray(g), np.asarray(diff),
-                            sparse=sparse,
+                            wid, ts, g_host, diff_host, sparse=sparse,
+                            tr=tr,
                         )
                     else:
                         g, new_key = run_step(shard, w_dev, key)
                         key = new_key
                         g_host = np.asarray(g)  # the push IS the readback
+                        if tr is not None:
+                            tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
                         _accepted, done = cl.push(wid, ts, g_host,
-                                                  sparse=sparse)
+                                                  sparse=sparse, tr=tr)
                     if done:
                         break
                 except (ConnectionError, OSError):

@@ -66,6 +66,14 @@ def recovery_totals() -> Dict[str, int]:
         return dict(_totals)
 
 
+def reset_recovery_totals() -> None:
+    """Zero the process-wide counters (per-run isolation; see
+    ``asyncframework_tpu.metrics.reset_totals``)."""
+    with _totals_lock:
+        for k in _totals:
+            _totals[k] = 0
+
+
 def bump_total(key: str, n: int = 1) -> None:
     with _totals_lock:
         _totals[key] = _totals.get(key, 0) + n

@@ -228,7 +228,13 @@ class ASAGA(FlopsAccountingMixin):
                 g = res.data[0]
                 task_ms = waiting.on_finish(res.worker_id, now_ms())
                 do_save = False
+                # trace timings (metrics/trace.py): collect -> lock
+                # (merge.queue) -> history-corrected apply (merge.apply)
+                t_drained = now_ms() if inst.tracer is not None else 0.0
+                t_apply0 = t_apply1 = t_drained
                 with state_lock:
+                    if inst.tracer is not None:
+                        t_apply0 = now_ms()
                     state["flops"] += self._task_flops(res.worker_id)
                     k = state["k"]
                     # ASAGA acceptance quirk: k - staleness <= taw
@@ -283,9 +289,13 @@ class ASAGA(FlopsAccountingMixin):
                         )
                     else:
                         state["dropped"] += 1
+                    if inst.tracer is not None:
+                        t_apply1 = now_ms()
                 inst.on_gradient_merged(
                     res.worker_id, res.staleness, accepted, k,
                     batch_size=res.batch_size, task_ms=task_ms,
+                    queue_ms=max(0.0, t_apply0 - t_drained),
+                    apply_ms=max(0.0, t_apply1 - t_apply0),
                 )
                 if do_save:
                     save_checkpoint(save_k, save_w, save_ab)

@@ -239,6 +239,11 @@ class ASGD(FlopsAccountingMixin):
                     except queue.Empty:
                         break
                 do_save = False
+                # trace timings (metrics/trace.py): drained -> lock+filter
+                # (merge.queue) -> device apply (merge.apply); only paid
+                # when a tracer is sampling this run
+                t_drained = now_ms() if inst.tracer is not None else 0.0
+                t_apply0 = t_apply1 = t_drained
                 with state_lock:
                     k = state["k"]
                     # never apply past the iteration budget: trim the batch
@@ -266,6 +271,8 @@ class ASGD(FlopsAccountingMixin):
                             )
                         # else: beyond the iteration budget -- ignored, like
                         # the old per-result loop's break-at-limit
+                    if inst.tracer is not None:
+                        t_apply0 = now_ms()
                     if len(accepted_g) >= BATCH_DRAIN_MIN:
                         # stack+apply = 2 dispatches replacing m.  The list
                         # is padded with the cached zero handle to the fixed
@@ -296,6 +303,8 @@ class ASGD(FlopsAccountingMixin):
                             state["w"], state["k_dev"] = self._apply(
                                 state["w"], g, state["k_dev"]
                             )
+                    if inst.tracer is not None:
+                        t_apply1 = now_ms()
                     if accepted_g:
                         k_new = k + len(accepted_g)
                         state["k"] = k_new
@@ -312,10 +321,14 @@ class ASGD(FlopsAccountingMixin):
                         # boundary must still save
                         do_save = ckpt.should_save_range(k, k_new)
                         save_k, save_w = state["k"], state["w"]
+                q_ms = max(0.0, t_apply0 - t_drained)
+                a_ms = (max(0.0, t_apply1 - t_apply0)
+                        / max(1, len(accepted_g)))
                 for res, accepted, task_ms, at_k in merged:
                     inst.on_gradient_merged(
                         res.worker_id, res.staleness, accepted, at_k,
                         batch_size=res.batch_size, task_ms=task_ms,
+                        queue_ms=q_ms, apply_ms=a_ms if accepted else 0.0,
                     )
                 if do_save:
                     save_checkpoint(save_k, save_w)

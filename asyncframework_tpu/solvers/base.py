@@ -318,6 +318,14 @@ class SolverConfig:
     metrics_csv: Optional[str] = None     # CsvSink path
     metrics_jsonl: Optional[str] = None   # JsonlSink path
     metrics_period_s: float = 1.0
+    # distributed tracing (metrics/trace.py): per-update sampling rate for
+    # lifecycle spans (compute / merge.queue / merge.apply here; the DCN
+    # path adds the wire stages).  None (default) = OFF for the in-process
+    # engine -- its updater thread is the measured hot path, so tracing it
+    # is explicit opt-in (--trace-sample / --conf async.trace.sample); the
+    # async.trace.sample conf default (1/64) governs the DCN plane, whose
+    # stages are network-dominated.
+    trace_sample: Optional[float] = None
     # failure detection / elastic recovery (HeartbeatReceiver parity)
     heartbeat: bool = True                # liveness monitoring during the run
     heartbeat_timeout_ms: float = 2000.0

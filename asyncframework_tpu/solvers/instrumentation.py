@@ -32,8 +32,27 @@ from asyncframework_tpu.metrics.bus import (
     SpeculativeLaunch,
     WorkerLost,
 )
+from asyncframework_tpu.metrics import trace as trace_mod
 from asyncframework_tpu.metrics.eventlog import EventLogWriter
 from asyncframework_tpu.metrics.system import CsvSink, JsonlSink, MetricsSystem
+
+
+class _GlobalTraceFold:
+    """Bus listener folding TraceSpan events into the process-global
+    aggregator (bench.py / tools read it) -- on the dispatch thread, so
+    the solver's updater never pays for histogram updates."""
+
+    def on_trace_span(self, ev) -> None:
+        trace_mod.aggregator().add(trace_mod.Span(
+            stage=ev.stage, trace_id=ev.trace_id, span_id=ev.span_id,
+            parent_id=ev.parent_id, worker_id=ev.worker_id,
+            model_version=ev.model_version, start_ms=ev.start_ms,
+            dur_ms=ev.dur_ms, staleness=ev.staleness,
+            staleness_ms=ev.staleness_ms, accepted=ev.accepted,
+        ))
+
+    def on_event(self, event) -> None:
+        pass
 
 
 class RunInstruments:
@@ -76,6 +95,32 @@ class RunInstruments:
             self.bus.start()
             self.ui = LiveUIServer(self.live_state, port=ui_port).start()
 
+        # distributed tracing: the single-process solvers' slice of the
+        # lifecycle vocabulary (compute / merge.queue / merge.apply --
+        # there is no wire here, so the pull/push stages are the DCN
+        # path's).  Sampled spans go to the bus as TraceSpan events (->
+        # event log / live UI) and a bus listener folds them into the
+        # process-global aggregator (bench.py --trace-jsonl reads it).
+        # EXPLICIT opt-in only (cfg.trace_sample / --trace-sample /
+        # --conf async.trace.sample): the conf default governs the DCN
+        # plane, where stages are network-dominated -- here the updater
+        # thread IS the measured hot path, and even microsecond-scale
+        # per-merge work (or the bus dispatch thread's GIL share)
+        # measurably shifts marginal-stability engine runs.  None or 0 =
+        # no tracer, zero per-merge work.
+        self.tracer: Optional[trace_mod.TraceRecorder] = None
+        _rate = getattr(cfg, "trace_sample", None)
+        if _rate is not None and float(_rate) > 0:
+            _rec = trace_mod.TraceRecorder(
+                sample_rate=float(_rate), sink=self._fold_span,
+            )
+            if _rec.enabled:
+                self.tracer = _rec
+                # start the bus so the updater pays only a queue put;
+                # span fan-out runs on the dispatch thread
+                self.bus.add_listener(_GlobalTraceFold())
+                self.bus.start()
+
         metrics_csv = getattr(cfg, "metrics_csv", None)
         metrics_jsonl = getattr(cfg, "metrics_jsonl", None)
         if metrics_csv or metrics_jsonl:
@@ -113,6 +158,12 @@ class RunInstruments:
         if self.metrics is not None:
             self._c_rounds.inc()
 
+    def _fold_span(self, span: "trace_mod.Span") -> None:
+        # hot-thread cost: one non-blocking queue put (the bus is started
+        # whenever the tracer is on); aggregation happens on the dispatch
+        # thread via _GlobalTraceFold / LiveStateListener
+        self.bus.post(trace_mod.span_event(span, self.now_ms()))
+
     def on_gradient_merged(
         self,
         worker_id: int,
@@ -121,6 +172,8 @@ class RunInstruments:
         iteration: int,
         batch_size: int = 0,
         task_ms: float = 0.0,
+        queue_ms: float = 0.0,
+        apply_ms: float = 0.0,
     ) -> None:
         self.bus.post(
             GradientMerged(
@@ -128,6 +181,28 @@ class RunInstruments:
                 batch_size,
             )
         )
+        if self.tracer is not None:
+            ut = self.tracer.start_update(worker_id)
+            if ut is not None:
+                # the stages ran back-to-back and just ended: reconstruct
+                # their starts from the measured durations
+                ut.ctx.model_version = int(iteration)
+                t_now = trace_mod.now_ms()
+                t_apply0 = t_now - apply_ms
+                t_queue0 = t_apply0 - queue_ms
+                t_comp0 = t_queue0 - task_ms
+                if task_ms:
+                    ut.add(trace_mod.COMPUTE, t_comp0, t_queue0)
+                if queue_ms:
+                    ut.add(trace_mod.MERGE_QUEUE, t_queue0, t_apply0)
+                # staleness in TIME: how old the worker's model basis was
+                # at merge = its task wall-clock + result-queue wait
+                ut.add(
+                    trace_mod.MERGE_APPLY, t_apply0, t_now,
+                    staleness=int(staleness),
+                    staleness_ms=float(task_ms + queue_ms),
+                    accepted=bool(accepted),
+                )
         if self.metrics is not None:
             (self._c_accepted if accepted else self._c_dropped).inc()
             self._h_staleness.update(float(staleness))

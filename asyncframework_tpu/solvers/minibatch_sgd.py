@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.parallel.mesh import make_mesh, pad_and_shard
 
 
@@ -54,6 +55,7 @@ class MiniBatchSGD:
         seed: int = 42,
         snapshot_every: int = 100,
         convergence_tol: float = 0.0,
+        trace_sample: Optional[float] = None,
     ):
         if updater not in ("simple", "l2", "l1"):
             raise ValueError(f"unknown updater {updater!r}")
@@ -68,6 +70,9 @@ class MiniBatchSGD:
         self.seed = seed
         self.snapshot_every = snapshot_every
         self.convergence_tol = convergence_tol
+        # in-process engine policy (see SolverConfig.trace_sample): tracing
+        # is explicit opt-in; the conf default governs the DCN plane only
+        self.trace_sample = trace_sample
 
     def _build(
         self,
@@ -186,12 +191,34 @@ class MiniBatchSGD:
 
             Xs, ys, vs, w_dev, _d = pad_and_shard_2d(mesh, X, y, w0)
         key0 = jax.random.PRNGKey(self.seed)
+        t_run0 = _trace.now_ms()
         wT, losses, ws = train(Xs, ys, vs, w_dev, key0)
+        # distributed-trace boundary: the whole fused lax.scan IS one
+        # compute span by construction (no host between updates, so the
+        # per-update decomposition the async solvers record cannot exist
+        # here); fold it into the process-global aggregator so a bench run
+        # mixing drivers still shows where the wall-clock went.  The
+        # readbacks below fence the dispatch, so stamp the span after them.
+        # Explicit opt-in like every in-process solver (a seconds-long
+        # whole-run span in the shared aggregator's compute stage must be
+        # asked for, not ambient).
+        _traced = (self.trace_sample is not None
+                   and float(self.trace_sample) > 0)
         if md_axis is not None:
             wT = wT[:d]
             ws = ws[:, :d]
         losses = np.asarray(losses)
         ws = np.asarray(ws)
+        if _traced:
+            agg = _trace.aggregator()
+            ctx = _trace.TraceContext(_trace._new_id(16), 0,
+                                      self.num_iterations)
+            agg.add(_trace.Span(
+                stage=_trace.COMPUTE, trace_id=ctx.trace_id,
+                span_id=ctx.span_id, parent_id=None, worker_id=0,
+                model_version=self.num_iterations, start_ms=t_run0,
+                dur_ms=max(0.0, _trace.now_ms() - t_run0),
+            ))
         snaps = [
             (i, ws[i]) for i in range(0, self.num_iterations, self.snapshot_every)
         ]

@@ -244,7 +244,19 @@ def run_child(config_name: str) -> None:
         seed=42,
         calibration_iters=100,
         run_timeout_s=RUN_TIMEOUT_S,
+        trace_sample=0.0,  # tracing only when the parent asks (BENCH_TRACE)
     )
+    # latency decomposition alongside throughput (bench.py --trace-jsonl):
+    # sample update lifecycles through metrics/trace.py so the BENCH
+    # artifact records per-stage p50/p95/p99 and staleness-in-ms, not just
+    # updates/s -- every later perf PR becomes judgeable stage by stage
+    if os.environ.get("BENCH_TRACE") == "1":
+        from asyncframework_tpu.metrics import trace as trace_mod
+
+        trace_mod.reset_aggregator()
+        scfg.trace_sample = float(
+            os.environ.get("BENCH_TRACE_SAMPLE", "0.125")
+        )
     solver = ASGD(ds, None, scfg, devices=devices)
 
     # warm the XLA compile caches outside the timed region (the reference's
@@ -312,6 +324,12 @@ def run_child(config_name: str) -> None:
 
     res = solver.run()
 
+    trace_snap = None
+    if os.environ.get("BENCH_TRACE") == "1":
+        from asyncframework_tpu.metrics import trace as trace_mod
+
+        trace_snap = trace_mod.aggregator().snapshot()
+
     initial = res.trajectory[0][1]
     target = initial * TARGET_FRACTION
     t_hit_traj = None
@@ -343,7 +361,8 @@ def run_child(config_name: str) -> None:
         emit({"config": config_name, "ok": False,
               "note": "TARGET NOT REACHED",
               "elapsed_s": round(res.elapsed_s, 2),
-              "final_over_initial": res.trajectory[-1][1] / initial})
+              "final_over_initial": res.trajectory[-1][1] / initial,
+              "trace": trace_snap})
         return
     baseline = spark_equal_recipe_baseline(cfg, k_hit)
 
@@ -405,6 +424,9 @@ def run_child(config_name: str) -> None:
                                  if per_update_s is not None else None),
         "fused": fused,   # device-resident accept loop, labeled apart
         "rtt_ms": round(rtt_ms, 2),
+        # per-stage latency decomposition + staleness-in-ms (None unless
+        # the parent ran with --trace-jsonl / BENCH_TRACE=1)
+        "trace": trace_snap,
     })
 
 
@@ -580,6 +602,17 @@ print(json.dumps(out))
     return json.loads(line)
 
 
+def trace_jsonl_path():
+    """--trace-jsonl PATH (or BENCH_TRACE_JSONL env): capture each run's
+    per-stage latency decomposition + staleness-in-ms alongside throughput,
+    one JSONL record per child sample."""
+    if "--trace-jsonl" in sys.argv:
+        i = sys.argv.index("--trace-jsonl")
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return os.environ.get("BENCH_TRACE_JSONL") or None
+
+
 def run_parent() -> None:
     names = [
         s for s in os.environ.get(
@@ -589,6 +622,9 @@ def run_parent() -> None:
     deadline = time.monotonic() + TOTAL_BUDGET_S
     samples = {name: [] for name in names}
     env = dict(os.environ)
+    trace_out = trace_jsonl_path()
+    if trace_out:
+        env["BENCH_TRACE"] = "1"
     # liveness gate BEFORE spending any child budget: round 3 burned 600s x 2
     # on a dead tunnel and left rc=124 with nothing; a dead backend must
     # yield a documented partial artifact instead
@@ -696,6 +732,11 @@ def run_parent() -> None:
                 and r["fused"].get("vs_baseline") is not None
             ]),
         }
+        traced = [r["trace"] for r in recs if r.get("trace")]
+        if traced:
+            # latest sample's full decomposition rides the artifact: the
+            # BENCH trajectory gains per-stage p50/p95/p99 + staleness-ms
+            configs_out[name]["trace"] = traced[-1]
         ratios.append(med_ratio)
         if name == "epsilon":
             headline_value = med_t
@@ -741,6 +782,17 @@ def run_parent() -> None:
         payload["note"] = skip_note
         if os.environ.get("BENCH_FALLBACK", "1") != "0":
             payload["fallback"] = run_fallback(names, deadline)
+    if trace_out:
+        with open(trace_out, "w") as f:
+            for name in names:
+                for rep, rec in enumerate(samples[name]):
+                    if rec.get("trace"):
+                        f.write(json.dumps({
+                            "config": name, "rep": rep,
+                            "updates_per_sec": rec.get("updates_per_sec"),
+                            "trace": rec["trace"],
+                        }) + "\n")
+        payload["trace_jsonl"] = trace_out
     emit(payload)
 
 

@@ -11,6 +11,13 @@ PS with an ElasticSupervisor (``PS_DEAD_AFTER_S`` tunes death detection);
 (instead of the modulo split); ``PS_EVAL=0`` disables the post-run
 snapshot evaluation (a worker destined to be SIGKILLed must not hold the
 eval slot); ``PS_NUM_ITER`` overrides the iteration budget.
+
+Trace-plane knobs (tests/test_trace.py): ``PS_EVENT_LOG=<path>`` attaches
+a ListenerBus + EventLogWriter to the PS (TraceSpan/GradientMerged events
+stream to JSONL); ``PS_UI=1`` also serves the live dashboard on an
+ephemeral port (printed as ``ui_port`` on the first stdout line).  Worker
+sampling itself is conf-driven: set ``ASYNCTPU_ASYNC_TRACE_SAMPLE`` in the
+child env.
 """
 
 import json
@@ -71,13 +78,35 @@ def main() -> None:
                 dead_after_s=float(os.environ.get("PS_DEAD_AFTER_S", "2.0")),
                 check_interval_s=0.2,
             )
+        bus = writer = ui = None
+        if os.environ.get("PS_EVENT_LOG") or os.environ.get("PS_UI") == "1":
+            from asyncframework_tpu.metrics.bus import ListenerBus
+            from asyncframework_tpu.metrics.eventlog import EventLogWriter
+
+            bus = ListenerBus()
+            if os.environ.get("PS_EVENT_LOG"):
+                writer = EventLogWriter(os.environ["PS_EVENT_LOG"])
+                bus.add_listener(writer)
+            if os.environ.get("PS_UI") == "1":
+                from asyncframework_tpu.metrics.live import (
+                    LiveStateListener,
+                    LiveUIServer,
+                )
+
+                state = LiveStateListener(NW)
+                bus.add_listener(state)
+                ui = LiveUIServer(state, port=0).start()
+            bus.start()
         ps = ps_dcn.ParameterServer(
             cfg, D, N, port=int(os.environ.get("PS_BIND_PORT", "0")),
             algo=algo,
             checkpoint_path=os.environ.get("PS_CHECKPOINT") or None,
-            supervisor=sup,
+            supervisor=sup, bus=bus,
         ).start()
-        print(json.dumps({"port": ps.port}), flush=True)
+        hello = {"port": ps.port}
+        if ui is not None:
+            hello["ui_port"] = ui.port
+        print(json.dumps(hello), flush=True)
         ok = ps.wait_done(timeout_s=120.0)
         total = ps.collect_eval(
             num_worker_procs=int(os.environ["PS_NUM_WORKER_PROCS"]),
@@ -95,10 +124,17 @@ def main() -> None:
                 str(w): c for w, c in ps.accepted_by_wid.items()
             },
             "recovery": sup.counters() if sup is not None else None,
+            "trace_spans": ps.trace_spans,
             "diagnostic": None if ok else str(ok),
             "trajectory": traj,
         }), flush=True)
         ps.stop()
+        if ui is not None:
+            ui.stop()
+        if bus is not None:
+            bus.stop()
+        if writer is not None:
+            writer.close()
     else:
         port = int(os.environ["PS_PORT"])
         pid = int(os.environ["PS_WORKER_ID"])
