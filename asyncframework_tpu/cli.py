@@ -67,6 +67,9 @@ CONF_TO_FIELD: Dict[str, str] = {
     "async.max.slot.failures": "max_slot_failures",
     "async.ui.port": "ui_port",
     "async.trace.sample": "trace_sample",
+    # DCN data-plane knobs (parallel/ps_dcn.py)
+    "async.pull.mode": "pull_mode",
+    "async.push.merge": "push_merge",
 }
 
 DRIVER_ALIASES: Dict[str, str] = {
@@ -480,6 +483,13 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
     pid = int(os.environ.get("ASYNCTPU_PROCESS_ID", "0"))
     if nproc < 2:
         raise SystemExit(f"DCN {algo} needs >= 2 processes (PS + workers)")
+
+    # version-gated delta pulls are ON by default for the multi-process
+    # cluster path (the wire is where they pay off; the equivalence suite
+    # in tests/test_dataplane.py guards byte-exactness) -- an explicit
+    # --conf async.pull.mode=full restores the legacy full-pull wire
+    if not conf.contains("async.pull.mode"):
+        conf.set("async.pull.mode", "delta")
 
     cfg = SolverConfig(
         num_workers=args.num_partitions,

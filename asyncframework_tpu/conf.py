@@ -229,6 +229,30 @@ NET_FAULT_SCHEDULE = ConfigEntry(
 NET_FAULT_SEED = ConfigEntry(
     "async.net.fault.seed", 0, int,
     "Seed chaos runs hand to retry policies so backoff walks replay.")
+# ------------------------------------------------------------- data plane
+# The DCN throughput knobs (net/wiredelta.py + parallel/ps_dcn.py): PULL
+# reply negotiation and the PS-side fused gradient apply.
+PULL_MODE = ConfigEntry(
+    "async.pull.mode", "full", str,
+    "PULL reply negotiation: 'full' ships the whole model every pull "
+    "(byte-identical legacy wire, the safe default); 'delta' sends "
+    "have=<ts> so the PS can answer NOT_MODIFIED (zero payload), a "
+    "byte-exact XOR sparse delta, or the full model -- whichever is "
+    "smallest.  Decode mismatch or cache miss falls back to a full pull.")
+PULL_DELTA_VERSIONS = ConfigEntry(
+    "async.pull.delta.versions", 4, int,
+    "Recent model versions the PS keeps host-side for delta encoding "
+    "(un-overridden, the PS auto-scales this to 4*num_workers+2 -- a "
+    "worker's basis is ~P versions old by its next pull); oldest "
+    "versions evict first, and the cache is only maintained once a "
+    "delta client shows up.  0 disables the cache: delta-mode pulls are "
+    "answered NOT_MODIFIED on an exact-version match (needs no cache) "
+    "or full otherwise.")
+PUSH_MERGE = ConfigEntry(
+    "async.push.merge", 8, int,
+    "Upper bound on PUSHes the PS coalesces into one fused device apply "
+    "when the model lock is contended (bit-identical to the serial apply "
+    "order; 1 = classic one-dispatch-per-push path).")
 # ------------------------------------------------------------ trace plane
 # Distributed tracing for the async update loop (metrics/trace.py): spans
 # are sampled per update lifecycle, propagated over the wire as an optional

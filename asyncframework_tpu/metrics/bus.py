@@ -112,6 +112,7 @@ class TraceSpan(Event):
     staleness: Optional[int] = None
     staleness_ms: Optional[float] = None
     accepted: Optional[bool] = None
+    bytes: Optional[int] = None  # wire bytes of the RPC the span covers
 
 
 EVENT_TYPES: Dict[str, Type[Event]] = {

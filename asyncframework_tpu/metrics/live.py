@@ -58,6 +58,12 @@ def _net_totals() -> Dict[str, int]:
     return net_totals()
 
 
+def _net_bytes_totals() -> Dict[str, int]:
+    from asyncframework_tpu.net import frame
+
+    return frame.bytes_totals()
+
+
 def _recovery_totals() -> Dict[str, int]:
     from asyncframework_tpu.parallel.supervisor import recovery_totals
 
@@ -111,6 +117,7 @@ class LiveStateListener(Listener):
         # second run's dashboard must not inherit the first run's counts
         self._base_shuffle = _shuffle_totals()
         self._base_net = _net_totals()
+        self._base_net_bytes = _net_bytes_totals()
         self._base_recovery = _recovery_totals()
 
     def register_queue_depth(self, fn: Callable[[], int]) -> None:
@@ -168,6 +175,7 @@ class LiveStateListener(Listener):
                     staleness=event.staleness,
                     staleness_ms=event.staleness_ms,
                     accepted=event.accepted,
+                    bytes=getattr(event, "bytes", None),
                 ))
 
     # ------------------------------------------------------------- snapshot
@@ -202,7 +210,12 @@ class LiveStateListener(Listener):
                 # DCN robustness counters (net/): retries taken, breaker
                 # trips, dedup hits, faults fired -- the failure-handling
                 # subsystem's health at a glance (per-run delta)
-                "net": _delta(_net_totals(), self._base_net),
+                "net": dict(
+                    _delta(_net_totals(), self._base_net),
+                    # wire-bytes accounting (net/frame.py choke point):
+                    # per-op sent/received frame bytes, per-run delta
+                    bytes=_delta(_net_bytes_totals(), self._base_net_bytes),
+                ),
                 # elastic-plane counters (parallel/supervisor.py): workers
                 # declared dead, shards adopted by survivors, rejoins,
                 # surrogate releases, PS checkpoint resumes (per-run delta)

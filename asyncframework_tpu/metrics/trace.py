@@ -109,12 +109,16 @@ class Span:
     staleness: Optional[int] = None
     staleness_ms: Optional[float] = None
     accepted: Optional[bool] = None
+    #: wire bytes of the RPC this span covers (pull.rtt/push.rtt: frame
+    #: bytes both directions, counted at the net/frame.py choke point) --
+    #: latency AND volume decompose per stage
+    bytes: Optional[int] = None
 
     # wire format: short keys, Nones omitted -- spans ride PUSH headers
     _WIRE = (("s", "stage"), ("t", "trace_id"), ("i", "span_id"),
              ("p", "parent_id"), ("w", "worker_id"), ("v", "model_version"),
              ("b", "start_ms"), ("d", "dur_ms"), ("st", "staleness"),
-             ("sm", "staleness_ms"), ("ac", "accepted"))
+             ("sm", "staleness_ms"), ("ac", "accepted"), ("by", "bytes"))
 
     def to_wire(self) -> dict:
         out = {}
@@ -353,6 +357,7 @@ class TraceAggregator:
         self._lock = threading.Lock()
         self._mk = lambda: Histogram(capacity)
         self._stages: Dict[str, "Histogram"] = {}
+        self._stage_bytes: Dict[str, "Histogram"] = {}
         self._staleness_v = self._mk()
         self._staleness_ms = self._mk()
         self.spans_total = 0
@@ -365,6 +370,11 @@ class TraceAggregator:
             if h is None:
                 h = self._stages[span.stage] = self._mk()
             h.update(span.dur_ms)
+            if span.bytes is not None:
+                hb = self._stage_bytes.get(span.stage)
+                if hb is None:
+                    hb = self._stage_bytes[span.stage] = self._mk()
+                hb.update(float(span.bytes))
             if span.staleness is not None:
                 self._staleness_v.update(float(span.staleness))
             if span.staleness_ms is not None:
@@ -394,17 +404,26 @@ class TraceAggregator:
             for name in self._stages:
                 if name not in stages:
                     stages[name] = self._stages[name].snapshot()
-            return {
+            out = {
                 "spans": self.spans_total,
                 "traces": len(self.traces_seen),
                 "stages_ms": stages,
                 "staleness_versions": self._staleness_v.snapshot(),
                 "staleness_ms": self._staleness_ms.snapshot(),
             }
+            if self._stage_bytes:
+                # wire-volume decomposition beside the latency one: rtt
+                # spans carry their RPC's frame bytes (net/frame.py)
+                out["stages_bytes"] = {
+                    name: h.snapshot()
+                    for name, h in self._stage_bytes.items()
+                }
+            return out
 
     def reset(self) -> None:
         with self._lock:
             self._stages.clear()
+            self._stage_bytes.clear()
             self._staleness_v = self._mk()
             self._staleness_ms = self._mk()
             self.spans_total = 0
@@ -440,7 +459,7 @@ def span_event(span: Span, time_ms: float) -> "object":
         worker_id=span.worker_id, model_version=span.model_version,
         start_ms=span.start_ms, dur_ms=span.dur_ms,
         staleness=span.staleness, staleness_ms=span.staleness_ms,
-        accepted=span.accepted,
+        accepted=span.accepted, bytes=span.bytes,
     )
 
 
@@ -509,10 +528,14 @@ def decomposition(spans) -> dict:
     """Per-stage latency stats + staleness distributions from TraceSpan
     events (the post-hoc analog of TraceAggregator.snapshot)."""
     by_stage: Dict[str, List[float]] = defaultdict(list)
+    by_bytes: Dict[str, List[float]] = defaultdict(list)
     stale_v: List[float] = []
     stale_ms: List[float] = []
     for sp in spans:
         by_stage[sp.stage].append(float(sp.dur_ms))
+        b = getattr(sp, "bytes", None)
+        if b is not None:
+            by_bytes[sp.stage].append(float(b))
         if sp.staleness is not None:
             stale_v.append(float(sp.staleness))
         if sp.staleness_ms is not None:
@@ -527,6 +550,12 @@ def decomposition(spans) -> dict:
     for st in by_stage:
         if st not in out["stages_ms"]:
             out["stages_ms"][st] = _stats(by_stage[st])
+    if by_bytes:
+        # wire-volume decomposition beside the latency one: rtt spans
+        # carry their RPC's frame bytes (net/frame.py choke point)
+        out["stages_bytes"] = {
+            st: _stats(v) for st, v in by_bytes.items()
+        }
     if stale_v:
         out["staleness_versions"] = _stats(stale_v)
     if stale_ms:

@@ -5,20 +5,39 @@ This is the framing that ``parallel/ps_dcn.py`` introduced and every other
 networked layer (the topic server, the standalone master/worker/client
 daemons) imported from it.  It now lives here so the robustness layer can
 wrap ONE choke point: every frame sent or received anywhere in the
-framework passes through :func:`send_msg` / :func:`recv_msg` /
-:func:`connect`, and each consults the process's active
+framework passes through :func:`send_msg` / :func:`send_msg_vectored` /
+:func:`recv_msg` / :func:`connect`, and each consults the process's active
 :class:`~asyncframework_tpu.net.faults.FaultInjector` (when installed) --
 the network-plane sibling of ``engine/straggler.py``'s compute delays.
 
-Frame layout (unchanged): ``!I``-prefixed JSON header line, then an
-``!I``-prefixed raw payload (possibly empty).  The header always carries
-``op``; mutating ops may carry ``sid``/``seq`` (see ``net/session.py``),
-and a frame sent while a trace context is installed on the calling thread
-(``metrics/trace.py``) carries it as an optional ``tc`` entry -- the wire
-propagation of distributed tracing, stamped here at the one choke point so
-every PULL/PUSH/PULL_SAGA/PUSH_SAGA, topic, and master op is covered.
-With tracing off nothing consults the clock and frames are byte-identical
-to the pre-trace wire.
+Frame layout (unchanged on the wire): ``!I``-prefixed JSON header line,
+then an ``!I``-prefixed raw payload (possibly empty).  The header always
+carries ``op``; mutating ops may carry ``sid``/``seq`` (see
+``net/session.py``), and a frame sent while a trace context is installed
+on the calling thread (``metrics/trace.py``) carries it as an optional
+``tc`` entry -- the wire propagation of distributed tracing, stamped here
+at the one choke point so every PULL/PUSH/PULL_SAGA/PUSH_SAGA, topic, and
+master op is covered.  With tracing off nothing consults the clock and
+frames are byte-identical to the pre-trace wire.
+
+Data-plane fast paths (the throughput overhaul):
+
+- :func:`send_msg_vectored` frames a payload given as a *sequence of
+  buffers* (``bytes``/``memoryview``/anything exporting the buffer
+  protocol) through ``socket.sendmsg`` -- the kernel gathers the iovec, so
+  a multi-megabyte model payload is never copied into a fresh frame
+  buffer.  The bytes on the wire are identical to
+  ``send_msg(sock, header, b"".join(parts))``.
+- :func:`recv_exact` fills ONE preallocated ``bytearray`` via
+  ``recv_into`` instead of accumulating per-``recv`` ``bytes`` chunks
+  (which allocated O(frames) intermediates for large payloads).
+
+Wire-bytes accounting: every frame sent or received here bumps a per-op
+byte counter (frame bytes: both length prefixes + header + payload).
+``bytes_totals()`` exposes them (live UI ``net.bytes`` section,
+``bench.py`` bytes-per-update); ``metrics.reset_totals()`` zeroes them via
+``net.reset_net_totals``.  The per-thread ``last_io_bytes()`` value lets a
+client attach this RPC's wire cost to its pull.rtt/push.rtt trace span.
 """
 
 from __future__ import annotations
@@ -26,12 +45,53 @@ from __future__ import annotations
 import json
 import socket
 import struct
-from typing import Optional, Tuple
+import threading
+from typing import Dict, Optional, Sequence, Tuple
 
 from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import faults
 
 _HDR = struct.Struct("!I")  # 4-byte big-endian frame length
+
+# ------------------------------------------------------------ wire bytes
+# Per-op frame byte counters (process-global, lock-guarded like every other
+# net counter).  Keyed "sent.<OP>" / "recv.<OP>" so the live UI's _delta
+# machinery (flat int dicts) applies unchanged.
+_bytes_lock = threading.Lock()
+_bytes_totals: Dict[str, int] = {}
+
+# Per-thread bytes of the last send/recv on this thread: a client sums the
+# two right after an RPC to stamp its rtt span with the wire cost.
+_io_tls = threading.local()
+
+
+def _count(direction: str, op: str, n: int) -> None:
+    key = f"{direction}.{op or '?'}"
+    with _bytes_lock:
+        _bytes_totals[key] = _bytes_totals.get(key, 0) + n
+        _bytes_totals[direction] = _bytes_totals.get(direction, 0) + n
+
+
+def bytes_totals() -> Dict[str, int]:
+    """Process-wide wire-byte counters: ``sent``/``recv`` grand totals plus
+    ``sent.<OP>`` / ``recv.<OP>`` per-op breakdowns (frame bytes, i.e.
+    prefixes + header + payload)."""
+    with _bytes_lock:
+        return dict(_bytes_totals)
+
+
+def reset_bytes_totals() -> None:
+    """Zero the wire-byte counters (per-run isolation; called from
+    ``net.reset_net_totals`` -> ``metrics.reset_totals``)."""
+    with _bytes_lock:
+        _bytes_totals.clear()
+
+
+def last_io_bytes() -> int:
+    """Frame bytes of this thread's most recent send plus most recent
+    receive -- the wire cost of the RPC that just completed."""
+    return (getattr(_io_tls, "sent", 0) or 0) + (getattr(_io_tls, "recv", 0)
+                                                 or 0)
 
 
 def endpoint_of(sock: socket.socket) -> str:
@@ -54,17 +114,51 @@ def connect(addr: Tuple[str, int], timeout: Optional[float] = 10.0
     return socket.create_connection(addr, timeout=timeout)
 
 
-def send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+def _stamped(header: dict) -> dict:
     tc = _trace.wire_header()
     if tc is not None and "tc" not in header:
         # copy, never mutate: retries re-send the caller's header verbatim
         # (dedup stamps), and the ambient context at retry time still wins
         header = dict(header, tc=tc)
+    return header
+
+
+_HAVE_SENDMSG = hasattr(socket.socket, "sendmsg")
+
+
+def _sendmsg_all(sock: socket.socket, parts) -> None:
+    """Gather-send every buffer in ``parts`` (memoryviews), handling short
+    writes by advancing the iovec -- the vectored analog of ``sendall``."""
+    views = [memoryview(p).cast("B") for p in parts if len(p)]
+    while views:
+        sent = sock.sendmsg(views)
+        # advance past fully-sent buffers, slice the partial one
+        while sent > 0 and views:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
+def _send_frame(sock: socket.socket, header: dict, parts: Sequence) -> None:
+    """Shared core of :func:`send_msg` / :func:`send_msg_vectored`: tc
+    stamping, fault injection, byte accounting, then the wire write --
+    vectored (zero-copy gather) when the platform has ``sendmsg`` and no
+    injector needs to see a contiguous frame."""
+    header = _stamped(header)
     head = json.dumps(header).encode()
-    data = _HDR.pack(len(head)) + head + _HDR.pack(len(payload)) + payload
+    plen = sum(len(p) for p in parts)
+    op = str(header.get("op", ""))
+    total = 2 * _HDR.size + len(head) + plen
     inj = faults.active()
     if inj is not None:
-        kind = inj.check_send(endpoint_of(sock), str(header.get("op", "")))
+        # chaos path: materialize the frame so mid-frame cuts slice the
+        # exact same byte stream the plain path would have sent
+        data = (_HDR.pack(len(head)) + head + _HDR.pack(plen)
+                + b"".join(bytes(memoryview(p)) for p in parts))
+        kind = inj.check_send(endpoint_of(sock), op)
         if kind == faults.CUT_MID_FRAME:
             # a prefix of the frame goes out, then the connection dies: the
             # peer sees a short frame + EOF, the sender sees a reset.  The
@@ -84,17 +178,51 @@ def send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
             # stale armed entry could fire on an unrelated future socket
             sock.sendall(data)
             inj.arm(sock, kind)
+            _io_tls.sent = total
+            _count("sent", op, total)
             return
-    sock.sendall(data)
+        sock.sendall(data)
+        _io_tls.sent = total
+        _count("sent", op, total)
+        return
+    prefix = _HDR.pack(len(head)) + head + _HDR.pack(plen)
+    if not plen:
+        sock.sendall(prefix)
+    elif _HAVE_SENDMSG:
+        _sendmsg_all(sock, [prefix, *parts])
+    else:  # pragma: no cover - platforms without sendmsg
+        sock.sendall(prefix + b"".join(bytes(memoryview(p)) for p in parts))
+    _io_tls.sent = total
+    _count("sent", op, total)
+
+
+def send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    _send_frame(sock, header, (payload,) if payload else ())
+
+
+def send_msg_vectored(sock: socket.socket, header: dict,
+                      parts: Sequence) -> None:
+    """Frame ``parts`` (a sequence of buffer-protocol objects) as ONE
+    payload without concatenating them: the kernel gathers the iovec via
+    ``socket.sendmsg``.  Byte-identical on the wire to
+    ``send_msg(sock, header, b"".join(parts))``; same fault-injection and
+    trace-stamping semantics (the choke point is shared)."""
+    _send_frame(sock, header, tuple(parts))
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    """Read exactly ``n`` bytes into one preallocated buffer
+    (``recv_into`` loop -- no per-chunk intermediate ``bytes``)."""
+    if n == 0:
+        return b""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed")
-        buf.extend(chunk)
+        got += r
     return bytes(buf)
 
 
@@ -103,6 +231,9 @@ def _recv_msg_raw(sock: socket.socket) -> Tuple[dict, bytes]:
     header = json.loads(recv_exact(sock, hlen))
     (plen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
     payload = recv_exact(sock, plen) if plen else b""
+    total = 2 * _HDR.size + hlen + plen
+    _io_tls.recv = total
+    _count("recv", str(header.get("op", "")), total)
     return header, payload
 
 

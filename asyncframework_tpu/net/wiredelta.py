@@ -1,0 +1,113 @@
+"""Byte-exact model-delta codec for version-gated PULL replies.
+
+ASAP (arXiv:1612.08608) showed async data-parallel systems win by sending
+deltas instead of full state; the asynchronous-SGD transfer-volume line of
+work (arXiv:1505.04956) identifies parameter bytes as the dominant DCN cost
+at scale.  The blocker for deltas in a *correctness-first* PS protocol is
+float arithmetic: ``basis + (current - basis)`` is NOT bit-equal to
+``current`` in IEEE-754, and a worker whose reconstructed model drifts by
+even one ulp is silently training against a model the PS never held.
+
+This codec sidesteps arithmetic entirely: the delta is the **XOR of the
+raw float32 bit patterns** (viewed as ``uint32``).  XOR is exact, so
+``basis_bits ^ delta_bits == current_bits`` byte-for-byte, and entries the
+update never touched XOR to zero -- the delta of a model that changed in
+few coordinates is naturally sparse.  Encoding picks the smallest wire
+form:
+
+- ``nm``     -- basis bytes == current bytes: header-only NOT_MODIFIED.
+- ``xdelta`` -- ``(idx u32, xorword u32)`` pairs for the changed entries,
+  chosen when ``nnz * 8 < d * 4``.
+- ``full``   -- the raw float32 payload (the delta would not be smaller,
+  or the server no longer caches the basis).
+
+Every non-full reply carries the CRC32 of the *current* model bytes; the
+decoder recomputes (or, for ``nm``, compares its cached basis CRC) and
+signals mismatch so the client can fall back to a full pull -- a delta
+path can degrade to the legacy wire, never to a wrong model.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+#: wire-encoding tags carried in the MODEL header's ``wenc`` field
+FULL = "full"
+NOT_MODIFIED = "nm"
+XDELTA = "xdelta"
+
+
+def crc(model_buf) -> int:
+    """CRC32 of a model payload (the integrity check on every delta/NM
+    reply).  Accepts any buffer-protocol object -- pass the contiguous
+    float32 array itself, no ``tobytes`` copy needed.  ~GB/s on commodity
+    hosts: microseconds at DCN model sizes."""
+    return zlib.crc32(model_buf) & 0xFFFFFFFF
+
+
+def encode(cur: np.ndarray, basis: Optional[np.ndarray],
+           cur_bytes: Optional[bytes] = None) -> Tuple[str, bytes, int]:
+    """Encode ``cur`` (float32) against ``basis`` (float32 or None).
+
+    Returns ``(wenc, payload, nnz)``: the chosen wire form, its model-part
+    payload bytes, and the changed-entry count (0 for ``nm``/``full``).
+    ``cur_bytes`` lets a caller with an already-serialized current model
+    (the PS's per-version encoded cache) avoid a redundant ``tobytes``.
+    """
+    def full() -> Tuple[str, bytes, int]:
+        return FULL, (cur_bytes if cur_bytes is not None
+                      else cur.tobytes()), 0
+
+    if basis is None or basis.shape != cur.shape:
+        return full()
+    cur_bits = cur.view(np.uint32)
+    xor = cur_bits ^ basis.view(np.uint32)
+    (nz,) = np.nonzero(xor)
+    if nz.size == 0:
+        return NOT_MODIFIED, b"", 0
+    if nz.size * 8 < cur.nbytes:
+        payload = (nz.astype(np.uint32).tobytes()
+                   + np.ascontiguousarray(xor[nz]).tobytes())
+        return XDELTA, payload, int(nz.size)
+    return full()
+
+
+def decode(wenc: str, payload, nnz: int, basis: Optional[np.ndarray],
+           want_crc: Optional[int], basis_crc: Optional[int] = None
+           ) -> Optional[np.ndarray]:
+    """Reconstruct the current model (float32) from a delta-form reply.
+
+    ``basis`` is the client's cached basis array; ``want_crc`` the CRC the
+    server stamped for the current version; ``basis_crc`` the client's
+    cached CRC of its basis bytes (lets ``nm`` validate in O(1)).
+
+    Returns the reconstructed array, or **None** on any mismatch -- cache
+    miss, shape drift, CRC disagreement -- in which case the caller MUST
+    fall back to a full pull.  Never returns a model that failed its CRC.
+    """
+    if wenc == FULL:
+        return np.frombuffer(payload, np.float32)
+    if basis is None:
+        return None
+    if wenc == NOT_MODIFIED:
+        if want_crc is None:
+            return None
+        have = basis_crc if basis_crc is not None else crc(basis)
+        return basis if have == want_crc else None
+    if wenc != XDELTA:
+        return None
+    if len(payload) != 8 * nnz or nnz <= 0:
+        return None
+    idx = np.frombuffer(payload[: 4 * nnz], np.uint32)
+    xwords = np.frombuffer(payload[4 * nnz:], np.uint32)
+    if idx.size and int(idx.max()) >= basis.size:
+        return None
+    bits = basis.view(np.uint32).copy()
+    bits[idx] ^= xwords
+    out = bits.view(np.float32)
+    if want_crc is None or crc(out) != want_crc:
+        return None
+    return out

@@ -233,6 +233,66 @@ def make_asgd_apply_batch(
     return apply_batch
 
 
+def make_asgd_apply_merge(
+    gamma: float, batch_rate: float, n: int, num_workers: int
+):
+    """jit (w, G (m, d), mask (m,), k) -> (w', k') -- ``m`` coalesced PUSH
+    gradients applied in ONE device dispatch, **bit-identical** to running
+    :func:`make_asgd_apply` serially over the masked slots.
+
+    Unlike :func:`make_asgd_apply_batch` (the in-process updater's masked
+    weighted sum, exact only up to float addition order), this folds the
+    slots through a ``lax.scan`` whose body is the serial apply expression
+    verbatim -- same per-element operation sequence, so the DCN merge
+    queue's fused apply can be asserted equal to the serial path bit for
+    bit.  One compile per (m, d) shape; the PS pads short batches to its
+    merge bound so only one shape ever exists.
+    """
+    par_recs = batch_rate * n / num_workers
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def apply_merge(w, G, mask, k):
+        def body(carry, xs):
+            w, k = carry
+            g, a = xs
+            lr = gamma / jnp.sqrt(k / num_workers + 1.0)
+            w2 = w - (lr / par_recs) * g
+            keep = a > 0
+            return (jnp.where(keep, w2, w), jnp.where(keep, k + 1.0, k)), None
+
+        (w, k), _ = jax.lax.scan(body, (w, k), (G, mask))
+        return w, k
+
+    return apply_merge
+
+
+def make_saga_apply_merge(
+    gamma: float, batch_rate: float, n: int, num_workers: int
+):
+    """jit (w, alpha_bar, G (m, d), mask (m,)) -> (w', alpha_bar') -- the
+    ASAGA face of the merge-queue fused apply (``delta == g`` over DCN,
+    see ``ParameterServer.__init__``), scanning the serial
+    :func:`make_saga_apply` expression over the masked slots so the fused
+    result is bit-identical to the one-dispatch-per-push path.
+    """
+    par_recs = batch_rate * n / num_workers
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def apply_merge(w, alpha_bar, G, mask):
+        def body(carry, xs):
+            w, ab = carry
+            g, a = xs
+            w2 = w - (gamma / par_recs) * g - gamma * ab
+            ab2 = ab + g / n
+            keep = a > 0
+            return (jnp.where(keep, w2, w), jnp.where(keep, ab2, ab)), None
+
+        (w, alpha_bar), _ = jax.lax.scan(body, (w, alpha_bar), (G, mask))
+        return w, alpha_bar
+
+    return apply_merge
+
+
 # ------------------------------------------------------------------ sparse
 def sparse_step_capacity(batch_rate: float, n_rows: int) -> int:
     """Static slot count for the compacted sparse step: E[count] + 6 sigma

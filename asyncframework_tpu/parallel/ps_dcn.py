@@ -52,6 +52,29 @@ Extensions past the ASGD-dense core:
   ``(idx u32, val f32)`` pairs when that beats the dense ``d*4`` bytes; the
   PS scatters into dense before its (dense) apply.  Workers decide per push
   -- a near-dense gradient goes dense.
+
+Data-plane throughput overhaul (version-cached replies, delta pulls,
+vectored framing, batched apply):
+
+- **Version-cached encoded replies**: the PS serializes the model ONCE per
+  version (host array + payload bytes + CRC); an entire cohort pull of an
+  unchanged version is a dict lookup plus a vectored socket write (the
+  backing array is float32 -- the old per-pull ``astype(...).tobytes()``
+  copy is gone).
+- **Version-gated delta pulls** (``async.pull.mode=delta``): workers send
+  ``have=<ts>``; the PS answers NOT_MODIFIED (zero model payload -- common
+  under wave gating and straggler re-pulls), a byte-exact XOR sparse delta
+  against a recent cached version (``net/wiredelta.py``,
+  ``async.pull.delta.versions``), or the full model, whichever is
+  smallest.  Every non-full reply carries the current version's CRC32; a
+  client-side mismatch or basis-cache miss falls back to a full pull --
+  the path can degrade to the legacy wire, never to a wrong model.  A
+  pull WITHOUT ``have`` gets the legacy reply, byte-identical.
+- **Batched gradient apply** (``async.push.merge``): pushes pending at
+  model-lock acquisition coalesce into ONE fused device apply
+  (``ops/steps.make_*_apply_merge`` -- a ``lax.scan`` over the serial
+  apply expression, bit-identical to one-dispatch-per-push), with
+  per-push accept/reject, dedup, and trace spans preserved per item.
 """
 
 from __future__ import annotations
@@ -69,6 +92,7 @@ import numpy as np
 from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import ClientSession, DedupWindow, RetryPolicy
 from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.net import wiredelta
 from asyncframework_tpu.parallel import supervisor as supervisor_mod
 from asyncframework_tpu.parallel.supervisor import ElasticSupervisor
 
@@ -103,6 +127,38 @@ class WaitDone:
 
     def __str__(self) -> str:
         return "done" if self.done else (self.diagnostic or "not done")
+
+
+class _PendingPush:
+    """One decoded PUSH waiting in the PS merge queue.
+
+    The handler thread decodes the payload OUTSIDE the model lock, enqueues
+    this record, and whoever holds the lock next drains every pending push
+    into one fused device apply (``_drain_merge_locked``) -- per-push
+    accept/reject, dedup, calibration, and trace bookkeeping all happen
+    per item in FIFO order, exactly as the serial path did; only the
+    device dispatch is coalesced."""
+
+    __slots__ = ("wid", "ts", "g_host", "diff", "header", "payload_len",
+                 "tc", "t_queue0", "done", "ack", "accepted", "staleness",
+                 "task_ms", "t_apply0", "t_done", "k_at_merge",
+                 "do_snapshot")
+
+    def __init__(self, wid: int, ts: int, g_host, diff, header: dict,
+                 payload_len: int, tc, t_queue0: float):
+        self.wid, self.ts = wid, ts
+        self.g_host, self.diff = g_host, diff
+        self.header, self.payload_len = header, payload_len
+        self.tc, self.t_queue0 = tc, t_queue0
+        self.done = False
+        self.ack: dict = {}
+        self.accepted = False
+        self.staleness = 0
+        self.task_ms = 0.0
+        self.t_apply0 = 0.0
+        self.t_done = 0.0
+        self.k_at_merge = 0
+        self.do_snapshot = False
 
 
 # ----------------------------------------------------------------- PS side
@@ -172,7 +228,89 @@ class ParameterServer:
             self._apply(zw, zg, zk)
 
         self._lock = threading.Lock()
+        # ---- data plane: version-cached encoded PULL replies + deltas.
+        # One readback AND one encode per model version: _w_host is the
+        # host float32 array (the backing device array is already float32,
+        # so no astype copy), _w_wire its serialized payload bytes, _w_crc
+        # the integrity stamp delta/NOT_MODIFIED replies carry.  A whole
+        # cohort pull of an unchanged version is a dict lookup + a socket
+        # write.  _w_versions keeps recent versions' host arrays (bounded,
+        # version-age eviction) so a worker pulling with ``have=<ts>`` can
+        # be served a byte-exact XOR delta (net/wiredelta.py).
         self._w_host: Optional[np.ndarray] = None  # host cache per version
+        self._w_wire: Optional[bytes] = None       # encoded payload cache
+        self._w_crc = 0
+        from collections import OrderedDict as _OD2
+        from asyncframework_tpu.conf import (
+            PULL_DELTA_VERSIONS,
+            PUSH_MERGE,
+            global_conf as _gconf,
+        )
+
+        self._w_versions: "_OD2[int, np.ndarray]" = _OD2()
+        # an un-overridden cache depth auto-scales with the worker count: a
+        # worker's basis is typically ~P versions old by its next pull (P
+        # peers each merged once in between, plus clock ticks from drops),
+        # so a cache shallower than that never hits.  Cost is host RAM
+        # only: depth * d * 4 bytes of version arrays.
+        if _gconf().contains(PULL_DELTA_VERSIONS.key):
+            self._delta_versions = max(
+                0, int(_gconf().get(PULL_DELTA_VERSIONS))
+            )
+        else:
+            self._delta_versions = max(
+                int(_gconf().get(PULL_DELTA_VERSIONS)),
+                4 * cfg.num_workers + 2,
+            )
+        # the version cache is only maintained once a delta-capable client
+        # shows up (first pull carrying ``have``): a full-mode deployment
+        # pays zero cache RAM and zero per-pull cache work
+        self._delta_clients_seen = False
+        # pull-reply shape counters (bench/tests: the "zero payload bytes
+        # per unchanged-version pull" claim is read off these)
+        self.pull_replies: Dict[str, int] = {"full": 0, "nm": 0,
+                                             "xdelta": 0}
+        self.pull_model_bytes = 0  # model-part payload bytes sent via PULL
+        # ---- data plane: batched gradient apply (merge queue).  All
+        # pushes pending at lock acquisition coalesce into ONE fused
+        # device apply (ops/steps.make_*_apply_merge -- bit-identical to
+        # the serial order); per-push semantics stay per item.
+        merge = getattr(cfg, "push_merge", None)
+        self._merge_max = max(1, int(merge if merge is not None
+                                     else _gconf().get(PUSH_MERGE)))
+        from collections import deque as _deque
+
+        self._merge_q: "_deque[_PendingPush]" = _deque()
+        self._apply_merge = None
+        # drain-time scratch (single writer under _lock; device_put copies
+        # host->device eagerly, so reusing the buffers across drains is
+        # safe and keeps the lock hold free of O(m*d) allocations)
+        self._merge_G: Optional[np.ndarray] = None
+        self._merge_mask: Optional[np.ndarray] = None
+        if self._merge_max > 1:
+            self._merge_G = np.empty((self._merge_max, d), np.float32)
+            self._merge_mask = np.empty(self._merge_max, np.float32)
+            zG = jax.device_put(
+                jnp.zeros((self._merge_max, d), jnp.float32), self.device
+            )
+            zm = jax.device_put(
+                jnp.zeros(self._merge_max, jnp.float32), self.device
+            )
+            if algo == "asaga":
+                self._apply_merge = steps.make_saga_apply_merge(
+                    cfg.gamma, cfg.batch_rate, n, cfg.num_workers
+                )
+                zab2 = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
+                self._apply_merge(zw, zab2, zG, zm)
+            else:
+                self._apply_merge = steps.make_asgd_apply_merge(
+                    cfg.gamma, cfg.batch_rate, n, cfg.num_workers
+                )
+                zk2 = jax.device_put(jnp.float32(0.0), self.device)
+                self._apply_merge(zw, zG, zm, zk2)
+        self.merge_batches = 0    # fused drains that applied >= 1 push
+        self.merge_merged = 0     # pushes applied through fused drains
+        self.merge_batch_max = 0  # largest single fused batch
         self._clock = 0          # merged gradients (ASYNCcontext.CurrentTime)
         self._k = 0              # accepted updates
         self.accepted = 0
@@ -364,6 +502,8 @@ class ParameterServer:
                 )
             self._w = jax.device_put(z["w"], self.device)
             self._w_host = None
+            self._w_wire = None
+            self._w_versions.clear()
             self._clock = int(meta["clock"])
             self._k = int(meta["k"])
             self.accepted = int(meta["accepted"])
@@ -627,13 +767,36 @@ class ParameterServer:
                 self._pending_idx[wid] = idx.astype(np.int64)
             extra_hdr = {"cap": cap, "n_valid": int(idx.size)}
             extra_payload = idx_pad.tobytes() + alpha_sel.tobytes()
+        have = header.get("have")
         with self._lock:
             ts = self._clock
-            # one readback per model VERSION, not per pull: a whole cohort
-            # reads the same bytes
+            # one readback AND one encode per model VERSION, not per pull:
+            # a whole cohort reads the same cached bytes.  The backing
+            # device array is float32 -- no astype copy on this path.
             if self._w_host is None:
                 self._w_host = np.asarray(self._w)
-            w_host = self._w_host
+                self._w_wire = self._w_host.tobytes()
+                self._w_crc = wiredelta.crc(self._w_wire)
+            w_host, w_wire, w_crc = self._w_host, self._w_wire, self._w_crc
+            basis = None
+            if have is not None:
+                self._delta_clients_seen = True
+            if self._delta_versions > 0 and self._delta_clients_seen:
+                # recent-version cache for delta encoding, maintained only
+                # once a delta client exists; eviction is by version age
+                # (oldest ts first)
+                self._w_versions[ts] = w_host
+                self._w_versions.move_to_end(ts)
+                while len(self._w_versions) > self._delta_versions:
+                    self._w_versions.popitem(last=False)
+            if have is not None:
+                if int(have) == ts:
+                    # exact-version match needs no cache: the basis IS the
+                    # current version, so this encodes to NOT_MODIFIED
+                    # (the reply CRC still guards a cross-PS-life clash)
+                    basis = w_host
+                elif self._delta_versions > 0:
+                    basis = self._w_versions.get(int(have))
             self._pull_times[wid] = self._now_ms()
             avg = self.avg_delay_ms
         if tc is not None:
@@ -653,19 +816,44 @@ class ParameterServer:
             orders = sup.orders_for(proc)
             if orders:
                 extra_hdr["adopt"] = orders
-        _send_msg(
+        # PULL negotiation (have -> NOT_MODIFIED | XDELTA | FULL): a pull
+        # WITHOUT ``have`` gets the legacy full reply, byte-identical to
+        # the pre-delta wire.  Encoding happens OUTSIDE the lock (the O(d)
+        # xor must not queue the apply path); the version caches pinned
+        # every array/bytes object we need above.
+        model_hdr: dict = {}
+        model_part: bytes = w_wire
+        if have is not None:
+            wenc, enc_payload, nnz = wiredelta.encode(
+                w_host, basis, cur_bytes=w_wire
+            )
+            model_hdr = {"wenc": wenc, "crc": w_crc}
+            if wenc == wiredelta.XDELTA:
+                model_hdr["nnz"] = nnz
+            model_part = enc_payload
+            model_hdr["wlen"] = len(model_part)
+            with self._lock:
+                self.pull_replies[wenc] = self.pull_replies.get(wenc, 0) + 1
+                self.pull_model_bytes += len(model_part)
+        else:
+            with self._lock:
+                self.pull_replies["full"] += 1
+                self.pull_model_bytes += len(model_part)
+        # vectored zero-copy framing: the cached model bytes and the ASAGA
+        # extra payload go out as one kernel-gathered iovec -- the payload
+        # is never copied into a fresh frame buffer
+        _frame.send_msg_vectored(
             conn,
             {"op": "MODEL", "ts": ts, "avg_delay_ms": avg,
              "calibrated":
                  self._cal_n >= self.cfg.effective_calibration_iters(),
-             **extra_hdr},
-            w_host.astype(np.float32).tobytes() + extra_payload,
+             **model_hdr, **extra_hdr},
+            (model_part, extra_payload) if extra_payload
+            else (model_part,),
         )
 
     def _handle_push(self, conn: socket.socket, header: dict,
                      payload: bytes) -> None:
-        import jax
-
         wid = int(header["wid"])
         ts = int(header["ts"])
         proc = header.get("proc")
@@ -707,28 +895,95 @@ class ParameterServer:
                 g_host, diff = raw[: self.d], raw[self.d:]
             else:
                 g_host = raw
-        do_snapshot = False
+        # merge queue: the payload was decoded OUTSIDE the lock; whoever
+        # holds the model lock next coalesces every pending push into one
+        # fused device apply.  Per-push accept/reject, dedup, clock, and
+        # calibration bookkeeping stay per item (FIFO), exactly as the
+        # serial path ordered them -- only the device dispatch is batched.
+        item = _PendingPush(wid, ts, g_host, diff, header, len(payload),
+                            tc, t_queue0)
+        self._merge_q.append(item)
         with self._lock:
-            # merge.queue: decode + wait for the single-writer model lock
-            t_apply0 = _trace.now_ms() if tc is not None else 0.0
-            self.push_bytes += len(payload)
+            while not item.done:
+                self._drain_merge_locked()
+        if tc is not None:
+            # staleness in TIME (ASAP's quantity): age of the model basis
+            # this gradient was computed on = now - that version's pull.
+            # merge.queue covers decode + wait for the single-writer model
+            # lock; merge.apply covers the drain this push rode (tau
+            # filter + fused apply dispatch) under the lock.
+            self._fold_span(_trace.Span(
+                stage=_trace.MERGE_QUEUE, trace_id=tc.trace_id,
+                span_id=_trace._new_id(8), parent_id=tc.span_id,
+                worker_id=wid, model_version=ts, start_ms=t_queue0,
+                dur_ms=max(0.0, item.t_apply0 - t_queue0),
+            ))
+            self._fold_span(_trace.Span(
+                stage=_trace.MERGE_APPLY, trace_id=tc.trace_id,
+                span_id=_trace._new_id(8), parent_id=tc.span_id,
+                worker_id=wid, model_version=ts, start_ms=item.t_apply0,
+                dur_ms=max(0.0, item.t_done - item.t_apply0),
+                staleness=int(item.staleness),
+                staleness_ms=float(item.task_ms),
+                accepted=bool(item.accepted),
+            ))
+        if self.bus is not None:
+            from asyncframework_tpu.metrics.bus import GradientMerged
+
+            self.bus.post(GradientMerged(
+                self._bus_time_ms(), worker_id=wid,
+                staleness=int(item.staleness),
+                accepted=bool(item.accepted),
+                iteration=item.k_at_merge,
+            ))
+        with self._wave_cv:
+            self._wave_cv.notify_all()  # a wave may now meet its threshold
+        _send_msg(conn, item.ack)
+        if item.do_snapshot:
+            # printer_freq cadence: signal the async checkpoint thread --
+            # nobody's next message waits behind the disk write
+            self._ckpt_trigger.set()
+
+    def _drain_merge_locked(self) -> None:
+        """Caller holds ``_lock``.  Drain up to ``_merge_max`` pending
+        pushes in FIFO order -- per-push accept/reject, dedup, clock, and
+        calibration bookkeeping identical to the serial path -- then run
+        ONE fused device apply for all accepted gradients
+        (``ops/steps.make_*_apply_merge``, bit-identical to the serial
+        apply order).  A push landing on the printer_freq snapshot
+        boundary closes its batch so the host copy below pins exactly
+        that version."""
+        import jax
+
+        drained: List[_PendingPush] = []
+        batch: List[Tuple[_PendingPush, Optional[np.ndarray]]] = []
+        while self._merge_q and len(drained) < self._merge_max:
+            item = self._merge_q.popleft()
+            drained.append(item)
+            item.t_apply0 = _trace.now_ms() if item.tc is not None else 0.0
+            self.push_bytes += item.payload_len
             if self._t0 is not None:
-                self._last_contact[wid] = self._now_ms()
-            self.pushes_by_wid[wid] = self.pushes_by_wid.get(wid, 0) + 1
-            staleness = self._clock - ts
+                self._last_contact[item.wid] = self._now_ms()
+            self.pushes_by_wid[item.wid] = (
+                self.pushes_by_wid.get(item.wid, 0) + 1
+            )
+            staleness = self._clock - item.ts
             self.max_staleness = max(self.max_staleness, staleness)
-            task_ms = self._now_ms() - self._pull_times.get(wid, self._now_ms())
+            task_ms = self._now_ms() - self._pull_times.get(
+                item.wid, self._now_ms()
+            )
             if self._cal_n < self.cfg.effective_calibration_iters():
                 self._cal_ms += task_ms
                 self._cal_n += 1
                 if self._cal_n >= self.cfg.effective_calibration_iters():
                     self.avg_delay_ms = self._cal_ms / max(self._cal_n, 1)
+            idx = None
             if self.algo == "asaga":
                 # ASAGA's filter quirk: accept iff k - staleness <= taw
                 # (SparkASAGAThread.scala:184; the ASGD driver tests
                 # staleness <= taw).  A push whose pull-time sample the PS
                 # no longer holds (restart) cannot commit -- drop it.
-                idx = self._pending_idx.pop(wid, None)
+                idx = self._pending_idx.pop(item.wid, None)
                 accepted = (
                     self._k - staleness <= self.cfg.taw
                     and self._k < self.cfg.num_iterations
@@ -740,88 +995,105 @@ class ParameterServer:
                     and self._k < self.cfg.num_iterations
                 )
             if accepted:
-                g_dev = jax.device_put(g_host, self.device)
-                if self.algo == "asaga":
-                    # three-term update + alpha_bar advance (delta == g is
-                    # exact over DCN; see __init__); then the ScalarMap
-                    # merge -- commit this push's candidate scalars
-                    self._w, self._ab = self._apply(
-                        self._w, self._ab, g_dev, g_dev
-                    )
-                    with self._saga_lock:  # vs checkpoint table copies
-                        self._table[wid][idx] = diff[: idx.size]
-                else:
-                    self._w, self._k_dev = self._apply(
-                        self._w, g_dev, self._k_dev
-                    )
-                self._w_host = None  # new version; next pull re-materializes
+                batch.append((item, idx))
                 self._k += 1
                 self.accepted += 1
-                self.accepted_by_wid[wid] = (
-                    self.accepted_by_wid.get(wid, 0) + 1
+                self.accepted_by_wid[item.wid] = (
+                    self.accepted_by_wid.get(item.wid, 0) + 1
                 )
                 if self._k % self.cfg.printer_freq == 0:
-                    do_snapshot = True
+                    item.do_snapshot = True
                 if self._k >= self.cfg.num_iterations:
                     self._done.set()
-                    if sup is not None:
+                    if self.supervisor is not None:
                         # run complete: pin membership -- post-done silence
                         # (evaluation, teardown) is not death
-                        sup.freeze()
+                        self.supervisor.freeze()
             else:
                 self.dropped += 1
             self._clock += 1
-            if do_snapshot:
-                # host copy NOW: the snapshot must pin this version (the PS
-                # has no immutable-handle trick across the wire anyway)
-                self._snapshots.append((self._now_ms(), np.asarray(self._w)))
+            item.staleness = staleness
+            item.task_ms = task_ms
+            item.accepted = accepted
+            item.k_at_merge = self._k
             ack = {"op": "ACK", "accepted": bool(accepted),
                    "done": self._done.is_set()}
-            # record INSIDE the lock, before sending: (1) a retry after a
+            # record INSIDE the lock, before any send: (1) a retry after a
             # lost ACK must find the (sid, seq) applied; (2) the checkpoint
             # writer serializes state under this same lock, so a saved
             # model can never be missing the dedup entry of a push it
             # already contains (that gap would re-apply the push after a
             # restart)
-            self._dedup.record(header, ack)
-            k_at_merge = self._k  # for the bus event: the clock THIS
-            # push's accept/drop was judged against, captured under the
-            # same lock (a later push may advance _k before we post)
-        if tc is not None:
-            # staleness in TIME (ASAP's quantity): age of the model basis
-            # this gradient was computed on = now - that version's pull.
-            # merge.queue covers decode+lock wait; merge.apply covers the
-            # tau filter + apply dispatch under the lock.
-            t_done = _trace.now_ms()
-            self._fold_span(_trace.Span(
-                stage=_trace.MERGE_QUEUE, trace_id=tc.trace_id,
-                span_id=_trace._new_id(8), parent_id=tc.span_id,
-                worker_id=wid, model_version=ts, start_ms=t_queue0,
-                dur_ms=max(0.0, t_apply0 - t_queue0),
-            ))
-            self._fold_span(_trace.Span(
-                stage=_trace.MERGE_APPLY, trace_id=tc.trace_id,
-                span_id=_trace._new_id(8), parent_id=tc.span_id,
-                worker_id=wid, model_version=ts, start_ms=t_apply0,
-                dur_ms=max(0.0, t_done - t_apply0),
-                staleness=int(staleness), staleness_ms=float(task_ms),
-                accepted=bool(accepted),
-            ))
-        if self.bus is not None:
-            from asyncframework_tpu.metrics.bus import GradientMerged
+            self._dedup.record(item.header, ack)
+            item.ack = ack
+            if item.do_snapshot:
+                # close the batch at the snapshot boundary: the pinned
+                # host copy must be exactly version k, not a later one
+                break
+        if batch:
+            if len(batch) == 1 or self._apply_merge is None:
+                self._apply_one(batch[0][0], batch[0][1])
+            else:
+                # ONE fused device dispatch for the whole drained batch:
+                # padded to the static merge bound so the kernel compiles
+                # once, masked so padding slots are no-ops.  The scratch is
+                # reused (no per-drain allocation) and padding rows keep
+                # whatever a previous drain left: the scan's
+                # `where(mask > 0, ...)` discards their w2 elementwise, so
+                # they never touch the result
+                G, mask = self._merge_G, self._merge_mask
+                for j, (it, _idx) in enumerate(batch):
+                    G[j] = it.g_host
+                mask[: len(batch)] = 1.0
+                mask[len(batch):] = 0.0
+                G_dev = jax.device_put(G, self.device)
+                m_dev = jax.device_put(mask, self.device)
+                if self.algo == "asaga":
+                    self._w, self._ab = self._apply_merge(
+                        self._w, self._ab, G_dev, m_dev
+                    )
+                    with self._saga_lock:  # vs checkpoint table copies
+                        for it, idx2 in batch:
+                            self._table[it.wid][idx2] = (
+                                it.diff[: idx2.size]
+                            )
+                else:
+                    self._w, self._k_dev = self._apply_merge(
+                        self._w, G_dev, m_dev, self._k_dev
+                    )
+            self._w_host = None  # new version; next pull re-materializes
+            self._w_wire = None
+            self.merge_batches += 1
+            self.merge_merged += len(batch)
+            self.merge_batch_max = max(self.merge_batch_max, len(batch))
+        for item in drained:
+            if item.do_snapshot:
+                # host copy NOW: the snapshot must pin this version (the
+                # boundary item closed its batch above, so _w is exactly
+                # the k it rode in on)
+                self._snapshots.append(
+                    (self._now_ms(), np.asarray(self._w))
+                )
+            if item.tc is not None:
+                item.t_done = _trace.now_ms()
+            item.done = True
 
-            self.bus.post(GradientMerged(
-                self._bus_time_ms(), worker_id=wid,
-                staleness=int(staleness), accepted=bool(accepted),
-                iteration=k_at_merge,
-            ))
-        with self._wave_cv:
-            self._wave_cv.notify_all()  # a wave may now meet its threshold
-        _send_msg(conn, ack)
-        if do_snapshot:
-            # printer_freq cadence: signal the async checkpoint thread --
-            # nobody's next message waits behind the disk write
-            self._ckpt_trigger.set()
+    def _apply_one(self, item: _PendingPush,
+                   idx: Optional[np.ndarray]) -> None:
+        """Serial single-push apply (the classic one-dispatch path; caller
+        holds ``_lock``)."""
+        import jax
+
+        g_dev = jax.device_put(item.g_host, self.device)
+        if self.algo == "asaga":
+            # three-term update + alpha_bar advance (delta == g is exact
+            # over DCN; see __init__); then the ScalarMap merge -- commit
+            # this push's candidate scalars
+            self._w, self._ab = self._apply(self._w, self._ab, g_dev, g_dev)
+            with self._saga_lock:  # vs checkpoint table copies
+                self._table[item.wid][idx] = item.diff[: idx.size]
+        else:
+            self._w, self._k_dev = self._apply(self._w, g_dev, self._k_dev)
 
     # ------------------------------------------------------------ evaluation
     def wait_done(self, timeout_s: float,
@@ -968,13 +1240,31 @@ class PSClient:
                  retry: Optional[RetryPolicy] = None,
                  session: Optional[ClientSession] = None,
                  proc: Optional[str] = None,
-                 recorder: Optional["_trace.TraceRecorder"] = None):
+                 recorder: Optional["_trace.TraceRecorder"] = None,
+                 pull_mode: Optional[str] = None):
         self.host, self.port = host, int(port)
         self.endpoint = f"{host}:{self.port}"
         self.retry = retry if retry is not None else RetryPolicy.from_conf(
             attempt_timeout_s=timeout_s
         )
         self.session = session if session is not None else ClientSession()
+        # version-gated delta pulls (net/wiredelta.py): in 'delta' mode the
+        # client advertises its basis version (``have=<ts>``) on every
+        # PULL and keeps the last successfully decoded model per wid so a
+        # NOT_MODIFIED / XDELTA reply can reconstruct byte-exactly.  Any
+        # decode mismatch or cache miss falls back to a full pull -- the
+        # basis is only ever replaced by a CRC-validated reconstruction or
+        # an authoritative full payload, never left wrong.
+        if pull_mode is None:
+            from asyncframework_tpu.conf import PULL_MODE, global_conf
+
+            pull_mode = str(global_conf().get(PULL_MODE))
+        self.pull_mode = pull_mode
+        # wid -> (ts, float32 basis array, crc of its bytes)
+        self._basis: Dict[int, Tuple[int, np.ndarray, int]] = {}
+        self.pull_wenc: Dict[str, int] = {"full": 0, "nm": 0, "xdelta": 0}
+        self.pull_model_bytes = 0  # model-part payload bytes received
+        self.delta_fallbacks = 0   # decode mismatch/cache miss full re-pulls
         # distributed tracing: completed spans from this process's recorder
         # piggyback on PUSH (and BYE) headers -- the PS folds them into its
         # event stream, so spans survive this worker's death.  None =
@@ -1069,27 +1359,115 @@ class PSClient:
         except BaseException:
             _trace.set_current(None)  # never leak the context on failure
             raise
-        tr.rpc_end(token)
+        # wire cost of the RPC that just completed (frame bytes, both
+        # directions) rides the rtt span -- latency AND volume decompose
+        # per stage (net/frame.py counts at the choke point)
+        tr.rpc_end(token, bytes=_frame.last_io_bytes())
         return out
+
+    def _have_hdr(self, wid: int, hdr: dict) -> dict:
+        """Advertise this wid's basis version on a PULL (delta mode)."""
+        if self.pull_mode == "delta":
+            basis = self._basis.get(wid)
+            if basis is not None:
+                hdr["have"] = basis[0]
+        return hdr
+
+    def _decode_model(self, wid: int, header: dict, payload: bytes,
+                      extra_len: int) -> Optional[np.ndarray]:
+        """The model part of a MODEL reply -> float32 array, maintaining
+        the basis cache.  ``extra_len`` is the trailing non-model payload
+        (ASAGA's idx/alpha block).  Returns None on decode mismatch or
+        basis cache miss -- the caller MUST fall back to a full pull; the
+        basis is only ever replaced by a CRC-validated reconstruction or
+        an authoritative full payload, never left wrong."""
+        ts = int(header["ts"])
+        wenc = header.get("wenc")
+        if wenc is None or wenc == wiredelta.FULL:
+            if wenc is None:  # legacy reply: model part is the payload head
+                end = len(payload) - extra_len
+                model_part = payload[:end] if extra_len else payload
+            else:
+                model_part = payload[: int(header.get("wlen", 0))]
+            w = np.frombuffer(model_part, np.float32)
+            if self.pull_mode == "delta":
+                crc_hdr = header.get("crc")
+                self._basis[wid] = (
+                    ts, w,
+                    int(crc_hdr) if crc_hdr is not None
+                    else wiredelta.crc(model_part),
+                )
+            self.pull_wenc["full"] += 1
+            self.pull_model_bytes += len(model_part)
+            return w
+        model_part = payload[: int(header.get("wlen", 0))]
+        basis = self._basis.get(wid)
+        crc_hdr = header.get("crc")
+        w = wiredelta.decode(
+            wenc, model_part, int(header.get("nnz", 0)),
+            basis[1] if basis is not None else None,
+            int(crc_hdr) if crc_hdr is not None else None,
+            basis[2] if basis is not None else None,
+        )
+        if w is None:
+            return None
+        self._basis[wid] = (ts, w, int(crc_hdr))
+        self.pull_wenc[wenc] = self.pull_wenc.get(wenc, 0) + 1
+        self.pull_model_bytes += len(model_part)
+        return w
+
+    def _pull_model_rpc(self, wid: int, make_hdr, extra_len_of, tr
+                        ) -> Optional[Tuple[dict, bytes, np.ndarray]]:
+        """One negotiated model pull with the decode-mismatch fallback
+        shared by PULL and PULL_SAGA: the first request advertises the
+        basis (delta mode); if its reply fails to decode -- basis cache
+        miss, CRC disagreement -- the basis is dropped and ONE full
+        re-pull follows (a full reply always decodes; never a wrong
+        model).  Returns (header, payload, w), or None on RELEASED/DONE
+        (``self.released`` distinguishes them)."""
+        header, payload = self._traced_call(
+            tr, _trace.PULL_RTT,
+            self._proc_hdr(self._have_hdr(wid, make_hdr())),
+        )
+        for fallback_left in (True, False):
+            if header["op"] == "RELEASED":
+                self.released = True
+                return None
+            if header["op"] == "DONE":
+                return None
+            self._note_orders(header)
+            w = self._decode_model(wid, header, payload,
+                                   extra_len_of(header))
+            if w is not None:
+                return header, payload, w
+            if not fallback_left:  # pragma: no cover - full always decodes
+                break
+            self._basis.pop(wid, None)
+            self.delta_fallbacks += 1
+            header, payload = self._traced_call(
+                tr, _trace.PULL_RTT, self._proc_hdr(make_hdr())
+            )
+        raise ConnectionError("PULL: full reply failed to decode")
 
     def pull(self, wid: int, tr=None
              ) -> Optional[Tuple[int, np.ndarray, float, bool]]:
         """Returns (ts, w, avg_delay_ms, calibrated); None when DONE or
         when this client's wid was RELEASED (check ``self.released``).
         ``tr`` (an UpdateTrace) records this pull's round trip as a
-        pull.rtt span and propagates the trace context on the wire."""
-        header, payload = self._traced_call(
-            tr, _trace.PULL_RTT, self._proc_hdr({"op": "PULL", "wid": wid})
+        pull.rtt span and propagates the trace context on the wire.
+
+        In ``delta`` pull mode the request advertises the cached basis
+        version (``have``) and the reply may be NOT_MODIFIED (zero model
+        payload) or a byte-exact XOR delta; a decode mismatch or basis
+        cache miss re-pulls FULL -- never a wrong model."""
+        got = self._pull_model_rpc(
+            wid, lambda: {"op": "PULL", "wid": wid}, lambda _h: 0, tr
         )
-        if header["op"] == "RELEASED":
-            self.released = True
+        if got is None:
             return None
-        if header["op"] == "DONE":
-            return None
-        self._note_orders(header)
+        header, _payload, w = got
         if tr is not None:
             tr.set_model_version(int(header["ts"]))
-        w = np.frombuffer(payload, np.float32)
         return (int(header["ts"]), w, float(header["avg_delay_ms"]),
                 bool(header["calibrated"]))
 
@@ -1165,23 +1543,21 @@ class PSClient:
         current history scalars with the model (the reference's sampledMap).
         Returns (ts, w, idx, alpha_sel, n_valid, avg_delay_ms, calibrated)
         or None when DONE."""
-        header, payload = self._traced_call(
-            tr, _trace.PULL_RTT,
-            self._proc_hdr({"op": "PULL_SAGA", "wid": wid, "n_p": n_p}),
+        got = self._pull_model_rpc(
+            wid, lambda: {"op": "PULL_SAGA", "wid": wid, "n_p": n_p},
+            lambda h: 8 * int(h["cap"]), tr,
         )
-        if header["op"] == "RELEASED":
-            self.released = True
+        if got is None:
             return None
-        if header["op"] == "DONE":
-            return None
-        self._note_orders(header)
+        header, payload, w = got
         if tr is not None:
             tr.set_model_version(int(header["ts"]))
+        # the ASAGA extra block (idx, alpha) always rides AFTER the model
+        # part, whatever its encoding; its offset is the payload tail
         cap = int(header["cap"])
-        d4 = len(payload) - 8 * cap
-        w = np.frombuffer(payload[:d4], np.float32)
-        idx = np.frombuffer(payload[d4: d4 + 4 * cap], np.uint32)
-        alpha_sel = np.frombuffer(payload[d4 + 4 * cap:], np.float32)
+        tail = len(payload) - 8 * cap
+        idx = np.frombuffer(payload[tail: tail + 4 * cap], np.uint32)
+        alpha_sel = np.frombuffer(payload[tail + 4 * cap:], np.float32)
         return (int(header["ts"]), w, idx, alpha_sel, int(header["n_valid"]),
                 float(header["avg_delay_ms"]), bool(header["calibrated"]))
 
@@ -1366,7 +1742,9 @@ def run_worker_process(
                 try:
                     if cl is None:
                         cl = PSClient(host, port, proc=proc_token,
-                                      recorder=recorder)
+                                      recorder=recorder,
+                                      pull_mode=getattr(cfg, "pull_mode",
+                                                        None))
                     # per-update sampling decision: a traced update's RPCs
                     # carry the trace context on the wire and its lifecycle
                     # spans (pull.rtt/compute/push.wait/push.rtt) land in

@@ -306,6 +306,14 @@ class SolverConfig:
     # tunneled v5e); large values win modestly when per-dispatch latency
     # dominates (6.2k updates/s at 128, +10%, same chip).
     drain_batch: int = 1
+    # DCN data plane (parallel/ps_dcn.py).  pull_mode: None = resolve from
+    # conf async.pull.mode ('full' ships the whole model per PULL,
+    # byte-identical legacy wire; 'delta' negotiates NOT_MODIFIED /
+    # byte-exact XOR delta / full per pull).  push_merge: None = resolve
+    # from conf async.push.merge (max pushes the PS coalesces into one
+    # fused device apply at lock acquisition; 1 = classic serial path).
+    pull_mode: Optional[str] = None
+    push_merge: Optional[int] = None
     # checkpoint/resume (SURVEY.md section 5: a capability the reference lacks)
     checkpoint_dir: Optional[str] = None  # None = checkpointing off
     checkpoint_freq: int = 0              # accepted updates between saves; 0 = off

@@ -430,6 +430,120 @@ def run_child(config_name: str) -> None:
     })
 
 
+# ----------------------------------------------------------------- DCN bench
+# Wire-plane microbench (always CPU: it measures the data plane, not the
+# chip): the REAL ParameterServer + worker loop over loopback TCP, once per
+# pull mode, recording updates/s, wire bytes per update, and pull/push
+# payload shapes.  This is the artifact the delta-pull/vectored-framing/
+# batched-apply overhaul is judged by.
+DCN_CONFIGS = {
+    # dense gradients touch every coordinate, so deltas degrade to full --
+    # this config guards the "delta mode must not cost throughput" side
+    "dense": dict(sparse=False, n=8192, d=2048, nnz=None, nw=4,
+                  gamma=0.05 * 2048, batch_rate=0.05, iters=300),
+    # rcv1-shaped: sparse pushes touch few coordinates, so consecutive
+    # pulls reconstruct from small XOR deltas -- the bytes-per-update win
+    "sparse": dict(sparse=True, n=4096, d=16384, nnz=8, nw=4,
+                   gamma=500.0, batch_rate=0.02, iters=300),
+}
+
+
+def run_dcn_child() -> None:
+    """One fresh-process DCN wire bench; prints one JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from asyncframework_tpu.conf import AsyncConf, set_global_conf
+    from asyncframework_tpu.data.sharded import ShardedDataset
+    from asyncframework_tpu.data.sparse import SparseShardedDataset
+    from asyncframework_tpu.net import frame, reset_net_totals
+    from asyncframework_tpu.parallel import ps_dcn
+    from asyncframework_tpu.solvers import SolverConfig
+
+    devices = jax.devices()
+    out = {}
+    for name, c in DCN_CONFIGS.items():
+        if c["sparse"]:
+            ds = SparseShardedDataset.generate_on_device(
+                c["n"], c["d"], c["nnz"], c["nw"], devices=devices,
+                seed=7, noise=0.01,
+            )
+        else:
+            ds = ShardedDataset.generate_on_device(
+                c["n"], c["d"], c["nw"], devices=devices, seed=7,
+                noise=0.01,
+            )
+        out[name] = {}
+        for mode in ("full", "delta"):
+            conf = AsyncConf()
+            conf.set("async.pull.mode", mode)
+            set_global_conf(conf)
+            reset_net_totals()
+            cfg = SolverConfig(
+                num_workers=c["nw"], num_iterations=c["iters"],
+                gamma=c["gamma"], taw=2**31 - 1,
+                batch_rate=c["batch_rate"], bucket_ratio=0.5,
+                printer_freq=100, coeff=0.0, seed=42,
+                calibration_iters=20, run_timeout_s=120.0,
+            )
+            ps = ps_dcn.ParameterServer(
+                cfg, c["d"], c["n"], device=devices[0], port=0
+            ).start()
+            shards = {w: ds.shard(w) for w in range(c["nw"])}
+            t0 = time.monotonic()
+            ps_dcn.run_worker_process(
+                "127.0.0.1", ps.port, list(range(c["nw"])), shards, cfg,
+                c["d"], c["n"], deadline_s=120.0,
+            )
+            done = ps.wait_done(timeout_s=5.0)
+            elapsed = time.monotonic() - t0
+            ps.stop()
+            bt = frame.bytes_totals()
+            pulls = max(sum(ps.pull_replies.values()), 1)
+            pushes = max(ps.accepted + ps.dropped, 1)
+            out[name][mode] = {
+                "ok": bool(done),
+                "accepted": ps.accepted,
+                "updates_per_sec": round(ps.accepted / elapsed, 1)
+                if elapsed > 0 else None,
+                # sent counts both directions of the loopback pair once
+                # (client requests + server replies): the wire volume
+                "wire_bytes_per_update": round(
+                    bt.get("sent", 0) / max(ps.accepted, 1)
+                ),
+                "pull_model_bytes_avg": round(ps.pull_model_bytes / pulls),
+                "pull_replies": dict(ps.pull_replies),
+                "push_payload_bytes_avg": round(ps.push_bytes / pushes),
+                "merge": {"batches": ps.merge_batches,
+                          "pushes": ps.merge_merged,
+                          "max_batch": ps.merge_batch_max},
+            }
+        full_b = out[name]["full"]["wire_bytes_per_update"]
+        delta_b = out[name]["delta"]["wire_bytes_per_update"]
+        out[name]["wire_bytes_ratio_full_over_delta"] = (
+            round(full_b / delta_b, 2) if delta_b else None
+        )
+    emit({"dcn": out})
+
+
+def collect_dcn_block(env: dict) -> dict:
+    """Run the DCN wire bench in a disposable subprocess (same discipline
+    as every other measurement: fresh process, parent owns the timeout)."""
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--dcn"],
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "dcn bench timed out"}
+    sys.stderr.write(res.stderr)
+    line = next((l for l in reversed(res.stdout.splitlines())
+                 if l.startswith("{")), None)
+    if line is None:
+        return {"error": f"no JSON from dcn child (rc={res.returncode})"}
+    return json.loads(line).get("dcn", {"error": "malformed dcn payload"})
+
+
 def run_probe() -> None:
     """Cheap backend-liveness check in a disposable process: init the backend
     and print one JSON line.  A dead TPU tunnel wedges jax.devices() forever
@@ -782,6 +896,10 @@ def run_parent() -> None:
         payload["note"] = skip_note
         if os.environ.get("BENCH_FALLBACK", "1") != "0":
             payload["fallback"] = run_fallback(names, deadline)
+    if os.environ.get("BENCH_DCN", "1") != "0":
+        # DCN data-plane bench (CPU loopback, device-independent): wire
+        # bytes per update and pull/push payload shapes per pull mode
+        payload["dcn"] = collect_dcn_block(env)
     if trace_out:
         with open(trace_out, "w") as f:
             for name in names:
@@ -797,6 +915,13 @@ def run_parent() -> None:
 
 
 def main() -> None:
+    if "--dcn" in sys.argv:
+        try:
+            run_dcn_child()
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            emit({"dcn": {"error": f"{type(e).__name__}: {str(e)[:200]}"}})
+        os._exit(0)
     if "--probe" in sys.argv:
         # parent owns the timeout; nothing here may block interpreter exit
         try:

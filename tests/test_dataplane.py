@@ -1,0 +1,540 @@
+"""Data-plane throughput overhaul (ISSUE 4): version-cached zero-copy PULL
+replies, version-gated delta pulls, vectored framing, batched gradient
+apply.
+
+The correctness spine is byte-exactness: (a) for ANY sequence of model
+versions, a delta-mode pull reconstructs byte-for-byte what a full-mode
+pull would have shipped (XOR deltas over float32 bit patterns, CRC-gated);
+(b) a retried delta pull under injected faults can never leave the worker
+on a wrong basis -- worst case it degrades to a full pull; (c) the PS's
+fused merge-queue apply is bit-identical to the serial
+one-dispatch-per-push order.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.conf import AsyncConf, set_global_conf
+from asyncframework_tpu.data.sharded import ShardedDataset
+from asyncframework_tpu.net import frame, reset_net_totals, wiredelta
+from asyncframework_tpu.net import faults
+from asyncframework_tpu.net.faults import (
+    CUT_MID_FRAME,
+    DROP_REPLY,
+    FaultSchedule,
+)
+from asyncframework_tpu.ops import steps
+from asyncframework_tpu.parallel import ps_dcn
+from asyncframework_tpu.solvers import SolverConfig
+
+
+def make_cfg(**kw):
+    defaults = dict(
+        num_workers=2, num_iterations=40, gamma=1.2, taw=2**31 - 1,
+        batch_rate=0.3, bucket_ratio=0.0, printer_freq=10, seed=42,
+        calibration_iters=4, run_timeout_s=60.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+@pytest.fixture()
+def delta_conf():
+    """Install a process conf with delta pulls on; always restored."""
+    conf = AsyncConf().set("async.pull.mode", "delta")
+    set_global_conf(conf)
+    try:
+        yield conf
+    finally:
+        set_global_conf(None)
+
+
+# ------------------------------------------------------------------ codec
+class TestWireDeltaCodec:
+    def test_roundtrip_property_any_version_sequence(self, rng):
+        """For a random walk of model versions and a client whose basis
+        lags by a random number of versions, delta decode reconstructs the
+        full-pull bytes EXACTLY -- including denormals, infs, NaNs, and
+        negative zeros (the codec works on bit patterns, not arithmetic).
+        """
+        d = 512
+        cur = rng.normal(size=d).astype(np.float32)
+        # seed some adversarial bit patterns
+        cur[:8] = [0.0, -0.0, np.inf, -np.inf, np.nan, 1e-42, -1e-42, 1e38]
+        history = [cur.copy()]
+        for _step in range(60):
+            cur = cur.copy()
+            kind = rng.integers(0, 3)
+            if kind == 0:      # sparse update: few coords touched
+                idx = rng.choice(d, size=int(rng.integers(1, 12)),
+                                 replace=False)
+                cur[idx] += rng.normal(size=idx.size).astype(np.float32)
+            elif kind == 1:    # dense update
+                cur += rng.normal(size=d).astype(np.float32) * 0.01
+            # kind == 2: version unchanged (dropped pushes tick the clock)
+            history.append(cur.copy())
+            basis = history[int(rng.integers(0, len(history)))]
+            wenc, payload, nnz = wiredelta.encode(cur, basis)
+            got = wiredelta.decode(
+                wenc, payload, nnz, basis,
+                wiredelta.crc(cur.tobytes()),
+            )
+            assert got is not None, wenc
+            assert got.tobytes() == cur.tobytes(), wenc
+
+    def test_unchanged_is_nm_and_sparse_change_is_xdelta(self):
+        w = np.arange(64, dtype=np.float32)
+        wenc, payload, nnz = wiredelta.encode(w, w.copy())
+        assert wenc == wiredelta.NOT_MODIFIED and payload == b""
+        w2 = w.copy()
+        w2[3] += 1.0
+        wenc, payload, nnz = wiredelta.encode(w2, w)
+        assert wenc == wiredelta.XDELTA and nnz == 1 and len(payload) == 8
+        # dense change: the delta would not beat the raw payload
+        w3 = w + 1.0
+        wenc, payload, _nnz = wiredelta.encode(w3, w)
+        assert wenc == wiredelta.FULL and payload == w3.tobytes()
+
+    def test_decode_rejects_wrong_basis_and_corruption(self, rng):
+        d = 128
+        a = rng.normal(size=d).astype(np.float32)
+        b = a.copy()
+        b[5] += 2.0
+        want = wiredelta.crc(b.tobytes())
+        wenc, payload, nnz = wiredelta.encode(b, a)
+        assert wenc == wiredelta.XDELTA
+        wrong_basis = a.copy()
+        wrong_basis[70] += 1.0
+        assert wiredelta.decode(wenc, payload, nnz, wrong_basis, want) is None
+        corrupt = bytearray(payload)
+        corrupt[-1] ^= 0xFF
+        assert wiredelta.decode(wenc, bytes(corrupt), nnz, a, want) is None
+        assert wiredelta.decode(wenc, payload, nnz, None, want) is None
+        # NM validates against the basis CRC, O(1) via the cached value
+        assert wiredelta.decode(wiredelta.NOT_MODIFIED, b"", 0, a, want,
+                                basis_crc=wiredelta.crc(a.tobytes())) is None
+        out = wiredelta.decode(wenc, payload, nnz, a, want)
+        assert out is not None and out.tobytes() == b.tobytes()
+
+
+# -------------------------------------------------------- vectored framing
+class TestVectoredFraming:
+    def _pair(self):
+        a, b = socket.socketpair()
+        a.settimeout(5.0)
+        b.settimeout(5.0)
+        return a, b
+
+    def test_vectored_stream_byte_identical_to_plain(self):
+        payload = b"A" * 1000 + b"B" * 333 + b"C" * 7
+        hdr = {"op": "MODEL", "ts": 3}
+        a, b = self._pair()
+        try:
+            frame.send_msg(a, dict(hdr), payload)
+            plain = frame.recv_exact(b, 8 + len(b'{"op": "MODEL", "ts": 3}')
+                                     + len(payload))
+        finally:
+            a.close()
+            b.close()
+        a, b = self._pair()
+        try:
+            frame.send_msg_vectored(
+                a, dict(hdr),
+                [b"A" * 1000, memoryview(b"B" * 333), b"", b"C" * 7],
+            )
+            vect = frame.recv_exact(b, len(plain))
+        finally:
+            a.close()
+            b.close()
+        assert vect == plain
+
+    def test_vectored_parses_and_counts_bytes(self):
+        reset_net_totals()
+        a, b = self._pair()
+        try:
+            parts = [np.arange(4, dtype=np.float32).tobytes(), b"tail"]
+            frame.send_msg_vectored(a, {"op": "XYZ"}, parts)
+            hdr, payload = frame.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+        assert hdr["op"] == "XYZ"
+        assert payload == b"".join(parts)
+        totals = frame.bytes_totals()
+        assert totals["sent.XYZ"] == totals["recv.XYZ"] > len(payload)
+        assert totals["sent"] == totals["recv"] == totals["sent.XYZ"]
+        # metrics.reset_totals() must cover the wire-byte counters too
+        from asyncframework_tpu.metrics import reset_totals
+
+        reset_totals()
+        assert frame.bytes_totals() == {}
+
+    def test_large_payload_roundtrip_recv_into(self):
+        blob = np.random.default_rng(3).bytes(1 << 20)
+        a, b = self._pair()
+        got = {}
+
+        def rx():
+            got["msg"] = frame.recv_msg(b)
+
+        t = threading.Thread(target=rx)
+        t.start()
+        try:
+            frame.send_msg_vectored(a, {"op": "BLOB"},
+                                    [blob[: 1 << 19], blob[1 << 19:]])
+            t.join(timeout=10)
+            assert not t.is_alive()
+        finally:
+            a.close()
+            b.close()
+        hdr, payload = got["msg"]
+        assert hdr["op"] == "BLOB" and payload == blob
+
+    def test_cut_mid_frame_fires_on_vectored_path(self):
+        # TCP loopback (not socketpair): fault schedules address peers as
+        # host:port, which AF_UNIX pairs do not have
+        srv = socket.create_server(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        a = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        b, _addr = srv.accept()
+        sched = FaultSchedule().add("*", "MODEL", 1, CUT_MID_FRAME)
+        try:
+            with faults.injected(sched):
+                with pytest.raises(ConnectionError):
+                    frame.send_msg_vectored(a, {"op": "MODEL"},
+                                            [b"x" * 512, b"y" * 512])
+            # the peer sees a short frame + EOF, exactly like the plain
+            # path's mid-frame cut
+            b.settimeout(5.0)
+            with pytest.raises(ConnectionError):
+                frame.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+            srv.close()
+
+
+# ------------------------------------------------- PULL negotiation (PS)
+class TestDeltaPullProtocol:
+    def _ps(self, devices, cfg=None, d=16, n=256):
+        cfg = cfg or make_cfg()
+        ps = ps_dcn.ParameterServer(cfg, d, n, device=devices[0],
+                                    port=0).start()
+        return ps, cfg, d, n
+
+    def _push_once(self, cl, ps, wid, d, scale=1.0, sparse_coord=None):
+        """One pull+push through a FULL-mode client (advances the model).
+        ``sparse_coord`` pushes a one-hot gradient so only that coordinate
+        of the model changes (keeps the next delta genuinely sparse)."""
+        ts, w, _avg, _cal = cl.pull(wid)
+        if sparse_coord is None:
+            g = np.full(d, scale, np.float32)
+        else:
+            g = np.zeros(d, np.float32)
+            g[sparse_coord] = scale
+        cl.push(wid, ts, g)
+
+    def test_unchanged_version_pull_carries_zero_payload(self, devices8,
+                                                         delta_conf):
+        """THE steady-state claim: an unchanged-version re-pull is a
+        header-only NOT_MODIFIED -- zero model payload bytes on the wire."""
+        ps, cfg, d, n = self._ps(devices8)
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, pull_mode="delta")
+            ts1, w1, _, _ = cl.pull(0)
+            assert cl.pull_wenc["full"] == 1  # no basis yet: full
+            reset_net_totals()
+            ts2, w2, _, _ = cl.pull(0)
+            assert cl.pull_wenc["nm"] == 1
+            assert ps.pull_replies["nm"] == 1
+            assert w2.tobytes() == w1.tobytes()
+            assert ps.pull_model_bytes == d * 4  # only the first pull paid
+            # the MODEL frame itself carried zero payload bytes: frame =
+            # 2 length prefixes + header line, nothing else
+            sent_model = frame.bytes_totals()["sent.MODEL"]
+            assert sent_model < 200, sent_model
+            cl.bye()
+        finally:
+            ps.stop()
+            reset_net_totals()
+
+    def test_delta_pull_reconstructs_full_pull_bytes(self, devices8,
+                                                     delta_conf):
+        """Wire equivalence on a live PS: for a sequence of versions, the
+        delta client's model == a full client's model, byte for byte."""
+        ps, cfg, d, n = self._ps(devices8)
+        try:
+            full_cl = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                      pull_mode="full")
+            delta_cl = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                       pull_mode="delta")
+            rng = np.random.default_rng(5)
+            for step in range(12):
+                # advance the model a random number of pushes (0 = NM)
+                for _ in range(int(rng.integers(0, 3))):
+                    self._push_once(full_cl, ps, 0, d,
+                                    scale=float(rng.normal()))
+                ts_f, w_f, _, _ = full_cl.pull(0)
+                ts_d, w_d, _, _ = delta_cl.pull(1)
+                assert ts_f == ts_d
+                assert w_f.tobytes() == w_d.tobytes(), step
+            assert delta_cl.pull_wenc["nm"] + delta_cl.pull_wenc["xdelta"] > 0
+            assert delta_cl.delta_fallbacks == 0
+            full_cl.bye()
+            delta_cl.bye()
+        finally:
+            ps.stop()
+
+    def test_evicted_basis_is_served_full_not_wrong(self, devices8):
+        """A basis older than the server's version cache gets a FULL
+        reply (cache miss on the SERVER side -- no client fallback
+        round-trip needed, and never a wrong model)."""
+        conf = (AsyncConf().set("async.pull.mode", "delta")
+                .set("async.pull.delta.versions", 1))
+        set_global_conf(conf)
+        try:
+            ps, cfg, d, n = self._ps(devices8)
+            try:
+                cl = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                     pull_mode="delta")
+                mover = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                        pull_mode="full")
+                cl.pull(0)
+                for _ in range(3):  # basis version ages out of the cache
+                    self._push_once(mover, ps, 1, d)
+                ts, w, _, _ = cl.pull(0)
+                ref_ts, ref_w, _, _ = mover.pull(1)
+                assert w.tobytes() == ref_w.tobytes()
+                assert cl.pull_wenc["full"] == 2  # initial + cache miss
+                assert cl.delta_fallbacks == 0
+                cl.bye()
+                mover.bye()
+            finally:
+                ps.stop()
+        finally:
+            set_global_conf(None)
+
+    def test_cache_disabled_still_answers_nm_on_exact_version(self,
+                                                              devices8):
+        """async.pull.delta.versions=0: no version cache, but an
+        unchanged-version re-pull (have == ts) is still NOT_MODIFIED --
+        the exact match needs no cache; anything older goes full."""
+        conf = (AsyncConf().set("async.pull.mode", "delta")
+                .set("async.pull.delta.versions", 0))
+        set_global_conf(conf)
+        try:
+            ps, cfg, d, n = self._ps(devices8)
+            try:
+                cl = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                     pull_mode="delta")
+                mover = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                        pull_mode="full")
+                w1 = cl.pull(0)[1]
+                w2 = cl.pull(0)[1]
+                assert cl.pull_wenc["nm"] == 1
+                assert w2.tobytes() == w1.tobytes()
+                self._push_once(mover, ps, 1, d, sparse_coord=3)
+                w3 = cl.pull(0)[1]
+                ref = mover.pull(1)[1]
+                assert w3.tobytes() == ref.tobytes()
+                assert cl.pull_wenc["xdelta"] == 0  # no cache: went full
+                assert len(ps._w_versions) == 0
+                cl.bye()
+                mover.bye()
+            finally:
+                ps.stop()
+        finally:
+            set_global_conf(None)
+
+    def test_full_mode_deployment_never_builds_version_cache(self,
+                                                            devices8):
+        """No delta client -> the PS must not spend RAM/cycles on the
+        version cache (it is built lazily on the first `have`)."""
+        ps, cfg, d, n = self._ps(devices8)
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, pull_mode="full")
+            for _ in range(3):
+                cl.pull(0)
+            assert len(ps._w_versions) == 0
+            cl.bye()
+        finally:
+            ps.stop()
+
+    def test_corrupt_client_basis_falls_back_to_full_pull(self, devices8,
+                                                          delta_conf):
+        """A client whose cached basis disagrees with what the server
+        thinks it has (bit rot, basis from a different PS life) FAILS the
+        CRC check and transparently re-pulls full -- never decodes a
+        wrong model."""
+        ps, cfg, d, n = self._ps(devices8)
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, pull_mode="delta")
+            mover = ps_dcn.PSClient("127.0.0.1", ps.port, pull_mode="full")
+            cl.pull(0)
+            # second pull carries `have`: the (lazy) server version cache
+            # starts tracking, with the basis version in it
+            cl.pull(0)
+            # tamper the basis: same ts, different bytes + stale crc
+            ts0, arr, crc0 = cl._basis[0]
+            bad = arr.copy()
+            bad[0] += 42.0
+            cl._basis[0] = (ts0, bad, crc0)
+            # a one-hot push keeps the model change sparse, so the server
+            # answers XDELTA -- whose CRC the tampered basis must fail
+            self._push_once(mover, ps, 1, d, sparse_coord=2)
+            ts, w, _, _ = cl.pull(0)
+            ref_ts, ref_w, _, _ = mover.pull(1)
+            assert w.tobytes() == ref_w.tobytes()
+            assert cl.delta_fallbacks == 1
+            cl.bye()
+            mover.bye()
+        finally:
+            ps.stop()
+
+    def test_retried_delta_pull_under_faults_never_wrong_basis(
+        self, devices8, delta_conf
+    ):
+        """Seeded chaos on the MODEL stream (drop_reply + cut_mid_frame):
+        the retried delta pulls must still hand the worker byte-exact
+        models every time, worst case via the full-pull fallback."""
+        ps, cfg, d, n = self._ps(devices8)
+        ep = f"127.0.0.1:{ps.port}"
+        try:
+            mover = ps_dcn.PSClient("127.0.0.1", ps.port, pull_mode="full")
+            sched = (FaultSchedule(seed=7)
+                     .add(ep, "PULL", 2, DROP_REPLY)
+                     .add(ep, "PULL", 4, CUT_MID_FRAME)
+                     .add(ep, "PULL", 6, DROP_REPLY))
+            with faults.injected(sched) as inj:
+                cl = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                     pull_mode="delta")
+                for step in range(8):
+                    self._push_once(mover, ps, 1, d)
+                    got = cl.pull(0)
+                    assert got is not None
+                    _ts, w, _, _ = got
+                    ref = mover.pull(1)
+                    assert ref is not None
+                    # the mover pulled AFTER cl, same version (no pushes in
+                    # between): byte-exact or the delta path is broken
+                    assert w.tobytes() == ref[1].tobytes(), step
+                assert len(inj.remaining()) == 0, "all faults must fire"
+                cl.bye()
+                mover.bye()
+        finally:
+            ps.stop()
+
+
+# -------------------------------------------------- batched gradient apply
+class TestBatchedApply:
+    def test_merge_kernels_bit_identical_to_serial(self, rng):
+        """Tier-1 guard for the fused apply: the scan kernels reproduce
+        the serial apply bit for bit (ASGD and ASAGA), including masked
+        (rejected/padding) slots."""
+        gamma, br, n, P, d, m = 1.2, 0.3, 4096, 8, 96, 6
+        G = rng.normal(size=(m, d)).astype(np.float32)
+        mask = np.array([1, 0, 1, 1, 0, 1], np.float32)
+        w0 = rng.normal(size=d).astype(np.float32)
+        import jax.numpy as jnp
+
+        ser = steps.make_asgd_apply(gamma, br, n, P)
+        w, k = jnp.asarray(w0), jnp.asarray(np.float32(5.0))
+        for j in range(m):
+            if mask[j] > 0:
+                w, k = ser(w, jnp.asarray(G[j]), k)
+        mrg = steps.make_asgd_apply_merge(gamma, br, n, P)
+        w_m, k_m = mrg(jnp.asarray(w0), jnp.asarray(G), jnp.asarray(mask),
+                       jnp.asarray(np.float32(5.0)))
+        assert np.asarray(w).tobytes() == np.asarray(w_m).tobytes()
+        assert float(k) == float(k_m)
+
+        ab0 = rng.normal(size=d).astype(np.float32)
+        ser_s = steps.make_saga_apply(gamma, br, n, P, donate_g=False)
+        w, ab = jnp.asarray(w0), jnp.asarray(ab0)
+        for j in range(m):
+            if mask[j] > 0:
+                g = jnp.asarray(G[j])
+                w, ab = ser_s(w, ab, g, g)
+        mrg_s = steps.make_saga_apply_merge(gamma, br, n, P)
+        w_m, ab_m = mrg_s(jnp.asarray(w0), jnp.asarray(ab0),
+                          jnp.asarray(G), jnp.asarray(mask))
+        assert np.asarray(w).tobytes() == np.asarray(w_m).tobytes()
+        assert np.asarray(ab).tobytes() == np.asarray(ab_m).tobytes()
+
+    def test_ps_fused_drain_matches_serial_ps(self, devices8):
+        """Two PSes fed the identical push sequence -- one draining through
+        the fused merge queue (a real multi-item batch), one serial --
+        finish with bit-identical models and identical ledgers."""
+        d, n = 16, 256
+        rng = np.random.default_rng(9)
+        pushes = [(w % 2, rng.normal(size=d).astype(np.float32))
+                  for w in range(6)]
+
+        def run(push_merge):
+            cfg = make_cfg(num_iterations=100, push_merge=push_merge)
+            ps = ps_dcn.ParameterServer(cfg, d, n, device=devices8[0],
+                                        port=0).start()
+            try:
+                for j, (wid, g) in enumerate(pushes):
+                    item = ps_dcn._PendingPush(
+                        wid, 0, g, None, {"op": "PUSH"}, g.nbytes, None,
+                        0.0,
+                    )
+                    ps._merge_q.append(item)
+                if push_merge > 1:
+                    # one drain folds the whole queue into ONE fused apply
+                    with ps._lock:
+                        ps._drain_merge_locked()
+                    assert ps.merge_batch_max == len(pushes)
+                else:
+                    with ps._lock:
+                        while ps._merge_q:
+                            ps._drain_merge_locked()
+                return (np.asarray(ps._w).tobytes(), ps.accepted,
+                        ps.dropped, ps._clock)
+            finally:
+                ps.stop()
+
+        serial = run(1)
+        fused = run(8)
+        assert fused == serial
+
+    def test_push_merge_zero_means_serial(self, devices8):
+        """An explicit push_merge=0 clamps to the classic serial path
+        (regression: a truthiness check used to fall back to the conf
+        default of 8)."""
+        cfg = make_cfg(push_merge=0)
+        ps = ps_dcn.ParameterServer(cfg, 8, 256, device=devices8[0], port=0)
+        try:
+            assert ps._merge_max == 1
+            assert ps._apply_merge is None
+        finally:
+            ps.stop()
+
+    def test_contended_run_engages_fused_applies(self, devices8):
+        """Under real contention (8 workers hammering one PS) the merge
+        queue must actually coalesce -- and the run still converges."""
+        cfg = make_cfg(num_workers=8, num_iterations=200, bucket_ratio=0.5,
+                       calibration_iters=20)
+        n, d = 2048, 16
+        ds = ShardedDataset.generate_on_device(n, d, 8, devices=devices8,
+                                               seed=3, noise=0.01)
+        ps = ps_dcn.ParameterServer(cfg, d, n, device=devices8[0],
+                                    port=0).start()
+        try:
+            shards = {w: ds.shard(w) for w in range(8)}
+            ps_dcn.run_worker_process(
+                "127.0.0.1", ps.port, list(range(8)), shards, cfg, d, n,
+                deadline_s=60.0,
+            )
+            assert ps.wait_done(timeout_s=5.0)
+            assert ps.accepted == 200
+            assert ps.merge_merged == ps.accepted
+            assert ps.merge_batch_max >= 2, (
+                "8 contending workers never produced a fused batch"
+            )
+        finally:
+            ps.stop()
