@@ -70,6 +70,7 @@ CONF_TO_FIELD: Dict[str, str] = {
     # DCN data-plane knobs (parallel/ps_dcn.py)
     "async.pull.mode": "pull_mode",
     "async.push.merge": "push_merge",
+    "async.pipeline.depth": "pipeline_depth",
 }
 
 DRIVER_ALIASES: Dict[str, str] = {
@@ -490,6 +491,13 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
     # --conf async.pull.mode=full restores the legacy full-pull wire
     if not conf.contains("async.pull.mode"):
         conf.set("async.pull.mode", "delta")
+    # the pipelined update loop is likewise ON by default for the cluster
+    # path: prefetched pulls + a bounded in-flight push sender overlap the
+    # DCN round trips with compute (tests/test_pipeline.py guards depth=0
+    # byte-identity and the chaos behavior) -- an explicit
+    # --conf async.pipeline.depth=0 restores the serial loop
+    if not conf.contains("async.pipeline.depth"):
+        conf.set("async.pipeline.depth", 2)
 
     cfg = SolverConfig(
         num_workers=args.num_partitions,

@@ -253,6 +253,27 @@ PUSH_MERGE = ConfigEntry(
     "Upper bound on PUSHes the PS coalesces into one fused device apply "
     "when the model lock is contended (bit-identical to the serial apply "
     "order; 1 = classic one-dispatch-per-push path).")
+PIPELINE_DEPTH = ConfigEntry(
+    "async.pipeline.depth", 0, int,
+    "DCN worker update-loop pipelining: 0 = the classic serial "
+    "pull -> compute -> push loop (byte- and step-identical legacy "
+    "behavior); >= 1 = a prefetch thread on a second PS connection pulls "
+    "model v(k+1) while step k computes, and pushes are handed to a "
+    "bounded in-flight sender (at most this many unacknowledged pushes) "
+    "so the next compute starts before the push ACK returns.  Gradient "
+    "staleness stays bounded: the PS's taw admission prices the extra "
+    "in-flight steps, and a taw rejection makes the worker discard its "
+    "prefetched model and re-pull fresh.  ASAGA ignores this (its "
+    "PS-side sampling requires strict pull->push alternation per "
+    "worker).")
+DEBUG_LOCKWATCH = ConfigEntry(
+    "async.debug.lockwatch", False, bool,
+    "Debug lock watchdog (net/lockwatch.py): the PS model lock becomes a "
+    "watched lock -- any socket send/recv attempted while it is held "
+    "raises AssertionError, and hold counts / max hold time are reported "
+    "in the live UI.  Enabled for the chaos suite and bin/chaos_sweep.py "
+    "so the lock-free PULL-serving claim is continuously checked; off by "
+    "default (zero hot-path cost).")
 # ------------------------------------------------------------ trace plane
 # Distributed tracing for the async update loop (metrics/trace.py): spans
 # are sampled per update lifecycle, propagated over the wire as an optional

@@ -70,6 +70,18 @@ def _recovery_totals() -> Dict[str, int]:
     return recovery_totals()
 
 
+def _pipeline_totals() -> Dict[str, int]:
+    from asyncframework_tpu.parallel.ps_dcn import pipeline_totals
+
+    return pipeline_totals()
+
+
+def _lockwatch_totals() -> Dict:
+    from asyncframework_tpu.net import lockwatch
+
+    return lockwatch.totals()
+
+
 def active_servers() -> List["LiveUIServer"]:
     with _ACTIVE_LOCK:
         return list(_ACTIVE)
@@ -119,6 +131,7 @@ class LiveStateListener(Listener):
         self._base_net = _net_totals()
         self._base_net_bytes = _net_bytes_totals()
         self._base_recovery = _recovery_totals()
+        self._base_pipeline = _pipeline_totals()
 
     def register_queue_depth(self, fn: Callable[[], int]) -> None:
         self._queue_depth_fn = fn
@@ -185,6 +198,7 @@ class LiveStateListener(Listener):
             buckets = [
                 f"<={b}" for b in self.STALENESS_BUCKETS
             ] + [f">{self.STALENESS_BUCKETS[-1]}"]
+            pl = _pipeline_totals()  # one read: delta + high-water agree
             return {
                 "elapsed_s": round(elapsed, 3),
                 "rounds": self.rounds,
@@ -220,6 +234,19 @@ class LiveStateListener(Listener):
                 # declared dead, shards adopted by survivors, rejoins,
                 # surrogate releases, PS checkpoint resumes (per-run delta)
                 "recovery": _delta(_recovery_totals(), self._base_recovery),
+                # pipelined update-loop counters (parallel/ps_dcn.py):
+                # prefetch hits/waits, stale-prefetch discards, async
+                # pushes (per-run delta); inflight_max is a high-water
+                # mark, shown raw
+                "pipeline": dict(
+                    _delta({k: v for k, v in pl.items()
+                            if k != "inflight_max"}, self._base_pipeline),
+                    inflight_max=pl.get("inflight_max", 0),
+                ),
+                # debug lock watchdog (net/lockwatch.py): socket-IO-under-
+                # model-lock violations (the lock-free PULL claim; 0 =
+                # holding) and hold-time stats, raw
+                "lockwatch": _lockwatch_totals(),
                 # distributed-trace section (metrics/trace.py): per-stage
                 # latency p50/p95/p99 and staleness in versions AND ms,
                 # folded from this run's TraceSpan events

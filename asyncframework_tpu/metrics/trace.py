@@ -57,16 +57,23 @@ from typing import Callable, Dict, List, Optional, Sequence
 # stage names, in canonical critical-path order
 PULL_WAIT = "pull.wait"
 PULL_RTT = "pull.rtt"
+#: pipelined worker loop only (parallel/ps_dcn.py, async.pipeline.depth
+#: >= 1): the update loop's RESIDUAL stall -- time the main loop blocked
+#: waiting for its prefetched model or for in-flight push-queue space.
+#: In the serial loop this time is pull.rtt + push.rtt on the critical
+#: path; pipelining overlaps those with compute, and whatever stall is
+#: left shows up here.
+PIPELINE = "pipeline"
 COMPUTE = "compute"
 PUSH_WAIT = "push.wait"
 PUSH_RTT = "push.rtt"
 MERGE_QUEUE = "merge.queue"
 MERGE_APPLY = "merge.apply"
 
-STAGES = (PULL_WAIT, PULL_RTT, COMPUTE, PUSH_WAIT, PUSH_RTT,
+STAGES = (PULL_WAIT, PULL_RTT, PIPELINE, COMPUTE, PUSH_WAIT, PUSH_RTT,
           MERGE_QUEUE, MERGE_APPLY)
 #: stages recorded client-side (worker process) vs server-side (PS)
-CLIENT_STAGES = (PULL_RTT, COMPUTE, PUSH_WAIT, PUSH_RTT)
+CLIENT_STAGES = (PULL_RTT, PIPELINE, COMPUTE, PUSH_WAIT, PUSH_RTT)
 SERVER_STAGES = (PULL_WAIT, MERGE_QUEUE, MERGE_APPLY)
 #: the minimum chain proving a cross-process trace survived the wire
 CHAIN_STAGES = (PULL_RTT, COMPUTE, PUSH_RTT)

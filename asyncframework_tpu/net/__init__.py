@@ -41,9 +41,10 @@ def reset_net_totals() -> None:
     dedup hits, faults fired, wire-byte totals) so back-to-back runs in
     one process start from a clean slate.  Breaker *state* is left alone
     -- see ``retry.reset_breakers`` for that."""
-    from asyncframework_tpu.net import faults, frame, retry, session
+    from asyncframework_tpu.net import faults, frame, lockwatch, retry, session
 
     retry.reset_retry_totals()
     session.reset_dedup_hits_total()
     faults.reset_faults_fired_total()
     frame.reset_bytes_totals()
+    lockwatch.reset_totals()

@@ -49,7 +49,7 @@ import threading
 from typing import Dict, Optional, Sequence, Tuple
 
 from asyncframework_tpu.metrics import trace as _trace
-from asyncframework_tpu.net import faults
+from asyncframework_tpu.net import faults, lockwatch
 
 _HDR = struct.Struct("!I")  # 4-byte big-endian frame length
 
@@ -89,9 +89,23 @@ def reset_bytes_totals() -> None:
 
 def last_io_bytes() -> int:
     """Frame bytes of this thread's most recent send plus most recent
-    receive -- the wire cost of the RPC that just completed."""
+    receive -- the wire cost of the RPC that just completed.  Only valid
+    for SYNCHRONOUS request/reply callers; windowed senders interleave
+    frames from different RPCs on one thread and must pair
+    :func:`last_sent_bytes` (captured at their send) with
+    :func:`last_recv_bytes` (captured at their receive) instead."""
     return (getattr(_io_tls, "sent", 0) or 0) + (getattr(_io_tls, "recv", 0)
                                                  or 0)
+
+
+def last_sent_bytes() -> int:
+    """Frame bytes of this thread's most recent send alone."""
+    return getattr(_io_tls, "sent", 0) or 0
+
+
+def last_recv_bytes() -> int:
+    """Frame bytes of this thread's most recent receive alone."""
+    return getattr(_io_tls, "recv", 0) or 0
 
 
 def endpoint_of(sock: socket.socket) -> str:
@@ -147,6 +161,10 @@ def _send_frame(sock: socket.socket, header: dict, parts: Sequence) -> None:
     stamping, fault injection, byte accounting, then the wire write --
     vectored (zero-copy gather) when the platform has ``sendmsg`` and no
     injector needs to see a contiguous frame."""
+    # lock watchdog (net/lockwatch.py): a frame sent while the caller
+    # holds a watched lock (the PS model lock) is exactly the contention
+    # the lock-free pull path removes -- fail loudly in debug runs
+    lockwatch.check_io("send")
     header = _stamped(header)
     head = json.dumps(header).encode()
     plen = sum(len(p) for p in parts)
@@ -227,6 +245,7 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_msg_raw(sock: socket.socket) -> Tuple[dict, bytes]:
+    lockwatch.check_io("recv")
     (hlen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
     header = json.loads(recv_exact(sock, hlen))
     (plen,) = _HDR.unpack(recv_exact(sock, _HDR.size))

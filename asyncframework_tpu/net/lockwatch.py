@@ -1,0 +1,158 @@
+"""Debug lock watchdog: no socket I/O while a watched lock is held.
+
+The pipelined data plane's central claim is that ``_handle_pull`` serves
+model replies WITHOUT touching the PS model lock (the lock guards the
+updater's apply path only), and that nothing anywhere does wire I/O while
+holding it -- a send or recv under the model lock would let one slow
+worker's socket stall every merge in the process.  That claim is easy to
+break silently in a refactor, so this module makes it checkable at
+runtime:
+
+- :class:`WatchedLock` is a drop-in ``threading.Lock`` replacement that
+  tracks, per thread, which watched locks are currently held, plus hold
+  counts and the max hold time (reported in the live UI's ``lockwatch``
+  section).
+- ``net/frame.py`` calls :func:`check_io` at its send/recv choke points;
+  when the watchdog is enabled and the calling thread holds any watched
+  lock, the call raises ``AssertionError`` naming the lock -- the
+  violation is also counted, so soak harnesses can assert on totals.
+
+Enablement is process-global and off by default (one module-flag check
+per frame when disabled).  ``async.debug.lockwatch`` turns it on via
+conf/env (subprocess chaos children inherit
+``ASYNCTPU_ASYNC_DEBUG_LOCKWATCH=1``); :func:`enable` turns it on
+programmatically (the chaos suite's autouse fixture).  The PS installs a
+watched model lock whenever either source says so.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+_enabled = False
+
+_tls = threading.local()
+
+_totals_lock = threading.Lock()
+_holds = 0
+_violations = 0
+_max_hold_ms = 0.0
+
+
+def enable(flag: bool = True) -> None:
+    """Turn the watchdog on/off process-wide (tests/suites; conf-driven
+    daemons go through :func:`enabled_for`)."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enabled_for(conf=None) -> bool:
+    """Should a freshly constructed server run with a watched lock?
+    True when the watchdog was enabled programmatically OR
+    ``async.debug.lockwatch`` is set; a conf hit also flips the process
+    flag so the frame choke points start checking."""
+    if _enabled:
+        return True
+    from asyncframework_tpu.conf import DEBUG_LOCKWATCH, global_conf
+
+    conf = conf if conf is not None else global_conf()
+    if bool(conf.get(DEBUG_LOCKWATCH)):
+        enable(True)
+        return True
+    return False
+
+
+def held() -> List[str]:
+    """Names of the watched locks the calling thread currently holds."""
+    return list(getattr(_tls, "stack", ()))
+
+
+def check_io(what: str) -> None:
+    """Choke-point assert (``net/frame.py``): socket I/O under a watched
+    lock is the exact contention the lock-free pull path exists to
+    remove.  No-op when disabled."""
+    if not _enabled:
+        return
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        global _violations
+        with _totals_lock:
+            _violations += 1
+        raise AssertionError(
+            f"lockwatch: socket {what} while holding watched lock(s) "
+            f"{list(stack)}"
+        )
+
+
+class WatchedLock:
+    """``threading.Lock`` with per-thread hold tracking + hold-time
+    stats.  Context-manager and acquire/release compatible; the tracking
+    cost is two thread-local list ops per hold."""
+
+    __slots__ = ("name", "_lock", "_t0")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        # per-holder acquire time; single writer (the holder), so a plain
+        # attribute is enough
+        self._t0 = 0.0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            stack = getattr(_tls, "stack", None)
+            if stack is None:
+                stack = _tls.stack = []
+            stack.append(self.name)
+            self._t0 = time.monotonic()
+        return got
+
+    def release(self) -> None:
+        global _holds, _max_hold_ms
+        hold_ms = (time.monotonic() - self._t0) * 1e3
+        stack = getattr(_tls, "stack", None)
+        if stack and self.name in stack:
+            stack.remove(self.name)
+        with _totals_lock:
+            _holds += 1
+            if hold_ms > _max_hold_ms:
+                _max_hold_ms = hold_ms
+        self._lock.release()
+
+    def __enter__(self) -> "WatchedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+
+def totals() -> Dict[str, object]:
+    """Watchdog report for the live UI: enabled flag, hold count, max
+    single hold in ms, violations caught (0 is the claim holding)."""
+    with _totals_lock:
+        return {
+            "enabled": _enabled,
+            "holds": _holds,
+            "violations": _violations,
+            "max_hold_ms": round(_max_hold_ms, 3),
+        }
+
+
+def reset_totals() -> None:
+    """Zero the counters (per-run isolation; enabled flag untouched)."""
+    global _holds, _violations, _max_hold_ms
+    with _totals_lock:
+        _holds = 0
+        _violations = 0
+        _max_hold_ms = 0.0

@@ -35,6 +35,7 @@ from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from asyncframework_tpu.ops.gradients import (
     least_squares_grad_sum,
@@ -46,6 +47,34 @@ from asyncframework_tpu.ops.gradients import (
 
 
 # ---------------------------------------------------------------- builders
+def make_pipelined_transfer(device) -> Tuple[Callable, Callable]:
+    """``(stage, readback)`` -- the two host<->device overlap points of
+    the pipelined DCN worker loop (``parallel/ps_dcn.py``,
+    ``async.pipeline.depth`` >= 1).
+
+    ``stage(w_host)`` puts the NEXT model version on the device.  It is
+    called on the prefetch thread the moment the pull reply decodes, and
+    ``jax.device_put`` dispatches asynchronously -- so the host->device
+    copy of model v(k+1) rides the transfer engine while step k's compute
+    is still running (double buffering: two model versions briefly live
+    on device; the old one is dropped when the loop advances).
+
+    ``readback(g)`` completes a gradient's device->host copy (blocking
+    ``np.asarray``).  In the pipelined loop the push that follows it is
+    a bare windowed send -- the ACK wait that serialized the serial
+    loop's readback -> push -> pull chain is a separate reaper thread's
+    problem.
+    """
+
+    def stage(w_host: np.ndarray):
+        return jax.device_put(w_host, device)
+
+    def readback(g) -> np.ndarray:
+        return np.asarray(g)
+
+    return stage, readback
+
+
 def make_asgd_worker_step(batch_rate: float, loss: str = "least_squares"):
     """jit (X, y, w, key) -> (g_sum, new_key); mask drawn on device.
 

@@ -75,6 +75,29 @@ vectored framing, batched apply):
   (``ops/steps.make_*_apply_merge`` -- a ``lax.scan`` over the serial
   apply expression, bit-identical to one-dispatch-per-push), with
   per-push accept/reject, dedup, and trace spans preserved per item.
+
+Pipelined update loop (``async.pipeline.depth``):
+
+- **Lock-free PULL serving**: the PS publishes a per-version
+  :class:`_ModelSnap` ``(ts, host array, payload bytes, CRC)`` via atomic
+  reference swap; ``_handle_pull`` serves full/NOT_MODIFIED/delta replies
+  from the published snapshot without ever touching the model lock (only
+  the wave gate and small bookkeeping locks remain on the pull path), so
+  a cohort pull never queues behind a merge drain and vice versa.  The
+  debug lock watchdog (``net/lockwatch.py``, ``async.debug.lockwatch``)
+  asserts the claim at the frame choke points.
+- **Prefetched pulls + decoupled pushes** (worker side, depth >= 1): a
+  prefetch thread on a SECOND PSClient connection pulls model v(k+1)
+  while step k computes (delta-mode ``have=`` pulls make an unchanged
+  version nearly free), and pushes are handed to a bounded in-flight
+  sender so the next compute starts before the push ACK returns.
+  Staleness stays bounded: the PS's taw admission prices the extra
+  in-flight steps, and a taw REJECTION makes the worker discard its
+  prefetched model and re-pull fresh (counted as a stale-prefetch
+  discard).  Exactly-once push semantics ride the session/dedup
+  machinery unchanged; adoption orders and RELEASED/DONE work on both
+  connections.  Depth 0 (the default outside ``async-cluster``) is the
+  classic serial loop, byte- and step-identical.
 """
 
 from __future__ import annotations
@@ -103,6 +126,124 @@ from asyncframework_tpu.parallel.supervisor import ElasticSupervisor
 _send_msg = _frame.send_msg
 _recv_exact = _frame.recv_exact
 _recv_msg = _frame.recv_msg
+
+
+# ------------------------------------------------- pipeline counters
+# Process-global pipelined-loop totals (live UI "pipeline" section).  The
+# worker loops accumulate locally (one _PipelineStats per worker process
+# run) and ship deltas on PUSH/BYE headers; the PS folds them here -- so
+# the counters land in the process that serves the dashboard whether the
+# workers are threads in this process or real OS processes across a DCN.
+_pl_lock = threading.Lock()
+_pl_totals: Dict[str, int] = {}
+
+
+def pipeline_totals() -> Dict[str, int]:
+    """Pipelined update-loop counters: prefetch_hits (model was already
+    waiting when the loop asked), prefetch_waits (the loop blocked on the
+    prefetch), stale_discards (prefetched model thrown away after a taw
+    rejection), pushes_async (pushes sent by the decoupled sender),
+    push_errors (pushes whose whole retry budget was spent),
+    inflight_max (max unacked pushes observed)."""
+    with _pl_lock:
+        return dict(_pl_totals)
+
+
+def reset_pipeline_totals() -> None:
+    """Zero the process-global pipeline counters (per-run isolation; see
+    ``asyncframework_tpu.metrics.reset_totals``)."""
+    with _pl_lock:
+        _pl_totals.clear()
+
+
+def _pl_fold(delta: Dict[str, int]) -> None:
+    """Fold a wire-shipped counter delta; ``inflight_max`` is a high-water
+    mark (max-merged), everything else a monotone count."""
+    if not delta:
+        return
+    with _pl_lock:
+        for k, v in delta.items():
+            try:
+                v = int(v)
+            except (TypeError, ValueError):
+                continue
+            if k == "inflight_max":
+                if v > _pl_totals.get(k, 0):
+                    _pl_totals[k] = v
+            else:
+                _pl_totals[k] = _pl_totals.get(k, 0) + v
+
+
+class _PipelineStats:
+    """Per-worker-process pipeline counters, shipped to the PS as deltas
+    on PUSH headers (``pl``) and on BYE -- the same piggyback discipline
+    as trace spans, so the PS-side live UI sees them even when the worker
+    is a separate OS process.  A delta taken for a push that terminally
+    fails is merged back so the counts ride the next attempt."""
+
+    __slots__ = ("_lock", "_counts", "_shipped_inflight_max")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._shipped_inflight_max = 0
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def high_water(self, key: str, v: int) -> None:
+        with self._lock:
+            if v > self._counts.get(key, 0):
+                self._counts[key] = v
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def take_wire(self) -> Dict[str, int]:
+        """Unshipped counter delta (empty dict = nothing to ship, no
+        header field, no wire bytes)."""
+        with self._lock:
+            out = {k: v for k, v in self._counts.items()
+                   if k != "inflight_max" and v}
+            hw = self._counts.get("inflight_max", 0)
+            if hw > self._shipped_inflight_max:
+                out["inflight_max"] = hw
+                self._shipped_inflight_max = hw
+            for k in out:
+                if k != "inflight_max":
+                    self._counts[k] = 0
+            return out
+
+    def merge_back(self, delta: Dict[str, int]) -> None:
+        with self._lock:
+            for k, v in delta.items():
+                if k == "inflight_max":
+                    continue  # the high-water mark survives locally
+                self._counts[k] = self._counts.get(k, 0) + v
+
+
+class _ModelSnap:
+    """One published model version: the host float32 array, its serialized
+    payload bytes, and the CRC32 integrity stamp -- immutable once built,
+    swapped in by atomic reference assignment so ``_handle_pull`` can
+    serve any reply shape without the model lock."""
+
+    __slots__ = ("ts", "w_host", "wire", "crc", "gen")
+
+    def __init__(self, ts: int, w_host: np.ndarray, wire: bytes, crc: int,
+                 gen: int):
+        self.ts = ts
+        self.w_host = w_host
+        self.wire = wire
+        self.crc = crc
+        #: model GENERATION the build basis carried (bumped on every
+        #: accepted push): the send-time clock re-stamp in _handle_pull
+        #: is allowed only while the generation is unchanged -- dropped
+        #: pushes tick the clock but not the generation, accepted ones
+        #: tick both, so gen equality proves "same bytes, newer clock"
+        self.gen = gen
 
 
 class WaitDone:
@@ -227,19 +368,49 @@ class ParameterServer:
             zk = jax.device_put(jnp.float32(0.0), self.device)
             self._apply(zw, zg, zk)
 
-        self._lock = threading.Lock()
+        # debug lock watchdog (net/lockwatch.py, async.debug.lockwatch):
+        # the model lock becomes a watched lock -- any socket send/recv
+        # under it raises at the frame choke point, continuously checking
+        # the lock-free PULL-serving claim in chaos/soak runs
+        from asyncframework_tpu.net import lockwatch as _lockwatch
+
+        if _lockwatch.enabled_for():
+            self._lock = _lockwatch.WatchedLock("ps.model")
+        else:
+            self._lock = threading.Lock()
         # ---- data plane: version-cached encoded PULL replies + deltas.
-        # One readback AND one encode per model version: _w_host is the
-        # host float32 array (the backing device array is already float32,
-        # so no astype copy), _w_wire its serialized payload bytes, _w_crc
-        # the integrity stamp delta/NOT_MODIFIED replies carry.  A whole
-        # cohort pull of an unchanged version is a dict lookup + a socket
-        # write.  _w_versions keeps recent versions' host arrays (bounded,
-        # version-age eviction) so a worker pulling with ``have=<ts>`` can
-        # be served a byte-exact XOR delta (net/wiredelta.py).
-        self._w_host: Optional[np.ndarray] = None  # host cache per version
-        self._w_wire: Optional[bytes] = None       # encoded payload cache
-        self._w_crc = 0
+        # One readback AND one encode per model version, published as an
+        # immutable _ModelSnap (host float32 array + serialized payload
+        # bytes + CRC) via ATOMIC REFERENCE SWAP: _handle_pull serves
+        # full/NOT_MODIFIED/delta replies from the published snapshot
+        # without touching the model lock -- a whole cohort pull of an
+        # unchanged version is an attribute read + a socket write, and
+        # PULL serving never queues behind a merge drain.  An accepted
+        # push clears the reference; the next pull rebuilds (readback +
+        # encode happen OUTSIDE the model lock, under _snap_build_lock so
+        # a cohort triggers one build, not P).  _w_versions keeps recent
+        # versions' host arrays (bounded, version-age eviction, its own
+        # small lock) so a worker pulling with ``have=<ts>`` can be
+        # served a byte-exact XOR delta (net/wiredelta.py).
+        self._snap: Optional[_ModelSnap] = None
+        # the build BASIS: (clock, device array) captured atomically at
+        # the end of every applying drain (O(1) tuple write under the
+        # lock the drain already holds).  A snapshot rebuild reads this
+        # reference instead of taking the model lock -- the pull path
+        # stays off the model lock even while rebuilding, so a merge
+        # convoy (continuous decoupled pushes keep handlers cycling the
+        # lock) cannot add its queueing delay to pull latency.
+        # model generation: +1 per ACCEPTED push (under the model lock,
+        # BEFORE its clock tick).  Snapshot re-stamping and publishing
+        # key off it -- see _ModelSnap.gen / _model_snap.
+        self._model_gen = 0
+        self._snap_basis: Tuple[int, object, int] = (0, self._w, 0)
+        self._snap_build_lock = threading.Lock()
+        self._versions_lock = threading.Lock()
+        # pull-path bookkeeping (reply-shape counters, pull timestamps,
+        # last-contact) keeps its own lock: read-modify-write safety
+        # without ever touching the model lock from the pull path
+        self._stats_lock = threading.Lock()
         from collections import OrderedDict as _OD2
         from asyncframework_tpu.conf import (
             PULL_DELTA_VERSIONS,
@@ -501,9 +672,10 @@ class ParameterServer:
                     f"{self.algo!r}"
                 )
             self._w = jax.device_put(z["w"], self.device)
-            self._w_host = None
-            self._w_wire = None
+            self._snap = None
             self._w_versions.clear()
+            self._snap_basis = (int(meta["clock"]), self._w,
+                                self._model_gen)
             self._clock = int(meta["clock"])
             self._k = int(meta["k"])
             self.accepted = int(meta["accepted"])
@@ -555,6 +727,11 @@ class ParameterServer:
                 target=self._serve_conn, args=(conn,), daemon=True
             )
             t.start()
+            # reap on append: a long-running elastic PS accepts a fresh
+            # connection per worker reconnect/retry -- without pruning,
+            # finished handler threads accumulate for the life of the
+            # process (one Thread object + name per connection ever made)
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _now_ms(self) -> float:
@@ -645,7 +822,9 @@ class ParameterServer:
                 elif op == "BYE":
                     # a departing worker's last completed spans (push.rtt
                     # of its final traced update has no later PUSH to ride)
+                    # and its final pipeline-counter delta
                     self._fold_wire_spans(header.get("spans"))
+                    _pl_fold(header.get("pl"))
                     _send_msg(conn, {"op": "ACK"})
                     return
                 else:
@@ -673,11 +852,48 @@ class ParameterServer:
                                    self.supervisor.live_worker_count()))
         return threshold
 
+    def _model_snap(self) -> _ModelSnap:
+        """The published snapshot of the current model version, built on
+        demand.  The fast path is one attribute read -- no locks at all.
+        A rebuild (first pull after an accepted push) reads the
+        atomically-published build basis and does the O(d) readback +
+        serialize + CRC without touching the model lock either;
+        ``_snap_build_lock`` makes a cohort trigger one build, not P."""
+        snap = self._snap
+        if snap is not None:
+            return snap
+        with self._snap_build_lock:
+            snap = self._snap
+            if snap is not None:
+                return snap
+            # the basis reference is written atomically by the drain (a
+            # tuple swap under the model lock); reading it here needs NO
+            # lock at all -- the build's only waits are the device
+            # readback and peer builders on _snap_build_lock
+            basis = self._snap_basis
+            ts, w_dev, gen = basis
+            # device readback without any lock: the updater rebinds _w to
+            # NEW buffers (w is never donated), so this one is immutable
+            w_host = np.asarray(w_dev)
+            wire = w_host.tobytes()
+            snap = _ModelSnap(int(ts), w_host, wire, wiredelta.crc(wire),
+                              int(gen))
+            # publish only while the model GENERATION is unchanged: a
+            # drain may be mid-apply right now (it bumped _model_gen in
+            # its accept branch, but writes the new basis only at drain
+            # end), and publishing a stale snap then would let the
+            # send-time re-stamp below pair the new clock with old
+            # bytes.  Serving the unpublished snap is still correct --
+            # it is stamped with ITS ts and staleness is priced.
+            if self._model_gen == gen:
+                self._snap = snap
+            return snap
+
     def _handle_pull(self, conn: socket.socket, header: dict) -> None:
         wid = int(header["wid"])
         proc = header.get("proc")
-        with self._lock:
-            if self._t0 is not None:
+        if self._t0 is not None:
+            with self._stats_lock:
                 self._last_contact[wid] = self._now_ms()
         sup = self.supervisor
         if sup is not None:
@@ -768,37 +984,52 @@ class ParameterServer:
             extra_hdr = {"cap": cap, "n_valid": int(idx.size)}
             extra_payload = idx_pad.tobytes() + alpha_sel.tobytes()
         have = header.get("have")
-        with self._lock:
-            ts = self._clock
-            # one readback AND one encode per model VERSION, not per pull:
-            # a whole cohort reads the same cached bytes.  The backing
-            # device array is float32 -- no astype copy on this path.
-            if self._w_host is None:
-                self._w_host = np.asarray(self._w)
-                self._w_wire = self._w_host.tobytes()
-                self._w_crc = wiredelta.crc(self._w_wire)
-            w_host, w_wire, w_crc = self._w_host, self._w_wire, self._w_crc
-            basis = None
-            if have is not None:
-                self._delta_clients_seen = True
-            if self._delta_versions > 0 and self._delta_clients_seen:
-                # recent-version cache for delta encoding, maintained only
-                # once a delta client exists; eviction is by version age
-                # (oldest ts first)
-                self._w_versions[ts] = w_host
-                self._w_versions.move_to_end(ts)
-                while len(self._w_versions) > self._delta_versions:
-                    self._w_versions.popitem(last=False)
-            if have is not None:
-                if int(have) == ts:
-                    # exact-version match needs no cache: the basis IS the
-                    # current version, so this encodes to NOT_MODIFIED
-                    # (the reply CRC still guards a cross-PS-life clash)
-                    basis = w_host
-                elif self._delta_versions > 0:
+        # LOCK-FREE model serving: everything below reads the published
+        # _ModelSnap (atomic reference) -- the model lock is never taken
+        # on this path (net/lockwatch.py asserts it in debug runs), so a
+        # cohort pull cannot queue behind a merge drain and a drain
+        # cannot stall behind a slow puller's socket.
+        if have is not None:
+            self._delta_clients_seen = True  # one-way flag, GIL-atomic
+        snap = self._model_snap()
+        ts, w_host, w_wire, w_crc = snap.ts, snap.w_host, snap.wire, snap.crc
+        # the clock may have ticked past the snapshot on DROPPED pushes
+        # (they advance the clock but not the model).  An accepted push
+        # bumps the model GENERATION before its clock tick, so if the
+        # generation still matches this snapshot's after an atomic clock
+        # read, every tick in between was a drop -- same bytes, newer
+        # version: stamp the current clock (send-time parity with the
+        # serial path).  A lost race just serves snap.ts, which only
+        # over-prices staleness, never mispairs version and bytes.
+        cur = self._clock
+        if cur != ts and self._model_gen == snap.gen:
+            ts = cur
+        basis = None
+        if have is not None and self._delta_versions > 0:
+            # recent-version cache for delta encoding, maintained only
+            # once a delta client exists; ts is monotone, so insertion
+            # order IS version age and eviction pops the oldest
+            with self._versions_lock:
+                if snap.ts not in self._w_versions:
+                    self._w_versions[snap.ts] = w_host
+                    while len(self._w_versions) > self._delta_versions:
+                        self._w_versions.popitem(last=False)
+                if ts != snap.ts and ts not in self._w_versions:
+                    self._w_versions[ts] = w_host  # same bytes, newer ts
+                    while len(self._w_versions) > self._delta_versions:
+                        self._w_versions.popitem(last=False)
+        if have is not None:
+            if int(have) == ts:
+                # exact-version match needs no cache: the basis IS the
+                # current version, so this encodes to NOT_MODIFIED
+                # (the reply CRC still guards a cross-PS-life clash)
+                basis = w_host
+            elif self._delta_versions > 0:
+                with self._versions_lock:
                     basis = self._w_versions.get(int(have))
+        with self._stats_lock:
             self._pull_times[wid] = self._now_ms()
-            avg = self.avg_delay_ms
+        avg = self.avg_delay_ms
         if tc is not None:
             # exactly the wave-gate wait (barrier cost), not the model
             # readback; folded here because the served version ts is only
@@ -832,11 +1063,11 @@ class ParameterServer:
                 model_hdr["nnz"] = nnz
             model_part = enc_payload
             model_hdr["wlen"] = len(model_part)
-            with self._lock:
+            with self._stats_lock:
                 self.pull_replies[wenc] = self.pull_replies.get(wenc, 0) + 1
                 self.pull_model_bytes += len(model_part)
         else:
-            with self._lock:
+            with self._stats_lock:
                 self.pull_replies["full"] += 1
                 self.pull_model_bytes += len(model_part)
         # vectored zero-copy framing: the cached model bytes and the ASAGA
@@ -861,6 +1092,10 @@ class ParameterServer:
         # that makes spans survive worker death); fold them before any
         # drop path so a membership-stale push still delivers its telemetry
         self._fold_wire_spans(header.get("spans"))
+        # pipelined-loop counter deltas piggyback the same way (only
+        # present when the worker runs the pipelined loop): dedup'd
+        # retries never reach this handler, so a delta folds exactly once
+        _pl_fold(header.get("pl"))
         tc = _trace.TraceContext.from_wire(header["tc"]) \
             if "tc" in header else None
         t_queue0 = _trace.now_ms() if tc is not None else 0.0
@@ -906,6 +1141,15 @@ class ParameterServer:
         with self._lock:
             while not item.done:
                 self._drain_merge_locked()
+        # pre-warm the pull snapshot for the version this drain produced,
+        # OFF the model lock, on this (push) thread: the next cohort pull
+        # finds it published and pays zero build latency.  A no-op when a
+        # peer already built it; worst case under heavy churn the build
+        # races a newer drain and is skipped at publish (CRC-gated
+        # fallback keeps even the raciest interleaving degrade-to-full,
+        # never wrong).
+        if item.accepted:
+            self._model_snap()
         if tc is not None:
             # staleness in TIME (ASAP's quantity): age of the model basis
             # this gradient was computed on = now - that version's pull.
@@ -995,6 +1239,15 @@ class ParameterServer:
                     and self._k < self.cfg.num_iterations
                 )
             if accepted:
+                # bump the model generation and unpublish the snapshot
+                # BEFORE the clock tick: a concurrent lock-free pull
+                # that reads this item's new clock must see the new
+                # generation too and keep the snapshot's own (older)
+                # version stamp -- never pair new version, old bytes.
+                # Dropped pushes tick the clock WITHOUT bumping: the
+                # model is unchanged, so the snapshot stays valid.
+                self._model_gen += 1
+                self._snap = None
                 batch.append((item, idx))
                 self._k += 1
                 self.accepted += 1
@@ -1061,8 +1314,10 @@ class ParameterServer:
                     self._w, self._k_dev = self._apply_merge(
                         self._w, G_dev, m_dev, self._k_dev
                     )
-            self._w_host = None  # new version; next pull re-materializes
-            self._w_wire = None
+            # publish the new build basis (O(1) tuple swap under the lock
+            # this drain already holds): the next snapshot rebuild reads
+            # it lock-free instead of queueing on the model lock
+            self._snap_basis = (self._clock, self._w, self._model_gen)
             self.merge_batches += 1
             self.merge_merged += len(batch)
             self.merge_batch_max = max(self.merge_batch_max, len(batch))
@@ -1221,6 +1476,9 @@ class ParameterServer:
             self._srv.close()
         except OSError:
             pass
+        # reap on stop: drop every finished handler thread (live ones are
+        # daemons draining their last reply; they exit with the sockets)
+        self._threads = [x for x in self._threads if x.is_alive()]
 
 
 # -------------------------------------------------------------- worker side
@@ -1241,7 +1499,8 @@ class PSClient:
                  session: Optional[ClientSession] = None,
                  proc: Optional[str] = None,
                  recorder: Optional["_trace.TraceRecorder"] = None,
-                 pull_mode: Optional[str] = None):
+                 pull_mode: Optional[str] = None,
+                 pl_stats: Optional[_PipelineStats] = None):
         self.host, self.port = host, int(port)
         self.endpoint = f"{host}:{self.port}"
         self.retry = retry if retry is not None else RetryPolicy.from_conf(
@@ -1270,12 +1529,26 @@ class PSClient:
         # event stream, so spans survive this worker's death.  None =
         # tracing off for this client, zero extra wire bytes.
         self.recorder = recorder
+        # pipelined-loop counters (prefetch hits / stale discards /
+        # in-flight depth): deltas piggyback on PUSH and BYE headers the
+        # same way spans do.  None (every non-pipelined client) = no
+        # header field, byte-identical wire.
+        self.pl_stats = pl_stats
         # elastic membership: the worker PROCESS token stamped on every
         # PULL/PUSH so the PS supervisor knows who serves which shard;
         # None = classic fixed-membership client
         self.proc = proc
         self.released = False    # the PS deposed this client's wid
         self._orders: List[int] = []  # adoption orders from PULL replies
+        # windowed push pipe (push_start/push_finish): sent-but-unACKed
+        # entries, oldest first -- replayed wholesale on reconnect.  The
+        # window lock serializes senders against the reaper's
+        # reconnect+replay; receives happen outside it (full duplex).
+        from collections import deque as _dq
+        self._push_window: "_dq[list]" = _dq()
+        self._win_lock = threading.Lock()
+        # the one in-flight prefetched PULL (pull_start/pull_finish)
+        self._pending_pull: Optional[tuple] = None
         self._sock: Optional[socket.socket] = None
         self.bytes_pushed = 0  # payload bytes shipped by push/push_saga
         # eager first dial (historical behavior: constructing a client to a
@@ -1416,19 +1689,14 @@ class PSClient:
         self.pull_model_bytes += len(model_part)
         return w
 
-    def _pull_model_rpc(self, wid: int, make_hdr, extra_len_of, tr
-                        ) -> Optional[Tuple[dict, bytes, np.ndarray]]:
-        """One negotiated model pull with the decode-mismatch fallback
-        shared by PULL and PULL_SAGA: the first request advertises the
-        basis (delta mode); if its reply fails to decode -- basis cache
-        miss, CRC disagreement -- the basis is dropped and ONE full
-        re-pull follows (a full reply always decodes; never a wrong
-        model).  Returns (header, payload, w), or None on RELEASED/DONE
-        (``self.released`` distinguishes them)."""
-        header, payload = self._traced_call(
-            tr, _trace.PULL_RTT,
-            self._proc_hdr(self._have_hdr(wid, make_hdr())),
-        )
+    def _process_pull_reply(self, wid: int, header: dict, payload: bytes,
+                            make_hdr, extra_len_of, tr
+                            ) -> Optional[Tuple[dict, bytes, np.ndarray]]:
+        """Shared back half of a model pull: RELEASED/DONE handling,
+        adoption orders, and decode with the ONE-full-re-pull fallback
+        (basis cache miss, CRC disagreement -- a full reply always
+        decodes; never a wrong model).  Returns (header, payload, w), or
+        None on RELEASED/DONE (``self.released`` distinguishes them)."""
         for fallback_left in (True, False):
             if header["op"] == "RELEASED":
                 self.released = True
@@ -1448,6 +1716,115 @@ class PSClient:
                 tr, _trace.PULL_RTT, self._proc_hdr(make_hdr())
             )
         raise ConnectionError("PULL: full reply failed to decode")
+
+    def _pull_model_rpc(self, wid: int, make_hdr, extra_len_of, tr
+                        ) -> Optional[Tuple[dict, bytes, np.ndarray]]:
+        """One negotiated model pull (request + reply + fallback)."""
+        header, payload = self._traced_call(
+            tr, _trace.PULL_RTT,
+            self._proc_hdr(self._have_hdr(wid, make_hdr())),
+        )
+        return self._process_pull_reply(wid, header, payload, make_hdr,
+                                        extra_len_of, tr)
+
+    # ---------------------------------------------------- prefetched pull
+    # The pipelined loop's pull prefetch: pull_start SENDS the next
+    # PULL and returns (the request parks in the PS wave gate and the
+    # reply accumulates in this socket's kernel buffer while the caller
+    # computes); pull_finish receives and decodes it.  Single-threaded
+    # by design -- the overlap lives in the socket, not in a thread --
+    # and safe to retry: a PULL is idempotent and unstamped, so a
+    # reconnect simply re-sends it.
+
+    def pull_start(self, wid: int, tr=None) -> None:
+        """Send the next PULL without waiting for the reply."""
+        hdr = self._proc_hdr(self._have_hdr(wid, {"op": "PULL",
+                                                  "wid": wid}))
+        token = tr.rpc_begin(_trace.PULL_RTT) if tr is not None else None
+        if tr is not None:
+            _trace.set_current(None)
+        # trailing slot: sent frame bytes, captured at send (see the
+        # push-window entries)
+        pending = [hdr, tr, token, 0]
+        self._pending_pull = pending
+        try:
+            if self._sock is None:
+                self._sock = _frame.connect(
+                    (self.host, self.port),
+                    timeout=self.retry.attempt_timeout_s,
+                )
+            if tr is not None:
+                _trace.set_current(tr.ctx)
+            try:
+                _send_msg(self._sock, hdr)
+                pending[3] = _frame.last_sent_bytes()
+            finally:
+                if tr is not None:
+                    _trace.set_current(None)
+        except OSError:
+            self._drop_sock()  # deferred: pull_finish re-dials + re-sends
+
+    def pull_ready(self) -> bool:
+        """True when the prefetched reply's first bytes are already in
+        the kernel buffer (the prefetch fully hid the pull)."""
+        if self._sock is None:
+            return False
+        import select
+
+        try:
+            return bool(select.select([self._sock], [], [], 0.0)[0])
+        except (OSError, ValueError):
+            return False
+
+    def pull_finish(self, wid: int
+                    ) -> Optional[Tuple[int, np.ndarray, float, bool]]:
+        """Receive the prefetched PULL's reply; same returns as
+        :meth:`pull`.  A dead connection re-dials and re-sends the
+        pending request under the retry policy."""
+        pending = self._pending_pull
+        if pending is None:
+            raise RuntimeError("pull_finish without pull_start")
+        hdr, tr, token = pending[0], pending[1], pending[2]
+
+        def attempt() -> Tuple[dict, bytes]:
+            try:
+                if self._sock is None:
+                    self._sock = _frame.connect(
+                        (self.host, self.port),
+                        timeout=self.retry.attempt_timeout_s,
+                    )
+                    if tr is not None:
+                        _trace.set_current(tr.ctx)
+                    try:
+                        _send_msg(self._sock, hdr)
+                        pending[3] = _frame.last_sent_bytes()
+                    finally:
+                        if tr is not None:
+                            _trace.set_current(None)
+                return _recv_msg(self._sock)
+            except OSError:
+                self._drop_sock()
+                raise
+
+        try:
+            header, payload = self.retry.call(attempt,
+                                              endpoint=self.endpoint)
+        finally:
+            self._pending_pull = None
+        if tr is not None and token is not None:
+            tr.rpc_end(token,
+                       bytes=pending[3] + _frame.last_recv_bytes())
+        got = self._process_pull_reply(
+            wid, header, payload,
+            lambda: {"op": "PULL", "wid": wid}, lambda _h: 0, tr,
+        )
+        if got is None:
+            return None
+        header, _payload, w = got
+        if tr is not None:
+            tr.set_model_version(int(header["ts"]))
+        return (int(header["ts"]), w, float(header["avg_delay_ms"]),
+                bool(header["calibrated"]))
 
     def pull(self, wid: int, tr=None
              ) -> Optional[Tuple[int, np.ndarray, float, bool]]:
@@ -1482,14 +1859,12 @@ class PSClient:
         return nz.size, (nz.astype(np.uint32).tobytes()
                          + g[nz].astype(np.float32).tobytes())
 
-    def push(self, wid: int, ts: int, g: np.ndarray,
-             sparse: bool = False, diff: Optional[np.ndarray] = None,
-             tr=None) -> Tuple[bool, bool]:
-        """Returns (accepted, run_done).  ``diff`` (ASAGA candidate history
-        scalars) rides after the gradient when given.  ``tr`` records this
-        push's encode time (push.wait) and round trip (push.rtt); any
-        completed spans in the client's recorder piggyback on the header
-        either way."""
+    def _encode_push(self, wid: int, ts: int, g: np.ndarray,
+                     sparse: bool, diff: Optional[np.ndarray], tr
+                     ) -> Tuple[dict, bytes, List[dict], dict]:
+        """Shared encode/stamp front half of :meth:`push` and
+        :meth:`push_start`: returns ``(header, payload, spans, pl_delta)``
+        with the piggybacks already attached to the header."""
         t_enc0 = _trace.now_ms() if tr is not None else 0.0
         g = np.asarray(g, np.float32)
         # ASAGA pushes ride their own verb so fault schedules can tell the
@@ -1517,6 +1892,36 @@ class PSClient:
             spans = self.recorder.drain_wire()
             if spans:
                 hdr["spans"] = spans
+        pl_delta: dict = {}
+        if self.pl_stats is not None:
+            # pipeline-counter piggyback, same discipline as spans: ship
+            # the unshipped delta; the PS folds it once (dedup'd retries
+            # never reach the handler)
+            pl_delta = self.pl_stats.take_wire()
+            if pl_delta:
+                hdr["pl"] = pl_delta
+        return hdr, payload, spans, pl_delta
+
+    def _requeue_piggybacks(self, spans: List[dict], pl_delta: dict) -> None:
+        """A push whose whole retry budget was spent must not silently eat
+        its piggybacked telemetry: spans and counter deltas go back to
+        ride the next push/BYE."""
+        if spans and self.recorder is not None:
+            self.recorder.requeue(spans)
+        if pl_delta and self.pl_stats is not None:
+            self.pl_stats.merge_back(pl_delta)
+
+    def push(self, wid: int, ts: int, g: np.ndarray,
+             sparse: bool = False, diff: Optional[np.ndarray] = None,
+             tr=None) -> Tuple[bool, bool]:
+        """Returns (accepted, run_done).  ``diff`` (ASAGA candidate history
+        scalars) rides after the gradient when given.  ``tr`` records this
+        push's encode time (push.wait) and round trip (push.rtt); any
+        completed spans in the client's recorder piggyback on the header
+        either way."""
+        hdr, payload, spans, pl_delta = self._encode_push(
+            wid, ts, g, sparse, diff, tr
+        )
         # stamp ONCE: retries re-send the same (sid, seq), so a push whose
         # ACK was lost is answered from the PS dedup window, not re-applied
         try:
@@ -1525,16 +1930,119 @@ class PSClient:
                 self.session.stamp(self._proc_hdr(hdr)), payload,
             )
         except BaseException:
-            if spans and self.recorder is not None:
-                # the whole retry budget is spent (PS down longer than one
-                # policy window): put the undelivered piggyback back so it
-                # rides the next push/BYE instead of vanishing -- these
-                # spans describe exactly the fault window being traced
-                self.recorder.requeue(spans)
+            self._requeue_piggybacks(spans, pl_delta)
             raise
         if header.get("released"):
             self.released = True
         return bool(header.get("accepted")), bool(header.get("done"))
+
+    # ------------------------------------------------- windowed push pipe
+    # The pipelined sender's wire window: push k+1 goes OUT before push
+    # k's ACK returns, so per-update push cost drops from a full RTT to
+    # the send itself.  The server already supports this shape -- its
+    # per-connection loop handles frames in order and replies in order --
+    # so ACKs pair with pushes FIFO.  Exactly-once survives every fault:
+    # each entry is stamped once, and on any connection error the whole
+    # unacked window is REPLAYED on the fresh socket (the PS dedup window
+    # re-ACKs already-applied entries instead of re-merging them).  These
+    # concurrency contract: any number of calls from ONE sending thread
+    # (push_start) plus ONE reaping thread (push_finish/push_abandon);
+    # the window lock serializes sends and reconnect/replay, receives
+    # run outside it (TCP full duplex).
+
+    def push_start(self, wid: int, ts: int, g: np.ndarray,
+                   sparse: bool = False,
+                   diff: Optional[np.ndarray] = None, tr=None) -> None:
+        """Encode, stamp, window, and SEND one push without waiting for
+        its ACK.  A send error (or an already-dead socket) is deferred:
+        the entry stays in the window and :meth:`push_finish`'s
+        reconnect replays it."""
+        hdr, payload, spans, pl_delta = self._encode_push(
+            wid, ts, g, sparse, diff, tr
+        )
+        token = tr.rpc_begin(_trace.PUSH_RTT) if tr is not None else None
+        if tr is not None:
+            _trace.set_current(None)  # _send_entry scopes the context
+        # trailing slot: this entry's sent frame bytes (captured at send,
+        # so the rtt span's `bytes` pairs OUR send with OUR reply even
+        # though the single-threaded loop interleaves other frames)
+        entry = [self.session.stamp(self._proc_hdr(hdr)), payload, tr,
+                 token, spans, pl_delta, 0]
+        with self._win_lock:
+            self._push_window.append(entry)
+            if self._sock is not None:
+                try:
+                    self._send_entry(entry)
+                except OSError:
+                    self._drop_sock()  # reaper reconnects and replays
+
+    def _send_entry(self, entry) -> None:
+        hdr, payload, tr = entry[0], entry[1], entry[2]
+        if tr is not None:
+            _trace.set_current(tr.ctx)  # the tc header for THIS push
+        try:
+            _send_msg(self._sock, hdr, payload)
+            entry[6] = _frame.last_sent_bytes()
+        finally:
+            if tr is not None:
+                _trace.set_current(None)
+
+    def _replay_window(self) -> None:
+        """Re-send every unacked push on the (fresh) socket, oldest
+        first, same stamps: applied-but-unACKed entries are answered from
+        the PS dedup window, lost ones are applied now -- FIFO ACK
+        pairing is preserved either way."""
+        for entry in self._push_window:
+            self._send_entry(entry)
+
+    def inflight_pushes(self) -> int:
+        return len(self._push_window)
+
+    def push_finish(self) -> Tuple[bool, bool]:
+        """Receive the OLDEST in-flight push's ACK (FIFO), under the
+        retry policy: a dead connection is re-dialed and the unacked
+        window replayed before the next receive attempt.  Returns
+        (accepted, run_done)."""
+
+        def attempt() -> Tuple[dict, bytes]:
+            try:
+                with self._win_lock:
+                    sock = self._sock
+                    if sock is None:
+                        sock = self._sock = _frame.connect(
+                            (self.host, self.port),
+                            timeout=self.retry.attempt_timeout_s,
+                        )
+                        self._replay_window()
+                # recv OUTSIDE the window lock: the sender keeps sending
+                # while this blocks (full duplex)
+                return _recv_msg(sock)
+            except OSError:
+                self._drop_sock()
+                raise
+
+        header, _ = self.retry.call(attempt, endpoint=self.endpoint)
+        entry = self._push_window.popleft()
+        _hdr, _payload, tr, token, _spans, _pl, sent_bytes = entry
+        if tr is not None and token is not None:
+            tr.rpc_end(token,
+                       bytes=sent_bytes + _frame.last_recv_bytes())
+        if header.get("released"):
+            self.released = True
+        return bool(header.get("accepted")), bool(header.get("done"))
+
+    def push_abandon(self) -> int:
+        """Drop every in-flight push (the window's whole retry budget is
+        spent -- the serial loop's error path loses its round the same
+        way), requeueing piggybacked telemetry.  Returns the number of
+        pushes abandoned."""
+        with self._win_lock:
+            n = len(self._push_window)
+            while self._push_window:
+                entry = self._push_window.popleft()
+                self._requeue_piggybacks(entry[4], entry[5])
+            self._drop_sock()
+        return n
 
     def pull_saga(self, wid: int, n_p: int, tr=None) -> Optional[
         Tuple[int, np.ndarray, np.ndarray, np.ndarray, int, float, bool]
@@ -1579,6 +2087,15 @@ class PSClient:
 
     def bye(self) -> None:
         try:
+            if self._pending_pull is not None:
+                # a prefetched PULL is still parked in the PS wave gate:
+                # its MODEL reply would arrive (possibly after a ~1 s
+                # starvation-fallback wait) ahead of any BYE ACK.  Just
+                # drop the connection -- the PS treats EOF as goodbye,
+                # and this client's telemetry rides its sibling push
+                # connection's BYE.
+                self._drop_sock()
+                return
             if self._sock is not None:
                 hdr: dict = {"op": "BYE"}
                 if self.recorder is not None:
@@ -1587,6 +2104,10 @@ class PSClient:
                     spans = self.recorder.drain_wire()
                     if spans:
                         hdr["spans"] = spans
+                if self.pl_stats is not None:
+                    pl_delta = self.pl_stats.take_wire()
+                    if pl_delta:
+                        hdr["pl"] = pl_delta
                 _send_msg(self._sock, hdr)
                 _recv_msg(self._sock)
         except (ConnectionError, OSError):
@@ -1629,6 +2150,13 @@ def run_worker_process(
     thread starts serving it.  A thread whose wid is reclaimed by a
     rejoining process is told RELEASED and stands down.  With
     ``shard_factory=None`` adoption orders are ignored (classic behavior).
+
+    Pipelining (``async.pipeline.depth`` / ``SolverConfig.pipeline_depth``):
+    depth 0 runs the classic serial loop below, byte- and step-identical;
+    depth >= 1 runs :func:`pipelined_worker_loop` -- prefetched pulls on a
+    second connection, a bounded in-flight push sender, and the
+    host<->device transfers staged off the compute thread.  ASAGA always
+    runs serial (PS-side sampling requires pull->push alternation).
     """
     import jax
 
@@ -1654,6 +2182,23 @@ def run_worker_process(
     counts = {wid: 0 for wid in wids}
     stop = threading.Event()
     calibrated_once = threading.Event()
+    # pipelined update loop (async.pipeline.depth): 0 = the classic
+    # serial pull -> compute -> push loop below, untouched (byte- and
+    # step-identical); >= 1 = prefetched pulls on a second connection +
+    # a bounded in-flight push sender (at most `depth` unacked pushes).
+    pipe_depth = getattr(cfg, "pipeline_depth", None)
+    if pipe_depth is None:
+        from asyncframework_tpu.conf import PIPELINE_DEPTH, global_conf
+
+        pipe_depth = global_conf().get(PIPELINE_DEPTH)
+    pipe_depth = max(0, int(pipe_depth))
+    if algo == "asaga":
+        # the PS samples per pull and holds ONE pending (idx, alpha) slot
+        # per wid: a prefetched pull would clobber the slot the in-flight
+        # push must commit against.  ASAGA keeps the strict pull->push
+        # alternation; pipelining is an ASGD-path capability.
+        pipe_depth = 0
+    pl_stats = _PipelineStats() if pipe_depth > 0 else None
     # elastic adoption bookkeeping: which wids this process serves (own +
     # adopted), and every loop thread ever started (joined at the end)
     group_lock = threading.Lock()
@@ -1826,8 +2371,187 @@ def run_worker_process(
                         active_wids.discard(wid)
                 cl.bye()
 
+    def pipelined_worker_loop(wid: int) -> None:
+        """Pipelined update loop (``async.pipeline.depth`` >= 1): the
+        serial loop's per-update stall structure is pull(RTT + wave wait)
+        -> compute -> push(RTT + merge wait), strictly serialized -- the
+        device idles during every RTT and the socket idles during every
+        compute.  Here the three overlap, on ONE thread per worker (the
+        overlap lives in the kernel socket buffers, not in extra threads
+        whose GIL handoffs would eat the win):
+
+        - **prefetched pulls** on a second PSClient connection:
+          ``pull_start`` SENDS the pull for model v(k+1) before step k
+          computes; the request parks in the PS wave gate and the reply
+          lands in this socket's kernel buffer while the step runs
+          (delta-mode ``have=`` pulls make an unchanged version a
+          header-only NOT_MODIFIED); ``pull_finish`` then decodes it --
+          usually without blocking at all (``prefetch_hits``);
+        - **decoupled pushes** on a bounded wire window:
+          ``push_start`` sends step k's gradient and the loop moves
+          straight on -- push k+1 goes out before ACK k returns (the
+          server replies in order, so ACKs pair FIFO); ACKs are reaped
+          lazily, and only when ``depth`` pushes are unacknowledged
+          does the loop block on one (``push_finish``);
+        - staleness stays bounded: the PS's taw admission prices the
+          in-flight window, and a taw REJECTION makes this loop discard
+          its prefetched model and pull fresh (``stale_discards``).
+
+        Exactly-once pushes ride the session/dedup machinery: window
+        entries are stamped once and REPLAYED on reconnect, so a
+        delivered-but-unACKed push is re-answered from the PS dedup
+        window, never re-applied.  Adoption orders (they ride PULL
+        replies, so they arrive on the prefetch connection),
+        RELEASED/DONE, and trace spans all keep working; the residual
+        stall (blocking in pull_finish or on the window cap) is
+        recorded as the ``pipeline`` trace stage."""
+        shard = shards[wid]
+        dev = shard_dev(shard)
+        stage, readback = steps.make_pipelined_transfer(dev)
+        key = jax.device_put(
+            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid), dev
+        )
+        deadline = time.monotonic() + deadline_s
+        pull_cl: Optional[PSClient] = None
+        push_cl: Optional[PSClient] = None
+        done = False
+        stale_feedback = False
+
+        def reap_one() -> None:
+            """Collect the oldest in-flight push's ACK (FIFO)."""
+            nonlocal done, stale_feedback
+            try:
+                accepted, acked_done = push_cl.push_finish()
+                pl_stats.bump("pushes_async")
+                if acked_done:
+                    done = True
+                elif not accepted:
+                    # taw rejection: the in-flight window ran too stale
+                    # -- discard the prefetched model and pull fresh
+                    stale_feedback = True
+            except (ConnectionError, OSError):
+                # whole retry budget spent: the unacked window is lost,
+                # exactly as the serial loop's error path loses its
+                # round; pace and keep going
+                lost = push_cl.push_abandon()
+                pl_stats.bump("push_errors", max(lost, 1))
+                time.sleep(0.2)
+
+        try:
+            while not stop.is_set() and time.monotonic() < deadline:
+                try:
+                    pull_cl = PSClient(host, port, proc=proc_token,
+                                       recorder=recorder,
+                                       pull_mode=getattr(cfg, "pull_mode",
+                                                         None))
+                    push_cl = PSClient(host, port, proc=proc_token,
+                                       recorder=recorder,
+                                       pull_mode=getattr(cfg, "pull_mode",
+                                                         None),
+                                       pl_stats=pl_stats)
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(0.2)  # PS mid-restart: pace and re-dial
+            if push_cl is None:
+                return
+            tr = recorder.start_update(wid) if recorder is not None else None
+            pull_cl.pull_start(wid, tr=tr)
+            while (not stop.is_set() and not done
+                   and time.monotonic() < deadline):
+                was_ready = pull_cl.pull_ready()
+                t_w0 = _trace.now_ms()
+                try:
+                    got = pull_cl.pull_finish(wid)
+                except (ConnectionError, OSError):
+                    time.sleep(0.2)
+                    tr = (recorder.start_update(wid)
+                          if recorder is not None else None)
+                    pull_cl.pull_start(wid, tr=tr)
+                    continue
+                if got is None:
+                    break  # DONE, or this wid was RELEASED to a rejoiner
+                if was_ready:
+                    pl_stats.bump("prefetch_hits")   # reply was buffered
+                else:
+                    pl_stats.bump("prefetch_waits")  # loop blocked on it
+                if tr is not None:
+                    # the pipeline's residual stall: whatever pull wait
+                    # the prefetch could not hide
+                    tr.add(_trace.PIPELINE, t_w0, _trace.now_ms())
+                # adoption orders ride PULL replies, i.e. arrive on the
+                # prefetch connection
+                if shard_factory is not None:
+                    for orphan in pull_cl.take_orders():
+                        adopt(orphan)
+                if stale_feedback:
+                    # stale-prefetch discard: pull fresh instead of
+                    # computing on a basis the taw filter just priced out
+                    # (delta mode makes the re-pull nearly free)
+                    stale_feedback = False
+                    pl_stats.bump("stale_discards")
+                    tr = (recorder.start_update(wid)
+                          if recorder is not None else None)
+                    pull_cl.pull_start(wid, tr=tr)
+                    continue
+                ts, w_host, avg_ms, calibrated = got
+                cur_tr = tr
+                # prefetch the NEXT model before computing: its wave-gate
+                # wait and RTT ride this step's compute
+                tr = (recorder.start_update(wid)
+                      if recorder is not None else None)
+                pull_cl.pull_start(wid, tr=tr)
+                if calibrated and not calibrated_once.is_set():
+                    delay_model.calibrate(avg_ms)
+                    calibrated_once.set()
+                t_c0 = _trace.now_ms() if cur_tr is not None else 0.0
+                dly = delay_model.delay_ms(wid) if calibrated else 0.0
+                if dly > 0:
+                    time.sleep(dly / 1e3)
+                w_dev = stage(w_host)
+                counts[wid] += 1
+                g, key = run_step(shard, w_dev, key)
+                g_host = readback(g)
+                if cur_tr is not None:
+                    cur_tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
+                # depth cap: at most pipe_depth unACKed pushes in flight
+                # -- THE staleness bound the taw admission prices.  Reap
+                # lazily: ACKs usually sit in the buffer already.
+                t_q0 = _trace.now_ms() if cur_tr is not None else 0.0
+                blocked = False
+                while (push_cl.inflight_pushes() >= pipe_depth
+                       and not done):
+                    blocked = True
+                    reap_one()
+                if done:
+                    break
+                push_cl.push_start(wid, ts, g_host, sparse=sparse,
+                                   tr=cur_tr)
+                pl_stats.high_water("inflight_max",
+                                    push_cl.inflight_pushes())
+                if blocked and cur_tr is not None:
+                    # window backpressure: the bounded in-flight cap held
+                    # the loop back -- the other face of the pipeline
+                    # stage
+                    cur_tr.add(_trace.PIPELINE, t_q0, _trace.now_ms())
+        finally:
+            if push_cl is not None:
+                # drain the window: every sent push gets its verdict (a
+                # DONE ack inside the tail is fine -- we are leaving)
+                while push_cl.inflight_pushes():
+                    reap_one()
+            released = ((pull_cl is not None and pull_cl.released)
+                        or (push_cl is not None and push_cl.released))
+            if released:
+                with group_lock:
+                    active_wids.discard(wid)
+            if push_cl is not None:
+                push_cl.bye()
+            if pull_cl is not None:
+                pull_cl.bye()
+
     def spawn(w: int) -> None:
-        t = threading.Thread(target=worker_loop, args=(w,), daemon=True)
+        target = pipelined_worker_loop if pipe_depth > 0 else worker_loop
+        t = threading.Thread(target=target, args=(w,), daemon=True)
         with group_lock:
             threads.append(t)
         t.start()

@@ -314,6 +314,11 @@ class SolverConfig:
     # fused device apply at lock acquisition; 1 = classic serial path).
     pull_mode: Optional[str] = None
     push_merge: Optional[int] = None
+    # pipeline_depth: None = resolve from conf async.pipeline.depth
+    # (0 = the classic serial worker loop, byte- and step-identical;
+    # >= 1 = prefetched pulls on a second connection + a bounded
+    # in-flight push sender with at most this many unacked pushes).
+    pipeline_depth: Optional[int] = None
     # checkpoint/resume (SURVEY.md section 5: a capability the reference lacks)
     checkpoint_dir: Optional[str] = None  # None = checkpointing off
     checkpoint_freq: int = 0              # accepted updates between saves; 0 = off

@@ -449,18 +449,28 @@ DCN_CONFIGS = {
 
 
 def run_dcn_child() -> None:
-    """One fresh-process DCN wire bench; prints one JSON line."""
+    """One fresh-process DCN wire bench; prints one JSON line.
+
+    Four arms per config: pull mode (full/delta) x update-loop pipelining
+    (off/on, ``async.pipeline.depth``).  The ``*_pipe`` arms are the
+    pipelined-update-loop A-B the tentpole is judged by: same wire modes,
+    prefetched pulls + decoupled pushes + lock-free PULL serving on top.
+    Each arm also records the trace decomposition (pull.wait/push.wait/
+    pipeline p50s) and the pipeline counters."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     from asyncframework_tpu.conf import AsyncConf, set_global_conf
     from asyncframework_tpu.data.sharded import ShardedDataset
     from asyncframework_tpu.data.sparse import SparseShardedDataset
+    from asyncframework_tpu.metrics import trace as trace_mod
     from asyncframework_tpu.net import frame, reset_net_totals
     from asyncframework_tpu.parallel import ps_dcn
     from asyncframework_tpu.solvers import SolverConfig
 
     devices = jax.devices()
+    # BENCH_DCN_PIPELINE=0 drops the pipelined arms entirely
+    pipe_depth = max(0, int(os.environ.get("BENCH_DCN_PIPELINE", "2")))
     out = {}
     for name, c in DCN_CONFIGS.items():
         if c["sparse"]:
@@ -474,11 +484,21 @@ def run_dcn_child() -> None:
                 noise=0.01,
             )
         out[name] = {}
-        for mode in ("full", "delta"):
+        arms = [("full", 0), ("delta", 0)]
+        if pipe_depth > 0:
+            arms += [("full", pipe_depth), ("delta", pipe_depth)]
+        for mode, depth in arms:
+            label = mode if depth == 0 else f"{mode}_pipe"
             conf = AsyncConf()
             conf.set("async.pull.mode", mode)
+            conf.set("async.pipeline.depth", depth)
+            # per-stage latency decomposition rides the artifact (same
+            # sampling cost in every arm, so the A-B stays fair)
+            conf.set("async.trace.sample", 1.0 / 8.0)
             set_global_conf(conf)
             reset_net_totals()
+            ps_dcn.reset_pipeline_totals()
+            trace_mod.reset_aggregator()
             cfg = SolverConfig(
                 num_workers=c["nw"], num_iterations=c["iters"],
                 gamma=c["gamma"], taw=2**31 - 1,
@@ -501,7 +521,8 @@ def run_dcn_child() -> None:
             bt = frame.bytes_totals()
             pulls = max(sum(ps.pull_replies.values()), 1)
             pushes = max(ps.accepted + ps.dropped, 1)
-            out[name][mode] = {
+            stages = trace_mod.aggregator().snapshot().get("stages_ms", {})
+            rec = {
                 "ok": bool(done),
                 "accepted": ps.accepted,
                 "updates_per_sec": round(ps.accepted / elapsed, 1)
@@ -514,15 +535,33 @@ def run_dcn_child() -> None:
                 "pull_model_bytes_avg": round(ps.pull_model_bytes / pulls),
                 "pull_replies": dict(ps.pull_replies),
                 "push_payload_bytes_avg": round(ps.push_bytes / pushes),
+                "max_staleness": ps.max_staleness,
                 "merge": {"batches": ps.merge_batches,
                           "pushes": ps.merge_merged,
                           "max_batch": ps.merge_batch_max},
+                # worker-loop stall decomposition: the pipelined arms
+                # should show pull.wait/push.wait p50 shrinking with the
+                # residual stall surfacing under "pipeline"
+                "trace_p50_ms": {
+                    st: round(s["p50"], 3) for st, s in stages.items()
+                },
             }
+            if depth > 0:
+                rec["pipeline"] = ps_dcn.pipeline_totals()
+            out[name][label] = rec
         full_b = out[name]["full"]["wire_bytes_per_update"]
         delta_b = out[name]["delta"]["wire_bytes_per_update"]
         out[name]["wire_bytes_ratio_full_over_delta"] = (
             round(full_b / delta_b, 2) if delta_b else None
         )
+        for mode in ("full", "delta"):
+            if f"{mode}_pipe" not in out[name]:
+                continue
+            off = out[name][mode]["updates_per_sec"]
+            on = out[name][f"{mode}_pipe"]["updates_per_sec"]
+            out[name][f"pipeline_speedup_{mode}"] = (
+                round(on / off, 3) if off and on else None
+            )
     emit({"dcn": out})
 
 
@@ -560,9 +599,23 @@ def run_probe() -> None:
           "n_devices": len(devices), "init_s": round(time.monotonic() - t0, 1)})
 
 
+# Probe FAILURES are cached per target platform for the life of this
+# invocation: a dead TPU tunnel costs 2 x 75 s ONCE, not once per config /
+# per fallback pass (BENCH_r05 burned the probe budget repeatedly before
+# every CPU fallback).  Successes are deliberately NOT cached -- the
+# wedge path re-probes precisely to detect a device link that died mid-run.
+_PROBE_FAILURES: dict = {}
+
+
 def probe_backend(env: dict) -> Tuple[bool, str]:
     """Run the probe subprocess with a hard timeout, bounded retries.
-    Returns (alive, note)."""
+    Returns (alive, note); a failure is memoized per platform."""
+    platform = env.get("BENCH_PLATFORM") or "default"
+    cached = _PROBE_FAILURES.get(platform)
+    if cached is not None:
+        print(f"# backend probe: cached failure for platform "
+              f"{platform!r} -- {cached[1]}", file=sys.stderr)
+        return cached
     for attempt in range(1, PROBE_ATTEMPTS + 1):
         t0 = time.monotonic()
         try:
@@ -587,8 +640,11 @@ def probe_backend(env: dict) -> Tuple[bool, str]:
         print(f"# backend probe {attempt}/{PROBE_ATTEMPTS}: rc="
               f"{out.returncode} stderr tail: {out.stderr[-300:]}",
               file=sys.stderr)
-    return False, (f"backend unavailable: {PROBE_ATTEMPTS} probe attempts "
-                   f"failed/hung within {PROBE_TIMEOUT_S:.0f}s each")
+    failed = (False,
+              f"backend unavailable: {PROBE_ATTEMPTS} probe attempts "
+              f"failed/hung within {PROBE_TIMEOUT_S:.0f}s each")
+    _PROBE_FAILURES[platform] = failed
+    return failed
 
 
 # -------------------------------------------------------------------- parent

@@ -32,6 +32,10 @@ def run_seed(seed: int, args) -> dict:
     env = dict(os.environ)
     env["ASYNC_CHAOS_SEED"] = str(seed)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # debug lock watchdog on for every sweep seed: any socket send/recv
+    # under the PS model lock fails the seed loudly (the lock-free PULL
+    # serving claim is re-checked on every fault interleaving)
+    env.setdefault("ASYNCTPU_ASYNC_DEBUG_LOCKWATCH", "1")
     marker = "chaos or soak" if args.soak else "chaos"
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",

@@ -55,6 +55,20 @@ def _clean_net_state():
     faults.clear()
 
 
+@pytest.fixture(autouse=True)
+def _lockwatch_on():
+    """Debug lock watchdog (net/lockwatch.py) armed for the whole chaos
+    suite: every PS constructed here gets a watched model lock, so any
+    socket send/recv under it -- the contention the lock-free PULL path
+    removed -- fails the test at the frame choke point instead of
+    surviving as a silent regression."""
+    from asyncframework_tpu.net import lockwatch
+
+    lockwatch.enable(True)
+    yield
+    lockwatch.enable(False)
+
+
 def make_cfg(**kw):
     defaults = dict(
         num_workers=1, num_iterations=30, gamma=1.2, taw=2**31 - 1,
