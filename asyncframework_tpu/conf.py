@@ -310,3 +310,34 @@ ELASTIC_BOOT_GRACE_S = ConfigEntry(
     "async.elastic.boot.grace.s", 10.0, float,
     "Never-contacted shards are not handed out for adoption before this "
     "much run time has passed (covers slow worker bring-up/compile).")
+# ----------------------------------------------------------- serving plane
+# The read path (asyncframework_tpu/serving/): ModelReplica processes
+# subscribe to the PS's versioned snapshots (SUBSCRIBE = a wave-gate-free
+# delta-negotiated pull) and answer PREDICT RPCs while training runs; a
+# ServingFrontend round-robins client requests over registered replicas
+# with retry/circuit-breaker failover.
+SERVE_REFRESH_S = ConfigEntry(
+    "async.serve.refresh.interval.s", 0.05, float,
+    "Replica background refresh period: how often a ModelReplica sends a "
+    "SUBSCRIBE (delta-mode have= pull, CRC-gated, full-pull fallback) to "
+    "the PS.  Bounds the replica's freshness lag when training is "
+    "advancing the model.")
+SERVE_MAX_STALE_MS = ConfigEntry(
+    "async.serve.max.staleness.ms", 2000.0, float,
+    "A replica whose last SUCCESSFUL refresh is older than this marks "
+    "itself unhealthy: PREDICT is answered UNHEALTHY (the frontend fails "
+    "over) until a refresh lands again.  0 disables the health gate -- "
+    "the replica serves its last model forever (bounded-staleness reads "
+    "degrade to eventual consistency).")
+SERVE_REPLICAS = ConfigEntry(
+    "async.serve.replicas", 2, int,
+    "Replica count launchers (bench --serve, k8s manifests) provision.")
+SERVE_MAX_REPLICAS = ConfigEntry(
+    "async.serve.max.replicas", 16, int,
+    "Registration slots a ServingFrontend allocates (the ElasticSupervisor "
+    "membership table is sized once).")
+SERVE_DEADLINE_S = ConfigEntry(
+    "async.serve.failover.deadline.s", 2.0, float,
+    "Frontend per-request budget across failover attempts: a PREDICT that "
+    "cannot be answered by ANY healthy replica within this raises "
+    "PredictError to the caller.")

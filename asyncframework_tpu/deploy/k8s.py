@@ -209,6 +209,75 @@ def render_topic_server(namespace: str = "default",
     ]
 
 
+SERVE_PORT = 7080
+
+
+def render_serving(replicas: int, ps: str, namespace: str = "default",
+                   image: str = DEFAULT_IMAGE,
+                   resources: Optional[dict] = None) -> List[dict]:
+    """Serving tier (asyncframework_tpu/serving/): a frontend Deployment +
+    Service (the stable predict endpoint) and a replica Deployment whose
+    pods SUBSCRIBE to the given PS address and HELLO the frontend Service
+    on boot.  Replica pod churn is safe by construction: a killed pod
+    drops out of the frontend rotation (pid probe / silence) and its
+    replacement re-HELLOs in; scaling reads is ``kubectl scale`` on the
+    replica Deployment -- no state moves, every replica serves the same
+    subscribed model."""
+    if replicas < 1:
+        raise ValueError("replicas must be >= 1")
+    if not ps:
+        raise ValueError("serving needs the PS address to SUBSCRIBE to")
+    fe_cmd = ["python", "-m", "asyncframework_tpu.serving.cli",
+              "frontend", "--host", "0.0.0.0", "--port", str(SERVE_PORT)]
+    rep_cmd = ["python", "-m", "asyncframework_tpu.serving.cli",
+               "replica", "--ps", ps, "--host", "0.0.0.0",
+               "--port", str(SERVE_PORT + 1),
+               "--frontend", f"async-serve:{SERVE_PORT}"]
+    return [
+        {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": _meta("async-serve-frontend", "serve-frontend",
+                              namespace),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "async-serve-frontend"}},
+                "template": {
+                    "metadata": {"labels":
+                                 {"app": "async-serve-frontend"}},
+                    "spec": {"containers": [_container(
+                        "frontend", image, fe_cmd, ports=[SERVE_PORT],
+                    )]},
+                },
+            },
+        },
+        {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": _meta("async-serve", "serve-frontend", namespace),
+            "spec": {"selector": {"app": "async-serve-frontend"},
+                     "ports": [{"name": "predict", "port": SERVE_PORT,
+                                "targetPort": SERVE_PORT}]},
+        },
+        {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": _meta("async-serve-replicas", "serve-replica",
+                              namespace),
+            "spec": {
+                "replicas": replicas,
+                "selector": {"matchLabels": {"app": "async-serve-replica"}},
+                "template": {
+                    "metadata": {"labels":
+                                 {"app": "async-serve-replica"}},
+                    "spec": {"containers": [_container(
+                        "replica", image, rep_cmd,
+                        ports=[SERVE_PORT + 1],
+                        resources=resources,
+                    )]},
+                },
+            },
+        },
+    ]
+
+
 def render_app_job(name: str, argv: List[str], num_processes: int,
                    namespace: str = "default", image: str = DEFAULT_IMAGE,
                    supervise: bool = True,
@@ -246,8 +315,9 @@ def render_app_job(name: str, argv: List[str], num_processes: int,
 
 def render_cluster(workers: int, namespace: str = "default",
                    image: str = DEFAULT_IMAGE, ha_replicas: int = 1,
-                   cores: int = 1, topic_server: bool = False
-                   ) -> Dict[str, str]:
+                   cores: int = 1, topic_server: bool = False,
+                   serving: int = 0,
+                   serving_ps: Optional[str] = None) -> Dict[str, str]:
     """The whole standalone topology as {filename: yaml} -- apply with
     ``kubectl apply -f <dir>``."""
     out = {
@@ -262,6 +332,11 @@ def render_cluster(workers: int, namespace: str = "default",
         out["topic-server.yaml"] = to_yaml(
             render_topic_server(namespace, image)
         )
+    if serving > 0:
+        out["serving.yaml"] = to_yaml(render_serving(
+            serving, serving_ps or f"async-master:{RPC_PORT}",
+            namespace, image,
+        ))
     return out
 
 
@@ -290,6 +365,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     r.add_argument("--cores", type=int, default=1)
     r.add_argument("--namespace", default="default")
     r.add_argument("--topic-server", action="store_true")
+    r.add_argument("--serving", type=int, default=0, metavar="REPLICAS",
+                   help="also render the serving tier (async-serve "
+                        "frontend + this many predict replica pods)")
+    r.add_argument("--serving-ps", default=None, metavar="HOST:PORT",
+                   help="PS address the serving replicas SUBSCRIBE to")
     a = sub.add_parser("app", help="render one application Job")
     a.add_argument("--out", required=True)
     a.add_argument("--name", required=True)
@@ -305,6 +385,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.workers, namespace=args.namespace, image=args.image,
             ha_replicas=args.ha, cores=args.cores,
             topic_server=args.topic_server,
+            serving=args.serving, serving_ps=args.serving_ps,
         )
     else:
         files = {f"app-{args.name}.yaml": to_yaml(render_app_job(

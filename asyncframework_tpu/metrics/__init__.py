@@ -55,11 +55,13 @@ def reset_totals() -> None:
     from asyncframework_tpu.net import reset_net_totals
     from asyncframework_tpu.parallel.ps_dcn import reset_pipeline_totals
     from asyncframework_tpu.parallel.supervisor import reset_recovery_totals
+    from asyncframework_tpu.serving.metrics import reset_serving_totals
 
     reset_net_totals()
     reset_recovery_totals()
     reset_shuffle_totals()
     reset_pipeline_totals()
+    reset_serving_totals()
     _trace.reset_aggregator()
 
 

@@ -76,6 +76,18 @@ def _pipeline_totals() -> Dict[str, int]:
     return pipeline_totals()
 
 
+def _serving_totals() -> Dict[str, int]:
+    from asyncframework_tpu.serving.metrics import serving_totals
+
+    return serving_totals()
+
+
+def _serving_snapshot() -> Dict:
+    from asyncframework_tpu.serving.metrics import serving_snapshot
+
+    return serving_snapshot()
+
+
 def _lockwatch_totals() -> Dict:
     from asyncframework_tpu.net import lockwatch
 
@@ -132,6 +144,7 @@ class LiveStateListener(Listener):
         self._base_net_bytes = _net_bytes_totals()
         self._base_recovery = _recovery_totals()
         self._base_pipeline = _pipeline_totals()
+        self._base_serving = _serving_totals()
 
     def register_queue_depth(self, fn: Callable[[], int]) -> None:
         self._queue_depth_fn = fn
@@ -242,6 +255,17 @@ class LiveStateListener(Listener):
                     _delta({k: v for k, v in pl.items()
                             if k != "inflight_max"}, self._base_pipeline),
                     inflight_max=pl.get("inflight_max", 0),
+                ),
+                # serving-plane counters (serving/metrics.py): predicts,
+                # failovers, unhealthy rejects, refresh shapes (per-run
+                # delta of the flat counters) plus the derived views --
+                # QPS over the delta'd window, predict-latency and
+                # freshness-lag (versions + ms) percentiles, per-replica
+                # breakdown -- shown raw (rings are reset-scoped, not
+                # baseline-scoped)
+                "serving": dict(
+                    _delta(_serving_totals(), self._base_serving),
+                    detail=_serving_snapshot(),
                 ),
                 # debug lock watchdog (net/lockwatch.py): socket-IO-under-
                 # model-lock violations (the lock-free PULL claim; 0 =

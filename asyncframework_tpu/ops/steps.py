@@ -756,3 +756,26 @@ def make_trajectory_loss_eval(loss: str = "least_squares"):
             raise ValueError(f"unknown loss {loss!r}")
 
     return eval_shard
+
+
+def make_predict_step(loss: str = "least_squares"):
+    """jit (X (n,d) f32, w (d,) f32) -> (n,) f32 predictions -- the serving
+    tier's PREDICT kernel (serving/replica.py).
+
+    least_squares serves the raw regression score ``X @ w``; logistic
+    serves the positive-class probability ``sigmoid(X @ w)``.  One jitted
+    executable per (loss, batch shape); replicas bucket batch sizes to
+    powers of two so a mixed request stream compiles O(log n) variants,
+    not one per request.
+    """
+    if loss not in ("least_squares", "logistic"):
+        raise ValueError(f"unknown loss {loss!r}")
+
+    @jax.jit
+    def predict(X, w):
+        z = mm_f32(X, w)
+        if loss == "logistic":
+            return jax.nn.sigmoid(z)
+        return z
+
+    return predict

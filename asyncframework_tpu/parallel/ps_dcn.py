@@ -442,6 +442,23 @@ class ParameterServer:
         self.pull_replies: Dict[str, int] = {"full": 0, "nm": 0,
                                              "xdelta": 0}
         self.pull_model_bytes = 0  # model-part payload bytes sent via PULL
+        # serving plane (asyncframework_tpu/serving/): SUBSCRIBE reply
+        # shapes + bytes, counted apart from PULL so the training data
+        # plane's bench numbers stay clean of read traffic
+        self.subscribe_replies: Dict[str, int] = {"full": 0, "nm": 0,
+                                                  "xdelta": 0}
+        self.subscribe_model_bytes = 0
+        # version birth times (bounded): ts -> run-clock ms at which that
+        # model version was PUBLISHED by an applying drain.  Feeds the
+        # freshness-lag-in-ms answer on SUBSCRIBE replies: the age of a
+        # served version is "how long ago did a NEWER version appear",
+        # which is 0 while the served version is still current (dropped
+        # pushes tick the clock without changing the model, and leave no
+        # entry here -- correctly aging nothing).
+        self._born_lock = threading.Lock()
+        from collections import OrderedDict as _ODB
+
+        self._ver_born: "_ODB[int, float]" = _ODB()
         # ---- data plane: batched gradient apply (merge queue).  All
         # pushes pending at lock acquisition coalesce into ONE fused
         # device apply (ops/steps.make_*_apply_merge -- bit-identical to
@@ -674,6 +691,8 @@ class ParameterServer:
             self._w = jax.device_put(z["w"], self.device)
             self._snap = None
             self._w_versions.clear()
+            with self._born_lock:
+                self._ver_born.clear()  # prior-life ages are meaningless
             self._snap_basis = (int(meta["clock"]), self._w,
                                 self._model_gen)
             self._clock = int(meta["clock"])
@@ -783,6 +802,10 @@ class ParameterServer:
                 # ASAGA stream without also counting ASGD ops
                 if op in ("PULL", "PULL_SAGA"):
                     self._handle_pull(conn, header)
+                elif op == "SUBSCRIBE":
+                    # serving-tier snapshot subscription: a read-only,
+                    # wave-gate-free pull that keeps answering after DONE
+                    self._handle_subscribe(conn, header)
                 elif op in ("PUSH", "PUSH_SAGA"):
                     cached = self._dedup.check(header)
                     if cached is not None:
@@ -889,6 +912,70 @@ class ParameterServer:
                 self._snap = snap
             return snap
 
+    def _negotiated_model(self, have) -> Tuple[int, int, dict, bytes]:
+        """The LOCK-FREE model-serving core shared by PULL and SUBSCRIBE:
+        everything here reads the published :class:`_ModelSnap` (atomic
+        reference) -- the model lock is never taken (net/lockwatch.py
+        asserts it in debug runs), so serving never queues behind a merge
+        drain and a drain never stalls behind a slow reader's socket.
+
+        Returns ``(ts, clock, model_hdr, model_part)``: the send-time
+        version stamp, the raw clock read, the negotiated reply header
+        fields (empty for a legacy no-``have`` reply, byte-identical to
+        the pre-delta wire), and the model payload bytes.  Encoding
+        happens OUTSIDE any lock (the O(d) xor must not queue the apply
+        path); the version caches pin every array/bytes object needed."""
+        if have is not None:
+            self._delta_clients_seen = True  # one-way flag, GIL-atomic
+        snap = self._model_snap()
+        ts, w_host, w_wire, w_crc = snap.ts, snap.w_host, snap.wire, snap.crc
+        # the clock may have ticked past the snapshot on DROPPED pushes
+        # (they advance the clock but not the model).  An accepted push
+        # bumps the model GENERATION before its clock tick, so if the
+        # generation still matches this snapshot's after an atomic clock
+        # read, every tick in between was a drop -- same bytes, newer
+        # version: stamp the current clock (send-time parity with the
+        # serial path).  A lost race just serves snap.ts, which only
+        # over-prices staleness, never mispairs version and bytes.
+        cur = self._clock
+        if cur != ts and self._model_gen == snap.gen:
+            ts = cur
+        basis = None
+        if have is not None and self._delta_versions > 0:
+            # recent-version cache for delta encoding, maintained only
+            # once a delta client exists; ts is monotone, so insertion
+            # order IS version age and eviction pops the oldest
+            with self._versions_lock:
+                if snap.ts not in self._w_versions:
+                    self._w_versions[snap.ts] = w_host
+                    while len(self._w_versions) > self._delta_versions:
+                        self._w_versions.popitem(last=False)
+                if ts != snap.ts and ts not in self._w_versions:
+                    self._w_versions[ts] = w_host  # same bytes, newer ts
+                    while len(self._w_versions) > self._delta_versions:
+                        self._w_versions.popitem(last=False)
+        if have is not None:
+            if int(have) == ts:
+                # exact-version match needs no cache: the basis IS the
+                # current version, so this encodes to NOT_MODIFIED
+                # (the reply CRC still guards a cross-PS-life clash)
+                basis = w_host
+            elif self._delta_versions > 0:
+                with self._versions_lock:
+                    basis = self._w_versions.get(int(have))
+        model_hdr: dict = {}
+        model_part: bytes = w_wire
+        if have is not None:
+            wenc, enc_payload, nnz = wiredelta.encode(
+                w_host, basis, cur_bytes=w_wire
+            )
+            model_hdr = {"wenc": wenc, "crc": w_crc}
+            if wenc == wiredelta.XDELTA:
+                model_hdr["nnz"] = nnz
+            model_part = enc_payload
+            model_hdr["wlen"] = len(model_part)
+        return ts, cur, model_hdr, model_part
+
     def _handle_pull(self, conn: socket.socket, header: dict) -> None:
         wid = int(header["wid"])
         proc = header.get("proc")
@@ -984,51 +1071,12 @@ class ParameterServer:
             extra_hdr = {"cap": cap, "n_valid": int(idx.size)}
             extra_payload = idx_pad.tobytes() + alpha_sel.tobytes()
         have = header.get("have")
-        # LOCK-FREE model serving: everything below reads the published
-        # _ModelSnap (atomic reference) -- the model lock is never taken
-        # on this path (net/lockwatch.py asserts it in debug runs), so a
-        # cohort pull cannot queue behind a merge drain and a drain
-        # cannot stall behind a slow puller's socket.
-        if have is not None:
-            self._delta_clients_seen = True  # one-way flag, GIL-atomic
-        snap = self._model_snap()
-        ts, w_host, w_wire, w_crc = snap.ts, snap.w_host, snap.wire, snap.crc
-        # the clock may have ticked past the snapshot on DROPPED pushes
-        # (they advance the clock but not the model).  An accepted push
-        # bumps the model GENERATION before its clock tick, so if the
-        # generation still matches this snapshot's after an atomic clock
-        # read, every tick in between was a drop -- same bytes, newer
-        # version: stamp the current clock (send-time parity with the
-        # serial path).  A lost race just serves snap.ts, which only
-        # over-prices staleness, never mispairs version and bytes.
-        cur = self._clock
-        if cur != ts and self._model_gen == snap.gen:
-            ts = cur
-        basis = None
-        if have is not None and self._delta_versions > 0:
-            # recent-version cache for delta encoding, maintained only
-            # once a delta client exists; ts is monotone, so insertion
-            # order IS version age and eviction pops the oldest
-            with self._versions_lock:
-                if snap.ts not in self._w_versions:
-                    self._w_versions[snap.ts] = w_host
-                    while len(self._w_versions) > self._delta_versions:
-                        self._w_versions.popitem(last=False)
-                if ts != snap.ts and ts not in self._w_versions:
-                    self._w_versions[ts] = w_host  # same bytes, newer ts
-                    while len(self._w_versions) > self._delta_versions:
-                        self._w_versions.popitem(last=False)
-        if have is not None:
-            if int(have) == ts:
-                # exact-version match needs no cache: the basis IS the
-                # current version, so this encodes to NOT_MODIFIED
-                # (the reply CRC still guards a cross-PS-life clash)
-                basis = w_host
-            elif self._delta_versions > 0:
-                with self._versions_lock:
-                    basis = self._w_versions.get(int(have))
+        ts, _clock, model_hdr, model_part = self._negotiated_model(have)
         with self._stats_lock:
             self._pull_times[wid] = self._now_ms()
+            shape = model_hdr.get("wenc", "full")
+            self.pull_replies[shape] = self.pull_replies.get(shape, 0) + 1
+            self.pull_model_bytes += len(model_part)
         avg = self.avg_delay_ms
         if tc is not None:
             # exactly the wave-gate wait (barrier cost), not the model
@@ -1047,29 +1095,6 @@ class ParameterServer:
             orders = sup.orders_for(proc)
             if orders:
                 extra_hdr["adopt"] = orders
-        # PULL negotiation (have -> NOT_MODIFIED | XDELTA | FULL): a pull
-        # WITHOUT ``have`` gets the legacy full reply, byte-identical to
-        # the pre-delta wire.  Encoding happens OUTSIDE the lock (the O(d)
-        # xor must not queue the apply path); the version caches pinned
-        # every array/bytes object we need above.
-        model_hdr: dict = {}
-        model_part: bytes = w_wire
-        if have is not None:
-            wenc, enc_payload, nnz = wiredelta.encode(
-                w_host, basis, cur_bytes=w_wire
-            )
-            model_hdr = {"wenc": wenc, "crc": w_crc}
-            if wenc == wiredelta.XDELTA:
-                model_hdr["nnz"] = nnz
-            model_part = enc_payload
-            model_hdr["wlen"] = len(model_part)
-            with self._stats_lock:
-                self.pull_replies[wenc] = self.pull_replies.get(wenc, 0) + 1
-                self.pull_model_bytes += len(model_part)
-        else:
-            with self._stats_lock:
-                self.pull_replies["full"] += 1
-                self.pull_model_bytes += len(model_part)
         # vectored zero-copy framing: the cached model bytes and the ASAGA
         # extra payload go out as one kernel-gathered iovec -- the payload
         # is never copied into a fresh frame buffer
@@ -1081,6 +1106,53 @@ class ParameterServer:
              **model_hdr, **extra_hdr},
             (model_part, extra_payload) if extra_payload
             else (model_part,),
+        )
+
+    def _version_age_ms(self, ts: int, clock: int) -> float:
+        """Freshness age of model version ``ts``: ms since the first NEWER
+        version was published (0 while ``ts`` is still the current model).
+        Bounded scan of the birth ring -- entries are clock-ascending, so
+        the first key past ``ts`` is the moment ``ts`` stopped being the
+        latest; an evicted birth (very stale subscriber) under-reports
+        rather than guessing."""
+        if ts >= clock or self._t0 is None:
+            return 0.0
+        now = self._now_ms()
+        with self._born_lock:
+            for v, born in self._ver_born.items():
+                if v > ts:
+                    return max(0.0, now - born)
+        return 0.0
+
+    def _handle_subscribe(self, conn: socket.socket, header: dict) -> None:
+        """Serving-tier snapshot subscription (serving/replica.py).
+
+        Same ``have=``-negotiated NOT_MODIFIED / XDELTA / FULL reply
+        shapes as PULL -- the replica cache-invalidation protocol IS the
+        delta-pull protocol -- but deliberately WITHOUT the partial-
+        barrier wave gate (a read must never wait for a training cohort
+        to fill), without membership/ownership discipline (replicas are
+        not shard servers), and still answering after DONE (training
+        finishing must not take the read path down).  Entirely lock-free
+        on the model lock, like ``_handle_pull``.  The reply additionally
+        carries the PS merge clock, the accepted-update count, the served
+        version's age in ms, and the done flag, so replicas can price
+        their own freshness lag in versions AND ms."""
+        have = header.get("have")
+        ts, cur, model_hdr, model_part = self._negotiated_model(have)
+        shape = model_hdr.get("wenc", "full")
+        with self._stats_lock:
+            self.subscribe_replies[shape] = (
+                self.subscribe_replies.get(shape, 0) + 1
+            )
+            self.subscribe_model_bytes += len(model_part)
+        _frame.send_msg_vectored(
+            conn,
+            {"op": "MODEL", "ts": ts, "clock": cur, "k": self._k,
+             "done": self._done.is_set(),
+             "age_ms": round(self._version_age_ms(ts, cur), 3),
+             **model_hdr},
+            (model_part,),
         )
 
     def _handle_push(self, conn: socket.socket, header: dict,
@@ -1318,6 +1390,15 @@ class ParameterServer:
             # this drain already holds): the next snapshot rebuild reads
             # it lock-free instead of queueing on the model lock
             self._snap_basis = (self._clock, self._w, self._model_gen)
+            # version birth (serving plane): this drain PUBLISHED a new
+            # model version -- stamp its clock with the wall time so
+            # SUBSCRIBE replies can price freshness age in ms (O(1), its
+            # own small lock; never the pull path's).
+            if self._t0 is not None:
+                with self._born_lock:
+                    self._ver_born[self._clock] = self._now_ms()
+                    while len(self._ver_born) > 1024:
+                        self._ver_born.popitem(last=False)
             self.merge_batches += 1
             self.merge_merged += len(batch)
             self.merge_batch_max = max(self.merge_batch_max, len(batch))
@@ -1847,6 +1928,33 @@ class PSClient:
             tr.set_model_version(int(header["ts"]))
         return (int(header["ts"]), w, float(header["avg_delay_ms"]),
                 bool(header["calibrated"]))
+
+    def subscribe(self, wid: int = 0
+                  ) -> Optional[Tuple[int, np.ndarray, int, int,
+                                      float, bool]]:
+        """Serving-tier snapshot subscription: one ``have=``-negotiated
+        SUBSCRIBE round trip (NOT_MODIFIED / XDELTA / FULL, CRC-gated,
+        full-pull fallback -- the same basis-cache machinery as delta
+        PULLs, keyed by ``wid``; replicas pass their replica id).
+
+        Returns ``(ts, w, clock, k, age_ms, done)``: the served version
+        and model, the PS merge clock and accepted-update count at reply
+        time, the served version's freshness age in ms (0 while it is
+        still the current model), and whether training has finished.
+        Unlike :meth:`pull` this never parks in the wave gate and keeps
+        working after DONE."""
+        got = self._pull_model_rpc(
+            wid, lambda: {"op": "SUBSCRIBE", "wid": wid}, lambda _h: 0,
+            None,
+        )
+        if got is None:
+            return None  # RELEASED/DONE headers never come from SUBSCRIBE
+        header, _payload, w = got
+        ts = int(header["ts"])
+        return (ts, w, int(header.get("clock", ts)),
+                int(header.get("k", 0)),
+                float(header.get("age_ms", 0.0)),
+                bool(header.get("done", False)))
 
     @staticmethod
     def _sparse_grad_enc(g: np.ndarray) -> Optional[Tuple[int, bytes]]:

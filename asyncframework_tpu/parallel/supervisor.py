@@ -122,7 +122,15 @@ class ElasticSupervisor:
 
     def __init__(self, num_workers: int, dead_after_s: float = 5.0,
                  check_interval_s: float = 0.5, boot_grace_s: float = 10.0,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, adopt: bool = True):
+        #: ``adopt=False`` is the serving-frontend mode
+        #: (serving/frontend.py): the same HELLO registration, pid-probe +
+        #: silence death detection, and rejoin revival -- but the slots
+        #: are predict replicas, not shard servers, so dead slots are
+        #: simply taken out of rotation (no adoption planning, no
+        #: unclaimed-slot handout, and no process-global recovery-counter
+        #: bumps -- the serving plane keeps its own counters).
+        self._adopt = bool(adopt)
         self.num_workers = int(num_workers)
         self.dead_after_ms = float(dead_after_s) * 1e3
         self.check_interval_s = float(check_interval_s)
@@ -212,13 +220,15 @@ class ElasticSupervisor:
                 self._owner[wid] = proc
                 if prev not in (None, proc):
                     self.releases += 1
-                    bump_total("releases")
+                    if self._adopt:
+                        bump_total("releases")
                     pend = self._pending.get(prev)
                     if pend is not None:
                         pend.pop(wid, None)
                 if rejoined:
                     self.rejoins += 1
-                    bump_total("rejoins")
+                    if self._adopt:
+                        bump_total("rejoins")
                 self._state[wid] = LIVE
                 self._contact_ms[wid] = now
                 # the claim supersedes any in-flight adoption order
@@ -361,15 +371,18 @@ class ElasticSupervisor:
                 else:
                     # unclaimed slot: nobody ever served this shard.  After
                     # the boot grace (and once there IS someone to adopt
-                    # it), hand it out rather than strand its data.
-                    if (live_procs
+                    # it), hand it out rather than strand its data.  In
+                    # serving mode (adopt=False) unclaimed slots are just
+                    # unused registration capacity -- never "dead".
+                    if (self._adopt and live_procs
                             and now - self._t0 > max(self.boot_grace_ms,
                                                      self.dead_after_ms)):
                         newly_dead.append(wid)
             for wid in newly_dead:
                 self._state[wid] = DEAD
                 self.workers_lost += 1
-                bump_total("workers_lost")
+                if self._adopt:
+                    bump_total("workers_lost")
             # 3. (re-)plan adoption for every dead wid lacking a live,
             # FRESH pending adopter -- covers adopters that died
             # mid-adoption AND adopters that never act on an order (a
@@ -387,7 +400,7 @@ class ElasticSupervisor:
                 wid for wid in range(self.num_workers)
                 if self._state[wid] == DEAD and wid not in pending_live
             ]
-            if orphans and live_procs:
+            if orphans and live_procs and self._adopt:
                 from asyncframework_tpu.engine.recovery import (
                     plan_reassignment,
                 )

@@ -1,0 +1,108 @@
+"""``async-serve``: the serving-tier process entry point.
+
+Two roles::
+
+    # a predict replica subscribed to a PS, optionally HELLOing a frontend
+    async-serve replica --ps HOST:PORT [--port P] [--frontend HOST:PORT]
+                        [--rid N] [--loss least_squares|logistic]
+                        [--conf k=v ...]
+
+    # a frontend: replica registration front door + client predict proxy
+    async-serve frontend [--port P] [--replicas h:p,h:p,...]
+                         [--conf k=v ...]
+
+Each role prints ONE JSON line on stdout once bound (``{"role": ...,
+"port": ...}``) so launchers (bench.py --serve, tests, k8s readiness
+wrappers) can parse the ephemeral port, then serves until SIGTERM/EOF.
+``--conf`` overlays any registered ``async.serve.*`` / ``async.net.*``
+knob, same precedence as async-submit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="async-serve", description=__doc__.split("\n\n")[0]
+    )
+    sub = p.add_subparsers(dest="role", required=True)
+    r = sub.add_parser("replica", help="snapshot-subscribing predict server")
+    r.add_argument("--ps", required=True, metavar="HOST:PORT",
+                   help="parameter server to SUBSCRIBE to")
+    r.add_argument("--host", default="0.0.0.0")
+    r.add_argument("--port", type=int, default=0,
+                   help="predict port (0 = ephemeral, printed on stdout)")
+    r.add_argument("--rid", type=int, default=0, help="replica id")
+    r.add_argument("--loss", default="least_squares",
+                   choices=["least_squares", "logistic"])
+    r.add_argument("--frontend", default=None, metavar="HOST:PORT",
+                   help="HELLO this frontend after binding (joins its "
+                        "rotation)")
+    r.add_argument("--conf", action="append", default=[], metavar="K=V")
+    f = sub.add_parser("frontend", help="replica registry + predict router")
+    f.add_argument("--host", default="0.0.0.0")
+    f.add_argument("--port", type=int, default=0,
+                   help="front-door port (0 = ephemeral, printed on stdout)")
+    f.add_argument("--replicas", default="", metavar="H:P,H:P",
+                   help="static replica endpoints (dynamic HELLOs add more)")
+    f.add_argument("--conf", action="append", default=[], metavar="K=V")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    if os.environ.get("ASYNCTPU_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    args = build_parser().parse_args(argv)
+    from asyncframework_tpu.cli import parse_conf_overlays
+
+    parse_conf_overlays(args.conf)
+    from asyncframework_tpu.net.faults import maybe_install_from_conf
+
+    maybe_install_from_conf()  # chaos fabric reaches serving daemons too
+    if args.role == "replica":
+        from asyncframework_tpu.serving.replica import serve_replica
+
+        rep = serve_replica(args.ps, rid=args.rid, host=args.host,
+                            port=args.port, loss=args.loss,
+                            frontend=args.frontend)
+        try:
+            while True:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            rep.stop()
+        return 0
+    # frontend role
+    from asyncframework_tpu.serving.frontend import ServingFrontend
+
+    replicas = []
+    for tok in (args.replicas or "").split(","):
+        tok = tok.strip()
+        if tok:
+            host, port = tok.rsplit(":", 1)
+            replicas.append((host, int(port)))
+    fe = ServingFrontend(replicas).serve(port=args.port, host=args.host)
+    print(json.dumps({"role": "frontend", "port": fe.port,
+                      "pid": os.getpid()}), flush=True)
+    try:
+        while True:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        fe.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

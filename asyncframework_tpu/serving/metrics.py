@@ -1,0 +1,110 @@
+"""Serving-plane counters and latency/freshness accounting.
+
+Process-global like every other observability module (net counters,
+recovery totals, pipeline totals): the ServingFrontend and any in-process
+ModelReplica bump these, ``serving_totals()`` feeds the live UI's per-run
+delta machinery (flat ints only), ``serving_snapshot()`` adds the derived
+views -- predict latency p50/p95/p99, freshness lag in versions AND ms,
+per-replica breakdown -- and ``reset_serving_totals()`` is wired into
+``asyncframework_tpu.metrics.reset_totals`` so a second serve run in one
+process starts from zero instead of inheriting the first run's QPS/lag
+totals (the same per-run-isolation contract PR 3 established for the
+net/recovery counters).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from asyncframework_tpu.metrics.system import Histogram
+
+_lock = threading.Lock()
+_totals: Dict[str, int] = {}
+#: per-replica flat views: endpoint -> {predicts, errors, lag_versions,
+#: lag_ms, ts} (last-observed values; counts monotone)
+_replicas: Dict[str, Dict[str, float]] = {}
+_predict_ms = Histogram(capacity=4096)
+_lag_versions = Histogram(capacity=4096)
+_lag_ms = Histogram(capacity=4096)
+#: monotonic time of the first/last observed predict (per-process QPS)
+_t_first: Optional[float] = None
+_t_last: Optional[float] = None
+
+
+def bump(key: str, n: int = 1) -> None:
+    """Monotone serving counter (predicts, predict_errors [whole request
+    failed], attempt_errors [one replica RPC failed], failovers,
+    unhealthy_rejects, refreshes, refresh_nm/xdelta/full,
+    refresh_fallbacks, refresh_errors, replica_predicts,
+    replicas_registered)."""
+    with _lock:
+        _totals[key] = _totals.get(key, 0) + n
+
+
+def observe_predict(endpoint: str, dur_ms: float, lag_versions: int,
+                    lag_ms: float, ts: int, ok: bool = True) -> None:
+    """One answered (or failed) PREDICT against ``endpoint``: latency and
+    the freshness lag the reply was served at."""
+    global _t_first, _t_last
+    now = time.monotonic()
+    with _lock:
+        _totals["predicts"] = _totals.get("predicts", 0) + int(ok)
+        if not ok:
+            # per-ATTEMPT failure (one replica, one RPC); requests that
+            # ultimately fail after every failover bump predict_errors
+            _totals["attempt_errors"] = _totals.get("attempt_errors", 0) + 1
+        rep = _replicas.setdefault(endpoint, {"predicts": 0, "errors": 0})
+        if ok:
+            rep["predicts"] += 1
+            rep["lag_versions"] = int(lag_versions)
+            rep["lag_ms"] = round(float(lag_ms), 3)
+            rep["ts"] = int(ts)
+        else:
+            rep["errors"] += 1
+        if _t_first is None:
+            _t_first = now
+        _t_last = now
+    if ok:
+        _predict_ms.update(float(dur_ms))
+        _lag_versions.update(float(lag_versions))
+        _lag_ms.update(float(lag_ms))
+
+
+def serving_totals() -> Dict[str, int]:
+    """Flat monotone counters (live-UI ``_delta`` compatible)."""
+    with _lock:
+        return dict(_totals)
+
+
+def serving_snapshot() -> Dict:
+    """The dashboard view: totals + derived latency/lag percentiles, QPS
+    over the observed predict window, and the per-replica breakdown."""
+    with _lock:
+        totals = dict(_totals)
+        replicas = {e: dict(v) for e, v in _replicas.items()}
+        window = ((_t_last - _t_first)
+                  if _t_first is not None and _t_last is not None else 0.0)
+    n = totals.get("predicts", 0)
+    return {
+        **totals,
+        "qps": round(n / window, 1) if window > 0 else float(n),
+        "predict_ms": _predict_ms.snapshot(),
+        "lag_versions": _lag_versions.snapshot(),
+        "lag_ms": _lag_ms.snapshot(),
+        "replicas": replicas,
+    }
+
+
+def reset_serving_totals() -> None:
+    """Zero every serving counter, ring, and per-replica view (per-run
+    isolation; see ``asyncframework_tpu.metrics.reset_totals``)."""
+    global _predict_ms, _lag_versions, _lag_ms, _t_first, _t_last
+    with _lock:
+        _totals.clear()
+        _replicas.clear()
+        _t_first = _t_last = None
+    _predict_ms = Histogram(capacity=4096)
+    _lag_versions = Histogram(capacity=4096)
+    _lag_ms = Histogram(capacity=4096)

@@ -75,6 +75,10 @@ PROBE_TIMEOUT_S = 75.0       # cheap backend-liveness probe (first init 20-45s)
 PROBE_ATTEMPTS = 2
 TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 2400.0))
 REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+# per-arm watchdog: total wall one config may burn across its repeats
+# (r03-r05 lesson: one wedging TPU config must not eat the whole budget
+# and leave the other arms dark)
+ARM_BUDGET_S = float(os.environ.get("BENCH_ARM_BUDGET_S", 900.0))
 
 # Each config mirrors one reference dataset's shape and recipe
 # (README.md:44-74; BASELINE.md).  gamma follows the 0.05*d conditioning
@@ -583,6 +587,222 @@ def collect_dcn_block(env: dict) -> dict:
     return json.loads(line).get("dcn", {"error": "malformed dcn payload"})
 
 
+# --------------------------------------------------------------- serve bench
+# Serving-tier bench (always CPU: it measures the read path's QPS vs
+# freshness lag, not the chip): a REAL ParameterServer with training
+# running on a worker thread, REAL replica OS processes subscribed over
+# loopback TCP, a ServingFrontend routing a multi-threaded client load --
+# and one arm where a replica is SIGKILLed mid-load to price failover.
+SERVE_CONFIG = dict(n=4096, d=512, nw=2, gamma=0.05 * 512,
+                    batch_rate=0.1, iters=200_000)
+SERVE_LOAD_S = float(os.environ.get("BENCH_SERVE_LOAD_S", 3.0))
+SERVE_CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", 4))
+SERVE_BATCH = int(os.environ.get("BENCH_SERVE_BATCH", 16))
+
+
+def _spawn_replica(ps_port: int, rid: int, env: dict,
+                   timeout_s: float = 60.0):
+    """One replica OS process; returns (Popen, predict_port).  The replica
+    announces its bound port as one JSON line on stdout."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "asyncframework_tpu.serving.cli", "replica",
+         "--ps", f"127.0.0.1:{ps_port}", "--host", "127.0.0.1",
+         "--rid", str(rid)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env,
+    )
+    line_box = {}
+
+    def read_line():
+        line_box["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=read_line, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    line = line_box.get("line")
+    if not line:
+        proc.kill()
+        raise RuntimeError(f"replica {rid} did not announce within "
+                           f"{timeout_s:.0f}s")
+    return proc, int(json.loads(line)["port"])
+
+
+def _pcts(vals, nd=3):
+    if not vals:
+        return None
+    v = sorted(vals)
+    rank = lambda q: v[min(len(v) - 1, max(0, int(round(q * len(v))) - 1))]
+    return {"p50": round(rank(0.50), nd), "p95": round(rank(0.95), nd),
+            "p99": round(rank(0.99), nd), "max": round(v[-1], nd)}
+
+
+def run_serve_child() -> None:
+    """One fresh-process serving bench; prints one JSON line.
+
+    Three arms: 1 replica, 2 replicas, and 2 replicas with one SIGKILLed
+    mid-load.  Every arm runs with training concurrently advancing the
+    model (the freshness-lag numbers are meaningless against a frozen
+    PS), and records QPS, predict latency, freshness lag in versions AND
+    ms, failovers, and the error rate."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import signal
+
+    from asyncframework_tpu.data.sharded import ShardedDataset
+    from asyncframework_tpu.metrics import reset_totals
+    from asyncframework_tpu.parallel import ps_dcn
+    from asyncframework_tpu.serving import ServingFrontend
+    from asyncframework_tpu.serving import metrics as smetrics
+    from asyncframework_tpu.solvers import SolverConfig
+
+    c = SERVE_CONFIG
+    devices = jax.devices()
+    ds = ShardedDataset.generate_on_device(
+        c["n"], c["d"], c["nw"], devices=devices, seed=7, noise=0.01
+    )
+    shards = {w: ds.shard(w) for w in range(c["nw"])}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ASYNCTPU_FORCE_CPU"] = "1"
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(SERVE_BATCH, c["d"])).astype(np.float32)
+    out = {}
+    arms = [("r1", 1, False), ("r2", 2, False), ("r2_kill", 2, True)]
+    for label, n_rep, kill in arms:
+        reset_totals()
+        cfg = SolverConfig(
+            num_workers=c["nw"], num_iterations=c["iters"],
+            gamma=c["gamma"], taw=2**31 - 1, batch_rate=c["batch_rate"],
+            bucket_ratio=0.5, printer_freq=10_000, coeff=0.0, seed=42,
+            calibration_iters=20, run_timeout_s=SERVE_LOAD_S + 30.0,
+        )
+        ps = ps_dcn.ParameterServer(
+            cfg, c["d"], c["n"], device=devices[0], port=0
+        ).start()
+        replicas = []
+        try:
+            for rid in range(n_rep):
+                replicas.append(_spawn_replica(ps.port, rid, env))
+            fe = ServingFrontend(
+                [("127.0.0.1", port) for (_p, port) in replicas],
+                deadline_s=1.0,
+            ).start()
+            # training runs CONCURRENTLY for the whole load window; the
+            # worker deadline, not the iteration budget, ends it
+            trainer = threading.Thread(
+                target=ps_dcn.run_worker_process,
+                args=("127.0.0.1", ps.port, list(range(c["nw"])), shards,
+                      cfg, c["d"], c["n"]),
+                kwargs=dict(deadline_s=SERVE_LOAD_S + 6.0), daemon=True,
+            )
+            trainer.start()
+            # warm: first predict proves replicas refreshed and compiled
+            warm_deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    fe.predict(X)
+                    break
+                except Exception:
+                    if time.monotonic() > warm_deadline:
+                        raise
+                    time.sleep(0.1)
+            accepted0 = ps.accepted
+            # counter baseline AFTER warm-up: boot-window failovers
+            # (replicas still compiling/refreshing) must not pollute the
+            # load window's numbers -- nonzero failovers is the KILL
+            # arm's discriminator
+            totals0 = smetrics.serving_totals()
+            stats_lock = threading.Lock()
+            oks, errs, lags_v, lags_ms, lat_ms = [0], [0], [], [], []
+            stop_at = time.monotonic() + SERVE_LOAD_S
+            kill_at = time.monotonic() + SERVE_LOAD_S / 2.0
+
+            def client_loop():
+                while time.monotonic() < stop_at:
+                    t0 = time.monotonic()
+                    try:
+                        _y, meta = fe.predict_ex(X)
+                    except Exception:
+                        with stats_lock:
+                            errs[0] += 1
+                        continue
+                    with stats_lock:
+                        oks[0] += 1
+                        lags_v.append(meta["lag_versions"])
+                        lags_ms.append(meta["lag_ms"])
+                        lat_ms.append((time.monotonic() - t0) * 1e3)
+
+            clients = [threading.Thread(target=client_loop, daemon=True)
+                       for _ in range(SERVE_CLIENTS)]
+            for t in clients:
+                t.start()
+            if kill:
+                while time.monotonic() < kill_at:
+                    time.sleep(0.01)
+                os.kill(replicas[0][0].pid, signal.SIGKILL)
+            for t in clients:
+                t.join(timeout=SERVE_LOAD_S + 10.0)
+            accepted_during = ps.accepted - accepted0
+            totals = smetrics.serving_totals()
+            n_ok, n_err = oks[0], errs[0]
+            out[label] = {
+                "replicas": n_rep,
+                "killed_mid_load": kill,
+                "load_s": SERVE_LOAD_S,
+                "clients": SERVE_CLIENTS,
+                "batch": SERVE_BATCH,
+                "predicts": n_ok,
+                "errors": n_err,
+                "error_rate": round(n_err / max(n_ok + n_err, 1), 4),
+                "qps": round(n_ok / SERVE_LOAD_S, 1),
+                "rows_per_sec": round(n_ok * SERVE_BATCH / SERVE_LOAD_S),
+                "failovers": (totals.get("failovers", 0)
+                              - totals0.get("failovers", 0)),
+                "unhealthy_rejects": (
+                    totals.get("unhealthy_rejects", 0)
+                    - totals0.get("unhealthy_rejects", 0)
+                ),
+                "predict_ms": _pcts(lat_ms),
+                "lag_versions": _pcts(lags_v, nd=0),
+                "lag_ms": _pcts(lags_ms),
+                "train_accepted_during_load": accepted_during,
+                "train_updates_per_sec": round(
+                    accepted_during / SERVE_LOAD_S, 1
+                ),
+                "subscribe_replies": dict(ps.subscribe_replies),
+            }
+            print(f"# serve {label}: {json.dumps(out[label])}",
+                  file=sys.stderr)
+            fe.stop()
+        finally:
+            for proc, _port in replicas:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+            ps.stop()
+    emit({"serve": out})
+
+
+def collect_serve_block(env: dict) -> dict:
+    """Run the serving bench in a disposable subprocess (fresh process,
+    parent owns the timeout -- the same discipline as every arm)."""
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serve"],
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "serve bench timed out"}
+    sys.stderr.write(res.stderr)
+    line = next((l for l in reversed(res.stdout.splitlines())
+                 if l.startswith("{")), None)
+    if line is None:
+        return {"error": f"no JSON from serve child (rc={res.returncode})"}
+    return json.loads(line).get("serve", {"error": "malformed serve payload"})
+
+
 def run_probe() -> None:
     """Cheap backend-liveness check in a disposable process: init the backend
     and print one JSON line.  A dead TPU tunnel wedges jax.devices() forever
@@ -804,6 +1024,7 @@ def run_parent() -> None:
         skip_note = note
     # round-robin repeats so every config gets one sample before the budget
     # can run out
+    arm_spent = {name: 0.0 for name in names}  # per-arm watchdog ledger
     for rep in range(REPEATS):
         if skip_note is not None:
             break
@@ -811,6 +1032,14 @@ def run_parent() -> None:
             have = len(samples[name])
             if rep > 0 and have == 0:
                 continue  # config is failing; don't burn budget re-proving it
+            if arm_spent[name] > ARM_BUDGET_S:
+                # per-arm watchdog: this config already burned its own
+                # budget (wedged children count their full timeout) --
+                # the remaining arms keep their share of the total
+                print(f"# arm budget exhausted for {name} "
+                      f"({arm_spent[name]:.0f}s > {ARM_BUDGET_S:.0f}s); "
+                      f"skipping repeat {rep}", file=sys.stderr)
+                continue
             if time.monotonic() > deadline and have >= 1:
                 print(f"# budget exhausted; skipping {name} repeat {rep}",
                       file=sys.stderr)
@@ -827,6 +1056,7 @@ def run_parent() -> None:
             except subprocess.TimeoutExpired:
                 print(f"# {name} rep {rep}: child timed out", file=sys.stderr)
                 child_wedged = True
+            arm_spent[name] += time.monotonic() - t0
             if not child_wedged:
                 sys.stderr.write(out.stderr)
                 line = next(
@@ -950,12 +1180,27 @@ def run_parent() -> None:
     }
     if skip_note is not None:
         payload["note"] = skip_note
-        if os.environ.get("BENCH_FALLBACK", "1") != "0":
-            payload["fallback"] = run_fallback(names, deadline)
+    # the CPU arm is ALWAYS recorded when any TPU arm went dark --
+    # whether the probe failed up front (skip_note) or children wedged /
+    # failed one by one while the probe kept passing (the r03-r05 mode:
+    # nothing but nulls in the artifact).  The fallback never stands in
+    # for the metric of record; it keeps the trajectory from going dark.
+    dark = [n for n in names if not samples[n]]
+    if dark and os.environ.get("BENCH_FALLBACK", "1") != "0":
+        payload["fallback"] = run_fallback(dark, deadline)
+        payload["fallback"]["reason"] = (
+            skip_note if skip_note is not None
+            else f"no TPU samples for {','.join(dark)}"
+        )
     if os.environ.get("BENCH_DCN", "1") != "0":
         # DCN data-plane bench (CPU loopback, device-independent): wire
         # bytes per update and pull/push payload shapes per pull mode
         payload["dcn"] = collect_dcn_block(env)
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        # serving-tier bench (CPU loopback): QPS vs freshness lag per
+        # replica count with training concurrently running, including the
+        # SIGKILL-a-replica-mid-load failover arm
+        payload["serve"] = collect_serve_block(env)
     if trace_out:
         with open(trace_out, "w") as f:
             for name in names:
@@ -977,6 +1222,13 @@ def main() -> None:
         except Exception as e:
             traceback.print_exc(file=sys.stderr)
             emit({"dcn": {"error": f"{type(e).__name__}: {str(e)[:200]}"}})
+        os._exit(0)
+    if "--serve" in sys.argv:
+        try:
+            run_serve_child()
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            emit({"serve": {"error": f"{type(e).__name__}: {str(e)[:200]}"}})
         os._exit(0)
     if "--probe" in sys.argv:
         # parent owns the timeout; nothing here may block interpreter exit

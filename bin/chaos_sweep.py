@@ -37,9 +37,13 @@ def run_seed(seed: int, args) -> dict:
     # serving claim is re-checked on every fault interleaving)
     env.setdefault("ASYNCTPU_ASYNC_DEBUG_LOCKWATCH", "1")
     marker = "chaos or soak" if args.soak else "chaos"
+    # the serving scenario rides every sweep seed: seeded SUBSCRIBE/
+    # PREDICT fault schedules (torn-model and failover invariants) are
+    # part of the chaos surface now that a read path exists
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
-        "tests/test_net_retry.py", "-q", "-m", marker,
+        "tests/test_net_retry.py", "tests/test_serving.py",
+        "-q", "-m", f"({marker}) or serve",
         "-p", "no:cacheprovider",
     ]
     if args.soak:

@@ -95,6 +95,43 @@ class TestAppJob:
             k8s.render_app_job("x", [], 2)
 
 
+class TestServingRendering:
+    def test_serving_tier_topology(self):
+        objs = k8s.render_serving(3, ps="async-ps:7078")
+        kinds = [o["kind"] for o in objs]
+        assert kinds == ["Deployment", "Service", "Deployment"]
+        fe_dep, svc, rep_dep = objs
+        fe_cmd = fe_dep["spec"]["template"]["spec"]["containers"][0][
+            "command"
+        ]
+        assert "frontend" in fe_cmd
+        ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
+        assert ports == {"predict": k8s.SERVE_PORT}
+        assert rep_dep["spec"]["replicas"] == 3
+        rep_cmd = rep_dep["spec"]["template"]["spec"]["containers"][0][
+            "command"
+        ]
+        # replicas SUBSCRIBE to the given PS and HELLO the frontend
+        # Service -- pod churn re-registers, scaling reads is kubectl
+        # scale on this Deployment
+        assert rep_cmd[rep_cmd.index("--ps") + 1] == "async-ps:7078"
+        assert (rep_cmd[rep_cmd.index("--frontend") + 1]
+                == f"async-serve:{k8s.SERVE_PORT}")
+
+    def test_serving_requires_ps_and_replicas(self):
+        with pytest.raises(ValueError):
+            k8s.render_serving(0, ps="x:1")
+        with pytest.raises(ValueError):
+            k8s.render_serving(2, ps="")
+
+    def test_cluster_bundle_gains_serving(self):
+        files = k8s.render_cluster(2, serving=2, serving_ps="ps:7078")
+        assert "serving.yaml" in files
+        objs = _load_all(files["serving.yaml"])
+        assert [o["kind"] for o in objs] == ["Deployment", "Service",
+                                             "Deployment"]
+
+
 class TestClusterBundle:
     def test_bundle_parses_and_covers_topology(self):
         files = k8s.render_cluster(4, ha_replicas=2, topic_server=True)
