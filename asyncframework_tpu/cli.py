@@ -71,6 +71,8 @@ CONF_TO_FIELD: Dict[str, str] = {
     "async.pull.mode": "pull_mode",
     "async.push.merge": "push_merge",
     "async.pipeline.depth": "pipeline_depth",
+    # telemetry plane (metrics/timeseries.py)
+    "async.convergence.sample": "conv_sample",
 }
 
 DRIVER_ALIASES: Dict[str, str] = {
@@ -498,6 +500,13 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
     # --conf async.pipeline.depth=0 restores the serial loop
     if not conf.contains("async.pipeline.depth"):
         conf.set("async.pipeline.depth", 2)
+    # convergence telemetry likewise defaults ON for the cluster path:
+    # every 16th update per logical worker ships (version, loss,
+    # grad_norm) on its PUSH header for the PS's loss-vs-wallclock /
+    # loss-vs-version curves (metrics/timeseries.py) -- an explicit
+    # --conf async.convergence.sample=0 restores the silent wire
+    if not conf.contains("async.convergence.sample"):
+        conf.set("async.convergence.sample", 16)
 
     cfg = SolverConfig(
         num_workers=args.num_partitions,
@@ -610,6 +619,12 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
             if writer is not None:
                 writer.close()
     # ---------------------------------------------------------- worker role
+    # per-process telemetry endpoint (async.metrics.port; -1 = off, so a
+    # stock cluster run adds no ports): /metrics + /api/status on every
+    # worker process, not just the PS/driver dashboard
+    from asyncframework_tpu.metrics.live import start_telemetry_from_conf
+
+    start_telemetry_from_conf(f"worker-{pid}", labels={"proc": str(pid)})
     devices = jax.devices()
     if args.devices is not None:
         devices = devices[: args.devices]

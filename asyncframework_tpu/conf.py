@@ -341,3 +341,58 @@ SERVE_DEADLINE_S = ConfigEntry(
     "Frontend per-request budget across failover attempts: a PREDICT that "
     "cannot be answered by ANY healthy replica within this raises "
     "PredictError to the caller.")
+# --------------------------------------------------------- telemetry plane
+# Continuous telemetry (metrics/timeseries.py, metrics/prom.py,
+# metrics/slo.py): every process samples its counter families into a
+# bounded time-series store, exposes Prometheus text exposition on
+# /metrics, folds convergence samples into loss-vs-wallclock /
+# loss-vs-version curves, and evaluates declarative SLO rules over
+# time-series windows.
+METRICS_PORT = ConfigEntry(
+    "async.metrics.port", -1, int,
+    "Per-process telemetry HTTP port serving /metrics (Prometheus text "
+    "exposition) and /api/status (-1 = off, 0 = ephemeral).  Processes "
+    "that already serve a live UI (async.ui.port) expose /metrics there "
+    "too; this knob adds the endpoint to processes with no dashboard -- "
+    "workers, serving replicas, frontends, the master.  k8s manifests "
+    "set it to 9095 via env and annotate pods for scraping.")
+METRICS_INTERVAL_S = ConfigEntry(
+    "async.metrics.interval.s", 1.0, float,
+    "Telemetry sampler period: every tick records each counter family "
+    "and derived source into the bounded time-series store and runs one "
+    "SLO evaluation pass.  <= 0 disables sampling (the /metrics "
+    "exposition still serves instantaneous values).")
+METRICS_RETENTION = ConfigEntry(
+    "async.metrics.retention", 512, int,
+    "Samples retained per time series (bounded ring; oldest evict "
+    "first, counted).  At the default 1 s interval this is ~8.5 min of "
+    "history per series; RAM is O(series x retention) small floats.")
+CONV_SAMPLE = ConfigEntry(
+    "async.convergence.sample", 0, int,
+    "Worker-side convergence sampling: every Nth update per logical "
+    "worker computes its shard's mean loss (one extra jitted eval) and "
+    "the gradient norm, and piggybacks (version, loss, grad_norm) on "
+    "the next PUSH header (cv entry) for the PS to fold into the "
+    "loss-vs-wallclock / loss-vs-version curves.  0 = off (the default: "
+    "the piggyback adds header bytes, and byte-identity suites compare "
+    "exact wires); async-cluster flips it to 16.")
+SLO_RULES = ConfigEntry(
+    "async.slo.rules",
+    "serve_freshness: p95(serving.freshness_lag_ms) < 2000 over 15s "
+    "for 2s; "
+    "predict_p99: max(serving.predict_ms_p99) < 500 over 30s for 5s; "
+    "staleness_ms: max(trace.staleness_ms_p95) < 60000 over 30s for 5s; "
+    "updates_floor: rate(ps.accepted) > 0.5 over 30s for 10s "
+    "unless ps.done",
+    str,
+    "Declarative SLO rule set (metrics/slo.py grammar: '<name>: "
+    "<agg>(<series>) <op> <threshold> [over Ns] [for Ns] "
+    "[unless <series>]', clauses ';'-separated; 'unless' gates a rule "
+    "to no_data while its series' last sample is truthy -- the "
+    "updates/s floor stands down once the run is DONE instead of "
+    "firing forever on a finished-but-still-serving PS).  Evaluated "
+    "over time-series windows each sampler "
+    "tick; rule states (ok/pending/firing/no_data, with burn "
+    "durations) surface as the /api/status 'health' section and the "
+    "async_slo_state gauges on /metrics.  Rules whose series never "
+    "produce samples report no_data and never fire.")

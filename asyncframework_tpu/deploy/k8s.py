@@ -40,6 +40,10 @@ import yaml
 DEFAULT_IMAGE = "asyncframework-tpu:latest"
 RPC_PORT = 7077
 UI_PORT = 8080
+#: per-pod telemetry endpoint (metrics/live.start_telemetry_from_conf):
+#: every daemon pod sets async.metrics.port to this via env and carries
+#: Prometheus scrape annotations pointing at it
+METRICS_PORT = 9095
 
 
 def _meta(name: str, app: str, namespace: str) -> dict:
@@ -51,11 +55,33 @@ def _meta(name: str, app: str, namespace: str) -> dict:
     }
 
 
+def _pod_meta(app: str) -> dict:
+    """Pod-template metadata: selector label + Prometheus scrape
+    annotations (the conventional prometheus.io/* trio a cluster-wide
+    scrape config discovers) pointing at the pod's telemetry port."""
+    return {
+        "labels": {"app": app},
+        "annotations": {
+            "prometheus.io/scrape": "true",
+            "prometheus.io/port": str(METRICS_PORT),
+            "prometheus.io/path": "/metrics",
+        },
+    }
+
+
 def _container(name: str, image: str, command: List[str],
                ports: Optional[List[int]] = None,
                resources: Optional[dict] = None,
-               volume_mounts: Optional[List[dict]] = None) -> dict:
+               volume_mounts: Optional[List[dict]] = None,
+               metrics: bool = True) -> dict:
     c: dict = {"name": name, "image": image, "command": command}
+    if metrics:
+        # ASYNCTPU_ASYNC_METRICS_PORT is conf async.metrics.port's env
+        # spelling: the daemon boots its /metrics + /api/status endpoint
+        # without any manifest-side CLI flag plumbing
+        c["env"] = [{"name": "ASYNCTPU_ASYNC_METRICS_PORT",
+                     "value": str(METRICS_PORT)}]
+        ports = list(ports or []) + [METRICS_PORT]
     if ports:
         c["ports"] = [{"containerPort": p} for p in ports]
     if resources:
@@ -101,7 +127,7 @@ def render_master(namespace: str = "default", image: str = DEFAULT_IMAGE,
             "replicas": ha_replicas,
             "selector": {"matchLabels": {"app": "async-master"}},
             "template": {
-                "metadata": {"labels": {"app": "async-master"}},
+                "metadata": _pod_meta("async-master"),
                 "spec": {
                     "containers": [_container(
                         "master", image, cmd,
@@ -145,7 +171,7 @@ def render_workers(replicas: int, namespace: str = "default",
             "replicas": replicas,
             "selector": {"matchLabels": {"app": "async-worker"}},
             "template": {
-                "metadata": {"labels": {"app": "async-worker"}},
+                "metadata": _pod_meta("async-worker"),
                 "spec": {"containers": [_container(
                     "worker", image, cmd,
                     resources=resources or {
@@ -242,8 +268,7 @@ def render_serving(replicas: int, ps: str, namespace: str = "default",
                 "replicas": 1,
                 "selector": {"matchLabels": {"app": "async-serve-frontend"}},
                 "template": {
-                    "metadata": {"labels":
-                                 {"app": "async-serve-frontend"}},
+                    "metadata": _pod_meta("async-serve-frontend"),
                     "spec": {"containers": [_container(
                         "frontend", image, fe_cmd, ports=[SERVE_PORT],
                     )]},
@@ -265,8 +290,7 @@ def render_serving(replicas: int, ps: str, namespace: str = "default",
                 "replicas": replicas,
                 "selector": {"matchLabels": {"app": "async-serve-replica"}},
                 "template": {
-                    "metadata": {"labels":
-                                 {"app": "async-serve-replica"}},
+                    "metadata": _pod_meta("async-serve-replica"),
                     "spec": {"containers": [_container(
                         "replica", image, rep_cmd,
                         ports=[SERVE_PORT + 1],

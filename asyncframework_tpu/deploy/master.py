@@ -565,6 +565,9 @@ def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
     from asyncframework_tpu.net import faults
 
     faults.maybe_install_from_conf()  # chaos runs configure daemons by env
+    from asyncframework_tpu.metrics.live import start_telemetry_from_conf
+
+    start_telemetry_from_conf("master")  # async.metrics.port gates it
     ui_host = args.ui_host
     if ui_host is None:
         ui_host = "0.0.0.0" if args.ui_port is not None else "127.0.0.1"

@@ -257,6 +257,9 @@ def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
     from asyncframework_tpu.net import faults
 
     faults.maybe_install_from_conf()  # chaos runs configure daemons by env
+    from asyncframework_tpu.metrics.live import start_telemetry_from_conf
+
+    start_telemetry_from_conf("deploy-worker")  # async.metrics.port gates it
     primary, *standbys = args.master.split(",")
     host, port = primary.rsplit(":", 1)
     w = Worker(host, int(port), worker_id=args.worker_id,

@@ -34,6 +34,10 @@ from asyncframework_tpu.metrics.system import (
     JsonlSink,
     MetricsSystem,
 )
+from asyncframework_tpu.metrics.timeseries import (
+    ConvergenceHistory,
+    TimeSeriesStore,
+)
 from asyncframework_tpu.metrics.trace import (
     Span,
     TraceAggregator,
@@ -43,26 +47,26 @@ from asyncframework_tpu.metrics.trace import (
 
 
 def reset_totals() -> None:
-    """Zero EVERY process-global observability counter (net, recovery,
-    shuffle, dedup/fault totals, the global trace aggregator) so
-    back-to-back runs in one process -- tests, notebooks, long-lived
-    daemons -- start from a clean slate instead of inheriting the previous
-    run's counts.  The live UI additionally captures per-run deltas at
-    listener construction, so calling this between runs is belt-and-braces
-    rather than required for the dashboard."""
-    from asyncframework_tpu.data.spill import reset_shuffle_totals
-    from asyncframework_tpu.metrics import trace as _trace
-    from asyncframework_tpu.net import reset_net_totals
-    from asyncframework_tpu.parallel.ps_dcn import reset_pipeline_totals
-    from asyncframework_tpu.parallel.supervisor import reset_recovery_totals
-    from asyncframework_tpu.serving.metrics import reset_serving_totals
+    """Zero EVERY process-global observability counter so back-to-back
+    runs in one process -- tests, notebooks, long-lived daemons -- start
+    from a clean slate instead of inheriting the previous run's counts.
 
-    reset_net_totals()
-    reset_recovery_totals()
-    reset_shuffle_totals()
-    reset_pipeline_totals()
-    reset_serving_totals()
+    The counter families (net, net bytes, recovery, shuffle, pipeline,
+    serving, convergence history, time-series store) are enumerated by
+    the one registry (``metrics/registry.py``) -- adding a family there
+    wires it into this reset, the live UI's per-run delta baselines, the
+    telemetry sampler, and the Prometheus exposition at once; the
+    registration audit test (``tests/test_telemetry.py``) fails on stray
+    unregistered ``*_totals`` providers.  The trace aggregator and SLO
+    rule states are not flat counter dicts, so they reset beside the
+    registry walk."""
+    from asyncframework_tpu.metrics import registry as _registry
+    from asyncframework_tpu.metrics import slo as _slo
+    from asyncframework_tpu.metrics import trace as _trace
+
+    _registry.reset_all()
     _trace.reset_aggregator()
+    _slo.reset_engine()
 
 
 __all__ = [
@@ -90,5 +94,7 @@ __all__ = [
     "TraceAggregator",
     "TraceContext",
     "TraceRecorder",
+    "TimeSeriesStore",
+    "ConvergenceHistory",
     "reset_totals",
 ]

@@ -23,8 +23,10 @@ from __future__ import annotations
 
 import http.server
 import json
+import os
 import threading
 import time
+import uuid
 from typing import Callable, Dict, List, Optional
 
 from asyncframework_tpu.metrics.bus import (
@@ -45,41 +47,30 @@ from asyncframework_tpu.metrics.trace import Span, TraceAggregator
 _ACTIVE: List["LiveUIServer"] = []
 _ACTIVE_LOCK = threading.Lock()
 
-
-def _shuffle_totals() -> Dict[str, int]:
-    from asyncframework_tpu.data.spill import shuffle_totals
-
-    return shuffle_totals()
-
-
-def _net_totals() -> Dict[str, int]:
-    from asyncframework_tpu.net import net_totals
-
-    return net_totals()
+#: process-wide run identity: stamped as the ``run_id`` label on every
+#: /metrics sample and in /api/status, so a Prometheus scrape (or a human
+#: comparing two dashboards) can tell process restarts apart
+RUN_ID = f"{uuid.uuid4().hex[:8]}-{os.getpid()}"
 
 
-def _net_bytes_totals() -> Dict[str, int]:
-    from asyncframework_tpu.net import frame
+def _family_totals() -> "Dict[str, Dict[str, int]]":
+    from asyncframework_tpu.metrics import registry
 
-    return frame.bytes_totals()
-
-
-def _recovery_totals() -> Dict[str, int]:
-    from asyncframework_tpu.parallel.supervisor import recovery_totals
-
-    return recovery_totals()
-
-
-def _pipeline_totals() -> Dict[str, int]:
-    from asyncframework_tpu.parallel.ps_dcn import pipeline_totals
-
-    return pipeline_totals()
+    out: Dict[str, Dict[str, int]] = {}
+    for name, fam in registry.families().items():
+        try:
+            out[name] = fam.totals()
+        except Exception:  # noqa: BLE001 - one family must not 500 the
+            out[name] = {}  # whole status endpoint
+    return out
 
 
-def _serving_totals() -> Dict[str, int]:
-    from asyncframework_tpu.serving.metrics import serving_totals
+def _baseline_families() -> Dict[str, object]:
+    """The registry families the live UI delta-baselines (per-run view);
+    keys -> CounterFamily."""
+    from asyncframework_tpu.metrics import registry
 
-    return serving_totals()
+    return {n: f for n, f in registry.families().items() if f.baseline}
 
 
 def _serving_snapshot() -> Dict:
@@ -92,6 +83,40 @@ def _lockwatch_totals() -> Dict:
     from asyncframework_tpu.net import lockwatch
 
     return lockwatch.totals()
+
+
+def _telemetry_sections() -> Dict[str, object]:
+    """The process-global telemetry-plane sections shared by every
+    /api/status (with or without a run listener): convergence curves +
+    summary, SLO health, and the time-series store meta-view."""
+    from asyncframework_tpu.metrics import slo, timeseries
+
+    conv = timeseries.convergence()
+    try:
+        health = slo.engine().health()
+    except Exception as e:  # noqa: BLE001 - a typo'd async.slo.rules must
+        # surface AS the health section, not 500 every dashboard page
+        # fleet-wide while training runs fine
+        health = {"state": "error", "rules": {},
+                  "error": f"{type(e).__name__}: {e}"}
+    return {
+        "convergence": {**conv.summary(), "curves": conv.curves()},
+        "health": health,
+        "timeseries": timeseries.store().summary(),
+    }
+
+
+def process_status(role: str = "process") -> Dict[str, object]:
+    """/api/status body for a process WITHOUT a run listener (workers,
+    serving replicas/frontends, the master): raw counter-family totals
+    plus the telemetry-plane sections."""
+    return {
+        "role": role,
+        "run_id": RUN_ID,
+        "pid": os.getpid(),
+        "counters": _family_totals(),
+        **_telemetry_sections(),
+    }
 
 
 def active_servers() -> List["LiveUIServer"]:
@@ -138,13 +163,15 @@ class LiveStateListener(Listener):
         # tools; the dashboard shows this run only)
         self._trace = TraceAggregator()
         # per-run delta baselines for the process-global counter panels: a
-        # second run's dashboard must not inherit the first run's counts
-        self._base_shuffle = _shuffle_totals()
-        self._base_net = _net_totals()
-        self._base_net_bytes = _net_bytes_totals()
-        self._base_recovery = _recovery_totals()
-        self._base_pipeline = _pipeline_totals()
-        self._base_serving = _serving_totals()
+        # second run's dashboard must not inherit the first run's counts.
+        # Registry-driven (metrics/registry.py): every baseline family
+        # gets captured here by construction -- a family added to the
+        # registry cannot be forgotten by this listener (the audit test
+        # in tests/test_telemetry.py checks the coverage).
+        self._bases: Dict[str, Dict[str, int]] = {
+            name: fam.totals()
+            for name, fam in _baseline_families().items()
+        }
 
     def register_queue_depth(self, fn: Callable[[], int]) -> None:
         self._queue_depth_fn = fn
@@ -206,13 +233,20 @@ class LiveStateListener(Listener):
 
     # ------------------------------------------------------------- snapshot
     def snapshot(self) -> Dict:
+        fams = _family_totals()  # one read per family: delta + raw agree
+        # process-global, touches no listener state -- and it runs a full
+        # SLO evaluation plus convergence-curve assembly, so gathering it
+        # under self._lock would stall every bus event callback behind
+        # each dashboard poll
+        telemetry = _telemetry_sections()
         with self._lock:
             elapsed = time.monotonic() - self._t0
             buckets = [
                 f"<={b}" for b in self.STALENESS_BUCKETS
             ] + [f">{self.STALENESS_BUCKETS[-1]}"]
-            pl = _pipeline_totals()  # one read: delta + high-water agree
+            pl = fams["pipeline"]
             return {
+                "run_id": RUN_ID,
                 "elapsed_s": round(elapsed, 3),
                 "rounds": self.rounds,
                 "accepted": self.accepted,
@@ -233,27 +267,30 @@ class LiveStateListener(Listener):
                 # driver-side shuffle accounting (SortShuffleManager /
                 # UnifiedMemoryManager observability role); per-run delta
                 # of the process-global totals
-                "shuffle": _delta(_shuffle_totals(), self._base_shuffle),
+                "shuffle": _delta(fams["shuffle"], self._bases["shuffle"]),
                 # DCN robustness counters (net/): retries taken, breaker
                 # trips, dedup hits, faults fired -- the failure-handling
                 # subsystem's health at a glance (per-run delta)
                 "net": dict(
-                    _delta(_net_totals(), self._base_net),
+                    _delta(fams["net"], self._bases["net"]),
                     # wire-bytes accounting (net/frame.py choke point):
                     # per-op sent/received frame bytes, per-run delta
-                    bytes=_delta(_net_bytes_totals(), self._base_net_bytes),
+                    bytes=_delta(fams["net_bytes"],
+                                 self._bases["net_bytes"]),
                 ),
                 # elastic-plane counters (parallel/supervisor.py): workers
                 # declared dead, shards adopted by survivors, rejoins,
                 # surrogate releases, PS checkpoint resumes (per-run delta)
-                "recovery": _delta(_recovery_totals(), self._base_recovery),
+                "recovery": _delta(fams["recovery"],
+                                   self._bases["recovery"]),
                 # pipelined update-loop counters (parallel/ps_dcn.py):
                 # prefetch hits/waits, stale-prefetch discards, async
                 # pushes (per-run delta); inflight_max is a high-water
                 # mark, shown raw
                 "pipeline": dict(
                     _delta({k: v for k, v in pl.items()
-                            if k != "inflight_max"}, self._base_pipeline),
+                            if k != "inflight_max"},
+                           self._bases["pipeline"]),
                     inflight_max=pl.get("inflight_max", 0),
                 ),
                 # serving-plane counters (serving/metrics.py): predicts,
@@ -264,7 +301,7 @@ class LiveStateListener(Listener):
                 # breakdown -- shown raw (rings are reset-scoped, not
                 # baseline-scoped)
                 "serving": dict(
-                    _delta(_serving_totals(), self._base_serving),
+                    _delta(fams["serving"], self._bases["serving"]),
                     detail=_serving_snapshot(),
                 ),
                 # debug lock watchdog (net/lockwatch.py): socket-IO-under-
@@ -275,6 +312,11 @@ class LiveStateListener(Listener):
                 # latency p50/p95/p99 and staleness in versions AND ms,
                 # folded from this run's TraceSpan events
                 "trace": self._trace.snapshot(),
+                # telemetry plane (metrics/timeseries.py + slo.py):
+                # convergence curves + summary, SLO health with burn
+                # durations, and the time-series store's meta-view (full
+                # rings on /api/timeseries)
+                **telemetry,
             }
 
 
@@ -297,13 +339,36 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+    def _status(self) -> Dict:
         state = self.server.state_listener  # type: ignore[attr-defined]
+        if state is not None:
+            return state.snapshot()
+        return process_status(
+            role=self.server.role  # type: ignore[attr-defined]
+        )
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
         if self.path.startswith("/api/status"):
-            body = json.dumps(state.snapshot()).encode()
+            body = json.dumps(self._status()).encode()
             self._send(200, body, "application/json")
+        elif self.path.startswith("/api/timeseries"):
+            # the full bounded rings (async-top sparklines, ad-hoc
+            # plotting); /api/status carries only the meta-view
+            from asyncframework_tpu.metrics import timeseries
+
+            body = json.dumps(timeseries.store().dump()).encode()
+            self._send(200, body, "application/json")
+        elif self.path.startswith("/metrics"):
+            # Prometheus text exposition (format 0.0.4), stamped with
+            # this server's process labels
+            from asyncframework_tpu.metrics import prom
+
+            body = prom.render(
+                self.server.prom_labels  # type: ignore[attr-defined]
+            ).encode()
+            self._send(200, body, "text/plain; version=0.0.4")
         elif self.path == "/" or self.path.startswith("/index"):
-            snap = json.dumps(state.snapshot(), indent=2)
+            snap = json.dumps(self._status(), indent=2)
             self._send(200, (_PAGE % snap).encode(), "text/html")
         else:
             self._send(404, b"not found", "text/plain")
@@ -313,18 +378,33 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
 
 class LiveUIServer:
-    """Threaded HTTP server around a :class:`LiveStateListener`.
+    """Threaded HTTP server around an optional :class:`LiveStateListener`.
+
+    With ``state=None`` this is a bare **telemetry server**: /api/status
+    serves the process-global counter/convergence/health view and
+    /metrics the Prometheus exposition -- the per-process endpoint
+    workers, serving replicas, frontends, and the master expose (see
+    :func:`start_telemetry_from_conf`).  With a state listener it is the
+    full live run dashboard, same endpoints included.
 
     ``port=0`` binds an ephemeral port (read it from ``.port`` after
     ``start``; also discoverable via :func:`active_servers`).
+    ``role``/``labels`` become the Prometheus labels on every sample
+    (plus ``run_id``, stamped automatically).
     """
 
-    def __init__(self, state: LiveStateListener, port: int = 0,
-                 host: str = "127.0.0.1"):
+    def __init__(self, state: Optional[LiveStateListener], port: int = 0,
+                 host: str = "127.0.0.1", role: str = "driver",
+                 labels: Optional[Dict[str, str]] = None):
         self.state = state
+        self.role = role
         self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.state_listener = state  # type: ignore[attr-defined]
+        self._httpd.role = role  # type: ignore[attr-defined]
+        self._httpd.prom_labels = dict(  # type: ignore[attr-defined]
+            {"role": role, "run_id": RUN_ID}, **(labels or {})
+        )
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -332,6 +412,12 @@ class LiveUIServer:
         return self._httpd.server_address[1]
 
     def start(self) -> "LiveUIServer":
+        # the continuous-telemetry contract: any process serving
+        # /metrics or a dashboard also samples its counters into the
+        # time-series store (SLO windows need history, not points)
+        from asyncframework_tpu.metrics import timeseries
+
+        timeseries.ensure_started()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="live-ui", daemon=True
         )
@@ -348,3 +434,29 @@ class LiveUIServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+def start_telemetry_from_conf(role: str, host: str = "0.0.0.0",
+                              labels: Optional[Dict[str, str]] = None
+                              ) -> Optional[LiveUIServer]:
+    """Start this process's bare telemetry endpoint when conf asks.
+
+    Reads ``async.metrics.port`` (-1 = off, the default; 0 = ephemeral):
+    every daemon entry point (worker daemon, serving replica/frontend,
+    master, cluster roles) calls this once at boot, so setting one conf
+    key -- or the ``ASYNCTPU_ASYNC_METRICS_PORT`` env var the k8s
+    manifests ship -- lights up /metrics and /api/status fleet-wide."""
+    from asyncframework_tpu.conf import METRICS_PORT, global_conf
+
+    port = int(global_conf().get(METRICS_PORT))
+    if port < 0:
+        return None
+    try:
+        return LiveUIServer(None, port=port, host=host, role=role,
+                            labels=labels).start()
+    except OSError:
+        # the port is taken -- e.g. a DCN executor inheriting its pod's
+        # ASYNCTPU_ASYNC_METRICS_PORT while the worker daemon already
+        # serves it.  Telemetry is best-effort fleet plumbing: the
+        # process must come up either way.
+        return None

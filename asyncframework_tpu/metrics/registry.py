@@ -1,0 +1,139 @@
+"""Counter-family registry: the ONE list of process-global counter dicts.
+
+Before this existed, each observability subsystem (net retries, wire
+bytes, elastic recovery, shuffle spill, pipelined-loop counters, serving
+QPS, ...) exported its own module-level ``*_totals()`` /
+``reset_*_totals()`` pair, and THREE consumers had to enumerate them by
+hand: ``metrics.reset_totals()`` (per-run isolation),
+``metrics/live.LiveStateListener`` (per-run delta baselines, so a second
+run's dashboard does not inherit the first run's counts), and now the
+time-series sampler (``metrics/timeseries.py``) and the Prometheus
+exposition (``metrics/prom.py``).  A family added to one list but
+forgotten in another only surfaced as a flaky "second run inherits
+counts" bug.  This module is the fix: every family is declared ONCE
+here, every consumer iterates :func:`families`, and a tier-1 audit test
+(``tests/test_telemetry.py``) introspects the package for stray
+``*_totals`` providers that are not registered.
+
+A family's ``totals`` must be a zero-arg callable returning a FLAT
+``Dict[str, int|float]`` (the live UI's ``_delta`` machinery and the
+Prometheus counter mapping both require flat numerics); ``reset`` zeroes
+it.  ``high_water`` names keys that are maxima rather than monotone
+counts -- per-run delta subtraction does not apply to them (the live UI
+shows them raw, and the sampler's ``rate()`` is meaningless on them).
+``baseline=False`` marks meta-families (the telemetry plane's own
+counters) that the live UI does not delta-baseline.
+
+Providers are referenced by (module, attr) strings and resolved lazily:
+importing this registry must not import jax-heavy modules.
+"""
+
+from __future__ import annotations
+
+import importlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Tuple
+
+
+@dataclass(frozen=True)
+class CounterFamily:
+    """One process-global flat counter dict and its reset."""
+
+    name: str
+    module: str        # dotted module owning the provider functions
+    totals_attr: str   # zero-arg callable -> Dict[str, int|float]
+    reset_attr: str    # zero-arg callable zeroing the totals
+    high_water: Tuple[str, ...] = ()
+    baseline: bool = True  # live UI captures a per-run delta baseline
+    doc: str = ""
+
+    def _resolve(self, attr: str) -> Callable:
+        return getattr(importlib.import_module(self.module), attr)
+
+    def totals(self) -> Dict[str, float]:
+        return self._resolve(self.totals_attr)()
+
+    def reset(self) -> None:
+        self._resolve(self.reset_attr)()
+
+
+_FAMILIES: "OrderedDict[str, CounterFamily]" = OrderedDict()
+
+
+def _register(fam: CounterFamily) -> None:
+    _FAMILIES[fam.name] = fam
+
+
+def families() -> "OrderedDict[str, CounterFamily]":
+    return OrderedDict(_FAMILIES)
+
+
+def totals(name: str) -> Dict[str, float]:
+    return _FAMILIES[name].totals()
+
+
+def all_totals() -> "OrderedDict[str, Dict[str, float]]":
+    """Every family's flat totals, registration order (the sampler's and
+    the Prometheus exposition's walk)."""
+    return OrderedDict((n, f.totals()) for n, f in _FAMILIES.items())
+
+
+def reset_all() -> None:
+    """Zero every registered family (``metrics.reset_totals`` core)."""
+    for fam in _FAMILIES.values():
+        fam.reset()
+
+
+# --------------------------------------------------------------------------
+# The families.  Order is presentation order (live UI, /metrics).
+# --------------------------------------------------------------------------
+_register(CounterFamily(
+    "net", "asyncframework_tpu.net", "net_totals", "reset_net_totals",
+    doc="DCN robustness: retries, breaker trips, dedup hits, faults "
+        "fired (net/retry.py, net/session.py, net/faults.py).",
+))
+_register(CounterFamily(
+    "net_bytes", "asyncframework_tpu.net.frame",
+    "bytes_totals", "reset_bytes_totals",
+    doc="Per-op frame bytes sent/received at the net/frame.py choke "
+        "point (also zeroed by reset_net_totals; resets are idempotent).",
+))
+_register(CounterFamily(
+    "recovery", "asyncframework_tpu.parallel.supervisor",
+    "recovery_totals", "reset_recovery_totals",
+    doc="Elastic plane: workers lost, shards adopted, rejoins, "
+        "releases, PS resumes (parallel/supervisor.py).",
+))
+_register(CounterFamily(
+    "shuffle", "asyncframework_tpu.data.spill",
+    "shuffle_totals", "reset_shuffle_totals",
+    doc="Driver-side shuffle routing/spill accounting (data/spill.py).",
+))
+_register(CounterFamily(
+    "pipeline", "asyncframework_tpu.parallel.ps_dcn",
+    "pipeline_totals", "reset_pipeline_totals",
+    high_water=("inflight_max",),
+    doc="Pipelined update loop: prefetch hits/waits, stale discards, "
+        "async pushes, push errors; inflight_max is a high-water mark.",
+))
+_register(CounterFamily(
+    "serving", "asyncframework_tpu.serving.metrics",
+    "serving_totals", "reset_serving_totals",
+    doc="Serving plane: predicts, failovers, unhealthy rejects, "
+        "refresh shapes (serving/metrics.py).",
+))
+_register(CounterFamily(
+    "convergence", "asyncframework_tpu.metrics.timeseries",
+    "convergence_totals", "reset_convergence",
+    baseline=False,
+    doc="Convergence telemetry meta-counters: samples folded, "
+        "piggybacks received, compactions (metrics/timeseries.py).",
+))
+_register(CounterFamily(
+    "timeseries", "asyncframework_tpu.metrics.timeseries",
+    "timeseries_totals", "reset_timeseries",
+    baseline=False,
+    doc="Time-series store meta-counters: samples recorded, series "
+        "live, evictions (metrics/timeseries.py).",
+))

@@ -1,0 +1,224 @@
+"""``async-top``: a terminal dashboard over ``/api/status``.
+
+The live UI's HTML page needs a browser; this is the ssh-session view —
+poll any process's status endpoint (driver dashboard, PS, worker,
+serving replica/frontend, master — anything `metrics/live.py` serves)
+and render throughput, per-stage latencies, the convergence curve and
+its slope, serving QPS/freshness, and the SLO health board in place,
+top(1)-style.
+
+Usage::
+
+    bin/async-top http://HOST:PORT [--interval 1.0] [--once] [--plain]
+
+``--once`` renders a single frame and exits (what the tests drive);
+``--plain`` skips the ANSI clear (pipe-friendly).  Rendering is PURE
+(:func:`render_status`: status dict -> text), so tests feed it captured
+snapshots without a server.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+#: SLO state -> (glyph, ANSI color) for the health board
+_STATE_GLYPH = {
+    "ok": ("ok", "32"),        # green
+    "pending": ("..", "33"),   # yellow
+    "firing": ("!!", "31"),    # red
+    "no_data": ("--", "90"),   # dim
+}
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float], width: int = 48) -> str:
+    """Downsample ``values`` to ``width`` block-character cells (the
+    loss-curve-in-a-terminal view).  Degenerate spans render flat."""
+    vals = [float(v) for v in values if v is not None
+            and math.isfinite(float(v))]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = len(vals) / width
+        vals = [vals[int(i * step)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / span * (len(_SPARK) - 1)))]
+        for v in vals
+    )
+
+
+def _fmt(v, nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != v:  # NaN
+            return "nan"
+        return f"{v:.{nd}f}" if abs(v) < 1e6 else f"{v:.3g}"
+    return str(v)
+
+
+def _color(text: str, code: str, plain: bool) -> str:
+    return text if plain else f"\x1b[{code}m{text}\x1b[0m"
+
+
+def render_status(status: Dict, plain: bool = True) -> str:
+    """One dashboard frame from an ``/api/status`` body (pure)."""
+    lines: List[str] = []
+    role = status.get("role", "driver")
+    head = [f"async-top  role={role}"]
+    if status.get("run_id"):
+        head.append(f"run={status['run_id']}")
+    if status.get("elapsed_s") is not None:
+        head.append(f"up={_fmt(status['elapsed_s'])}s")
+    if status.get("updates_per_sec") is not None:
+        head.append(f"{_fmt(status['updates_per_sec'])} upd/s")
+    if status.get("accepted") is not None:
+        head.append(f"acc={status['accepted']} drop="
+                    f"{status.get('dropped', 0)}")
+    if status.get("model_version") is not None:
+        head.append(f"v={status['model_version']}")
+    lines.append("  ".join(head))
+
+    # ---- health board (the reason to look at this screen at 3am)
+    health = status.get("health") or {}
+    rules = health.get("rules") or {}
+    if rules:
+        overall = health.get("state", "ok")
+        glyph, code = _STATE_GLYPH.get(overall, ("??", "0"))
+        lines.append("")
+        lines.append("SLO health: "
+                     + _color(f"{overall.upper()} [{glyph}]", code, plain))
+        for name in sorted(rules):
+            r = rules[name]
+            glyph, code = _STATE_GLYPH.get(r.get("state"), ("??", "0"))
+            detail = (f"{r.get('agg')}({r.get('series')}) {r.get('op')} "
+                      f"{_fmt(r.get('threshold'))}")
+            val = _fmt(r.get("value"), 2)
+            burn = (f" burn={_fmt(r.get('burn_s'))}s"
+                    if r.get("burn_s") else "")
+            fired = (f" fired×{r['fired']}" if r.get("fired") else "")
+            lines.append(
+                f"  {_color(glyph, code, plain)} {name:<18} {detail:<44} "
+                f"value={val}{burn}{fired}"
+            )
+
+    # ---- convergence curve + slope
+    conv = status.get("convergence") or {}
+    curves = conv.get("curves") or {}
+    lw = curves.get("loss_vs_wallclock") or []
+    if lw or conv.get("samples"):
+        lines.append("")
+        slope = conv.get("slope_per_s")
+        if slope is None:
+            trend = "?"
+        elif slope < 0:
+            trend = "converging"
+        elif slope > 0:
+            trend = "diverging"  # the 3am trend this line exists for
+        else:
+            trend = "plateaued"
+        lines.append(
+            f"convergence: loss={_fmt(conv.get('last_loss'), 6)} "
+            f"best={_fmt(conv.get('best_loss'), 6)} "
+            f"slope={_fmt(slope, 6)}/s ({trend}) "
+            f"samples={conv.get('samples', 0)}"
+        )
+        if lw:
+            lines.append("  loss " + sparkline([p[1] for p in lw]))
+
+    # ---- per-stage latency decomposition (trace section)
+    trace = status.get("trace") or {}
+    stages = trace.get("stages_ms") or {}
+    shown = [(s, d) for s, d in sorted(stages.items()) if d.get("count")]
+    if shown:
+        lines.append("")
+        lines.append(f"{'stage':<14}{'p50 ms':>10}{'p95 ms':>10}"
+                     f"{'p99 ms':>10}{'count':>9}")
+        for stage, d in shown:
+            lines.append(
+                f"{stage:<14}{_fmt(d.get('p50'), 2):>10}"
+                f"{_fmt(d.get('p95'), 2):>10}{_fmt(d.get('p99'), 2):>10}"
+                f"{d.get('count', 0):>9}"
+            )
+        sm = trace.get("staleness_ms") or {}
+        if sm.get("count"):
+            lines.append(f"staleness: p95={_fmt(sm.get('p95'))}ms "
+                         f"max={_fmt(sm.get('max'))}ms")
+
+    # ---- serving plane
+    serving = status.get("serving") or {}
+    detail = serving.get("detail") or serving  # driver vs bare process
+    if detail.get("qps") or detail.get("predicts"):
+        pm = detail.get("predict_ms") or {}
+        lines.append("")
+        lines.append(
+            f"serving: qps={_fmt(detail.get('qps'))} "
+            f"predict p50={_fmt(pm.get('p50'), 2)}ms "
+            f"p99={_fmt(pm.get('p99'), 2)}ms "
+            f"freshness={_fmt(detail.get('freshness_lag_ms'))}ms "
+            f"failovers={detail.get('failovers', 0)}"
+        )
+
+    ts = status.get("timeseries") or {}
+    if ts.get("series"):
+        lines.append("")
+        lines.append(f"timeseries: {ts['series']} series, "
+                     f"{ts.get('samples', 0)} samples "
+                     f"({ts.get('evicted', 0)} evicted)")
+    return "\n".join(lines) + "\n"
+
+
+def fetch_status(url: str, timeout_s: float = 5.0) -> Dict:
+    if not url.startswith("http"):
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/api/status"):
+        url = url.rstrip("/") + "/api/status"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        "async-top", description="terminal dashboard over /api/status"
+    )
+    p.add_argument("url", help="http://HOST:PORT (or HOST:PORT) of any "
+                               "process serving /api/status")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit")
+    p.add_argument("--plain", action="store_true",
+                   help="no ANSI colors / screen clears (pipe-friendly)")
+    args = p.parse_args(argv)
+    while True:
+        try:
+            status = fetch_status(args.url)
+            frame = render_status(status, plain=args.plain)
+        except (OSError, ValueError) as e:
+            frame = f"async-top: {args.url} unreachable ({e})\n"
+        if not args.plain:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(frame)
+        sys.stdout.flush()
+        if args.once:
+            return 0
+        try:
+            time.sleep(max(0.1, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,6 +174,32 @@ def _pl_fold(delta: Dict[str, int]) -> None:
                 _pl_totals[k] = _pl_totals.get(k, 0) + v
 
 
+def _cv_fold(wire, clock: int = 0,
+             wall_ms: Optional[float] = None) -> None:
+    """Fold piggybacked convergence samples (the ``cv`` PUSH/BYE header
+    entry: ``[[version, loss, grad_norm], ...]``) into the process-global
+    :class:`~asyncframework_tpu.metrics.timeseries.ConvergenceHistory`,
+    stamped with the PS run clock's wallclock and the staleness the PS
+    observes (merge clock minus the sample's model version).  Dedup'd
+    PUSH retries never reach the handlers, so a sample folds exactly
+    once -- the span/pipeline-counter discipline."""
+    if not wire:
+        return
+    from asyncframework_tpu.metrics import timeseries as _ts
+
+    conv = _ts.convergence()
+    now_ms = wall_ms if wall_ms is not None else time.time() * 1e3
+    for item in wire:
+        try:
+            version = int(item[0])
+            loss = item[1]
+            gnorm = item[2] if len(item) > 2 else None
+        except (TypeError, ValueError, IndexError):
+            continue  # junk from the wire must not kill the handler
+        conv.add(now_ms, version, loss=loss, grad_norm=gnorm,
+                 staleness=max(0, clock - version) if clock else None)
+
+
 class _PipelineStats:
     """Per-worker-process pipeline counters, shipped to the PS as deltas
     on PUSH headers (``pl``) and on BYE -- the same piggyback discipline
@@ -594,7 +620,31 @@ class ParameterServer:
             self._ckpt_thread.start()
         if self.supervisor is not None:
             self.supervisor.start()
+        # continuous telemetry (metrics/timeseries.py): this PS's core
+        # scalars become the ``ps.*`` time series every sampler tick --
+        # the updates/s-floor SLO (rate(ps.accepted)) and the adaptive
+        # controller's input surface.  Last registration wins, matching
+        # "the live PS owns the dashboard"; stop() unhooks only itself.
+        from asyncframework_tpu.metrics import timeseries as _ts
+
+        self._ts_source = self._telemetry_source
+        _ts.register_source("ps", self._ts_source)
+        _ts.ensure_started()
         return self
+
+    def _telemetry_source(self) -> Dict[str, float]:
+        """Flat scalars the time-series sampler records as ``ps.<key>``
+        (lock-free reads of ints: a tick may see a torn multi-field view,
+        but each individual series stays monotone/correct)."""
+        return {
+            "clock": self._clock,
+            "k": self._k,
+            "accepted": self.accepted,
+            "dropped": self.dropped,
+            "push_bytes": self.push_bytes,
+            "max_staleness": self.max_staleness,
+            "done": int(self._done.is_set()),
+        }
 
     # ---------------------------------------------------------- checkpointing
     def _checkpoint_state(self) -> dict:
@@ -845,9 +895,11 @@ class ParameterServer:
                 elif op == "BYE":
                     # a departing worker's last completed spans (push.rtt
                     # of its final traced update has no later PUSH to ride)
-                    # and its final pipeline-counter delta
+                    # and its final pipeline-counter / convergence deltas
                     self._fold_wire_spans(header.get("spans"))
                     _pl_fold(header.get("pl"))
+                    _cv_fold(header.get("cv"), clock=self._clock,
+                             wall_ms=self._bus_time_ms())
                     _send_msg(conn, {"op": "ACK"})
                     return
                 else:
@@ -1168,6 +1220,12 @@ class ParameterServer:
         # present when the worker runs the pipelined loop): dedup'd
         # retries never reach this handler, so a delta folds exactly once
         _pl_fold(header.get("pl"))
+        # convergence samples (conf-gated, async.convergence.sample):
+        # (version, loss, grad_norm) tuples fold into the loss-vs-wallclock
+        # / loss-vs-version curves, stamped with THIS PS's run clock and
+        # the staleness it observes right now
+        _cv_fold(header.get("cv"), clock=self._clock,
+                 wall_ms=self._bus_time_ms())
         tc = _trace.TraceContext.from_wire(header["tc"]) \
             if "tc" in header else None
         t_queue0 = _trace.now_ms() if tc is not None else 0.0
@@ -1549,6 +1607,11 @@ class ParameterServer:
     def stop(self) -> None:
         self._stop.set()
         self._done.set()
+        if getattr(self, "_ts_source", None) is not None:
+            from asyncframework_tpu.metrics import timeseries as _ts
+
+            # identity-gated: a stopped PS must not unhook its replacement
+            _ts.unregister_source("ps", self._ts_source)
         if self.supervisor is not None:
             self.supervisor.stop()
         with self._wave_cv:
@@ -1581,7 +1644,8 @@ class PSClient:
                  proc: Optional[str] = None,
                  recorder: Optional["_trace.TraceRecorder"] = None,
                  pull_mode: Optional[str] = None,
-                 pl_stats: Optional[_PipelineStats] = None):
+                 pl_stats: Optional[_PipelineStats] = None,
+                 cv_buf=None):
         self.host, self.port = host, int(port)
         self.endpoint = f"{host}:{self.port}"
         self.retry = retry if retry is not None else RetryPolicy.from_conf(
@@ -1615,6 +1679,12 @@ class PSClient:
         # same way spans do.  None (every non-pipelined client) = no
         # header field, byte-identical wire.
         self.pl_stats = pl_stats
+        # convergence telemetry (metrics/timeseries.ConvergenceBuffer):
+        # buffered (version, loss, grad_norm) samples ride PUSH/BYE
+        # headers as the ``cv`` entry, same discipline as spans and
+        # pipeline counters.  None (the default) = no header field,
+        # byte-identical wire.
+        self.cv_buf = cv_buf
         # elastic membership: the worker PROCESS token stamped on every
         # PULL/PUSH so the PS supervisor knows who serves which shard;
         # None = classic fixed-membership client
@@ -1969,10 +2039,10 @@ class PSClient:
 
     def _encode_push(self, wid: int, ts: int, g: np.ndarray,
                      sparse: bool, diff: Optional[np.ndarray], tr
-                     ) -> Tuple[dict, bytes, List[dict], dict]:
+                     ) -> Tuple[dict, bytes, List[dict], dict, List[list]]:
         """Shared encode/stamp front half of :meth:`push` and
-        :meth:`push_start`: returns ``(header, payload, spans, pl_delta)``
-        with the piggybacks already attached to the header."""
+        :meth:`push_start`: returns ``(header, payload, spans, pl_delta,
+        cv_wire)`` with the piggybacks already attached to the header."""
         t_enc0 = _trace.now_ms() if tr is not None else 0.0
         g = np.asarray(g, np.float32)
         # ASAGA pushes ride their own verb so fault schedules can tell the
@@ -2008,16 +2078,26 @@ class PSClient:
             pl_delta = self.pl_stats.take_wire()
             if pl_delta:
                 hdr["pl"] = pl_delta
-        return hdr, payload, spans, pl_delta
+        cv_wire: List[list] = []
+        if self.cv_buf is not None:
+            # convergence-sample piggyback: drain the unshipped tail (a
+            # bounded slice; the rest rides later pushes)
+            cv_wire = self.cv_buf.take_wire()
+            if cv_wire:
+                hdr["cv"] = cv_wire
+        return hdr, payload, spans, pl_delta, cv_wire
 
-    def _requeue_piggybacks(self, spans: List[dict], pl_delta: dict) -> None:
+    def _requeue_piggybacks(self, spans: List[dict], pl_delta: dict,
+                            cv_wire: Optional[List[list]] = None) -> None:
         """A push whose whole retry budget was spent must not silently eat
-        its piggybacked telemetry: spans and counter deltas go back to
-        ride the next push/BYE."""
+        its piggybacked telemetry: spans, counter deltas, and convergence
+        samples go back to ride the next push/BYE."""
         if spans and self.recorder is not None:
             self.recorder.requeue(spans)
         if pl_delta and self.pl_stats is not None:
             self.pl_stats.merge_back(pl_delta)
+        if cv_wire and self.cv_buf is not None:
+            self.cv_buf.merge_back(cv_wire)
 
     def push(self, wid: int, ts: int, g: np.ndarray,
              sparse: bool = False, diff: Optional[np.ndarray] = None,
@@ -2027,7 +2107,7 @@ class PSClient:
         push's encode time (push.wait) and round trip (push.rtt); any
         completed spans in the client's recorder piggyback on the header
         either way."""
-        hdr, payload, spans, pl_delta = self._encode_push(
+        hdr, payload, spans, pl_delta, cv_wire = self._encode_push(
             wid, ts, g, sparse, diff, tr
         )
         # stamp ONCE: retries re-send the same (sid, seq), so a push whose
@@ -2038,7 +2118,7 @@ class PSClient:
                 self.session.stamp(self._proc_hdr(hdr)), payload,
             )
         except BaseException:
-            self._requeue_piggybacks(spans, pl_delta)
+            self._requeue_piggybacks(spans, pl_delta, cv_wire)
             raise
         if header.get("released"):
             self.released = True
@@ -2065,7 +2145,7 @@ class PSClient:
         its ACK.  A send error (or an already-dead socket) is deferred:
         the entry stays in the window and :meth:`push_finish`'s
         reconnect replays it."""
-        hdr, payload, spans, pl_delta = self._encode_push(
+        hdr, payload, spans, pl_delta, cv_wire = self._encode_push(
             wid, ts, g, sparse, diff, tr
         )
         token = tr.rpc_begin(_trace.PUSH_RTT) if tr is not None else None
@@ -2075,7 +2155,7 @@ class PSClient:
         # so the rtt span's `bytes` pairs OUR send with OUR reply even
         # though the single-threaded loop interleaves other frames)
         entry = [self.session.stamp(self._proc_hdr(hdr)), payload, tr,
-                 token, spans, pl_delta, 0]
+                 token, spans, pl_delta, cv_wire, 0]
         with self._win_lock:
             self._push_window.append(entry)
             if self._sock is not None:
@@ -2090,7 +2170,7 @@ class PSClient:
             _trace.set_current(tr.ctx)  # the tc header for THIS push
         try:
             _send_msg(self._sock, hdr, payload)
-            entry[6] = _frame.last_sent_bytes()
+            entry[7] = _frame.last_sent_bytes()
         finally:
             if tr is not None:
                 _trace.set_current(None)
@@ -2131,7 +2211,7 @@ class PSClient:
 
         header, _ = self.retry.call(attempt, endpoint=self.endpoint)
         entry = self._push_window.popleft()
-        _hdr, _payload, tr, token, _spans, _pl, sent_bytes = entry
+        _hdr, _payload, tr, token, _spans, _pl, _cv, sent_bytes = entry
         if tr is not None and token is not None:
             tr.rpc_end(token,
                        bytes=sent_bytes + _frame.last_recv_bytes())
@@ -2148,7 +2228,7 @@ class PSClient:
             n = len(self._push_window)
             while self._push_window:
                 entry = self._push_window.popleft()
-                self._requeue_piggybacks(entry[4], entry[5])
+                self._requeue_piggybacks(entry[4], entry[5], entry[6])
             self._drop_sock()
         return n
 
@@ -2216,6 +2296,12 @@ class PSClient:
                     pl_delta = self.pl_stats.take_wire()
                     if pl_delta:
                         hdr["pl"] = pl_delta
+                if self.cv_buf is not None:
+                    # the final unshipped convergence samples leave with
+                    # the goodbye, like the last traced update's spans
+                    cv_wire = self.cv_buf.take_wire()
+                    if cv_wire:
+                        hdr["cv"] = cv_wire
                 _send_msg(self._sock, hdr)
                 _recv_msg(self._sock)
         except (ConnectionError, OSError):
@@ -2307,6 +2393,46 @@ def run_worker_process(
         # alternation; pipelining is an ASGD-path capability.
         pipe_depth = 0
     pl_stats = _PipelineStats() if pipe_depth > 0 else None
+    # convergence telemetry (async.convergence.sample /
+    # SolverConfig.conv_sample): every Nth update per logical worker
+    # evaluates the shard's mean loss (one extra jitted eval against the
+    # model the gradient was computed on) plus the gradient norm, and
+    # buffers the (version, loss, grad_norm) sample for the next PUSH
+    # header's ``cv`` entry -- the PS folds them into the process-global
+    # loss-vs-wallclock / loss-vs-version curves (metrics/timeseries.py).
+    # 0 = off: no eval, no header field, byte-identical wire.
+    conv_every = getattr(cfg, "conv_sample", None)
+    if conv_every is None:
+        from asyncframework_tpu.conf import CONV_SAMPLE, global_conf
+
+        conv_every = global_conf().get(CONV_SAMPLE)
+    conv_every = max(0, int(conv_every))
+    cv_buf = None
+    conv_eval = None
+    if conv_every > 0:
+        from asyncframework_tpu.metrics.timeseries import ConvergenceBuffer
+
+        cv_buf = ConvergenceBuffer()
+        conv_eval = (steps.make_sparse_trajectory_loss_eval() if sparse
+                     else steps.make_trajectory_loss_eval(
+                         getattr(cfg, "loss", "least_squares")))
+
+    def conv_sample(shard, w_dev, ts: int, g_host: np.ndarray) -> None:
+        """One convergence sample: shard mean loss at the pulled model +
+        gradient norm, buffered for the PUSH piggyback.  Telemetry must
+        never break the update loop."""
+        try:
+            if sparse:
+                sums = conv_eval(shard.cols, shard.vals, shard.y,
+                                 w_dev[None, :])
+            else:
+                sums = conv_eval(shard.X, shard.y, w_dev[None, :])
+            loss = (float(np.asarray(sums)[0])
+                    / max(1, int(shard.y.shape[0])))
+            cv_buf.add(ts, loss, float(np.linalg.norm(g_host)))
+        except Exception:  # noqa: BLE001
+            pass
+
     # elastic adoption bookkeeping: which wids this process serves (own +
     # adopted), and every loop thread ever started (joined at the end)
     group_lock = threading.Lock()
@@ -2397,7 +2523,8 @@ def run_worker_process(
                         cl = PSClient(host, port, proc=proc_token,
                                       recorder=recorder,
                                       pull_mode=getattr(cfg, "pull_mode",
-                                                        None))
+                                                        None),
+                                      cv_buf=cv_buf)
                     # per-update sampling decision: a traced update's RPCs
                     # carry the trace context on the wire and its lifecycle
                     # spans (pull.rtt/compute/push.wait/push.rtt) land in
@@ -2447,6 +2574,9 @@ def run_worker_process(
                         diff_host = np.asarray(diff)
                         if tr is not None:
                             tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
+                        if cv_buf is not None and \
+                                counts[wid] % conv_every == 0:
+                            conv_sample(shard, w_dev, ts, g_host)
                         _accepted, done = cl.push_saga(
                             wid, ts, g_host, diff_host, sparse=sparse,
                             tr=tr,
@@ -2457,6 +2587,9 @@ def run_worker_process(
                         g_host = np.asarray(g)  # the push IS the readback
                         if tr is not None:
                             tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
+                        if cv_buf is not None and \
+                                counts[wid] % conv_every == 0:
+                            conv_sample(shard, w_dev, ts, g_host)
                         _accepted, done = cl.push(wid, ts, g_host,
                                                   sparse=sparse, tr=tr)
                     if done:
@@ -2556,7 +2689,8 @@ def run_worker_process(
                                        recorder=recorder,
                                        pull_mode=getattr(cfg, "pull_mode",
                                                          None),
-                                       pl_stats=pl_stats)
+                                       pl_stats=pl_stats,
+                                       cv_buf=cv_buf)
                     break
                 except (ConnectionError, OSError):
                     time.sleep(0.2)  # PS mid-restart: pace and re-dial
@@ -2621,6 +2755,8 @@ def run_worker_process(
                 g_host = readback(g)
                 if cur_tr is not None:
                     cur_tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
+                if cv_buf is not None and counts[wid] % conv_every == 0:
+                    conv_sample(shard, w_dev, ts, g_host)
                 # depth cap: at most pipe_depth unACKed pushes in flight
                 # -- THE staleness bound the taw admission prices.  Reap
                 # lazily: ACKs usually sit in the buffer already.

@@ -68,6 +68,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     from asyncframework_tpu.net.faults import maybe_install_from_conf
 
     maybe_install_from_conf()  # chaos fabric reaches serving daemons too
+    from asyncframework_tpu.metrics.live import start_telemetry_from_conf
+
+    # per-process telemetry endpoint (async.metrics.port; -1 = off):
+    # /metrics Prometheus exposition + /api/status counters/health for
+    # the serving fleet -- k8s manifests annotate these pods for scraping
+    if args.role == "replica":
+        start_telemetry_from_conf("replica",
+                                  labels={"rid": str(args.rid)})
+    else:
+        start_telemetry_from_conf("frontend")
     if args.role == "replica":
         from asyncframework_tpu.serving.replica import serve_replica
 

@@ -298,6 +298,7 @@ class ServingFrontend(FramedServer):
                     # does not
                     if slot is not None:
                         self.supervisor.touch(slot, ch.proc)
+                    smetrics.note_attempt()
                     smetrics.bump("unhealthy_rejects")
                     smetrics.bump("failovers")
                     continue

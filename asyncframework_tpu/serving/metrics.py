@@ -31,6 +31,12 @@ _lag_ms = Histogram(capacity=4096)
 #: monotonic time of the first/last observed predict (per-process QPS)
 _t_first: Optional[float] = None
 _t_last: Optional[float] = None
+#: monotonic time + observed lag of the last SUCCESSFUL predict: the
+#: freshness-lag SLO input (metrics/slo.py).  While predicts keep
+#: succeeding this tracks the served lag; when every replica is down the
+#: last success recedes into the past and the derived value grows.
+_t_last_ok: Optional[float] = None
+_last_ok_lag_ms: float = 0.0
 
 
 def bump(key: str, n: int = 1) -> None:
@@ -47,9 +53,12 @@ def observe_predict(endpoint: str, dur_ms: float, lag_versions: int,
                     lag_ms: float, ts: int, ok: bool = True) -> None:
     """One answered (or failed) PREDICT against ``endpoint``: latency and
     the freshness lag the reply was served at."""
-    global _t_first, _t_last
+    global _t_first, _t_last, _t_last_ok, _last_ok_lag_ms
     now = time.monotonic()
     with _lock:
+        if ok:
+            _t_last_ok = now
+            _last_ok_lag_ms = float(lag_ms)
         _totals["predicts"] = _totals.get("predicts", 0) + int(ok)
         if not ok:
             # per-ATTEMPT failure (one replica, one RPC); requests that
@@ -72,6 +81,37 @@ def observe_predict(endpoint: str, dur_ms: float, lag_versions: int,
         _lag_ms.update(float(lag_ms))
 
 
+def note_attempt() -> None:
+    """A PREDICT attempt reached the serving plane without producing a
+    servable answer (e.g. every replica rejected it UNHEALTHY): advances
+    the demand clock :func:`freshness_lag_ms` grows against.  RPC-level
+    failures take the same clock via ``observe_predict(ok=False)``."""
+    global _t_first, _t_last
+    now = time.monotonic()
+    with _lock:
+        if _t_first is None:
+            _t_first = now
+        _t_last = now
+
+
+def freshness_lag_ms() -> Optional[float]:
+    """The serve-freshness SLO signal: the model-content lag observed at
+    the last successful predict, grown by how far the last predict
+    ATTEMPT (ok or failed) has receded past it.  While traffic is being
+    answered this tracks the true served lag; when attempts keep failing
+    (replicas dead or all UNHEALTHY) the value grows with the failing
+    demand -- exactly the "reads are going stale" condition a freshness
+    SLO exists to catch.  A traffic lull with healthy replicas holds the
+    last observed lag instead of growing (nobody is being served stale
+    when nobody is reading), and None until the first successful predict
+    (an idle frontend is not an outage)."""
+    with _lock:
+        if _t_last_ok is None:
+            return None
+        ref = _t_last_ok if _t_last is None else max(_t_last, _t_last_ok)
+        return round(_last_ok_lag_ms + (ref - _t_last_ok) * 1e3, 3)
+
+
 def serving_totals() -> Dict[str, int]:
     """Flat monotone counters (live-UI ``_delta`` compatible)."""
     with _lock:
@@ -90,6 +130,7 @@ def serving_snapshot() -> Dict:
     return {
         **totals,
         "qps": round(n / window, 1) if window > 0 else float(n),
+        "freshness_lag_ms": freshness_lag_ms(),
         "predict_ms": _predict_ms.snapshot(),
         "lag_versions": _lag_versions.snapshot(),
         "lag_ms": _lag_ms.snapshot(),
@@ -101,10 +142,13 @@ def reset_serving_totals() -> None:
     """Zero every serving counter, ring, and per-replica view (per-run
     isolation; see ``asyncframework_tpu.metrics.reset_totals``)."""
     global _predict_ms, _lag_versions, _lag_ms, _t_first, _t_last
+    global _t_last_ok, _last_ok_lag_ms
     with _lock:
         _totals.clear()
         _replicas.clear()
         _t_first = _t_last = None
+        _t_last_ok = None
+        _last_ok_lag_ms = 0.0
     _predict_ms = Histogram(capacity=4096)
     _lag_versions = Histogram(capacity=4096)
     _lag_ms = Histogram(capacity=4096)

@@ -868,4 +868,10 @@ class ASAGA(FlopsAccountingMixin):
                 part = self._eval(shard.X, shard.y, Wd)
             totals += np.asarray(part, np.float64)
         totals /= self.ds.n
-        return [(t, float(l)) for (t, _), l in zip(snapshots, totals)]
+        traj = [(t, float(l)) for (t, _), l in zip(snapshots, totals)]
+        # continuous telemetry: fold the run's loss-vs-wallclock curve
+        # into the process-global convergence history (see asgd.py)
+        from asyncframework_tpu.metrics import timeseries as _ts
+
+        _ts.fold_trajectory(traj)
+        return traj

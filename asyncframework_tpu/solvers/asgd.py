@@ -841,4 +841,11 @@ class ASGD(FlopsAccountingMixin):
                 part = self._eval(shard.X, shard.y, Wd)
             totals += np.asarray(part, np.float64)
         totals /= self.ds.n
-        return [(t, float(l)) for (t, _), l in zip(snapshots, totals)]
+        traj = [(t, float(l)) for (t, _), l in zip(snapshots, totals)]
+        # continuous telemetry: the finished run's loss-vs-wallclock curve
+        # lands in the process-global convergence history (the /api/status
+        # `convergence` section the in-process live UI serves)
+        from asyncframework_tpu.metrics import timeseries as _ts
+
+        _ts.fold_trajectory(traj)
+        return traj

@@ -339,6 +339,15 @@ class SolverConfig:
     # async.trace.sample conf default (1/64) governs the DCN plane, whose
     # stages are network-dominated.
     trace_sample: Optional[float] = None
+    # convergence telemetry (metrics/timeseries.py): every Nth update per
+    # logical DCN worker evaluates its shard's mean loss + grad norm and
+    # piggybacks the sample on the next PUSH header (``cv``) for the PS's
+    # loss-vs-wallclock / loss-vs-version curves.  None = resolve from
+    # conf async.convergence.sample (default 0 = off: one extra jitted
+    # eval per sample, and byte-identity suites compare exact wires);
+    # async-cluster flips it to 16.  In-process solvers fold their
+    # post-hoc trajectory instead -- this knob is DCN-worker-side only.
+    conv_sample: Optional[int] = None
     # failure detection / elastic recovery (HeartbeatReceiver parity)
     heartbeat: bool = True                # liveness monitoring during the run
     heartbeat_timeout_ms: float = 2000.0

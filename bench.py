@@ -134,6 +134,32 @@ def emit(payload: dict) -> None:
     sys.stdout.flush()
 
 
+def telemetry_block(trajectory, updates_per_sec) -> dict:
+    """Per-config statistical-efficiency record (ISSUE 7): the convergence
+    curve summarized as loss at 25/50/100% of the run's wallclock plus its
+    trailing-half slope, and the conf SLO rule set's static verdicts --
+    BENCH_*.json captures how well the run CONVERGED, not just how fast it
+    pushed updates."""
+    from asyncframework_tpu.metrics import slo
+    from asyncframework_tpu.metrics.timeseries import (
+        loss_at_fractions,
+        loss_slope,
+    )
+
+    out: dict = {}
+    try:
+        traj = [(t, l) for (t, l) in (trajectory or [])]
+        out["loss_at"] = loss_at_fractions(traj)
+        slope = loss_slope(traj)
+        out["slope_per_s"] = (round(slope, 8) if slope is not None
+                              else None)
+        out["samples"] = len(traj)
+        out["slo"] = slo.bench_verdicts(updates_per_sec, traj)
+    except Exception as e:  # evidence-only: never fail the run on it
+        out["error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    return out
+
+
 # --------------------------------------------------------------------- child
 def arm_watchdog(config_name: str) -> None:
     """Emit a parseable failure line and hard-exit if the process wedges
@@ -366,7 +392,9 @@ def run_child(config_name: str) -> None:
               "note": "TARGET NOT REACHED",
               "elapsed_s": round(res.elapsed_s, 2),
               "final_over_initial": res.trajectory[-1][1] / initial,
-              "trace": trace_snap})
+              "trace": trace_snap,
+              "telemetry": telemetry_block(res.trajectory,
+                                           res.updates_per_sec)})
         return
     baseline = spark_equal_recipe_baseline(cfg, k_hit)
 
@@ -431,6 +459,9 @@ def run_child(config_name: str) -> None:
         # per-stage latency decomposition + staleness-in-ms (None unless
         # the parent ran with --trace-jsonl / BENCH_TRACE=1)
         "trace": trace_snap,
+        # statistical efficiency: loss at 25/50/100% wallclock, trailing
+        # slope, and the conf SLO rule set's verdicts for this run
+        "telemetry": telemetry_block(res.trajectory, res.updates_per_sec),
     })
 
 
@@ -921,7 +952,7 @@ def run_fallback(names, deadline) -> dict:
         keep = {k: rec.get(k) for k in (
             "ok", "t_hit", "k_hit", "updates_per_sec", "accepted",
             "elapsed_s", "gflops", "kernel_gflops", "kernel_ms_per_update",
-            "fused", "note",
+            "fused", "note", "telemetry",
         )}
         block["configs"][name] = keep
     try:
@@ -1137,6 +1168,11 @@ def run_parent() -> None:
             # latest sample's full decomposition rides the artifact: the
             # BENCH trajectory gains per-stage p50/p95/p99 + staleness-ms
             configs_out[name]["trace"] = traced[-1]
+        telem = [r["telemetry"] for r in recs if r.get("telemetry")]
+        if telem:
+            # latest sample's convergence summary + SLO verdicts: the
+            # artifact records statistical efficiency, not just updates/s
+            configs_out[name]["telemetry"] = telem[-1]
         ratios.append(med_ratio)
         if name == "epsilon":
             headline_value = med_t

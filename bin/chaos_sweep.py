@@ -28,6 +28,57 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def slo_sanity(seed: int) -> str:
+    """Per-seed SLO-engine sanity (ISSUE 7): drive the conf rule set
+    through a seeded outage -- healthy window, violation long enough to
+    burn into firing, then recovery -- and assert no rule stays wedged
+    firing after the series recovers.  Deterministic per seed (the noise
+    walk is seeded); returns "" on pass, the failure reason otherwise."""
+    import random
+
+    sys.path.insert(0, REPO)
+    from asyncframework_tpu.metrics.slo import (
+        FIRING,
+        OK,
+        SLOEngine,
+        parse_rules,
+    )
+    from asyncframework_tpu.metrics.timeseries import TimeSeriesStore
+    from asyncframework_tpu.utils.clock import ManualClock
+
+    rng = random.Random(seed)
+    clk = ManualClock()
+    store = TimeSeriesStore(capacity=512, clock=clk)
+    rules = parse_rules(
+        "lag: p95(serving.freshness_lag_ms) < 2000 over 15s for 2s"
+    )
+    eng = SLOEngine(rules, store=store, now_fn=lambda: clk.now_ms() / 1e3)
+
+    def tick(value: float, n: int) -> None:
+        for _ in range(n):
+            clk.advance(1000)
+            store.record("serving.freshness_lag_ms",
+                         value * (1.0 + rng.uniform(-0.05, 0.05)))
+            eng.evaluate()
+
+    tick(100.0, 20)     # healthy
+    state0 = eng.evaluate()["lag"]["state"]
+    if state0 != OK:
+        return f"healthy window evaluated {state0!r}, want ok"
+    tick(10_000.0, 20)  # outage: violated >> burn duration
+    state1 = eng.evaluate()["lag"]["state"]
+    if state1 != FIRING:
+        return f"sustained violation evaluated {state1!r}, want firing"
+    tick(100.0, 30)     # recovery: the whole 15 s window drains
+    view = eng.evaluate()["lag"]
+    if view["state"] != OK:
+        return (f"rule wedged {view['state']!r} after recovery "
+                f"(value={view['value']})")
+    if not view["fired"] or not view["recovered"]:
+        return f"transition counts wrong: {view}"
+    return ""
+
+
 def run_seed(seed: int, args) -> dict:
     env = dict(os.environ)
     env["ASYNC_CHAOS_SEED"] = str(seed)
@@ -40,10 +91,13 @@ def run_seed(seed: int, args) -> dict:
     # the serving scenario rides every sweep seed: seeded SUBSCRIBE/
     # PREDICT fault schedules (torn-model and failover invariants) are
     # part of the chaos surface now that a read path exists
+    # telemetry-plane chaos rides every seed too: /metrics + /api/status
+    # availability/validity under the fault schedule (tests/test_telemetry)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
-        "-q", "-m", f"({marker}) or serve",
+        "tests/test_telemetry.py",
+        "-q", "-m", f"({marker}) or serve or telemetry",
         "-p", "no:cacheprovider",
     ]
     if args.soak:
@@ -60,9 +114,16 @@ def run_seed(seed: int, args) -> dict:
     elapsed = time.monotonic() - t0
     tail = proc.stdout.strip().splitlines()
     summary = tail[-1] if tail else ""
+    ok = proc.returncode == 0
+    # SLO-engine sanity each seed: no rule may stay wedged firing after
+    # recovery completes (deterministic, seeded; one-line repro below)
+    slo_err = slo_sanity(seed)
+    if slo_err:
+        ok = False
+        summary = f"SLO sanity: {slo_err} | {summary}"
     return {
         "seed": seed,
-        "ok": proc.returncode == 0,
+        "ok": ok,
         "elapsed_s": elapsed,
         "summary": summary,
         "output": proc.stdout,
