@@ -532,12 +532,38 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
             f"needs at least one partition"
         )
     if pid == 0:
-        from asyncframework_tpu.conf import ELASTIC_ENABLED
+        from asyncframework_tpu.conf import ELASTIC_ENABLED, PS_SHARDS
 
+        # sharded PS group (async.ps.shards > 1, ASGD only): this driver
+        # process runs shard 0 (the primary -- wave gate, worker
+        # supervision, eval plane) on the coordinator port and a
+        # ShardGroup controller spawning + supervising the secondary
+        # shard processes; workers resolve the map at HELLO.
+        ps_shards = max(1, int(conf.get(PS_SHARDS)))
+        if ps_shards > 1 and algo != "asgd":
+            raise SystemExit("async.ps.shards > 1 supports asgd only "
+                             "(ASAGA's PS-side sampling is range-global)")
+        ckpt_dir = args.checkpoint_dir
+        if ps_shards > 1 and not ckpt_dir:
+            # sharded failover is checkpoint-based: a shard relaunched
+            # with no durable state would serve a ZERO model for its
+            # range mid-run (silent convergence loss).  "Kill any shard,
+            # lose nothing" therefore defaults to a run-scoped dir
+            # rather than degrading quietly; --checkpoint-dir overrides.
+            import tempfile
+
+            ckpt_dir = tempfile.mkdtemp(prefix="async-ps-shards-")
+            print(f"async.ps.shards={ps_shards}: no --checkpoint-dir; "
+                  f"using {ckpt_dir} for shard failover checkpoints",
+                  file=sys.stderr)
         ckpt_path = None
-        if args.checkpoint_dir:
-            os.makedirs(args.checkpoint_dir, exist_ok=True)
-            ckpt_path = os.path.join(args.checkpoint_dir, f"ps_{algo}.npz")
+        if ckpt_dir:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            ckpt_path = (
+                os.path.join(ckpt_dir, "ps_shard0.npz")
+                if ps_shards > 1
+                else os.path.join(ckpt_dir, f"ps_{algo}.npz")
+            )
         sup = None
         if conf.get(ELASTIC_ENABLED):
             from asyncframework_tpu.parallel.supervisor import (
@@ -573,21 +599,56 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
                 bus.add_listener(live_state)
                 ui = LiveUIServer(live_state, port=ui_port).start()
             bus.start()
+        group = None
         try:
+            ps_d = args.d
+            shard_map_wire = None
+            if ps_shards > 1:
+                from asyncframework_tpu.parallel.shardgroup import (
+                    ShardGroup,
+                    shard_ranges,
+                )
+
+                # the driver IS shard 0 (primary: wave gate, worker
+                # supervision, eval plane) on the coordinator port; the
+                # ShardGroup controller spawns, probes, and restarts the
+                # secondary shard processes on this host.  Workers learn
+                # the assembled map from the primary's WELCOME.
+                group = ShardGroup(
+                    cfg, args.d, args.N, ps_shards, host=host, algo=algo,
+                    checkpoint_dir=ckpt_dir,
+                    indices=range(1, ps_shards),
+                    fixed_entries={0: (host, int(port_s))},
+                    conf_overlays=conf.to_dict(),
+                    worker_procs=0,
+                    stderr_dir=os.environ.get("ASYNC_SHARD_STDERR_DIR"),
+                ).start()
+                shard_map_wire = group.smap.to_wire()
+                ps_d = shard_ranges(args.d, ps_shards)[0][1]
             ps = ps_dcn.ParameterServer(
-                cfg, args.d, args.N, host="0.0.0.0", port=int(port_s),
+                cfg, ps_d, args.N, host="0.0.0.0", port=int(port_s),
                 algo=algo, checkpoint_path=ckpt_path, supervisor=sup,
-                bus=bus,
+                bus=bus, shard_map=shard_map_wire, shard_index=0,
             ).start()
             ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
             if not ok:
                 # progress-aware diagnostic: who went silent, who
                 # contributed
                 print(ok.diagnostic, file=sys.stderr)
+            if group is not None:
+                # group-wide DONE backstop (workers' BYE already broadcast
+                # FINISH best-effort); also stops treating child exits as
+                # deaths so teardown is not mistaken for a crash
+                group.finish()
             total = ps.collect_eval(n_workers_procs, timeout_s=120.0)
             trajectory = []
             if total is not None:
                 times, _W = ps.snapshot_stack()
+                # sharded eval stacks are tail-aligned worker-side (the
+                # assembled trajectory is the min length across shards),
+                # so the loss rows pair with the TAIL of the primary's
+                # snapshot times; at shards=1 the slice is the whole list
+                times = times[-len(total):]
                 trajectory = [
                     (t, float(l) / args.N) for t, l in zip(times, total)
                 ]
@@ -604,6 +665,10 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
                 "final_objective": trajectory[-1][1] if trajectory else None,
                 "trajectory": trajectory,
             }
+            if group is not None:
+                # same section /api/status serves (metrics/live.py reads
+                # the active group) -- one assembly, no drift
+                summary["ps_shards"] = group.status_section()
             if ui is not None:
                 summary["ui_port"] = ui.port
             return summary
@@ -612,6 +677,8 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
             # summary must still seal the event log (a .gz without its end
             # marker forces every later read through the torn-tail path)
             # and stop the UI/bus threads
+            if group is not None:
+                group.stop()
             if ui is not None:
                 ui.stop()
             if bus is not None:

@@ -57,8 +57,18 @@ def set_global_conf(conf: Optional["AsyncConf"]) -> None:
 
 
 def global_conf() -> "AsyncConf":
-    """The installed process conf, or a fresh one (env > defaults)."""
-    return _GLOBAL_CONF if _GLOBAL_CONF is not None else AsyncConf()
+    """The installed process conf; created AND INSTALLED on first use.
+
+    The lazily-created default is installed (not discarded): before this,
+    ``global_conf().set(...)`` on a process that never called
+    :func:`set_global_conf` silently mutated a throwaway instance and the
+    next ``global_conf()`` call returned a fresh one -- the classic
+    lost-write footgun.  Now the first call pins the instance, so sets
+    stick regardless of whether the CLI installed overlays first."""
+    global _GLOBAL_CONF
+    if _GLOBAL_CONF is None:
+        _GLOBAL_CONF = AsyncConf()
+    return _GLOBAL_CONF
 
 
 class AsyncConf:
@@ -248,6 +258,19 @@ PULL_DELTA_VERSIONS = ConfigEntry(
     "delta client shows up.  0 disables the cache: delta-mode pulls are "
     "answered NOT_MODIFIED on an exact-version match (needs no cache) "
     "or full otherwise.")
+PS_SHARDS = ConfigEntry(
+    "async.ps.shards", 1, int,
+    "Parameter-server shard processes the launcher provisions "
+    "(parallel/shardgroup.py): the model is range-partitioned across "
+    "this many ParameterServer processes behind a shard map workers "
+    "resolve at HELLO.  A PULL becomes per-shard parallel sub-pulls "
+    "(each reusing the have= NM/XDELTA/FULL negotiation and CRC "
+    "gating), a PUSH fans out per-shard rows under per-shard (sid, "
+    "seq) exactly-once sessions, and the staleness contract becomes a "
+    "per-shard version vector.  Shard 0 (the primary) keeps the wave "
+    "gate, the elastic supervisor, and the eval plane; secondaries "
+    "serve their ranges ungated.  1 (the default) is the classic "
+    "single-PS path, byte- and step-identical.")
 PUSH_MERGE = ConfigEntry(
     "async.push.merge", 8, int,
     "Upper bound on PUSHes the PS coalesces into one fused device apply "
@@ -383,7 +406,9 @@ SLO_RULES = ConfigEntry(
     "predict_p99: max(serving.predict_ms_p99) < 500 over 30s for 5s; "
     "staleness_ms: max(trace.staleness_ms_p95) < 60000 over 30s for 5s; "
     "updates_floor: rate(ps.accepted) > 0.5 over 30s for 10s "
-    "unless ps.done",
+    "unless ps.done; "
+    "shard_availability: max(ps_shards.dark_ranges) < 1 over 15s "
+    "for 3s unless ps_shards.done",
     str,
     "Declarative SLO rule set (metrics/slo.py grammar: '<name>: "
     "<agg>(<series>) <op> <threshold> [over Ns] [for Ns] "

@@ -302,6 +302,111 @@ def render_serving(replicas: int, ps: str, namespace: str = "default",
     ]
 
 
+PS_SHARD_PORT = 7200
+
+
+def render_ps_shards(shards: int, d: int, n: int,
+                     workers: int = 8, namespace: str = "default",
+                     image: str = DEFAULT_IMAGE,
+                     cfg_overrides: Optional[dict] = None,
+                     resources: Optional[dict] = None) -> List[dict]:
+    """Sharded parameter-server group (parallel/shardgroup.py): one
+    Deployment + Service + checkpoint PVC **per shard**, each pod running
+    the same env-driven shard child the local :class:`ShardGroup`
+    controller spawns.  k8s-native failover: the Deployment controller IS
+    the restart supervisor -- a killed shard pod comes back behind its
+    stable Service name, restores from the durable checkpoint on its PVC
+    (model + clock + dedup window, so replayed pushes are exactly-once),
+    and rejoins the group at the same map entry.  The shard map is static
+    by construction (Service DNS + pinned port), rendered into every
+    pod's ``ASYNC_SHARD_MAP``; workers/replicas still discover it at
+    HELLO against shard 0 (the primary -- wave gate, worker supervision,
+    eval plane), so client manifests only need the ONE address k8s
+    already guarantees.  Per-shard scrape: every pod carries the
+    prometheus.io annotations plus a ``shard`` label, and the child
+    starts its /metrics endpoint with a ``shard=<i>`` exposition label --
+    per-shard series never collapse in the aggregator."""
+    import dataclasses
+    import json as _json
+
+    from asyncframework_tpu.parallel.shardgroup import shard_ranges
+    from asyncframework_tpu.solvers import SolverConfig
+
+    if shards < 2:
+        raise ValueError("a PS shard group needs shards >= 2 "
+                         "(1 is the classic single PS)")
+    if d < shards:
+        raise ValueError(f"d={d} cannot range-partition over "
+                         f"{shards} shards")
+    cfg = dataclasses.asdict(SolverConfig(num_workers=workers))
+    cfg.update(cfg_overrides or {})
+    ranges = shard_ranges(d, shards)
+    smap = [[f"async-ps-shard-{i}", PS_SHARD_PORT, lo, hi]
+            for i, (lo, hi) in enumerate(ranges)]
+    objs: List[dict] = []
+    for i, (lo, hi) in enumerate(ranges):
+        name = f"async-ps-shard-{i}"
+        env = [
+            {"name": "ASYNC_SHARD_INDEX", "value": str(i)},
+            {"name": "ASYNC_SHARD_COUNT", "value": str(shards)},
+            {"name": "ASYNC_SHARD_D", "value": str(d)},
+            {"name": "ASYNC_SHARD_N", "value": str(n)},
+            {"name": "ASYNC_SHARD_ALGO", "value": "asgd"},
+            {"name": "ASYNC_SHARD_BIND_PORT", "value": str(PS_SHARD_PORT)},
+            {"name": "ASYNC_SHARD_CFG", "value": _json.dumps(cfg)},
+            {"name": "ASYNC_SHARD_CKPT",
+             "value": f"/ckpt/ps_shard{i}.npz"},
+            {"name": "ASYNC_SHARD_MAP", "value": _json.dumps(smap)},
+            {"name": "ASYNC_SHARD_ELASTIC",
+             "value": "1" if i == 0 else "0"},
+        ]
+        container = _container(
+            f"ps-shard-{i}", image,
+            ["python", "-m", "asyncframework_tpu.parallel.shardgroup"],
+            ports=[PS_SHARD_PORT], resources=resources,
+            volume_mounts=[{"name": "ckpt", "mountPath": "/ckpt"}],
+        )
+        container["env"] = env + container.get("env", [])
+        meta = _pod_meta(name)
+        meta["labels"]["shard"] = str(i)
+        objs.append({
+            "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": _meta(f"{name}-ckpt", "ps-shard", namespace),
+            "spec": {"accessModes": ["ReadWriteOnce"],
+                     "resources": {"requests": {"storage": "1Gi"}}},
+        })
+        objs.append({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": _meta(name, "ps-shard", namespace),
+            "spec": {
+                # exactly one pod per shard: the range's durable state
+                # lives in its checkpoint, and two writers of one range
+                # would fork the model
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": meta,
+                    "spec": {
+                        "containers": [container],
+                        "volumes": [{
+                            "name": "ckpt",
+                            "persistentVolumeClaim":
+                                {"claimName": f"{name}-ckpt"},
+                        }],
+                    },
+                },
+            },
+        })
+        objs.append({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": _meta(name, "ps-shard", namespace),
+            "spec": {"selector": {"app": name},
+                     "ports": [{"name": "ps", "port": PS_SHARD_PORT,
+                                "targetPort": PS_SHARD_PORT}]},
+        })
+    return objs
+
+
 def render_app_job(name: str, argv: List[str], num_processes: int,
                    namespace: str = "default", image: str = DEFAULT_IMAGE,
                    supervise: bool = True,
@@ -341,7 +446,9 @@ def render_cluster(workers: int, namespace: str = "default",
                    image: str = DEFAULT_IMAGE, ha_replicas: int = 1,
                    cores: int = 1, topic_server: bool = False,
                    serving: int = 0,
-                   serving_ps: Optional[str] = None) -> Dict[str, str]:
+                   serving_ps: Optional[str] = None,
+                   ps_shards: int = 0, ps_d: int = 0, ps_n: int = 0,
+                   ps_workers: int = 8) -> Dict[str, str]:
     """The whole standalone topology as {filename: yaml} -- apply with
     ``kubectl apply -f <dir>``."""
     out = {
@@ -360,6 +467,11 @@ def render_cluster(workers: int, namespace: str = "default",
         out["serving.yaml"] = to_yaml(render_serving(
             serving, serving_ps or f"async-master:{RPC_PORT}",
             namespace, image,
+        ))
+    if ps_shards > 0:
+        out["ps-shards.yaml"] = to_yaml(render_ps_shards(
+            ps_shards, ps_d, ps_n, workers=ps_workers,
+            namespace=namespace, image=image,
         ))
     return out
 
@@ -394,6 +506,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "frontend + this many predict replica pods)")
     r.add_argument("--serving-ps", default=None, metavar="HOST:PORT",
                    help="PS address the serving replicas SUBSCRIBE to")
+    r.add_argument("--ps-shards", type=int, default=0, metavar="N",
+                   help="also render an N-shard parameter-server group "
+                        "(per-shard pod + Service + checkpoint PVC; "
+                        "workers HELLO async-ps-shard-0)")
+    r.add_argument("--ps-d", type=int, default=0,
+                   help="model width the shard group range-partitions")
+    r.add_argument("--ps-n", type=int, default=0,
+                   help="dataset rows the shard group's run covers")
+    r.add_argument("--ps-workers", type=int, default=8,
+                   help="logical workers the shard group's primary gates")
     a = sub.add_parser("app", help="render one application Job")
     a.add_argument("--out", required=True)
     a.add_argument("--name", required=True)
@@ -410,6 +532,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             ha_replicas=args.ha, cores=args.cores,
             topic_server=args.topic_server,
             serving=args.serving, serving_ps=args.serving_ps,
+            ps_shards=args.ps_shards, ps_d=args.ps_d, ps_n=args.ps_n,
+            ps_workers=args.ps_workers,
         )
     else:
         files = {f"app-{args.name}.yaml": to_yaml(render_app_job(

@@ -99,11 +99,23 @@ def _telemetry_sections() -> Dict[str, object]:
         # fleet-wide while training runs fine
         health = {"state": "error", "rules": {},
                   "error": f"{type(e).__name__}: {e}"}
-    return {
+    out = {
         "convergence": {**conv.summary(), "curves": conv.curves()},
         "health": health,
         "timeseries": timeseries.store().summary(),
     }
+    try:
+        from asyncframework_tpu.parallel import shardgroup
+
+        group = shardgroup.active_group()
+        if group is not None:
+            # per-shard section (parallel/shardgroup.py): the process
+            # hosting the shard-group controller shows its map + member
+            # liveness on every dashboard page
+            out["shards"] = group.status_section()
+    except Exception:  # noqa: BLE001 - a half-torn-down group must not
+        pass           # 500 every dashboard page
+    return out
 
 
 def process_status(role: str = "process") -> Dict[str, object]:

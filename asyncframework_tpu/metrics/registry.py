@@ -124,6 +124,13 @@ _register(CounterFamily(
         "refresh shapes (serving/metrics.py).",
 ))
 _register(CounterFamily(
+    "shardgroup", "asyncframework_tpu.parallel.shardgroup",
+    "shard_totals", "reset_shard_totals",
+    doc="Sharded PS group: shard deaths/restarts, finish broadcasts, "
+        "assembled pulls/pushes, abandoned fan-out rounds "
+        "(parallel/shardgroup.py).",
+))
+_register(CounterFamily(
     "convergence", "asyncframework_tpu.metrics.timeseries",
     "convergence_totals", "reset_convergence",
     baseline=False,

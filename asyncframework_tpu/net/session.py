@@ -22,6 +22,18 @@ LRU) because sessions come and go with worker churn; a legitimate retry
 arrives within one retry-policy deadline, not hours later.  Unstamped
 requests pass straight through -- old clients keep working, they just
 keep the old at-least-once semantics.
+
+Sharded-PS contract (``parallel/shardgroup.py``): sessions are strictly
+**per shard**.  Each of a ``ShardedPSClient``'s sub-clients mints its own
+:class:`ClientSession`, each shard keeps its own :class:`DedupWindow`,
+and each window rides its shard's durable checkpoint
+(``state()``/``load_state()``, captured under the model lock) -- so when
+a fan-out round is abandoned mid-flight and replayed, every shard judges
+its OWN ``(sid, seq)`` history independently: the sub-pushes that landed
+before the fault are re-answered from cache (on a restarted shard, from
+the RESTORED window), the ones that never arrived apply fresh.  Nothing
+in this module is shard-aware; the guarantee composes because the stamps
+never cross shard boundaries.
 """
 
 from __future__ import annotations

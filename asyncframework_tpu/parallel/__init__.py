@@ -13,3 +13,11 @@ from asyncframework_tpu.parallel.supervisor import (  # noqa: F401
     ElasticSupervisor,
     recovery_totals,
 )
+from asyncframework_tpu.parallel.shardgroup import (  # noqa: F401
+    ShardGroup,
+    ShardMap,
+    ShardedPSClient,
+    ShardedSubscriber,
+    shard_ranges,
+    shard_totals,
+)

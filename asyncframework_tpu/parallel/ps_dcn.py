@@ -342,7 +342,7 @@ class ParameterServer:
                  port: int = 0, algo: str = "asgd",
                  checkpoint_path: Optional[str] = None,
                  supervisor: Optional[ElasticSupervisor] = None,
-                 bus=None):
+                 bus=None, shard_map=None, shard_index: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -353,6 +353,16 @@ class ParameterServer:
         self.cfg = cfg
         self.d, self.n = d, n
         self.algo = algo
+        # sharded PS group (parallel/shardgroup.py): when this server is one
+        # range of a shard group, ``shard_map`` is the group's wire map
+        # (per-shard [host, port, lo, hi]) and ``shard_index`` names this
+        # server's range.  The map is what HELLO's WELCOME reply hands
+        # workers so they resolve the group with no side channel; it may
+        # also be installed after construction (SETMAP, or attribute
+        # assignment before start).  None/0 = the classic single PS --
+        # WELCOME omits the key and the wire stays byte-identical.
+        self.shard_map = [list(e) for e in shard_map] if shard_map else None
+        self.shard_index = int(shard_index)
         self.checkpoint_path = checkpoint_path
         self.resumed_from_k: Optional[int] = None
         self.device = device if device is not None else jax.devices()[0]
@@ -874,8 +884,43 @@ class ParameterServer:
                             pid=header.get("pid"),
                             host=header.get("host"),
                         )
-                    _send_msg(conn, {"op": "WELCOME",
-                                     "elastic": self.supervisor is not None})
+                    welcome = {"op": "WELCOME",
+                               "elastic": self.supervisor is not None}
+                    if self.shard_map:
+                        # the shard-map handshake: workers/replicas resolve
+                        # the group here and fan every PULL/PUSH out per
+                        # range (shardgroup.ShardedPSClient).  Key absent
+                        # on an unsharded PS -- byte-identical legacy wire.
+                        welcome["shards"] = self.shard_map
+                    _send_msg(conn, welcome)
+                elif op == "SHARDMAP":
+                    # shard-map query (group members, liveness probes,
+                    # serving replicas): the classic single PS answers an
+                    # empty list -- "no group here"
+                    _send_msg(conn, {"op": "SHARDMAP",
+                                     "shards": self.shard_map or []})
+                elif op == "SETMAP":
+                    # group controller installing the assembled map on a
+                    # freshly-spawned shard child (it cannot know its
+                    # peers' ephemeral ports before they announce)
+                    wire = header.get("shards") or None
+                    self.shard_map = ([list(e) for e in wire]
+                                      if wire else None)
+                    if "index" in header:
+                        self.shard_index = int(header["index"])
+                    _send_msg(conn, {"op": "ACK"})
+                elif op == "FINISH":
+                    # group-wide DONE broadcast: a secondary shard serves
+                    # its range with an unbounded iteration budget and
+                    # learns run completion only from the primary's DONE,
+                    # fanned out here (worker BYE and the group controller
+                    # both send it; idempotent by construction)
+                    self._done.set()
+                    if self.supervisor is not None:
+                        self.supervisor.freeze()
+                    with self._wave_cv:
+                        self._wave_cv.notify_all()
+                    _send_msg(conn, {"op": "ACK"})
                 elif op == "SNAPSHOTS":
                     # only meaningful once the run is done; the stack is
                     # consistent either way (lock-copied)
@@ -2417,10 +2462,12 @@ def run_worker_process(
                      else steps.make_trajectory_loss_eval(
                          getattr(cfg, "loss", "least_squares")))
 
-    def conv_sample(shard, w_dev, ts: int, g_host: np.ndarray) -> None:
+    def conv_sample(shard, w_dev, ts, g_host: np.ndarray) -> None:
         """One convergence sample: shard mean loss at the pulled model +
         gradient norm, buffered for the PUSH piggyback.  Telemetry must
-        never break the update loop."""
+        never break the update loop.  Against a sharded PS group ``ts``
+        is the version VECTOR -- the sample is stamped with the primary's
+        component (its clock drives the convergence curves)."""
         try:
             if sparse:
                 sums = conv_eval(shard.cols, shard.vals, shard.y,
@@ -2429,9 +2476,33 @@ def run_worker_process(
                 sums = conv_eval(shard.X, shard.y, w_dev[None, :])
             loss = (float(np.asarray(sums)[0])
                     / max(1, int(shard.y.shape[0])))
-            cv_buf.add(ts, loss, float(np.linalg.norm(g_host)))
+            ver = int(ts[0]) if isinstance(ts, (tuple, list)) else int(ts)
+            cv_buf.add(ver, loss, float(np.linalg.norm(g_host)))
         except Exception:  # noqa: BLE001
             pass
+
+    # sharded PS group (parallel/shardgroup.py): resolved from the HELLO
+    # WELCOME below.  None = the classic single PS -- every client below
+    # is a stock PSClient and the wire is byte-identical.
+    smap = None
+
+    def make_client(recorder=None, pl_stats=None, cv_buf=None):
+        """One PS-facing client: a ShardedPSClient fan-out facade when
+        the HELLO resolved a shard map, the classic PSClient otherwise.
+        Same surface either way -- the loops below cannot tell."""
+        if smap is not None:
+            from asyncframework_tpu.parallel.shardgroup import (
+                ShardedPSClient,
+            )
+
+            return ShardedPSClient(
+                smap, proc=proc_token, recorder=recorder,
+                pull_mode=getattr(cfg, "pull_mode", None),
+                pl_stats=pl_stats, cv_buf=cv_buf,
+            )
+        return PSClient(host, port, proc=proc_token, recorder=recorder,
+                        pull_mode=getattr(cfg, "pull_mode", None),
+                        pl_stats=pl_stats, cv_buf=cv_buf)
 
     # elastic adoption bookkeeping: which wids this process serves (own +
     # adopted), and every loop thread ever started (joined at the end)
@@ -2520,11 +2591,7 @@ def run_worker_process(
             while not stop.is_set() and time.monotonic() < deadline:
                 try:
                     if cl is None:
-                        cl = PSClient(host, port, proc=proc_token,
-                                      recorder=recorder,
-                                      pull_mode=getattr(cfg, "pull_mode",
-                                                        None),
-                                      cv_buf=cv_buf)
+                        cl = make_client(recorder=recorder, cv_buf=cv_buf)
                     # per-update sampling decision: a traced update's RPCs
                     # carry the trace context on the wire and its lifecycle
                     # spans (pull.rtt/compute/push.wait/push.rtt) land in
@@ -2681,16 +2748,10 @@ def run_worker_process(
         try:
             while not stop.is_set() and time.monotonic() < deadline:
                 try:
-                    pull_cl = PSClient(host, port, proc=proc_token,
-                                       recorder=recorder,
-                                       pull_mode=getattr(cfg, "pull_mode",
-                                                         None))
-                    push_cl = PSClient(host, port, proc=proc_token,
-                                       recorder=recorder,
-                                       pull_mode=getattr(cfg, "pull_mode",
-                                                         None),
-                                       pl_stats=pl_stats,
-                                       cv_buf=cv_buf)
+                    pull_cl = make_client(recorder=recorder)
+                    push_cl = make_client(recorder=recorder,
+                                          pl_stats=pl_stats,
+                                          cv_buf=cv_buf)
                     break
                 except (ConnectionError, OSError):
                     time.sleep(0.2)  # PS mid-restart: pace and re-dial
@@ -2803,13 +2864,47 @@ def run_worker_process(
     # introduce this process to the PS before serving: the supervisor
     # learns the proc token, wids, and pid (local-exit detection); a
     # rejoining process's HELLO is also what deposes its surrogate.  A
-    # fixed-membership PS just says WELCOME.
-    try:
-        hello_cl = PSClient(host, port, proc=proc_token)
-        hello_cl.hello(proc_token, wids, pid=os.getpid())
-        hello_cl.bye()
-    except (ConnectionError, OSError):
-        pass  # PS mid-restart: the loops' retry path will find it
+    # fixed-membership PS just says WELCOME.  The WELCOME reply is also
+    # the SHARD-MAP handshake (parallel/shardgroup.py): against a sharded
+    # PS group it carries the per-shard [host, port, lo, hi] map and every
+    # loop below runs a ShardedPSClient instead -- so HELLO is retried
+    # for the WHOLE worker deadline, never skipped: without the WELCOME
+    # this process cannot know whether the PS is a shard group, and
+    # serving a sharded group as if it were one PS would pull a single
+    # range as the whole model (a width mismatch the loops' transport
+    # except clauses cannot absorb).  A PS dark past the deadline aborts
+    # the process cleanly instead.
+    hello_deadline = time.monotonic() + deadline_s
+    hello_ok = False
+    while True:
+        try:
+            hello_cl = PSClient(host, port, proc=proc_token)
+            welcome = hello_cl.hello(proc_token, wids, pid=os.getpid())
+            hello_cl.bye()
+            wire_map = welcome.get("shards") or []
+            if len(wire_map) > 1:
+                from asyncframework_tpu.parallel.shardgroup import ShardMap
+
+                if algo != "asgd":
+                    raise ValueError(
+                        "sharded PS groups serve algo='asgd' only"
+                    )
+                smap = ShardMap.from_wire(wire_map)
+            hello_ok = True
+            break
+        except (ConnectionError, OSError):
+            if time.monotonic() >= hello_deadline:
+                break
+            # gentle pacing: each PSClient ctor already spent a full retry
+            # budget (backoff + breaker); hammering here only keeps the
+            # shared breaker's open-window fresh and starves the half-open
+            # probe that would notice the PS came up
+            time.sleep(0.5)
+    if not hello_ok:
+        # the PS never answered within the worker budget: there is no
+        # safe topology to assume, so give up loudly with empty counts
+        # (the launcher's summary shows zero contributed gradients)
+        return dict(counts)
 
     for w in wids:
         spawn(w)
@@ -2825,8 +2920,9 @@ def run_worker_process(
         # this process's shards, push one summed loss vector.  Only shards
         # this process still SERVES count -- an adopted shard whose owner
         # rejoined (RELEASED) is evaluated by its real owner, and summing
-        # it here too would double-count its loss.
-        cl = PSClient(host, port)
+        # it here too would double-count its loss.  Against a shard group
+        # the client assembles the full-width snapshot stack per range.
+        cl = make_client()
         try:
             times, W = cl.snapshots()
             with group_lock:

@@ -151,14 +151,31 @@ class ModelReplica(FramedServer):
                     self._refresh_lock.release()
 
     # -------------------------------------------------------------- refresh
-    def _ensure_client(self) -> PSClient:
+    def _ensure_client(self):
         if self._client is None:
-            # delta mode unconditionally: the refresh loop is exactly the
+            # shard-map resolution first (one SHARDMAP round trip): a
+            # sharded PS group answers its per-range map and the replica
+            # subscribes every range (shardgroup.ShardedSubscriber --
+            # partial refresh + per-range freshness); the classic single
+            # PS answers empty and gets the stock client.  Delta mode
+            # unconditionally either way: the refresh loop is exactly the
             # workload NM/XDELTA negotiation exists for (the CRC fallback
-            # keeps it degrade-to-full, never wrong)
-            self._client = PSClient(self.ps_host, self.ps_port,
-                                    pull_mode="delta")
+            # keeps it degrade-to-full, never wrong).
+            from asyncframework_tpu.parallel import shardgroup as _sg
+
+            smap = _sg.fetch_shard_map(self.ps_host, self.ps_port)
+            if smap is not None:
+                self._client = _sg.ShardedSubscriber(smap)
+            else:
+                self._client = PSClient(self.ps_host, self.ps_port,
+                                        pull_mode="delta")
         return self._client
+
+    def _sharded(self):
+        """The ShardedSubscriber when this replica reads a shard group,
+        else None (duck-typing on the one surface that differs)."""
+        cl = self._client
+        return cl if hasattr(cl, "stale_ranges") else None
 
     def refresh_once(self) -> bool:
         """One SUBSCRIBE round trip; True iff a (possibly unchanged) model
@@ -193,9 +210,15 @@ class ModelReplica(FramedServer):
             smetrics.bump("refresh_fallbacks",
                           cl.delta_fallbacks - fb_before)
         prev = self._served
-        if prev is not None and prev.ts == ts:
+        if (prev is not None and prev.ts == ts
+                and not getattr(cl, "changed_since_last", False)):
             # unchanged version (NM fast path): reuse the device buffer,
-            # refresh only the freshness bookkeeping
+            # refresh only the freshness bookkeeping.  Against a shard
+            # group ts is a SUM of per-shard versions, and a shard
+            # restart rolls its clock back -- sum collisions happen, so
+            # the subscriber's vector-compare flag gates the reuse (a
+            # stock PSClient has no flag: its ts is a single monotone
+            # clock and equality IS identity)
             w_dev = prev.w_dev
         else:
             if self.device is None:
@@ -266,6 +289,13 @@ class ModelReplica(FramedServer):
             return True
         if self.max_stale_ms <= 0:
             return True
+        sub = self._sharded()
+        if sub is not None:
+            # per-range gate: a partially-dark group keeps publishing
+            # (live ranges refresh), so health must price the STALEST
+            # range, not the last assembled publish
+            age = sub.oldest_ok_age_ms()
+            return age is not None and age <= self.max_stale_ms
         last_ok = self._last_ok_mono
         return (last_ok is not None
                 and (time.monotonic() - last_ok) * 1e3 <= self.max_stale_ms)
@@ -286,6 +316,13 @@ class ModelReplica(FramedServer):
         if cl is not None:
             out["refresh_wenc"] = dict(cl.pull_wenc)
             out["refresh_fallbacks"] = cl.delta_fallbacks
+        sub = self._sharded()
+        if sub is not None:
+            # UNHEALTHY-per-range surface: which ranges are fresh, which
+            # are dark, and how stale the stalest is
+            out["ranges"] = sub.range_status()
+            if self.max_stale_ms > 0:
+                out["stale_ranges"] = sub.stale_ranges(self.max_stale_ms)
         if served is not None:
             out.update(ts=served.ts, clock=served.clock, k=served.k,
                        **self._lag(served))
@@ -309,6 +346,11 @@ class ModelReplica(FramedServer):
             with self._stats_lock:
                 self.predict_unhealthy += 1
             lag = self._lag(served) if served is not None else {}
+            sub = self._sharded()
+            if sub is not None and self.max_stale_ms > 0:
+                # name the dark ranges: the caller learns WHICH slice of
+                # the model went stale, not just that something did
+                lag["stale_ranges"] = sub.stale_ranges(self.max_stale_ms)
             _send_msg(conn, {"op": "UNHEALTHY", "rid": self.rid, **lag})
             return
         n = int(header.get("n", 0))

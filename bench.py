@@ -597,6 +597,73 @@ def run_dcn_child() -> None:
             out[name][f"pipeline_speedup_{mode}"] = (
                 round(on / off, 3) if off and on else None
             )
+    # sharded-PS arm (parallel/shardgroup.py): 1 vs 3 REAL shard child
+    # processes serving the dense config, full and delta wire modes.  The
+    # 1-shard control crosses the same process boundary (a managed child,
+    # classic single-PS wire), so the A-B isolates the range-partition
+    # fan-out cost/win rather than loopback-vs-process noise.
+    # BENCH_DCN_SHARDS=0 drops the arm.
+    if os.environ.get("BENCH_DCN_SHARDS", "1") != "0":
+        from asyncframework_tpu.parallel.shardgroup import ShardGroup
+
+        c = DCN_CONFIGS["dense"]
+        ds = ShardedDataset.generate_on_device(
+            c["n"], c["d"], c["nw"], devices=devices, seed=7, noise=0.01,
+        )
+        out["shards"] = {}
+        for shard_count in (1, 3):
+            for mode in ("full", "delta"):
+                label = f"s{shard_count}_{mode}"
+                conf = AsyncConf()
+                conf.set("async.pull.mode", mode)
+                conf.set("async.pipeline.depth", 0)
+                set_global_conf(conf)
+                reset_net_totals()
+                cfg = SolverConfig(
+                    num_workers=c["nw"], num_iterations=c["iters"],
+                    gamma=c["gamma"], taw=2**31 - 1,
+                    batch_rate=c["batch_rate"], bucket_ratio=0.5,
+                    printer_freq=100, coeff=0.0, seed=42,
+                    calibration_iters=20, run_timeout_s=120.0,
+                    pull_mode=mode,
+                )
+                group = ShardGroup(
+                    cfg, c["d"], c["n"], shard_count,
+                    conf_overlays=conf.to_dict(),
+                ).start()
+                try:
+                    primary_port = group.port_of(0)
+                    shards = {w: ds.shard(w) for w in range(c["nw"])}
+                    t0 = time.monotonic()
+                    counts = ps_dcn.run_worker_process(
+                        "127.0.0.1", primary_port, list(range(c["nw"])),
+                        shards, cfg, c["d"], c["n"], deadline_s=120.0,
+                    )
+                    elapsed = time.monotonic() - t0
+                    group.finish()
+                    result = group.result_of(0, timeout_s=30.0) or {}
+                finally:
+                    group.stop()
+                bt = frame.bytes_totals()
+                accepted = int(result.get("accepted", 0))
+                out["shards"][label] = {
+                    "ok": bool(result.get("done")),
+                    "shards": shard_count,
+                    "accepted": accepted,
+                    "gradients": int(sum(counts.values())),
+                    "updates_per_sec": round(accepted / elapsed, 1)
+                    if elapsed > 0 and accepted else None,
+                    "wire_bytes_per_update": round(
+                        bt.get("sent", 0) / max(accepted, 1)
+                    ),
+                    "restarts": group.restarts_of(0),
+                }
+        for mode in ("full", "delta"):
+            one = out["shards"][f"s1_{mode}"]["updates_per_sec"]
+            three = out["shards"][f"s3_{mode}"]["updates_per_sec"]
+            out["shards"][f"shard_speedup_{mode}"] = (
+                round(three / one, 3) if one and three else None
+            )
     emit({"dcn": out})
 
 
@@ -606,7 +673,7 @@ def collect_dcn_block(env: dict) -> dict:
     try:
         res = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--dcn"],
-            capture_output=True, text=True, timeout=420, env=env,
+            capture_output=True, text=True, timeout=600, env=env,
         )
     except subprocess.TimeoutExpired:
         return {"error": "dcn bench timed out"}
@@ -1232,6 +1299,25 @@ def run_parent() -> None:
         # DCN data-plane bench (CPU loopback, device-independent): wire
         # bytes per update and pull/push payload shapes per pull mode
         payload["dcn"] = collect_dcn_block(env)
+        if (os.environ.get("BENCH_FALLBACK", "1") != "0"
+                and os.environ.get("BENCH_DCN_SHARDS", "1") != "0"
+                and "shards" not in payload["dcn"]):
+            # dead-arm keep-list discipline (PR 6): the sharded-PS arm is
+            # part of the trajectory of record and must never go dark --
+            # if the full dcn pass wedged or errored before reaching it,
+            # retry JUST that arm (pipelined arms dropped) and graft the
+            # result in, labeled
+            env2 = dict(env)
+            env2["BENCH_DCN_PIPELINE"] = "0"
+            retry = collect_dcn_block(env2)
+            if "shards" in retry:
+                if not isinstance(payload["dcn"], dict) \
+                        or "error" in payload["dcn"]:
+                    payload["dcn"] = {"error": payload["dcn"].get("error")
+                                      if isinstance(payload["dcn"], dict)
+                                      else str(payload["dcn"])}
+                payload["dcn"]["shards"] = retry["shards"]
+                payload["dcn"]["shards_note"] = "recovered by retry pass"
     if os.environ.get("BENCH_SERVE", "1") != "0":
         # serving-tier bench (CPU loopback): QPS vs freshness lag per
         # replica count with training concurrently running, including the

@@ -93,11 +93,14 @@ def run_seed(seed: int, args) -> dict:
     # part of the chaos surface now that a read path exists
     # telemetry-plane chaos rides every seed too: /metrics + /api/status
     # availability/validity under the fault schedule (tests/test_telemetry)
+    # shard-group chaos rides every seed: kill -9 one PS shard of 3 mid-run
+    # (real OS processes), recovery from the durable checkpoint, exactly-
+    # once across the restart (tests/test_shardgroup.py, seeded kill timing)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
-        "tests/test_telemetry.py",
-        "-q", "-m", f"({marker}) or serve or telemetry",
+        "tests/test_telemetry.py", "tests/test_shardgroup.py",
+        "-q", "-m", f"({marker}) or serve or telemetry or shard",
         "-p", "no:cacheprovider",
     ]
     if args.soak:

@@ -1,0 +1,723 @@
+"""Sharded parameter server with elastic shard failover (ISSUE 8).
+
+The correctness spine:
+
+- ``shards=1`` IS the classic single PS: the conf knob being set must be
+  byte-identical on the wire (per-op frame-byte totals) and
+  step-identical (accepted/dropped/staleness/clock) to the knob being
+  absent, under a fixed seed;
+- the staleness contract is a per-shard VECTOR: every pull returns one
+  clock per shard, every sub-push is priced against its own shard's
+  clock, and a shard whose clock runs ahead (direct out-of-band pushes)
+  prices staleness higher than its peers -- independently;
+- the serving tier degrades per range: a dark range keeps its last
+  validated slice (partial refresh), freshness prices the STALEST range,
+  and UNHEALTHY names the stale ranges instead of serving a torn model;
+- the acceptance run (`shard` marker, rides every bin/chaos_sweep.py
+  seed): an ASGD run over a 3-shard group of REAL OS processes survives
+  SIGKILL of one shard mid-run -- the controller's supervisor detects the
+  death (pid probe / port silence), relaunches the shard on its pinned
+  port from its durable checkpoint (model + clock + dedup window), the
+  wire-window machinery replays in-flight pushes onto the recovered
+  shard exactly-once, and the run completes with full coverage.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu import conf as conf_mod
+from asyncframework_tpu.conf import AsyncConf, global_conf, set_global_conf
+from asyncframework_tpu.data.sharded import ShardedDataset
+from asyncframework_tpu.net import frame, reset_net_totals
+from asyncframework_tpu.net.retry import reset_breakers
+from asyncframework_tpu.parallel import ps_dcn
+from asyncframework_tpu.parallel import shardgroup as sg
+from asyncframework_tpu.solvers import SolverConfig
+
+pytestmark = pytest.mark.shard
+
+CHILD = Path(__file__).parent / "ps_dcn_child.py"
+CHAOS_SEED = int(os.environ.get("ASYNC_CHAOS_SEED", "7"))
+
+
+def make_cfg(**kw):
+    defaults = dict(
+        num_workers=4, num_iterations=120, gamma=1.2, taw=2**31 - 1,
+        batch_rate=0.3, bucket_ratio=0.5, printer_freq=40, seed=42,
+        calibration_iters=10, run_timeout_s=120.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Wire-byte totals, shard totals, breakers, and the global conf are
+    process-global; runs must neither inherit nor leak them."""
+    reset_net_totals()
+    sg.reset_shard_totals()
+    reset_breakers()
+    set_global_conf(AsyncConf())
+    yield
+    reset_net_totals()
+    sg.reset_shard_totals()
+    reset_breakers()
+    set_global_conf(None)
+
+
+def run_group(devices, cfg, shards, n=1024, d=23, seed=7, conf=None,
+              checkpoint_dir=None):
+    """One in-process shard group + worker run; returns (ps_list, counts,
+    total) with every PS stopped."""
+    if conf is not None:
+        set_global_conf(conf)
+    ds = ShardedDataset.generate_on_device(
+        n, d, cfg.num_workers, devices=devices[:1], seed=seed, noise=0.01)
+    ps_list, smap = sg.launch_inprocess_group(
+        cfg, d, n, shards, device=devices[0],
+        checkpoint_dir=checkpoint_dir)
+    try:
+        shards_data = {w: ds.shard(w) for w in range(cfg.num_workers)}
+        counts = ps_dcn.run_worker_process(
+            "127.0.0.1", ps_list[0].port, list(range(cfg.num_workers)),
+            shards_data, cfg, d, n, eval_wid=0, deadline_s=120.0)
+        assert ps_list[0].wait_done(timeout_s=10.0)
+        total = ps_list[0].collect_eval(num_worker_procs=1, timeout_s=30.0)
+        return ps_list, smap, counts, total
+    finally:
+        for ps in ps_list:
+            ps.stop()
+
+
+# --------------------------------------------------- global_conf() footgun
+class TestGlobalConfInstall:
+    def test_lazily_created_conf_is_installed(self):
+        """`global_conf().set(...)` on a process that never called
+        set_global_conf must STICK: the lazily-created default is
+        installed, not discarded (the lost-write footgun)."""
+        set_global_conf(None)
+        global_conf().set("async.pull.mode", "delta")
+        assert global_conf().contains("async.pull.mode")
+        assert global_conf().get("async.pull.mode") == "delta"
+        # and it is the SAME instance on every later call
+        assert global_conf() is global_conf()
+
+    def test_explicit_install_still_wins(self):
+        set_global_conf(None)
+        _ = global_conf()  # lazily installed
+        mine = AsyncConf().set("async.pull.mode", "full")
+        set_global_conf(mine)
+        assert global_conf() is mine
+
+
+# ------------------------------------------------------- ranges + map units
+class TestShardRanges:
+    def test_cover_and_contiguous(self):
+        for d, s in [(24, 3), (23, 3), (7, 7), (100, 8), (5, 1)]:
+            ranges = sg.shard_ranges(d, s)
+            assert ranges[0][0] == 0 and ranges[-1][1] == d
+            for (a, b), (c, e) in zip(ranges, ranges[1:]):
+                assert b == c and b > a and e > c
+
+    def test_clamped_to_d(self):
+        assert len(sg.shard_ranges(3, 8)) == 3
+
+    def test_remainder_spread(self):
+        sizes = [hi - lo for lo, hi in sg.shard_ranges(23, 3)]
+        assert sizes == [8, 8, 7]
+
+
+class TestShardMap:
+    def test_wire_round_trip(self):
+        m = sg.ShardMap([("a", 1, 0, 8), ("b", 2, 8, 16)])
+        assert sg.ShardMap.from_wire(m.to_wire()).entries == m.entries
+        assert m.n_shards == 2 and m.d == 16
+        assert m.ranges() == [(0, 8), (8, 16)]
+
+    @pytest.mark.parametrize("entries", [
+        [],                                        # empty
+        [("a", 1, 0, 8), ("b", 2, 9, 16)],         # hole
+        [("a", 1, 0, 8), ("b", 2, 4, 16)],         # overlap
+        [("a", 1, 0, 8), ("b", 2, 8, 8)],          # empty range
+        [("a", 1, 1, 8)],                          # does not start at 0
+    ])
+    def test_invalid_maps_rejected(self, entries):
+        with pytest.raises(ValueError):
+            sg.ShardMap(entries)
+
+
+# --------------------------------------------------- shards=1 byte identity
+class TestShardsOneIsClassic:
+    def test_conf_set_matches_unset_byte_identical(self, devices8):
+        """`async.ps.shards=1` must leave the wire byte-identical and the
+        run step-identical to the knob being absent: one worker, full
+        pulls, calibration off -- the whole exchange is deterministic, so
+        per-op frame-byte totals must match EXACTLY."""
+        results = []
+        for shards_conf in (None, "1"):
+            conf = (AsyncConf().set("async.pull.mode", "full")
+                    .set("async.trace.sample", 0.0))
+            if shards_conf is not None:
+                conf.set("async.ps.shards", shards_conf)
+            set_global_conf(conf)
+            reset_net_totals()
+            cfg = make_cfg(num_workers=1, num_iterations=40,
+                           calibration_iters=10**9, bucket_ratio=0.0)
+            ds = ShardedDataset.generate_on_device(
+                512, 16, 1, devices=devices8[:1], seed=11, noise=0.01)
+            ps_list, smap = sg.launch_inprocess_group(
+                cfg, 16, 512, max(1, int(shards_conf or 1)),
+                device=devices8[0])
+            assert smap is None  # shards=1: no map, classic PS
+            ps = ps_list[0]
+            try:
+                counts = ps_dcn.run_worker_process(
+                    "127.0.0.1", ps.port, [0], {0: ds.shard(0)}, cfg,
+                    16, 512, deadline_s=120.0)
+                assert ps.wait_done(timeout_s=10.0)
+            finally:
+                ps.stop()
+            results.append({
+                "accepted": ps.accepted, "dropped": ps.dropped,
+                "max_staleness": ps.max_staleness, "clock": ps._clock,
+                "pull_replies": dict(ps.pull_replies),
+                "counts": dict(counts),
+                "bytes": frame.bytes_totals(),
+            })
+        unset, one = results
+        assert unset["accepted"] == one["accepted"] == 40
+        assert unset == one, (unset, one)
+
+    def test_welcome_carries_no_map_on_classic_ps(self, devices8):
+        cfg = make_cfg(num_workers=1, num_iterations=5, bucket_ratio=0.0)
+        ps = ps_dcn.ParameterServer(cfg, 8, 64, device=devices8[0],
+                                    port=0).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port)
+            welcome = cl.hello("t-proc", [0], pid=os.getpid())
+            assert "shards" not in welcome
+            assert sg.fetch_shard_map("127.0.0.1", ps.port) is None
+            cl.bye()
+        finally:
+            ps.stop()
+
+
+# ------------------------------------------------ sharded run + vector clock
+class TestShardedRun:
+    def test_three_shard_run_converges_full_coverage(self, devices8):
+        cfg = make_cfg()
+        ps_list, smap, counts, total = run_group(devices8, cfg, 3)
+        primary = ps_list[0]
+        assert primary.accepted == cfg.num_iterations
+        # full coverage: every logical worker contributed to the primary
+        assert set(primary.accepted_by_wid) == set(range(cfg.num_workers))
+        # every secondary applied at least the primary's accepted count
+        # (they also take the tail pushes the primary drops post-done)
+        for ps in ps_list[1:]:
+            assert ps.accepted >= primary.accepted
+        assert sum(counts.values()) >= cfg.num_iterations
+        traj = total / 1024
+        assert traj[-1] < traj[0] * 0.1, traj
+        totals = sg.shard_totals()
+        assert totals["sharded_pulls"] > 0
+        assert totals["sharded_pushes"] >= cfg.num_iterations
+
+    def test_pull_returns_version_vector(self, devices8):
+        """The facade's pull ts is a per-shard clock TUPLE; pushes carry
+        it back and the assembled model is the concatenation of the
+        per-range slices at those versions."""
+        cfg = make_cfg(num_workers=1, num_iterations=50, bucket_ratio=0.0,
+                       calibration_iters=10**9)
+        n, d = 256, 23
+        ps_list, smap = sg.launch_inprocess_group(cfg, d, n, 3,
+                                                  device=devices8[0])
+        try:
+            cl = sg.ShardedPSClient(smap)
+            got = cl.pull(0)
+            assert got is not None
+            ts, w, _ms, _cal = got
+            assert isinstance(ts, tuple) and len(ts) == 3
+            assert w.shape == (d,)
+            # direct per-shard pulls agree with the assembled slices
+            for i, (h, p, lo, hi) in enumerate(smap.entries):
+                direct = ps_dcn.PSClient(h, p)
+                got_i = direct.pull(0)
+                assert got_i is not None
+                ts_i, w_i, _m, _c = got_i
+                assert ts_i == ts[i]
+                np.testing.assert_array_equal(w_i, w[lo:hi])
+                direct.bye()
+            g = np.random.default_rng(0).normal(size=d).astype(np.float32)
+            accepted, done = cl.push(0, ts, g)
+            assert accepted and not done
+            cl.bye()
+        finally:
+            for ps in ps_list:
+                ps.stop()
+
+    def test_per_shard_staleness_is_independent(self, devices8):
+        """Drive ONE shard's clock ahead with direct out-of-band pushes:
+        a facade push stamped with the (now stale) vector must price the
+        staleness per shard -- the driven shard records a HIGHER
+        staleness than its peers, and the existing staleness metrics
+        (max_staleness) surface it per shard."""
+        cfg = make_cfg(num_workers=2, num_iterations=10**6,
+                       bucket_ratio=0.0, calibration_iters=10**9)
+        n, d = 256, 24
+        ps_list, smap = sg.launch_inprocess_group(cfg, d, n, 3,
+                                                  device=devices8[0])
+        try:
+            cl = sg.ShardedPSClient(smap)
+            got = cl.pull(0)
+            ts, w, _ms, _cal = got
+            # out-of-band: advance shard 1's clock by 5 direct pushes
+            h1, p1, lo1, hi1 = smap.entries[1]
+            direct = ps_dcn.PSClient(h1, p1)
+            for _ in range(5):
+                dts, dw, _m, _c = direct.pull(1)
+                direct.push(1, dts, np.ones(hi1 - lo1, np.float32))
+            direct.bye()
+            # the next facade pull sees the skewed vector
+            ts2 = cl.pull(0)[0]
+            assert ts2[1] >= ts[1] + 5
+            assert ts2[0] <= ts2[1] - 5 + 1
+            # a push stamped with the OLD vector: shard 1 prices the 5
+            # out-of-band merges as staleness; shard 0/2 price ~0
+            cl.push(0, ts, np.ones(d, np.float32))
+            assert ps_list[1].max_staleness >= 5
+            assert ps_list[0].max_staleness <= 2
+            assert ps_list[2].max_staleness <= 2
+            cl.bye()
+        finally:
+            for ps in ps_list:
+                ps.stop()
+
+
+# --------------------------------------------------------- serving per range
+class TestShardedSubscriber:
+    def _group(self, devices8, **cfg_kw):
+        cfg = make_cfg(num_workers=1, num_iterations=10**6,
+                       bucket_ratio=0.0, calibration_iters=10**9, **cfg_kw)
+        n, d = 256, 24
+        ps_list, smap = sg.launch_inprocess_group(cfg, d, n, 3,
+                                                  device=devices8[0])
+        return ps_list, smap, n, d
+
+    def test_assembled_subscribe_matches_direct_pull(self, devices8):
+        ps_list, smap, n, d = self._group(devices8)
+        try:
+            sub = sg.ShardedSubscriber(smap)
+            ts, w, clock, k, age, done = sub.subscribe()
+            assert w.shape == (d,) and not done
+            direct = sg.ShardedPSClient(smap)
+            got = direct.pull(0)
+            np.testing.assert_array_equal(got[1], w)
+            assert ts == sum(got[0])
+            direct.bye()
+            assert sub.stale_ranges(10_000.0) == []
+            assert sub.oldest_ok_age_ms() is not None
+            status = sub.range_status()
+            assert [s["shard"] for s in status] == [0, 1, 2]
+            assert [(s["lo"], s["hi"]) for s in status] == smap.ranges()
+            sub.bye()
+        finally:
+            for ps in ps_list:
+                ps.stop()
+
+    def test_dark_range_partial_refresh_prices_stalest(self, devices8):
+        """Kill one shard: the subscriber keeps serving the assembled
+        model from the live ranges + the dead range's last validated
+        slice, with age pricing the DARK range -- and stale_ranges names
+        it (the UNHEALTHY-per-range answer)."""
+        ps_list, smap, n, d = self._group(devices8)
+        try:
+            sub = sg.ShardedSubscriber(smap)
+            ts0, w0, *_ = sub.subscribe()
+            ps_list[1].stop()  # range 1 goes dark
+            time.sleep(0.05)
+            ts1, w1, clock1, k1, age1, _done = sub.subscribe()
+            assert w1.shape == (d,)
+            lo1, hi1 = smap.ranges()[1]
+            np.testing.assert_array_equal(w1[lo1:hi1], w0[lo1:hi1])
+            time.sleep(1.0)
+            _ts, _w, _c, _k, age2, _d = sub.subscribe()
+            assert age2 >= 1000.0  # the dark range's age keeps growing
+            # UNHEALTHY-per-range: only the dark range is named (the
+            # dead-range probe's bounded backoff must not smear onto the
+            # live ranges just refreshed this round)
+            assert sub.stale_ranges(800.0) == [1]
+            sub.bye()
+        finally:
+            for ps in ps_list:
+                ps.stop()
+
+    def test_replica_resolves_group_and_serves(self, devices8):
+        """serving/replica.py end-to-end over a shard group: the replica
+        resolves the map via SHARDMAP, refreshes through the
+        ShardedSubscriber, answers PREDICT, and its STATUS carries the
+        per-range freshness surface."""
+        from asyncframework_tpu.serving.replica import ModelReplica
+
+        ps_list, smap, n, d = self._group(devices8, loss="least_squares")
+        rep = None
+        try:
+            rep = ModelReplica("127.0.0.1", ps_list[0].port, port=0,
+                               refresh_interval_s=0.05,
+                               max_stale_ms=5000.0).start()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                st = rep.status()
+                if st.get("ts") is not None:
+                    break
+                time.sleep(0.05)
+            st = rep.status()
+            assert st.get("ranges") is not None and len(st["ranges"]) == 3
+            assert st.get("stale_ranges") == []
+            X = np.ascontiguousarray(
+                np.random.default_rng(1).normal(size=(4, d)), np.float32)
+            sock = frame.connect(("127.0.0.1", rep.port))
+            try:
+                frame.send_msg(sock, {"op": "PREDICT", "n": 4}, X.tobytes())
+                hdr, payload = frame.recv_msg(sock)
+            finally:
+                sock.close()
+            assert hdr["op"] == "PREDICTION", hdr
+            out = np.frombuffer(payload, np.float32)
+            assert out.shape == (4,) and np.all(np.isfinite(out))
+        finally:
+            if rep is not None:
+                rep.stop()
+            for ps in ps_list:
+                ps.stop()
+
+
+# ------------------------------------------------------------ k8s rendering
+class TestK8sRendering:
+    def test_ps_shard_objects(self):
+        from asyncframework_tpu.deploy.k8s import (
+            PS_SHARD_PORT,
+            render_ps_shards,
+        )
+
+        objs = render_ps_shards(3, 24, 2048, workers=8)
+        kinds = [o["kind"] for o in objs]
+        assert kinds.count("Deployment") == 3
+        assert kinds.count("Service") == 3
+        assert kinds.count("PersistentVolumeClaim") == 3
+        deps = [o for o in objs if o["kind"] == "Deployment"]
+        maps = set()
+        for i, dep in enumerate(deps):
+            meta = dep["spec"]["template"]["metadata"]
+            assert meta["annotations"]["prometheus.io/scrape"] == "true"
+            assert meta["labels"]["shard"] == str(i)
+            env = {e["name"]: e["value"] for e in
+                   dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["ASYNC_SHARD_INDEX"] == str(i)
+            assert env["ASYNC_SHARD_COUNT"] == "3"
+            assert env["ASYNC_SHARD_ELASTIC"] == ("1" if i == 0 else "0")
+            maps.add(env["ASYNC_SHARD_MAP"])
+            json.loads(env["ASYNC_SHARD_CFG"])  # valid SolverConfig dict
+        # every pod carries the SAME static map, valid and contiguous
+        assert len(maps) == 1
+        wire = json.loads(maps.pop())
+        smap = sg.ShardMap.from_wire(wire)
+        assert smap.d == 24
+        assert all(p == PS_SHARD_PORT for (_h, p, _l, _r) in smap.entries)
+        assert [h for (h, _p, _l, _r) in smap.entries] == [
+            f"async-ps-shard-{i}" for i in range(3)]
+
+    def test_render_cluster_includes_shards(self):
+        from asyncframework_tpu.deploy.k8s import render_cluster
+
+        files = render_cluster(2, ps_shards=3, ps_d=24, ps_n=2048)
+        assert "ps-shards.yaml" in files
+        import yaml
+
+        docs = [d for d in yaml.safe_load_all(files["ps-shards.yaml"])
+                if d is not None]
+        assert len(docs) == 9  # 3 x (PVC + Deployment + Service)
+        assert "async-ps-shard-0" in files["ps-shards.yaml"]
+
+    def test_rejects_bad_shapes(self):
+        from asyncframework_tpu.deploy.k8s import render_ps_shards
+
+        with pytest.raises(ValueError):
+            render_ps_shards(1, 24, 2048)
+        with pytest.raises(ValueError):
+            render_ps_shards(8, 4, 2048)
+
+
+# ------------------------------------------------- telemetry + SLO plumbing
+class TestTelemetryAndSLO:
+    def test_default_rules_include_shard_availability(self):
+        from asyncframework_tpu.metrics.slo import parse_rules
+
+        rules = parse_rules(AsyncConf().get(conf_mod.SLO_RULES))
+        byname = {r.name: r for r in rules}
+        assert "shard_availability" in byname
+        rule = byname["shard_availability"]
+        assert rule.series == "ps_shards.dark_ranges"
+        assert rule.unless_series == "ps_shards.done"
+
+    def test_shard_availability_fires_on_dark_range(self):
+        """Drive the ps_shards.dark_ranges series through healthy ->
+        dark -> recovered and assert the default rule burns into firing
+        and stands back down (no wedge)."""
+        from asyncframework_tpu.metrics.slo import (
+            FIRING,
+            OK,
+            SLOEngine,
+            parse_rules,
+        )
+        from asyncframework_tpu.metrics.timeseries import TimeSeriesStore
+        from asyncframework_tpu.utils.clock import ManualClock
+
+        clk = ManualClock()
+        store = TimeSeriesStore(capacity=512, clock=clk)
+        rules = [r for r in parse_rules(AsyncConf().get(conf_mod.SLO_RULES))
+                 if r.name == "shard_availability"]
+        eng = SLOEngine(rules, store=store,
+                        now_fn=lambda: clk.now_ms() / 1e3)
+
+        def tick(dark: float, n: int):
+            for _ in range(n):
+                clk.advance(1000)
+                store.record("ps_shards.dark_ranges", dark)
+                eng.evaluate()
+
+        tick(0.0, 20)
+        assert eng.evaluate()["shard_availability"]["state"] == OK
+        tick(1.0, 20)  # a range is dark past the burn window
+        assert eng.evaluate()["shard_availability"]["state"] == FIRING
+        tick(0.0, 20)  # recovered
+        assert eng.evaluate()["shard_availability"]["state"] == OK
+
+    def test_per_shard_metrics_labels_and_status_section(self):
+        """A shard child's telemetry endpoint: every /metrics sample
+        carries the shard label (per-shard series never collapse in an
+        aggregator) and strict-parses; /api/status carries the shardgroup
+        counter family and the SLO health section with the
+        shard_availability rule."""
+        import urllib.request
+
+        from asyncframework_tpu.metrics.live import LiveUIServer
+        from asyncframework_tpu.metrics.prom import parse_exposition
+
+        sg._bump("shards_restarted")
+        srv = LiveUIServer(None, port=0, role="ps-shard-1",
+                           labels={"shard": "1"}).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            text = urllib.request.urlopen(f"{base}/metrics",
+                                          timeout=5).read().decode()
+            samples = parse_exposition(text)
+            assert samples, "empty exposition"
+            for (_name, labels) in samples:
+                ld = dict(labels)
+                assert ld.get("shard") == "1"
+                assert ld.get("role") == "ps-shard-1"
+            assert any(name == "async_shardgroup_shards_restarted_total"
+                       for (name, _l) in samples)
+            status = json.loads(urllib.request.urlopen(
+                f"{base}/api/status", timeout=5).read())
+            assert status["counters"]["shardgroup"].get(
+                "shards_restarted") == 1
+            assert "shard_availability" in status["health"]["rules"]
+        finally:
+            srv.stop()
+
+    def test_registry_has_shardgroup_family(self):
+        from asyncframework_tpu.metrics import registry, reset_totals
+
+        assert "shardgroup" in registry.families()
+        sg._bump("sharded_pulls")
+        reset_totals()
+        assert sg.shard_totals() == {}
+
+
+# --------------------------------------------- the acceptance: kill a shard
+@pytest.mark.shard
+class TestKillShardMidRun:
+    """Real OS processes end to end: a 3-shard group under the controller,
+    two worker processes, SIGKILL of a secondary shard mid-run."""
+
+    NW, N, D = 8, 4096, 24
+    ITERS = 500
+
+    def _worker(self, port, wpid, tmp, eval_on=True):
+        env = dict(os.environ)
+        env.update({
+            "PS_ROLE": "worker", "PS_PORT": str(port),
+            "PS_WORKER_ID": str(wpid), "PS_NUM_WORKER_PROCS": "2",
+            "PS_NUM_ITER": str(self.ITERS),
+            "JAX_PLATFORMS": "cpu",
+        })
+        if not eval_on:
+            env["PS_EVAL"] = "0"
+        return subprocess.Popen(
+            [sys.executable, str(CHILD)], env=env,
+            stdout=subprocess.PIPE,
+            stderr=open(os.path.join(tmp, f"worker{wpid}.stderr.log"), "w"),
+            text=True,
+        )
+
+    def test_sigkill_one_shard_of_three(self, tmp_path):
+        # cfg MUST mirror tests/ps_dcn_child.py::config()
+        cfg = SolverConfig(
+            num_workers=self.NW, num_iterations=self.ITERS, gamma=1.2,
+            taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5,
+            printer_freq=50, seed=42, calibration_iters=20,
+            run_timeout_s=120.0,
+        )
+        group = sg.ShardGroup(
+            cfg, self.D, self.N, 3, checkpoint_dir=str(tmp_path),
+            worker_procs=2, dead_after_s=1.0, check_interval_s=0.2,
+            stderr_dir=str(tmp_path),
+        ).start()
+        workers = []
+        killed_pid = None
+        try:
+            port0 = group.port_of(0)
+            workers = [self._worker(port0, 0, str(tmp_path)),
+                       self._worker(port0, 1, str(tmp_path))]
+            # watch shard 1's merge clock via lock-free SUBSCRIBE; kill
+            # only after its cadence checkpoint exists (clock > 50) so
+            # the restart actually exercises durable recovery.  The
+            # threshold is chaos-seeded: each sweep seed kills at a
+            # different point of the run.
+            kill_after = 60 + (CHAOS_SEED % 50)
+            watch = ps_dcn.PSClient("127.0.0.1", group.port_of(1))
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                got = watch.subscribe(0)
+                if got is not None and got[2] >= kill_after:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("shard 1 never reached the kill threshold")
+            try:
+                watch.bye()
+            except (ConnectionError, OSError):
+                pass
+            killed_pid = group.pid_of(1)
+            os.kill(killed_pid, signal.SIGKILL)
+            # the controller must detect the corpse and relaunch it from
+            # its durable checkpoint on the SAME port
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if group.restarts_of(1) >= 1:
+                    break
+                time.sleep(0.1)
+            assert group.restarts_of(1) >= 1, "shard 1 was never restarted"
+            # the run must COMPLETE through the blip
+            result0 = group.result_of(0, timeout_s=90.0)
+            assert result0 is not None, "primary never finished"
+            assert result0["done"] is True
+            assert result0["accepted"] == self.ITERS
+            # full coverage: every logical worker contributed
+            assert set(map(int, result0["accepted_by_wid"])) == set(
+                range(self.NW))
+            # the end-of-run eval plane survived too: a real, decreasing
+            # loss trajectory assembled across all three ranges
+            traj = result0.get("trajectory")
+            assert traj, "no trajectory (eval plane died with the shard?)"
+            assert traj[-1][1] < traj[0][1] * 0.2, traj
+            group.finish()
+            # recovery observability: the restarted child announced what
+            # it resumed from (the durable checkpoint's k), and the
+            # controller counted the death + restart
+            assert group._procs[1].resumed_from is not None, \
+                "restarted shard did not resume from its checkpoint"
+            totals = sg.shard_totals()
+            assert totals.get("shard_deaths", 0) >= 1
+            assert totals.get("shards_restarted", 0) >= 1
+            # the controller's /api/status grows the per-shard section
+            # (metrics/live.py reads the active group)
+            from asyncframework_tpu.metrics.live import process_status
+
+            section = process_status("test").get("shards")
+            assert section is not None
+            assert section["restarts"] >= 1
+            assert set(section["members"]) == {"0", "1", "2"}
+            # exactly-once across the restart: the recovered shard's
+            # result line (its SECOND stdout line of this life) reports a
+            # consistent clock -- every accepted push counted once, and
+            # replays that were already applied+checkpointed were
+            # answered from the RESTORED dedup window, not re-merged
+            result1 = group.result_of(1, timeout_s=30.0)
+            if result1 is not None:  # restarted life's lines shift by one
+                assert result1.get("accepted", 0) + \
+                    result1.get("dropped", 0) <= result1.get("clock", 0) + 1
+            for w in workers:
+                rc = w.wait(timeout=60.0)
+                assert rc == 0, f"worker exited rc={rc}"
+            out = [json.loads(w.stdout.read().splitlines()[-1])
+                   for w in workers]
+            assert sum(o["gradients"] for o in out) >= self.ITERS
+        finally:
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            group.stop()
+
+    @pytest.mark.soak
+    def test_sigkill_primary_shard(self, tmp_path):
+        """The primary (wave gate + eval plane) is a first-class member
+        too: SIGKILL it mid-run, the controller relaunches it from its
+        checkpoint on the same port, workers re-dial, the run completes."""
+        cfg = SolverConfig(
+            num_workers=self.NW, num_iterations=self.ITERS, gamma=1.2,
+            taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5,
+            printer_freq=50, seed=42, calibration_iters=20,
+            run_timeout_s=120.0,
+        )
+        group = sg.ShardGroup(
+            cfg, self.D, self.N, 3, checkpoint_dir=str(tmp_path),
+            worker_procs=2, dead_after_s=1.0, check_interval_s=0.2,
+            stderr_dir=str(tmp_path),
+        ).start()
+        workers = []
+        try:
+            port0 = group.port_of(0)
+            workers = [self._worker(port0, 0, str(tmp_path)),
+                       self._worker(port0, 1, str(tmp_path))]
+            watch = ps_dcn.PSClient("127.0.0.1", port0)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                got = watch.subscribe(0)
+                if got is not None and got[2] >= 80:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("primary never reached the kill threshold")
+            try:
+                watch.bye()
+            except (ConnectionError, OSError):
+                pass
+            os.kill(group.pid_of(0), signal.SIGKILL)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if group.restarts_of(0) >= 1:
+                    break
+                time.sleep(0.1)
+            assert group.restarts_of(0) >= 1
+            result0 = group.result_of(0, timeout_s=90.0)
+            assert result0 is not None and result0["done"] is True
+            assert result0["accepted"] == self.ITERS
+            assert result0.get("resumed_from") is not None
+            group.finish()
+            for w in workers:
+                assert w.wait(timeout=60.0) == 0
+        finally:
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            group.stop()
