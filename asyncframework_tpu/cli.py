@@ -507,6 +507,14 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
     # --conf async.convergence.sample=0 restores the silent wire
     if not conf.contains("async.convergence.sample"):
         conf.set("async.convergence.sample", 16)
+    # epoch fencing defaults ON for the cluster path: servers mint
+    # fencing epochs, ops carry them, and a partitioned-then-replaced
+    # member's stale writes are REJECT_FENCED instead of silently
+    # double-applied (tests/test_fencing.py guards the protocol and the
+    # fencing-off byte identity) -- an explicit
+    # --conf async.fence.enabled=false restores the legacy wire
+    if not conf.contains("async.fence.enabled"):
+        conf.set("async.fence.enabled", True)
 
     cfg = SolverConfig(
         num_workers=args.num_partitions,
@@ -629,6 +637,8 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
                 cfg, ps_d, args.N, host="0.0.0.0", port=int(port_s),
                 algo=algo, checkpoint_path=ckpt_path, supervisor=sup,
                 bus=bus, shard_map=shard_map_wire, shard_index=0,
+                shard_epochs=(group.epochs_wire()
+                              if group is not None else None),
             ).start()
             ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
             if not ok:

@@ -333,6 +333,48 @@ ELASTIC_BOOT_GRACE_S = ConfigEntry(
     "async.elastic.boot.grace.s", 10.0, float,
     "Never-contacted shards are not handed out for adoption before this "
     "much run time has passed (covers slow worker bring-up/compile).")
+# ---------------------------------------------------------- fencing plane
+# Partition-tolerant membership (parallel/supervisor.py, parallel/ps_dcn.py,
+# parallel/shardgroup.py): time-bounded leases granted at HELLO and renewed
+# on any op, a SUSPECT state between live and dead, and monotonic fencing
+# epochs minted per member so a partitioned-but-alive zombie can never
+# mutate or serve a range it no longer owns (servers answer REJECT_FENCED
+# to stale-epoch ops).
+FENCE_ENABLED = ConfigEntry(
+    "async.fence.enabled", False, bool,
+    "Epoch fencing for the PS plane: servers mint a monotonic fencing "
+    "epoch (persisted in their checkpoints, bumped every incarnation and "
+    "every lease-expiry failover), clients stamp it on every "
+    "PULL/PUSH/SUBSCRIBE (ep header), and a server rejects ops whose "
+    "epoch is not current (REJECT_FENCED) -- so a zombie shard behind a "
+    "healed partition, or a deposed worker replaying its buffered "
+    "pushes, can never double-apply against the replacement's state.  "
+    "Off (the default) the wire is byte-identical legacy (no ep keys, "
+    "epoch 0 everywhere); async-cluster flips it on.")
+LEASE_S = ConfigEntry(
+    "async.lease.s", 0.0, float,
+    "Membership lease duration: granted at HELLO, renewed by any op; a "
+    "member whose lease expires is declared dead and (with fencing on) "
+    "its replacement is launched under a bumped fencing epoch.  0 (the "
+    "default) aliases async.elastic.dead.after.s -- the lease IS the "
+    "silence bound, named for what it grants.")
+SUSPECT_AFTER_S = ConfigEntry(
+    "async.suspect.after.s", 0.0, float,
+    "Silence past this marks a member SUSPECT (surfaced in membership, "
+    "metrics, and routing demotion) without declaring death -- the "
+    "partition-tolerant middle state between live and dead.  0 (the "
+    "default) = half the lease.")
+GRAY_RTT_FACTOR = ConfigEntry(
+    "async.gray.rtt.factor", 3.0, float,
+    "Gray-failure detection (net/health.py): an endpoint whose op-RTT "
+    "EWMA exceeds this multiple of the cohort median (and the floor "
+    "below) is latency-SUSPECT -- slow-but-alive members are demoted in "
+    "routing and surfaced in membership without being declared dead.")
+GRAY_RTT_MIN_MS = ConfigEntry(
+    "async.gray.rtt.min.ms", 50.0, float,
+    "Gray-failure RTT floor: an endpoint is never latency-suspected "
+    "while its EWMA is under this many ms (micro-jitter on a fast local "
+    "cohort is not a gray failure).")
 # ----------------------------------------------------------- serving plane
 # The read path (asyncframework_tpu/serving/): ModelReplica processes
 # subscribe to the PS's versioned snapshots (SUBSCRIBE = a wave-gate-free
@@ -408,7 +450,8 @@ SLO_RULES = ConfigEntry(
     "updates_floor: rate(ps.accepted) > 0.5 over 30s for 10s "
     "unless ps.done; "
     "shard_availability: max(ps_shards.dark_ranges) < 1 over 15s "
-    "for 3s unless ps_shards.done",
+    "for 3s unless ps_shards.done; "
+    "fenced_writes: rate(recovery.fenced_rejects) < 1 over 30s for 10s",
     str,
     "Declarative SLO rule set (metrics/slo.py grammar: '<name>: "
     "<agg>(<series>) <op> <threshold> [over Ns] [for Ns] "

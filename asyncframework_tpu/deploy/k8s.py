@@ -359,6 +359,19 @@ def render_ps_shards(shards: int, d: int, n: int,
             {"name": "ASYNC_SHARD_MAP", "value": _json.dumps(smap)},
             {"name": "ASYNC_SHARD_ELASTIC",
              "value": "1" if i == 0 else "0"},
+            # epoch fencing, controller-less edition: the Deployment
+            # controller restarts a dead shard pod, and the child mints
+            # its next epoch from the checkpoint on the PVC
+            # (restore bumps past the persisted epoch) -- ASYNC_SHARD_
+            # EPOCH=1 is only the base for the very first life.  A
+            # zombie pod behind a healed partition answers REJECT_FENCED
+            # to everything once its successor's epoch is seen.
+            {"name": "ASYNC_SHARD_EPOCH", "value": "1"},
+            {"name": "ASYNCTPU_ASYNC_FENCE_ENABLED", "value": "1"},
+            # lease-based death detection on the primary's worker
+            # supervisor: cross-host pids are never probed, so the lease
+            # (silence bound) is the ONLY honest signal up here
+            {"name": "ASYNCTPU_ASYNC_LEASE_S", "value": "5"},
         ]
         container = _container(
             f"ps-shard-{i}", image,

@@ -103,7 +103,15 @@ _register(CounterFamily(
     "recovery", "asyncframework_tpu.parallel.supervisor",
     "recovery_totals", "reset_recovery_totals",
     doc="Elastic plane: workers lost, shards adopted, rejoins, "
-        "releases, PS resumes (parallel/supervisor.py).",
+        "releases, PS resumes, plus the partition-tolerant membership "
+        "counters -- suspicions, lease expiries, fencing-epoch bumps, "
+        "fenced rejects (parallel/supervisor.py).",
+))
+_register(CounterFamily(
+    "gray", "asyncframework_tpu.net.health",
+    "gray_totals", "reset_gray_totals",
+    doc="Gray-failure detection: latency-suspicion transitions "
+        "(net/health.py RttSuspector).",
 ))
 _register(CounterFamily(
     "shuffle", "asyncframework_tpu.data.spill",

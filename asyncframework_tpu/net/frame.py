@@ -46,10 +46,12 @@ import json
 import socket
 import struct
 import threading
+import weakref
 from typing import Dict, Optional, Sequence, Tuple
 
 from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import faults, lockwatch
+from asyncframework_tpu.net import retry as _retry
 
 _HDR = struct.Struct("!I")  # 4-byte big-endian frame length
 
@@ -117,15 +119,64 @@ def endpoint_of(sock: socket.socket) -> str:
         return "?:?"
 
 
+#: sock -> its RESTING timeout (the caller's attempt timeout), stashed
+#: the first time a deadline cap tightens it so later ops can restore or
+#: re-derive the right bound.  Without this, a cap is a ratchet: a call
+#: finishing with 0.2 s of deadline left would leave settimeout(0.2) on
+#: a REUSED connection (PSClient._sock, the frontend's pooled channels)
+#: and every later call -- fresh deadline or none -- would inherit it.
+_base_timeouts: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _deadline_cap(sock: Optional[socket.socket] = None,
+                  timeout: Optional[float] = None) -> Optional[float]:
+    """Cap a socket timeout to the calling thread's active retry deadline
+    (net/retry.py): once the overall deadline is spent, raise
+    ``socket.timeout`` immediately instead of letting a blocking syscall
+    (a stalled read from a gray peer, a stall_read fault) hold the caller
+    past the policy.  Returns the capped timeout; with ``sock`` given,
+    installs ``min(resting timeout, remaining deadline)`` on the socket
+    -- and with no deadline active, RESTORES the resting timeout a
+    previous cap may have tightened."""
+    rem = _retry.remaining_deadline_s()
+    if sock is not None:
+        try:
+            cur = sock.gettimeout()
+            base = _base_timeouts.get(sock, cur)
+            if rem is None:
+                if cur != base:
+                    sock.settimeout(base)
+            elif rem > 0:
+                want = rem if base is None else min(base, rem)
+                if cur != want:
+                    _base_timeouts[sock] = base
+                    sock.settimeout(want)
+        except OSError:  # pragma: no cover - closed socket races
+            pass
+    if rem is None:
+        return timeout
+    if rem <= 0:
+        raise socket.timeout("retry deadline exhausted")
+    return rem if timeout is None else min(timeout, rem)
+
+
 def connect(addr: Tuple[str, int], timeout: Optional[float] = 10.0
             ) -> socket.socket:
     """``socket.create_connection`` with the fault hook: an armed
-    connection-refused event fires here, before any real dial."""
+    connection-refused event (or an active partition) fires here, before
+    any real dial.  The dial itself is capped to the calling thread's
+    retry deadline; the socket's RESTING timeout stays the caller's
+    ``timeout`` (per-op deadline caps re-tighten as needed), so a reused
+    connection never inherits one call's dying deadline."""
     endpoint = f"{addr[0]}:{int(addr[1])}"
     inj = faults.active()
     if inj is not None:
         inj.check_connect(endpoint)
-    return socket.create_connection(addr, timeout=timeout)
+    sock = socket.create_connection(addr,
+                                    timeout=_deadline_cap(None, timeout))
+    if sock.gettimeout() != timeout:
+        sock.settimeout(timeout)
+    return sock
 
 
 def _stamped(header: dict) -> dict:
@@ -170,13 +221,26 @@ def _send_frame(sock: socket.socket, header: dict, parts: Sequence) -> None:
     plen = sum(len(p) for p in parts)
     op = str(header.get("op", ""))
     total = 2 * _HDR.size + len(head) + plen
+    _deadline_cap(sock)  # a spent retry deadline fails the write outright
     inj = faults.active()
     if inj is not None:
+        endpoint = endpoint_of(sock)
+        if inj.partition_active(endpoint):
+            # blackholed: nothing leaves this host, the connection is
+            # poisoned (the peer sees silence, exactly like a real cut)
+            inj.note_partition_drop(endpoint, op)
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"fault-injected: partitioned from {endpoint}"
+            )
         # chaos path: materialize the frame so mid-frame cuts slice the
         # exact same byte stream the plain path would have sent
         data = (_HDR.pack(len(head)) + head + _HDR.pack(plen)
                 + b"".join(bytes(memoryview(p)) for p in parts))
-        kind = inj.check_send(endpoint_of(sock), op)
+        kind = inj.check_send(endpoint, op)
         if kind == faults.CUT_MID_FRAME:
             # a prefix of the frame goes out, then the connection dies: the
             # peer sees a short frame + EOF, the sender sees a reset.  The
@@ -246,6 +310,7 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_msg_raw(sock: socket.socket) -> Tuple[dict, bytes]:
     lockwatch.check_io("recv")
+    _deadline_cap(sock)  # cap the blocking read to the retry deadline
     (hlen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
     header = json.loads(recv_exact(sock, hlen))
     (plen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
@@ -259,6 +324,14 @@ def _recv_msg_raw(sock: socket.socket) -> Tuple[dict, bytes]:
 def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
     inj = faults.active()
     if inj is not None:
+        endpoint = endpoint_of(sock)
+        if inj.partition_active(endpoint):
+            # the partition began (or still holds) while a reply was due:
+            # the bytes never arrive -- same observable as a gray peer
+            inj.note_partition_drop(endpoint, "RECV")
+            raise socket.timeout(
+                f"fault-injected: partitioned from {endpoint}"
+            )
         kind = inj.disarm(sock)
         if kind == faults.STALL_READ:
             # the reply never arrives within the attempt window; the unread

@@ -50,6 +50,27 @@ class CircuitOpenError(ConnectionError):
 _totals_lock = threading.Lock()
 _totals = {"retries": 0, "giveups": 0, "breaker_trips": 0}
 
+# -------------------------------------------------------- deadline plumbing
+# The overall deadline, enforced AT THE SOCKET LAYER: the policy alone can
+# only check the clock between attempts, so one attempt whose socket
+# timeout (attempt_timeout_s, default 120 s) exceeds the remaining
+# deadline used to hold the caller long past it -- a stalled read (gray
+# peer, stall_read fault) outlived the policy.  call() publishes the
+# absolute deadline in a thread-local for the attempt's duration;
+# net/frame.py consults it before every blocking connect/send/recv and
+# caps the socket timeout to the remaining budget (raising socket.timeout
+# outright once it is spent).  Zero cost on the no-deadline path.
+_deadline_tls = threading.local()
+
+
+def remaining_deadline_s() -> Optional[float]:
+    """Seconds left on the calling thread's active retry deadline; None
+    when no deadline-bearing RetryPolicy.call is on the stack."""
+    dl = getattr(_deadline_tls, "deadline", None)
+    if dl is None:
+        return None
+    return dl - time.monotonic()
+
 
 def _bump(key: str, n: int = 1) -> None:
     with _totals_lock:
@@ -202,6 +223,21 @@ class RetryPolicy:
         backoff = self.backoffs_ms()
         deadline = (time.monotonic() + self.deadline_s
                     if self.deadline_s > 0 else None)
+        # publish the absolute deadline for the socket layer (net/frame.py
+        # caps connect/recv timeouts to the remaining budget); nested
+        # policy calls see the TIGHTER of the two deadlines
+        outer_dl = getattr(_deadline_tls, "deadline", None)
+        if deadline is not None:
+            _deadline_tls.deadline = (deadline if outer_dl is None
+                                      else min(deadline, outer_dl))
+        try:
+            return self._call_inner(fn, br, backoff, deadline, endpoint,
+                                    on_retry)
+        finally:
+            if deadline is not None:
+                _deadline_tls.deadline = outer_dl
+
+    def _call_inner(self, fn, br, backoff, deadline, endpoint, on_retry):
         last: Optional[BaseException] = None
         attempt = 0
         for attempt in range(1, self.max_attempts + 1):

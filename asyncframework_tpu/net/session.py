@@ -34,6 +34,14 @@ before the fault are re-answered from cache (on a restarted shard, from
 the RESTORED window), the ones that never arrived apply fresh.  Nothing
 in this module is shard-aware; the guarantee composes because the stamps
 never cross shard boundaries.
+
+Epoch-fencing contract (``async.fence.enabled``, parallel/ps_dcn.py):
+dedup STRICTLY precedes fencing on the server -- an op this incarnation
+already applied re-answers its cached verdict whatever epochs say (the
+applied state is the truth), and a REJECT_FENCED verdict is itself
+``record()``-ed so retries of a fenced stamp re-answer the fence rather
+than racing a fresh admission.  Windows and fences therefore never
+disagree: a stamp is applied-once, fenced-once, or unseen.
 """
 
 from __future__ import annotations

@@ -342,7 +342,8 @@ class ParameterServer:
                  port: int = 0, algo: str = "asgd",
                  checkpoint_path: Optional[str] = None,
                  supervisor: Optional[ElasticSupervisor] = None,
-                 bus=None, shard_map=None, shard_index: int = 0):
+                 bus=None, shard_map=None, shard_index: int = 0,
+                 epoch: Optional[int] = None, shard_epochs=None):
         import jax
         import jax.numpy as jnp
 
@@ -353,6 +354,34 @@ class ParameterServer:
         self.cfg = cfg
         self.d, self.n = d, n
         self.algo = algo
+        # fencing epoch (async.fence.enabled): 0 = fencing off, the
+        # byte-identical legacy wire (no ep header keys anywhere).  > 0 =
+        # this server incarnation's minted epoch; every PULL/PUSH/
+        # SUBSCRIBE stamped with a DIFFERENT epoch is answered
+        # REJECT_FENCED (admission in _fence_reject), so a deposed client
+        # replaying buffered pushes -- or any op routed at a deposed
+        # incarnation of this range -- can never double-apply against the
+        # current owner's state.  Restoring from checkpoint bumps past
+        # the persisted epoch (every incarnation is a new epoch), and a
+        # controller (shardgroup.ShardGroup) passes an explicit epoch
+        # that already counts its lease-expiry fences.
+        if epoch is None:
+            from asyncframework_tpu.conf import FENCE_ENABLED
+            from asyncframework_tpu.conf import global_conf as _gc
+
+            epoch = 1 if _gc().get(FENCE_ENABLED) else 0
+        self.epoch = int(epoch)
+        #: per-shard epochs of the whole group (index-aligned with
+        #: shard_map); installed by SETMAP / the launcher so WELCOME can
+        #: hand workers the full epoch vector next to the map
+        self.shard_epochs = ([int(e) for e in shard_epochs]
+                             if shard_epochs else None)
+        #: highest foreign epoch seen ABOVE ours: once a client proves a
+        #: successor exists for this range, this incarnation is a zombie
+        #: and refuses every stamped op (even same-epoch ones) -- "never
+        #: mutate or serve a range it no longer owns"
+        self._fenced_above = 0
+        self.fenced_rejects = 0
         # sharded PS group (parallel/shardgroup.py): when this server is one
         # range of a shard group, ``shard_map`` is the group's wire map
         # (per-shard [host, port, lo, hi]) and ``shard_index`` names this
@@ -688,6 +717,12 @@ class ParameterServer:
                 str(w): c for w, c in self.accepted_by_wid.items()
             },
             "membership_rejects": self.membership_rejects,
+            # fencing: the epoch rides the checkpoint so a restart can
+            # never come back BELOW a fence (the restore bumps past it),
+            # and the reject count survives incarnations for the
+            # acceptance assertions / metrics
+            "epoch": self.epoch,
+            "fenced_rejects": self.fenced_rejects,
         }
         arrays = {"w": np.asarray(self._w, np.float32)}
         if self._snapshots:
@@ -791,6 +826,14 @@ class ParameterServer:
                 for w, c in meta.get("accepted_by_wid", {}).items()
             }
             self.membership_rejects = int(meta.get("membership_rejects", 0))
+            if self.epoch > 0:
+                # every incarnation is a NEW epoch: a restart from this
+                # checkpoint must dominate anything the previous life
+                # stamped or accepted (a controller-passed epoch that
+                # already counts more fences wins via max)
+                self.epoch = max(self.epoch,
+                                 int(meta.get("epoch", 0)) + 1)
+            self.fenced_rejects = int(meta.get("fenced_rejects", 0))
         self.resumed_from_k = self._k
         supervisor_mod.bump_total("ps_resumes")
 
@@ -861,28 +904,37 @@ class ParameterServer:
                 # verbs so fault schedules (net/faults.py) can target the
                 # ASAGA stream without also counting ASGD ops
                 if op in ("PULL", "PULL_SAGA"):
+                    if self._fence_reject(conn, header):
+                        continue
                     self._handle_pull(conn, header)
                 elif op == "SUBSCRIBE":
                     # serving-tier snapshot subscription: a read-only,
                     # wave-gate-free pull that keeps answering after DONE
+                    if self._fence_reject(conn, header):
+                        continue
                     self._handle_subscribe(conn, header)
                 elif op in ("PUSH", "PUSH_SAGA"):
                     cached = self._dedup.check(header)
                     if cached is not None:
                         # duplicate of an already-applied push (the ACK was
-                        # lost on the wire): re-send it, merge nothing
+                        # lost on the wire): re-send it, merge nothing.
+                        # Dedup wins over the fence check: an op this
+                        # incarnation ALREADY applied must re-answer its
+                        # cached verdict, not invent a new one.
                         _send_msg(conn, cached[0])
-                    else:
+                    elif not self._fence_reject(conn, header, record=True):
                         self._handle_push(conn, header, payload)
                 elif op == "HELLO":
                     # a worker process introducing itself (elastic plane):
-                    # proc token + logical worker ids + pid/host
+                    # proc token + logical worker ids + pid/host (+ the
+                    # pid's /proc start time, pid-reuse protection)
                     if self.supervisor is not None:
                         self.supervisor.register(
                             str(header.get("proc")),
                             [int(w) for w in header.get("wids", [])],
                             pid=header.get("pid"),
                             host=header.get("host"),
+                            pid_start=header.get("pstart"),
                         )
                     welcome = {"op": "WELCOME",
                                "elastic": self.supervisor is not None}
@@ -892,13 +944,23 @@ class ParameterServer:
                         # range (shardgroup.ShardedPSClient).  Key absent
                         # on an unsharded PS -- byte-identical legacy wire.
                         welcome["shards"] = self.shard_map
+                        if self.shard_epochs:
+                            welcome["epochs"] = self.shard_epochs
+                    if self.epoch:
+                        welcome["epoch"] = self.epoch
                     _send_msg(conn, welcome)
                 elif op == "SHARDMAP":
                     # shard-map query (group members, liveness probes,
                     # serving replicas): the classic single PS answers an
                     # empty list -- "no group here"
-                    _send_msg(conn, {"op": "SHARDMAP",
-                                     "shards": self.shard_map or []})
+                    reply = {"op": "SHARDMAP",
+                             "shards": self.shard_map or []}
+                    if self.epoch:
+                        reply["epoch"] = self.epoch
+                        reply["fenced_rejects"] = self.fenced_rejects
+                    if self.shard_epochs:
+                        reply["epochs"] = self.shard_epochs
+                    _send_msg(conn, reply)
                 elif op == "SETMAP":
                     # group controller installing the assembled map on a
                     # freshly-spawned shard child (it cannot know its
@@ -908,6 +970,12 @@ class ParameterServer:
                                       if wire else None)
                     if "index" in header:
                         self.shard_index = int(header["index"])
+                    if header.get("epochs"):
+                        # the controller's epoch vector (post-fence
+                        # re-installs ride this too, so WELCOME hands new
+                        # workers current epochs, not boot-time ones)
+                        self.shard_epochs = [int(e)
+                                             for e in header["epochs"]]
                     _send_msg(conn, {"op": "ACK"})
                 elif op == "FINISH":
                     # group-wide DONE broadcast: a secondary shard serves
@@ -953,6 +1021,65 @@ class ParameterServer:
             return
         finally:
             conn.close()
+
+    def _fence_reject(self, conn: socket.socket, header: dict,
+                      record: bool = False) -> bool:
+        """Epoch-fencing admission (async.fence.enabled): True when the
+        op was answered REJECT_FENCED and must not be served.
+
+        Rules (``ep`` = the op's stamped epoch, ``self.epoch`` = this
+        incarnation's minted one):
+
+        - fencing off (``self.epoch == 0``) or unstamped op (legacy
+          client): serve -- the wire stays byte-identical and old
+          clients keep their old semantics;
+        - ``ep < self.epoch``: the CLIENT is deposed (it pulled its view
+          from a fenced incarnation) -- reject, tell it the current
+          epoch so it re-resolves and continues;
+        - ``ep > self.epoch``: a successor exists, so THIS server is the
+          zombie -- remember the foreign epoch and reject; from here on
+          every stamped op is refused (a zombie must neither mutate nor
+          serve its old range, even to same-epoch stragglers);
+        - ``ep == self.epoch`` and not deposed: serve.
+
+        The reply carries the highest epoch this server knows, so a
+        fenced client self-heals: it adopts the epoch and its next op
+        (stamped fresh) is admitted by the current owner.  Fenced PUSH
+        verdicts are recorded in the dedup window (``record=True``) so a
+        retry of the same stamp re-answers the fence instead of racing a
+        fresh admission."""
+        if not self.epoch:
+            return False
+        ep = header.get("ep")
+        if ep is None:
+            return False
+        ep = int(ep)
+        if ep > self.epoch:
+            # lock-free int write: monotone max under the GIL; a racing
+            # reader sees either value, both of which fence correctly
+            if ep > self._fenced_above:
+                self._fenced_above = ep
+        elif ep == self.epoch and self._fenced_above <= self.epoch:
+            return False
+        rej = {"op": "REJECT_FENCED",
+               "epoch": max(self.epoch, self._fenced_above)}
+        with self._stats_lock:
+            self.fenced_rejects += 1
+        supervisor_mod.bump_total("fenced_rejects")
+        if record:
+            # PUSH: fold the piggybacked telemetry BEFORE rejecting --
+            # the 'fold before any drop path' invariant (_handle_push).
+            # Spans/counters/convergence samples around a failover are
+            # exactly the telemetry the fence window must not eat, and
+            # dedup-replayed fenced stamps never reach here (the cached
+            # verdict answers them), so nothing double-folds.
+            self._fold_wire_spans(header.get("spans"))
+            _pl_fold(header.get("pl"))
+            _cv_fold(header.get("cv"), clock=self._clock,
+                     wall_ms=self._bus_time_ms())
+            self._dedup.record(header, rej)
+        _send_msg(conn, rej)
+        return True
 
     def _release_wave_locked(self) -> None:
         """Fire the partial barrier: everyone currently waiting rides this
@@ -1195,6 +1322,12 @@ class ParameterServer:
         # vectored zero-copy framing: the cached model bytes and the ASAGA
         # extra payload go out as one kernel-gathered iovec -- the payload
         # is never copied into a fresh frame buffer
+        if self.epoch:
+            # fencing on: replies advertise the current epoch so a
+            # client that joined before a fence converges without a
+            # REJECT_FENCED round trip (absent with fencing off --
+            # byte-identical legacy wire)
+            extra_hdr["ep"] = self.epoch
         _frame.send_msg_vectored(
             conn,
             {"op": "MODEL", "ts": ts, "avg_delay_ms": avg,
@@ -1243,6 +1376,8 @@ class ParameterServer:
                 self.subscribe_replies.get(shape, 0) + 1
             )
             self.subscribe_model_bytes += len(model_part)
+        if self.epoch:
+            model_hdr["ep"] = self.epoch
         _frame.send_msg_vectored(
             conn,
             {"op": "MODEL", "ts": ts, "clock": cur, "k": self._k,
@@ -1670,6 +1805,15 @@ class ParameterServer:
         self._threads = [x for x in self._threads if x.is_alive()]
 
 
+class FencedError(ConnectionError):
+    """The server refused this client's ops under epoch fencing and the
+    client cannot self-heal by adopting a newer epoch -- the server
+    itself is at (or below) the client's epoch, i.e. the client is
+    talking to a deposed zombie.  Subclasses ConnectionError so worker
+    loops treat it like any other dead endpoint: pace, re-dial, and
+    land on the current owner."""
+
+
 # -------------------------------------------------------------- worker side
 class PSClient:
     """One TCP connection to the PS (workers may hold several, one per
@@ -1690,9 +1834,19 @@ class PSClient:
                  recorder: Optional["_trace.TraceRecorder"] = None,
                  pull_mode: Optional[str] = None,
                  pl_stats: Optional[_PipelineStats] = None,
-                 cv_buf=None):
+                 cv_buf=None, epoch: int = 0):
         self.host, self.port = host, int(port)
         self.endpoint = f"{host}:{self.port}"
+        # fencing epoch this client stamps on every PULL/PUSH/SUBSCRIBE
+        # (``ep`` header key; 0 = fencing off, no key, byte-identical
+        # legacy wire).  Seeded from the WELCOME handshake and advanced
+        # by MODEL replies / REJECT_FENCED verdicts -- a fenced client
+        # adopts the minted epoch and its NEXT op is admitted; entries
+        # already stamped (the windowed push pipe replays verbatim) keep
+        # their old epoch and are rejected exactly once each, which is
+        # the point: a deposed incarnation's buffered writes never land.
+        self.epoch = int(epoch)
+        self.fenced_replies = 0
         self.retry = retry if retry is not None else RetryPolicy.from_conf(
             attempt_timeout_s=timeout_s
         )
@@ -1791,6 +1945,8 @@ class PSClient:
     def _proc_hdr(self, hdr: dict) -> dict:
         if self.proc is not None:
             hdr["proc"] = self.proc
+        if self.epoch:
+            hdr["ep"] = self.epoch
         return hdr
 
     def _note_orders(self, header: dict) -> None:
@@ -1805,13 +1961,21 @@ class PSClient:
     def hello(self, proc: str, wids: List[int],
               pid: Optional[int] = None) -> dict:
         """Introduce this worker process to the PS (elastic registration;
-        a fixed-membership PS just says WELCOME and ignores it)."""
+        a fixed-membership PS just says WELCOME and ignores it).  Carries
+        this process's /proc start time next to its pid so the
+        supervisor's liveness probe can tell a recycled pid from the
+        registered member."""
         import socket as _socket
 
-        header, _ = self._call_raw({
+        hdr = {
             "op": "HELLO", "proc": proc, "wids": [int(w) for w in wids],
             "pid": pid, "host": _socket.gethostname(),
-        })
+        }
+        if pid is not None:
+            pstart = supervisor_mod.proc_start_time(pid)
+            if pstart is not None:
+                hdr["pstart"] = pstart
+        header, _ = self._call_raw(hdr)
         return header
 
     def _traced_call(self, tr, stage: str, header: dict,
@@ -1885,20 +2049,58 @@ class PSClient:
         self.pull_model_bytes += len(model_part)
         return w
 
+    def _note_fenced(self, header: dict) -> bool:
+        """Fold one REJECT_FENCED verdict: adopt the minted epoch when it
+        is NEWER than ours (we were deposed and can self-heal -- the next
+        op, stamped fresh, will be admitted) and return True; False means
+        the SERVER is the stale party (a zombie) and cannot serve us."""
+        self.fenced_replies += 1
+        srv_ep = int(header.get("epoch", 0))
+        if srv_ep > self.epoch:
+            self.epoch = srv_ep
+            return True
+        return False
+
     def _process_pull_reply(self, wid: int, header: dict, payload: bytes,
                             make_hdr, extra_len_of, tr
                             ) -> Optional[Tuple[dict, bytes, np.ndarray]]:
         """Shared back half of a model pull: RELEASED/DONE handling,
-        adoption orders, and decode with the ONE-full-re-pull fallback
-        (basis cache miss, CRC disagreement -- a full reply always
-        decodes; never a wrong model).  Returns (header, payload, w), or
-        None on RELEASED/DONE (``self.released`` distinguishes them)."""
-        for fallback_left in (True, False):
-            if header["op"] == "RELEASED":
+        REJECT_FENCED self-healing, adoption orders, and decode with the
+        ONE-full-re-pull fallback (basis cache miss, CRC disagreement --
+        a full reply always decodes; never a wrong model).  Returns
+        (header, payload, w), or None on RELEASED/DONE (``self.released``
+        distinguishes them)."""
+        fence_left = True
+        fallback_left = True
+        while True:
+            op = header["op"]
+            if op == "RELEASED":
                 self.released = True
                 return None
-            if header["op"] == "DONE":
+            if op == "DONE":
                 return None
+            if op == "REJECT_FENCED":
+                # deposed basis: adopt the minted epoch and re-pull ONCE
+                # with the fresh stamp (the current owner admits it); a
+                # second fence, or a server whose epoch does not exceed
+                # ours, is a zombie endpoint -- surface it
+                if self._note_fenced(header) and fence_left:
+                    fence_left = False
+                    header, payload = self._traced_call(
+                        tr, _trace.PULL_RTT,
+                        self._proc_hdr(self._have_hdr(wid, make_hdr())),
+                    )
+                    continue
+                raise FencedError(
+                    f"fenced by {self.endpoint} at epoch "
+                    f"{int(header.get('epoch', 0))} (client epoch "
+                    f"{self.epoch})"
+                )
+            srv_ep = header.get("ep")
+            if srv_ep is not None and int(srv_ep) > self.epoch:
+                # replies advertise the server's current epoch: track it
+                # so our next op is stamped current without a fence trip
+                self.epoch = int(srv_ep)
             self._note_orders(header)
             w = self._decode_model(wid, header, payload,
                                    extra_len_of(header))
@@ -1906,6 +2108,7 @@ class PSClient:
                 return header, payload, w
             if not fallback_left:  # pragma: no cover - full always decodes
                 break
+            fallback_left = False
             self._basis.pop(wid, None)
             self.delta_fallbacks += 1
             header, payload = self._traced_call(
@@ -2165,6 +2368,16 @@ class PSClient:
         except BaseException:
             self._requeue_piggybacks(spans, pl_delta, cv_wire)
             raise
+        if header.get("op") == "REJECT_FENCED":
+            # this gradient was computed under a deposed epoch: it is
+            # DROPPED (the same loss as a taw rejection), and with the
+            # adopted epoch the next round is admitted
+            if self._note_fenced(header):
+                return False, False
+            raise FencedError(
+                f"push fenced by zombie {self.endpoint} (epoch "
+                f"{int(header.get('epoch', 0))} <= ours {self.epoch})"
+            )
         if header.get("released"):
             self.released = True
         return bool(header.get("accepted")), bool(header.get("done"))
@@ -2260,6 +2473,27 @@ class PSClient:
         if tr is not None and token is not None:
             tr.rpc_end(token,
                        bytes=sent_bytes + _frame.last_recv_bytes())
+        if header.get("op") == "REJECT_FENCED":
+            # a windowed entry stamped under a deposed epoch (typically a
+            # replay onto a fenced range's replacement): dropped, epoch
+            # adopted -- later push_start calls stamp the current epoch.
+            # Judge against THIS ENTRY'S stamp, not self.epoch: with >= 2
+            # stale entries in flight, the first fence already advanced
+            # self.epoch, and comparing the second reply against the
+            # advanced value would misread the healthy replacement as a
+            # zombie (each stale entry is rejected exactly once, that is
+            # the design -- only a server whose epoch does not exceed
+            # what WE stamped on the op is actually stale itself).
+            self.fenced_replies += 1
+            srv_ep = int(header.get("epoch", 0))
+            if srv_ep > self.epoch:
+                self.epoch = srv_ep
+            if srv_ep > int(entry[0].get("ep", 0) or 0):
+                return False, False
+            raise FencedError(
+                f"push fenced by zombie {self.endpoint} (epoch "
+                f"{srv_ep} <= op stamp {entry[0].get('ep')})"
+            )
         if header.get("released"):
             self.released = True
         return bool(header.get("accepted")), bool(header.get("done"))
@@ -2483,8 +2717,12 @@ def run_worker_process(
 
     # sharded PS group (parallel/shardgroup.py): resolved from the HELLO
     # WELCOME below.  None = the classic single PS -- every client below
-    # is a stock PSClient and the wire is byte-identical.
+    # is a stock PSClient and the wire is byte-identical.  The WELCOME
+    # also seeds the fencing epochs (async.fence.enabled on the servers;
+    # absent = 0 = legacy, clients stamp nothing).
     smap = None
+    smap_epochs: Optional[List[int]] = None
+    ps_epoch = 0
 
     def make_client(recorder=None, pl_stats=None, cv_buf=None):
         """One PS-facing client: a ShardedPSClient fan-out facade when
@@ -2498,11 +2736,11 @@ def run_worker_process(
             return ShardedPSClient(
                 smap, proc=proc_token, recorder=recorder,
                 pull_mode=getattr(cfg, "pull_mode", None),
-                pl_stats=pl_stats, cv_buf=cv_buf,
+                pl_stats=pl_stats, cv_buf=cv_buf, epochs=smap_epochs,
             )
         return PSClient(host, port, proc=proc_token, recorder=recorder,
                         pull_mode=getattr(cfg, "pull_mode", None),
-                        pl_stats=pl_stats, cv_buf=cv_buf)
+                        pl_stats=pl_stats, cv_buf=cv_buf, epoch=ps_epoch)
 
     # elastic adoption bookkeeping: which wids this process serves (own +
     # adopted), and every loop thread ever started (joined at the end)
@@ -2890,6 +3128,10 @@ def run_worker_process(
                         "sharded PS groups serve algo='asgd' only"
                     )
                 smap = ShardMap.from_wire(wire_map)
+                wire_epochs = welcome.get("epochs")
+                if wire_epochs:
+                    smap_epochs = [int(e) for e in wire_epochs]
+            ps_epoch = int(welcome.get("epoch", 0) or 0)
             hello_ok = True
             break
         except (ConnectionError, OSError):
@@ -2922,16 +3164,34 @@ def run_worker_process(
         # rejoined (RELEASED) is evaluated by its real owner, and summing
         # it here too would double-count its loss.  Against a shard group
         # the client assembles the full-width snapshot stack per range.
-        cl = make_client()
-        try:
-            times, W = cl.snapshots()
-            with group_lock:
-                served = {w: s for w, s in shards.items()
-                          if w in active_wids}
-            losses = evaluate_snapshots_on_shards(served, times, W, cfg.loss)
-            cl.send_eval(eval_wid, losses)
-        finally:
-            cl.bye()
+        # The fan-out is RETRIED under pacing: a shard mid-relaunch
+        # (elastic failover; a fenced zombie being replaced right at run
+        # end) must cost the eval plane a pause, not the whole trajectory
+        # -- before this, one refused dial here crashed the worker and
+        # silently voided the assembled loss curve.
+        eval_deadline = time.monotonic() + min(60.0, deadline_s)
+        while True:
+            cl = None
+            try:
+                cl = make_client()
+                times, W = cl.snapshots()
+                with group_lock:
+                    served = {w: s for w, s in shards.items()
+                              if w in active_wids}
+                losses = evaluate_snapshots_on_shards(served, times, W,
+                                                      cfg.loss)
+                cl.send_eval(eval_wid, losses)
+                break
+            except (ConnectionError, OSError):
+                if time.monotonic() >= eval_deadline:
+                    break  # trajectory forfeited, counts still returned
+                time.sleep(0.5)
+            finally:
+                if cl is not None:
+                    try:
+                        cl.bye()
+                    except (ConnectionError, OSError):
+                        pass
     return counts
 
 

@@ -214,11 +214,26 @@ def fetch_shard_map(host: str, port: int,
     """One SHARDMAP round trip against any group member.  Returns None
     when the server is unsharded (the classic single PS answers an empty
     map).  Raises on transport failure -- callers own retry pacing."""
+    smap, _epochs, _epoch = fetch_group_info(host, port, timeout_s)
+    return smap
+
+
+def fetch_group_info(host: str, port: int, timeout_s: float = 10.0
+                     ) -> Tuple[Optional[ShardMap],
+                                Optional[List[int]], int]:
+    """One SHARDMAP round trip returning ``(shard_map, epochs, epoch)``:
+    the group map (None when unsharded), the per-shard fencing-epoch
+    vector (None when fencing is off or unknown), and the answering
+    server's own epoch (0 = fencing off) -- everything a subscriber
+    needs to stamp its reads so a fenced zombie can never serve it."""
     header = _oneshot(host, port, {"op": "SHARDMAP"}, timeout_s)
     wire = header.get("shards") or []
+    epochs = header.get("epochs")
+    epoch = int(header.get("epoch", 0) or 0)
     if len(wire) <= 1:
-        return None
-    return ShardMap.from_wire(wire)
+        return None, None, epoch
+    return (ShardMap.from_wire(wire),
+            [int(e) for e in epochs] if epochs else None, epoch)
 
 
 def finish_endpoint(host: str, port: int, timeout_s: float = 5.0) -> None:
@@ -252,20 +267,25 @@ class ShardedPSClient:
     def __init__(self, smap: ShardMap, timeout_s: float = 120.0,
                  proc: Optional[str] = None, recorder=None,
                  pull_mode: Optional[str] = None, pl_stats=None,
-                 cv_buf=None):
+                 cv_buf=None, epochs: Optional[Sequence[int]] = None):
         from asyncframework_tpu.parallel.ps_dcn import PSClient
 
         self.smap = smap
         # piggybacked telemetry (trace spans, pipeline counters,
         # convergence samples) rides the PRIMARY connection only: the
         # primary folds it into the process that serves the dashboard;
-        # shipping copies per shard would double-count every sample
+        # shipping copies per shard would double-count every sample.
+        # ``epochs`` (WELCOME handshake) seeds per-shard fencing epochs:
+        # each sub-client stamps ITS shard's epoch -- ranges fence
+        # independently, exactly like the staleness vector.
         self.clients: List[PSClient] = [
             PSClient(h, p, timeout_s=timeout_s, proc=proc,
                      recorder=recorder if i == 0 else None,
                      pull_mode=pull_mode,
                      pl_stats=pl_stats if i == 0 else None,
-                     cv_buf=cv_buf if i == 0 else None)
+                     cv_buf=cv_buf if i == 0 else None,
+                     epoch=(int(epochs[i])
+                            if epochs and i < len(epochs) else 0))
             for i, (h, p, _lo, _hi) in enumerate(smap.entries)
         ]
         self._saw_done = False
@@ -479,7 +499,8 @@ class ShardedSubscriber:
     UNHEALTHY naming the stale ranges rather than serving a silent lie.
     """
 
-    def __init__(self, smap: ShardMap, timeout_s: float = 120.0):
+    def __init__(self, smap: ShardMap, timeout_s: float = 120.0,
+                 epochs: Optional[Sequence[int]] = None):
         from asyncframework_tpu.net.retry import RetryPolicy
         from asyncframework_tpu.parallel.ps_dcn import PSClient
 
@@ -504,8 +525,10 @@ class ShardedSubscriber:
         )
         self.clients = [
             PSClient(h, p, timeout_s=timeout_s, retry=retry,
-                     pull_mode="delta")
-            for (h, p, _lo, _hi) in smap.entries
+                     pull_mode="delta",
+                     epoch=(int(epochs[i])
+                            if epochs and i < len(epochs) else 0))
+            for i, (h, p, _lo, _hi) in enumerate(smap.entries)
         ]
         self._last: List[Optional[tuple]] = [None] * smap.n_shards
         self._ok_mono: List[Optional[float]] = [None] * smap.n_shards
@@ -726,15 +749,53 @@ class ShardGroup:
             i: _ShardProc(i) for i in self.indices
         }
         self.smap: Optional[ShardMap] = None
+        # epoch fencing (async.fence.enabled, read through the overlays
+        # the children will see so controller and children agree): the
+        # controller is the epoch minter for its managed shards -- a
+        # shard's running epoch is 1 + its slot's supervisor fence count,
+        # passed down at spawn and re-announced to the group via SETMAP
+        # after every relaunch.  The child additionally bumps past its
+        # checkpoint's persisted epoch, so even a controller-less restart
+        # (the k8s Deployment path) mints a fresh incarnation.
+        from asyncframework_tpu.conf import (
+            FENCE_ENABLED,
+            GRAY_RTT_FACTOR,
+            GRAY_RTT_MIN_MS,
+            LEASE_S,
+            SUSPECT_AFTER_S,
+            AsyncConf,
+        )
+
+        overlay_conf = AsyncConf(self.conf_overlays)
+        self.fence = bool(overlay_conf.get(FENCE_ENABLED))
+        # gray-failure detection: the liveness probes below time their
+        # round trips into a cohort RTT suspector; a slow-but-alive shard
+        # is marked SUSPECT in membership (and surfaced in telemetry)
+        # without being killed -- lease expiry alone escalates to DEAD.
+        # Tuning is read through the SAME overlays the children see (the
+        # fence-flag discipline): controller and children must agree.
+        from asyncframework_tpu.net.health import RttSuspector
+
+        self._gray = RttSuspector(
+            factor=overlay_conf.get(GRAY_RTT_FACTOR),
+            min_ms=overlay_conf.get(GRAY_RTT_MIN_MS),
+        )
         # PR 2 supervisor, shard edition: one slot per shard, no adoption
         # planning (a PS shard is re-homed by RESTART, not by handing its
         # range to a peer -- the range's durable state lives in its
         # checkpoint).  Port probes feed touch(); pid probes catch local
         # exits between ticks.
+        # async.lease.s / async.suspect.after.s (same overlay discipline
+        # as the fence flag) override the ctor's dead_after_s default, so
+        # an operator widening the shard lease for slow bring-up or long
+        # partitions is actually obeyed here, not just worker-side
         self.sup = supervisor_mod.ElasticSupervisor(
             self.shards, dead_after_s=dead_after_s,
             check_interval_s=check_interval_s, boot_grace_s=dead_after_s,
-            adopt=False,
+            adopt=False, fence=self.fence,
+            lease_s=float(overlay_conf.get(LEASE_S)) or None,
+            suspect_after_s=float(overlay_conf.get(SUSPECT_AFTER_S))
+            or None,
         )
         self._check_interval_s = float(check_interval_s)
         self._stop = threading.Event()
@@ -767,7 +828,28 @@ class ShardGroup:
         env["ASYNC_SHARD_CONF"] = json.dumps(self.conf_overlays)
         env["ASYNC_SHARD_MAP"] = (json.dumps(self.smap.to_wire())
                                   if self.smap is not None else "")
+        env["ASYNC_SHARD_EPOCH"] = str(self.epoch_of(index))
+        epochs = self.epochs_wire()
+        env["ASYNC_SHARD_EPOCHS"] = json.dumps(epochs) if epochs else ""
         return env
+
+    def epoch_of(self, index: int) -> int:
+        """The fencing epoch shard ``index`` currently runs at (0 =
+        fencing off): base epoch 1 plus one bump per lease-expiry/exit
+        fence the supervisor declared for its slot."""
+        if not self.fence:
+            return 0
+        return 1 + self.sup.epoch_of(index)
+
+    def epochs_wire(self) -> Optional[List[int]]:
+        """The whole group's epoch vector in range order (None with
+        fencing off); unmanaged shards (the cluster CLI's in-process
+        primary) sit at their base epoch unless their own restarts bump
+        them -- their minting rides their checkpoints, not this
+        controller."""
+        if not self.fence:
+            return None
+        return [self.epoch_of(i) for i in range(self.shards)]
 
     def _spawn(self, index: int, bind_port: int) -> dict:
         rec = self._procs[index]
@@ -842,33 +924,53 @@ class ShardGroup:
         return self
 
     def _setmap(self, index: int) -> None:
-        _oneshot(self.host, self._procs[index].port,
-                 {"op": "SETMAP", "index": index,
-                  "shards": self.smap.to_wire()}, timeout_s=10.0)
+        hdr = {"op": "SETMAP", "index": index,
+               "shards": self.smap.to_wire()}
+        epochs = self.epochs_wire()
+        if epochs:
+            hdr["epochs"] = epochs
+        _oneshot(self.host, self._procs[index].port, hdr, timeout_s=10.0)
 
     def _telemetry_source(self) -> Dict[str, float]:
         member = self.sup.membership()
         dark = sum(1 for i in self._procs
                    if member.get(i, {}).get("state") == supervisor_mod.DEAD)
+        suspect = sum(
+            1 for i in self._procs
+            if member.get(i, {}).get("state") == supervisor_mod.SUSPECT
+        )
         totals = shard_totals()
         return {
             "total": float(self.shards),
             "managed": float(len(self._procs)),
             "dark_ranges": float(dark),
+            "suspect_ranges": float(suspect),
             "live": float(self.shards - dark),
             "restarts": float(totals.get("shards_restarted", 0)),
+            "fence_epoch_bumps": float(
+                totals.get("fence_epoch_bumps", 0)),
             "done": float(self._finished.is_set()),
         }
 
     # ------------------------------------------------------------- monitor
     def _probe(self, index: int) -> bool:
         """One liveness probe: a SHARDMAP round trip against the shard's
-        pinned port.  Success feeds the supervisor's contact signal."""
+        pinned port.  Success feeds the supervisor's contact signal (the
+        lease renewal) AND the gray-failure RTT suspector: a shard that
+        answers, but at a multiple of its cohort's round trip, is marked
+        SUSPECT -- surfaced in membership/telemetry, never killed on
+        latency alone."""
+        endpoint = f"{self.host}:{self._procs[index].port}"
+        t0 = time.monotonic()
         try:
             _oneshot(self.host, self._procs[index].port,
                      {"op": "SHARDMAP"}, timeout_s=1.0)
         except (ConnectionError, OSError):
             return False
+        if self._gray.observe(endpoint, (time.monotonic() - t0) * 1e3):
+            self.sup.suspect(index)
+        else:
+            self.sup.unsuspect(index)
         self.sup.touch(index, f"ps-shard-{index}")
         return True
 
@@ -884,6 +986,9 @@ class ShardGroup:
         newly_dead = [i for i in self.sup.check_once() if i in self._procs]
         for i in newly_dead:
             _bump("shard_deaths")
+            # a dead member's frozen RTT EWMA must leave the cohort:
+            # left in, it skews every later suspicion median
+            self._gray.forget(f"{self.host}:{self._procs[i].port}")
             self._restart(i)
         member = self.sup.membership()
         for i in self._procs:
@@ -952,6 +1057,28 @@ class ShardGroup:
             # checkpointed k it came back at (None = fresh model, e.g.
             # death before the first cadence checkpoint)
             rec.resumed_from = hello.get("resumed_from")
+            if self.fence and self.smap is not None:
+                # announce the bumped epoch vector to every reachable
+                # member -- INCLUDING unmanaged fixed entries (the
+                # cluster CLI's in-process primary serves every worker
+                # HELLO, so it above all must hand out current epochs):
+                # WELCOME hands NEW workers current epochs, and existing
+                # clients converge via MODEL ep stamps / REJECT_FENCED
+                # verdicts either way -- best-effort by design (a
+                # still-partitioned member self-heals later).  This is
+                # where a fencing epoch actually reaches the wire, so it
+                # is also where recovery.epoch_bumps counts.
+                _bump("fence_epoch_bumps")
+                supervisor_mod.bump_total("epoch_bumps")
+                epochs = self.epochs_wire()
+                for j, (h, p, _lo, _hi) in enumerate(self.smap.entries):
+                    try:
+                        _oneshot(h, p,
+                                 {"op": "SETMAP", "index": j,
+                                  "shards": self.smap.to_wire(),
+                                  "epochs": epochs}, timeout_s=10.0)
+                    except (ConnectionError, OSError):
+                        pass
 
     # ------------------------------------------------------------ plumbing
     def port_of(self, index: int) -> int:
@@ -1001,9 +1128,10 @@ class ShardGroup:
                 pass
 
     def status_section(self) -> dict:
-        """The /api/status ``shards`` section: map + per-shard liveness."""
+        """The /api/status ``shards`` section: map + per-shard liveness,
+        fencing epochs, and the gray-failure RTT view."""
         totals = shard_totals()
-        return {
+        out = {
             "shards": self.shards,
             "map": self.smap.to_wire() if self.smap is not None else None,
             "deaths": totals.get("shard_deaths", 0),
@@ -1011,6 +1139,12 @@ class ShardGroup:
             "done": self._finished.is_set(),
             "members": {str(i): st for i, st in self.status().items()},
         }
+        if self.fence:
+            out["epochs"] = self.epochs_wire()
+        gray = self._gray.snapshot()
+        if gray:
+            out["rtt"] = gray
+        return out
 
     def stop(self, timeout_s: float = 15.0) -> None:
         _set_active_group(None, only_if=self)
@@ -1094,6 +1228,12 @@ def launch_inprocess_group(cfg, d: int, n: int, shards: int,
     ])
     for ps in ps_list:
         ps.shard_map = smap.to_wire()
+    if any(p.epoch for p in ps_list):
+        # fencing on (each PS minted its conf-derived epoch): hand every
+        # member the group's epoch vector so WELCOME/SHARDMAP carry it
+        epochs = [p.epoch for p in ps_list]
+        for ps in ps_list:
+            ps.shard_epochs = epochs
     # start secondaries first, primary LAST: the primary's ``ps`` rolling
     # telemetry source registration must win (last wins by design)
     for ps in reversed(ps_list):
@@ -1157,6 +1297,14 @@ def _child_main() -> int:
 
     start_telemetry_from_conf(f"ps-shard-{index}",
                               labels={"shard": str(index)})
+    # fencing epoch: the controller passes the minted epoch (base 1 +
+    # its lease-expiry fences for this slot); 0/absent defers to conf
+    # (async.fence.enabled -> 1, off -> 0).  The PS restore additionally
+    # bumps past the checkpointed epoch, so every incarnation -- even a
+    # controller-less k8s pod restart -- runs at a fresh epoch.
+    epoch_env = int(os.environ.get("ASYNC_SHARD_EPOCH") or 0)
+    epochs_env = os.environ.get("ASYNC_SHARD_EPOCHS") or ""
+    shard_epochs = json.loads(epochs_env) if epochs_env else None
     ps = ParameterServer(
         shard_cfg, hi - lo, n,
         port=int(os.environ.get("ASYNC_SHARD_BIND_PORT", "0")),
@@ -1164,6 +1312,7 @@ def _child_main() -> int:
         checkpoint_path=os.environ.get("ASYNC_SHARD_CKPT") or None,
         supervisor=sup,
         shard_map=smap_wire, shard_index=index,
+        epoch=epoch_env or None, shard_epochs=shard_epochs or None,
     ).start()
     print(json.dumps({"port": ps.port, "shard": index,
                       "resumed_from": ps.resumed_from_k}), flush=True)
@@ -1175,6 +1324,8 @@ def _child_main() -> int:
         "clock": ps._clock, "max_staleness": ps.max_staleness,
         "dedup_hits": ps.dedup_hits,
         "resumed_from": ps.resumed_from_k,
+        "epoch": ps.epoch,
+        "fenced_rejects": ps.fenced_rejects,
         "accepted_by_wid": {str(w): c
                             for w, c in ps.accepted_by_wid.items()},
     }

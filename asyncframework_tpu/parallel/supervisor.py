@@ -48,15 +48,20 @@ from asyncframework_tpu.utils.clock import Clock, SystemClock
 # states a logical worker (shard slot) moves through
 UNKNOWN = "unknown"   # never heard from (counts as live for cohort sizing)
 LIVE = "live"
-DEAD = "dead"         # declared dead; shard awaiting / under adoption
+SUSPECT = "suspect"   # missed lease renewal / latency outlier; still live
+DEAD = "dead"         # lease expired / process exited; under replacement
 
 _totals_lock = threading.Lock()
 _totals: Dict[str, int] = {
-    "workers_lost": 0,     # wids declared dead (exit or silence)
+    "workers_lost": 0,     # wids declared dead (exit or lease expiry)
     "shards_adopted": 0,   # adoption orders issued to survivors
     "rejoins": 0,          # wids reclaimed by a re-registered process
     "releases": 0,         # surrogate loops told to stand down
     "ps_resumes": 0,       # ParameterServer restarts from checkpoint
+    "suspicions": 0,       # members marked SUSPECT (silence or RTT)
+    "lease_expiries": 0,   # deaths declared by lease expiry (not exit)
+    "epoch_bumps": 0,      # fencing epochs minted before replacements
+    "fenced_rejects": 0,   # stale-epoch ops servers answered REJECT_FENCED
 }
 
 
@@ -90,12 +95,33 @@ def _pid_alive(pid) -> bool:
         return True
 
 
+def proc_start_time(pid) -> Optional[float]:
+    """The process's kernel start time (``/proc/<pid>/stat`` field 22, in
+    clock ticks since boot) -- the disambiguator that makes a pid probe
+    honest: pids are recycled, and "pid N is alive" says nothing about
+    WHICH process holds it.  A member records its own start time at HELLO
+    (``pstart``); the probe treats a live pid whose start time no longer
+    matches as exited (the member died and an unrelated process reused
+    its pid).  None on platforms without /proc or on any read failure --
+    callers fall back to the bare pid probe."""
+    try:
+        with open(f"/proc/{int(pid)}/stat", "rb") as f:
+            data = f.read()
+        # comm (field 2) may contain spaces and parens: split AFTER the
+        # last ')' -- tail[0] is field 3 (state), starttime is field 22
+        tail = data.rsplit(b")", 1)[1].split()
+        return float(tail[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 class _ProcRecord:
-    __slots__ = ("token", "pid", "pid_is_local", "registered_ms",
-                 "last_contact_ms", "exited")
+    __slots__ = ("token", "pid", "pid_is_local", "pid_start",
+                 "registered_ms", "last_contact_ms", "exited")
 
     def __init__(self, token: str, now_ms: float, pid: Optional[int] = None,
-                 host: Optional[str] = None):
+                 host: Optional[str] = None,
+                 pid_start: Optional[float] = None):
         self.token = token
         self.pid = pid
         # a pid is only probeable when the peer runs on THIS host; trusting
@@ -105,9 +131,29 @@ class _ProcRecord:
             and host is not None
             and host == socket.gethostname()
         )
+        # proc start time pins WHICH process the pid names: supplied by
+        # the member itself (HELLO pstart -- it read its own /proc/self),
+        # else read here at registration (the member just contacted us,
+        # so the pid is still overwhelmingly likely to be it)
+        if pid_start is None and self.pid_is_local:
+            pid_start = proc_start_time(pid)
+        self.pid_start = pid_start
         self.registered_ms = now_ms
         self.last_contact_ms = now_ms
         self.exited = False
+
+    def pid_gone(self) -> bool:
+        """Local-pid death probe with pid-reuse protection: dead when the
+        pid is gone, OR alive-but-not-ours (start time mismatch)."""
+        if not self.pid_is_local:
+            return False
+        if not _pid_alive(self.pid):
+            return True
+        if self.pid_start is not None:
+            cur = proc_start_time(self.pid)
+            if cur is not None and cur != self.pid_start:
+                return True  # pid recycled by an unrelated process
+        return False
 
 
 class ElasticSupervisor:
@@ -122,7 +168,10 @@ class ElasticSupervisor:
 
     def __init__(self, num_workers: int, dead_after_s: float = 5.0,
                  check_interval_s: float = 0.5, boot_grace_s: float = 10.0,
-                 clock: Optional[Clock] = None, adopt: bool = True):
+                 clock: Optional[Clock] = None, adopt: bool = True,
+                 lease_s: Optional[float] = None,
+                 suspect_after_s: Optional[float] = None,
+                 fence: Optional[bool] = None):
         #: ``adopt=False`` is the serving-frontend mode
         #: (serving/frontend.py): the same HELLO registration, pid-probe +
         #: silence death detection, and rejoin revival -- but the slots
@@ -132,9 +181,34 @@ class ElasticSupervisor:
         #: bumps -- the serving plane keeps its own counters).
         self._adopt = bool(adopt)
         self.num_workers = int(num_workers)
+        # the membership LEASE: granted at register (HELLO), renewed by
+        # any op (touch).  ``lease_s`` names what ``dead_after_s`` always
+        # was -- silence past it expires the lease and declares death;
+        # when given it overrides dead_after_s outright.
+        if lease_s is not None and float(lease_s) > 0:
+            dead_after_s = float(lease_s)
         self.dead_after_ms = float(dead_after_s) * 1e3
+        self.lease_ms = self.dead_after_ms
+        # the SUSPECT threshold: silence past this (default: half the
+        # lease) marks the member suspected -- surfaced in membership and
+        # routing, but no replacement is launched until the lease itself
+        # expires.  A partitioned-but-alive member spends the partition
+        # here instead of being double-served by a hasty replacement.
+        self.suspect_after_ms = (
+            float(suspect_after_s) * 1e3
+            if suspect_after_s is not None and float(suspect_after_s) > 0
+            else self.dead_after_ms / 2.0
+        )
         self.check_interval_s = float(check_interval_s)
         self.boot_grace_ms = float(boot_grace_s) * 1e3
+        # epoch fencing gate: epochs are only MINTED (and counted) when
+        # fencing is on -- a fence-off run must not report fencing
+        # activity its wire never carried.  None = conf-derived.
+        if fence is None:
+            from asyncframework_tpu.conf import FENCE_ENABLED, global_conf
+
+            fence = bool(global_conf().get(FENCE_ENABLED))
+        self.fence = bool(fence)
         self._clock = clock or SystemClock()
         self._lock = threading.Lock()
         self._t0 = self._clock.now_ms()
@@ -154,10 +228,22 @@ class ElasticSupervisor:
         # shard_factory keeps failing, or a classic client that ignores
         # orders, must not strand the shard forever)
         self._pending: Dict[str, Dict[int, float]] = {}
+        # fencing epochs, one per slot: bumped BEFORE any replacement is
+        # launched for a dead member, so the replacement's minted epoch
+        # strictly dominates anything the deposed incarnation ever
+        # stamped (parallel/ps_dcn.py REJECT_FENCED admission)
+        self._epochs: Dict[int, int] = {}
+        # latency suspicion overlay (net/health.py feeds it): advisory --
+        # an RTT-suspect member keeps renewing its lease, so it is never
+        # killed on latency alone, but membership/routing see SUSPECT
+        self._rtt_suspect: Dict[int, str] = {}
         self.workers_lost = 0
         self.shards_adopted = 0
         self.rejoins = 0
         self.releases = 0
+        self.suspicions = 0
+        self.lease_expiries = 0
+        self.leases_granted = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # set when the run completes: membership is frozen -- workers
@@ -172,6 +258,8 @@ class ElasticSupervisor:
             ELASTIC_BOOT_GRACE_S,
             ELASTIC_CHECK_INTERVAL_S,
             ELASTIC_DEAD_AFTER_S,
+            LEASE_S,
+            SUSPECT_AFTER_S,
             global_conf,
         )
 
@@ -181,6 +269,8 @@ class ElasticSupervisor:
             dead_after_s=conf.get(ELASTIC_DEAD_AFTER_S),
             check_interval_s=conf.get(ELASTIC_CHECK_INTERVAL_S),
             boot_grace_s=conf.get(ELASTIC_BOOT_GRACE_S),
+            lease_s=conf.get(LEASE_S) or None,
+            suspect_after_s=conf.get(SUSPECT_AFTER_S) or None,
         )
 
     # -------------------------------------------------------------- lifecycle
@@ -202,14 +292,19 @@ class ElasticSupervisor:
 
     # ------------------------------------------------------------ membership
     def register(self, proc: str, wids: Sequence[int],
-                 pid: Optional[int] = None, host: Optional[str] = None
-                 ) -> None:
-        """HELLO: ``proc`` claims ``wids``.  A claim over a wid someone
-        else currently serves is a REJOIN -- the old server's surrogate
-        loop is deposed (it learns via RELEASED on its next pull)."""
+                 pid: Optional[int] = None, host: Optional[str] = None,
+                 pid_start: Optional[float] = None) -> None:
+        """HELLO: ``proc`` claims ``wids`` and is GRANTED a lease (renewed
+        by any op via :meth:`touch`; expiry past ``lease_s`` of silence
+        declares death).  A claim over a wid someone else currently
+        serves is a REJOIN -- the old server's surrogate loop is deposed
+        (it learns via RELEASED on its next pull).  ``pid_start`` is the
+        member's own /proc start time (pid-reuse protection)."""
         now = self._clock.now_ms()
         with self._lock:
-            self._procs[proc] = _ProcRecord(proc, now, pid=pid, host=host)
+            self._procs[proc] = _ProcRecord(proc, now, pid=pid, host=host,
+                                            pid_start=pid_start)
+            self.leases_granted += 1
             for wid in wids:
                 wid = int(wid)
                 if wid not in self._owner:
@@ -236,7 +331,10 @@ class ElasticSupervisor:
                     pend.pop(wid, None)
 
     def touch(self, wid: int, proc: Optional[str] = None) -> None:
-        """Contact from ``proc`` serving ``wid`` (every PULL/PUSH)."""
+        """Contact from ``proc`` serving ``wid`` (every PULL/PUSH): the
+        lease renewal.  Clears silence-suspicion (the member answered);
+        latency suspicion (:meth:`suspect`) survives contact by design --
+        a gray member's whole signature is that it keeps answering."""
         now = self._clock.now_ms()
         with self._lock:
             if wid in self._state:
@@ -278,7 +376,7 @@ class ElasticSupervisor:
             owner_dead = (
                 rec is None
                 or rec.exited
-                or (rec.pid_is_local and not _pid_alive(rec.pid))
+                or rec.pid_gone()
                 or now - max(rec.last_contact_ms, rec.registered_ms)
                 > self.dead_after_ms
             )
@@ -306,6 +404,49 @@ class ElasticSupervisor:
             pend = self._pending.get(proc)
             if pend is not None:
                 pend.pop(wid, None)
+
+    # ------------------------------------------------------------- suspicion
+    def suspect(self, wid: int, reason: str = "rtt") -> None:
+        """External suspicion input (gray-failure detection,
+        net/health.py): mark ``wid`` SUSPECT without touching its lease.
+        Advisory -- routing demotes it, membership surfaces it, but only
+        lease expiry or process exit escalates to DEAD."""
+        with self._lock:
+            if wid not in self._state or self._state.get(wid) == DEAD:
+                return
+            if wid not in self._rtt_suspect:
+                self._rtt_suspect[wid] = str(reason)
+                self.suspicions += 1
+                if self._adopt:
+                    bump_total("suspicions")
+
+    def unsuspect(self, wid: int) -> None:
+        """The latency normalized: clear the external suspicion."""
+        with self._lock:
+            self._rtt_suspect.pop(wid, None)
+
+    def state_of(self, wid: int) -> str:
+        """The slot's effective state: DEAD dominates, then any
+        suspicion (silence-based or latency-based), then the base
+        state."""
+        with self._lock:
+            return self._state_of_locked(wid)
+
+    def _state_of_locked(self, wid: int) -> str:
+        base = self._state.get(wid, UNKNOWN)
+        if base == DEAD:
+            return DEAD
+        if wid in self._rtt_suspect:
+            return SUSPECT
+        return base
+
+    # ---------------------------------------------------------------- epochs
+    def epoch_of(self, wid: int) -> int:
+        """Fencing-epoch bumps minted for this slot (0 = never fenced).
+        A replacement for slot ``wid`` runs at base_epoch + epoch_of(wid);
+        see parallel/shardgroup.py / parallel/ps_dcn.py."""
+        with self._lock:
+            return self._epochs.get(int(wid), 0)
 
     def _live_procs_locked(self, now: float) -> List[str]:
         return [
@@ -346,15 +487,21 @@ class ElasticSupervisor:
             return []
         now = self._clock.now_ms()
         newly_dead: List[int] = []
+        expired: List[int] = []
         with self._lock:
-            # 1. process-exit detection (local pids only): immediate death,
-            # no silence window
+            # 1. process-exit detection (local pids only): immediate
+            # death, no silence window.  pid_gone() also catches a
+            # recycled pid -- alive, but not the process that registered.
             for rec in self._procs.values():
-                if (not rec.exited and rec.pid_is_local
-                        and not _pid_alive(rec.pid)):
+                if not rec.exited and rec.pid_gone():
                     rec.exited = True
             live_procs = self._live_procs_locked(now)
-            # 2. per-worker death: owner exited, or silence past the bound
+            # 2. per-worker death: owner exited, or the LEASE expired
+            # (silence past the bound).  Silence past the suspect
+            # threshold but inside the lease marks SUSPECT -- surfaced,
+            # demoted in routing, but no replacement yet: a partitioned
+            # member that heals inside its lease rejoins with nothing to
+            # undo.
             for wid in range(self.num_workers):
                 if self._state[wid] == DEAD:
                     continue
@@ -368,6 +515,14 @@ class ElasticSupervisor:
                     exited = rec is not None and rec.exited
                     if exited or now - base > self.dead_after_ms:
                         newly_dead.append(wid)
+                        if not exited:
+                            expired.append(wid)
+                    elif (self._state[wid] == LIVE
+                          and now - base > self.suspect_after_ms):
+                        self._state[wid] = SUSPECT
+                        self.suspicions += 1
+                        if self._adopt:
+                            bump_total("suspicions")
                 else:
                     # unclaimed slot: nobody ever served this shard.  After
                     # the boot grace (and once there IS someone to adopt
@@ -380,9 +535,25 @@ class ElasticSupervisor:
                         newly_dead.append(wid)
             for wid in newly_dead:
                 self._state[wid] = DEAD
+                self._rtt_suspect.pop(wid, None)
                 self.workers_lost += 1
+                if self.fence:
+                    # mint the fencing epoch BEFORE any replacement
+                    # exists: whatever the deposed incarnation stamped
+                    # is now, by construction, a stale epoch its
+                    # successor's admission rejects (REJECT_FENCED).
+                    # The process-global epoch_bumps COUNTER is bumped
+                    # only where a minted epoch actually reaches the
+                    # wire (shardgroup.ShardGroup's fenced relaunch) --
+                    # worker/replica slots keep their ledger here in
+                    # membership() without inflating the metric.
+                    self._epochs[wid] = self._epochs.get(wid, 0) + 1
+                if wid in expired:
+                    self.lease_expiries += 1
                 if self._adopt:
                     bump_total("workers_lost")
+                    if wid in expired:
+                        bump_total("lease_expiries")
             # 3. (re-)plan adoption for every dead wid lacking a live,
             # FRESH pending adopter -- covers adopters that died
             # mid-adoption AND adopters that never act on an order (a
@@ -425,20 +596,30 @@ class ElasticSupervisor:
                 "shards_adopted": self.shards_adopted,
                 "rejoins": self.rejoins,
                 "releases": self.releases,
+                "suspicions": self.suspicions,
+                "lease_expiries": self.lease_expiries,
+                "leases_granted": self.leases_granted,
             }
 
     def membership(self) -> Dict[int, Dict]:
-        """Per-worker view for the PS's wait_done diagnostic."""
+        """Per-worker view for the PS's wait_done diagnostic: effective
+        state (suspicion overlaid), owner, silence, remaining lease, and
+        the slot's fencing epoch."""
         now = self._clock.now_ms()
         with self._lock:
             out = {}
             for wid in range(self.num_workers):
                 contact = self._contact_ms.get(wid)
                 out[wid] = {
-                    "state": self._state[wid],
+                    "state": self._state_of_locked(wid),
                     "owner": self._owner.get(wid),
                     "silence_ms": (
                         None if contact is None else round(now - contact, 1)
                     ),
+                    "lease_left_ms": (
+                        None if contact is None
+                        else round(self.lease_ms - (now - contact), 1)
+                    ),
+                    "epoch": self._epochs.get(wid, 0),
                 }
             return out

@@ -32,8 +32,13 @@ import numpy as np
 
 from asyncframework_tpu.net import RetryPolicy
 from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.net.health import RttSuspector
 from asyncframework_tpu.net.retry import breaker_for
-from asyncframework_tpu.parallel.supervisor import DEAD, ElasticSupervisor
+from asyncframework_tpu.parallel.supervisor import (
+    DEAD,
+    SUSPECT,
+    ElasticSupervisor,
+)
 from asyncframework_tpu.serving import metrics as smetrics
 from asyncframework_tpu.serving.server import FramedServer
 
@@ -173,6 +178,12 @@ class ServingFrontend(FramedServer):
         self._channels: List[_ReplicaChannel] = []
         self._by_endpoint: Dict[str, int] = {}
         self._rr = 0
+        # gray-failure detection: every answered predict's round trip
+        # feeds a cohort RTT suspector; a replica that answers at a
+        # multiple of its peers is SUSPECT -- demoted to the back of the
+        # rotation (with the dead and breaker-open), never evicted on
+        # latency alone
+        self._gray = RttSuspector()
         for host, port in (replicas or ()):
             self.add_replica(host, port)
 
@@ -180,7 +191,8 @@ class ServingFrontend(FramedServer):
     def add_replica(self, host: str, port: int,
                     proc: Optional[str] = None,
                     pid: Optional[int] = None,
-                    hostname: Optional[str] = None) -> int:
+                    hostname: Optional[str] = None,
+                    pid_start: Optional[float] = None) -> int:
         """Register (or revive) a replica; returns its slot index.  The
         proc token defaults to the endpoint, so a restarted replica on
         the same address re-HELLOs into its old slot."""
@@ -221,7 +233,8 @@ class ServingFrontend(FramedServer):
                 smetrics.bump("replicas_registered")
             else:
                 self._channels[idx].proc = proc
-        self.supervisor.register(proc, [idx], pid=pid, host=hostname)
+        self.supervisor.register(proc, [idx], pid=pid, host=hostname,
+                                 pid_start=pid_start)
         return idx
 
     def replica_count(self) -> int:
@@ -240,9 +253,12 @@ class ServingFrontend(FramedServer):
     # -------------------------------------------------------------- routing
     def _rotation(self) -> List[_ReplicaChannel]:
         """Live replicas in round-robin order for ONE request: start
-        rotates per call; supervisor-dead and breaker-open slots sort to
-        the back (still tried last -- a half-open probe is how a breaker
-        closes and a revived replica is how a dead slot comes back)."""
+        rotates per call; supervisor-dead, SUSPECT (silence past the
+        suspect threshold, or a gray-failure RTT outlier), and
+        breaker-open slots sort to the back (still tried last -- a
+        half-open probe is how a breaker closes, a revived replica is
+        how a dead slot comes back, and a suspect that answers fast
+        again un-suspects itself)."""
         member = self.supervisor.membership()
         with self._lock:
             n = len(self._channels)
@@ -254,9 +270,24 @@ class ServingFrontend(FramedServer):
         preferred, backoff = [], []
         for ch in order:
             slot = self._by_endpoint.get(ch.endpoint, 0)
-            dead = member.get(slot, {}).get("state") == DEAD
+            state = member.get(slot, {}).get("state")
+            if state == DEAD:
+                # a corpse's frozen RTT EWMA must leave the cohort, or
+                # it skews every later suspicion median; a revived
+                # replica re-learns from scratch
+                self._gray.forget(ch.endpoint)
+            if state == SUSPECT and not self._gray.is_suspect(ch.endpoint):
+                # the RTT suspicion expired (demotion starved the slot of
+                # the traffic that would clear it -- the suspector's TTL
+                # is the recovery path), or the suspicion was silence-
+                # based, which a demoted replica can also never clear
+                # (only predicts touch it): restore the slot to the
+                # rotation and let it re-earn its verdict live
+                self.supervisor.unsuspect(slot)
+                state = None
             tripped = breaker_for(ch.endpoint).open
-            (backoff if dead or tripped else preferred).append(ch)
+            (backoff if state in (DEAD, SUSPECT) or tripped
+             else preferred).append(ch)
         return preferred + backoff
 
     def predict(self, X) -> np.ndarray:
@@ -314,6 +345,12 @@ class ServingFrontend(FramedServer):
                 if slot is not None:
                     self.supervisor.touch(slot, ch.proc)
                 dur_ms = (time.monotonic() - t0) * 1e3
+                if slot is not None:
+                    # gray-failure feed: this answered RTT vs the cohort
+                    if self._gray.observe(ch.endpoint, dur_ms):
+                        self.supervisor.suspect(slot)
+                    else:
+                        self.supervisor.unsuspect(slot)
                 meta = {
                     "endpoint": ch.endpoint,
                     "ts": int(hdr.get("ts", 0)),
@@ -368,6 +405,7 @@ class ServingFrontend(FramedServer):
                     proc=str(header.get("proc")),
                     pid=header.get("pid"),
                     hostname=header.get("host"),
+                    pid_start=header.get("pstart"),
                 )
             except ValueError as e:
                 _send_msg(conn, {"op": "ERR", "msg": str(e)[:200]})
@@ -389,6 +427,7 @@ class ServingFrontend(FramedServer):
                 "op": "STATUS",
                 "replicas": self.membership(),
                 "serving": smetrics.serving_snapshot(),
+                "rtt": self._gray.snapshot(),
             })
         else:
             return False

@@ -163,12 +163,18 @@ class ModelReplica(FramedServer):
             # keeps it degrade-to-full, never wrong).
             from asyncframework_tpu.parallel import shardgroup as _sg
 
-            smap = _sg.fetch_shard_map(self.ps_host, self.ps_port)
+            smap, epochs, epoch = _sg.fetch_group_info(
+                self.ps_host, self.ps_port
+            )
+            # fencing epochs ride the same handshake: a fenced (zombie)
+            # shard answers the subscriber's stamped reads REJECT_FENCED
+            # instead of serving a range it no longer owns, and the
+            # subscriber self-heals onto the replacement's epoch
             if smap is not None:
-                self._client = _sg.ShardedSubscriber(smap)
+                self._client = _sg.ShardedSubscriber(smap, epochs=epochs)
             else:
                 self._client = PSClient(self.ps_host, self.ps_port,
-                                        pull_mode="delta")
+                                        pull_mode="delta", epoch=epoch)
         return self._client
 
     def _sharded(self):
@@ -415,13 +421,24 @@ def serve_replica(ps: str, rid: int = 0, host: str = "0.0.0.0",
         fh, fp = frontend.rsplit(":", 1)
 
         def hello_once() -> None:
+            from asyncframework_tpu.parallel.supervisor import (
+                proc_start_time,
+            )
+
             sock = _frame.connect((fh, int(fp)), timeout=5.0)
             try:
-                _send_msg(sock, {"op": "HELLO",
-                                 "proc": f"replica-{os.getpid()}",
-                                 "replica": True, "port": rep.port,
-                                 "host": socket.gethostname(),
-                                 "pid": os.getpid(), "rid": rid})
+                hdr = {"op": "HELLO",
+                       "proc": f"replica-{os.getpid()}",
+                       "replica": True, "port": rep.port,
+                       "host": socket.gethostname(),
+                       "pid": os.getpid(), "rid": rid}
+                pstart = proc_start_time(os.getpid())
+                if pstart is not None:
+                    # pid-reuse protection for the frontend's local pid
+                    # probe: WHICH process holds this pid, not just that
+                    # one does
+                    hdr["pstart"] = pstart
+                _send_msg(sock, hdr)
                 _recv_msg(sock)
             finally:
                 sock.close()

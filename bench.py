@@ -73,6 +73,14 @@ CHILD_WATCHDOG_S = 420.0     # child hard-kill (dead device link wedges C code)
 CHILD_TIMEOUT_S = 480.0      # parent's per-child subprocess timeout
 PROBE_TIMEOUT_S = 75.0       # cheap backend-liveness probe (first init 20-45s)
 PROBE_ATTEMPTS = 2
+# hard bound on the WHOLE probe (all attempts + child reaping): the probe
+# exists to detect a dead TPU tunnel, so the probe itself must be
+# un-wedgeable -- subprocess timeouts alone are not enough (a killed child
+# whose grandchild still holds the pipe can block the post-kill reap
+# forever; reaping is pushed to a daemon thread and this deadline caps
+# everything else)
+PROBE_BUDGET_S = float(os.environ.get("BENCH_PROBE_BUDGET_S",
+                                      2 * PROBE_TIMEOUT_S + 15))
 TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 2400.0))
 REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
 # per-arm watchdog: total wall one config may burn across its repeats
@@ -925,28 +933,60 @@ def run_probe() -> None:
 _PROBE_FAILURES: dict = {}
 
 
+def _reap_detached(proc: subprocess.Popen) -> None:
+    """Reap a killed probe child WITHOUT ever blocking the parent: the
+    post-kill communicate() can hang forever when a grandchild inherited
+    the pipe fds (the exact wedge the probe exists to detect), so it
+    runs on a throwaway daemon thread."""
+    def reap():
+        try:
+            proc.communicate(timeout=10)
+        except Exception:  # noqa: BLE001 - best-effort cleanup only
+            pass
+
+    threading.Thread(target=reap, daemon=True).start()
+
+
 def probe_backend(env: dict) -> Tuple[bool, str]:
-    """Run the probe subprocess with a hard timeout, bounded retries.
-    Returns (alive, note); a failure is memoized per platform."""
+    """Run the probe subprocess with a hard per-attempt timeout, bounded
+    retries, AND a hard bound on the whole probe (BENCH_PROBE_BUDGET_S):
+    whatever a dead device link does to the children, the probe itself
+    returns within the budget.  Returns (alive, note); a failure is
+    memoized per platform."""
     platform = env.get("BENCH_PLATFORM") or "default"
     cached = _PROBE_FAILURES.get(platform)
     if cached is not None:
         print(f"# backend probe: cached failure for platform "
               f"{platform!r} -- {cached[1]}", file=sys.stderr)
         return cached
+    deadline = time.monotonic() + PROBE_BUDGET_S
+    attempts_run = 0
     for attempt in range(1, PROBE_ATTEMPTS + 1):
+        left = deadline - time.monotonic()
+        if left <= 1.0:
+            print(f"# backend probe: budget {PROBE_BUDGET_S:.0f}s "
+                  f"exhausted after {attempts_run} attempt(s)",
+                  file=sys.stderr)
+            break
+        attempts_run = attempt
         t0 = time.monotonic()
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
         try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--probe"],
-                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-                env=env,
+            out_s, err_s = proc.communicate(
+                timeout=min(PROBE_TIMEOUT_S, left)
             )
         except subprocess.TimeoutExpired:
+            proc.kill()
+            _reap_detached(proc)
             print(f"# backend probe {attempt}/{PROBE_ATTEMPTS}: hung past "
-                  f"{PROBE_TIMEOUT_S:.0f}s (dead device link)", file=sys.stderr)
+                  f"{min(PROBE_TIMEOUT_S, left):.0f}s (dead device link)",
+                  file=sys.stderr)
             continue
-        line = next((l for l in reversed(out.stdout.splitlines())
+        line = next((l for l in reversed(out_s.splitlines())
                      if l.startswith("{")), None)
         if line is not None and json.loads(line).get("probe"):
             rec = json.loads(line)
@@ -956,11 +996,11 @@ def probe_backend(env: dict) -> Tuple[bool, str]:
                   f"({time.monotonic() - t0:.0f}s)", file=sys.stderr)
             return True, note
         print(f"# backend probe {attempt}/{PROBE_ATTEMPTS}: rc="
-              f"{out.returncode} stderr tail: {out.stderr[-300:]}",
+              f"{proc.returncode} stderr tail: {err_s[-300:]}",
               file=sys.stderr)
     failed = (False,
-              f"backend unavailable: {PROBE_ATTEMPTS} probe attempts "
-              f"failed/hung within {PROBE_TIMEOUT_S:.0f}s each")
+              f"backend unavailable: {attempts_run} probe attempts "
+              f"failed/hung inside the {PROBE_BUDGET_S:.0f}s budget")
     _PROBE_FAILURES[platform] = failed
     return failed
 

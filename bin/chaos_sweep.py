@@ -83,6 +83,15 @@ def run_seed(seed: int, args) -> dict:
     env = dict(os.environ)
     env["ASYNC_CHAOS_SEED"] = str(seed)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    if getattr(args, "net_profile", "none") != "none":
+        # net-profile preset (net/faults.py wan_profile_schedule): suites
+        # that OPT IN (tests/test_fencing.py today) merge the profile's
+        # delay/jitter/loss events into their schedules via
+        # profile_schedule_from_env + merge_schedules.  Byte-identical-
+        # replay suites (test_chaos.py, test_dataplane.py paths) stay on
+        # their exact schedules by design -- a merged profile would
+        # break the very determinism they assert.
+        env["ASYNC_CHAOS_NET_PROFILE"] = args.net_profile
     # debug lock watchdog on for every sweep seed: any socket send/recv
     # under the PS model lock fails the seed loudly (the lock-free PULL
     # serving claim is re-checked on every fault interleaving)
@@ -96,11 +105,15 @@ def run_seed(seed: int, args) -> dict:
     # shard-group chaos rides every seed: kill -9 one PS shard of 3 mid-run
     # (real OS processes), recovery from the durable checkpoint, exactly-
     # once across the restart (tests/test_shardgroup.py, seeded kill timing)
+    # partition/fencing chaos rides every seed too: partition (not kill) a
+    # shard past lease expiry, epoch-fenced relaunch, stale-epoch pushes
+    # REJECT_FENCED, run completes (tests/test_fencing.py, seeded timing)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
         "tests/test_telemetry.py", "tests/test_shardgroup.py",
-        "-q", "-m", f"({marker}) or serve or telemetry or shard",
+        "tests/test_fencing.py",
+        "-q", "-m", f"({marker}) or serve or telemetry or shard or fence",
         "-p", "no:cacheprovider",
     ]
     if args.soak:
@@ -145,6 +158,14 @@ def main() -> int:
                     help="include the slow kill -9 soak tests")
     ap.add_argument("-k", dest="keyword", default=None,
                     help="pytest -k expression forwarded to each run")
+    ap.add_argument("--net-profile", choices=["none", "wan"],
+                    default="none",
+                    help="overlay a net profile on the schedules of "
+                         "suites that opt in (the fencing/partition "
+                         "suite today; exact-replay suites keep their "
+                         "pinned schedules): 'wan' = 15ms+jitter per op "
+                         "plus seeded reply drops / mid-frame cuts "
+                         "(net/faults.py wan_profile_schedule)")
     ap.add_argument("--timeout", type=float, default=1800.0,
                     help="per-seed timeout in seconds (default 1800)")
     ap.add_argument("--show-failures", action="store_true",

@@ -1,0 +1,873 @@
+"""Partition-tolerant membership: leases, epoch fencing, gray failures
+(ISSUE 9).
+
+The correctness spine:
+
+- **partitions and delays are first-class faults** (net/faults.py): a
+  scheduled ``PartitionEvent`` blackholes an endpoint set bidirectionally
+  at the frame choke point and heals on schedule (or explicitly); a
+  ``delay`` event adds seeded latency while letting ops through -- the
+  slow-but-alive gray member;
+- **leases, not pid probes, decide death**: silence past the suspect
+  threshold marks SUSPECT (no replacement!), only lease expiry (or
+  verified process exit) escalates to DEAD, and the pid probe checks the
+  process START TIME so a recycled pid can never impersonate a member;
+- **epoch fencing makes replacements safe**: every incarnation runs at a
+  minted monotonic epoch (checkpoint-persisted, controller-passed),
+  clients stamp it on every PULL/PUSH/SUBSCRIBE, and a server answers
+  stale-epoch ops REJECT_FENCED -- a deposed client self-heals by
+  adopting the minted epoch, while a zombie server (one that has seen a
+  successor's epoch) refuses everything.  Fencing OFF is the
+  byte-identical legacy wire: no ``ep`` keys anywhere;
+- **the acceptance run** (``fence`` marker, rides every
+  bin/chaos_sweep.py seed): a 3-shard group of REAL OS processes is
+  PARTITIONED (not killed) from its controller past lease expiry; the
+  controller suspects, expires the lease, fences the epoch, and
+  relaunches the range; stale-epoch pushes are rejected REJECT_FENCED
+  (counted), and the run completes with full coverage and a decreasing
+  loss trajectory.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.conf import AsyncConf, set_global_conf
+from asyncframework_tpu.net import faults
+from asyncframework_tpu.net import frame
+from asyncframework_tpu.net import health
+from asyncframework_tpu.net import reset_net_totals
+from asyncframework_tpu.net.retry import (
+    RetryError,
+    RetryPolicy,
+    remaining_deadline_s,
+    reset_breakers,
+)
+from asyncframework_tpu.parallel import ps_dcn
+from asyncframework_tpu.parallel import shardgroup as sg
+from asyncframework_tpu.parallel import supervisor as sup_mod
+from asyncframework_tpu.solvers import SolverConfig
+from asyncframework_tpu.utils.clock import ManualClock
+
+pytestmark = pytest.mark.fence
+
+CHILD = Path(__file__).parent / "ps_dcn_child.py"
+CHAOS_SEED = int(os.environ.get("ASYNC_CHAOS_SEED", "7"))
+
+
+def make_cfg(**kw):
+    defaults = dict(
+        num_workers=2, num_iterations=60, gamma=0.5, taw=2**31 - 1,
+        batch_rate=0.3, bucket_ratio=0.0, printer_freq=20, seed=42,
+        calibration_iters=5, run_timeout_s=60.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Injectors, breakers, counters, and the global conf are
+    process-global; fencing tests must neither inherit nor leak them."""
+    faults.clear()
+    reset_net_totals()
+    reset_breakers()
+    sg.reset_shard_totals()
+    sup_mod.reset_recovery_totals()
+    health.reset_gray_totals()
+    set_global_conf(AsyncConf())
+    yield
+    faults.clear()
+    reset_net_totals()
+    reset_breakers()
+    sg.reset_shard_totals()
+    sup_mod.reset_recovery_totals()
+    health.reset_gray_totals()
+    set_global_conf(None)
+
+
+def _snappy_retry(**kw):
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("base_ms", 5.0)
+    kw.setdefault("max_ms", 20.0)
+    kw.setdefault("attempt_timeout_s", 2.0)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------- partition/delay faults
+class TestPartitionDelayFaults:
+    def test_schedule_json_round_trip(self):
+        s = faults.FaultSchedule(seed=11)
+        s.add("*:70", "PUSH", 2, faults.DROP_REPLY)
+        s.add_delay("h:1", "PULL|SUBSCRIBE", 25.0, jitter_ms=10.0,
+                    nth=3, count=0)
+        s.add_partition(["*:70", "h:2"], start_s=0.5, duration_s=2.0)
+        s2 = faults.FaultSchedule.from_json(s.to_json())
+        assert s2.seed == 11
+        assert len(s2.events) == 2 and len(s2.partitions) == 1
+        d = s2.events[1]
+        assert d.kind == faults.DELAY and d.delay_ms == 25.0
+        assert d.jitter_ms == 10.0 and d.nth == 3 and d.count == 0
+        p = s2.partitions[0]
+        assert p.endpoints == ["*:70", "h:2"]
+        assert p.start_s == 0.5 and p.duration_s == 2.0
+        # legacy schedules (no partitions key, no delay fields) still load
+        legacy = faults.FaultSchedule.from_json(
+            '{"seed": 1, "events": [{"endpoint": "*", "op": "PULL", '
+            '"nth": 1, "kind": "drop_reply"}]}'
+        )
+        assert len(legacy.events) == 1 and not legacy.partitions
+
+    def test_partition_blackholes_until_healed(self):
+        cfg = make_cfg()
+        ps = ps_dcn.ParameterServer(cfg, 6, 64, port=0).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                 retry=_snappy_retry())
+            assert cl.pull(0) is not None  # healthy before the cut
+            sched = faults.FaultSchedule(seed=CHAOS_SEED)
+            sched.add_partition([f"*:{ps.port}"])  # until healed
+            inj = faults.install(faults.FaultInjector(sched))
+            with pytest.raises((ConnectionError, OSError)):
+                cl.pull(0)
+            assert any(f["kind"] == faults.PARTITION for f in inj.fired)
+            inj.heal_partitions()
+            reset_breakers()  # the storm tripped the endpoint breaker
+            got = cl.pull(0)
+            assert got is not None, "healed partition must serve again"
+            cl.bye()
+        finally:
+            faults.clear()
+            ps.stop()
+
+    def test_partition_heals_on_schedule(self):
+        cfg = make_cfg()
+        ps = ps_dcn.ParameterServer(cfg, 6, 64, port=0).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port,
+                                 retry=_snappy_retry())
+            sched = faults.FaultSchedule(seed=CHAOS_SEED)
+            sched.add_partition([f"*:{ps.port}"], start_s=0.0,
+                                duration_s=0.5)
+            inj = faults.install(faults.FaultInjector(sched))
+            assert inj.partition_active(f"127.0.0.1:{ps.port}")
+            with pytest.raises((ConnectionError, OSError)):
+                cl.pull(0)
+            time.sleep(0.6)
+            assert not inj.partition_active(f"127.0.0.1:{ps.port}")
+            reset_breakers()
+            assert cl.pull(0) is not None
+            cl.bye()
+        finally:
+            faults.clear()
+            ps.stop()
+
+    def test_delay_fault_adds_latency_and_lets_op_through(self):
+        cfg = make_cfg()
+        ps = ps_dcn.ParameterServer(cfg, 6, 64, port=0).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port)
+            t0 = time.monotonic()
+            assert cl.pull(0) is not None
+            base = time.monotonic() - t0
+            sched = faults.FaultSchedule(seed=CHAOS_SEED)
+            sched.add_delay(f"*:{ps.port}", "PULL", 80.0, count=0)
+            faults.install(faults.FaultInjector(sched))
+            t0 = time.monotonic()
+            assert cl.pull(0) is not None  # delayed, not dropped
+            delayed = time.monotonic() - t0
+            assert delayed >= base + 0.06, (base, delayed)
+            assert faults.faults_fired_total() >= 1
+            cl.bye()
+        finally:
+            faults.clear()
+            ps.stop()
+
+    def test_delay_jitter_is_seeded_deterministic(self):
+        def draws(seed):
+            s = faults.FaultSchedule(seed=seed)
+            s.add_delay("*", "*", 1.0, jitter_ms=50.0, count=0)
+            inj = faults.FaultInjector(s)
+            return [inj.delay_for("e:1", "PULL") for _ in range(5)]
+
+        assert draws(3) == draws(3)
+        assert draws(3) != draws(4)
+
+    def test_wan_profile_and_merge(self):
+        wan = faults.wan_profile_schedule(9)
+        assert wan.to_json() == faults.wan_profile_schedule(9).to_json()
+        assert wan.to_json() != faults.wan_profile_schedule(10).to_json()
+        assert any(e.kind == faults.DELAY for e in wan.events)
+        assert any(e.kind == faults.DROP_REPLY for e in wan.events)
+        base = faults.FaultSchedule(seed=1).add("*", "PULL", 1,
+                                                faults.DROP_REPLY)
+        merged = faults.merge_schedules(base, wan)
+        assert len(merged.events) == 1 + len(wan.events)
+        assert merged.seed == 1
+        assert faults.merge_schedules(base, None) is base
+        # env-driven selection (bin/chaos_sweep.py --net-profile)
+        os.environ["ASYNC_CHAOS_NET_PROFILE"] = "wan"
+        try:
+            prof = faults.profile_schedule_from_env(9)
+            assert prof is not None and prof.to_json() == wan.to_json()
+        finally:
+            del os.environ["ASYNC_CHAOS_NET_PROFILE"]
+        assert faults.profile_schedule_from_env(9) is None
+
+
+# ------------------------------------------------------- leases + suspicion
+class TestLeaseSuspicion:
+    def _sup(self, **kw):
+        kw.setdefault("dead_after_s", 10.0)
+        # fence on: epochs are only minted under fencing (a fence-off
+        # run must not report fencing activity), and these tests assert
+        # the minting
+        kw.setdefault("fence", True)
+        clock = ManualClock()
+        sup = sup_mod.ElasticSupervisor(2, clock=clock, **kw)
+        return sup, clock
+
+    def test_silence_suspects_then_expires_lease_then_fences(self):
+        sup, clock = self._sup()
+        sup.register("p1", [0, 1], pid=None)
+        sup.touch(0, "p1")
+        sup.touch(1, "p1")
+        # inside the suspect window: live
+        clock.advance(4_000)
+        assert sup.check_once() == []
+        assert sup.membership()[0]["state"] == sup_mod.LIVE
+        # past suspect threshold (half the lease), inside the lease:
+        # SUSPECT -- surfaced, but NO replacement yet
+        clock.advance(2_000)
+        assert sup.check_once() == []
+        m = sup.membership()[0]
+        assert m["state"] == sup_mod.SUSPECT
+        assert m["epoch"] == 0
+        assert sup.counters()["suspicions"] >= 1
+        assert sup.live_worker_count() == 2  # suspects count live
+        # contact clears silence-suspicion (the lease renewal)
+        sup.touch(0, "p1")
+        assert sup.membership()[0]["state"] == sup_mod.LIVE
+        # lease expiry: DEAD + fencing epoch minted BEFORE replacement
+        clock.advance(11_000)
+        dead = sup.check_once()
+        assert set(dead) == {0, 1}
+        m = sup.membership()[0]
+        assert m["state"] == sup_mod.DEAD
+        assert m["epoch"] == 1 and sup.epoch_of(0) == 1
+        assert sup.counters()["lease_expiries"] >= 2
+        # a second expiry episode mints a HIGHER epoch
+        sup.register("p2", [0], pid=None)
+        clock.advance(11_000)
+        sup.check_once()
+        assert sup.epoch_of(0) == 2
+
+    def test_fence_off_supervisor_mints_no_epochs(self):
+        sup, clock = self._sup(fence=False)
+        sup.register("p1", [0], pid=None)
+        sup.touch(0, "p1")
+        clock.advance(11_000)
+        assert 0 in sup.check_once()
+        assert sup.epoch_of(0) == 0
+        assert sup.membership()[0]["epoch"] == 0
+
+    def test_lease_s_overrides_dead_after(self):
+        sup, clock = self._sup(lease_s=3.0)
+        assert sup.lease_ms == 3_000.0
+        assert sup.suspect_after_ms == 1_500.0
+        sup.register("p1", [0], pid=None)
+        sup.touch(0, "p1")
+        clock.advance(3_100)
+        assert 0 in sup.check_once()
+
+    def test_rtt_suspicion_overlays_and_survives_contact(self):
+        sup, clock = self._sup()
+        sup.register("p1", [0], pid=None)
+        sup.touch(0, "p1")
+        sup.suspect(0, reason="rtt")
+        assert sup.state_of(0) == sup_mod.SUSPECT
+        # contact does NOT clear latency suspicion (a gray member's whole
+        # signature is that it keeps answering)
+        sup.touch(0, "p1")
+        assert sup.state_of(0) == sup_mod.SUSPECT
+        # suspects still count LIVE (never-contacted slots do too):
+        # suspicion demotes routing, it does not shrink cohorts
+        assert sup.live_worker_count() == 2
+        sup.unsuspect(0)
+        assert sup.state_of(0) == sup_mod.LIVE
+        # DEAD dominates any suspicion
+        sup.suspect(0)
+        clock.advance(11_000)
+        sup.check_once()
+        assert sup.state_of(0) == sup_mod.DEAD
+
+    def test_rtt_suspector_cohort_detection(self):
+        det = health.RttSuspector(factor=3.0, min_ms=1.0, alpha=0.5,
+                                  min_samples=3)
+        for _ in range(6):
+            det.observe("a:1", 10.0)
+            det.observe("b:1", 12.0)
+            sus = det.observe("c:1", 200.0)
+        assert sus and det.is_suspect("c:1")
+        assert not det.is_suspect("a:1")
+        assert health.gray_totals().get("suspicions", 0) >= 1
+        # recovery: the outlier normalizes and un-suspects itself
+        for _ in range(20):
+            det.observe("c:1", 10.0)
+        assert not det.is_suspect("c:1")
+        assert health.gray_totals().get("recoveries", 0) >= 1
+
+    def test_rtt_suspector_needs_a_cohort(self):
+        det = health.RttSuspector(factor=3.0, min_ms=1.0, min_samples=2)
+        for _ in range(10):
+            assert not det.observe("only:1", 5_000.0)
+
+
+# ------------------------------------------------- pid reuse (satellite 1)
+class TestPidReuseProbe:
+    def test_start_time_mismatch_is_exited(self):
+        """A live pid whose /proc start time no longer matches the
+        registered member's is a RECYCLED pid: the probe must report the
+        member dead, not false-alive."""
+        me = os.getpid()
+        real = sup_mod.proc_start_time(me)
+        assert real is not None
+        host = socket.gethostname()
+        honest = sup_mod._ProcRecord("p", 0.0, pid=me, host=host,
+                                     pid_start=real)
+        assert not honest.pid_gone()
+        imposter = sup_mod._ProcRecord("p", 0.0, pid=me, host=host,
+                                       pid_start=real + 12345.0)
+        assert imposter.pid_gone()
+
+    def test_supervisor_declares_recycled_pid_dead_immediately(self):
+        clock = ManualClock()
+        sup = sup_mod.ElasticSupervisor(1, dead_after_s=1e6, clock=clock)
+        sup.register("p1", [0], pid=os.getpid(),
+                     host=socket.gethostname(),
+                     pid_start=sup_mod.proc_start_time(os.getpid())
+                     + 99.0)
+        sup.touch(0, "p1")
+        clock.advance(10)  # far inside the lease: only the pid says dead
+        assert sup.check_once() == [0]
+
+    def test_registration_reads_local_start_time(self):
+        rec = sup_mod._ProcRecord("p", 0.0, pid=os.getpid(),
+                                  host=socket.gethostname())
+        assert rec.pid_start == sup_mod.proc_start_time(os.getpid())
+
+    def test_hello_carries_pstart_end_to_end(self):
+        cfg = make_cfg()
+        sup = sup_mod.ElasticSupervisor(2, dead_after_s=30.0)
+        ps = ps_dcn.ParameterServer(cfg, 6, 64, port=0,
+                                    supervisor=sup).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, proc="me")
+            cl.hello("me", [0], pid=os.getpid())
+            rec = sup._procs["me"]
+            assert rec.pid_start == sup_mod.proc_start_time(os.getpid())
+            cl.bye()
+        finally:
+            ps.stop()
+
+
+# -------------------------------------------- socket deadline (satellite 2)
+class TestSocketDeadline:
+    def test_real_stall_cannot_outlive_the_deadline(self):
+        """A server that accepts, reads the request, and never replies --
+        the real gray peer (stall_read's honest sibling).  The policy's
+        deadline must bound the call even though the per-attempt socket
+        timeout (30 s here) is far larger: the socket layer caps its
+        blocking reads to the remaining deadline."""
+        srv = socket.create_server(("127.0.0.1", 0))
+        srv.settimeout(5.0)
+        stop = threading.Event()
+
+        def stall():
+            conns = []
+            while not stop.is_set():
+                try:
+                    c, _ = srv.accept()
+                    conns.append(c)  # read nothing, reply nothing
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+            for c in conns:
+                c.close()
+
+        t = threading.Thread(target=stall, daemon=True)
+        t.start()
+        policy = RetryPolicy(max_attempts=5, base_ms=5.0, max_ms=20.0,
+                             attempt_timeout_s=30.0, deadline_s=1.0)
+        addr = srv.getsockname()
+
+        def attempt():
+            s = frame.connect(addr, timeout=30.0)
+            try:
+                frame.send_msg(s, {"op": "PULL", "wid": 0})
+                return frame.recv_msg(s)
+            finally:
+                s.close()
+
+        t0 = time.monotonic()
+        with pytest.raises((RetryError, ConnectionError, OSError)):
+            policy.call(attempt, endpoint=f"stall:{addr[1]}")
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, (
+            f"stalled read held the caller {elapsed:.1f}s past its "
+            f"1s deadline"
+        )
+        stop.set()
+        srv.close()
+
+    def test_deadline_cap_does_not_ratchet_reused_sockets(self):
+        """A cap tightens a REUSED socket's timeout to the dying call's
+        remaining deadline; the next op must re-derive from the socket's
+        RESTING timeout (restore with no deadline, min(resting, fresh
+        remaining) with one) -- not inherit the stale near-zero value."""
+        a, b = socket.socketpair()
+        try:
+            a.settimeout(30.0)
+            policy = RetryPolicy(max_attempts=1, attempt_timeout_s=30.0,
+                                 deadline_s=0.3)
+            with pytest.raises((RetryError, OSError)):
+                policy.call(lambda: frame.recv_msg(a))  # blocks -> cap
+            assert a.gettimeout() is not None and a.gettimeout() <= 0.3
+            # next op with NO deadline: resting timeout restored
+            frame._deadline_cap(a)
+            assert a.gettimeout() == 30.0
+            # next op with a FRESH deadline: min(resting, remaining),
+            # never the previous call's leftovers
+            fresh = RetryPolicy(max_attempts=1, deadline_s=10.0)
+            seen = []
+            fresh.call(lambda: seen.append(
+                (frame._deadline_cap(a), a.gettimeout())))
+            assert 0 < seen[0][1] <= 10.0
+            frame._deadline_cap(a)
+            assert a.gettimeout() == 30.0
+        finally:
+            a.close()
+            b.close()
+
+    def test_deadline_tls_is_scoped_to_the_call(self):
+        assert remaining_deadline_s() is None
+        policy = RetryPolicy(max_attempts=1, deadline_s=5.0)
+        seen = []
+        policy.call(lambda: seen.append(remaining_deadline_s()))
+        assert seen[0] is not None and 0 < seen[0] <= 5.0
+        assert remaining_deadline_s() is None
+
+    def test_no_deadline_means_no_tls(self):
+        policy = RetryPolicy(max_attempts=1)
+        seen = []
+        policy.call(lambda: seen.append(remaining_deadline_s()))
+        assert seen[0] is None
+
+
+# ----------------------------------------------------------- epoch fencing
+class TestEpochFencing:
+    def test_fence_off_is_legacy_wire_no_ep_keys(self):
+        cfg = make_cfg()
+        ps = ps_dcn.ParameterServer(cfg, 6, 64, port=0).start()
+        try:
+            assert ps.epoch == 0
+            s = frame.connect(("127.0.0.1", ps.port))
+            frame.send_msg(s, {"op": "PULL", "wid": 0})
+            hdr, _ = frame.recv_msg(s)
+            assert hdr["op"] == "MODEL" and "ep" not in hdr
+            frame.send_msg(s, {"op": "HELLO", "proc": "x", "wids": [0]})
+            hdr, _ = frame.recv_msg(s)
+            assert "epoch" not in hdr and "epochs" not in hdr
+            s.close()
+            assert ps.fenced_rejects == 0
+        finally:
+            ps.stop()
+
+    def test_conf_derives_epoch(self):
+        set_global_conf(AsyncConf({"async.fence.enabled": True}))
+        ps = ps_dcn.ParameterServer(make_cfg(), 6, 64, port=0)
+        assert ps.epoch == 1
+        ps.stop()
+        set_global_conf(AsyncConf())
+        ps2 = ps_dcn.ParameterServer(make_cfg(), 6, 64, port=0)
+        assert ps2.epoch == 0
+        ps2.stop()
+
+    def test_stale_pull_self_heals(self):
+        ps = ps_dcn.ParameterServer(make_cfg(), 6, 64, port=0,
+                                    epoch=2).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, epoch=1)
+            got = cl.pull(0)
+            assert got is not None
+            assert cl.epoch == 2 and cl.fenced_replies == 1
+            assert ps.fenced_rejects == 1
+            # welcome advertises the epoch for fresh joiners
+            welcome = cl.hello("p", [0])
+            assert welcome.get("epoch") == 2
+            cl.bye()
+        finally:
+            ps.stop()
+
+    def test_stale_push_dropped_then_healed_next_round(self):
+        ps = ps_dcn.ParameterServer(make_cfg(), 6, 64, port=0,
+                                    epoch=2).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, epoch=1)
+            acc, done = cl.push(0, 0, np.zeros(6, np.float32))
+            assert (acc, done) == (False, False)
+            assert cl.epoch == 2 and ps.fenced_rejects == 1
+            acc, _done = cl.push(0, 0, np.zeros(6, np.float32))
+            assert acc, "current-epoch push must be admitted"
+            # the fenced gradient was never merged: exactly one accept
+            assert ps.accepted == 1
+            cl.bye()
+        finally:
+            ps.stop()
+
+    def test_zombie_server_refuses_everything_stamped(self):
+        """A server that has SEEN a successor epoch is a zombie: it
+        refuses every stamped op -- even from same-epoch stragglers --
+        so it can neither mutate nor serve the range."""
+        ps = ps_dcn.ParameterServer(make_cfg(), 6, 64, port=0,
+                                    epoch=1).start()
+        try:
+            ahead = ps_dcn.PSClient("127.0.0.1", ps.port, epoch=2)
+            with pytest.raises(ps_dcn.FencedError):
+                ahead.pull(0)
+            assert ps._fenced_above == 2
+            peer = ps_dcn.PSClient("127.0.0.1", ps.port, epoch=1)
+            with pytest.raises(ps_dcn.FencedError):
+                peer.pull(0)
+            # a same-epoch PUSH is refused too; the reply names the
+            # successor epoch, so the pusher heals toward the real owner
+            pusher = ps_dcn.PSClient("127.0.0.1", ps.port, epoch=1)
+            acc, done = pusher.push(0, 0, np.zeros(6, np.float32))
+            assert (acc, done) == (False, False)
+            assert pusher.epoch == 2
+            assert ps.accepted == 0, "the zombie merged a gradient"
+            assert ps.fenced_rejects >= 3
+        finally:
+            ps.stop()
+
+    def test_fenced_push_retry_reanswers_from_dedup(self):
+        """A fenced PUSH verdict is recorded in the dedup window: a
+        retry of the same (sid, seq) re-answers REJECT_FENCED instead of
+        racing a fresh admission."""
+        ps = ps_dcn.ParameterServer(make_cfg(), 6, 64, port=0,
+                                    epoch=2).start()
+        try:
+            s = frame.connect(("127.0.0.1", ps.port))
+            hdr = {"op": "PUSH", "wid": 0, "ts": 0, "ep": 1,
+                   "sid": "abc", "seq": 1}
+            payload = np.zeros(6, np.float32).tobytes()
+            frame.send_msg(s, hdr, payload)
+            r1, _ = frame.recv_msg(s)
+            assert r1["op"] == "REJECT_FENCED" and r1["epoch"] == 2
+            frame.send_msg(s, hdr, payload)  # same stamp, retried
+            r2, _ = frame.recv_msg(s)
+            assert r2["op"] == "REJECT_FENCED"
+            assert ps.fenced_rejects == 1, "dedup answered the retry"
+            s.close()
+        finally:
+            ps.stop()
+
+    def test_whole_stale_window_drops_without_zombie_misread(self):
+        """>= 2 in-flight pushes stamped under a deposed epoch (the
+        windowed replay onto a fenced range's replacement): the FIRST
+        fence advances the client epoch, and the remaining stale entries
+        must still drop gracefully -- judged against their OWN stamps --
+        instead of misreading the healthy replacement as a zombie."""
+        ps = ps_dcn.ParameterServer(make_cfg(), 6, 64, port=0,
+                                    epoch=2).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, epoch=1)
+            cl.push_start(0, 0, np.zeros(6, np.float32))
+            cl.push_start(0, 0, np.ones(6, np.float32))
+            assert cl.push_finish() == (False, False)
+            assert cl.epoch == 2  # healed by the first fence
+            assert cl.push_finish() == (False, False)  # NOT FencedError
+            assert ps.fenced_rejects == 2 and ps.accepted == 0
+            # and the healed client's next windowed push is admitted
+            cl.push_start(0, 0, np.zeros(6, np.float32))
+            acc, _done = cl.push_finish()
+            assert acc
+            cl.bye()
+        finally:
+            ps.stop()
+
+    def test_subscribe_is_fenced_and_heals(self):
+        ps = ps_dcn.ParameterServer(make_cfg(), 6, 64, port=0,
+                                    epoch=3).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, epoch=1,
+                                 pull_mode="delta")
+            got = cl.subscribe(0)
+            assert got is not None and cl.epoch == 3
+            assert ps.fenced_rejects == 1
+            cl.bye()
+        finally:
+            ps.stop()
+
+    def test_checkpoint_restart_bumps_incarnation(self, tmp_path):
+        p = str(tmp_path / "ps.npz")
+        cfg = make_cfg()
+        ps = ps_dcn.ParameterServer(cfg, 6, 64, port=0, epoch=1,
+                                    checkpoint_path=p).start()
+        ps.save_checkpoint()
+        ps.stop()
+        ps2 = ps_dcn.ParameterServer(cfg, 6, 64, port=0, epoch=1,
+                                     checkpoint_path=p)
+        assert ps2.epoch == 2, "every incarnation is a new epoch"
+        ps2.save_checkpoint()
+        ps2.stop()
+        # a controller that already counted MORE fences wins via max
+        ps3 = ps_dcn.ParameterServer(cfg, 6, 64, port=0, epoch=7,
+                                     checkpoint_path=p)
+        assert ps3.epoch == 7
+        ps3.stop()
+        # fencing off: the checkpoint's epoch is inert
+        ps4 = ps_dcn.ParameterServer(cfg, 6, 64, port=0, epoch=0,
+                                     checkpoint_path=p)
+        assert ps4.epoch == 0
+        ps4.stop()
+
+    def test_fence_on_is_step_identical_to_off(self, devices8):
+        """Fencing changes header bytes, never semantics: the same seeded
+        run converges to the same model with the same accept/drop record
+        whether fencing is on or off (the acceptance criterion's
+        byte/step-identity-with-conf-off, asserted from the ON side)."""
+        from asyncframework_tpu.data.sharded import ShardedDataset
+
+        results = []
+        for fence in (False, True):
+            set_global_conf(AsyncConf({"async.fence.enabled": fence}))
+            # ONE worker: the strictly serial pull->push loop makes the
+            # whole run deterministic, so the two arms are comparable
+            cfg = make_cfg(num_workers=1, num_iterations=40)
+            ds = ShardedDataset.generate_on_device(
+                256, 6, 1, devices=devices8[:1], seed=5, noise=0.01)
+            ps = ps_dcn.ParameterServer(cfg, 6, 256, port=0,
+                                        device=devices8[0]).start()
+            shards = {0: ds.shard(0)}
+            ps_dcn.run_worker_process(
+                "127.0.0.1", ps.port, [0], shards, cfg, 6, 256,
+                deadline_s=60.0)
+            assert ps.wait_done(timeout_s=10.0)
+            results.append((ps.accepted, ps.dropped, ps._clock,
+                            np.asarray(ps._w).copy(), ps.epoch))
+            ps.stop()
+        (a0, d0, c0, w0, e0), (a1, d1, c1, w1, e1) = results
+        assert (e0, e1) == (0, 1)
+        assert (a0, d0, c0) == (a1, d1, c1)
+        np.testing.assert_array_equal(w0, w1)
+
+
+# ------------------------------------------------------ sharded group fence
+class TestShardedFencing:
+    def test_welcome_hands_out_epoch_vector(self, devices8):
+        set_global_conf(AsyncConf({"async.fence.enabled": True}))
+        cfg = make_cfg(num_workers=2)
+        ps_list, smap = sg.launch_inprocess_group(
+            cfg, 9, 256, 3, device=devices8[0])
+        try:
+            assert [p.epoch for p in ps_list] == [1, 1, 1]
+            cl = ps_dcn.PSClient("127.0.0.1", ps_list[0].port, proc="w")
+            welcome = cl.hello("w", [0, 1], pid=os.getpid())
+            assert welcome.get("epochs") == [1, 1, 1]
+            cl.bye()
+            smap2, epochs, _ep = sg.fetch_group_info(
+                "127.0.0.1", ps_list[1].port)
+            assert smap2 is not None and epochs == [1, 1, 1]
+        finally:
+            for p in ps_list:
+                p.stop()
+
+    def test_per_shard_fence_heals_independently(self, devices8):
+        set_global_conf(AsyncConf({"async.fence.enabled": True}))
+        cfg = make_cfg(num_workers=2)
+        ps_list, smap = sg.launch_inprocess_group(
+            cfg, 9, 256, 3, device=devices8[0])
+        try:
+            cl = sg.ShardedPSClient(smap, epochs=[1, 1, 1], proc="w")
+            got = cl.pull(0)
+            assert got is not None
+            ts, w, _avg, _cal = got
+            # shard 1 is fenced out from under the client (a relaunch)
+            ps_list[1].epoch = 2
+            acc, done = cl.push(0, ts, np.zeros(9, np.float32))
+            # the round lands (primary's verdict); shard 1's sub-push was
+            # fenced + the sub-client adopted the minted epoch
+            assert cl.clients[1].epoch == 2
+            assert ps_list[1].fenced_rejects >= 1
+            got = cl.pull(0)
+            assert got is not None
+            acc, _done = cl.push(0, got[0], np.zeros(9, np.float32))
+            assert acc, "healed client's next round is admitted"
+            assert cl.clients[1].fenced_replies >= 1
+            cl.bye()
+        finally:
+            for p in ps_list:
+                p.stop()
+
+
+# ---------------------------------------- THE acceptance run (real procs)
+class TestPartitionFenceRelaunch:
+    """Partition (not kill) one shard of a real 3-shard group past lease
+    expiry: the controller suspects, expires the lease, mints a fencing
+    epoch, and relaunches the range; stale-epoch pushes are rejected
+    REJECT_FENCED; the run completes with full coverage and a decreasing
+    loss trajectory."""
+
+    NW, N, D = 8, 4096, 24
+    # a longer run than the SIGKILL acceptance (test_shardgroup.py): the
+    # fence needs a full LEASE of probe silence before it fires, and the
+    # partition must land while the run is still in flight even on a
+    # fast rig -- 500 iters can finish inside the lease window
+    ITERS = 1500
+
+    def _worker(self, port, wpid, tmp):
+        env = dict(os.environ)
+        env.update({
+            "PS_ROLE": "worker", "PS_PORT": str(port),
+            "PS_WORKER_ID": str(wpid), "PS_NUM_WORKER_PROCS": "2",
+            "PS_NUM_ITER": str(self.ITERS),
+            "JAX_PLATFORMS": "cpu",
+        })
+        return subprocess.Popen(
+            [sys.executable, str(CHILD)], env=env,
+            stdout=subprocess.PIPE,
+            stderr=open(os.path.join(tmp, f"worker{wpid}.stderr.log"),
+                        "w"),
+            text=True,
+        )
+
+    def test_partition_shard_fence_and_relaunch(self, tmp_path):
+        # cfg MUST mirror tests/ps_dcn_child.py::config()
+        cfg = SolverConfig(
+            num_workers=self.NW, num_iterations=self.ITERS, gamma=1.2,
+            taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5,
+            printer_freq=50, seed=42, calibration_iters=20,
+            run_timeout_s=120.0,
+        )
+        group = sg.ShardGroup(
+            cfg, self.D, self.N, 3, checkpoint_dir=str(tmp_path),
+            worker_procs=2, dead_after_s=1.0, check_interval_s=0.2,
+            stderr_dir=str(tmp_path),
+            conf_overlays={"async.fence.enabled": True},
+        ).start()
+        assert group.fence and group.epochs_wire() == [1, 1, 1]
+        workers = []
+        try:
+            port0 = group.port_of(0)
+            port1 = group.port_of(1)
+            workers = [self._worker(port0, 0, str(tmp_path)),
+                       self._worker(port0, 1, str(tmp_path))]
+            # let shard 1 make durable progress first (its cadence
+            # checkpoint must exist so the relaunch recovers state);
+            # threshold seeded so every sweep seed cuts at a different
+            # point of the run
+            cut_after = 60 + (CHAOS_SEED % 40)
+            watch = ps_dcn.PSClient("127.0.0.1", port1)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                got = watch.subscribe(0)
+                if got is not None and got[2] >= cut_after:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("shard 1 never reached the cut threshold")
+            try:
+                watch.bye()
+            except (ConnectionError, OSError):
+                pass
+            # PARTITION shard 1 away from this (controller) process: its
+            # process stays alive and serving -- the zombie.  Workers are
+            # separate processes and deliberately NOT partitioned: they
+            # keep talking to the zombie until the fence.  The wan
+            # profile (chaos_sweep --net-profile) overlays here when set.
+            sched = faults.FaultSchedule(seed=CHAOS_SEED)
+            sched.add_partition([f"*:{port1}"], duration_s=4.0)
+            sched = faults.merge_schedules(
+                sched, faults.profile_schedule_from_env(CHAOS_SEED))
+            faults.install(faults.FaultInjector(sched))
+            # the controller's probes now fail: SUSPECT at half the
+            # lease, lease expiry at 1 s, epoch fence, relaunch
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                if group.restarts_of(1) >= 1:
+                    break
+                time.sleep(0.1)
+            assert group.restarts_of(1) >= 1, \
+                "partitioned shard was never fenced + relaunched"
+            # death was declared by LEASE EXPIRY, not process exit: the
+            # zombie's pid was still alive when the fence fired (the
+            # controller kills it only afterwards, to reclaim the pinned
+            # port -- cross-host the zombie would simply stay fenced)
+            assert group.sup.counters()["lease_expiries"] >= 1
+            assert group.epoch_of(1) >= 2, "no fencing epoch was minted"
+            faults.clear()  # heal: the controller sees the group again
+            # wait until the relaunched shard 1 answers and is stable
+            deadline = time.monotonic() + 30.0
+            epoch1 = 0
+            while time.monotonic() < deadline:
+                try:
+                    hdr = sg._oneshot("127.0.0.1", group.port_of(1),
+                                      {"op": "SHARDMAP"}, timeout_s=2.0)
+                    epoch1 = int(hdr.get("epoch", 0))
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(0.2)
+            assert epoch1 >= 2, f"relaunched shard epoch {epoch1}"
+            # THE fencing assertion: a push stamped with the deposed
+            # epoch -- exactly what the zombie's clients replay after
+            # the heal -- is rejected REJECT_FENCED, counted, and the
+            # client self-heals onto the minted epoch
+            lo, hi = sg.shard_ranges(self.D, 3)[1]
+            stale = ps_dcn.PSClient("127.0.0.1", group.port_of(1),
+                                    epoch=1)
+            acc, done = stale.push(0, 0, np.zeros(hi - lo, np.float32))
+            assert (acc, done) == (False, False)
+            assert stale.fenced_replies >= 1
+            assert stale.epoch == epoch1
+            hdr = sg._oneshot("127.0.0.1", group.port_of(1),
+                              {"op": "SHARDMAP"}, timeout_s=2.0)
+            assert int(hdr.get("fenced_rejects", 0)) >= 1
+            # the run COMPLETES through the partition: full coverage,
+            # decreasing assembled loss trajectory
+            result0 = group.result_of(0, timeout_s=90.0)
+            assert result0 is not None, "primary never finished"
+            assert result0["done"] is True
+            assert result0["accepted"] == self.ITERS
+            assert set(map(int, result0["accepted_by_wid"])) == set(
+                range(self.NW))
+            traj = result0.get("trajectory")
+            assert traj, "no trajectory (eval plane died?)"
+            assert traj[-1][1] < traj[0][1] * 0.2, traj
+            group.finish()
+            # observability: the controller counted the fence + restart
+            totals = sg.shard_totals()
+            assert totals.get("shard_deaths", 0) >= 1
+            assert totals.get("shards_restarted", 0) >= 1
+            assert totals.get("fence_epoch_bumps", 0) >= 1
+            for w in workers:
+                rc = w.wait(timeout=60.0)
+                assert rc == 0, f"worker exited rc={rc}"
+            out = [json.loads(w.stdout.read().splitlines()[-1])
+                   for w in workers]
+            assert sum(o["gradients"] for o in out) >= self.ITERS
+        finally:
+            faults.clear()
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            group.stop()
