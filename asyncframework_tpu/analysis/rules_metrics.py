@@ -1,0 +1,105 @@
+"""Counter-family registration: the static equivalent of the PR 7
+runtime audit (``tests/test_telemetry.py``'s pkgutil walk).
+
+``metrics/registry.py`` is the ONE list of counter families; four
+consumers iterate it (``reset_totals``, live-UI baselines, the sampler,
+/metrics).  The runtime audit only fires when the telemetry suite runs
+and only sees modules that import cleanly in that environment; this rule
+fires on every lint of every tree state:
+
+- ``metrics-unregistered-totals``: a public module-level ``*_totals``
+  function in the package that no ``CounterFamily`` row references --
+  the "second run inherits counts" bug waiting to happen;
+- ``metrics-dangling-family``: a registry row whose (module, attr)
+  provider does not exist in the tree (a rename that silently emptied a
+  dashboard section).
+
+Aggregator functions that roll other families up (``registry.all_totals``
+itself, ``net/retry.retry_totals`` inside ``net_totals``) are suppressed
+in ``analysis/allowlist.py`` with their reasons -- the same exemptions
+the runtime audit documents.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from asyncframework_tpu.analysis.core import Finding, LintContext
+
+PKG_PREFIX = "asyncframework_tpu/"
+
+
+def _module_name(path: str) -> str:
+    mod = path[:-3] if path.endswith(".py") else path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _registered(ctx: LintContext) -> Set[Tuple[str, str]]:
+    """(module, attr) provider pairs from metrics/registry.py."""
+    from asyncframework_tpu.metrics import registry
+
+    out: Set[Tuple[str, str]] = set()
+    for fam in registry.families().values():
+        out.add((fam.module, fam.totals_attr))
+        out.add((fam.module, fam.reset_attr))
+    return out
+
+
+def check(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    registered = _registered(ctx)
+
+    providers: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for path, sf in ctx.files.items():
+        if not path.startswith(PKG_PREFIX):
+            continue
+        mod = _module_name(path)
+        for node in sf.tree.body:  # module level only, like the pkgutil walk
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name.endswith("_totals") and \
+                    not node.name.startswith(("_", "reset")):
+                providers[(mod, node.name)] = (path, node.lineno)
+
+    for (mod, attr), (path, line) in sorted(providers.items()):
+        if (mod, attr) in registered:
+            continue
+        # a provider re-exported via a package __init__ may be registered
+        # under the package name (net_totals lives in net/__init__.py)
+        if any(rm.startswith(mod) or mod.startswith(rm)
+               for rm, ra in registered if ra == attr):
+            continue
+        findings.append(Finding(
+            "metrics-unregistered-totals", path, line, attr,
+            f"public counter provider {mod}.{attr} is not referenced by "
+            f"any CounterFamily in metrics/registry.py -- register it "
+            f"(wires reset_totals, live-UI baselines, the sampler and "
+            f"/metrics) or rename it private"))
+
+    # dangling registry rows: provider module/attr must exist in-tree
+    known_paths = set(ctx.files)
+    for (mod, attr) in sorted(registered):
+        relpath = mod.replace(".", "/")
+        candidates = (relpath + ".py", relpath + "/__init__.py")
+        sf = next((ctx.files[c] for c in candidates if c in known_paths),
+                  None)
+        if sf is None:
+            continue  # outside lint scope
+        present = any(
+            (isinstance(n, ast.FunctionDef) and n.name == attr) or
+            (isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == attr
+                for t in n.targets)) or
+            (isinstance(n, (ast.Import, ast.ImportFrom)) and any(
+                (a.asname or a.name) == attr for a in n.names))
+            for n in sf.tree.body)
+        if not present:
+            findings.append(Finding(
+                "metrics-dangling-family", "asyncframework_tpu/metrics/"
+                "registry.py", 1, f"{mod}.{attr}",
+                f"registry references provider {mod}.{attr}, which does "
+                f"not exist at module level in {sf.relpath}"))
+    return findings

@@ -65,6 +65,7 @@ CONF_TO_FIELD: Dict[str, str] = {
     "async.allocation.idle.timeout.s": "allocation_idle_timeout_s",
     "async.heartbeat.timeout.ms": "heartbeat_timeout_ms",
     "async.max.slot.failures": "max_slot_failures",
+    "async.broadcast.versions": "max_live_versions",
     "async.ui.port": "ui_port",
     "async.trace.sample": "trace_sample",
     # DCN data-plane knobs (parallel/ps_dcn.py)

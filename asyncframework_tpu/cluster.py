@@ -96,7 +96,8 @@ def launch_local_cluster(
             results[pid] = p.communicate()
 
     threads = [
-        threading.Thread(target=drain, args=(pid, p), daemon=True)
+        threading.Thread(target=drain, args=(pid, p),
+                         name=f"cluster-drain-{pid}", daemon=True)
         for pid, p in enumerate(procs)
     ]
     for t in threads:

@@ -142,15 +142,15 @@ PRINTER_FREQ = ConfigEntry("async.printer.freq", 100, int, "Trajectory snapshot 
 DELAY_COEFF = ConfigEntry("async.delay.coeff", 0.0, float,
                           "Straggler delay intensity; -1 = cloud long-tail model.")
 SEED = ConfigEntry("async.seed", 42, int, "Root PRNG seed.")
-MODE = ConfigEntry("async.mode", 1, int, "1 = async (non-blocking jobs), 0 = sync.")
+# async.mode, async.updater.drain.max, async.heartbeat.interval and
+# async.heartbeat.timeout were declared here for reference parity but
+# never read (async-lint conf-dead-knob): mode is selected by driver
+# alias (asgd vs asgd-sync), drain batching rides async.drain.batch, and
+# executor heartbeats ride async.heartbeat.timeout.ms -- deleted rather
+# than left as operator-facing no-ops.
 MODEL_VERSIONS = ConfigEntry("async.broadcast.versions", 4, int,
-                             "Model versions kept live in the versioned store.")
-QUEUE_DRAIN_MAX = ConfigEntry("async.updater.drain.max", 0, int,
-                              "Max results drained per updater wake (0 = all).")
-HEARTBEAT_INTERVAL_S = ConfigEntry("async.heartbeat.interval", 0.5, float,
-                                   "Executor heartbeat period, seconds.")
-HEARTBEAT_TIMEOUT_S = ConfigEntry("async.heartbeat.timeout", 5.0, float,
-                                  "Executor declared dead after this silence.")
+                             "Model versions kept live in the versioned store "
+                             "(SolverConfig.max_live_versions).")
 DRAIN_BATCH = ConfigEntry("async.drain.batch", 1, int,
                           "Queued gradients folded into one device dispatch.")
 UI_PORT = ConfigEntry("async.ui.port", -1, int,

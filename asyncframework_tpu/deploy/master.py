@@ -36,12 +36,16 @@ from typing import Dict, List, Optional
 
 from asyncframework_tpu.cluster import _free_port
 from asyncframework_tpu.net import DedupWindow
+from asyncframework_tpu.net import protocol as _protocol
 from asyncframework_tpu.net.frame import recv_msg as _recv_msg
 from asyncframework_tpu.net.frame import send_msg as _send_msg
 
 #: ops that mutate master state: a retried SUBMIT_APP must not schedule the
 #: app twice, a retried KILL_APP is answered from cache (net/session.py)
-_MUTATING_OPS = frozenset({"SUBMIT_APP", "KILL_APP"})
+# the (sid, seq)-gated verbs come from the declared wire-protocol table
+# (net/protocol.py): the table is the single place an op's exactly-once
+# obligation lives, and bin/async-lint checks this derivation stays put
+_MUTATING_OPS = _protocol.dedup_gated_ops(_protocol.MASTER)
 
 # NOTE on coordinator ports: _free_port binds-then-releases on the master's
 # host, so (a) another process could steal the port before the app binds it
@@ -253,7 +257,8 @@ class Master:
                 continue
             except OSError:
                 return
-            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 name="master-conn", daemon=True)
             t.start()
 
     def _reaper_loop(self) -> None:
@@ -532,8 +537,10 @@ class MasterUIServer:
         self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         name="master-ui", daemon=True).start()
+        self._ui_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="master-ui",
+            daemon=True)
+        self._ui_thread.start()
 
     def stop(self) -> None:
         try:

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 from asyncframework_tpu.net import RetryPolicy
 from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.utils.threads import guarded
 from asyncframework_tpu.net.frame import recv_msg as _recv_msg
 from asyncframework_tpu.net.frame import send_msg as _send_msg
 
@@ -242,7 +243,11 @@ class Worker:
                 sys.stdout.write(out)
                 sys.stdout.flush()
 
-        threading.Thread(target=watch, daemon=True).start()
+        threading.Thread(
+            target=guarded(watch, f"exec-watch-{order['app_id']}"),
+            name=f"exec-watch-{order['app_id']}-{order['proc_id']}",
+            daemon=True,
+        ).start()
 
 
 def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover

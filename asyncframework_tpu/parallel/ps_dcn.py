@@ -436,13 +436,14 @@ class ParameterServer:
         # debug lock watchdog (net/lockwatch.py, async.debug.lockwatch):
         # the model lock becomes a watched lock -- any socket send/recv
         # under it raises at the frame choke point, continuously checking
-        # the lock-free PULL-serving claim in chaos/soak runs
+        # the lock-free PULL-serving claim in chaos/soak runs.  The other
+        # contended PS locks ride named_lock too, feeding the lock-order
+        # race detector acquisition edges (a cycle among ps.model /
+        # ps.stats / ps.versions / supervisor.members is a potential
+        # deadlock caught at the first nested hold, not in production).
         from asyncframework_tpu.net import lockwatch as _lockwatch
 
-        if _lockwatch.enabled_for():
-            self._lock = _lockwatch.WatchedLock("ps.model")
-        else:
-            self._lock = threading.Lock()
+        self._lock = _lockwatch.named_lock("ps.model")
         # ---- data plane: version-cached encoded PULL replies + deltas.
         # One readback AND one encode per model version, published as an
         # immutable _ModelSnap (host float32 array + serialized payload
@@ -470,12 +471,12 @@ class ParameterServer:
         # key off it -- see _ModelSnap.gen / _model_snap.
         self._model_gen = 0
         self._snap_basis: Tuple[int, object, int] = (0, self._w, 0)
-        self._snap_build_lock = threading.Lock()
-        self._versions_lock = threading.Lock()
+        self._snap_build_lock = _lockwatch.named_lock("ps.snap_build")
+        self._versions_lock = _lockwatch.named_lock("ps.versions")
         # pull-path bookkeeping (reply-shape counters, pull timestamps,
         # last-contact) keeps its own lock: read-modify-write safety
         # without ever touching the model lock from the pull path
-        self._stats_lock = threading.Lock()
+        self._stats_lock = _lockwatch.named_lock("ps.stats")
         from collections import OrderedDict as _OD2
         from asyncframework_tpu.conf import (
             PULL_DELTA_VERSIONS,
@@ -846,7 +847,8 @@ class ParameterServer:
             except OSError:
                 return
             t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=self._serve_conn, args=(conn,),
+                name=f"ps-conn-{conn.fileno()}", daemon=True
             )
             t.start()
             # reap on append: a long-running elastic PS accepts a fresh
@@ -3094,7 +3096,8 @@ def run_worker_process(
 
     def spawn(w: int) -> None:
         target = pipelined_worker_loop if pipe_depth > 0 else worker_loop
-        t = threading.Thread(target=target, args=(w,), daemon=True)
+        t = threading.Thread(target=target, args=(w,),
+                             name=f"dcn-worker-{w}", daemon=True)
         with group_lock:
             threads.append(t)
         t.start()

@@ -210,7 +210,13 @@ class ElasticSupervisor:
             fence = bool(global_conf().get(FENCE_ENABLED))
         self.fence = bool(fence)
         self._clock = clock or SystemClock()
-        self._lock = threading.Lock()
+        # membership lock feeds the lock-order race detector when the
+        # watchdog is armed (net/lockwatch.py named_lock); the
+        # supervisor never does wire I/O under it, so watching it is
+        # side-effect-free
+        from asyncframework_tpu.net import lockwatch as _lockwatch
+
+        self._lock = _lockwatch.named_lock("supervisor.members")
         self._t0 = self._clock.now_ms()
         self._owner: Dict[int, Optional[str]] = {
             w: None for w in range(self.num_workers)

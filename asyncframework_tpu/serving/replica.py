@@ -46,6 +46,7 @@ from asyncframework_tpu.net import frame as _frame
 from asyncframework_tpu.parallel.ps_dcn import PSClient
 from asyncframework_tpu.serving import metrics as smetrics
 from asyncframework_tpu.serving.server import FramedServer
+from asyncframework_tpu.utils.threads import guarded
 
 _send_msg = _frame.send_msg
 _recv_msg = _frame.recv_msg
@@ -454,7 +455,8 @@ def serve_replica(ps: str, rid: int = 0, host: str = "0.0.0.0",
             hello_once()
         except (ConnectionError, OSError):
             pass  # not fatal: the loop below keeps trying
-        threading.Thread(target=hello_loop, name=f"replica-{rid}-hello",
+        threading.Thread(target=guarded(hello_loop, f"replica-{rid}-hello"),
+                         name=f"replica-{rid}-hello",
                          daemon=True).start()
     announce(json.dumps({"role": "replica", "rid": rid, "port": rep.port,
                          "pid": os.getpid()}), flush=True)

@@ -70,7 +70,8 @@ class FramedServer:
             except OSError:
                 return
             t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=self._serve_conn, args=(conn,),
+                name=f"{self._name}-conn-{conn.fileno()}", daemon=True
             )
             t.start()
             # reap on append: a long-lived daemon accepts a fresh

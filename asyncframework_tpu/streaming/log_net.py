@@ -42,13 +42,17 @@ from asyncframework_tpu.net import (
     RetryPolicy,
 )
 from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.net import protocol as _protocol
 from asyncframework_tpu.net.frame import recv_msg as _recv_msg
 from asyncframework_tpu.net.frame import send_msg as _send_msg
 from asyncframework_tpu.streaming.log import LogTopic
+from asyncframework_tpu.utils.threads import guarded
 
 #: ops that mutate server state and therefore ride the (sid, seq) dedup
-#: window -- a retried APPEND must never append twice (round-5 ADVICE bug)
-_MUTATING_OPS = frozenset({"APPEND", "COMMIT"})
+#: window -- a retried APPEND must never append twice (round-5 ADVICE
+#: bug).  Derived from the declared wire-protocol table (net/protocol.py)
+#: so the obligation lives in ONE place; bin/async-lint checks this.
+_MUTATING_OPS = _protocol.dedup_gated_ops(_protocol.TOPIC)
 
 
 class LogTopicServer:
@@ -124,7 +128,8 @@ class LogTopicServer:
             except OSError:
                 return  # socket closed by stop()
             threading.Thread(
-                target=self._handle, args=(conn,),
+                target=guarded(self._handle, "log-topic-conn"),
+                args=(conn,),
                 name="log-topic-conn", daemon=True,
             ).start()
 

@@ -137,6 +137,20 @@ if os.environ.get("BENCH_SCALE") == "fallback":
         _c.update(_FB[_name])
 
 
+def _guarded(fn, what: str):
+    """Local copy of utils/threads.guarded (the thread exception policy):
+    the probe/reaper paths must not import the package -- a wedged jax
+    init is exactly what they guard against."""
+    def _run(*a, **k):
+        try:
+            fn(*a, **k)
+        except Exception:  # noqa: BLE001 - report, never die silently
+            print(f"bench: unhandled exception in thread {what!r}",
+                  file=sys.stderr, flush=True)
+            traceback.print_exc()
+    return _run
+
+
 def emit(payload: dict) -> None:
     print(json.dumps(payload))
     sys.stdout.flush()
@@ -722,7 +736,8 @@ def _spawn_replica(ps_port: int, rid: int, env: dict,
     def read_line():
         line_box["line"] = proc.stdout.readline()
 
-    t = threading.Thread(target=read_line, daemon=True)
+    t = threading.Thread(target=read_line, name="bench-probe-read",
+                         daemon=True)
     t.start()
     t.join(timeout=timeout_s)
     line = line_box.get("line")
@@ -774,7 +789,18 @@ def run_serve_child() -> None:
     rng = np.random.default_rng(3)
     X = rng.normal(size=(SERVE_BATCH, c["d"])).astype(np.float32)
     out = {}
-    arms = [("r1", 1, False), ("r2", 2, False), ("r2_kill", 2, True)]
+    # replica count for the top arm comes from the declared knob (default
+    # 2 keeps the historical r1/r2/r2_kill arms byte-identical); operators
+    # bench wider via --conf async.serve.replicas / ASYNCTPU_ env
+    from asyncframework_tpu.conf import SERVE_REPLICAS, global_conf
+
+    n_top = max(1, int(global_conf().get(SERVE_REPLICAS)))
+    arms = [("r1", 1, False)]
+    if n_top > 1:
+        arms.append((f"r{n_top}", n_top, False))
+        # the kill arm needs a survivor to fail over to: with one replica
+        # a SIGKILL measures a guaranteed outage, not failover
+        arms.append((f"r{n_top}_kill", n_top, True))
     for label, n_rep, kill in arms:
         reset_totals()
         cfg = SolverConfig(
@@ -800,7 +826,8 @@ def run_serve_child() -> None:
                 target=ps_dcn.run_worker_process,
                 args=("127.0.0.1", ps.port, list(range(c["nw"])), shards,
                       cfg, c["d"], c["n"]),
-                kwargs=dict(deadline_s=SERVE_LOAD_S + 6.0), daemon=True,
+                kwargs=dict(deadline_s=SERVE_LOAD_S + 6.0),
+                name=f"bench-serve-trainer-{label}", daemon=True,
             )
             trainer.start()
             # warm: first predict proves replicas refreshed and compiled
@@ -839,8 +866,10 @@ def run_serve_child() -> None:
                         lags_ms.append(meta["lag_ms"])
                         lat_ms.append((time.monotonic() - t0) * 1e3)
 
-            clients = [threading.Thread(target=client_loop, daemon=True)
-                       for _ in range(SERVE_CLIENTS)]
+            clients = [threading.Thread(target=client_loop,
+                                        name=f"bench-serve-client-{i}",
+                                        daemon=True)
+                       for i in range(SERVE_CLIENTS)]
             for t in clients:
                 t.start()
             if kill:
@@ -944,7 +973,8 @@ def _reap_detached(proc: subprocess.Popen) -> None:
         except Exception:  # noqa: BLE001 - best-effort cleanup only
             pass
 
-    threading.Thread(target=reap, daemon=True).start()
+    threading.Thread(target=_guarded(reap, "bench-probe-reap"),
+                     name="bench-probe-reap", daemon=True).start()
 
 
 def probe_backend(env: dict) -> Tuple[bool, str]:

@@ -79,6 +79,61 @@ def slo_sanity(seed: int) -> str:
     return ""
 
 
+def lockorder_sanity(seed: int) -> str:
+    """Per-seed lock-order-detector arming check (ISSUE 10): drive two
+    threads through a seeded reversed acquisition (A->B in one, B->A in
+    the other) and assert the detector reports exactly that cycle --
+    proving the machinery every suite in this seed leans on (the
+    test_chaos teardown assert_no_cycles gate) is actually live.
+    Deterministic per seed (the interleaving is join-serialized; the
+    seed only varies lock names).  Returns "" on pass."""
+    import threading
+
+    sys.path.insert(0, REPO)
+    from asyncframework_tpu.net import lockwatch
+
+    a, b = f"sweep.a{seed}", f"sweep.b{seed}"
+    lockwatch.reset_totals()
+    # snapshot after the fold: a real cycle some earlier run left in
+    # this process survives the restore below
+    prior_history = lockwatch.cycle_history()
+    lockwatch.enable(True)
+    try:
+        la, lb = lockwatch.WatchedLock(a), lockwatch.WatchedLock(b)
+
+        def fwd():
+            with la:
+                with lb:
+                    pass
+
+        def rev():
+            with lb:
+                with la:
+                    pass
+
+        for fn, name in ((fwd, "sweep-fwd"), (rev, "sweep-rev")):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            t.join(timeout=10.0)
+        cycles = lockwatch.lock_order_cycles()
+        if len(cycles) != 1 or a not in cycles[0] or b not in cycles[0]:
+            return (f"reversed acquisition yielded cycles={cycles!r}, "
+                    f"want exactly one through {a}/{b}")
+        try:
+            lockwatch.assert_no_cycles()
+            return "assert_no_cycles did not raise on a known cycle"
+        except AssertionError:
+            pass
+        return ""
+    finally:
+        lockwatch.enable(False)
+        lockwatch.reset_totals()
+        # this sanity check creates its cycle deliberately -- restore
+        # the prior history (dropping only OUR cycle) so any REAL cycle
+        # recorded earlier still reaches a session-wide gate
+        lockwatch.set_cycle_history(prior_history)
+
+
 def run_seed(seed: int, args) -> dict:
     env = dict(os.environ)
     env["ASYNC_CHAOS_SEED"] = str(seed)
@@ -137,6 +192,14 @@ def run_seed(seed: int, args) -> dict:
     if slo_err:
         ok = False
         summary = f"SLO sanity: {slo_err} | {summary}"
+    # lock-order detector armed + self-checked each seed: the chaos
+    # suites' teardown gate (lockwatch.assert_no_cycles) fails any seed
+    # whose interleaving produced a real acquisition-order cycle; this
+    # proves the detector itself catches a known reversed acquisition
+    lock_err = lockorder_sanity(seed)
+    if lock_err:
+        ok = False
+        summary = f"lock-order sanity: {lock_err} | {summary}"
     return {
         "seed": seed,
         "ok": ok,

@@ -20,6 +20,7 @@ from asyncframework_tpu.data.sharded import ShardedDataset
 from asyncframework_tpu.parallel import ps_dcn
 from asyncframework_tpu.parallel.shardgroup import ShardGroup, shard_totals
 from asyncframework_tpu.solvers import SolverConfig
+from asyncframework_tpu.utils.threads import guarded
 
 
 def main(n=4096, d=24, workers=8, iters=500, shards=3):
@@ -55,7 +56,8 @@ def main(n=4096, d=24, workers=8, iters=500, shards=3):
                 print(f"SIGKILL shard 1 (pid {pid}) at clock {got[2]}")
                 os.kill(pid, signal.SIGKILL)
 
-            threading.Thread(target=kill_one_shard, daemon=True).start()
+            threading.Thread(target=guarded(kill_one_shard, "kill-shard"),
+                             name="kill-one-shard", daemon=True).start()
             shards_data = {w: ds.shard(w) for w in range(workers)}
             ps_dcn.run_worker_process(
                 "127.0.0.1", group.port_of(0), list(range(workers)),

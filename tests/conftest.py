@@ -25,6 +25,24 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lockorder_gate():
+    """Session-wide lock-order deadlock gate (net/lockwatch.py): any
+    suite that armed the watchdog (chaos/pipeline fixtures, chaos_sweep
+    seeds via ASYNCTPU_ASYNC_DEBUG_LOCKWATCH) and produced an
+    acquisition-order cycle among watched locks fails the session at
+    teardown, whichever test happened to interleave it.  Suites that
+    deliberately drive cycles (tests/test_analysis.py, the sweep's
+    lockorder_sanity) clear the sticky history in their own teardown;
+    everyone else's reset_totals() FOLDS cycles into that history
+    instead of erasing them, so a cycle from any armed suite reaches
+    this gate even if a later suite reset the live graph."""
+    yield
+    from asyncframework_tpu.net import lockwatch
+
+    lockwatch.assert_no_cycles(include_history=True)
+
+
 @pytest.fixture(scope="session")
 def devices8():
     import jax

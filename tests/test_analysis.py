@@ -1,0 +1,612 @@
+"""async-lint suite (ISSUE 10): every rule fires on a minimal bad
+example, stays silent on the clean tree, and the whole repo self-lints
+clean -- plus the acceptance mutations (deleting a dedup gate, an ``ep``
+stamp, or a conf declaration makes the lint fail) and the dynamic
+lock-order race detector.
+
+Fixture trees are built under tmp_path with the repo's directory shape;
+``LintContext`` takes an explicit path list, so fixtures never touch the
+real tree.  The protocol-rule acceptance tests lint a MUTATED COPY of
+the real ``ps_dcn.py`` (never the live file), so they also prove the
+rule still understands the real dispatch code's shape.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from asyncframework_tpu.analysis import core as lint_core
+from asyncframework_tpu.analysis import (
+    rules_conf,
+    rules_locks,
+    rules_metrics,
+    rules_protocol,
+    rules_threads,
+)
+from asyncframework_tpu.analysis.core import Allow, LintContext, run_lint
+from asyncframework_tpu.net import lockwatch, protocol
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_tree(tmp_path, files):
+    """Write {relpath: source} under tmp_path; returns (root, paths)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path), list(files)
+
+
+def ctx_of(tmp_path, files):
+    root, paths = make_tree(tmp_path, files)
+    return LintContext(root, paths=paths)
+
+
+def rule_tokens(findings, rule):
+    return sorted(f.token for f in findings if f.rule == rule)
+
+
+# --------------------------------------------------------------- conf rule
+CONF_FIXTURE = '''
+class ConfigEntry:
+    def __init__(self, *a, **k):
+        pass
+
+LIVE = ConfigEntry("async.live.knob", 1, int, "read elsewhere")
+DEAD = ConfigEntry("async.dead.knob", 2, int, "read nowhere")
+'''
+
+
+class TestConfRule:
+    def test_undeclared_read_fires(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": CONF_FIXTURE,
+            "asyncframework_tpu/user.py":
+                'x = conf.get("async.live.knob")\n'
+                'y = conf.get("async.bogus.knob")\n',
+        })
+        f = rules_conf.check(ctx)
+        assert rule_tokens(f, "conf-undeclared-read") == ["async.bogus.knob"]
+
+    def test_dead_knob_fires_and_reference_silences(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": CONF_FIXTURE,
+            "asyncframework_tpu/user.py":
+                'x = conf.get("async.live.knob")\n',
+        })
+        f = rules_conf.check(ctx)
+        assert rule_tokens(f, "conf-dead-knob") == ["async.dead.knob"]
+        # referencing the entry CONSTANT (not the literal) also counts
+        ctx2 = ctx_of(tmp_path / "b", {
+            "asyncframework_tpu/conf.py": CONF_FIXTURE,
+            "asyncframework_tpu/user.py":
+                'from asyncframework_tpu.conf import DEAD, LIVE\n'
+                'a = conf.get(DEAD)\nb = conf.get(LIVE)\n',
+        })
+        assert rule_tokens(rules_conf.check(ctx2), "conf-dead-knob") == []
+
+    def test_env_alias_mismatch_fires(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": CONF_FIXTURE,
+            "asyncframework_tpu/user.py":
+                'import os\n'
+                'ok = os.environ.get("ASYNCTPU_ASYNC_LIVE_KNOB")\n'
+                'bad = os.environ.get("ASYNCTPU_ASYNC_TYPO_KNOB")\n',
+        })
+        f = rules_conf.check(ctx)
+        assert rule_tokens(f, "conf-env-alias") == [
+            "ASYNCTPU_ASYNC_TYPO_KNOB"]
+
+    def test_conf_to_field_checks(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": CONF_FIXTURE,
+            "asyncframework_tpu/cli.py":
+                'CONF_TO_FIELD = {"async.live.knob": "nope",\n'
+                '                 "async.unknown.knob": "taw"}\n',
+            "asyncframework_tpu/solvers/base.py":
+                'class SolverConfig:\n    taw: int = 1\n',
+        })
+        f = rules_conf.check(ctx)
+        toks = rule_tokens(f, "conf-field-map")
+        assert "async.unknown.knob" in toks      # unregistered key
+        assert "async.live.knob" in toks         # missing field
+
+    def test_conf_to_field_parses_annotated_assignment(self, tmp_path):
+        """The real cli.py declares `CONF_TO_FIELD: Dict[str, str] =
+        {...}` (ast.AnnAssign) -- the rule must parse that shape, or it
+        is vacuous on the actual tree (caught in review by mapping a
+        key to a nonexistent field with zero findings)."""
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": CONF_FIXTURE,
+            "asyncframework_tpu/cli.py":
+                'from typing import Dict\n'
+                'CONF_TO_FIELD: Dict[str, str] = {\n'
+                '    "async.live.knob": "no_such_field_xyz"}\n',
+            "asyncframework_tpu/solvers/base.py":
+                'class SolverConfig:\n    taw: int = 1\n',
+        })
+        toks = rule_tokens(rules_conf.check(ctx), "conf-field-map")
+        assert toks == ["async.live.knob"]
+
+    def test_underscore_key_declaration_violates_grammar(self, tmp_path):
+        """Underscore-bearing key segments make the ASYNCTPU_ env-alias
+        reverse mapping ambiguous, so declaring one is itself a finding
+        -- and its mechanically-correct env literal is NOT flagged as a
+        bad alias (the declaration is the bug, not the literal)."""
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": CONF_FIXTURE.replace(
+                '"async.dead.knob"', '"async.win_max.knob"'),
+            "asyncframework_tpu/user.py":
+                'x = conf.get("async.live.knob")\n',
+        })
+        f = rules_conf.check(ctx)
+        assert rule_tokens(f, "conf-key-grammar") == ["async.win_max.knob"]
+
+    def test_clean_tree_is_silent_for_conf(self):
+        result = run_lint(REPO, rules=["conf"])
+        assert result.findings == [], [f.format() for f in result.findings]
+
+
+# ----------------------------------------------------------- protocol rule
+PS_DCN_REAL = os.path.join(REPO, "asyncframework_tpu/parallel/ps_dcn.py")
+
+
+def real_ps_src():
+    with open(PS_DCN_REAL) as f:
+        return f.read()
+
+
+def protocol_findings_for(tmp_path, ps_src):
+    """Protocol-rule findings over a tree whose ps_dcn.py is ``ps_src``
+    (every other protocol module absent -- the rule skips missing
+    files)."""
+    ctx = ctx_of(tmp_path, {
+        "asyncframework_tpu/parallel/ps_dcn.py": ps_src,
+    })
+    return rules_protocol.check(ctx)
+
+
+class TestProtocolRule:
+    def test_table_is_sane(self):
+        tbl = protocol.table()
+        # the planes' load-bearing verbs are declared with the
+        # obligations the engine's correctness story rests on
+        assert tbl["PUSH"].dedup_gated and tbl["PUSH"].fence_stamped
+        assert tbl["APPEND"].dedup_gated
+        assert tbl["SUBMIT_APP"].dedup_gated
+        assert tbl["SUBSCRIBE"].fence_stamped
+        assert not tbl["MODEL"].mutating
+        assert protocol.dedup_gated_ops(protocol.TOPIC) == {
+            "APPEND", "COMMIT"}
+        assert protocol.dedup_gated_ops(protocol.MASTER) == {
+            "SUBMIT_APP", "KILL_APP"}
+
+    def test_dedup_gated_implies_mutating_enforced(self):
+        with pytest.raises(ValueError):
+            protocol.WireOp("X", protocol.PS, dedup_gated=True)
+
+    def test_undeclared_op_fires(self, tmp_path):
+        f = protocol_findings_for(
+            tmp_path,
+            'def serve(conn, header):\n'
+            '    op = header["op"]\n'
+            '    if op == "FROBNICATE":\n'
+            '        send(conn, {"op": "ACK"})\n')
+        assert "FROBNICATE" in rule_tokens(f, "proto-undeclared-op")
+
+    def test_unhandled_op_fires_on_stub_server(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/serving/frontend.py":
+                'def handle_op(conn, op, header, payload):\n'
+                '    if op == "HELLO":\n'
+                '        return True\n'
+                '    return False\n',
+        })
+        toks = rule_tokens(rules_protocol.check(ctx), "proto-unhandled-op")
+        assert "PREDICT" in toks and "STATUS" in toks
+
+    def test_deleting_push_dedup_gate_fails_lint(self, tmp_path):
+        src = real_ps_src()
+        mutated = src.replace("cached = self._dedup.check(header)",
+                              "cached = None", 1)
+        assert mutated != src
+        f = protocol_findings_for(tmp_path, mutated)
+        assert set(rule_tokens(f, "proto-dedup-gate")) >= {
+            "PUSH", "PUSH_SAGA"}
+        # the unmutated real file is clean
+        assert rule_tokens(
+            protocol_findings_for(tmp_path / "clean", src),
+            "proto-dedup-gate") == []
+
+    def test_deleting_fence_admission_fails_lint(self, tmp_path):
+        src = real_ps_src()
+        # remove the PULL branch's fencing admission call
+        mutated = src.replace(
+            "if op in (\"PULL\", \"PULL_SAGA\"):\n"
+            "                    if self._fence_reject(conn, header):\n"
+            "                        continue\n",
+            "if op in (\"PULL\", \"PULL_SAGA\"):\n", 1)
+        assert mutated != src
+        f = protocol_findings_for(tmp_path, mutated)
+        assert set(rule_tokens(f, "proto-fence-gate")) >= {
+            "PULL", "PULL_SAGA"}
+
+    def test_deleting_client_ep_stamp_fails_lint(self, tmp_path):
+        src = real_ps_src()
+        i = src.index("def _proc_hdr")
+        j = src.index('hdr["ep"] = self.epoch', i)
+        mutated = (src[:j] + "pass"
+                   + src[j + len('hdr["ep"] = self.epoch'):])
+        f = protocol_findings_for(tmp_path, mutated)
+        assert rule_tokens(f, "proto-fence-gate") == ["ep-stamp"]
+
+    def test_clean_tree_is_silent_for_protocol(self):
+        result = run_lint(REPO, rules=["protocol"])
+        assert result.findings == [], [f.format() for f in result.findings]
+
+
+# --------------------------------------------------------------- lock rule
+class TestLockRule:
+    def test_sleep_under_lock_fires(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/x.py":
+                'import time\n'
+                'def f(self):\n'
+                '    with self._lock:\n'
+                '        time.sleep(1.0)\n',
+        })
+        assert rule_tokens(rules_locks.check(ctx),
+                           "lock-blocking-call") == ["_lock:sleep"]
+
+    def test_socket_and_frame_io_under_lock_fire(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/x.py":
+                'def f(self, conn, hdr):\n'
+                '    with self._model_lock:\n'
+                '        conn.sendall(b"x")\n'
+                '        _send_msg(conn, hdr)\n',
+        })
+        toks = rule_tokens(rules_locks.check(ctx), "lock-blocking-call")
+        assert toks == ["_model_lock:_send_msg", "_model_lock:sendall"]
+
+    def test_nested_def_is_excluded_and_cv_wait_allowed(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/x.py":
+                'import time\n'
+                'def f(self):\n'
+                '    with self._lock:\n'
+                '        def later():\n'
+                '            time.sleep(1.0)\n'   # runs outside the hold
+                '        return later\n'
+                'def g(self):\n'
+                '    with self._wave_cv:\n'
+                '        self._wave_cv.wait(0.1)\n',  # releases the lock
+        })
+        assert rules_locks.check(ctx) == []
+
+    def test_str_join_not_flagged_thread_join_flagged(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/x.py":
+                'def f(self, parts):\n'
+                '    with self._lock:\n'
+                '        s = ",".join(parts)\n'     # 1 positional: str.join
+                '        self._ckpt_thread.join()\n',
+        })
+        assert rule_tokens(rules_locks.check(ctx),
+                           "lock-blocking-call") == ["_lock:join"]
+
+    def test_clean_tree_lock_findings_all_suppressed(self):
+        result = run_lint(REPO, rules=["locks"])
+        assert result.findings == [], [f.format() for f in result.findings]
+        # the known client-channel locks ride the allowlist, with reasons
+        assert all(a.reason.strip() for _f, a in result.suppressed)
+
+    def test_allowlist_tokens_are_lock_scoped(self):
+        """An entry written for one lock's documented contract must not
+        suppress the same callee under a DIFFERENT lock in the same
+        file: tokens carry the lock name, so a hypothetical model-lock
+        connect in ps_dcn.py escapes the _win_lock:connect entry."""
+        from asyncframework_tpu.analysis.allowlist import ALLOWLIST
+
+        hot = lint_core.Finding(
+            "lock-blocking-call",
+            "asyncframework_tpu/parallel/ps_dcn.py", 1,
+            "_lock:connect", "socket .connect() under the model lock")
+        assert not any(a.matches(hot) for a in ALLOWLIST)
+        win = lint_core.Finding(
+            "lock-blocking-call",
+            "asyncframework_tpu/parallel/ps_dcn.py", 1,
+            "_win_lock:connect", "push-window reconnect")
+        assert any(a.matches(win) for a in ALLOWLIST)
+
+
+# ------------------------------------------------------------- thread rule
+class TestThreadRule:
+    def test_bad_site_fires_all_three(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/x.py":
+                'import threading\n'
+                'def f(target):\n'
+                '    threading.Thread(target=target).start()\n',
+        })
+        rules = sorted(f.rule for f in rules_threads.check(ctx))
+        assert rules == ["thread-implicit-daemon", "thread-unguarded",
+                         "thread-unnamed"]
+
+    def test_assigning_start_result_is_not_retained(self, tmp_path):
+        """`t = threading.Thread(...).start()` binds None, not the
+        thread -- the object is lost and unguarded, so the rule must
+        fire (review repro: this passed as 'retained' before)."""
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/x.py":
+                'import threading\n'
+                'def f(target):\n'
+                '    t = threading.Thread(target=target, name="x",\n'
+                '                         daemon=True).start()\n',
+        })
+        rules = sorted(f.rule for f in rules_threads.check(ctx))
+        assert rules == ["thread-unguarded"]
+
+    def test_named_daemon_retained_is_clean(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/x.py":
+                'import threading\n'
+                'def f(self, target):\n'
+                '    self._t = threading.Thread(target=target,\n'
+                '                               name="x", daemon=True)\n'
+                '    self._t.start()\n',
+        })
+        assert rules_threads.check(ctx) == []
+
+    def test_guarded_fire_and_forget_is_clean(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/x.py":
+                'import threading\n'
+                'from asyncframework_tpu.utils.threads import guarded\n'
+                'def f(target):\n'
+                '    threading.Thread(target=guarded(target, "w"),\n'
+                '                     name="x", daemon=True).start()\n',
+        })
+        assert rules_threads.check(ctx) == []
+
+    def test_clean_tree_is_silent_for_threads(self):
+        result = run_lint(REPO, rules=["threads"])
+        assert result.findings == [], [f.format() for f in result.findings]
+
+    def test_guarded_reports_and_swallows(self, capsys):
+        from asyncframework_tpu.utils.threads import guarded
+
+        hits = []
+
+        def boom():
+            hits.append(1)
+            raise RuntimeError("kaboom")
+
+        t = threading.Thread(target=guarded(boom, "boom-test"),
+                             name="boom-test", daemon=True)
+        t.start()
+        t.join(timeout=10.0)
+        assert hits == [1] and not t.is_alive()
+        err = capsys.readouterr().err
+        assert "boom-test" in err and "kaboom" in err
+
+
+# ------------------------------------------------------------ metrics rule
+class TestMetricsRule:
+    def test_unregistered_totals_fires(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/rogue.py":
+                'def rogue_totals():\n    return {"n": 1}\n'
+                'def reset_rogue_totals():\n    pass\n'
+                'def _private_totals():\n    return {}\n',
+        })
+        toks = rule_tokens(rules_metrics.check(ctx),
+                           "metrics-unregistered-totals")
+        assert toks == ["rogue_totals"]  # reset_* and _private excluded
+
+    def test_clean_tree_metrics_findings_all_suppressed(self):
+        result = run_lint(REPO, rules=["metrics"])
+        assert result.findings == [], [f.format() for f in result.findings]
+
+
+# ------------------------------------------------- allowlist + whole tree
+class TestAllowlistPolicy:
+    def test_empty_reason_is_refused(self):
+        with pytest.raises(ValueError, match="reason"):
+            run_lint(REPO, rules=["conf"],
+                     allowlist=[Allow("conf-dead-knob", "*", "*", "  ")])
+
+    def test_repo_allowlist_entries_all_carry_reasons(self):
+        from asyncframework_tpu.analysis.allowlist import ALLOWLIST
+
+        assert all(a.reason.strip() for a in ALLOWLIST)
+
+    def test_allow_matching_is_exact_on_rule_and_token(self):
+        f = lint_core.Finding("conf-dead-knob",
+                              "asyncframework_tpu/conf.py", 1,
+                              "async.x", "m")
+        assert Allow("conf-dead-knob", "asyncframework_tpu/*",
+                     "async.x", "r").matches(f)
+        assert not Allow("conf-dead-knob", "asyncframework_tpu/*",
+                         "async.y", "r").matches(f)
+        assert not Allow("lock-blocking-call", "asyncframework_tpu/*",
+                         "async.x", "r").matches(f)
+
+
+class TestWholeTreeSelfLint:
+    def test_whole_tree_self_lints_clean(self):
+        """THE acceptance test: every rule over the whole repo, zero
+        findings beyond the reason-carrying allowlist."""
+        result = run_lint(REPO)
+        assert result.ok, "\n".join(f.format() for f in result.findings)
+        assert result.files_scanned > 150
+
+    def test_cli_json_clean_and_machine_readable(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "async-lint"),
+             "--json"],
+            capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload["ok"] is True
+        assert payload["findings"] == []
+        for s in payload["suppressed"]:
+            assert s["reason"].strip()
+
+    def test_deleting_a_conf_declaration_fails_lint(self, tmp_path):
+        """Acceptance mutation: drop ONE ConfigEntry declaration from a
+        copy of conf.py next to the real cli.py -> the CONF_TO_FIELD
+        read of that key becomes an undeclared read."""
+        with open(os.path.join(REPO, "asyncframework_tpu/conf.py")) as f:
+            conf_src = f.read()
+        with open(os.path.join(REPO, "asyncframework_tpu/cli.py")) as f:
+            cli_src = f.read()
+        target = ('TAW = ConfigEntry("async.taw", 2**31 - 1, int, '
+                  '"Staleness bound tau.")')
+        assert target in conf_src
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": conf_src.replace(target, ""),
+            "asyncframework_tpu/cli.py": cli_src,
+        })
+        toks = rule_tokens(rules_conf.check(ctx), "conf-undeclared-read")
+        assert "async.taw" in toks
+
+
+# ------------------------------------------------- lock-order race detector
+class TestLockOrderDetector:
+    def setup_method(self):
+        lockwatch.reset_totals()
+        # snapshot AFTER the fold above: if an earlier armed suite left
+        # a real cycle (live or already-folded), it is in this snapshot
+        # and teardown's restore preserves it for the session-wide gate
+        self._prior_history = lockwatch.cycle_history()
+        lockwatch.enable(True)
+
+    def teardown_method(self):
+        lockwatch.enable(False)
+        lockwatch.reset_totals()
+        # this class drives cycles DELIBERATELY: restore the pre-test
+        # history (dropping only OUR cycles) instead of wholesale
+        # clearing, which would also hide an earlier suite's real
+        # potential deadlock from the session-wide conftest gate
+        lockwatch.set_cycle_history(self._prior_history)
+
+    def test_reversed_acquisition_two_threads_reports_cycle(self):
+        """The satellite's required unit: two threads, two locks,
+        reversed acquisition order -> exactly one potential-deadlock
+        cycle, surfaced in totals() and fatal via assert_no_cycles."""
+        a = lockwatch.WatchedLock("t.alpha")
+        b = lockwatch.WatchedLock("t.beta")
+
+        def fwd():
+            with a:
+                with b:
+                    pass
+
+        def rev():
+            with b:
+                with a:
+                    pass
+
+        for fn, name in ((fwd, "lo-fwd"), (rev, "lo-rev")):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            t.join(timeout=10.0)
+        cycles = lockwatch.lock_order_cycles()
+        assert len(cycles) == 1
+        assert "t.alpha" in cycles[0] and "t.beta" in cycles[0]
+        tot = lockwatch.totals()
+        assert tot["order_cycles"] == 1 and tot["order_edges"] == 2
+        assert tot["cycles"] == cycles
+        with pytest.raises(AssertionError, match="t.alpha"):
+            lockwatch.assert_no_cycles()
+
+    def test_consistent_order_reports_no_cycle(self):
+        a = lockwatch.WatchedLock("c.alpha")
+        b = lockwatch.WatchedLock("c.beta")
+        c = lockwatch.WatchedLock("c.gamma")
+        for first, second in ((a, b), (a, c), (b, c)):
+            def fn(x=first, y=second):
+                with x:
+                    with y:
+                        pass
+            t = threading.Thread(target=fn, name="lo-ok", daemon=True)
+            t.start()
+            t.join(timeout=10.0)
+        assert lockwatch.lock_order_cycles() == []
+        lockwatch.assert_no_cycles()
+        assert lockwatch.totals()["order_edges"] == 3
+
+    def test_three_lock_transitive_cycle_detected(self):
+        a = lockwatch.WatchedLock("tr.a")
+        b = lockwatch.WatchedLock("tr.b")
+        c = lockwatch.WatchedLock("tr.c")
+        for first, second in ((a, b), (b, c), (c, a)):
+            def fn(x=first, y=second):
+                with x:
+                    with y:
+                        pass
+            t = threading.Thread(target=fn, name="lo-tri", daemon=True)
+            t.start()
+            t.join(timeout=10.0)
+        cycles = lockwatch.lock_order_cycles()
+        assert len(cycles) == 1
+        for name in ("tr.a", "tr.b", "tr.c"):
+            assert name in cycles[0]
+
+    def test_reset_clears_graph(self):
+        a = lockwatch.WatchedLock("r.a")
+        b = lockwatch.WatchedLock("r.b")
+        with a:
+            with b:
+                pass
+        assert lockwatch.totals()["order_edges"] == 1
+        lockwatch.reset_totals()
+        t = lockwatch.totals()
+        assert t["order_edges"] == 0 and t["order_cycles"] == 0
+
+    def test_reset_folds_cycles_into_sticky_history(self):
+        """A suite that reset_totals() for isolation must not erase
+        another suite's recorded cycle before the session-wide conftest
+        gate sees it: reset folds cycles into cycle_history(), which
+        only clear_cycle_history() drops."""
+        a = lockwatch.WatchedLock("h.a")
+        b = lockwatch.WatchedLock("h.b")
+
+        def fwd():
+            with a:
+                with b:
+                    pass
+
+        def rev():
+            with b:
+                with a:
+                    pass
+
+        for fn in (fwd, rev):
+            t = threading.Thread(target=fn, name="lo-hist", daemon=True)
+            t.start()
+            t.join(timeout=10.0)
+        assert len(lockwatch.lock_order_cycles()) == 1
+        lockwatch.reset_totals()  # the bystander reset
+        assert lockwatch.lock_order_cycles() == []      # live graph gone
+        assert len(lockwatch.cycle_history()) == 1      # verdict survives
+        lockwatch.assert_no_cycles()                    # current-only: ok
+        with pytest.raises(AssertionError, match="h.a"):
+            lockwatch.assert_no_cycles(include_history=True)
+        lockwatch.clear_cycle_history()
+        lockwatch.assert_no_cycles(include_history=True)
+
+    def test_named_lock_resolution(self):
+        assert isinstance(lockwatch.named_lock("x"),
+                          lockwatch.WatchedLock)
+        lockwatch.enable(False)
+        assert not isinstance(lockwatch.named_lock("x"),
+                              lockwatch.WatchedLock)

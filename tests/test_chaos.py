@@ -61,12 +61,21 @@ def _lockwatch_on():
     suite: every PS constructed here gets a watched model lock, so any
     socket send/recv under it -- the contention the lock-free PULL path
     removed -- fails the test at the frame choke point instead of
-    surviving as a silent regression."""
+    surviving as a silent regression.  Teardown additionally asserts the
+    lock-order race detector saw NO acquisition-order cycle among the
+    watched locks (ps.model / ps.stats / ps.versions /
+    supervisor.members): a cycle is a potential deadlock that a chaos
+    interleaving would eventually hit for real."""
     from asyncframework_tpu.net import lockwatch
 
+    lockwatch.reset_totals()
     lockwatch.enable(True)
-    yield
-    lockwatch.enable(False)
+    try:
+        yield
+        lockwatch.assert_no_cycles()
+    finally:
+        lockwatch.enable(False)
+        lockwatch.reset_totals()
 
 
 def make_cfg(**kw):
