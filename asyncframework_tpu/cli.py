@@ -72,6 +72,7 @@ CONF_TO_FIELD: Dict[str, str] = {
     "async.pull.mode": "pull_mode",
     "async.push.merge": "push_merge",
     "async.pipeline.depth": "pipeline_depth",
+    "async.mesh.devices": "mesh_devices",
     # telemetry plane (metrics/timeseries.py)
     "async.convergence.sample": "conv_sample",
 }

@@ -289,6 +289,20 @@ PIPELINE_DEPTH = ConfigEntry(
     "prefetched model and re-pull fresh.  ASAGA ignores this (its "
     "PS-side sampling requires strict pull->push alternation per "
     "worker).")
+MESH_DEVICES = ConfigEntry(
+    "async.mesh.devices", 0, int,
+    "Devices in each DCN worker's LOCAL compute mesh (parallel/mesh.py): "
+    "0 = the classic single-device gradient step (byte- and step-"
+    "identical legacy behavior); >= 2 = the worker computes each "
+    "mini-batch gradient batch-parallel over a dp mesh of this many "
+    "chips -- its shard rows are padded+sharded into HBM once at loop "
+    "start (ops/steps.make_mesh_asgd_worker_step / "
+    "make_mesh_saga_dcn_worker_step), per-device partial gradients "
+    "lax.psum-reduce locally, and the worker still emits ONE fused "
+    "gradient per step (wire protocol unchanged).  A value beyond the "
+    "rig's device count clamps (logged); a clamped value below 2, or a "
+    "sparse (padded-ELL) shard, degrades to the serial single-device "
+    "path instead of crashing the worker daemon.")
 DEBUG_LOCKWATCH = ConfigEntry(
     "async.debug.lockwatch", False, bool,
     "Debug lock watchdog (net/lockwatch.py): the PS model lock becomes a "

@@ -19,7 +19,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from asyncframework_tpu.parallel.mesh import make_mesh, pad_and_shard
+from asyncframework_tpu.parallel.mesh import (
+    make_mesh,
+    pad_and_shard,
+    resolve_shard_map,
+)
 
 
 def _pairwise_d2(X, centers):
@@ -91,7 +95,7 @@ class KMeans:
 
         @jax.jit
         @partial(
-            jax.shard_map,
+            resolve_shard_map(),
             mesh=mesh,
             in_specs=(P("dp", None), P("dp"), P(None, None)),
             out_specs=(P(None, None), P(None), P()),

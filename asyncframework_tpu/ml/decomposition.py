@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from asyncframework_tpu.parallel.mesh import resolve_shard_map
+
 
 def _gram_and_mean(X, mesh: Optional[Mesh], axis: str):
     """(n, X^T X, column sums), psum-combined over the mesh when given."""
@@ -33,7 +35,7 @@ def _gram_and_mean(X, mesh: Optional[Mesh], axis: str):
         return X.shape[0], X.T @ X, X.sum(axis=0)
 
     @partial(
-        jax.shard_map,
+        resolve_shard_map(),
         mesh=mesh,
         in_specs=P(axis, None),
         out_specs=(P(), P(None, None), P(None)),

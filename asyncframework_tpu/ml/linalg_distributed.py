@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from asyncframework_tpu.ml.decomposition import _gram_and_mean, svd as _svd
+from asyncframework_tpu.parallel.mesh import resolve_shard_map
 from asyncframework_tpu.ml.stat import ColStats, col_stats
 
 
@@ -92,7 +93,7 @@ class RowMatrix:
             return RowMatrix(self.X @ B)
 
         @partial(
-            jax.shard_map,
+            resolve_shard_map(),
             mesh=self.mesh,
             in_specs=(P(self.axis, None), P(None, None)),
             out_specs=P(self.axis, None),
@@ -136,7 +137,7 @@ class RowMatrix:
             return RowMatrix(q * sign[None, :]), r * sign[:, None]
 
         @partial(
-            jax.shard_map,
+            resolve_shard_map(),
             mesh=self.mesh,
             in_specs=P(self.axis, None),
             out_specs=(P(self.axis, None), P(self.axis, None)),
@@ -152,7 +153,7 @@ class RowMatrix:
         Q2 = Q2 * sign[None, :]
 
         @partial(
-            jax.shard_map,
+            resolve_shard_map(),
             mesh=self.mesh,
             in_specs=(P(self.axis, None), P(self.axis, None)),
             out_specs=P(self.axis, None),

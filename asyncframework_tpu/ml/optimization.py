@@ -35,7 +35,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from asyncframework_tpu.ml.gradient import Gradient, LeastSquaresGradient
 from asyncframework_tpu.ml.updater import SimpleUpdater, Updater
-from asyncframework_tpu.parallel.mesh import make_mesh, pad_and_shard
+from asyncframework_tpu.parallel.mesh import (
+    make_mesh,
+    pad_and_shard,
+    resolve_shard_map,
+)
 
 
 class GradientDescent:
@@ -125,7 +129,7 @@ class GradientDescent:
         )
 
         @partial(
-            jax.shard_map,
+            resolve_shard_map(),
             mesh=mesh,
             in_specs=(P(axis, None), P(axis), P(axis), P(None), P(None)),
             out_specs=out_specs,
@@ -276,7 +280,7 @@ class LBFGS:
         grad = self.gradient
 
         @partial(
-            jax.shard_map,
+            resolve_shard_map(),
             mesh=mesh,
             in_specs=(P("dp", None), P("dp"), P("dp"), P(None)),
             out_specs=(P(), P(None)),

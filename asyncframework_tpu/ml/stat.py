@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from asyncframework_tpu.parallel.mesh import resolve_shard_map
+
 
 @dataclass(frozen=True)
 class ColStats:
@@ -48,7 +50,7 @@ def col_stats(X, mesh: Optional[Mesh] = None, axis: str = "dp") -> ColStats:
         n, s1, s2, nnz, mx, mn = _moments(X)
     else:
         @partial(
-            jax.shard_map,
+            resolve_shard_map(),
             mesh=mesh,
             in_specs=P(axis, None),
             out_specs=(P(), P(None), P(None), P(None), P(None), P(None)),

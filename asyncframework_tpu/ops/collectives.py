@@ -61,8 +61,12 @@ def data_parallel_grad_fn(grad_sum_fn: Callable, mesh: Mesh, axis: str = "dp"):
     via an ICI psum -- the TPU-native ``treeAggregate``.
     """
 
+    # lazy: ops.__init__ is imported from parallel-side modules, so a
+    # top-level ops -> parallel import would be cyclic
+    from asyncframework_tpu.parallel.mesh import resolve_shard_map
+
     @partial(
-        jax.shard_map,
+        resolve_shard_map(),
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(None), P(axis)),
         out_specs=P(None),

@@ -256,9 +256,12 @@ def device_reduce_by_key(
             padded_k.append(k)
             padded_v.append(v)
         mesh = Mesh(np.array([d for d in devs]), ("w",))
+        # lazy: ops.__init__ is imported from parallel-side modules, so a
+        # top-level ops -> parallel import would be cyclic
+        from asyncframework_tpu.parallel.mesh import resolve_shard_map
 
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            resolve_shard_map(), mesh=mesh,
             in_specs=(P("w"), P("w")), out_specs=(P("w"), P("w")),
         )
         def shuffle(k, v):

@@ -52,6 +52,11 @@ def make_pipelined_transfer(device) -> Tuple[Callable, Callable]:
     the pipelined DCN worker loop (``parallel/ps_dcn.py``,
     ``async.pipeline.depth`` >= 1).
 
+    ``device`` may be a single ``jax.Device`` or any ``Sharding`` --
+    the mesh worker path passes ``replicated_sharding(mesh)`` so the
+    staged put replicates the pulled model over every mesh device (P
+    transfer-engine copies behind the same double buffer).
+
     ``stage(w_host)`` puts the NEXT model version on the device.  It is
     called on the prefetch thread the moment the pull reply decodes, and
     ``jax.device_put`` dispatches asynchronously -- so the host->device
@@ -263,7 +268,8 @@ def make_asgd_apply_batch(
 
 
 def make_asgd_apply_merge(
-    gamma: float, batch_rate: float, n: int, num_workers: int
+    gamma: float, batch_rate: float, n: int, num_workers: int,
+    donate_model: bool = False,
 ):
     """jit (w, G (m, d), mask (m,), k) -> (w', k') -- ``m`` coalesced PUSH
     gradients applied in ONE device dispatch, **bit-identical** to running
@@ -276,10 +282,23 @@ def make_asgd_apply_merge(
     queue's fused apply can be asserted equal to the serial path bit for
     bit.  One compile per (m, d) shape; the PS pads short batches to its
     merge bound so only one shape ever exists.
+
+    ``donate_model=True`` additionally donates ``w``: XLA writes ``w'``
+    into the dead input's buffer, so a steady-state drain allocates
+    NOTHING (donation changes aliasing only, never values -- asserted
+    bit-identical to the undonated kernel in tests/test_meshgrad.py).
+    The caller owns the lifetime discipline: every retained copy of the
+    model (snapshot stack, checkpoint capture, published pull snapshots)
+    must be a HOST copy taken before the next donated apply, because the
+    old device handle dies at dispatch -- see ``ParameterServer``'s
+    drain, which only routes a drain through the donated kernel when the
+    outgoing version is already host-published.
     """
     par_recs = batch_rate * n / num_workers
 
-    @functools.partial(jax.jit, donate_argnums=(3,))
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 3) if donate_model else (3,)
+    )
     def apply_merge(w, G, mask, k):
         def body(carry, xs):
             w, k = carry
@@ -296,17 +315,24 @@ def make_asgd_apply_merge(
 
 
 def make_saga_apply_merge(
-    gamma: float, batch_rate: float, n: int, num_workers: int
+    gamma: float, batch_rate: float, n: int, num_workers: int,
+    donate_model: bool = False,
 ):
     """jit (w, alpha_bar, G (m, d), mask (m,)) -> (w', alpha_bar') -- the
     ASAGA face of the merge-queue fused apply (``delta == g`` over DCN,
     see ``ParameterServer.__init__``), scanning the serial
     :func:`make_saga_apply` expression over the masked slots so the fused
     result is bit-identical to the one-dispatch-per-push path.
+
+    ``donate_model=True`` donates ``w`` alongside the always-donated
+    ``alpha_bar`` -- same zero-allocation drain and same caller-side
+    lifetime discipline as :func:`make_asgd_apply_merge`.
     """
     par_recs = batch_rate * n / num_workers
 
-    @functools.partial(jax.jit, donate_argnums=(1,))
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1) if donate_model else (1,)
+    )
     def apply_merge(w, alpha_bar, G, mask):
         def body(carry, xs):
             w, ab = carry
@@ -320,6 +346,122 @@ def make_saga_apply_merge(
         return w, alpha_bar
 
     return apply_merge
+
+
+# ------------------------------------------------------------- mesh steps
+# Multi-chip worker compute plane (ISSUE 11 / ROADMAP item 1): a DCN
+# worker whose host has N chips computes its mini-batch gradient
+# batch-parallel over a local ``dp`` mesh (parallel/mesh.py::make_mesh)
+# instead of on one device.  Decomposition per arXiv:1505.04956
+# (Hogwild-style data parallelism): each device holds a static row block
+# of the worker's shard (placed ONCE via pad_and_shard, resident in HBM
+# for the whole run), computes the partial gradient of its rows, and a
+# ``lax.psum`` over ``dp`` reduces the partials locally -- the worker
+# still emits ONE fused gradient per step, so the PS wire protocol is
+# untouched (one PUSH per cohort member, same payload shape).
+
+
+def make_mesh_asgd_worker_step(
+    batch_rate: float, mesh, loss: str = "least_squares", axis: str = "dp"
+):
+    """jit (Xs, ys, valid, w, key) -> (g_sum, new_key) over a ``dp`` mesh.
+
+    ``Xs``/``ys``/``valid`` are the pad_and_shard placements of the
+    worker's shard (rows split over ``axis``); ``w`` and ``key`` are
+    replicated.  Sampling is device-count-invariant: every device draws
+    the IDENTICAL full-length Bernoulli mask (replicated subkey, global
+    padded shape) and slices its own row block, so the sampled row set
+    is a function of (key, padded length) alone, not of how many chips
+    the worker happens to have.  On an unpadded shard the draw is
+    bit-identical to :func:`make_asgd_worker_step`'s dense mask.
+
+    The per-device partial is the same masked ``grad_sum`` the
+    single-device step runs on its rows; ``lax.psum`` folds the partials
+    (on this rig's CPU backend the all-reduce is a sequential
+    device-order fold -- the oracle tests/test_meshgrad.py pins bit-for-
+    bit).  The mesh path always uses the masked full-block compute: the
+    single-device step's sparse-compaction shortcut would need a
+    per-device capacity draw and buys nothing once the rows are already
+    split P ways.
+    """
+    if loss == "least_squares":
+        grad_sum = least_squares_grad_sum
+    elif loss == "logistic":
+        grad_sum = logistic_grad_sum
+    else:
+        raise ValueError(f"unknown loss {loss!r}")
+    from jax.sharding import PartitionSpec as P
+
+    from asyncframework_tpu.parallel.mesh import resolve_shard_map
+
+    n_dev = mesh.shape[axis]
+
+    @functools.partial(
+        resolve_shard_map(),
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(None), P(None)),
+        out_specs=(P(None), P(None)),
+    )
+    def _step(Xl, yl, vl, w, key):
+        key2, sub = jax.random.split(key)
+        n_l = Xl.shape[0]  # static local block length
+        p = jax.lax.axis_index(axis)
+        # replicated full-length draw, then slice my block: the mask is
+        # identical on every device and invariant to the mesh size
+        mask_full = jax.random.bernoulli(sub, batch_rate, (n_l * n_dev,))
+        ml = jax.lax.dynamic_slice_in_dim(
+            mask_full.astype(jnp.float32), p * n_l, n_l
+        ) * vl
+        g_local = grad_sum(Xl, yl, w, ml)
+        return jax.lax.psum(g_local, axis), key2
+
+    return jax.jit(_step)
+
+
+def make_mesh_saga_dcn_worker_step(mesh, axis: str = "dp"):
+    """jit (Xs, ys, w, idx, alpha_sel, n_valid) -> (g, diff_sel) -- the
+    mesh face of :func:`make_saga_dcn_worker_step`.
+
+    The PS samples row ids ``idx`` into the worker's shard and ships the
+    current history scalars ``alpha_sel`` with the model (both
+    replicated); the shard's rows live row-sharded over ``axis``.  Each
+    sampled slot is OWNED by exactly one device (the one holding that
+    row): the owner gathers its row locally, computes the candidate
+    scalar ``diff_j = x_j . w - y_j`` and the slot's gradient
+    contribution ``(diff_j - alpha_j) x_j``; non-owners contribute exact
+    zeros.  Two psums assemble the full (cap,) candidate vector and the
+    fused (d,) gradient -- the same values the single-device step
+    produces, decomposed by row ownership.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from asyncframework_tpu.parallel.mesh import resolve_shard_map
+
+    @functools.partial(
+        resolve_shard_map(),
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(None), P(None), P(None), P()),
+        out_specs=(P(None), P(None)),
+    )
+    def _step(Xl, yl, w, idx, alpha_sel, n_valid):
+        cap = idx.shape[0]
+        n_l = Xl.shape[0]
+        p = jax.lax.axis_index(axis)
+        valid = jnp.arange(cap) < n_valid
+        local = idx - p * n_l
+        mine = valid & (local >= 0) & (local < n_l)
+        li = jnp.clip(local, 0, n_l - 1)
+        vm = mine.astype(jnp.float32)
+        Xs_ = Xl[li]  # (cap, d) LOCAL gather -- only my rows are real
+        diff_l = (mm_f32(Xs_, w) - yl[li]) * vm
+        g_l = mm_f32(Xs_.T, (diff_l - alpha_sel) * vm)
+        # each slot has exactly one owner: the psums add zeros to the
+        # owner's value (slot-exact) and fold the per-device gradient
+        # partials (device-order, like the ASGD mesh step)
+        g, diff = jax.lax.psum((g_l, diff_l), axis)
+        return g, diff
+
+    return jax.jit(_step)
 
 
 # ------------------------------------------------------------------ sparse

@@ -11,11 +11,69 @@ replicated, but the axis is wired through so the same code scales).
 
 from __future__ import annotations
 
+import functools
+import logging
 from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_shard_map():
+    """The one shard_map entry point for the whole repo.
+
+    ``shard_map`` moved across jax releases: new jax exposes
+    ``jax.shard_map`` (keyword-only ``mesh``/``in_specs``/``out_specs``,
+    ``check_vma=``), older installs only have
+    ``jax.experimental.shard_map.shard_map`` (``check_rep=`` instead of
+    ``check_vma=``, no varying-manual-axes tracking).  Every call site
+    routes through this resolver so one install difference is absorbed in
+    one place.  The returned callable always speaks the NEW surface --
+    ``check_vma=`` is accepted (and honored natively); the fallback runs
+    with ``check_rep=False`` unconditionally -- the old checker's
+    replication inference has known false positives the new API fixed
+    (scan carries whose rep sets converge only after a fixed point, e.g.
+    "Scan carry input and output got mismatched replication types ...
+    as a temporary workaround pass the check_rep=False argument", and
+    reductions of ``all_gather`` outputs).  Both flags are trace-time
+    diagnostics only; disabling one never changes numerics, and the
+    new-API path keeps full vma checking wherever it exists.
+    """
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def _compat(f=None, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        del check_vma  # legacy check_rep: known false positives (above)
+        if f is None:
+            return functools.partial(
+                _compat, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kw,
+            )
+        return _legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False, **kw,
+        )
+
+    return _compat
+
+
+def pcast_varying(x, axis: str):
+    """``jax.lax.pcast(x, axis, to="varying")`` where available.
+
+    Legacy jax (the ``jax.experimental.shard_map`` era) has no
+    varying-manual-axes tracking, so there is nothing to cast -- the
+    value is returned unchanged and ``check_rep`` does its own (coarser)
+    replication inference.
+    """
+    pc = getattr(jax.lax, "pcast", None)
+    if pc is None:
+        return x
+    return pc(x, (axis,), to="varying")
 
 
 def make_mesh(
@@ -23,25 +81,44 @@ def make_mesh(
     axis_names: Tuple[str, ...] = ("dp",),
     axis_sizes: Optional[Tuple[int, ...]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    clamp: bool = False,
 ) -> Mesh:
     """Create a mesh over the first ``n_devices`` (default: all).
 
     For multi-host deployments callers run ``jax.distributed.initialize()``
     first; ``jax.devices()`` then spans hosts and the same mesh code rides
     ICI within a slice and DCN across slices.
+
+    ``clamp=True``: an ``n_devices`` beyond what the rig actually has is
+    CLAMPED to the available device count (logged) instead of raising --
+    the conf-driven path (``async.mesh.devices`` on a worker daemon) must
+    degrade on a smaller rig, never crash the process.  The default stays
+    strict: a programmatic caller asking for devices that are not there is
+    a bug worth a traceback.
     """
     devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         if len(devs) < n_devices:
-            raise ValueError(
-                f"requested a {n_devices}-device mesh but only {len(devs)} "
-                f"devices are available"
+            if not clamp:
+                raise ValueError(
+                    f"requested a {n_devices}-device mesh but only "
+                    f"{len(devs)} devices are available"
+                )
+            logger.warning(
+                "make_mesh: requested %d devices but only %d available; "
+                "clamping", n_devices, len(devs),
             )
+            n_devices = len(devs)
         devs = devs[:n_devices]
     if axis_sizes is None:
         axis_sizes = (len(devs),) + (1,) * (len(axis_names) - 1)
     arr = np.array(devs).reshape(axis_sizes)
-    return Mesh(arr, axis_names)
+    mesh = Mesh(arr, axis_names)
+    if clamp:
+        logger.info("make_mesh: using mesh %s over %d %s device(s)",
+                    dict(zip(axis_names, axis_sizes)), len(devs),
+                    devs[0].platform if devs else "?")
+    return mesh
 
 
 def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:

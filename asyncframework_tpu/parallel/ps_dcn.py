@@ -550,15 +550,25 @@ class ParameterServer:
             zm = jax.device_put(
                 jnp.zeros(self._merge_max, jnp.float32), self.device
             )
+            # donate_model: the fused drain writes w' into the dead
+            # input's buffer -- zero steady-state allocation.  The drain
+            # only routes a batch through this kernel when the outgoing
+            # version is already HOST-published (its _ModelSnap exists),
+            # so nothing can ever need the donated device buffer again;
+            # otherwise it falls back to the serial per-item applies
+            # (asserted bit-identical).  Warm dummies are donated too --
+            # zw/zk2/zab2 are dead after this call by construction.
             if algo == "asaga":
                 self._apply_merge = steps.make_saga_apply_merge(
-                    cfg.gamma, cfg.batch_rate, n, cfg.num_workers
+                    cfg.gamma, cfg.batch_rate, n, cfg.num_workers,
+                    donate_model=True,
                 )
                 zab2 = jax.device_put(jnp.zeros(d, jnp.float32), self.device)
                 self._apply_merge(zw, zab2, zG, zm)
             else:
                 self._apply_merge = steps.make_asgd_apply_merge(
-                    cfg.gamma, cfg.batch_rate, n, cfg.num_workers
+                    cfg.gamma, cfg.batch_rate, n, cfg.num_workers,
+                    donate_model=True,
                 )
                 zk2 = jax.device_put(jnp.float32(0.0), self.device)
                 self._apply_merge(zw, zG, zm, zk2)
@@ -641,7 +651,7 @@ class ParameterServer:
         self._t0 = time.monotonic() - self._elapsed_offset_ms / 1e3
         with self._lock:
             if self.resumed_from_k is None:
-                self._snapshots.append((0.0, np.asarray(self._w)))
+                self._snapshots.append((0.0, np.array(self._w, np.float32)))
             if self._k >= self.cfg.num_iterations:
                 self._done.set()  # checkpoint was already past the finish
                 if self.supervisor is not None:
@@ -725,13 +735,15 @@ class ParameterServer:
             "epoch": self.epoch,
             "fenced_rejects": self.fenced_rejects,
         }
-        arrays = {"w": np.asarray(self._w, np.float32)}
+        # owned copies, never device-buffer views: a later donated drain
+        # overwrites the model buffer in place
+        arrays = {"w": np.array(self._w, np.float32)}
         if self._snapshots:
             arrays["snap_stack"] = np.stack(
                 [np.asarray(w) for (_t, w) in self._snapshots]
             )
         if self.algo == "asaga":
-            arrays["ab"] = np.asarray(self._ab, np.float32)
+            arrays["ab"] = np.array(self._ab, np.float32)
             with self._saga_lock:  # consistent table + RNG capture
                 for wid, table in self._table.items():
                     arrays[f"table_{wid}"] = table.copy()
@@ -1121,9 +1133,20 @@ class ParameterServer:
             # readback and peer builders on _snap_build_lock
             basis = self._snap_basis
             ts, w_dev, gen = basis
-            # device readback without any lock: the updater rebinds _w to
-            # NEW buffers (w is never donated), so this one is immutable
-            w_host = np.asarray(w_dev)
+            # device readback without any lock.  The fused drain DONATES
+            # the model buffer (in-place apply), so two disciplines:
+            # (1) w_host must be an owned COPY, never a view of device
+            # memory (np.asarray of a CPU jax array aliases the buffer);
+            # (2) a donated drain can invalidate the basis buffer between
+            # our tuple read and the readback -- it redirects the basis
+            # (to the outgoing version's host copy) BEFORE the donating
+            # dispatch, so one re-read always lands on valid memory.
+            try:
+                w_host = np.array(w_dev, np.float32)
+            except Exception:
+                basis = self._snap_basis
+                ts, w_dev, gen = basis
+                w_host = np.array(w_dev, np.float32)
             wire = w_host.tobytes()
             snap = _ModelSnap(int(ts), w_host, wire, wiredelta.crc(wire),
                               int(gen))
@@ -1513,6 +1536,15 @@ class ParameterServer:
 
         drained: List[_PendingPush] = []
         batch: List[Tuple[_PendingPush, Optional[np.ndarray]]] = []
+        # donation guard, captured BEFORE any accept mutates gen/_snap:
+        # the fused kernel donates the model buffer (in-place apply), so
+        # it may only run when the OUTGOING version already exists as a
+        # host-side _ModelSnap -- then no rebuild, checkpoint, or delta
+        # encode can ever need the donated device buffer again.  The
+        # accepted-push pre-warm (_model_snap right after each drain)
+        # makes this the overwhelmingly common case.
+        prev_snap = self._snap
+        prev_gen = self._model_gen
         while self._merge_q and len(drained) < self._merge_max:
             item = self._merge_q.popleft()
             drained.append(item)
@@ -1596,9 +1628,26 @@ class ParameterServer:
                 # host copy must be exactly version k, not a later one
                 break
         if batch:
+            donate_ok = (prev_snap is not None
+                         and prev_snap.gen == prev_gen)
             if len(batch) == 1 or self._apply_merge is None:
                 self._apply_one(batch[0][0], batch[0][1])
+            elif not donate_ok:
+                # outgoing version not host-published (two drains raced
+                # faster than the off-lock pre-warm): the fused kernel
+                # would donate a device buffer the next rebuild still
+                # needs.  Apply serially instead -- the merge kernel is
+                # bit-identical to this order by contract, so the model
+                # cannot tell which path ran.
+                for it, idx2 in batch:
+                    self._apply_one(it, idx2)
             else:
+                # donation window: until this drain publishes its new
+                # basis below, point rebuilds at the HOST copy of the
+                # outgoing version -- the device buffer dies the moment
+                # the donated dispatch below runs
+                self._snap_basis = (prev_snap.ts, prev_snap.w_host,
+                                    prev_snap.gen)
                 # ONE fused device dispatch for the whole drained batch:
                 # padded to the static merge bound so the kernel compiles
                 # once, masked so padding slots are no-ops.  The scratch is
@@ -1646,9 +1695,10 @@ class ParameterServer:
             if item.do_snapshot:
                 # host copy NOW: the snapshot must pin this version (the
                 # boundary item closed its batch above, so _w is exactly
-                # the k it rode in on)
+                # the k it rode in on).  Owned copy, not a buffer view:
+                # a later donated drain overwrites the device memory
                 self._snapshots.append(
-                    (self._now_ms(), np.asarray(self._w))
+                    (self._now_ms(), np.array(self._w, np.float32))
                 )
             if item.tc is not None:
                 item.t_done = _trace.now_ms()
@@ -1743,7 +1793,7 @@ class ParameterServer:
 
     def snapshot_stack(self) -> Tuple[List[float], np.ndarray]:
         with self._lock:
-            final = (self._now_ms(), np.asarray(self._w))
+            final = (self._now_ms(), np.array(self._w, np.float32))
             snaps = list(self._snapshots) + [final]
         times = [t for (t, _w) in snaps]
         W = np.stack([w for (_t, w) in snaps])
@@ -2674,6 +2724,81 @@ def run_worker_process(
         # alternation; pipelining is an ASGD-path capability.
         pipe_depth = 0
     pl_stats = _PipelineStats() if pipe_depth > 0 else None
+    # mesh compute plane (async.mesh.devices / SolverConfig.mesh_devices):
+    # 0 = the classic single-device gradient step below, byte- and step-
+    # identical; >= 2 = each logical worker computes its mini-batch
+    # gradient batch-parallel over a LOCAL dp mesh -- shard rows are
+    # padded+sharded into HBM once per run (pad_and_shard), per-device
+    # partial gradients psum-reduce on the mesh, and the loop still
+    # pushes ONE fused gradient per step (the wire cannot tell).  A conf
+    # asking for more chips than the rig has clamps (make_mesh clamp=
+    # True, logged); fewer than 2 effective devices, or sparse
+    # (padded-ELL) shards, degrade to the serial path -- an operator
+    # overshooting a knob must cost a warning, never the worker daemon.
+    mesh_devices = getattr(cfg, "mesh_devices", None)
+    if mesh_devices is None:
+        from asyncframework_tpu.conf import MESH_DEVICES, global_conf
+
+        mesh_devices = global_conf().get(MESH_DEVICES)
+    mesh_devices = max(0, int(mesh_devices))
+    worker_mesh = None
+    mesh_step = None
+    mesh_replicated = None
+    if mesh_devices:
+        import logging as _logging
+
+        _mlog = _logging.getLogger(__name__)
+        from asyncframework_tpu.parallel.mesh import (
+            make_mesh,
+            replicated_sharding,
+        )
+
+        if sparse:
+            _mlog.warning(
+                "async.mesh.devices=%d ignored: sparse (padded-ELL) "
+                "shards run the single-device step", mesh_devices,
+            )
+        else:
+            # make_mesh owns the clamp: an over-ask logs the documented
+            # "requested N but only M available; clamping" warning there
+            mesh = make_mesh(mesh_devices, clamp=True)
+            if mesh.devices.size < 2:
+                _mlog.warning(
+                    "async.mesh.devices=%d yields a %d-device mesh; "
+                    "running the single-device step", mesh_devices,
+                    mesh.devices.size,
+                )
+            else:
+                worker_mesh = mesh
+                mesh_replicated = replicated_sharding(worker_mesh)
+                if algo == "asaga":
+                    mesh_step = steps.make_mesh_saga_dcn_worker_step(
+                        worker_mesh
+                    )
+                else:
+                    mesh_step = steps.make_mesh_asgd_worker_step(
+                        cfg.batch_rate, worker_mesh, cfg.loss
+                    )
+    # one-time per-wid mesh placement (HBM-resident across the run);
+    # built lazily under its own lock so adopted shards place too
+    mesh_lock = threading.Lock()
+    mesh_placed: Dict[int, tuple] = {}
+
+    def mesh_place(wid: int, shard):
+        """Row-shard this wid's batch over the worker mesh ONCE."""
+        if worker_mesh is None:
+            return None
+        with mesh_lock:
+            got = mesh_placed.get(wid)
+        if got is not None:
+            return got
+        from asyncframework_tpu.parallel.mesh import pad_and_shard
+
+        Xs, ys, vs, _n = pad_and_shard(
+            worker_mesh, np.asarray(shard.X), np.asarray(shard.y)
+        )
+        with mesh_lock:
+            return mesh_placed.setdefault(wid, (Xs, ys, vs))
     # convergence telemetry (async.convergence.sample /
     # SolverConfig.conv_sample): every Nth update per logical worker
     # evaluates the shard's mean loss (one extra jitted eval against the
@@ -2753,18 +2878,33 @@ def run_worker_process(
     def shard_dev(shard):
         return (shard.cols if sparse else shard.X).device
 
-    def run_step(shard, w_dev, key):
-        """Dense/sparse ASGD: (g, new_key)."""
+    def run_step(shard, w_dev, key, placed=None):
+        """Dense/sparse/mesh ASGD: (g, new_key)."""
+        if placed is not None:
+            Xs, ys, vs = placed
+            return mesh_step(Xs, ys, vs, w_dev, key)
         if sparse:
             return step(shard.cols, shard.vals, shard.y, w_dev, key)
         return step(shard.X, shard.y, w_dev, key)
 
-    def run_saga_step(shard, w_dev, idx_dev, alpha_dev, n_valid):
-        """Dense/sparse DCN-ASAGA: (g, diff_sel)."""
+    def run_saga_step(shard, w_dev, idx_dev, alpha_dev, n_valid,
+                      placed=None):
+        """Dense/sparse/mesh DCN-ASAGA: (g, diff_sel)."""
+        if placed is not None:
+            Xs, ys, _vs = placed
+            return mesh_step(Xs, ys, w_dev, idx_dev, alpha_dev, n_valid)
         if sparse:
             return step(shard.cols, shard.vals, shard.y, w_dev, idx_dev,
                         alpha_dev, n_valid)
         return step(shard.X, shard.y, w_dev, idx_dev, alpha_dev, n_valid)
+
+    def put_model(w_host, dev, placed):
+        """Host model -> device(s): replicated over the mesh when this
+        wid computes mesh-parallel, the classic single-device put
+        otherwise."""
+        if placed is not None:
+            return jax.device_put(w_host, mesh_replicated)
+        return jax.device_put(w_host, dev)
 
     # warm every owned shard's executable BEFORE the first pull
     # (first-iteration-blocking parity): without this, compile skew across
@@ -2778,21 +2918,26 @@ def run_worker_process(
         dev = shard_dev(shard)
         n_p = int(shard.y.shape[0])
         shape = (shard.cols if sparse else shard.X).shape
-        if (shape, dev) in warmed:
+        placed = mesh_place(wid, shard)  # one-time HBM placement per wid
+        wkey = (shape, "mesh" if placed is not None else dev)
+        if wkey in warmed:
             continue
-        warmed.add((shape, dev))
-        w0 = jax.device_put(jnp.zeros(d, jnp.float32), dev)
+        warmed.add(wkey)
+        w0 = put_model(np.zeros(d, np.float32), dev, placed)
         if algo == "asaga":
             cap = steps.sparse_step_capacity(cfg.batch_rate, n_p)
             g0, _ = run_saga_step(
                 shard, w0,
-                jax.device_put(jnp.zeros(cap, jnp.int32), dev),
-                jax.device_put(jnp.zeros(cap, jnp.float32), dev),
-                np.int32(0),
+                np.zeros(cap, np.int32) if placed is not None
+                else jax.device_put(jnp.zeros(cap, jnp.int32), dev),
+                np.zeros(cap, np.float32) if placed is not None
+                else jax.device_put(jnp.zeros(cap, jnp.float32), dev),
+                np.int32(0), placed=placed,
             )
         else:
-            key0 = jax.device_put(jax.random.PRNGKey(0), dev)
-            g0, _ = run_step(shard, w0, key0)
+            key0 = (jax.random.PRNGKey(0) if placed is not None
+                    else jax.device_put(jax.random.PRNGKey(0), dev))
+            g0, _ = run_step(shard, w0, key0, placed=placed)
         g0.block_until_ready()
 
     def adopt(orphan: int) -> None:
@@ -2820,11 +2965,12 @@ def run_worker_process(
     def worker_loop(wid: int) -> None:
         shard = shards[wid]
         dev = shard_dev(shard)
+        placed = mesh_place(wid, shard)  # None = single-device step
         key = None
         if algo != "asaga":  # ASAGA samples PS-side; workers need no chain
-            key = jax.device_put(
-                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid), dev
-            )
+            key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid)
+            key = (jax.device_put(key, mesh_replicated)
+                   if placed is not None else jax.device_put(key, dev))
         deadline = time.monotonic() + deadline_s
         cl: Optional[PSClient] = None
         try:
@@ -2868,14 +3014,17 @@ def run_worker_process(
                     dly = delay_model.delay_ms(wid) if calibrated else 0.0
                     if dly > 0:
                         time.sleep(dly / 1e3)
-                    w_dev = jax.device_put(w_host, dev)
+                    w_dev = put_model(w_host, dev, placed)
                     counts[wid] += 1
                     if algo == "asaga":
+                        idx32 = idx.astype(np.int32)
                         g, diff = run_saga_step(
                             shard, w_dev,
-                            jax.device_put(idx.astype(np.int32), dev),
-                            jax.device_put(alpha_sel, dev),
-                            np.int32(n_valid),
+                            idx32 if placed is not None
+                            else jax.device_put(idx32, dev),
+                            alpha_sel if placed is not None
+                            else jax.device_put(alpha_sel, dev),
+                            np.int32(n_valid), placed=placed,
                         )
                         g_host = np.asarray(g)
                         diff_host = np.asarray(diff)
@@ -2883,20 +3032,29 @@ def run_worker_process(
                             tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
                         if cv_buf is not None and \
                                 counts[wid] % conv_every == 0:
-                            conv_sample(shard, w_dev, ts, g_host)
+                            # mesh path: the shard-loss eval runs on the
+                            # shard's own device -- hand it the HOST
+                            # model, not the mesh-replicated handle
+                            # (committed-device mismatch would raise)
+                            conv_sample(shard,
+                                        w_host if placed is not None
+                                        else w_dev, ts, g_host)
                         _accepted, done = cl.push_saga(
                             wid, ts, g_host, diff_host, sparse=sparse,
                             tr=tr,
                         )
                     else:
-                        g, new_key = run_step(shard, w_dev, key)
+                        g, new_key = run_step(shard, w_dev, key,
+                                              placed=placed)
                         key = new_key
                         g_host = np.asarray(g)  # the push IS the readback
                         if tr is not None:
                             tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
                         if cv_buf is not None and \
                                 counts[wid] % conv_every == 0:
-                            conv_sample(shard, w_dev, ts, g_host)
+                            conv_sample(shard,
+                                        w_host if placed is not None
+                                        else w_dev, ts, g_host)
                         _accepted, done = cl.push(wid, ts, g_host,
                                                   sparse=sparse, tr=tr)
                     if done:
@@ -2952,13 +3110,27 @@ def run_worker_process(
         replies, so they arrive on the prefetch connection),
         RELEASED/DONE, and trace spans all keep working; the residual
         stall (blocking in pull_finish or on the window cap) is
-        recorded as the ``pipeline`` trace stage."""
+        recorded as the ``pipeline`` trace stage.
+
+        Mesh interaction (``async.mesh.devices``): with a worker mesh
+        the staged host->device put replicates the pulled model over
+        every mesh device (make_pipelined_transfer handed the mesh's
+        replicated sharding) -- the P transfer-engine
+        copies overlap step k's compute exactly like the single-device
+        double buffer, and the psum at the end of the mesh step overlaps
+        the next prefetch's RTT the same way single-device compute did.
+        Everything else (two connections, bounded window, exactly-once
+        replay) is mesh-oblivious: the loop pushes the one fused
+        gradient the mesh step returns."""
         shard = shards[wid]
         dev = shard_dev(shard)
-        stage, readback = steps.make_pipelined_transfer(dev)
-        key = jax.device_put(
-            jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid), dev
+        placed = mesh_place(wid, shard)  # None = single-device step
+        stage, readback = steps.make_pipelined_transfer(
+            mesh_replicated if placed is not None else dev
         )
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), wid)
+        key = (jax.device_put(key, mesh_replicated)
+               if placed is not None else jax.device_put(key, dev))
         deadline = time.monotonic() + deadline_s
         pull_cl: Optional[PSClient] = None
         push_cl: Optional[PSClient] = None
@@ -3052,12 +3224,14 @@ def run_worker_process(
                     time.sleep(dly / 1e3)
                 w_dev = stage(w_host)
                 counts[wid] += 1
-                g, key = run_step(shard, w_dev, key)
+                g, key = run_step(shard, w_dev, key, placed=placed)
                 g_host = readback(g)
                 if cur_tr is not None:
                     cur_tr.add(_trace.COMPUTE, t_c0, _trace.now_ms())
                 if cv_buf is not None and counts[wid] % conv_every == 0:
-                    conv_sample(shard, w_dev, ts, g_host)
+                    conv_sample(shard,
+                                w_host if placed is not None else w_dev,
+                                ts, g_host)
                 # depth cap: at most pipe_depth unACKed pushes in flight
                 # -- THE staleness bound the taw admission prices.  Reap
                 # lazily: ACKs usually sit in the buffer already.

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from asyncframework_tpu.parallel.mesh import pcast_varying, resolve_shard_map
+
 _NEG = -1e30  # mask fill / softmax-max init: finite so (-inf) - (-inf) never NaNs
 
 
@@ -132,7 +134,7 @@ def ring_attention(
     use_vma = block_kernel != "pallas"
 
     @functools.partial(
-        jax.shard_map,
+        resolve_shard_map(),
         mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None),
@@ -140,7 +142,7 @@ def ring_attention(
     )
     def ring(ql, kl, vl):
         p_idx = jax.lax.axis_index(axis)
-        P_sz = jax.lax.axis_size(axis)
+        P_sz = n_dev  # static mesh axis size (jax.lax.axis_size is new-API)
         b, tq, h, d = ql.shape
         t_local = kl.shape[1]
         # pcast to varying: the accumulators become device-varying on the sp
@@ -149,7 +151,7 @@ def ring_attention(
         def varying(x):
             if not use_vma:
                 return x  # vma tracking off: pcast is meaningless
-            return jax.lax.pcast(x, (axis,), to="varying")
+            return pcast_varying(x, axis)
 
         m0 = varying(jnp.full((b, h, tq), _NEG, jnp.float32))
         l0 = varying(jnp.zeros((b, h, tq), jnp.float32))
@@ -237,7 +239,7 @@ def ulysses_attention(
         )
 
     @functools.partial(
-        jax.shard_map,
+        resolve_shard_map(),
         mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None),

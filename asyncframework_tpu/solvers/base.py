@@ -319,6 +319,15 @@ class SolverConfig:
     # >= 1 = prefetched pulls on a second connection + a bounded
     # in-flight push sender with at most this many unacked pushes).
     pipeline_depth: Optional[int] = None
+    # mesh_devices: None = resolve from conf async.mesh.devices (0 = the
+    # classic single-device worker gradient step, byte- and step-
+    # identical; >= 2 = each DCN worker computes its mini-batch gradient
+    # batch-parallel over a local dp mesh of this many chips -- shard
+    # rows resident in HBM across the run, per-device partials psum-
+    # reduced locally, ONE fused gradient per PUSH, wire unchanged).
+    # Clamped to the rig's device count; degrades to the serial path
+    # (logged) when fewer than 2 devices result or the shard is sparse.
+    mesh_devices: Optional[int] = None
     # checkpoint/resume (SURVEY.md section 5: a capability the reference lacks)
     checkpoint_dir: Optional[str] = None  # None = checkpointing off
     checkpoint_freq: int = 0              # accepted updates between saves; 0 = off

@@ -37,7 +37,11 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from asyncframework_tpu.metrics import trace as _trace
-from asyncframework_tpu.parallel.mesh import make_mesh, pad_and_shard
+from asyncframework_tpu.parallel.mesh import (
+    make_mesh,
+    pad_and_shard,
+    resolve_shard_map,
+)
 
 
 class MiniBatchSGD:
@@ -145,7 +149,7 @@ class MiniBatchSGD:
         out_specs = (P(md_axis), P(None), P(None, md_axis))
 
         @partial(
-            jax.shard_map,
+            resolve_shard_map(),
             mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs,

@@ -689,6 +689,123 @@ def run_dcn_child() -> None:
     emit({"dcn": out})
 
 
+def run_dcn_mesh_child() -> None:
+    """Mesh-arm DCN bench (ISSUE 11): the dense config with the worker
+    gradient step single-device (``async.mesh.devices=0``, the control)
+    vs batch-parallel over an 8-device mesh, in a child whose platform
+    is 8 FORCED-HOST CPU devices (the parent sets XLA_FLAGS; the rig's
+    TPU tunnel is routinely dead, so the CPU arm is the control of
+    record).  Records updates/s, the per-step compute p50 from the trace
+    decomposition, the actual mesh shape, and -- like every MULTICHIP
+    emit -- ``jax.device_count()`` + platform, so a dead-TPU fallback
+    run is distinguishable from a real 1-chip run in the trajectory.
+
+    Loopback reality check (same story as PR 4's delta bytes and PR 8's
+    shard fan-out): on virtual CPU devices the psum and the P-way
+    emulated dispatch are pure overhead -- the win this arm exists to
+    price appears when the per-device partial gradient runs on a real
+    chip and the all-reduce rides ICI.  The compute-p50 decomposition is
+    what makes the A-B readable either way.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from asyncframework_tpu.conf import AsyncConf, set_global_conf
+    from asyncframework_tpu.data.sharded import ShardedDataset
+    from asyncframework_tpu.metrics import trace as trace_mod
+    from asyncframework_tpu.net import reset_net_totals
+    from asyncframework_tpu.parallel import ps_dcn
+    from asyncframework_tpu.solvers import SolverConfig
+
+    devices = jax.devices()
+    mesh_n = max(1, int(os.environ.get("BENCH_DCN_MESH_DEVICES", "8")))
+    c = DCN_CONFIGS["dense"]
+    ds = ShardedDataset.generate_on_device(
+        c["n"], c["d"], c["nw"], devices=devices, seed=7, noise=0.01,
+    )
+    out = {
+        "device_count": jax.device_count(),
+        "platform": devices[0].platform,
+        "requested_mesh_devices": mesh_n,
+    }
+    for label, mesh_dev in (("mesh_off", 0), ("mesh_on", mesh_n)):
+        conf = AsyncConf()
+        conf.set("async.pull.mode", "full")
+        conf.set("async.pipeline.depth", 0)
+        conf.set("async.mesh.devices", mesh_dev)
+        conf.set("async.trace.sample", 1.0 / 8.0)
+        set_global_conf(conf)
+        reset_net_totals()
+        trace_mod.reset_aggregator()
+        cfg = SolverConfig(
+            num_workers=c["nw"], num_iterations=c["iters"],
+            gamma=c["gamma"], taw=2**31 - 1,
+            batch_rate=c["batch_rate"], bucket_ratio=0.5,
+            printer_freq=100, coeff=0.0, seed=42,
+            calibration_iters=20, run_timeout_s=120.0,
+        )
+        ps = ps_dcn.ParameterServer(
+            cfg, c["d"], c["n"], device=devices[0], port=0
+        ).start()
+        shards = {w: ds.shard(w) for w in range(c["nw"])}
+        t0 = time.monotonic()
+        ps_dcn.run_worker_process(
+            "127.0.0.1", ps.port, list(range(c["nw"])), shards, cfg,
+            c["d"], c["n"], deadline_s=120.0,
+        )
+        done = ps.wait_done(timeout_s=5.0)
+        elapsed = time.monotonic() - t0
+        ps.stop()
+        stages = trace_mod.aggregator().snapshot().get("stages_ms", {})
+        eff = min(mesh_dev, len(devices)) if mesh_dev else 0
+        out[label] = {
+            "ok": bool(done),
+            "accepted": ps.accepted,
+            "updates_per_sec": round(ps.accepted / elapsed, 1)
+            if elapsed > 0 else None,
+            # the worker-side gradient step is the stage the mesh
+            # parallelizes: its p50 is the per-step compute cost
+            "compute_p50_ms": round(
+                stages.get(trace_mod.COMPUTE, {}).get("p50", 0.0), 3
+            ) or None,
+            "mesh_shape": {"dp": eff} if eff >= 2 else None,
+            "max_staleness": ps.max_staleness,
+        }
+    off = out["mesh_off"]["updates_per_sec"]
+    on = out["mesh_on"]["updates_per_sec"]
+    out["mesh_speedup"] = round(on / off, 3) if off and on else None
+    emit({"dcn_mesh": out})
+
+
+def collect_dcn_mesh_block(env: dict) -> dict:
+    """Run the mesh arm in a disposable subprocess whose platform is
+    forced to 8 virtual host devices (XLA latches the flag at backend
+    init, so the fan-out must happen at process birth)."""
+    env2 = dict(env)
+    env2["JAX_PLATFORMS"] = "cpu"
+    flags = env2.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env2["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--dcn-mesh"],
+            capture_output=True, text=True, timeout=600, env=env2,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "dcn mesh bench timed out"}
+    sys.stderr.write(res.stderr)
+    line = next((l for l in reversed(res.stdout.splitlines())
+                 if l.startswith("{")), None)
+    if line is None:
+        return {"error": f"no JSON from dcn mesh child "
+                         f"(rc={res.returncode})"}
+    return json.loads(line).get(
+        "dcn_mesh", {"error": "malformed dcn mesh payload"}
+    )
+
+
 def collect_dcn_block(env: dict) -> dict:
     """Run the DCN wire bench in a disposable subprocess (same discipline
     as every other measurement: fresh process, parent owns the timeout)."""
@@ -1388,6 +1505,14 @@ def run_parent() -> None:
                                       else str(payload["dcn"])}
                 payload["dcn"]["shards"] = retry["shards"]
                 payload["dcn"]["shards_note"] = "recovered by retry pass"
+        if os.environ.get("BENCH_DCN_MESH", "1") != "0":
+            # mesh gradient-plane arm (ISSUE 11): single-device vs
+            # 8-forced-host-device worker step on the dense config; its
+            # own child so the forced device count cannot perturb the
+            # other arms' shard placement
+            if not isinstance(payload["dcn"], dict):
+                payload["dcn"] = {"error": str(payload["dcn"])}
+            payload["dcn"]["mesh"] = collect_dcn_mesh_block(env)
     if os.environ.get("BENCH_SERVE", "1") != "0":
         # serving-tier bench (CPU loopback): QPS vs freshness lag per
         # replica count with training concurrently running, including the
@@ -1408,6 +1533,14 @@ def run_parent() -> None:
 
 
 def main() -> None:
+    if "--dcn-mesh" in sys.argv:
+        try:
+            run_dcn_mesh_child()
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            emit({"dcn_mesh":
+                  {"error": f"{type(e).__name__}: {str(e)[:200]}"}})
+        os._exit(0)
     if "--dcn" in sys.argv:
         try:
             run_dcn_child()
