@@ -41,14 +41,30 @@ _OP_RE = re.compile(r"^[A-Z][A-Z_]+$")
 PS_DCN_PATH = "asyncframework_tpu/parallel/ps_dcn.py"
 FAULTS_PATH = "asyncframework_tpu/net/faults.py"
 
-#: the client-side fencing stamp choke point: every PS-plane client op
-#: header flows through this function (PSClient._proc_hdr; the sharded
-#: facade and serving replicas ride PSClient sub-clients, so there is
-#: exactly one).  The rule requires the ``["ep"]`` assignment INSIDE it
-#: -- an ``ep`` write elsewhere (the server advertising its epoch on
-#: replies) must not satisfy the client-stamp obligation.
-FENCE_CLIENT_PATHS = (PS_DCN_PATH,)
-FENCE_STAMP_FN = "_proc_hdr"
+#: server modules that owe fence-stamped ops a ``_fence_reject``
+#: admission call in their dispatch branches (the PS plane and the
+#: relaycast plane -- the two places a zombie incarnation could serve
+#: or mutate state it no longer owns)
+FENCE_SERVER_PATHS = (
+    PS_DCN_PATH,
+    "asyncframework_tpu/relaycast/node.py",
+)
+
+#: the client-side fencing stamp choke points, path -> stamping function:
+#: every PS-plane client op header flows through PSClient._proc_hdr (the
+#: sharded facade and serving replicas ride PSClient sub-clients, so
+#: there is exactly one), and every relay hop through
+#: RelaySource._stamped.  The rule requires the ``["ep"]`` assignment
+#: INSIDE the named function -- an ``ep`` write elsewhere (a server
+#: advertising its epoch on replies) must not satisfy the client-stamp
+#: obligation.
+FENCE_CLIENT_STAMPS = {
+    PS_DCN_PATH: "_proc_hdr",
+    "asyncframework_tpu/relaycast/source.py": "_stamped",
+}
+# legacy aliases (kept: the acceptance tests and docs name them)
+FENCE_CLIENT_PATHS = tuple(FENCE_CLIENT_STAMPS)
+FENCE_STAMP_FN = FENCE_CLIENT_STAMPS[PS_DCN_PATH]
 
 
 def _is_op_compare(node: ast.Compare) -> bool:
@@ -240,30 +256,32 @@ def check(ctx: LintContext) -> List[Finding]:
                 f"stays the single source of truth"))
 
     # 4. fencing: server-side admission per branch, client-side stamp
-    for op in sorted(protocol.fence_stamped_ops()):
-        if ps is None:
-            break
-        branches = _dispatch_branches(ps, op)
-        if not branches:
+    for path in FENCE_SERVER_PATHS:
+        sf = ctx.get(path)
+        if sf is None:
             continue
-        fenced = any(
-            isinstance(n, (ast.Attribute, ast.Name))
-            and tail_name(n) == "_fence_reject"
-            for branch in branches for n in _branch_scope(branch))
-        if not fenced:
-            findings.append(Finding(
-                "proto-fence-gate", PS_DCN_PATH, branches[0].lineno, op,
-                f"dispatch branch for fence-stamped op {op!r} never "
-                f"calls _fence_reject -- a zombie incarnation would "
-                f"serve/apply it (async.fence.enabled)"))
+        for op in sorted(protocol.fence_stamped_ops()):
+            branches = _dispatch_branches(sf, op)
+            if not branches:
+                continue
+            fenced = any(
+                isinstance(n, (ast.Attribute, ast.Name))
+                and tail_name(n) == "_fence_reject"
+                for branch in branches for n in _branch_scope(branch))
+            if not fenced:
+                findings.append(Finding(
+                    "proto-fence-gate", path, branches[0].lineno, op,
+                    f"dispatch branch for fence-stamped op {op!r} never "
+                    f"calls _fence_reject -- a zombie incarnation would "
+                    f"serve/apply it (async.fence.enabled)"))
     if protocol.fence_stamped_ops():
-        for path in FENCE_CLIENT_PATHS:
+        for path, stamp_fn in FENCE_CLIENT_STAMPS.items():
             sf = ctx.get(path)
             if sf is None:
                 continue
             stamps = any(
                 isinstance(fn, ast.FunctionDef)
-                and fn.name == FENCE_STAMP_FN
+                and fn.name == stamp_fn
                 and any(
                     isinstance(node, ast.Assign) and node.targets and
                     isinstance(node.targets[0], ast.Subscript) and
@@ -275,7 +293,7 @@ def check(ctx: LintContext) -> List[Finding]:
                     "proto-fence-gate", path, 1, "ep-stamp",
                     f"net/protocol.py declares fence-stamped ops but "
                     f"the client stamp choke point "
-                    f"{FENCE_STAMP_FN}() no longer assigns the 'ep' "
+                    f"{stamp_fn}() no longer assigns the 'ep' "
                     f"header"))
 
     # 5. fault presets may only target schedulable ops

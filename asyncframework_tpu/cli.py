@@ -71,6 +71,7 @@ CONF_TO_FIELD: Dict[str, str] = {
     # DCN data-plane knobs (parallel/ps_dcn.py)
     "async.pull.mode": "pull_mode",
     "async.push.merge": "push_merge",
+    "async.codec.push": "push_codec",
     "async.pipeline.depth": "pipeline_depth",
     "async.mesh.devices": "mesh_devices",
     # telemetry plane (metrics/timeseries.py)

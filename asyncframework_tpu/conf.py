@@ -311,6 +311,52 @@ DEBUG_LOCKWATCH = ConfigEntry(
     "in the live UI.  Enabled for the chaos suite and bin/chaos_sweep.py "
     "so the lock-free PULL-serving claim is continuously checked; off by "
     "default (zero hot-path cost).")
+# ------------------------------------------------------------- codec plane
+# Wire-compression codecs (net/wirecodec.py): quantized gradient pushes
+# with per-worker error feedback, and lossless compression of snapshot
+# deltas on the relaycast distribution plane.
+CODEC_PUSH = ConfigEntry(
+    "async.codec.push", "off", str,
+    "Gradient PUSH quantization (net/wirecodec.py): 'off' (the default) "
+    "ships raw f32 -- byte-identical legacy wire; 'fp16' halves and "
+    "'int8' (per-push max-abs scale) quarters the dense gradient bytes, "
+    "with the quantization residual kept in a per-worker error-feedback "
+    "accumulator and folded into the next push, so the model's deviation "
+    "from the uncompressed trajectory stays bounded by ONE step's "
+    "quantization error.  Non-finite gradients, fp16-overflowing "
+    "magnitudes, sparse-encoded pushes, and ASAGA (exact history "
+    "scalars) always fall back to the raw wire.")
+# ------------------------------------------------------------- relay plane
+# Relaycast (asyncframework_tpu/relaycast/): peer-relayed versioned model
+# distribution -- replicas form a k-ary tree rooted at the PS, the root's
+# direct children SUBSCRIBE as usual, and every deeper node RELAY_FETCHes
+# CRC-gated XOR deltas from its parent and re-serves them to its own
+# children, so PS egress per version is O(fanout), not O(replicas).
+RELAY_FANOUT = ConfigEntry(
+    "async.relay.fanout", 2, int,
+    "Children per node in the relaycast distribution tree (the PS root "
+    "included: it accepts at most this many relay-child registrations "
+    "for its RELAY_OFFER push path; k8s/CLI tree plans use the same "
+    "arity).  Tree depth is log_fanout(replicas).")
+RELAY_COMPRESS = ConfigEntry(
+    "async.relay.compress", True, bool,
+    "Lossless zlib compression of relay-hop model payloads "
+    "(net/wirecodec.py): XOR deltas of a training step compress "
+    "severalfold (agreeing sign/exponent bits, ascending index half); "
+    "losslessness keeps the CRC gate exact.  On by default -- the relay "
+    "plane is new wire with no byte-identity legacy to preserve; "
+    "payloads that would not shrink ship raw automatically.")
+RELAY_VERSIONS = ConfigEntry(
+    "async.relay.versions", 8, int,
+    "Recent model versions a relay node keeps for delta-encoding "
+    "children's RELAY_FETCH have= requests (oldest evict first; a "
+    "missing basis answers full, exactly like the PS delta cache).")
+RELAY_PARENT_RETRY_S = ConfigEntry(
+    "async.relay.parent.retry.s", 5.0, float,
+    "After a relay parent fails (dead, fenced, CRC mismatch) the child "
+    "re-homes to the ROOT (direct SUBSCRIBE -- the always-safe path) "
+    "and only re-tries its parent after this many seconds, so a "
+    "flapping interior node cannot oscillate the subtree.")
 # ------------------------------------------------------------ trace plane
 # Distributed tracing for the async update loop (metrics/trace.py): spans
 # are sampled per update lifecycle, propagated over the wire as an optional

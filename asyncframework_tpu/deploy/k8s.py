@@ -238,9 +238,15 @@ def render_topic_server(namespace: str = "default",
 SERVE_PORT = 7080
 
 
+#: relay-node port on relay-tier replica pods (the predict port is
+#: SERVE_PORT + 1; the relay tree rides its own port next to it)
+RELAY_PORT = 7181
+
+
 def render_serving(replicas: int, ps: str, namespace: str = "default",
                    image: str = DEFAULT_IMAGE,
-                   resources: Optional[dict] = None) -> List[dict]:
+                   resources: Optional[dict] = None,
+                   relay_fanout: int = 0) -> List[dict]:
     """Serving tier (asyncframework_tpu/serving/): a frontend Deployment +
     Service (the stable predict endpoint) and a replica Deployment whose
     pods SUBSCRIBE to the given PS address and HELLO the frontend Service
@@ -248,17 +254,36 @@ def render_serving(replicas: int, ps: str, namespace: str = "default",
     drops out of the frontend rotation (pid probe / silence) and its
     replacement re-HELLOs in; scaling reads is ``kubectl scale`` on the
     replica Deployment -- no state moves, every replica serves the same
-    subscribed model."""
+    subscribed model.
+
+    ``relay_fanout > 0`` renders the **relaycast tier** instead: the
+    replica pods become a StatefulSet behind a headless Service, so
+    each pod's ordinal hostname IS its tree position -- the replica CLI
+    (``--relay-auto``) derives its rid and its planned parent's stable
+    DNS name (``async-serve-replica-<p>.async-serve-relay``) from the
+    deterministic k-ary plan (relaycast/tree.py), with zero
+    coordination.  PS snapshot egress per version is then O(fanout):
+    only the first ``fanout`` pods SUBSCRIBE directly; deeper pods
+    RELAY_FETCH CRC-gated (compressed) deltas from their parents, and
+    ANY relay failure falls back to a direct PS SUBSCRIBE, so pod churn
+    degrades to extra root traffic, never to staleness or torn
+    models."""
     if replicas < 1:
         raise ValueError("replicas must be >= 1")
     if not ps:
         raise ValueError("serving needs the PS address to SUBSCRIBE to")
+    if relay_fanout < 0:
+        raise ValueError("relay_fanout must be >= 0 (0 = relay off)")
     fe_cmd = ["python", "-m", "asyncframework_tpu.serving.cli",
               "frontend", "--host", "0.0.0.0", "--port", str(SERVE_PORT)]
     rep_cmd = ["python", "-m", "asyncframework_tpu.serving.cli",
                "replica", "--ps", ps, "--host", "0.0.0.0",
                "--port", str(SERVE_PORT + 1),
                "--frontend", f"async-serve:{SERVE_PORT}"]
+    if relay_fanout > 0:
+        rep_cmd += ["--relay-auto", "--relay-port", str(RELAY_PORT),
+                    "--relay-service", "async-serve-relay",
+                    "--conf", f"async.relay.fanout={relay_fanout}"]
     return [
         {
             "apiVersion": "apps/v1", "kind": "Deployment",
@@ -282,24 +307,65 @@ def render_serving(replicas: int, ps: str, namespace: str = "default",
                      "ports": [{"name": "predict", "port": SERVE_PORT,
                                 "targetPort": SERVE_PORT}]},
         },
+        (
+            {
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": _meta("async-serve-replicas", "serve-replica",
+                                  namespace),
+                "spec": {
+                    "replicas": replicas,
+                    "selector": {
+                        "matchLabels": {"app": "async-serve-replica"}},
+                    "template": {
+                        "metadata": _pod_meta("async-serve-replica"),
+                        "spec": {"containers": [_container(
+                            "replica", image, rep_cmd,
+                            ports=[SERVE_PORT + 1],
+                            resources=resources,
+                        )]},
+                    },
+                },
+            }
+            if relay_fanout <= 0 else
+            # relaycast tier: StatefulSet ordinals are tree positions,
+            # the headless Service gives every pod the stable DNS name
+            # its children dial (async-serve-replica-<i>.async-serve-
+            # relay) -- the tree needs identity, which a Deployment's
+            # interchangeable pods cannot provide
+            {
+                "apiVersion": "apps/v1", "kind": "StatefulSet",
+                "metadata": _meta("async-serve-replica", "serve-replica",
+                                  namespace),
+                "spec": {
+                    "replicas": replicas,
+                    "serviceName": "async-serve-relay",
+                    "podManagementPolicy": "Parallel",
+                    "selector": {
+                        "matchLabels": {"app": "async-serve-replica"}},
+                    "template": {
+                        "metadata": _pod_meta("async-serve-replica"),
+                        "spec": {"containers": [_container(
+                            "replica", image, rep_cmd,
+                            ports=[SERVE_PORT + 1, RELAY_PORT],
+                            resources=resources,
+                        )]},
+                    },
+                },
+            }
+        ),
+    ] + ([] if relay_fanout <= 0 else [
         {
-            "apiVersion": "apps/v1", "kind": "Deployment",
-            "metadata": _meta("async-serve-replicas", "serve-replica",
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": _meta("async-serve-relay", "serve-replica",
                               namespace),
             "spec": {
-                "replicas": replicas,
-                "selector": {"matchLabels": {"app": "async-serve-replica"}},
-                "template": {
-                    "metadata": _pod_meta("async-serve-replica"),
-                    "spec": {"containers": [_container(
-                        "replica", image, rep_cmd,
-                        ports=[SERVE_PORT + 1],
-                        resources=resources,
-                    )]},
-                },
+                "clusterIP": "None",  # headless: per-pod DNS records
+                "selector": {"app": "async-serve-replica"},
+                "ports": [{"name": "relay", "port": RELAY_PORT,
+                           "targetPort": RELAY_PORT}],
             },
         },
-    ]
+    ])
 
 
 PS_SHARD_PORT = 7200
@@ -460,6 +526,7 @@ def render_cluster(workers: int, namespace: str = "default",
                    cores: int = 1, topic_server: bool = False,
                    serving: int = 0,
                    serving_ps: Optional[str] = None,
+                   relay_fanout: int = 0,
                    ps_shards: int = 0, ps_d: int = 0, ps_n: int = 0,
                    ps_workers: int = 8) -> Dict[str, str]:
     """The whole standalone topology as {filename: yaml} -- apply with
@@ -479,7 +546,7 @@ def render_cluster(workers: int, namespace: str = "default",
     if serving > 0:
         out["serving.yaml"] = to_yaml(render_serving(
             serving, serving_ps or f"async-master:{RPC_PORT}",
-            namespace, image,
+            namespace, image, relay_fanout=relay_fanout,
         ))
     if ps_shards > 0:
         out["ps-shards.yaml"] = to_yaml(render_ps_shards(
@@ -519,6 +586,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "frontend + this many predict replica pods)")
     r.add_argument("--serving-ps", default=None, metavar="HOST:PORT",
                    help="PS address the serving replicas SUBSCRIBE to")
+    r.add_argument("--relay-fanout", type=int, default=0, metavar="K",
+                   help="render the serving replicas as a relaycast "
+                        "tree of this arity (StatefulSet + headless "
+                        "Service; 0 = classic direct-SUBSCRIBE "
+                        "Deployment)")
     r.add_argument("--ps-shards", type=int, default=0, metavar="N",
                    help="also render an N-shard parameter-server group "
                         "(per-shard pod + Service + checkpoint PVC; "
@@ -545,6 +617,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             ha_replicas=args.ha, cores=args.cores,
             topic_server=args.topic_server,
             serving=args.serving, serving_ps=args.serving_ps,
+            relay_fanout=args.relay_fanout,
             ps_shards=args.ps_shards, ps_d=args.ps_d, ps_n=args.ps_n,
             ps_workers=args.ps_workers,
         )

@@ -132,6 +132,21 @@ _register(CounterFamily(
         "refresh shapes (serving/metrics.py).",
 ))
 _register(CounterFamily(
+    "relay", "asyncframework_tpu.relaycast.metrics",
+    "relay_totals", "reset_relay_totals",
+    doc="Relaycast distribution plane: fetches served per shape, "
+        "offers sent/received, parent fetches vs root fallbacks, "
+        "re-homes, fenced hops, CRC rejects "
+        "(asyncframework_tpu/relaycast/).",
+))
+_register(CounterFamily(
+    "codec", "asyncframework_tpu.net.wirecodec",
+    "codec_totals", "reset_codec_totals",
+    doc="Wire codecs: quantized-gradient encodes/decodes and raw "
+        "fallbacks, raw-vs-wire byte totals, snapshot-delta "
+        "compression hits (net/wirecodec.py).",
+))
+_register(CounterFamily(
     "shardgroup", "asyncframework_tpu.parallel.shardgroup",
     "shard_totals", "reset_shard_totals",
     doc="Sharded PS group: shard deaths/restarts, finish broadcasts, "

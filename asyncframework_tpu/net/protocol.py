@@ -47,6 +47,7 @@ SERVING = "serving"     # serving/replica.py, serving/frontend.py
 MASTER = "master"       # deploy/master.py
 WORKER = "worker"       # deploy/worker.py order socket
 TOPIC = "topic"         # streaming/log_net.py
+RELAY = "relay"         # relaycast/node.py (peer-relayed distribution)
 PSEUDO = "pseudo"       # protocol-less hook points (fault injection)
 
 
@@ -149,6 +150,27 @@ _op("PREDICTION", SERVING, direction=REPLY,
 _op("UNHEALTHY", SERVING, direction=REPLY,
     doc="Replica past its staleness SLO refusing to serve; frontend "
         "fails over.")
+# ------------------------------------------------------------- relay plane
+_op("RELAY_FETCH", RELAY, fence_stamped=True, fault_schedulable=True,
+    doc="Peer fetch of a relayed model version (``have=``-negotiated "
+        "NM/XDELTA/FULL, optionally zlib-compressed, always CRC-gated); "
+        "read-only and idempotent, safe to retry.  A fetch whose stamped "
+        "epoch is stale is REJECT_FENCED; a fetch whose REPLY carries a "
+        "stale version epoch is discarded client-side (the child falls "
+        "back to a direct root SUBSCRIBE either way).")
+_op("RELAY_OFFER", RELAY, mutating=True, fence_stamped=True,
+    fault_schedulable=True,
+    doc="Parent's new-version announcement down the distribution tree "
+        "(the PS root's offer loop and every interior node send it).  "
+        "Mutating only as 'remember the newest offered version and wake "
+        "the fetch path'; idempotent by construction -- re-delivery of "
+        "the same (ts, crc) is a no-op by monotone version compare, so "
+        "no dedup window is needed, and a LOST offer costs nothing (the "
+        "child's poll loop fetches on its next tick).")
+_op("RELAY_MODEL", RELAY, direction=REPLY,
+    doc="RELAY_FETCH reply: negotiated model payload with wenc/CRC, the "
+        "version's fencing epoch, and freshness metadata "
+        "(clock/k/age_ms/done) so every hop keeps pricing its lag.")
 # ------------------------------------------------------------ master plane
 _op("REGISTER_WORKER", MASTER, mutating=True,
     doc="Worker daemon introduction; idempotent re-register by "
@@ -261,6 +283,8 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "asyncframework_tpu/deploy/worker.py",
     "asyncframework_tpu/deploy/client.py",
     "asyncframework_tpu/streaming/log_net.py",
+    "asyncframework_tpu/relaycast/node.py",
+    "asyncframework_tpu/relaycast/source.py",
     "asyncframework_tpu/net/faults.py",
 )
 
@@ -294,6 +318,8 @@ SERVER_DISPATCH: Dict[str, Tuple[str, ...]] = {
     "LIST_WORKERS": ("asyncframework_tpu/deploy/master.py",),
     "LAUNCH": ("asyncframework_tpu/deploy/worker.py",),
     "KILL": ("asyncframework_tpu/deploy/worker.py",),
+    "RELAY_FETCH": ("asyncframework_tpu/relaycast/node.py",),
+    "RELAY_OFFER": ("asyncframework_tpu/relaycast/node.py",),
     "APPEND": ("asyncframework_tpu/streaming/log_net.py",),
     "COMMIT": ("asyncframework_tpu/streaming/log_net.py",),
     "READ": ("asyncframework_tpu/streaming/log_net.py",),

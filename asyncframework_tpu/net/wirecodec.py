@@ -1,0 +1,269 @@
+"""Wire-compression codec layer: quantized gradients + compressed deltas.
+
+ASAP (arXiv:1612.08608) argues the right trade for asynchronous data-
+parallel systems is *approximate with bounded error* on the wire; the
+gradient-compression line of work (1-bit SGD, QSGD, error-feedback SGD)
+makes that concrete for the PUSH path: quantize each gradient, keep the
+quantization residual in a per-worker **error-feedback accumulator**, and
+fold it into the next gradient before quantizing again.  The model then
+never drifts unboundedly: after T pushes the applied sum equals the true
+gradient sum minus only the CURRENT residual, and the residual is bounded
+by one step's quantization error (see :func:`grad_error_bound`).
+
+Two independent codecs live here, both conf-gated and both **off by
+default = byte-identical wire** (the repo-wide discipline: every plane's
+legacy wire is asserted byte-identical via per-op frame totals when its
+knob is absent):
+
+- **gradient quantization** (``async.codec.push`` = ``fp16`` | ``int8``):
+  lossy-but-error-fed encode of dense ASGD PUSH payloads.  fp16 halves
+  the gradient bytes; int8 (per-push max-abs scale) quarters them.
+  Non-finite gradients (NaN/inf), fp16-overflowing magnitudes, sparse-
+  encoded pushes, and ASAGA pushes (whose history scalars must be exact)
+  all fall back to the raw f32 wire -- the codec degrades to exact,
+  never to poisoned.
+
+- **snapshot-delta compression** (the relaycast plane's
+  ``async.relay.compress``): **lossless** zlib over the XOR-delta /
+  full model payloads of ``net/wiredelta.py``.  XOR deltas of a
+  training step are structurally compressible (sign/exponent bits of
+  consecutive versions agree, so xor words lead with zero bytes, and
+  the index half is ascending u32), and losslessness means the
+  CRC-gating contract is untouched: decompress, then the stock decode
+  verifies the version CRC exactly as before.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+#: gradient-codec names (``async.codec.push`` values)
+OFF = "off"
+FP16 = "fp16"
+INT8 = "int8"
+GRAD_CODECS = (OFF, FP16, INT8)
+
+#: fp16 magnitudes past this overflow to inf; ship such pushes raw
+_FP16_SAFE_MAX = 6.0e4
+#: fp16 relative quantization error (one ulp at 11 significand bits)
+_FP16_REL = 2.0 ** -11
+#: fp16 subnormal floor (absolute error near zero)
+_FP16_ABS = 6.0e-8
+
+_lock = threading.Lock()
+_totals: Dict[str, int] = {}
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _lock:
+        _totals[key] = _totals.get(key, 0) + n
+
+
+def codec_totals() -> Dict[str, int]:
+    """Flat monotone counters (metrics/registry.py ``codec`` family):
+    grad_enc_fp16/int8, grad_enc_raw_fallback, grad_dec, grad_bytes_raw/
+    grad_bytes_wire, snap_compressed, snap_incompressible,
+    snap_bytes_raw/snap_bytes_wire, snap_decompressed."""
+    with _lock:
+        return dict(_totals)
+
+
+def reset_codec_totals() -> None:
+    with _lock:
+        _totals.clear()
+
+
+# ------------------------------------------------------------ gradient path
+def grad_error_bound(codec: str, absmax: float) -> float:
+    """Per-coordinate quantization error bound of ONE encode whose input
+    (gradient + carried residual) has max-abs ``absmax``.  This is also
+    the bound on the error-feedback residual itself, and therefore on
+    the model's deviation from the uncompressed trajectory at any time
+    (times the step size): the residual never compounds, because every
+    encode folds the previous residual back in before quantizing."""
+    if codec == INT8:
+        # scale = absmax/127, rint rounds to the nearest level: s/2
+        return absmax / 254.0
+    if codec == FP16:
+        return absmax * _FP16_REL + _FP16_ABS
+    return 0.0
+
+
+def encode_grad(g: np.ndarray, codec: str, err: Optional[np.ndarray]
+                ) -> Optional[Tuple[dict, bytes, np.ndarray]]:
+    """Quantize ``g`` (float32) with error feedback.
+
+    ``err`` is this worker's carried residual (None on the first push).
+    Returns ``(header_fields, payload, new_err)``, or **None** when the
+    push must ship raw f32: codec off, non-finite input (a NaN/inf
+    gradient quantizes to garbage -- exactness is the only safe
+    encoding), or an fp16-overflowing magnitude.  On the None path the
+    residual is NOT consumed -- it simply rides to the next quantized
+    push (the raw push is exact, so skipping the fold loses nothing).
+    """
+    if codec == OFF:
+        return None
+    if codec not in GRAD_CODECS:
+        raise ValueError(f"unknown gradient codec {codec!r}")
+    x = g + err if err is not None else np.array(g, np.float32)
+    if not np.isfinite(x).all():
+        _bump("grad_enc_raw_fallback")
+        return None
+    absmax = float(np.max(np.abs(x))) if x.size else 0.0
+    if codec == FP16:
+        if absmax > _FP16_SAFE_MAX:
+            _bump("grad_enc_raw_fallback")
+            return None
+        q = x.astype(np.float16)
+        applied = q.astype(np.float32)
+        hdr = {"gq": FP16}
+        payload = q.tobytes()
+        _bump("grad_enc_fp16")
+    else:  # INT8
+        scale = absmax / 127.0
+        if scale > 0.0:
+            q = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+            applied = q.astype(np.float32) * np.float32(scale)
+        else:
+            q = np.zeros(x.shape, np.int8)
+            applied = np.zeros(x.shape, np.float32)
+        hdr = {"gq": INT8, "gs": float(scale)}
+        payload = q.tobytes()
+        _bump("grad_enc_int8")
+    new_err = x - applied
+    _bump("grad_bytes_raw", int(g.nbytes))
+    _bump("grad_bytes_wire", len(payload))
+    return hdr, payload, new_err
+
+
+def decode_grad(header: dict, payload, d: int) -> np.ndarray:
+    """Server-side decode of a quantized PUSH payload back to float32.
+    Raises ``ValueError`` on a malformed frame (wrong codec tag or
+    payload length) -- the server answers ERR instead of applying."""
+    gq = header.get("gq")
+    if gq == FP16:
+        if len(payload) != 2 * d:
+            raise ValueError(f"fp16 push wants {2 * d} bytes, "
+                             f"got {len(payload)}")
+        g = np.frombuffer(payload, np.float16).astype(np.float32)
+    elif gq == INT8:
+        if len(payload) != d:
+            raise ValueError(f"int8 push wants {d} bytes, "
+                             f"got {len(payload)}")
+        gs = header.get("gs")
+        if gs is None or not np.isfinite(float(gs)) or float(gs) < 0.0:
+            # a missing/garbage scale must answer ERR, not silently
+            # apply an all-zero (or poisoned) gradient
+            raise ValueError(f"int8 push with bad scale {gs!r}")
+        g = (np.frombuffer(payload, np.int8).astype(np.float32)
+             * np.float32(gs))
+    else:
+        raise ValueError(f"unknown gradient codec tag {gq!r}")
+    _bump("grad_dec")
+    return g
+
+
+# ------------------------------------------------------------ snapshot path
+#: do not bother compressing payloads under this (zlib header overhead)
+_SNAP_MIN_BYTES = 64
+#: deflate level for snapshot deltas: the relay plane trades a little
+#: encode CPU for wire bytes by design (one encode serves a subtree)
+_SNAP_LEVEL = 6
+
+
+def _shuffle4(payload: bytes) -> bytes:
+    """Byte-plane transposition over 4-byte words (the Blosc/HDF5
+    shuffle filter): all byte-0s, then all byte-1s, ...  XOR words of
+    consecutive training versions agree in their high bytes, so the
+    transposed planes are runs deflate actually crunches.  Exact
+    inverse in :func:`_unshuffle4`; requires word alignment."""
+    return np.frombuffer(payload, np.uint8).reshape(-1, 4).T.tobytes()
+
+
+def _unshuffle4(payload: bytes) -> bytes:
+    a = np.frombuffer(payload, np.uint8).reshape(4, -1).T
+    return np.ascontiguousarray(a).tobytes()
+
+
+def compress_model_part(wenc: str, payload: bytes, nnz: int = 0
+                        ) -> Tuple[dict, bytes]:
+    """LOSSLESS compression of a model-part payload for the relay wire.
+
+    Structure-aware, tag carried as the ``cz`` header field:
+
+    - ``zd`` (sparse XOR delta with known ``nnz``): the ascending index
+      half is delta-encoded (consecutive differences -- small ints with
+      three near-zero byte planes) and both halves byte-shuffled before
+      deflate;
+    - ``zs`` (any word-aligned payload -- XFULL dense xor, FULL f32):
+      byte-shuffle + deflate;
+    - ``z``: plain deflate (unaligned fallback).
+
+    Whichever candidate is smallest ships; if none beats raw, the
+    payload ships unchanged (fields empty).  The consumer inverts the
+    transform BEFORE ``wiredelta.decode``, so CRC gating sees exactly
+    the original bytes -- compression can fail to help, never corrupt.
+    """
+    n = len(payload)
+    if n < _SNAP_MIN_BYTES:
+        return {}, payload
+    best = ({}, payload)
+    if wenc == "xdelta" and nnz > 0 and n == 8 * nnz:
+        idx = np.frombuffer(payload[: 4 * nnz], np.uint32)
+        idxd = np.diff(idx, prepend=np.uint32(0)).astype(np.uint32)
+        z = zlib.compress(_shuffle4(idxd.tobytes())
+                          + _shuffle4(payload[4 * nnz:]), _SNAP_LEVEL)
+        if len(z) < len(best[1]):
+            best = ({"cz": "zd", "ulen": n}, z)
+    if n % 4 == 0:
+        z = zlib.compress(_shuffle4(payload), _SNAP_LEVEL)
+        if len(z) < len(best[1]):
+            best = ({"cz": "zs", "ulen": n}, z)
+    else:
+        z = zlib.compress(payload, 1)
+        if len(z) < len(best[1]):
+            best = ({"cz": "z", "ulen": n}, z)
+    if not best[0]:
+        _bump("snap_incompressible")
+        return best
+    _bump("snap_compressed")
+    _bump("snap_bytes_raw", n)
+    _bump("snap_bytes_wire", len(best[1]))
+    return best
+
+
+def decompress_model_part(header: dict, payload) -> bytes:
+    """Undo :func:`compress_model_part` (no-op for an uncompressed
+    reply).  Raises ``ValueError`` on corrupt/length-mismatched data --
+    callers treat it like a CRC mismatch (full-refetch fallback)."""
+    cz = header.get("cz")
+    if cz is None:
+        return bytes(payload)
+    if cz not in ("z", "zs", "zd"):
+        raise ValueError(f"unknown compression tag {cz!r}")
+    try:
+        out = zlib.decompress(bytes(payload))
+    except zlib.error as e:
+        raise ValueError(f"corrupt compressed payload: {e}") from e
+    ulen = int(header.get("ulen", -1))
+    if len(out) != ulen:
+        raise ValueError(f"decompressed to {len(out)} bytes, "
+                         f"header says {ulen}")
+    if cz == "zd":
+        nnz = int(header.get("nnz", 0))
+        if ulen != 8 * nnz or nnz <= 0:
+            raise ValueError(f"zd payload: ulen={ulen} vs nnz={nnz}")
+        idxd = np.frombuffer(_unshuffle4(out[: 4 * nnz]), np.uint32)
+        xorw = _unshuffle4(out[4 * nnz:])
+        idx = np.cumsum(idxd.astype(np.uint64)).astype(np.uint32)
+        out = idx.tobytes() + xorw
+    elif cz == "zs":
+        if ulen % 4 != 0:
+            raise ValueError(f"zs payload: unaligned ulen={ulen}")
+        out = _unshuffle4(out)
+    _bump("snap_decompressed")
+    return out

@@ -38,6 +38,13 @@ import numpy as np
 FULL = "full"
 NOT_MODIFIED = "nm"
 XDELTA = "xdelta"
+#: dense XOR form (relaycast plane only -- the PS never emits it): the
+#: raw ``cur_bits ^ basis_bits`` words with NO index list, same size as
+#: FULL but structurally compressible (consecutive training versions
+#: agree in sign/exponent/top-mantissa bits, so the xor's high byte
+#: planes are near-zero -- see net/wirecodec.py's shuffle transform).
+#: Still byte-exact and CRC-gated like every other form.
+XFULL = "xfull"
 
 
 def crc(model_buf) -> int:
@@ -75,6 +82,13 @@ def encode(cur: np.ndarray, basis: Optional[np.ndarray],
     return full()
 
 
+def encode_xfull(cur: np.ndarray, basis: np.ndarray) -> bytes:
+    """The dense XOR payload (``XFULL``): exact by construction, FULL-
+    sized on the wire but built for the wirecodec shuffle+deflate
+    transform.  Caller guarantees matching shapes."""
+    return (cur.view(np.uint32) ^ basis.view(np.uint32)).tobytes()
+
+
 def decode(wenc: str, payload, nnz: int, basis: Optional[np.ndarray],
            want_crc: Optional[int], basis_crc: Optional[int] = None
            ) -> Optional[np.ndarray]:
@@ -97,6 +111,14 @@ def decode(wenc: str, payload, nnz: int, basis: Optional[np.ndarray],
             return None
         have = basis_crc if basis_crc is not None else crc(basis)
         return basis if have == want_crc else None
+    if wenc == XFULL:
+        if len(payload) != basis.nbytes:
+            return None
+        bits = basis.view(np.uint32) ^ np.frombuffer(payload, np.uint32)
+        out = bits.view(np.float32)
+        if want_crc is None or crc(out) != want_crc:
+            return None
+        return out
     if wenc != XDELTA:
         return None
     if len(payload) != 8 * nnz or nnz <= 0:

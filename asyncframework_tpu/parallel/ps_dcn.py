@@ -115,7 +115,7 @@ import numpy as np
 from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import ClientSession, DedupWindow, RetryPolicy
 from asyncframework_tpu.net import frame as _frame
-from asyncframework_tpu.net import wiredelta
+from asyncframework_tpu.net import wirecodec, wiredelta
 from asyncframework_tpu.parallel import supervisor as supervisor_mod
 from asyncframework_tpu.parallel.supervisor import ElasticSupervisor
 
@@ -514,6 +514,24 @@ class ParameterServer:
         self.subscribe_replies: Dict[str, int] = {"full": 0, "nm": 0,
                                                   "xdelta": 0}
         self.subscribe_model_bytes = 0
+        # relaycast root offer path (asyncframework_tpu/relaycast/): a
+        # SUBSCRIBE whose header carries ``rport`` registers the
+        # subscriber as a direct relay child (the shared ChildRegistry:
+        # bounded by async.relay.fanout with LRU eviction, so a deep
+        # node that root-subscribed once cannot squat a slot a planned
+        # direct child keeps renewing), and a lazy offer thread
+        # announces each new version via RELAY_OFFER so depth-1 nodes
+        # fetch event-driven instead of poll-bounded.  Offers are
+        # advisory: a lost one costs nothing (the child's refresh loop
+        # still polls).
+        from asyncframework_tpu.conf import RELAY_FANOUT as _RF
+
+        self._relay_fanout = max(1, int(_gconf().get(_RF)))
+        self._relay_registry = None  # built with the first rport seen
+        self._relay_lock = threading.Lock()
+        self._relay_thread: Optional[threading.Thread] = None
+        self._relay_offered = -1  # newest clock already offered
+        self.relay_offers = 0
         # version birth times (bounded): ts -> run-clock ms at which that
         # model version was PUBLISHED by an applying drain.  Feeds the
         # freshness-lag-in-ms answer on SUBSCRIBE replies: the age of a
@@ -1379,6 +1397,57 @@ class ParameterServer:
                     return max(0.0, now - born)
         return 0.0
 
+    def _register_relay_child(self, host: str, port: int) -> None:
+        """Record a relaycast direct child (SUBSCRIBE carried ``rport``)
+        and lazily start the offer thread.  The shared ChildRegistry
+        (relaycast/offers.py) bounds the set at the tree fanout with
+        LRU eviction: direct children renew their slot on every
+        subscribe, so a stale registrant (a deep node that re-homed
+        here once) is displaced, never a live one."""
+        start = False
+        with self._relay_lock:
+            if self._relay_registry is None:
+                from asyncframework_tpu.relaycast.offers import (
+                    ChildRegistry,
+                )
+
+                self._relay_registry = ChildRegistry(self._relay_fanout)
+            if self._relay_thread is None:
+                from asyncframework_tpu.utils.threads import guarded
+
+                self._relay_thread = threading.Thread(
+                    target=guarded(self._relay_offer_loop,
+                                   "ps-relay-offer"),
+                    name="ps-relay-offer", daemon=True,
+                )
+                start = True
+        self._relay_registry.register(host, port)
+        if start:
+            self._relay_thread.start()
+
+    def _relay_offer_loop(self) -> None:
+        """The root offer path: watch the merge clock and announce each
+        new published version (RELAY_OFFER: ts + CRC + epoch) to the
+        registered direct children via the shared ChildRegistry fan-out.
+        Entirely off the hot path -- the snapshot build it may trigger
+        is the same one the next pull would pay, and sends happen
+        outside every lock with short timeouts."""
+        while not self._stop.is_set():
+            self._stop.wait(0.02)
+            clock = self._clock
+            if clock == self._relay_offered:
+                continue
+            registry = self._relay_registry
+            if registry is None or not registry.children():
+                self._relay_offered = clock
+                continue
+            snap = self._model_snap()
+            hdr = {"op": "RELAY_OFFER", "ts": snap.ts, "crc": snap.crc}
+            if self.epoch:
+                hdr["ep"] = self.epoch
+            self.relay_offers += registry.offer(hdr)
+            self._relay_offered = clock
+
     def _handle_subscribe(self, conn: socket.socket, header: dict) -> None:
         """Serving-tier snapshot subscription (serving/replica.py).
 
@@ -1393,6 +1462,16 @@ class ParameterServer:
         carries the PS merge clock, the accepted-update count, the served
         version's age in ms, and the done flag, so replicas can price
         their own freshness lag in versions AND ms."""
+        rp = header.get("rport")
+        if rp is not None:
+            # relaycast: the subscriber runs a relay node on this port --
+            # register it for the root offer path
+            try:
+                peer = conn.getpeername()[0]
+            except OSError:
+                peer = None
+            if peer is not None:
+                self._register_relay_child(peer, int(rp))
         have = header.get("have")
         ts, cur, model_hdr, model_part = self._negotiated_model(have)
         shape = model_hdr.get("wenc", "full")
@@ -1449,7 +1528,21 @@ class ParameterServer:
         if sup is not None:
             sup.touch(wid, proc)
         diff = None
-        if header.get("enc") == "sparse":
+        if header.get("gq") is not None:
+            # quantized gradient (net/wirecodec.py, async.codec.push):
+            # fp16/int8 payload back to dense f32.  The worker's error-
+            # feedback accumulator already folded this push's
+            # quantization residual into its NEXT gradient, so the
+            # server applies the dequantized value as-is -- stateless
+            # here by design.  ASAGA never quantizes (exact history
+            # scalars), so diff stays None.
+            try:
+                g_host = wirecodec.decode_grad(header, payload, self.d)
+            except ValueError as e:
+                _send_msg(conn, {"op": "ERR",
+                                 "msg": f"bad quantized push: {e}"})
+                return
+        elif header.get("enc") == "sparse":
             # (idx, val) pair gradient (rcv1-class): scatter into dense on
             # host -- the PS's apply path is dense either way
             nnz = int(header["nnz"])
@@ -1886,7 +1979,8 @@ class PSClient:
                  recorder: Optional["_trace.TraceRecorder"] = None,
                  pull_mode: Optional[str] = None,
                  pl_stats: Optional[_PipelineStats] = None,
-                 cv_buf=None, epoch: int = 0):
+                 cv_buf=None, epoch: int = 0,
+                 push_codec: Optional[str] = None):
         self.host, self.port = host, int(port)
         self.endpoint = f"{host}:{self.port}"
         # fencing epoch this client stamps on every PULL/PUSH/SUBSCRIBE
@@ -1915,6 +2009,18 @@ class PSClient:
 
             pull_mode = str(global_conf().get(PULL_MODE))
         self.pull_mode = pull_mode
+        # gradient quantization (net/wirecodec.py, async.codec.push):
+        # 'off' (default) ships raw f32 -- byte-identical legacy wire;
+        # fp16/int8 quantize each dense ASGD push and keep the residual
+        # in a per-wid error-feedback accumulator folded into the next
+        # push, so the model's deviation from the uncompressed
+        # trajectory is bounded by ONE step's quantization error.
+        if push_codec is None:
+            from asyncframework_tpu.conf import CODEC_PUSH, global_conf
+
+            push_codec = str(global_conf().get(CODEC_PUSH))
+        self.push_codec = push_codec
+        self._ef: Dict[int, np.ndarray] = {}  # wid -> carried residual
         # wid -> (ts, float32 basis array, crc of its bytes)
         self._basis: Dict[int, Tuple[int, np.ndarray, int]] = {}
         self.pull_wenc: Dict[str, int] = {"full": 0, "nm": 0, "xdelta": 0}
@@ -2299,7 +2405,7 @@ class PSClient:
         return (int(header["ts"]), w, float(header["avg_delay_ms"]),
                 bool(header["calibrated"]))
 
-    def subscribe(self, wid: int = 0
+    def subscribe(self, wid: int = 0, extra: Optional[dict] = None
                   ) -> Optional[Tuple[int, np.ndarray, int, int,
                                       float, bool]]:
         """Serving-tier snapshot subscription: one ``have=``-negotiated
@@ -2312,11 +2418,16 @@ class PSClient:
         time, the served version's freshness age in ms (0 while it is
         still the current model), and whether training has finished.
         Unlike :meth:`pull` this never parks in the wave gate and keeps
-        working after DONE."""
-        got = self._pull_model_rpc(
-            wid, lambda: {"op": "SUBSCRIBE", "wid": wid}, lambda _h: 0,
-            None,
-        )
+        working after DONE.  ``extra`` merges additional header fields
+        into every attempt (relaycast advertises its relay port as
+        ``rport`` here, which registers it for the PS's offer path)."""
+        def mk() -> dict:
+            hdr = {"op": "SUBSCRIBE", "wid": wid}
+            if extra:
+                hdr.update(extra)
+            return hdr
+
+        got = self._pull_model_rpc(wid, mk, lambda _h: 0, None)
         if got is None:
             return None  # RELEASED/DONE headers never come from SUBSCRIBE
         header, _payload, w = got
@@ -2354,7 +2465,22 @@ class PSClient:
             hdr = {"op": op, "wid": wid, "ts": ts,
                    "enc": "sparse", "nnz": nnz}
         else:
-            hdr, payload = {"op": op, "wid": wid, "ts": ts}, g.tobytes()
+            hdr, payload = {"op": op, "wid": wid, "ts": ts}, None
+            if diff is None and self.push_codec != wirecodec.OFF:
+                # quantize with error feedback (dense ASGD only: sparse
+                # already beat dense above, and ASAGA's history scalars
+                # must be exact).  encode_grad returns None for any
+                # input it cannot encode safely (non-finite, fp16
+                # overflow) -- that push ships raw and the residual
+                # simply rides to the next quantized one.
+                q = wirecodec.encode_grad(g, self.push_codec,
+                                          self._ef.get(wid))
+                if q is not None:
+                    qhdr, payload, new_err = q
+                    self._ef[wid] = new_err
+                    hdr.update(qhdr)
+            if payload is None:
+                payload = g.tobytes()
         if diff is not None:
             payload += np.asarray(diff, np.float32).tobytes()
         self.bytes_pushed += len(payload)
@@ -2867,7 +2993,8 @@ def run_worker_process(
             )
         return PSClient(host, port, proc=proc_token, recorder=recorder,
                         pull_mode=getattr(cfg, "pull_mode", None),
-                        pl_stats=pl_stats, cv_buf=cv_buf, epoch=ps_epoch)
+                        pl_stats=pl_stats, cv_buf=cv_buf, epoch=ps_epoch,
+                        push_codec=getattr(cfg, "push_codec", None))
 
     # elastic adoption bookkeeping: which wids this process serves (own +
     # adopted), and every loop thread ever started (joined at the end)

@@ -45,6 +45,23 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--frontend", default=None, metavar="HOST:PORT",
                    help="HELLO this frontend after binding (joins its "
                         "rotation)")
+    r.add_argument("--relay-port", type=int, default=None,
+                   help="run a relaycast node on this port (0 = "
+                        "ephemeral, announced on stdout); absent = "
+                        "relay off, classic direct SUBSCRIBE")
+    r.add_argument("--relay-parent", default=None, metavar="HOST:PORT",
+                   help="planned relay parent's node endpoint; absent "
+                        "with --relay-port = a direct child of the PS "
+                        "root")
+    r.add_argument("--relay-auto", action="store_true",
+                   help="derive rid + relay parent from this pod's "
+                        "hostname ordinal (StatefulSet convention "
+                        "name-<i>) and the k-ary tree plan "
+                        "(async.relay.fanout); needs --relay-port and "
+                        "--relay-service")
+    r.add_argument("--relay-service", default=None, metavar="SVC",
+                   help="headless-service DNS suffix for --relay-auto "
+                        "peer addressing (name-<i>.SVC:relay-port)")
     r.add_argument("--conf", action="append", default=[], metavar="K=V")
     f = sub.add_parser("frontend", help="replica registry + predict router")
     f.add_argument("--host", default="0.0.0.0")
@@ -81,9 +98,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.role == "replica":
         from asyncframework_tpu.serving.replica import serve_replica
 
-        rep = serve_replica(args.ps, rid=args.rid, host=args.host,
+        rid, relay_parent = args.rid, args.relay_parent
+        if args.relay_auto:
+            # StatefulSet convention: hostname "async-serve-replica-3"
+            # -> rid 3; the parent is a pure function of (rid, fanout)
+            # (relaycast/tree.py), addressed through the headless
+            # service -- zero coordination, every pod computes the same
+            # tree
+            import socket as _socket
+
+            from asyncframework_tpu.conf import RELAY_FANOUT, global_conf
+            from asyncframework_tpu.relaycast import ROOT, parent_index
+
+            if args.relay_port is None or not args.relay_service:
+                raise SystemExit("--relay-auto needs --relay-port and "
+                                 "--relay-service")
+            hostname = _socket.gethostname()
+            base, _, ordinal = hostname.rpartition("-")
+            if not ordinal.isdigit():
+                raise SystemExit(f"--relay-auto needs an ordinal "
+                                 f"hostname (got {hostname!r})")
+            rid = int(ordinal)
+            fanout = int(global_conf().get(RELAY_FANOUT))
+            p = parent_index(rid, fanout)
+            relay_parent = None if p == ROOT else (
+                f"{base}-{p}.{args.relay_service}:{args.relay_port}"
+            )
+        rep = serve_replica(args.ps, rid=rid, host=args.host,
                             port=args.port, loss=args.loss,
-                            frontend=args.frontend)
+                            frontend=args.frontend,
+                            relay_port=args.relay_port,
+                            relay_parent=relay_parent)
         try:
             while True:
                 time.sleep(0.5)

@@ -85,7 +85,9 @@ class ModelReplica(FramedServer):
                  loss: str = "least_squares",
                  refresh_interval_s: Optional[float] = None,
                  max_stale_ms: Optional[float] = None,
-                 device=None):
+                 device=None,
+                 relay_port: Optional[int] = None,
+                 relay_parent: Optional[tuple] = None):
         from asyncframework_tpu.conf import (
             SERVE_MAX_STALE_MS,
             SERVE_REFRESH_S,
@@ -106,6 +108,28 @@ class ModelReplica(FramedServer):
             else float(conf.get(SERVE_MAX_STALE_MS))
         )
         self.device = device
+        # relaycast (asyncframework_tpu/relaycast/): relay_port is not
+        # None = this replica runs a RelayNode next to its predict
+        # server and fetches through the distribution tree --
+        # relay_parent names its planned parent's relay endpoint (None =
+        # a direct child of the PS root, which SUBSCRIBEs as usual and
+        # re-serves its children).  The fetch path falls back to a
+        # direct root SUBSCRIBE on ANY relay failure, so relay mode can
+        # lag, never regress safety.
+        self.relay_port = relay_port
+        self.relay_parent = (tuple(relay_parent) if relay_parent
+                             else None)
+        self._relay_node = None
+        if relay_port is not None:
+            # bind EAGERLY (like the predict server below): children may
+            # dial this node before our first refresh lands -- they get
+            # an honest "no model yet" ERR and fall back to the root,
+            # instead of a connection refused that looks like death
+            from asyncframework_tpu.relaycast import RelayNode
+
+            self._relay_node = RelayNode(rid=self.rid,
+                                         port=int(relay_port),
+                                         on_offer=self._on_relay_offer)
         self._predict_step = None   # built lazily with the first model
         self._served: Optional[_Served] = None  # ATOMIC reference swap
         self.d: Optional[int] = None
@@ -129,6 +153,8 @@ class ModelReplica(FramedServer):
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ModelReplica":
         self.start_accepting()
+        if self._relay_node is not None:
+            self._relay_node.start()
         self._refresh_thread = threading.Thread(
             target=self._refresh_loop, name=f"replica-{self.rid}-refresh",
             daemon=True,
@@ -138,6 +164,8 @@ class ModelReplica(FramedServer):
 
     def stop(self) -> None:
         self.stop_server()
+        if self._relay_node is not None:
+            self._relay_node.stop()
         if self._client is not None:
             # the refresh thread shares this client's connection: say BYE
             # only once any in-flight refresh has drained (bounded wait --
@@ -172,11 +200,34 @@ class ModelReplica(FramedServer):
             # instead of serving a range it no longer owns, and the
             # subscriber self-heals onto the replacement's epoch
             if smap is not None:
+                # relay + shard group is not a supported combination:
+                # per-range relays would need a per-shard tree each --
+                # the sharded subscriber's fan-out pull is the path
                 self._client = _sg.ShardedSubscriber(smap, epochs=epochs)
+            elif self._relay_node is not None:
+                from asyncframework_tpu.relaycast import RelaySource
+
+                node = self._relay_node
+                if epoch and epoch > node.epoch:
+                    node.epoch = int(epoch)
+                self._client = RelaySource(
+                    self.ps_host, self.ps_port, node,
+                    parent=self.relay_parent, rid=self.rid,
+                )
             else:
                 self._client = PSClient(self.ps_host, self.ps_port,
                                         pull_mode="delta", epoch=epoch)
         return self._client
+
+    def _on_relay_offer(self) -> None:
+        """A parent (or the PS root) announced a new version: refresh
+        NOW instead of waiting out the poll interval.  Serialized by the
+        refresh lock like every other caller; failures are the refresh
+        path's problem (counted there), never the offer handler's."""
+        try:
+            self.refresh_once()
+        except (ConnectionError, OSError):  # pragma: no cover - paced
+            pass                            # retry on the poll loop
 
     def _sharded(self):
         """The ShardedSubscriber when this replica reads a shard group,
@@ -330,6 +381,17 @@ class ModelReplica(FramedServer):
             out["ranges"] = sub.range_status()
             if self.max_stale_ms > 0:
                 out["stale_ranges"] = sub.stale_ranges(self.max_stale_ms)
+        node = self._relay_node
+        if node is not None:
+            # relaycast surface: tree position, learned children, fetch
+            # traffic, and how this replica is currently sourcing bytes
+            relay = node.status()
+            relay["parent"] = (list(self.relay_parent)
+                               if self.relay_parent else None)
+            if cl is not None:
+                relay["via_parent"] = getattr(cl, "via_parent", 0)
+                relay["via_root"] = getattr(cl, "via_root", 0)
+            out["relay"] = relay
         if served is not None:
             out.update(ts=served.ts, clock=served.clock, k=served.k,
                        **self._lag(served))
@@ -402,7 +464,9 @@ def serve_replica(ps: str, rid: int = 0, host: str = "0.0.0.0",
                   port: int = 0, loss: str = "least_squares",
                   frontend: Optional[str] = None,
                   announce=print,
-                  hello_interval_s: float = 2.0) -> ModelReplica:
+                  hello_interval_s: float = 2.0,
+                  relay_port: Optional[int] = None,
+                  relay_parent: Optional[str] = None) -> ModelReplica:
     """CLI helper (``async-serve replica``): start a replica, keep it
     registered with a frontend, and announce the bound port as one JSON
     line on stdout (launchers parse it).
@@ -416,8 +480,13 @@ def serve_replica(ps: str, rid: int = 0, host: str = "0.0.0.0",
     import json
 
     ps_host, ps_port = ps.rsplit(":", 1)
+    rparent = None
+    if relay_parent:
+        ph, pp = relay_parent.rsplit(":", 1)
+        rparent = (ph, int(pp))
     rep = ModelReplica(ps_host, int(ps_port), rid=rid, host=host,
-                       port=port, loss=loss).start()
+                       port=port, loss=loss, relay_port=relay_port,
+                       relay_parent=rparent).start()
     if frontend:
         fh, fp = frontend.rsplit(":", 1)
 
@@ -458,6 +527,11 @@ def serve_replica(ps: str, rid: int = 0, host: str = "0.0.0.0",
         threading.Thread(target=guarded(hello_loop, f"replica-{rid}-hello"),
                          name=f"replica-{rid}-hello",
                          daemon=True).start()
-    announce(json.dumps({"role": "replica", "rid": rid, "port": rep.port,
-                         "pid": os.getpid()}), flush=True)
+    line = {"role": "replica", "rid": rid, "port": rep.port,
+            "pid": os.getpid()}
+    if rep._relay_node is not None:
+        # the node bound in __init__, so an ephemeral ask announces the
+        # real port and launchers learn the tree endpoint here
+        line["relay_port"] = int(rep._relay_node.port)
+    announce(json.dumps(line), flush=True)
     return rep

@@ -314,6 +314,12 @@ class SolverConfig:
     # fused device apply at lock acquisition; 1 = classic serial path).
     pull_mode: Optional[str] = None
     push_merge: Optional[int] = None
+    # push_codec: None = resolve from conf async.codec.push ('off' ships
+    # raw f32 gradients, byte-identical legacy wire; 'fp16'/'int8'
+    # quantize dense ASGD pushes with per-worker error-feedback residual
+    # accumulation -- net/wirecodec.py; ASAGA and sparse-encoded pushes
+    # always ship exact).
+    push_codec: Optional[str] = None
     # pipeline_depth: None = resolve from conf async.pipeline.depth
     # (0 = the classic serial worker loop, byte- and step-identical;
     # >= 1 = prefetched pulls on a second connection + a bounded

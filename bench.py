@@ -1055,6 +1055,179 @@ def collect_serve_block(env: dict) -> dict:
     return json.loads(line).get("serve", {"error": "malformed serve payload"})
 
 
+# --------------------------------------------------------------- relay bench
+# Relaycast wire bench (ISSUE 12; always CPU -- it measures wire bytes,
+# not chips): an in-process PS plus N relay sources driven
+# DETERMINISTICALLY (topo order per version, no background loops), so
+# the byte counters are exact.  Three distribution arms -- direct
+# SUBSCRIBE (the N x control), relay tree raw, relay tree compressed --
+# plus the quantized-PUSH codec arm (off/fp16/int8 wire bytes per
+# update).  Never-dark: each arm records its error instead of killing
+# the block.
+RELAY_REPLICAS = int(os.environ.get("BENCH_RELAY_REPLICAS", 8))
+RELAY_VERSIONS = int(os.environ.get("BENCH_RELAY_VERSIONS", 18))
+
+
+def run_relay_child() -> None:
+    import numpy as np
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from asyncframework_tpu.metrics import reset_totals
+    from asyncframework_tpu.net import wirecodec
+    from asyncframework_tpu.parallel import ps_dcn
+    from asyncframework_tpu.relaycast import (
+        ROOT,
+        RelayNode,
+        RelaySource,
+        parent_index,
+    )
+    from asyncframework_tpu.relaycast import metrics as rmetrics
+    from asyncframework_tpu.solvers import SolverConfig
+
+    d, n = 4096, 1024
+    fanout = 2
+
+    def make_ps():
+        cfg = SolverConfig(
+            num_workers=2, num_iterations=10_000, gamma=0.5,
+            taw=2 ** 31 - 1, batch_rate=0.3, bucket_ratio=0.0,
+            printer_freq=1000, seed=42, calibration_iters=4,
+            run_timeout_s=120.0,
+        )
+        return ps_dcn.ParameterServer(cfg, d, n, port=0).start()
+
+    def push_version(cl, rng, v):
+        ts, _w, _avg, _cal = cl.pull(0)
+        # decaying update magnitudes: versions sweep from the hard
+        # near-incompressible early regime (big random updates) into
+        # the converged regime a serving fleet actually lives in (tiny
+        # relative updates) -- the steady-state tail is reported
+        # separately below
+        scale = 0.5 * (0.45 ** v) + 1e-5
+        cl.push(0, ts, (scale * rng.normal(size=d)).astype(np.float32))
+
+    def distribution_arm(relay: bool, compress: bool) -> dict:
+        reset_totals()
+        ps = make_ps()
+        nodes, sources = [], []
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, pull_mode="full")
+            for rid in range(RELAY_REPLICAS):
+                node = RelayNode(rid=rid, port=0,
+                                 compress=compress).start()
+                p = parent_index(rid, fanout)
+                parent = (None if (not relay or p == ROOT)
+                          else ("127.0.0.1", nodes[p].port))
+                nodes.append(node)
+                sources.append(RelaySource("127.0.0.1", ps.port, node,
+                                           parent=parent, rid=rid))
+            rng = np.random.default_rng(7)
+            fetch_by_version = []
+            prev_fetch = 0
+            for v in range(RELAY_VERSIONS):
+                push_version(cl, rng, v)
+                for rid in range(RELAY_REPLICAS):
+                    got = sources[rid].subscribe(rid)
+                    assert got[0] == v + 1
+                cur = rmetrics.relay_totals().get("fetch_bytes_out", 0)
+                fetch_by_version.append(cur - prev_fetch)
+                prev_fetch = cur
+            rt = rmetrics.relay_totals()
+            ct = wirecodec.codec_totals()
+            out = {
+                "ps_subscribe_bytes_per_version":
+                    round(ps.subscribe_model_bytes / RELAY_VERSIONS),
+                "ps_subscribe_replies": dict(ps.subscribe_replies),
+                "relay_fetch_bytes_per_version":
+                    round(rt.get("fetch_bytes_out", 0) / RELAY_VERSIONS),
+                "relay_fetch_bytes_by_version": fetch_by_version,
+                "parent_fetches": rt.get("parent_fetches", 0),
+                "root_fallbacks": rt.get("root_fallbacks", 0),
+            }
+            if ct.get("snap_bytes_wire"):
+                out["snap_compression_ratio"] = round(
+                    ct["snap_bytes_raw"] / ct["snap_bytes_wire"], 2)
+            return out
+        finally:
+            for node in nodes:
+                node.stop()
+            ps.stop()
+
+    def codec_arm(codec: str) -> dict:
+        reset_totals()
+        ps = make_ps()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, pull_mode="full",
+                                 push_codec=codec)
+            rng = np.random.default_rng(11)
+            K = 40
+            for v in range(K):
+                push_version(cl, rng, v % 8)
+            return {
+                "push_payload_bytes_per_update":
+                    round(ps.push_bytes / K),
+                "accepted": ps.accepted,
+            }
+        finally:
+            ps.stop()
+
+    out = {"replicas": RELAY_REPLICAS, "versions": RELAY_VERSIONS,
+           "d": d, "fanout": fanout, "platform": "cpu",
+           "arms": {}, "codec": {}}
+    for name, (relay, compress) in (
+            ("direct", (False, False)),
+            ("relay_raw", (True, False)),
+            ("relay_z", (True, True))):
+        try:
+            out["arms"][name] = distribution_arm(relay, compress)
+        except Exception as e:  # noqa: BLE001 - never-dark discipline
+            out["arms"][name] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    raw_bv = out["arms"].get("relay_raw", {}).get(
+        "relay_fetch_bytes_by_version")
+    z_bv = out["arms"].get("relay_z", {}).get(
+        "relay_fetch_bytes_by_version")
+    if raw_bv and z_bv:
+        # steady-state compression: the converged-regime tail (last
+        # half of the deterministic schedule), which is the serving
+        # fleet's actual operating point; the whole-run average above
+        # includes the incompressible warm-up transient
+        half = len(raw_bv) // 2
+        raw_tail, z_tail = sum(raw_bv[half:]), sum(z_bv[half:])
+        if z_tail > 0:
+            out["steady_state_compression_ratio"] = round(
+                raw_tail / z_tail, 2)
+    for codec in ("off", "fp16", "int8"):
+        try:
+            out["codec"][codec] = codec_arm(codec)
+        except Exception as e:  # noqa: BLE001 - never-dark discipline
+            out["codec"][codec] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    emit({"relay": out})
+
+
+def collect_relay_block(env: dict) -> dict:
+    """Run the relaycast bench in a disposable subprocess (fresh
+    process, parent owns the timeout -- the discipline of every arm)."""
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--relay"],
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "relay bench timed out"}
+    sys.stderr.write(res.stderr)
+    line = next((l for l in reversed(res.stdout.splitlines())
+                 if l.startswith("{")), None)
+    if line is None:
+        return {"error": f"no JSON from relay child (rc={res.returncode})"}
+    return json.loads(line).get("relay",
+                                {"error": "malformed relay payload"})
+
+
 def run_probe() -> None:
     """Cheap backend-liveness check in a disposable process: init the backend
     and print one JSON line.  A dead TPU tunnel wedges jax.devices() forever
@@ -1518,6 +1691,12 @@ def run_parent() -> None:
         # replica count with training concurrently running, including the
         # SIGKILL-a-replica-mid-load failover arm
         payload["serve"] = collect_serve_block(env)
+    if os.environ.get("BENCH_RELAY", "1") != "0":
+        # relaycast wire bench (ISSUE 12, CPU loopback): PS subscribe
+        # egress per distributed version -- direct (N x control) vs
+        # relay tree raw vs compressed -- plus quantized-PUSH wire
+        # bytes per update per codec
+        payload["relay"] = collect_relay_block(env)
     if trace_out:
         with open(trace_out, "w") as f:
             for name in names:
@@ -1554,6 +1733,13 @@ def main() -> None:
         except Exception as e:
             traceback.print_exc(file=sys.stderr)
             emit({"serve": {"error": f"{type(e).__name__}: {str(e)[:200]}"}})
+        os._exit(0)
+    if "--relay" in sys.argv:
+        try:
+            run_relay_child()
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            emit({"relay": {"error": f"{type(e).__name__}: {str(e)[:200]}"}})
         os._exit(0)
     if "--probe" in sys.argv:
         # parent owns the timeout; nothing here may block interpreter exit

@@ -163,12 +163,17 @@ def run_seed(seed: int, args) -> dict:
     # partition/fencing chaos rides every seed too: partition (not kill) a
     # shard past lease expiry, epoch-fenced relaunch, stale-epoch pushes
     # REJECT_FENCED, run completes (tests/test_fencing.py, seeded timing)
+    # relay-tree chaos rides every seed: seeded SIGKILL of an interior
+    # relay node mid-distribution -- children re-home to the root within
+    # the suspicion window, CRC + fence assert no torn/stale-epoch model
+    # ever serves (tests/test_relaycast.py, seeded kill timing)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
         "tests/test_telemetry.py", "tests/test_shardgroup.py",
-        "tests/test_fencing.py",
-        "-q", "-m", f"({marker}) or serve or telemetry or shard or fence",
+        "tests/test_fencing.py", "tests/test_relaycast.py",
+        "-q", "-m",
+        f"({marker}) or serve or telemetry or shard or fence or relay",
         "-p", "no:cacheprovider",
     ]
     if args.soak:

@@ -247,6 +247,49 @@ class TestProtocolRule:
         f = protocol_findings_for(tmp_path, mutated)
         assert rule_tokens(f, "proto-fence-gate") == ["ep-stamp"]
 
+    def test_deleting_relay_fence_admission_fails_lint(self, tmp_path):
+        """ISSUE 12 acceptance mutation: the relaycast node's dispatch
+        must run fencing admission on every fence-stamped relay verb --
+        deleting the admission call is a lint failure, not a chaos
+        lottery."""
+        with open(os.path.join(
+                REPO, "asyncframework_tpu/relaycast/node.py")) as f:
+            src = f.read()
+        mutated = src.replace(
+            'if op == "RELAY_FETCH":\n'
+            '            if not self._fence_reject(conn, header):\n'
+            '                self._handle_fetch(conn, header)\n',
+            'if op == "RELAY_FETCH":\n'
+            '            self._handle_fetch(conn, header)\n', 1)
+        assert mutated != src
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/relaycast/node.py": mutated,
+        })
+        toks = rule_tokens(rules_protocol.check(ctx), "proto-fence-gate")
+        assert "RELAY_FETCH" in toks
+        # the unmutated real file is clean
+        ctx = ctx_of(tmp_path / "clean", {
+            "asyncframework_tpu/relaycast/node.py": src,
+        })
+        assert rule_tokens(rules_protocol.check(ctx),
+                           "proto-fence-gate") == []
+
+    def test_deleting_relay_client_ep_stamp_fails_lint(self, tmp_path):
+        """And the client half: RelaySource._stamped is the relay
+        plane's ep-stamp choke point, pinned like PSClient._proc_hdr."""
+        with open(os.path.join(
+                REPO, "asyncframework_tpu/relaycast/source.py")) as f:
+            src = f.read()
+        i = src.index("def _stamped")
+        j = src.index('hdr["ep"] = self.node.epoch', i)
+        mutated = (src[:j] + "pass"
+                   + src[j + len('hdr["ep"] = self.node.epoch'):])
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/relaycast/source.py": mutated,
+        })
+        toks = rule_tokens(rules_protocol.check(ctx), "proto-fence-gate")
+        assert toks == ["ep-stamp"]
+
     def test_clean_tree_is_silent_for_protocol(self):
         result = run_lint(REPO, rules=["protocol"])
         assert result.findings == [], [f.format() for f in result.findings]

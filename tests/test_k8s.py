@@ -118,11 +118,52 @@ class TestServingRendering:
         assert (rep_cmd[rep_cmd.index("--frontend") + 1]
                 == f"async-serve:{k8s.SERVE_PORT}")
 
+    def test_relay_tier_is_statefulset_with_headless_service(self):
+        """ISSUE 12: relay_fanout > 0 renders the relaycast tier -- a
+        StatefulSet (ordinal = tree position) behind a headless Service
+        (stable per-pod DNS the children dial), the replica CLI in
+        --relay-auto mode, and the fanout pinned via --conf so every
+        pod computes the same deterministic tree."""
+        objs = k8s.render_serving(5, ps="async-ps:7078", relay_fanout=2)
+        kinds = [o["kind"] for o in objs]
+        assert kinds == ["Deployment", "Service", "StatefulSet",
+                         "Service"]
+        sts, headless = objs[2], objs[3]
+        assert sts["spec"]["serviceName"] == "async-serve-relay"
+        assert sts["spec"]["replicas"] == 5
+        assert headless["spec"]["clusterIP"] == "None"
+        ports = {p["name"]: p["port"]
+                 for p in headless["spec"]["ports"]}
+        assert ports == {"relay": k8s.RELAY_PORT}
+        cmd = sts["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert "--relay-auto" in cmd
+        assert cmd[cmd.index("--relay-port") + 1] == str(k8s.RELAY_PORT)
+        assert cmd[cmd.index("--relay-service") + 1] == \
+            "async-serve-relay"
+        assert "async.relay.fanout=2" in cmd
+        # the relay port is exposed on the pod next to the predict port
+        cports = [p["containerPort"] for p in
+                  sts["spec"]["template"]["spec"]["containers"][0][
+                      "ports"]]
+        assert k8s.RELAY_PORT in cports and k8s.SERVE_PORT + 1 in cports
+
+    def test_relay_off_is_byte_identical_topology(self):
+        assert (k8s.render_serving(3, ps="x:1")
+                == k8s.render_serving(3, ps="x:1", relay_fanout=0))
+
+    def test_cluster_bundle_gains_relay_tier(self):
+        files = k8s.render_cluster(2, serving=4, serving_ps="ps:7078",
+                                   relay_fanout=2)
+        objs = _load_all(files["serving.yaml"])
+        assert "StatefulSet" in [o["kind"] for o in objs]
+
     def test_serving_requires_ps_and_replicas(self):
         with pytest.raises(ValueError):
             k8s.render_serving(0, ps="x:1")
         with pytest.raises(ValueError):
             k8s.render_serving(2, ps="")
+        with pytest.raises(ValueError):
+            k8s.render_serving(2, ps="x:1", relay_fanout=-1)
 
     def test_cluster_bundle_gains_serving(self):
         files = k8s.render_cluster(2, serving=2, serving_ps="ps:7078")

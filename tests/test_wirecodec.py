@@ -1,0 +1,342 @@
+"""Wire-codec layer (ISSUE 12): quantized gradients + compressed deltas.
+
+The correctness spine:
+
+- error-feedback quantization NEVER diverges: after any prefix of
+  pushes, (true gradient sum) - (applied dequantized sum) equals
+  exactly the CURRENT residual, and the residual is bounded by ONE
+  step's quantization error -- the property tests sweep random
+  sequences including NaN/inf/-0 bit patterns (the test_dataplane
+  XOR-delta discipline);
+- anything the codec cannot encode safely ships RAW (non-finite
+  gradients, fp16 overflow): degrade to exact, never to poisoned;
+- snapshot-delta compression is LOSSLESS and tag-reversible -- the
+  decompressed bytes are the original payload bit-for-bit, so CRC
+  gating is untouched;
+- codec off is BYTE-IDENTICAL to the knob absent, asserted via per-op
+  frame-byte totals under a fixed seed (the repo-wide legacy-wire
+  discipline).
+"""
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu.conf import set_global_conf
+from asyncframework_tpu.metrics import reset_totals
+from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.net import wirecodec as wc
+from asyncframework_tpu.net import wiredelta
+from asyncframework_tpu.net.retry import reset_breakers
+
+pytestmark = pytest.mark.relay
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    reset_totals()
+    reset_breakers()
+    yield
+    reset_totals()
+    reset_breakers()
+    set_global_conf(None)
+
+
+# ------------------------------------------------------------- gradient path
+class TestGradCodec:
+    @pytest.mark.parametrize("codec", [wc.FP16, wc.INT8])
+    def test_error_feedback_never_diverges(self, codec):
+        """THE invariant: sum(true) - sum(applied) == current residual
+        exactly (in exact arithmetic; float64 accounting below), and
+        the residual is bounded by one step's quantization error -- so
+        the model deviation is bounded for ANY sequence length."""
+        rng = np.random.default_rng(7)
+        d = 257  # odd on purpose
+        err = None
+        true_sum = np.zeros(d, np.float64)
+        applied_sum = np.zeros(d, np.float64)
+        for t in range(200):
+            scale = 10.0 ** rng.integers(-4, 3)
+            g = (scale * rng.normal(size=d)).astype(np.float32)
+            out = wc.encode_grad(g, codec, err)
+            assert out is not None
+            hdr, payload, err = out
+            applied = wc.decode_grad(hdr, payload, d)
+            true_sum += g.astype(np.float64)
+            applied_sum += applied.astype(np.float64)
+            # residual identity (float64 slack for the accounting only)
+            drift = np.abs((true_sum - applied_sum) - err)
+            assert drift.max() < 1e-3 * max(1.0, np.abs(err).max() + 1), t
+            # residual bound: one step's quantization error of x=g+err
+            x_absmax = float(np.abs(applied + err).max()) + float(
+                np.abs(err).max())
+            bound = wc.grad_error_bound(codec, x_absmax)
+            assert np.abs(err).max() <= bound * 1.5 + 1e-6, t
+
+    @pytest.mark.parametrize("codec", [wc.FP16, wc.INT8])
+    def test_server_applies_exactly_what_client_accounted(self, codec):
+        """decode_grad(payload) must equal the client's ``applied``
+        (x - new_err) bit-for-bit -- the server and the accumulator
+        agree on what landed, or the bound above is fiction."""
+        rng = np.random.default_rng(3)
+        d = 64
+        err = np.zeros(d, np.float32)
+        g = rng.normal(size=d).astype(np.float32)
+        hdr, payload, new_err = wc.encode_grad(g, codec, err)
+        applied = wc.decode_grad(hdr, payload, d)
+        np.testing.assert_array_equal(applied, (g + err) - new_err)
+
+    @pytest.mark.parametrize("bad", [np.nan, np.inf, -np.inf])
+    def test_nonfinite_ships_raw(self, bad):
+        g = np.ones(16, np.float32)
+        g[3] = bad
+        err = np.full(16, 0.25, np.float32)
+        assert wc.encode_grad(g, wc.INT8, err) is None
+        assert wc.encode_grad(g, wc.FP16, err) is None
+        # the residual was NOT consumed: it rides to the next push
+        np.testing.assert_array_equal(err, np.full(16, 0.25, np.float32))
+
+    def test_negative_zero_and_zero_grad(self):
+        g = np.zeros(8, np.float32)
+        g[1] = -0.0
+        for codec in (wc.FP16, wc.INT8):
+            hdr, payload, err = wc.encode_grad(g, codec, None)
+            applied = wc.decode_grad(hdr, payload, 8)
+            assert np.all(applied == 0.0)
+            assert np.abs(err).max() == 0.0
+
+    def test_fp16_overflow_ships_raw(self):
+        g = np.ones(8, np.float32)
+        g[0] = 1e5  # fp16 would quantize to inf -> poisoned residual
+        assert wc.encode_grad(g, wc.FP16, None) is None
+        # int8 handles any finite magnitude (per-push scale)
+        assert wc.encode_grad(g, wc.INT8, None) is not None
+
+    def test_off_and_unknown_codec(self):
+        g = np.ones(4, np.float32)
+        assert wc.encode_grad(g, wc.OFF, None) is None
+        with pytest.raises(ValueError, match="unknown"):
+            wc.encode_grad(g, "zstd", None)
+
+    def test_decode_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            wc.decode_grad({"gq": wc.FP16}, b"\x00" * 7, 4)
+        with pytest.raises(ValueError):
+            wc.decode_grad({"gq": wc.INT8, "gs": 1.0}, b"\x00" * 3, 4)
+        with pytest.raises(ValueError):
+            wc.decode_grad({"gq": "nope"}, b"\x00" * 16, 4)
+        # review fix: a missing/garbage int8 scale must raise (answer
+        # ERR), never silently apply an all-zero/poisoned gradient
+        with pytest.raises(ValueError, match="scale"):
+            wc.decode_grad({"gq": wc.INT8}, b"\x01" * 4, 4)
+        with pytest.raises(ValueError, match="scale"):
+            wc.decode_grad({"gq": wc.INT8, "gs": float("nan")},
+                           b"\x01" * 4, 4)
+        with pytest.raises(ValueError, match="scale"):
+            wc.decode_grad({"gq": wc.INT8, "gs": -1.0}, b"\x01" * 4, 4)
+
+
+# ------------------------------------------------------------- snapshot path
+def _xdelta_payload(rng, d, nnz):
+    idx = np.sort(rng.choice(d, size=nnz, replace=False)).astype(np.uint32)
+    xor = rng.integers(0, 2 ** 32, size=nnz, dtype=np.uint64).astype(
+        np.uint32)
+    return idx.tobytes() + xor.tobytes()
+
+
+class TestSnapshotCodec:
+    def test_roundtrip_property_all_tags(self):
+        """Random payloads through every tag path reconstruct
+        bit-for-bit, including NaN/inf/-0 float bit patterns."""
+        rng = np.random.default_rng(11)
+        for trial in range(20):
+            d = int(rng.integers(32, 1024))
+            w = rng.normal(size=d).astype(np.float32)
+            # plant the special bit patterns the XOR-delta suite uses
+            w[rng.integers(0, d)] = np.nan
+            w[rng.integers(0, d)] = np.inf
+            w[rng.integers(0, d)] = -0.0
+            cases = [
+                ("full", w.tobytes(), 0),
+                ("xfull", w.view(np.uint32).tobytes(), 0),
+            ]
+            nnz = max(1, d // 8)
+            cases.append(("xdelta", _xdelta_payload(rng, d, nnz), nnz))
+            for wenc, payload, nnz_ in cases:
+                hdr, wire = wc.compress_model_part(wenc, payload, nnz_)
+                full_hdr = dict(hdr)
+                if nnz_:
+                    full_hdr["nnz"] = nnz_
+                out = wc.decompress_model_part(full_hdr, wire)
+                assert out == payload, (trial, wenc, hdr)
+
+    def test_structured_delta_compresses_2x(self):
+        """The acceptance regime: a late-training dense update (small
+        relative change per coordinate) as an XFULL payload, and a
+        sparse update as an XDELTA payload, both cut >= 2x."""
+        rng = np.random.default_rng(0)
+        d = 4096
+        w = rng.normal(size=d).astype(np.float32)
+        w2 = (w * (1 + 1e-4 * rng.normal(size=d))).astype(np.float32)
+        xfull = wiredelta.encode_xfull(w2, w)
+        hdr, wire = wc.compress_model_part("xfull", xfull, 0)
+        assert hdr.get("cz"), "xfull delta did not compress at all"
+        assert len(xfull) >= 2 * len(wire), (len(xfull), len(wire))
+        # sparse: idx half delta-encodes, xor half shuffles
+        w3 = w.copy()
+        idx = np.sort(rng.choice(d, size=d // 20, replace=False))
+        w3[idx] = (w3[idx] * (1 + 1e-4 * rng.normal(size=idx.size))
+                   ).astype(np.float32)
+        wenc, payload, nnz = wiredelta.encode(w3, w)
+        assert wenc == wiredelta.XDELTA
+        hdr, wire = wc.compress_model_part(wenc, payload, nnz)
+        assert hdr.get("cz") == "zd"
+        assert len(payload) >= 2 * len(wire), (len(payload), len(wire))
+
+    def test_incompressible_ships_raw(self):
+        rng = np.random.default_rng(5)
+        payload = rng.integers(0, 256, size=4096, dtype=np.uint8).tobytes()
+        hdr, wire = wc.compress_model_part("full", payload, 0)
+        assert hdr == {} and wire == payload
+        assert wc.decompress_model_part({}, wire) == payload
+
+    def test_small_payload_unchanged(self):
+        hdr, wire = wc.compress_model_part("full", b"abcd", 0)
+        assert hdr == {} and wire == b"abcd"
+
+    def test_corrupt_payload_raises(self):
+        payload = np.arange(256, dtype=np.uint32).tobytes()
+        hdr, wire = wc.compress_model_part("xfull", payload, 0)
+        assert hdr.get("cz") == "zs"
+        with pytest.raises(ValueError):
+            wc.decompress_model_part(hdr, wire[:-3])
+        with pytest.raises(ValueError):
+            wc.decompress_model_part({**hdr, "ulen": 17}, wire)
+        with pytest.raises(ValueError):
+            wc.decompress_model_part({**hdr, "cz": "??"}, wire)
+
+    def test_xfull_decode_is_exact_and_crc_gated(self):
+        rng = np.random.default_rng(9)
+        d = 128
+        basis = rng.normal(size=d).astype(np.float32)
+        cur = (basis * 1.0001).astype(np.float32)
+        payload = wiredelta.encode_xfull(cur, basis)
+        out = wiredelta.decode(wiredelta.XFULL, payload, 0, basis,
+                               wiredelta.crc(cur), None)
+        assert out is not None and out.tobytes() == cur.tobytes()
+        # wrong CRC -> None (fallback contract)
+        assert wiredelta.decode(wiredelta.XFULL, payload, 0, basis,
+                                12345, None) is None
+        # wrong basis size -> None
+        assert wiredelta.decode(wiredelta.XFULL, payload, 0,
+                                basis[:-1], wiredelta.crc(cur),
+                                None) is None
+
+
+# ----------------------------------------------------------------- wire path
+def make_cfg(**kw):
+    from asyncframework_tpu.solvers import SolverConfig
+
+    defaults = dict(
+        num_workers=2, num_iterations=400, gamma=0.5, taw=2 ** 31 - 1,
+        batch_rate=0.3, bucket_ratio=0.0, printer_freq=100, seed=42,
+        calibration_iters=4, run_timeout_s=60.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+def _drive_pushes(ps_port, codec, n_pushes, d, scale=0.05):
+    """A deterministic pull+push sequence through one client; returns
+    the client (for its counters)."""
+    from asyncframework_tpu.parallel.ps_dcn import PSClient
+
+    cl = PSClient("127.0.0.1", ps_port, pull_mode="full",
+                  push_codec=codec)
+    rng = np.random.default_rng(123)
+    for _ in range(n_pushes):
+        ts, _w, _avg, _cal = cl.pull(0)
+        g = (scale * rng.normal(size=d)).astype(np.float32)
+        cl.push(0, ts, g)
+    return cl
+
+
+class TestCodecWire:
+    def _final_model(self, devices, codec, d=64, n_pushes=30):
+        import jax
+
+        from asyncframework_tpu.parallel import ps_dcn
+
+        reset_totals()
+        ps = ps_dcn.ParameterServer(make_cfg(), d, 256,
+                                    device=devices[0], port=0).start()
+        try:
+            _drive_pushes(ps.port, codec, n_pushes, d)
+            w = np.array(ps._model_snap().w_host, np.float32)
+            push_bytes = ps.push_bytes
+        finally:
+            ps.stop()
+        return w, push_bytes
+
+    def test_codec_off_matches_knob_absent_byte_identical(self, devices8):
+        """'off' must be the legacy wire, asserted the repo way: per-op
+        frame-byte totals identical under a fixed seed."""
+        import jax
+
+        from asyncframework_tpu.parallel import ps_dcn
+
+        totals = {}
+        for label, codec in (("absent", None), ("off", "off")):
+            reset_totals()
+            ps = ps_dcn.ParameterServer(make_cfg(), 32, 256,
+                                        device=devices8[0],
+                                        port=0).start()
+            try:
+                _drive_pushes(ps.port, codec, 12, 32)
+            finally:
+                ps.stop()
+            totals[label] = {
+                op: dict(v) for op, v in _frame.bytes_totals().items()
+                if op in ("PUSH", "MODEL", "PULL", "ACK")
+            }
+        assert totals["absent"] == totals["off"]
+
+    def test_int8_quarters_push_bytes_and_bounded_deviation(self,
+                                                           devices8):
+        d = 64
+        w_off, bytes_off = self._final_model(devices8, "off", d=d)
+        w_q, bytes_q = self._final_model(devices8, "int8", d=d)
+        # dense f32 payload (d*4) -> int8 payload (d): ~4x fewer
+        # gradient bytes on the wire
+        assert bytes_q < 0.35 * bytes_off, (bytes_q, bytes_off)
+        # error feedback keeps the trajectory deviation bounded: the
+        # applied-sum identity means the models differ by the step
+        # scale times ONE residual, not by anything cumulative
+        denom = np.abs(w_off).max() + 1e-9
+        assert np.abs(w_q - w_off).max() / denom < 0.05, (
+            np.abs(w_q - w_off).max(), denom)
+
+    def test_fp16_halves_push_bytes(self, devices8):
+        d = 64
+        _w_off, bytes_off = self._final_model(devices8, "off", d=d)
+        w_q, bytes_q = self._final_model(devices8, "fp16", d=d)
+        assert bytes_q < 0.6 * bytes_off, (bytes_q, bytes_off)
+        assert np.isfinite(w_q).all()
+
+    def test_push_codec_resolves_from_conf(self, devices8):
+        """SolverConfig/conf plumbing: a client built with no explicit
+        codec reads async.codec.push."""
+        from asyncframework_tpu.conf import AsyncConf, set_global_conf
+        from asyncframework_tpu.parallel import ps_dcn
+
+        ps = ps_dcn.ParameterServer(make_cfg(), 16, 256,
+                                    device=devices8[0], port=0).start()
+        try:
+            set_global_conf(AsyncConf({"async.codec.push": "int8"}))
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, pull_mode="full")
+            assert cl.push_codec == "int8"
+            ts, _w, _a, _c = cl.pull(0)
+            cl.push(0, ts, np.ones(16, np.float32))
+            assert wc.codec_totals().get("grad_enc_int8", 0) == 1
+        finally:
+            set_global_conf(None)
+            ps.stop()
