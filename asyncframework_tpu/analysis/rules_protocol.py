@@ -61,6 +61,11 @@ FENCE_SERVER_PATHS = (
 FENCE_CLIENT_STAMPS = {
     PS_DCN_PATH: "_proc_hdr",
     "asyncframework_tpu/relaycast/source.py": "_stamped",
+    # the replication stream's choke point: every REPL_SYNC/REPL_APPEND
+    # frame carries the primary's current epoch, so a deposed
+    # incarnation's appends are exactly the stale-stamp shape the
+    # standby's admission rejects
+    "asyncframework_tpu/parallel/replication.py": "_stamped",
 }
 # legacy aliases (kept: the acceptance tests and docs name them)
 FENCE_CLIENT_PATHS = tuple(FENCE_CLIENT_STAMPS)

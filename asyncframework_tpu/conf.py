@@ -271,6 +271,24 @@ PS_SHARDS = ConfigEntry(
     "gate, the elastic supervisor, and the eval plane; secondaries "
     "serve their ranges ungated.  1 (the default) is the classic "
     "single-PS path, byte- and step-identical.")
+PS_STANDBY = ConfigEntry(
+    "async.ps.standby", 0, int,
+    "Warm standby processes per PS shard (parallel/replication.py): 1 "
+    "provisions one standby child behind every shard primary; the "
+    "primary streams accepted merge batches to it (REPL_SYNC bootstrap "
+    "+ REPL_APPEND per drained batch -- post-dedup, with each item's "
+    "(sid, seq) stamp and verdict, stamped with the primary's merge "
+    "clock and fencing epoch), and on lease expiry the ShardGroup "
+    "controller PROMOTEs the standby under the next fencing epoch "
+    "instead of relaunching from checkpoint -- failover is bounded by "
+    "suspicion time, not checkpoint replay, and the deposed primary's "
+    "writes are REJECT_FENCED.  Standbys double as read replicas "
+    "(SUBSCRIBE / relaycast roots) with staleness priced by their "
+    "replication lag (ps.standby_lag series, standby_lag SLO rule).  "
+    "0 (the default) keeps the classic restart-from-checkpoint "
+    "recovery.  Promotion additionally requires async.fence.enabled "
+    "and shards >= 2 (a map to re-announce the moved endpoint "
+    "through); otherwise a standby is a warm read replica only.")
 PUSH_MERGE = ConfigEntry(
     "async.push.merge", 8, int,
     "Upper bound on PUSHes the PS coalesces into one fused device apply "
@@ -511,6 +529,8 @@ SLO_RULES = ConfigEntry(
     "unless ps.done; "
     "shard_availability: max(ps_shards.dark_ranges) < 1 over 15s "
     "for 3s unless ps_shards.done; "
+    "standby_lag: max(ps.standby_lag) < 512 over 15s for 5s "
+    "unless ps.done; "
     "fenced_writes: rate(recovery.fenced_rejects) < 1 over 30s for 10s",
     str,
     "Declarative SLO rule set (metrics/slo.py grammar: '<name>: "

@@ -375,7 +375,8 @@ def render_ps_shards(shards: int, d: int, n: int,
                      workers: int = 8, namespace: str = "default",
                      image: str = DEFAULT_IMAGE,
                      cfg_overrides: Optional[dict] = None,
-                     resources: Optional[dict] = None) -> List[dict]:
+                     resources: Optional[dict] = None,
+                     standbys: int = 0) -> List[dict]:
     """Sharded parameter-server group (parallel/shardgroup.py): one
     Deployment + Service + checkpoint PVC **per shard**, each pod running
     the same env-driven shard child the local :class:`ShardGroup`
@@ -391,7 +392,17 @@ def render_ps_shards(shards: int, d: int, n: int,
     already guarantees.  Per-shard scrape: every pod carries the
     prometheus.io annotations plus a ``shard`` label, and the child
     starts its /metrics endpoint with a ``shard=<i>`` exposition label --
-    per-shard series never collapse in the aggregator."""
+    per-shard series never collapse in the aggregator.
+
+    ``standbys=1`` additionally renders one WARM STANDBY pod + Service
+    per shard (parallel/replication.py): the primary streams its
+    accepted merge batches to ``async-ps-shard-<i>-standby`` (rendered
+    into its ``ASYNC_SHARD_STANDBYS``), which mirrors the range live --
+    a read replica for SUBSCRIBE / relaycast roots whose staleness is
+    priced by replication lag, and a promotion target for an operator
+    or external controller (the Deployment controller's restart remains
+    the k8s-native recovery for the primary itself; a standby pod needs
+    no PVC -- its state is re-synced over the stream on every boot)."""
     import dataclasses
     import json as _json
 
@@ -409,6 +420,8 @@ def render_ps_shards(shards: int, d: int, n: int,
     ranges = shard_ranges(d, shards)
     smap = [[f"async-ps-shard-{i}", PS_SHARD_PORT, lo, hi]
             for i, (lo, hi) in enumerate(ranges)]
+    standby_map = ([[f"async-ps-shard-{i}-standby", PS_SHARD_PORT]
+                    for i in range(shards)] if standbys > 0 else None)
     objs: List[dict] = []
     for i, (lo, hi) in enumerate(ranges):
         name = f"async-ps-shard-{i}"
@@ -439,6 +452,9 @@ def render_ps_shards(shards: int, d: int, n: int,
             # (silence bound) is the ONLY honest signal up here
             {"name": "ASYNCTPU_ASYNC_LEASE_S", "value": "5"},
         ]
+        if standby_map is not None:
+            env.append({"name": "ASYNC_SHARD_STANDBYS",
+                        "value": _json.dumps(standby_map)})
         container = _container(
             f"ps-shard-{i}", image,
             ["python", "-m", "asyncframework_tpu.parallel.shardgroup"],
@@ -480,6 +496,54 @@ def render_ps_shards(shards: int, d: int, n: int,
             "apiVersion": "v1", "kind": "Service",
             "metadata": _meta(name, "ps-shard", namespace),
             "spec": {"selector": {"app": name},
+                     "ports": [{"name": "ps", "port": PS_SHARD_PORT,
+                                "targetPort": PS_SHARD_PORT}]},
+        })
+        if standby_map is None:
+            continue
+        sb_name = f"{name}-standby"
+        sb_env = [
+            {"name": "ASYNC_SHARD_INDEX", "value": str(i)},
+            {"name": "ASYNC_SHARD_COUNT", "value": str(shards)},
+            {"name": "ASYNC_SHARD_D", "value": str(d)},
+            {"name": "ASYNC_SHARD_N", "value": str(n)},
+            {"name": "ASYNC_SHARD_ALGO", "value": "asgd"},
+            {"name": "ASYNC_SHARD_BIND_PORT", "value": str(PS_SHARD_PORT)},
+            {"name": "ASYNC_SHARD_CFG", "value": _json.dumps(cfg)},
+            {"name": "ASYNC_SHARD_ROLE", "value": "standby"},
+            # no checkpoint, no PVC: a standby's state arrives over the
+            # replication stream (REPL_SYNC on every boot/reconnect)
+            {"name": "ASYNC_SHARD_CKPT", "value": ""},
+            {"name": "ASYNC_SHARD_MAP", "value": _json.dumps(smap)},
+            {"name": "ASYNC_SHARD_ELASTIC", "value": "0"},
+            {"name": "ASYNC_SHARD_EPOCH", "value": "1"},
+            {"name": "ASYNCTPU_ASYNC_FENCE_ENABLED", "value": "1"},
+        ]
+        sb_container = _container(
+            f"ps-shard-{i}-standby", image,
+            ["python", "-m", "asyncframework_tpu.parallel.shardgroup"],
+            ports=[PS_SHARD_PORT], resources=resources,
+        )
+        sb_container["env"] = sb_env + sb_container.get("env", [])
+        sb_meta = _pod_meta(sb_name)
+        sb_meta["labels"]["shard"] = str(i)
+        sb_meta["labels"]["role"] = "standby"
+        objs.append({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": _meta(sb_name, "ps-shard-standby", namespace),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": sb_name}},
+                "template": {
+                    "metadata": sb_meta,
+                    "spec": {"containers": [sb_container]},
+                },
+            },
+        })
+        objs.append({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": _meta(sb_name, "ps-shard-standby", namespace),
+            "spec": {"selector": {"app": sb_name},
                      "ports": [{"name": "ps", "port": PS_SHARD_PORT,
                                 "targetPort": PS_SHARD_PORT}]},
         })
@@ -528,7 +592,8 @@ def render_cluster(workers: int, namespace: str = "default",
                    serving_ps: Optional[str] = None,
                    relay_fanout: int = 0,
                    ps_shards: int = 0, ps_d: int = 0, ps_n: int = 0,
-                   ps_workers: int = 8) -> Dict[str, str]:
+                   ps_workers: int = 8,
+                   ps_standbys: int = 0) -> Dict[str, str]:
     """The whole standalone topology as {filename: yaml} -- apply with
     ``kubectl apply -f <dir>``."""
     out = {
@@ -551,7 +616,7 @@ def render_cluster(workers: int, namespace: str = "default",
     if ps_shards > 0:
         out["ps-shards.yaml"] = to_yaml(render_ps_shards(
             ps_shards, ps_d, ps_n, workers=ps_workers,
-            namespace=namespace, image=image,
+            namespace=namespace, image=image, standbys=ps_standbys,
         ))
     return out
 

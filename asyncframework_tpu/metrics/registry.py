@@ -149,9 +149,18 @@ _register(CounterFamily(
 _register(CounterFamily(
     "shardgroup", "asyncframework_tpu.parallel.shardgroup",
     "shard_totals", "reset_shard_totals",
-    doc="Sharded PS group: shard deaths/restarts, finish broadcasts, "
-        "assembled pulls/pushes, abandoned fan-out rounds "
+    doc="Sharded PS group: shard deaths/restarts, standby promotions/"
+        "respawns, finish broadcasts, assembled pulls/pushes, map "
+        "re-resolves, abandoned fan-out rounds "
         "(parallel/shardgroup.py).",
+))
+_register(CounterFamily(
+    "replication", "asyncframework_tpu.parallel.replication",
+    "repl_totals", "reset_repl_totals",
+    doc="Hot-standby replication: batches/items streamed, syncs, "
+        "resyncs, reconnects, queue overflows, fenced streams "
+        "(primary sender); appends applied, sync installs, promotions "
+        "(standby applier) (parallel/replication.py).",
 ))
 _register(CounterFamily(
     "convergence", "asyncframework_tpu.metrics.timeseries",

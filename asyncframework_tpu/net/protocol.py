@@ -127,6 +127,34 @@ _op("EVAL_RESULT", PS, mutating=True,
 _op("BYE", PS, mutating=True,
     doc="Departing client's final piggybacks (spans/pl/cv).  Sent once "
         "per connection, never retried; span folds dedup by span_id.")
+_op("REPL_APPEND", PS, mutating=True, fence_stamped=True,
+    fault_schedulable=True,
+    doc="Primary->standby replication of one accepted merge batch "
+        "(parallel/replication.py): the post-dedup drained items with "
+        "their (sid, seq) stamps, verdicts, and staleness, stamped with "
+        "the primary's merge clock (pre) and fencing epoch; accepted "
+        "gradients ride as the payload.  Mutating but NOT dedup-gated: "
+        "idempotence is the clock compare -- a batch entirely at-or-"
+        "below the standby's applied clock re-ACKs as a duplicate, a "
+        "batch starting exactly AT the clock applies, anything else is "
+        "refused with resync=True (never applied twice; the stream is "
+        "strictly serial per connection).  A deposed primary's post-"
+        "promotion appends are REJECT_FENCED -- that admission IS the "
+        "promotion-safety argument.")
+_op("REPL_SYNC", PS, mutating=True, fence_stamped=True,
+    fault_schedulable=True,
+    doc="Full-state bootstrap of a (re)connecting standby: the "
+        "primary's checkpoint image (model + clock + dedup window + "
+        "trajectory) as one payload.  Idempotent: installing the same "
+        "image twice converges to the same state, and a newer sync "
+        "simply supersedes an older one.")
+_op("PROMOTE", PS, mutating=True,
+    doc="Controller order promoting a standby to range primary under "
+        "the NEXT fencing epoch.  Deliberately NOT fence_stamped: its "
+        "whole job is to raise the epoch past the deposed primary's.  "
+        "Idempotent by monotone epoch compare -- re-delivery of the "
+        "same (or an older) epoch re-answers ACK without demoting "
+        "anything.")
 _op("MODEL", PS, direction=REPLY,
     doc="PULL/SUBSCRIBE reply: full / NOT_MODIFIED / XOR-delta payload "
         "with version CRC.")
@@ -276,6 +304,7 @@ def fault_schedulable_ops() -> FrozenSet[str]:
 PROTOCOL_MODULES: Tuple[str, ...] = (
     "asyncframework_tpu/parallel/ps_dcn.py",
     "asyncframework_tpu/parallel/shardgroup.py",
+    "asyncframework_tpu/parallel/replication.py",
     "asyncframework_tpu/serving/replica.py",
     "asyncframework_tpu/serving/frontend.py",
     "asyncframework_tpu/serving/server.py",
@@ -302,6 +331,9 @@ SERVER_DISPATCH: Dict[str, Tuple[str, ...]] = {
     "SHARDMAP": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "SETMAP": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "FINISH": ("asyncframework_tpu/parallel/ps_dcn.py",),
+    "REPL_APPEND": ("asyncframework_tpu/parallel/ps_dcn.py",),
+    "REPL_SYNC": ("asyncframework_tpu/parallel/ps_dcn.py",),
+    "PROMOTE": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "SNAPSHOTS": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "EVAL_RESULT": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "BYE": ("asyncframework_tpu/parallel/ps_dcn.py",),

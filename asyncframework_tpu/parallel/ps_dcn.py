@@ -343,7 +343,8 @@ class ParameterServer:
                  checkpoint_path: Optional[str] = None,
                  supervisor: Optional[ElasticSupervisor] = None,
                  bus=None, shard_map=None, shard_index: int = 0,
-                 epoch: Optional[int] = None, shard_epochs=None):
+                 epoch: Optional[int] = None, shard_epochs=None,
+                 standby: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -392,6 +393,22 @@ class ParameterServer:
         # WELCOME omits the key and the wire stays byte-identical.
         self.shard_map = [list(e) for e in shard_map] if shard_map else None
         self.shard_index = int(shard_index)
+        # hot-standby replication (parallel/replication.py, ISSUE 13).
+        # standby=True: this server is a WARM STANDBY -- it refuses the
+        # training plane (PULL/PUSH answer ERR; it is not in the shard
+        # map), applies its primary's replicated merge batches
+        # (REPL_SYNC bootstrap + REPL_APPEND stream) through the same
+        # jitted kernel, and serves SUBSCRIBE/SHARDMAP reads from the
+        # mirrored snapshot (staleness priced by replication lag).  A
+        # PROMOTE order flips it to range primary under the minted
+        # epoch.  standby_map names every shard's standby endpoint
+        # ([host, port] | None per range, installed via SETMAP or the
+        # launcher); a PRIMARY whose own entry is set runs a
+        # ReplicationStream (self.repl) to it.
+        self._standby = bool(standby)
+        self.standby_map: Optional[List] = None
+        self.repl = None
+        self.promoted = False
         self.checkpoint_path = checkpoint_path
         self.resumed_from_k: Optional[int] = None
         self.device = device if device is not None else jax.devices()[0]
@@ -650,7 +667,13 @@ class ParameterServer:
         self._seen_span_ids: "_OD[str, None]" = _OD()
 
         self._elapsed_offset_ms = 0.0  # wall already spent before a resume
-        if checkpoint_path and os.path.exists(checkpoint_path):
+        # a STANDBY never boot-restores: its state arrives over the wire
+        # (REPL_SYNC) at the epoch its primary streams, and a stale
+        # checkpoint restore here would mint an epoch ABOVE the stream's
+        # and wrongly fence it out.  The path is still kept: once
+        # promoted, this server checkpoints its range there.
+        if (checkpoint_path and os.path.exists(checkpoint_path)
+                and not self._standby):
             self._restore(checkpoint_path)
 
         self._srv = socket.create_server((host, port))
@@ -704,7 +727,7 @@ class ParameterServer:
         """Flat scalars the time-series sampler records as ``ps.<key>``
         (lock-free reads of ints: a tick may see a torn multi-field view,
         but each individual series stays monotone/correct)."""
-        return {
+        out = {
             "clock": self._clock,
             "k": self._k,
             "accepted": self.accepted,
@@ -713,6 +736,16 @@ class ParameterServer:
             "max_staleness": self.max_staleness,
             "done": int(self._done.is_set()),
         }
+        repl = self.repl
+        if repl is not None:
+            # the standby's replication lag in merge units -- the
+            # ps.standby_lag series the default standby_lag SLO rule
+            # watches (read staleness on the standby is priced by it)
+            out["standby_lag"] = float(repl.lag_versions())
+            out["standby_synced"] = 1.0 if repl.synced else 0.0
+        if self._standby:
+            out["standby"] = 1.0
+        return out
 
     # ---------------------------------------------------------- checkpointing
     def _checkpoint_state(self) -> dict:
@@ -805,8 +838,6 @@ class ParameterServer:
                 pass
 
     def _restore(self, path: str) -> None:
-        import jax
-
         with np.load(path, allow_pickle=False) as z:
             meta = json.loads(str(z["__meta__"]))
             if meta["algo"] != self.algo:
@@ -814,49 +845,7 @@ class ParameterServer:
                     f"checkpoint algo {meta['algo']!r} != PS algo "
                     f"{self.algo!r}"
                 )
-            self._w = jax.device_put(z["w"], self.device)
-            self._snap = None
-            self._w_versions.clear()
-            with self._born_lock:
-                self._ver_born.clear()  # prior-life ages are meaningless
-            self._snap_basis = (int(meta["clock"]), self._w,
-                                self._model_gen)
-            self._clock = int(meta["clock"])
-            self._k = int(meta["k"])
-            self.accepted = int(meta["accepted"])
-            self.dropped = int(meta["dropped"])
-            self.push_bytes = int(meta["push_bytes"])
-            self.max_staleness = int(meta["max_staleness"])
-            self._cal_ms = float(meta["cal_ms"])
-            self._cal_n = int(meta["cal_n"])
-            self.avg_delay_ms = float(meta["avg_delay_ms"])
-            self._elapsed_offset_ms = float(meta["elapsed_ms"])
-            if "snap_stack" in z:
-                stack = z["snap_stack"]
-                self._snapshots = [
-                    (t, stack[i].copy())
-                    for i, t in enumerate(meta["snap_times"])
-                ]
-            if self.algo == "asaga":
-                self._ab = jax.device_put(z["ab"], self.device)
-                self._table = {
-                    int(k.split("_", 1)[1]): z[k].copy()
-                    for k in z.files if k.startswith("table_")
-                }
-                for wid_s, state in meta.get("rng_states", {}).items():
-                    rng = np.random.default_rng()
-                    rng.bit_generator.state = state
-                    self._rngs[int(wid_s)] = rng
-            self._dedup.load_state(meta.get("dedup"))
-            self.pushes_by_wid = {
-                int(w): int(c)
-                for w, c in meta.get("pushes_by_wid", {}).items()
-            }
-            self.accepted_by_wid = {
-                int(w): int(c)
-                for w, c in meta.get("accepted_by_wid", {}).items()
-            }
-            self.membership_rejects = int(meta.get("membership_rejects", 0))
+            self._install_state(z, meta)
             if self.epoch > 0:
                 # every incarnation is a NEW epoch: a restart from this
                 # checkpoint must dominate anything the previous life
@@ -867,6 +856,80 @@ class ParameterServer:
             self.fenced_rejects = int(meta.get("fenced_rejects", 0))
         self.resumed_from_k = self._k
         supervisor_mod.bump_total("ps_resumes")
+
+    def _install_state(self, z, meta: dict) -> None:
+        """Install a checkpoint image's model + bookkeeping (shared by
+        the boot-time restore and the standby's REPL_SYNC applier).
+        Deliberately does NOT touch the fencing epoch or the fenced-
+        reject counter: incarnation identity belongs to the caller --
+        a restore bumps past the persisted epoch, a standby sync keeps
+        the epoch its stream runs at."""
+        import jax
+
+        # generation bump FIRST: a lock-free reader mid-build (a live
+        # standby keeps serving SUBSCRIBE through a re-sync) must fail
+        # its publish guard, or it would cache the PRE-install snapshot
+        # after the install and serve it until the next accepted apply
+        # happened to bump the generation.  The _snap clear comes LAST,
+        # after every other field, so a reader that re-reads the basis
+        # builds the NEW state.  (The guard's compare-then-store is not
+        # atomic -- the residual preemption window is the same one the
+        # drain path has always had, and the next invalidation clears
+        # it.)
+        self._model_gen += 1
+        self._w = jax.device_put(z["w"], self.device)
+        self._w_versions.clear()
+        with self._born_lock:
+            self._ver_born.clear()  # prior-life ages are meaningless
+        self._snap_basis = (int(meta["clock"]), self._w,
+                            self._model_gen)
+        self._clock = int(meta["clock"])
+        self._k = int(meta["k"])
+        # the DEVICE step counter must follow k: the ASGD step-size
+        # schedule reads it (gamma/sqrt(k/P+1)), so leaving it at this
+        # life's old value would replay the installed state's future
+        # updates at the wrong step sizes -- a silent divergence between
+        # a mirror and its primary (and, before this, between a
+        # restarted shard and the run it resumed)
+        import jax.numpy as jnp
+
+        self._k_dev = jax.device_put(jnp.float32(self._k), self.device)
+        self.accepted = int(meta["accepted"])
+        self.dropped = int(meta["dropped"])
+        self.push_bytes = int(meta["push_bytes"])
+        self.max_staleness = int(meta["max_staleness"])
+        self._cal_ms = float(meta["cal_ms"])
+        self._cal_n = int(meta["cal_n"])
+        self.avg_delay_ms = float(meta["avg_delay_ms"])
+        self._elapsed_offset_ms = float(meta["elapsed_ms"])
+        if "snap_stack" in z:
+            stack = z["snap_stack"]
+            self._snapshots = [
+                (t, stack[i].copy())
+                for i, t in enumerate(meta["snap_times"])
+            ]
+        else:
+            self._snapshots = []
+        if self.algo == "asaga":
+            self._ab = jax.device_put(z["ab"], self.device)
+            self._table = {
+                int(k.split("_", 1)[1]): z[k].copy()
+                for k in z.files if k.startswith("table_")
+            }
+            for wid_s, state in meta.get("rng_states", {}).items():
+                rng = np.random.default_rng()
+                rng.bit_generator.state = state
+                self._rngs[int(wid_s)] = rng
+        self._dedup.load_state(meta.get("dedup"))
+        self.pushes_by_wid = {
+            int(w): int(c)
+            for w, c in meta.get("pushes_by_wid", {}).items()
+        }
+        self.accepted_by_wid = {
+            int(w): int(c)
+            for w, c in meta.get("accepted_by_wid", {}).items()
+        }
+        self.membership_rejects = int(meta.get("membership_rejects", 0))
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -936,16 +999,31 @@ class ParameterServer:
                 # verbs so fault schedules (net/faults.py) can target the
                 # ASAGA stream without also counting ASGD ops
                 if op in ("PULL", "PULL_SAGA"):
+                    if self._standby:
+                        # a standby is a READ replica: SUBSCRIBE serves
+                        # from its mirrored snapshot, but the training
+                        # plane (wave gate, membership, merges) belongs
+                        # to the range primary alone -- it is not in
+                        # the shard map, and a client that lands here
+                        # anyway must re-resolve, not train against a
+                        # mirror
+                        _send_msg(conn, {"op": "ERR", "msg": "standby"})
+                        continue
                     if self._fence_reject(conn, header):
                         continue
                     self._handle_pull(conn, header)
                 elif op == "SUBSCRIBE":
                     # serving-tier snapshot subscription: a read-only,
                     # wave-gate-free pull that keeps answering after DONE
+                    # (standbys serve it too -- the read-replica face of
+                    # hot-standby replication, staleness priced by lag)
                     if self._fence_reject(conn, header):
                         continue
                     self._handle_subscribe(conn, header)
                 elif op in ("PUSH", "PUSH_SAGA"):
+                    if self._standby:
+                        _send_msg(conn, {"op": "ERR", "msg": "standby"})
+                        continue
                     cached = self._dedup.check(header)
                     if cached is not None:
                         # duplicate of an already-applied push (the ACK was
@@ -992,6 +1070,13 @@ class ParameterServer:
                         reply["fenced_rejects"] = self.fenced_rejects
                     if self.shard_epochs:
                         reply["epochs"] = self.shard_epochs
+                    if self.standby_map:
+                        # discovery surface for the read path: serving
+                        # replicas / relaycast roots may subscribe to a
+                        # range's standby instead of its primary
+                        reply["standbys"] = self.standby_map
+                    if self._standby:
+                        reply["standby"] = True
                     _send_msg(conn, reply)
                 elif op == "SETMAP":
                     # group controller installing the assembled map on a
@@ -1008,7 +1093,47 @@ class ParameterServer:
                         # workers current epochs, not boot-time ones)
                         self.shard_epochs = [int(e)
                                              for e in header["epochs"]]
+                    if "standbys" in header:
+                        # the controller's standby endpoints: a primary
+                        # whose own entry is set (re)targets its
+                        # replication stream here -- promotion re-homes
+                        # a NEW standby behind the promoted primary via
+                        # the same install
+                        self.set_standby_map(header.get("standbys"))
                     _send_msg(conn, {"op": "ACK"})
+                elif op in ("REPL_APPEND", "REPL_SYNC"):
+                    # primary->standby replication stream (parallel/
+                    # replication.py).  Only a standby applies it, and
+                    # the fence admission below is THE promotion-safety
+                    # gate: a deposed primary's post-promotion appends
+                    # carry its stale epoch and bounce REJECT_FENCED --
+                    # including against the PROMOTED (ex-standby)
+                    # server itself, whose minted epoch now dominates,
+                    # which is how the zombie learns it was deposed.
+                    if self._standby:
+                        ep = header.get("ep")
+                        if ep is not None and int(ep) > self.epoch:
+                            # adopt-forward: the stream's source is
+                            # authoritative for its standby (a primary
+                            # relaunched from checkpoint streams at its
+                            # bumped epoch); a STALE stamp still fails
+                            # the admission below
+                            self.epoch = int(ep)
+                    if self._fence_reject(conn, header):
+                        continue
+                    if not self._standby:
+                        _send_msg(conn, {"op": "ERR",
+                                         "msg": "not a standby"})
+                        continue
+                    if op == "REPL_SYNC":
+                        self._handle_repl_sync(conn, payload)
+                    else:
+                        self._handle_repl_append(conn, header, payload)
+                elif op == "PROMOTE":
+                    # controller order: this standby becomes its range's
+                    # primary under the minted epoch (idempotent by
+                    # monotone epoch compare)
+                    self._handle_promote(conn, header)
                 elif op == "FINISH":
                     # group-wide DONE broadcast: a secondary shard serves
                     # its range with an unbounded iteration budget and
@@ -1112,6 +1237,236 @@ class ParameterServer:
             self._dedup.record(header, rej)
         _send_msg(conn, rej)
         return True
+
+    def note_fenced_above(self, ep: int) -> None:
+        """Fold a foreign successor epoch observed OUT of band (the
+        replication stream's REJECT_FENCED reply): from here on every
+        stamped op is refused, exactly as if a client had proven the
+        successor -- which drives workers to re-resolve onto it."""
+        ep = int(ep)
+        if ep > self._fenced_above:
+            self._fenced_above = ep
+
+    # ----------------------------------------------- hot-standby replication
+    def attach_standby(self, host: str, port: int) -> None:
+        """(Re)point this PRIMARY's replication stream at its warm
+        standby (parallel/replication.py).  Idempotent per endpoint.
+        ASGD-only, like the sharded plane it serves: ASAGA's per-sample
+        history table is not streamed."""
+        if self.algo != "asgd":
+            raise ValueError("standby replication is ASGD-only")
+        if self._standby:
+            raise ValueError("a standby does not stream to a standby")
+        from asyncframework_tpu.parallel.replication import (
+            ReplicationStream,
+        )
+
+        cur = self.repl
+        if (cur is not None and not cur.fenced
+                and (cur.host, cur.port) == (host, int(port))):
+            return
+        if cur is not None:
+            cur.stop()
+        self.repl = ReplicationStream(self, host, int(port))
+
+    def set_standby_map(self, wire) -> None:
+        """Install the group's standby endpoints (``[host, port]`` |
+        None per range, SETMAP/launcher-supplied) and reconcile this
+        server's own stream: a primary whose entry is set streams to
+        it; an entry gone stops the stream."""
+        self.standby_map = ([list(e) if e else None for e in wire]
+                            if wire else None)
+        if self._standby:
+            return
+        mine = None
+        if (self.standby_map
+                and self.shard_index < len(self.standby_map)):
+            mine = self.standby_map[self.shard_index]
+        if mine:
+            self.attach_standby(str(mine[0]), int(mine[1]))
+        elif self.repl is not None:
+            self.repl.stop()
+            self.repl = None
+
+    def _handle_repl_sync(self, conn: socket.socket,
+                          payload: bytes) -> None:
+        """Standby side of REPL_SYNC: install the primary's checkpoint
+        image as this mirror's state.  Idempotent -- re-installing the
+        same image converges to the same state; a newer image simply
+        supersedes.  The epoch is NOT taken from the image: the stream's
+        ``ep`` stamp (adopt-forward in the dispatch) is the incarnation
+        authority."""
+        from asyncframework_tpu.parallel import replication as _repl
+
+        try:
+            with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+                meta = json.loads(str(z["__meta__"]))
+                if meta["algo"] != self.algo:
+                    raise ValueError(
+                        f"sync algo {meta['algo']!r} != {self.algo!r}")
+                with self._lock:
+                    self._install_state(z, meta)
+                    clock = self._clock
+        except (ValueError, KeyError, OSError) as e:
+            _send_msg(conn, {"op": "ERR", "msg": f"bad sync: {e}"})
+            return
+        if self._t0 is not None:
+            # align this process's run clock with the primary's elapsed
+            # wall, so mirrored version births / snapshot times price
+            # freshness on the primary's timeline, not this process's
+            self._t0 = time.monotonic() - self._elapsed_offset_ms / 1e3
+        _repl.bump("sync_installs")
+        _send_msg(conn, {"op": "ACK", "clock": clock})
+
+    def _handle_repl_append(self, conn: socket.socket, header: dict,
+                            payload: bytes) -> None:
+        """Standby side of REPL_APPEND: apply one replicated merge batch
+        exactly as the primary judged it -- same accept verdicts through
+        the same jitted kernel in the same order, same ``(sid, seq)``
+        dedup records (so a promoted standby re-answers replayed worker
+        pushes from the REPLICATED window, never by re-applying), same
+        snapshot cadence (the promoted trajectory continues seamlessly).
+
+        Idempotence is the clock compare: a batch entirely at-or-below
+        the applied clock is a duplicate delivery and re-ACKs; a batch
+        starting exactly AT the clock applies; anything else is a gap --
+        refused with ``resync`` so the stream re-bootstraps.  Never
+        applied twice, never applied out of order."""
+        import jax
+
+        from asyncframework_tpu.parallel import replication as _repl
+
+        if self.algo != "asgd":
+            _send_msg(conn, {"op": "ERR", "msg": "replication is "
+                                                 "ASGD-only"})
+            return
+        items = header.get("items") or []
+        pre = int(header.get("pre", -1))
+        cal = header.get("cal")
+        with self._lock:
+            if pre + len(items) <= self._clock:
+                reply = {"op": "ACK", "clock": self._clock, "dup": True}
+            elif pre != self._clock:
+                _repl.bump("resyncs_requested")
+                reply = {"op": "ERR", "resync": True,
+                         "clock": self._clock}
+            else:
+                off = 0
+                for it in items:
+                    wid, ts = int(it[0]), int(it[1])
+                    acc = bool(it[2])
+                    sid, seq, ack = it[3], it[4], it[5]
+                    st = int(it[6])
+                    if sid is not None:
+                        self._dedup.record({"sid": sid, "seq": seq},
+                                           dict(ack))
+                    self.pushes_by_wid[wid] = (
+                        self.pushes_by_wid.get(wid, 0) + 1)
+                    if st > self.max_staleness:
+                        self.max_staleness = st
+                    if acc:
+                        g = np.frombuffer(
+                            payload[off:off + 4 * self.d], np.float32)
+                        off += 4 * self.d
+                        # same unpublish-before-tick discipline as the
+                        # drain: lock-free SUBSCRIBE readers must never
+                        # pair a new clock with old bytes
+                        self._model_gen += 1
+                        self._snap = None
+                        g_dev = jax.device_put(g, self.device)
+                        self._w, self._k_dev = self._apply(
+                            self._w, g_dev, self._k_dev)
+                        self._k += 1
+                        self.accepted += 1
+                        self.accepted_by_wid[wid] = (
+                            self.accepted_by_wid.get(wid, 0) + 1)
+                        if self._k % self.cfg.printer_freq == 0:
+                            # the primary's snapshot cadence, mirrored:
+                            # an owned host copy, never a buffer view
+                            self._snapshots.append((
+                                self._now_ms()
+                                if self._t0 is not None else 0.0,
+                                np.array(self._w, np.float32),
+                            ))
+                        if self._k >= self.cfg.num_iterations:
+                            self._done.set()
+                    else:
+                        self.dropped += 1
+                    self._clock += 1
+                if cal:
+                    self._cal_ms = float(cal[0])
+                    self._cal_n = int(cal[1])
+                    self.avg_delay_ms = float(cal[2])
+                self._snap_basis = (self._clock, self._w,
+                                    self._model_gen)
+                if self._t0 is not None:
+                    with self._born_lock:
+                        self._ver_born[self._clock] = self._now_ms()
+                        while len(self._ver_born) > 1024:
+                            self._ver_born.popitem(last=False)
+                _repl.bump("appends_applied")
+                _repl.bump("append_items", len(items))
+                reply = {"op": "ACK", "clock": self._clock}
+        # deliberately NO checkpoint trigger here: durability is the
+        # PRIMARY's job (a dead mirror is respawned and re-synced,
+        # nothing to restore), and a mirror writing the shard's durable
+        # files would race the acting primary's checkpoint thread on a
+        # shared path.  Once PROMOTED, this server checkpoints through
+        # the normal push path.
+        _send_msg(conn, reply)
+
+    def _handle_promote(self, conn: socket.socket,
+                        header: dict) -> None:
+        """PROMOTE: this standby becomes its range's primary at the
+        controller-minted epoch.  Idempotent by monotone compare; the
+        deposed primary needs no teardown order -- its next stream
+        append (or any worker op, once note_fenced_above folds the
+        bounce back) is REJECT_FENCED by the epoch installed here."""
+        from asyncframework_tpu.parallel import replication as _repl
+
+        ep = int(header.get("epoch", 0) or 0)
+        with self._lock:
+            if self._standby and ep <= self.epoch:
+                # a STALE order against a fresh mirror (a late operator
+                # retry, a re-delivered PROMOTE after this standby was
+                # respawned): flipping would orphan it from its
+                # primary's stream -- refuse, loudly.  An already-
+                # promoted server re-ACKs below (idempotent).
+                stale_ep, cur_ep = ep, self.epoch
+                was_standby = None
+            else:
+                if ep > self.epoch:
+                    self.epoch = ep
+                was_standby = self._standby
+                self._standby = False
+                # an already-promoted server re-ACKs a DUPLICATE order
+                # (ep == epoch: same map, install idempotent by value)
+                # but must NOT install a STALE one (ep < epoch: a late
+                # re-delivery from before a LATER failover would regress
+                # the map/epoch vector this server hands out)
+                stale_order = ep < self.epoch
+            clock, k = self._clock, self._k
+        if was_standby is None:
+            _send_msg(conn, {"op": "ERR",
+                             "msg": f"stale promote: epoch {stale_ep} "
+                                    f"<= standby epoch {cur_ep}"})
+            return
+        if not stale_order:
+            wire = header.get("shards") or None
+            if wire:
+                self.shard_map = [list(e) for e in wire]
+            if "index" in header:
+                self.shard_index = int(header["index"])
+            if header.get("epochs"):
+                self.shard_epochs = [int(e) for e in header["epochs"]]
+            if "standbys" in header:
+                # the fresh standby spawned behind THIS promoted primary
+                self.set_standby_map(header.get("standbys"))
+        if was_standby:
+            self.promoted = True
+            _repl.bump("promotions")
+        _send_msg(conn, {"op": "ACK", "clock": clock, "k": k,
+                         "epoch": self.epoch})
 
     def _release_wave_locked(self) -> None:
         """Fire the partial barrier: everyone currently waiting rides this
@@ -1629,6 +1984,10 @@ class ParameterServer:
 
         drained: List[_PendingPush] = []
         batch: List[Tuple[_PendingPush, Optional[np.ndarray]]] = []
+        # replication stream (parallel/replication.py): the standby
+        # applies from exactly this clock, so capture it before any
+        # item ticks it
+        pre_clock = self._clock
         # donation guard, captured BEFORE any accept mutates gen/_snap:
         # the fused kernel donates the model buffer (in-place apply), so
         # it may only run when the OUTGOING version already exists as a
@@ -1784,6 +2143,25 @@ class ParameterServer:
             self.merge_batches += 1
             self.merge_merged += len(batch)
             self.merge_batch_max = max(self.merge_batch_max, len(batch))
+        if self.repl is not None and drained:
+            # hot-standby replication: hand the WHOLE drained batch --
+            # verdicts, (sid, seq) stamps, staleness, and the accepted
+            # gradients' host arrays -- to the stream.  O(items) list
+            # work under the lock; serialization and I/O happen on the
+            # sender thread.  Dropped items ride too: they tick the
+            # standby's clock and land their dedup verdicts, so a
+            # promoted standby re-answers EVERY replayed stamp.
+            items = []
+            grads = []
+            for it in drained:
+                items.append([it.wid, it.ts, 1 if it.accepted else 0,
+                              it.header.get("sid"), it.header.get("seq"),
+                              it.ack, int(it.staleness)])
+                if it.accepted:
+                    grads.append(it.g_host)
+            self.repl.enqueue(pre_clock, items, grads,
+                              [self._cal_ms, self._cal_n,
+                               self.avg_delay_ms])
         for item in drained:
             if item.do_snapshot:
                 # host copy NOW: the snapshot must pin this version (the
@@ -1932,6 +2310,8 @@ class ParameterServer:
     def stop(self) -> None:
         self._stop.set()
         self._done.set()
+        if self.repl is not None:
+            self.repl.stop()
         if getattr(self, "_ts_source", None) is not None:
             from asyncframework_tpu.metrics import timeseries as _ts
 
@@ -2237,6 +2617,12 @@ class PSClient:
                 return None
             if op == "DONE":
                 return None
+            if op == "ERR":
+                # a refusing endpoint (a hot STANDBY answers the
+                # training plane this way): surface as a dead endpoint
+                # so loops pace and sharded facades re-resolve the map
+                raise ConnectionError(
+                    f"{self.endpoint} refused: {header.get('msg')!r}")
             if op == "REJECT_FENCED":
                 # deposed basis: adopt the minted epoch and re-pull ONCE
                 # with the fresh stamp (the current owner admits it); a
@@ -2556,6 +2942,12 @@ class PSClient:
                 f"push fenced by zombie {self.endpoint} (epoch "
                 f"{int(header.get('epoch', 0))} <= ours {self.epoch})"
             )
+        if header.get("op") == "ERR":
+            # a refusing endpoint (standby / malformed push): dead-
+            # endpoint semantics, same as the pull path
+            raise ConnectionError(
+                f"push refused by {self.endpoint}: "
+                f"{header.get('msg')!r}")
         if header.get("released"):
             self.released = True
         return bool(header.get("accepted")), bool(header.get("done"))
@@ -2672,6 +3064,10 @@ class PSClient:
                 f"push fenced by zombie {self.endpoint} (epoch "
                 f"{srv_ep} <= op stamp {entry[0].get('ep')})"
             )
+        if header.get("op") == "ERR":
+            raise ConnectionError(
+                f"windowed push refused by {self.endpoint}: "
+                f"{header.get('msg')!r}")
         if header.get("released"):
             self.released = True
         return bool(header.get("accepted")), bool(header.get("done"))
@@ -3489,6 +3885,21 @@ def run_worker_process(
             except (ConnectionError, OSError):
                 if time.monotonic() >= eval_deadline:
                     break  # trajectory forfeited, counts still returned
+                if smap is not None:
+                    # a hot-standby promotion may have MOVED a shard's
+                    # endpoint since HELLO: every retry here builds a
+                    # FRESH facade, so refresh the map from any live
+                    # member or the rebuilds would dial the dead
+                    # endpoint until the deadline forfeits the curve
+                    from asyncframework_tpu.parallel.shardgroup import (
+                        resolve_live_group,
+                    )
+
+                    smap2, epochs2 = resolve_live_group(smap.entries)
+                    if smap2 is not None:
+                        smap = smap2
+                        if epochs2:
+                            smap_epochs = epochs2
                 time.sleep(0.5)
             finally:
                 if cl is not None:

@@ -241,6 +241,26 @@ def finish_endpoint(host: str, port: int, timeout_s: float = 5.0) -> None:
     _oneshot(host, port, {"op": "FINISH"}, timeout_s)
 
 
+def resolve_live_group(entries, timeout_s: float = 2.0
+                       ) -> Tuple[Optional[ShardMap],
+                                  Optional[List[int]]]:
+    """Sweep a (possibly stale) map's entries for any LIVE member and
+    return its view of the CURRENT ``(shard_map, epochs)`` -- the one
+    re-resolution primitive behind every 'a promotion moved an
+    endpoint' recovery path (worker facade, serving subscriber, the
+    eval fan-out).  ``(None, None)`` when nobody answers."""
+    for e in list(entries):
+        try:
+            smap, epochs, _ep = fetch_group_info(
+                str(e[0]), int(e[1]), timeout_s=timeout_s)
+        except (ConnectionError, OSError):
+            continue
+        if smap is not None:
+            return smap, epochs
+        return None, None  # an unsharded answer: nothing to re-resolve
+    return None, None
+
+
 # ------------------------------------------------------- worker-side facade
 class ShardedPSClient:
     """The PSClient surface over a shard group: same methods the stock
@@ -271,6 +291,16 @@ class ShardedPSClient:
         from asyncframework_tpu.parallel.ps_dcn import PSClient
 
         self.smap = smap
+        # rebuild context for hot-standby promotion (ISSUE 13): a
+        # sub-shard endpoint can MOVE mid-run (the controller promotes
+        # the standby onto its own port), so _re_resolve needs
+        # everything a fresh sub-client takes
+        self._timeout_s = float(timeout_s)
+        self._proc = proc
+        self._recorder = recorder
+        self._pull_mode = pull_mode
+        self._pl_stats = pl_stats
+        self._cv_buf = cv_buf
         # piggybacked telemetry (trace spans, pipeline counters,
         # convergence samples) rides the PRIMARY connection only: the
         # primary folds it into the process that serves the dashboard;
@@ -290,6 +320,9 @@ class ShardedPSClient:
         ]
         self._saw_done = False
         self._finished = False
+        # faulted fan-out rounds since construction: every 3rd one also
+        # re-resolves the map (promotion-following, paced -- see _reset)
+        self._round_errors = 0
 
     # ------------------------------------------------------------ plumbing
     @property
@@ -303,12 +336,95 @@ class ShardedPSClient:
               pid: Optional[int] = None) -> dict:
         return self.clients[0].hello(proc, wids, pid=pid)
 
+    def _rebuild_client(self, i: int, host: str, port: int,
+                        epoch: int):
+        """One sub-client re-homed onto a moved endpoint (promotion).
+        The replacement keeps the OLD client's ClientSession and
+        inherits its unacked push window VERBATIM -- original
+        ``(sid, seq)`` stamps, original epoch stamps -- and drains the
+        replay synchronously: an entry the deposed primary applied AND
+        streamed re-answers from the promoted standby's REPLICATED
+        dedup window (exactly-once across the failover); an unapplied
+        or unstreamed one comes back REJECT_FENCED on its stale stamp
+        and is dropped -- the same loss as an abandoned round, never a
+        double apply."""
+        from asyncframework_tpu.parallel.ps_dcn import PSClient
+
+        old = self.clients[i]
+        nc = PSClient(host, int(port), timeout_s=self._timeout_s,
+                      proc=self._proc,
+                      recorder=self._recorder if i == 0 else None,
+                      pull_mode=self._pull_mode,
+                      pl_stats=self._pl_stats if i == 0 else None,
+                      cv_buf=self._cv_buf if i == 0 else None,
+                      session=old.session, epoch=int(epoch))
+        with old._win_lock:
+            entries = list(old._push_window)
+            old._push_window.clear()
+        old._drop_sock()
+        if entries:
+            nc._push_window.extend(entries)
+            nc._drop_sock()  # push_finish's reconnect REPLAYS them all
+            for _ in range(len(entries)):
+                try:
+                    nc.push_finish()
+                except (ConnectionError, OSError):
+                    nc.push_abandon()
+                    break
+        return nc
+
+    def _re_resolve(self) -> bool:
+        """After a sub-shard fault: ask any reachable member for the
+        CURRENT map (a promotion re-SETMAPs every member) and re-home
+        the sub-clients whose endpoints moved -- every moved one in ONE
+        pass, judged against each CLIENT's actual endpoint (an earlier
+        partial re-resolve must never mask a still-stale client).
+        Best-effort -- the caller is already on an error path and
+        retries either way."""
+        smap, epochs = resolve_live_group(self.smap.entries,
+                                          timeout_s=2.0)
+        if smap is None or smap.ranges() != self.smap.ranges():
+            return False
+        changed = False
+        for i, entry in enumerate(smap.entries):
+            c = self.clients[i]
+            if (str(entry[0]), int(entry[1])) == (c.host, c.port):
+                if (epochs and i < len(epochs)
+                        and int(epochs[i]) > c.epoch):
+                    c.epoch = int(epochs[i])
+                continue
+            try:
+                self.clients[i] = self._rebuild_client(
+                    i, entry[0], entry[1],
+                    int(epochs[i]) if epochs and i < len(epochs) else 0)
+            except (ConnectionError, OSError):
+                continue  # that replacement not up yet; retry later
+            changed = True
+        if changed:
+            self.smap = smap
+            _bump("map_re_resolves")
+        return changed
+
     def _reset(self) -> None:
         """Abandon the whole fan-out round: every shard's unacked window
         is dropped (piggybacks requeued) and every socket closed, so the
         next round starts from a clean slate on fresh connections --
         a half-consumed reply can never be mispaired."""
         _bump("shard_round_errors")
+        self._round_errors += 1
+        if self._round_errors % 3 == 0:
+            # hot-standby promotion moves a shard's endpoint mid-run:
+            # learn the current map and re-home moved sub-clients
+            # (their windows ride along and replay against the
+            # replicated dedup window).  PACED to every third faulted
+            # round -- the overwhelmingly common _reset trigger is a
+            # transient (a shard mid-relaunch), which must stay pure
+            # local cleanup, not a serial network sweep whose dark-
+            # member connect timeouts stall the worker's error path.
+            try:
+                self._re_resolve()
+            except Exception:  # noqa: BLE001 - recovery must never
+                pass           # mask the fault that brought us here
         for c in self.clients:
             try:
                 c.push_abandon()
@@ -523,6 +639,8 @@ class ShardedSubscriber:
             attempt_timeout_s=min(float(timeout_s), 2.0), max_attempts=1,
             base_ms=20.0, max_ms=80.0,
         )
+        self._retry = retry
+        self._timeout_s = float(timeout_s)
         self.clients = [
             PSClient(h, p, timeout_s=timeout_s, retry=retry,
                      pull_mode="delta",
@@ -532,6 +650,11 @@ class ShardedSubscriber:
         ]
         self._last: List[Optional[tuple]] = [None] * smap.n_shards
         self._ok_mono: List[Optional[float]] = [None] * smap.n_shards
+        # consecutive dark rounds per range: every third one also asks a
+        # live member whether the range's endpoint MOVED (hot-standby
+        # promotion) -- bounded extra probing, so a plainly-dead shard
+        # mid-restart does not buy a map round trip per refresh
+        self._dark_rounds: List[int] = [0] * smap.n_shards
         # collision guard for the replica's NOT_MODIFIED fast path: the
         # returned ts is the SUM of per-shard versions (the lag math
         # needs clock - ts in merge units), but a shard RESTART rolls its
@@ -564,7 +687,11 @@ class ShardedSubscriber:
                 got = c.subscribe(rid)
             except (ConnectionError, OSError):
                 _bump("subscribe_dark_rounds")
+                self._dark_rounds[i] += 1
+                if self._dark_rounds[i] % 3 == 0:
+                    self._maybe_re_resolve(i)
                 continue
+            self._dark_rounds[i] = 0
             if got is None:  # pragma: no cover - SUBSCRIBE never says DONE
                 continue
             self._last[i] = got
@@ -593,6 +720,45 @@ class ShardedSubscriber:
         done = all(bool(l[5]) for l in self._last)
         _bump("sharded_subscribes")
         return ts, w, clock, k, age, done
+
+    def _maybe_re_resolve(self, i: int) -> None:
+        """Range ``i`` has been dark for a few rounds: ask a LIVE member
+        for the current map -- a hot-standby promotion moved the range's
+        endpoint, and the subscriber must follow it (the replica's
+        partial-refresh machinery then completes the model with one
+        NM/delta round trip).  Rebuilds EVERY range whose endpoint
+        moved (simultaneous promotions included), judged against each
+        CLIENT's actual endpoint -- adopting the new map while
+        rebuilding only one range would strand the others forever.
+        Best-effort and bounded: one sweep, the dark range excluded
+        from the query targets (its blackholed probe must not stall
+        the refresh round)."""
+        from asyncframework_tpu.parallel.ps_dcn import PSClient
+
+        others = [e for j, e in enumerate(self.smap.entries) if j != i]
+        smap, epochs = resolve_live_group(others, timeout_s=1.0)
+        if smap is None or smap.ranges() != self.smap.ranges():
+            return
+        changed = False
+        for j, entry in enumerate(smap.entries):
+            c = self.clients[j]
+            if (str(entry[0]), int(entry[1])) == (c.host, c.port):
+                continue
+            try:
+                nc = PSClient(entry[0], int(entry[1]),
+                              timeout_s=self._timeout_s,
+                              retry=self._retry, pull_mode="delta",
+                              epoch=(int(epochs[j])
+                                     if epochs and j < len(epochs)
+                                     else 0))
+            except (ConnectionError, OSError):
+                continue  # that replacement not up yet; next dark round
+            c._drop_sock()
+            self.clients[j] = nc
+            changed = True
+        if changed:
+            self.smap = smap
+            _bump("subscriber_re_resolves")
 
     def oldest_ok_age_ms(self) -> Optional[float]:
         """Age of the STALEST range's last successful refresh; None until
@@ -710,7 +876,8 @@ class ShardGroup:
                  dead_after_s: float = 2.0,
                  check_interval_s: float = 0.25,
                  max_restarts: int = 10,
-                 spawn_timeout_s: float = 90.0):
+                 spawn_timeout_s: float = 90.0,
+                 standbys: Optional[int] = None):
         if algo != "asgd":
             raise ValueError("sharded PS groups support algo='asgd' only "
                              "(ASAGA's PS-side sampling is range-global)")
@@ -762,12 +929,33 @@ class ShardGroup:
             GRAY_RTT_FACTOR,
             GRAY_RTT_MIN_MS,
             LEASE_S,
+            PS_STANDBY,
             SUSPECT_AFTER_S,
             AsyncConf,
         )
 
         overlay_conf = AsyncConf(self.conf_overlays)
         self.fence = bool(overlay_conf.get(FENCE_ENABLED))
+        # hot-standby replication (ISSUE 13, async.ps.standby read
+        # through the same overlays the children see): one warm standby
+        # child per managed shard, fed by its primary's REPL stream.
+        # Failover becomes PROMOTE-under-the-minted-epoch instead of
+        # restart-from-checkpoint -- promotion additionally requires
+        # fencing (the epoch IS the safety primitive) and a shard map
+        # to re-announce; without either, standbys still serve as read
+        # replicas and recovery stays the classic relaunch.
+        if standbys is None:
+            standbys = int(overlay_conf.get(PS_STANDBY))
+        self.standbys = 1 if int(standbys) > 0 else 0
+        self._standby_procs: Dict[int, _ShardProc] = {}
+        self._standby_ok: Dict[int, float] = {}
+        self._standby_probe_t: Dict[int, float] = {}
+        self._standby_gen: Dict[int, int] = {}
+        self._promotions: Dict[int, int] = {}
+        self.promotions = 0
+        # deposed-but-alive primaries (promoted over while partitioned):
+        # fenced out of every write path, kept only so stop() reaps them
+        self._deposed: List[subprocess.Popen] = []
         # gray-failure detection: the liveness probes below time their
         # round trips into a cohort RTT suspector; a slow-but-alive shard
         # is marked SUSPECT in membership (and surfaced in telemetry)
@@ -811,7 +999,54 @@ class ShardGroup:
         return os.path.join(self.checkpoint_dir,
                             f"ps_shard{index}.npz")
 
-    def _child_env(self, index: int, bind_port: int) -> Dict[str, str]:
+    def _ckpt_standby_path(self, index: int) -> Optional[str]:
+        """Where THIS GENERATION's standby would checkpoint its range
+        once promoted.  Per-generation file (the spawn counter is in
+        the name): every durable file for a range has exactly ONE
+        writer ever -- a mirror never checkpoints while standby, and
+        successive promoted incarnations never share a path, so no
+        zombie's final save can race or roll back a successor's image."""
+        if not self.checkpoint_dir:
+            return None
+        gen = self._standby_gen.get(index, 0)
+        return os.path.join(self.checkpoint_dir,
+                            f"ps_shard{index}.standby{gen}.npz")
+
+    def _ckpt_newest_path(self, index: int) -> Optional[str]:
+        """The range's FRESHEST durable image for a fallback relaunch:
+        after promotions the acting primary persists to its generation's
+        standby file, so restoring the original path would silently
+        roll the range back past everything merged since the first
+        failover.  Candidates are ranked by the image's own (epoch,
+        clock) -- mtime alone could prefer a fenced zombie's last
+        stale save -- with mtime as the tiebreak/fallback for
+        unreadable files."""
+        primary = self._ckpt_path(index)
+        if not primary:
+            return None
+        import glob as _glob
+
+        candidates = [p for p in [primary] + sorted(_glob.glob(
+            os.path.join(self.checkpoint_dir,
+                         f"ps_shard{index}.standby*.npz")))
+            if os.path.exists(p)]
+        if not candidates:
+            return primary
+
+        def rank(path):
+            try:
+                with np.load(path, allow_pickle=False) as z:
+                    meta = json.loads(str(z["__meta__"]))
+                return (int(meta.get("epoch", 0)),
+                        int(meta.get("clock", 0)),
+                        os.path.getmtime(path))
+            except Exception:  # noqa: BLE001 - torn/corrupt image
+                return (-1, -1, os.path.getmtime(path))
+
+        return max(candidates, key=rank)
+
+    def _child_env(self, index: int, bind_port: int,
+                   role: str = "primary") -> Dict[str, str]:
         import dataclasses
 
         env = dict(self.env)
@@ -822,15 +1057,25 @@ class ShardGroup:
         env["ASYNC_SHARD_ALGO"] = self.algo
         env["ASYNC_SHARD_BIND_PORT"] = str(bind_port)
         env["ASYNC_SHARD_CFG"] = json.dumps(dataclasses.asdict(self.cfg))
-        env["ASYNC_SHARD_CKPT"] = self._ckpt_path(index) or ""
+        env["ASYNC_SHARD_ROLE"] = role
+        env["ASYNC_SHARD_CKPT"] = (
+            (self._ckpt_standby_path(index) if role == "standby"
+             else self._ckpt_newest_path(index)) or ""
+        )
         env["ASYNC_SHARD_WORKER_PROCS"] = str(self.worker_procs)
-        env["ASYNC_SHARD_ELASTIC"] = "1" if self.elastic else "0"
+        env["ASYNC_SHARD_ELASTIC"] = (
+            "1" if self.elastic and role == "primary" else "0"
+        )
         env["ASYNC_SHARD_CONF"] = json.dumps(self.conf_overlays)
         env["ASYNC_SHARD_MAP"] = (json.dumps(self.smap.to_wire())
                                   if self.smap is not None else "")
         env["ASYNC_SHARD_EPOCH"] = str(self.epoch_of(index))
         epochs = self.epochs_wire()
         env["ASYNC_SHARD_EPOCHS"] = json.dumps(epochs) if epochs else ""
+        sbs = self.standbys_wire() if role == "primary" else None
+        env["ASYNC_SHARD_STANDBYS"] = (
+            json.dumps(sbs) if sbs and any(sbs) else ""
+        )
         return env
 
     def epoch_of(self, index: int) -> int:
@@ -851,40 +1096,73 @@ class ShardGroup:
             return None
         return [self.epoch_of(i) for i in range(self.shards)]
 
-    def _spawn(self, index: int, bind_port: int) -> dict:
-        rec = self._procs[index]
+    def _spawn(self, index: int, bind_port: int,
+               role: str = "primary") -> dict:
+        standby = role == "standby"
+        if standby:
+            # per-generation identity (names this life's post-promotion
+            # checkpoint file -- see _ckpt_standby_path)
+            self._standby_gen[index] = (
+                self._standby_gen.get(index, 0) + 1)
+        rec = (self._standby_procs if standby else self._procs)[index]
         stderr = subprocess.DEVNULL
         if self.stderr_dir:
             # crash forensics (chaos tests, field debugging): each life of
             # each shard appends to its own log
             os.makedirs(self.stderr_dir, exist_ok=True)
-            stderr = open(os.path.join(self.stderr_dir,
-                                       f"shard{index}.stderr.log"), "a")
+            suffix = "-standby" if standby else ""
+            stderr = open(os.path.join(
+                self.stderr_dir,
+                f"shard{index}{suffix}.stderr.log"), "a")
         proc = subprocess.Popen(
             [sys.executable, "-m", "asyncframework_tpu.parallel.shardgroup"],
-            env=self._child_env(index, bind_port),
+            env=self._child_env(index, bind_port, role=role),
             stdout=subprocess.PIPE, stderr=stderr, text=True,
         )
         if stderr is not subprocess.DEVNULL:
             stderr.close()  # the child owns the fd now
         rec.attach(proc)
+        if not standby:
+            # register the relaunch IMMEDIATELY -- pid + /proc start
+            # time land under the supervisor lock the moment the child
+            # exists, not after its (possibly long) announce wait.
+            # Before this, the slot stayed DEAD for the whole spawn and
+            # a concurrent scan (check_once is public; tests and
+            # operators call it) could schedule a SECOND spawn for the
+            # same shard, killing the fresh child.  _restart's
+            # membership guard is the other half of the fix.
+            self.sup.register(f"ps-shard-{index}", [index], pid=proc.pid,
+                              host=socket.gethostname())
         line = rec.next_line(0, self.spawn_timeout_s)
         if line is None:
             proc.kill()
             raise RuntimeError(
-                f"PS shard {index} did not announce within "
+                f"PS shard {index} {role} did not announce within "
                 f"{self.spawn_timeout_s:.0f}s"
             )
         hello = json.loads(line)
         rec.port = int(hello["port"])
-        self.sup.register(f"ps-shard-{index}", [index], pid=proc.pid,
-                          host=socket.gethostname())
+        if standby:
+            self._standby_ok[index] = time.monotonic()
         return hello
 
     def start(self) -> "ShardGroup":
         try:
             for i in self.indices:
                 self._spawn(i, 0)
+            if self.standbys:
+                # warm standbys, one per managed shard: spawned AFTER
+                # the primaries (a standby is useless without a stream
+                # source) and announced to them via SETMAP below.  A
+                # failed standby spawn degrades that shard to the
+                # classic restart recovery -- never fails the group.
+                for i in self.indices:
+                    self._standby_procs[i] = _ShardProc(i)
+                    try:
+                        self._spawn(i, 0, role="standby")
+                    except (RuntimeError, OSError):
+                        _bump("standby_spawn_failures")
+                        del self._standby_procs[i]
             if self.shards > 1:
                 entries = []
                 for i, (lo, hi) in enumerate(self._ranges):
@@ -901,11 +1179,20 @@ class ShardGroup:
                 # in-process primary with shard_map= directly)
                 for i in self.indices:
                     self._setmap(i)
+            elif self.standbys and self._standby_procs:
+                # shards=1 control arm: no map, but the single child
+                # still learns its standby endpoint (read replica +
+                # replicated state; failover for the unmapped single PS
+                # stays restart-from-checkpoint -- there is no map to
+                # re-announce a moved endpoint through)
+                for i in self.indices:
+                    self._setmap(i)
         except Exception:
             # a later spawn, map assembly, or SETMAP failed: the children
             # already up must not be leaked (the caller's `group` variable
             # was never assigned, so its cleanup path cannot reach them)
-            for rec in self._procs.values():
+            for rec in list(self._procs.values()) + list(
+                    self._standby_procs.values()):
                 if rec.proc is not None and rec.proc.poll() is None:
                     rec.proc.kill()
             raise
@@ -923,13 +1210,64 @@ class ShardGroup:
         _set_active_group(self)
         return self
 
+    def standbys_wire(self) -> Optional[List]:
+        """Per-shard standby endpoints in range order (``[host, port]``
+        or None per entry; None overall when the standby plane is off).
+        What SETMAP installs and SHARDMAP advertises."""
+        if not self.standbys:
+            return None
+        out: List = []
+        for i in range(self.shards):
+            rec = self._standby_procs.get(i)
+            alive = (rec is not None and rec.port is not None
+                     and rec.proc is not None and rec.proc.poll() is None)
+            out.append([self.host, rec.port] if alive else None)
+        return out
+
     def _setmap(self, index: int) -> None:
         hdr = {"op": "SETMAP", "index": index,
-               "shards": self.smap.to_wire()}
+               "shards": (self.smap.to_wire()
+                          if self.smap is not None else [])}
         epochs = self.epochs_wire()
         if epochs:
             hdr["epochs"] = epochs
+        sbs = self.standbys_wire()
+        if sbs is not None:
+            hdr["standbys"] = sbs
         _oneshot(self.host, self._procs[index].port, hdr, timeout_s=10.0)
+
+    def _announce_group(self, timeout_s: float = 3.0) -> None:
+        """Best-effort SETMAP of the CURRENT map + epoch vector +
+        standby endpoints to every reachable member (unmanaged fixed
+        entries included -- the cluster CLI's in-process primary serves
+        every worker HELLO, so it above all must hand out current
+        state).  This is where a promotion or a standby respawn
+        actually reaches the wire; a still-partitioned member self-
+        heals later via fencing.  The per-target timeout is kept SHORT:
+        this runs on the monitor thread, and a partitioned member must
+        cost seconds, not stall the next death scan for 10s a target."""
+        epochs = self.epochs_wire()
+        sbs = self.standbys_wire()
+        if self.smap is not None:
+            targets = [(j, h, p)
+                       for j, (h, p, _lo, _hi)
+                       in enumerate(self.smap.entries)]
+        else:
+            targets = [(i, self.host, rec.port)
+                       for i, rec in self._procs.items()
+                       if rec.port is not None]
+        for j, h, p in targets:
+            hdr = {"op": "SETMAP", "index": j,
+                   "shards": (self.smap.to_wire()
+                              if self.smap is not None else [])}
+            if epochs:
+                hdr["epochs"] = epochs
+            if sbs is not None:
+                hdr["standbys"] = sbs
+            try:
+                _oneshot(h, p, hdr, timeout_s=timeout_s)
+            except (ConnectionError, OSError):
+                pass
 
     def _telemetry_source(self) -> Dict[str, float]:
         member = self.sup.membership()
@@ -940,6 +1278,10 @@ class ShardGroup:
             if member.get(i, {}).get("state") == supervisor_mod.SUSPECT
         )
         totals = shard_totals()
+        live_standbys = sum(
+            1 for rec in self._standby_procs.values()
+            if rec.proc is not None and rec.proc.poll() is None
+        )
         return {
             "total": float(self.shards),
             "managed": float(len(self._procs)),
@@ -949,6 +1291,8 @@ class ShardGroup:
             "restarts": float(totals.get("shards_restarted", 0)),
             "fence_epoch_bumps": float(
                 totals.get("fence_epoch_bumps", 0)),
+            "standbys": float(live_standbys),
+            "promotions": float(self.promotions),
             "done": float(self._finished.is_set()),
         }
 
@@ -996,6 +1340,8 @@ class ShardGroup:
                     and member.get(i, {}).get("state")
                     == supervisor_mod.DEAD):
                 self._restart(i)
+        if self.standbys:
+            self._check_standbys()
         return newly_dead
 
     def _run(self) -> None:
@@ -1007,16 +1353,168 @@ class ShardGroup:
             except Exception:  # noqa: BLE001 - the monitor must outlive
                 pass           # any one bad scan (spawn failure, junk IO)
 
+    def _check_standbys(self) -> None:
+        """Standby liveness, OUTSIDE the fencing supervisor: a standby
+        owns no range, so its death mints no epoch -- it is simply
+        respawned, and its primary's stream re-bootstraps it with a
+        fresh REPL_SYNC on reconnect.  Runs on the monitor thread, so
+        its network work is bounded: probes are PACED (a dark standby's
+        1 s timeout must not recur every 0.25 s scan and delay the next
+        PRIMARY death scan -- the gap this module exists to bound)."""
+        now = time.monotonic()
+        if self._stop.is_set() or self._finished.is_set():
+            return
+        dead_after_s = self.sup.dead_after_ms / 1e3
+        probe_gap_s = max(0.5, self._check_interval_s)
+        for i in self.indices:
+            rec = self._standby_procs.get(i)
+            if rec is None:
+                # a promotion (or an earlier failed spawn) left this
+                # shard un-backed: recreate the slot and try again
+                self._standby_procs[i] = rec = _ShardProc(i)
+            proc = rec.proc
+            if proc is not None and proc.poll() is None:
+                if now - self._standby_probe_t.get(i, 0.0) < probe_gap_s:
+                    continue  # paced: this scan skips the probe
+                self._standby_probe_t[i] = now
+                orphaned = False
+                try:
+                    hdr = _oneshot(self.host, rec.port,
+                                   {"op": "SHARDMAP"}, timeout_s=1.0)
+                    # a registered standby that no longer ANSWERS as one
+                    # is a self-promoted orphan (a PROMOTE was delivered
+                    # but its reply timed out, so the controller fell
+                    # back to a relaunch): it would wedge the acting
+                    # primary's stream with 'not a standby' forever --
+                    # reap and respawn a real standby behind it
+                    if hdr.get("standby"):
+                        self._standby_ok[i] = now
+                        continue
+                    orphaned = True
+                    _bump("standby_orphans_reaped")
+                except (ConnectionError, OSError):
+                    pass
+                if (not orphaned
+                        and now - self._standby_ok.get(i, now)
+                        <= dead_after_s):
+                    continue  # one dark probe is not death
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+                except OSError:  # pragma: no cover
+                    pass
+            if proc is not None:
+                _bump("standby_deaths")
+            try:
+                self._spawn(i, 0, role="standby")
+            except (RuntimeError, OSError):
+                _bump("standby_spawn_failures")
+                continue
+            _bump("standbys_respawned")
+            # the shard's primary must re-target its stream, and every
+            # SHARDMAP reply must advertise the new endpoint
+            self._announce_group()
+
+    def promotions_of(self, index: int) -> int:
+        return self._promotions.get(index, 0)
+
+    def _promote(self, index: int) -> bool:
+        """Hot-standby promotion: the shard's warm standby becomes the
+        range primary under the slot's freshly-minted fencing epoch --
+        no process spawn, no checkpoint replay on the recovery path;
+        the availability gap is the suspicion time plus one RPC.
+        Returns False when the promotion path is unavailable (standby
+        plane off, fencing off, no map to re-announce the moved
+        endpoint through, standby dead) -- the caller falls back to
+        restart-from-checkpoint."""
+        sb = self._standby_procs.get(index)
+        if (not self.standbys or not self.fence or self.smap is None
+                or sb is None or sb.proc is None
+                or sb.proc.poll() is not None or sb.port is None):
+            return False
+        new_epoch = self.epoch_of(index)  # the death already minted it
+        entries = [list(e) for e in self.smap.entries]
+        entries[index] = [self.host, sb.port,
+                          entries[index][2], entries[index][3]]
+        new_map = ShardMap(entries)
+        epochs = self.epochs_wire()
+        try:
+            rep = _oneshot(self.host, sb.port,
+                           {"op": "PROMOTE", "epoch": new_epoch,
+                            "index": index, "shards": new_map.to_wire(),
+                            "epochs": epochs}, timeout_s=10.0)
+        except (ConnectionError, OSError):
+            _bump("promotion_failures")
+            return False
+        if rep.get("op") != "ACK":
+            # refused (a stale order against a fresh mirror): fall back
+            # to the relaunch path rather than install a map pointing
+            # at a member that never flipped
+            _bump("promotion_failures")
+            return False
+        old = self._procs[index]
+        if old.proc is not None and old.proc.poll() is None:
+            # a PARTITIONED-but-alive primary is deliberately NOT
+            # killed here: promotion needs nothing it holds (the
+            # standby serves on its own port), and cross-host the
+            # controller could not reach it anyway -- the minted epoch
+            # deposes it the moment its stream append (or any stamped
+            # op) bounces REJECT_FENCED at the promoted member.  It is
+            # only retained for teardown reaping.
+            self._deposed.append(old.proc)
+        self._gray.forget(f"{self.host}:{old.port}")
+        promoted = sb
+        del self._standby_procs[index]
+        self._standby_ok.pop(index, None)
+        promoted.restarts = old.restarts
+        self._procs[index] = promoted
+        self.smap = new_map
+        self.promotions += 1
+        self._promotions[index] = self._promotions.get(index, 0) + 1
+        _bump("standby_promotions")
+        # the minted epoch reaches the wire through the announce below
+        # -- the same accounting point as the fenced relaunch path
+        _bump("fence_epoch_bumps")
+        supervisor_mod.bump_total("epoch_bumps")
+        self.sup.register(f"ps-shard-{index}", [index],
+                          pid=promoted.proc.pid,
+                          host=socket.gethostname())
+        # a fresh standby behind the new primary (best-effort: a failed
+        # spawn leaves the shard un-backed until the next scan retries)
+        self._standby_procs[index] = _ShardProc(index)
+        try:
+            self._spawn(index, 0, role="standby")
+        except (RuntimeError, OSError):
+            _bump("standby_spawn_failures")
+            del self._standby_procs[index]
+        # group-wide announce: every member re-learns map + epochs +
+        # standbys; workers/replicas re-resolve on their next fault
+        self._announce_group()
+        return True
+
     def _restart(self, index: int) -> None:
-        """Re-home a dead shard: kill the corpse if the pid is somehow
-        still holding the port (wedged, not exited), then relaunch on the
-        SAME port from the durable checkpoint.  Live shards never stop
-        serving their ranges meanwhile."""
+        """Re-home a dead shard: PROMOTE its warm standby when the
+        replication plane is on (failover without a restart), else kill
+        the corpse if the pid is somehow still holding the port
+        (wedged, not exited) and relaunch on the SAME port from the
+        durable checkpoint.  Live shards never stop serving their
+        ranges meanwhile."""
         with self._restart_lock:
             if self._stop.is_set() or self._finished.is_set():
                 return
             rec = self._procs[index]
             proc = rec.proc
+            # double-spawn guard (the other half of _spawn's early
+            # registration): a concurrent scan that queued behind this
+            # lock while a relaunch was in flight must NOT kill the
+            # fresh child and spawn a second one -- if the slot is no
+            # longer DEAD (the relaunch registered its pid the moment
+            # it was Popen'd) and its process is alive, there is
+            # nothing left to recover.
+            state = self.sup.membership().get(index, {}).get("state")
+            if (state != supervisor_mod.DEAD
+                    and proc is not None and proc.poll() is None):
+                return
             if proc is not None and proc.poll() == 0:
                 # graceful conclusion (DONE/FINISH reached, result printed,
                 # exit 0), not a crash: nothing to recover -- restarting
@@ -1024,6 +1522,11 @@ class ShardGroup:
                 return
             if rec.restarts >= self.max_restarts:
                 return  # gave up on this range; counted at each failure
+            if self._promote(index):
+                # failover WITHOUT a restart: the standby took the
+                # range under the minted epoch -- no spawn, no
+                # checkpoint replay, availability gap = suspicion time
+                return
             if not self._ckpt_path(index):
                 # no durable state: the relaunch serves a FRESH (zero)
                 # model for this range mid-run.  Still better than a dark
@@ -1070,15 +1573,7 @@ class ShardGroup:
                 # is also where recovery.epoch_bumps counts.
                 _bump("fence_epoch_bumps")
                 supervisor_mod.bump_total("epoch_bumps")
-                epochs = self.epochs_wire()
-                for j, (h, p, _lo, _hi) in enumerate(self.smap.entries):
-                    try:
-                        _oneshot(h, p,
-                                 {"op": "SETMAP", "index": j,
-                                  "shards": self.smap.to_wire(),
-                                  "epochs": epochs}, timeout_s=10.0)
-                    except (ConnectionError, OSError):
-                        pass
+                self._announce_group()
 
     # ------------------------------------------------------------ plumbing
     def port_of(self, index: int) -> int:
@@ -1121,6 +1616,11 @@ class ShardGroup:
             targets = [(self.host, rec.port)
                        for rec in self._procs.values()
                        if rec.port is not None]
+        # standbys learn DONE too (their mirrored k may sit just short
+        # of the finish when the stream lags the final merges)
+        targets += [(self.host, rec.port)
+                    for rec in self._standby_procs.values()
+                    if rec.port is not None]
         for (h, p) in targets:
             try:
                 finish_endpoint(h, p)
@@ -1139,6 +1639,9 @@ class ShardGroup:
             "done": self._finished.is_set(),
             "members": {str(i): st for i, st in self.status().items()},
         }
+        if self.standbys:
+            out["standbys"] = self.standbys_wire()
+            out["promotions"] = self.promotions
         if self.fence:
             out["epochs"] = self.epochs_wire()
         gray = self._gray.snapshot()
@@ -1157,17 +1660,16 @@ class ShardGroup:
             from asyncframework_tpu.metrics import timeseries as _ts
 
             _ts.unregister_source("ps_shards", self._ts_source)
-        for rec in self._procs.values():
-            proc = rec.proc
-            if proc is None:
-                continue
+        procs = [rec.proc for rec in
+                 list(self._procs.values())
+                 + list(self._standby_procs.values())
+                 if rec.proc is not None]
+        procs += self._deposed
+        for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
         deadline = time.monotonic() + timeout_s
-        for rec in self._procs.values():
-            proc = rec.proc
-            if proc is None:
-                continue
+        for proc in procs:
             left = max(0.1, deadline - time.monotonic())
             try:
                 proc.wait(timeout=left)
@@ -1285,8 +1787,16 @@ def _child_main() -> int:
     shard_cfg = cfg if index == 0 else secondary_cfg(cfg)
     map_env = os.environ.get("ASYNC_SHARD_MAP") or ""
     smap_wire = json.loads(map_env) if map_env else None
+    # hot-standby role (ISSUE 13): a standby child runs the SAME cfg as
+    # the shard it shadows (post-promotion behavior must match), applies
+    # its primary's replication stream instead of worker pushes, and
+    # never runs the worker supervisor (after a promotion, membership
+    # rebuilds from live traffic via implicit registration).
+    role = os.environ.get("ASYNC_SHARD_ROLE", "primary")
+    standby = role == "standby"
     sup = None
-    if index == 0 and os.environ.get("ASYNC_SHARD_ELASTIC") == "1":
+    if (index == 0 and not standby
+            and os.environ.get("ASYNC_SHARD_ELASTIC") == "1"):
         from asyncframework_tpu.parallel.supervisor import ElasticSupervisor
 
         sup = ElasticSupervisor.from_conf(cfg.num_workers)
@@ -1295,8 +1805,9 @@ def _child_main() -> int:
     # collapse into one another in an aggregator
     from asyncframework_tpu.metrics.live import start_telemetry_from_conf
 
-    start_telemetry_from_conf(f"ps-shard-{index}",
-                              labels={"shard": str(index)})
+    start_telemetry_from_conf(
+        f"ps-{'standby' if standby else 'shard'}-{index}",
+        labels={"shard": str(index)})
     # fencing epoch: the controller passes the minted epoch (base 1 +
     # its lease-expiry fences for this slot); 0/absent defers to conf
     # (async.fence.enabled -> 1, off -> 0).  The PS restore additionally
@@ -1313,23 +1824,36 @@ def _child_main() -> int:
         supervisor=sup,
         shard_map=smap_wire, shard_index=index,
         epoch=epoch_env or None, shard_epochs=shard_epochs or None,
+        standby=standby,
     ).start()
-    print(json.dumps({"port": ps.port, "shard": index,
+    sbs_env = os.environ.get("ASYNC_SHARD_STANDBYS") or ""
+    if sbs_env and not standby:
+        # launcher-known standby endpoints (the k8s path, where SETMAP
+        # has no controller to send it): installs the map and starts
+        # this primary's replication stream
+        ps.set_standby_map(json.loads(sbs_env))
+    print(json.dumps({"port": ps.port, "shard": index, "role": role,
                       "resumed_from": ps.resumed_from_k}), flush=True)
-    print(f"shard {index} serving on {ps.port}", file=sys.stderr, flush=True)
+    print(f"shard {index} ({role}) serving on {ps.port}",
+          file=sys.stderr, flush=True)
     ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
     result = {
-        "role": "ps-shard", "shard": index, "done": bool(ok),
+        "role": "ps-standby" if standby and not ps.promoted
+        else "ps-shard", "shard": index, "done": bool(ok),
         "accepted": ps.accepted, "dropped": ps.dropped,
         "clock": ps._clock, "max_staleness": ps.max_staleness,
         "dedup_hits": ps.dedup_hits,
         "resumed_from": ps.resumed_from_k,
+        "promoted": bool(ps.promoted),
         "epoch": ps.epoch,
         "fenced_rejects": ps.fenced_rejects,
         "accepted_by_wid": {str(w): c
                             for w, c in ps.accepted_by_wid.items()},
     }
-    if index == 0:
+    if index == 0 and (not standby or ps.promoted):
+        # the primary's end-of-run eval plane -- a never-promoted
+        # standby must not sit a collect_eval timeout for EVAL_RESULTs
+        # that only ever go to the real primary
         nproc = int(os.environ.get("ASYNC_SHARD_WORKER_PROCS", "0"))
         traj = None
         if nproc > 0:

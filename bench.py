@@ -686,7 +686,148 @@ def run_dcn_child() -> None:
             out["shards"][f"shard_speedup_{mode}"] = (
                 round(three / one, 3) if one and three else None
             )
+    # failover arm (ISSUE 13): p99 pull latency through a seeded
+    # primary SIGKILL, checkpoint-restart vs hot-standby promotion --
+    # the number ROADMAP item 5's acceptance is judged by.  Per-arm
+    # never-dark: an arm that wedges or errors records its error
+    # string, not a hole.  BENCH_DCN_FAILOVER=0 drops the arm.
+    if os.environ.get("BENCH_DCN_FAILOVER", "1") != "0":
+        out["failover"] = {}
+        for label, sb in (("restart", 0), ("promote", 1)):
+            try:
+                out["failover"][label] = _dcn_failover_arm(sb)
+            except Exception as e:  # noqa: BLE001 - never-dark per arm
+                out["failover"][label] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+        r = out["failover"].get("restart", {}).get("gap_s")
+        p = out["failover"].get("promote", {}).get("gap_s")
+        out["failover"]["gap_ratio_restart_over_promote"] = (
+            round(r / p, 2) if r and p else None
+        )
     emit({"dcn": out})
+
+
+def _dcn_failover_arm(standbys: int) -> dict:
+    """One failover measurement: a 2-shard REAL-process group (fence
+    on; ``standbys`` warm standbys per shard) with in-process workers
+    training through it, SIGKILL of shard 1's primary mid-run, and a
+    20 ms-cadence read probe against the range's CURRENT endpoint.
+    Records the availability gap (kill -> first answer from the
+    recovered endpoint), p99 probe latency across the window, and HOW
+    the range recovered (promotion vs restart-from-checkpoint)."""
+    import signal as _signal
+    import tempfile
+    import threading
+
+    import numpy as np  # noqa: F811 - child-scope import, bench style
+    import jax
+
+    from asyncframework_tpu.conf import AsyncConf, set_global_conf
+    from asyncframework_tpu.data.sharded import ShardedDataset
+    from asyncframework_tpu.parallel import ps_dcn
+    from asyncframework_tpu.parallel import shardgroup as sgm
+    from asyncframework_tpu.solvers import SolverConfig
+
+    n, d, nw = 2048, 64, 4
+    kill_after = int(os.environ.get("BENCH_FAILOVER_KILL_AFTER", "60"))
+    cfg = SolverConfig(
+        num_workers=nw, num_iterations=10**6, gamma=0.5, taw=2**31 - 1,
+        batch_rate=0.2, bucket_ratio=0.5, printer_freq=50, coeff=0.0,
+        seed=42, calibration_iters=20, run_timeout_s=120.0,
+    )
+    conf = AsyncConf({"async.fence.enabled": True,
+                      "async.ps.standby": standbys})
+    set_global_conf(conf)
+    tmp = tempfile.mkdtemp(prefix="bench-failover-")
+    group = sgm.ShardGroup(
+        cfg, d, n, 2, checkpoint_dir=tmp, conf_overlays=conf.to_dict(),
+        dead_after_s=1.0, check_interval_s=0.2, stderr_dir=tmp,
+    ).start()
+    ds = ShardedDataset.generate_on_device(
+        n, d, nw, devices=jax.devices(), seed=7, noise=0.01,
+    )
+    shards = {w: ds.shard(w) for w in range(nw)}
+
+    def train():
+        try:
+            ps_dcn.run_worker_process(
+                "127.0.0.1", group.port_of(0), list(range(nw)), shards,
+                cfg, d, n, deadline_s=90.0,
+            )
+        except Exception:  # noqa: BLE001 - the probe owns the verdict
+            pass
+
+    worker = threading.Thread(target=train, name="bench-failover-worker",
+                              daemon=True)
+    worker.start()
+    try:
+        # wait for shard 1 to merge past the kill threshold (its
+        # cadence checkpoint must exist so the restart arm actually
+        # replays one)
+        deadline = time.monotonic() + 45.0
+        while time.monotonic() < deadline:
+            try:
+                hdr = sgm._oneshot("127.0.0.1", group.port_of(1),
+                                   {"op": "SUBSCRIBE"}, timeout_s=1.0)
+                if int(hdr.get("clock", 0)) >= kill_after:
+                    break
+            except (ConnectionError, OSError):
+                pass
+            time.sleep(0.02)
+        else:
+            return {"error": "shard 1 never reached the kill threshold"}
+        lat_ms = []
+
+        def probe_until(deadline_s, stop_when=None):
+            """20 ms-cadence reads of range 1 at its CURRENT endpoint;
+            successful round trips land in lat_ms.  Returns the
+            monotonic time stop_when first held, else None."""
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                t0 = time.monotonic()
+                try:
+                    sgm._oneshot("127.0.0.1", group.port_of(1),
+                                 {"op": "SUBSCRIBE"}, timeout_s=1.0)
+                    lat_ms.append((time.monotonic() - t0) * 1e3)
+                    if stop_when is not None and stop_when():
+                        return time.monotonic()
+                except (ConnectionError, OSError):
+                    pass
+                time.sleep(0.02)
+            return None
+
+        probe_until(2.0)  # healthy baseline window
+        os.kill(group.pid_of(1), _signal.SIGKILL)
+        t_kill = time.monotonic()
+        recovered_at = probe_until(
+            60.0,
+            stop_when=lambda: (group.promotions_of(1) >= 1
+                               or group.restarts_of(1) >= 1),
+        )
+        gap_s = (recovered_at - t_kill) if recovered_at is not None \
+            else None
+        probe_until(2.0)  # recovered window: post-failover latency
+        group.finish()
+        worker.join(timeout=30.0)
+        result1 = group.result_of(1, timeout_s=15.0) or {}
+        return {
+            "ok": gap_s is not None,
+            "standbys": standbys,
+            "gap_s": round(gap_s, 3) if gap_s is not None else None,
+            "pull_p99_ms": (round(float(np.percentile(lat_ms, 99)), 3)
+                            if lat_ms else None),
+            "pull_p50_ms": (round(float(np.percentile(lat_ms, 50)), 3)
+                            if lat_ms else None),
+            "probes": len(lat_ms),
+            "recovered_by": ("promotion" if group.promotions_of(1)
+                             else "restart" if group.restarts_of(1)
+                             else None),
+            "resumed_from": result1.get("resumed_from"),
+            "promoted": result1.get("promoted"),
+        }
+    finally:
+        group.stop()
 
 
 def run_dcn_mesh_child() -> None:

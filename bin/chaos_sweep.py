@@ -167,13 +167,19 @@ def run_seed(seed: int, args) -> dict:
     # relay node mid-distribution -- children re-home to the root within
     # the suspicion window, CRC + fence assert no torn/stale-epoch model
     # ever serves (tests/test_relaycast.py, seeded kill timing)
+    # hot-standby replication chaos rides every seed: SIGKILL (seeded
+    # timing) and PARTITION of a shard primary with a warm standby --
+    # promotion instead of restart, zombie stream appends REJECT_FENCED,
+    # exactly-once across the failover (tests/test_replication.py)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
         "tests/test_telemetry.py", "tests/test_shardgroup.py",
         "tests/test_fencing.py", "tests/test_relaycast.py",
+        "tests/test_replication.py",
         "-q", "-m",
-        f"({marker}) or serve or telemetry or shard or fence or relay",
+        f"({marker}) or serve or telemetry or shard or fence or relay"
+        f" or repl",
         "-p", "no:cacheprovider",
     ]
     if args.soak:

@@ -227,12 +227,13 @@ class TestProtocolRule:
 
     def test_deleting_fence_admission_fails_lint(self, tmp_path):
         src = real_ps_src()
-        # remove the PULL branch's fencing admission call
+        # remove the PULL branch's fencing admission call (it follows
+        # the standby guard inside the same branch)
         mutated = src.replace(
-            "if op in (\"PULL\", \"PULL_SAGA\"):\n"
             "                    if self._fence_reject(conn, header):\n"
-            "                        continue\n",
-            "if op in (\"PULL\", \"PULL_SAGA\"):\n", 1)
+            "                        continue\n"
+            "                    self._handle_pull(conn, header)\n",
+            "                    self._handle_pull(conn, header)\n", 1)
         assert mutated != src
         f = protocol_findings_for(tmp_path, mutated)
         assert set(rule_tokens(f, "proto-fence-gate")) >= {
@@ -289,6 +290,51 @@ class TestProtocolRule:
         })
         toks = rule_tokens(rules_protocol.check(ctx), "proto-fence-gate")
         assert toks == ["ep-stamp"]
+
+    def test_deleting_standby_fence_admission_fails_lint(self, tmp_path):
+        """ISSUE 13 acceptance mutation: the standby's REPL_APPEND/
+        REPL_SYNC dispatch must run fencing admission -- it is THE
+        promotion-safety gate (a deposed primary's post-promotion
+        stream appends bounce REJECT_FENCED).  Deleting the admission
+        call is a lint failure, not a chaos lottery."""
+        src = real_ps_src()
+        mutated = src.replace(
+            "                    if self._fence_reject(conn, header):\n"
+            "                        continue\n"
+            "                    if not self._standby:\n",
+            "                    if not self._standby:\n", 1)
+        assert mutated != src
+        f = protocol_findings_for(tmp_path, mutated)
+        assert set(rule_tokens(f, "proto-fence-gate")) >= {
+            "REPL_APPEND", "REPL_SYNC"}
+        # the unmutated real file is clean
+        assert rule_tokens(
+            protocol_findings_for(tmp_path / "clean", src),
+            "proto-fence-gate") == []
+
+    def test_deleting_repl_stream_ep_stamp_fails_lint(self, tmp_path):
+        """And the client half: ReplicationStream._stamped is the
+        replication plane's ep-stamp choke point, pinned like
+        PSClient._proc_hdr -- without it a deposed primary's appends
+        would arrive unstamped and a standby could apply them."""
+        with open(os.path.join(
+                REPO, "asyncframework_tpu/parallel/replication.py")) as f:
+            src = f.read()
+        i = src.index("def _stamped")
+        j = src.index('hdr["ep"] = self.ps.epoch', i)
+        mutated = (src[:j] + "pass"
+                   + src[j + len('hdr["ep"] = self.ps.epoch'):])
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/parallel/replication.py": mutated,
+        })
+        toks = rule_tokens(rules_protocol.check(ctx), "proto-fence-gate")
+        assert toks == ["ep-stamp"]
+        # the unmutated real file is clean
+        ctx = ctx_of(tmp_path / "clean", {
+            "asyncframework_tpu/parallel/replication.py": src,
+        })
+        assert rule_tokens(rules_protocol.check(ctx),
+                           "proto-fence-gate") == []
 
     def test_clean_tree_is_silent_for_protocol(self):
         result = run_lint(REPO, rules=["protocol"])

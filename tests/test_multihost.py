@@ -22,6 +22,22 @@ import pytest
 CHILD = Path(__file__).parent / "dcn_child.py"
 
 
+def _require_cpu_spmd() -> None:
+    """Probed-capability gate (ISSUE 13 tier-1 deflake): cross-process
+    SPMD on the CPU backend is a jax-build capability, not a property of
+    this repo's code -- jax 0.4.37 without gloo-capable CPU collectives
+    raises "Multiprocess computations aren't implemented on the CPU
+    backend".  The session-cached 2-process probe (tests/test_deploy.py,
+    ISSUE 12) runs the repo's own bring-up once; on incapable rigs these
+    suites SKIP with the probed reason instead of failing as a
+    permanent baseline."""
+    from test_deploy import cpu_spmd_capability
+
+    reason = cpu_spmd_capability()
+    if reason:
+        pytest.skip(reason)
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -71,6 +87,7 @@ def _check_bringup(results, n: int):
 
 
 def test_two_process_bringup_barrier_and_psum():
+    _require_cpu_spmd()
     _check_bringup(_spawn_group(CHILD, 2, timeout=150), 2)
 
 
@@ -80,6 +97,7 @@ def test_four_process_bringup_barrier_and_psum():
     coordinated processes (8 global devices) join, fence, and psum across
     every process boundary (the reference's story is an 8-worker cluster,
     README.md:56)."""
+    _require_cpu_spmd()
     _check_bringup(_spawn_group(CHILD, 4), 4)
 
 
@@ -117,6 +135,7 @@ def test_two_process_distributed_training_matches_local():
     """The cluster story end to end: the SAME MiniBatchSGD code trains over
     a 2-process global mesh (DCN) and produces the same model as one
     process with an equal-size mesh."""
+    _require_cpu_spmd()
     results = _spawn_group(
         Path(__file__).parent / "dcn_train_child.py", 2, timeout=150
     )
@@ -128,6 +147,7 @@ def test_four_process_distributed_training_matches_local():
     """VERDICT r4 #7, training half: one step short of the reference's
     8-worker recipe -- 4 processes x 2 devices train over DCN and agree
     with the single-process 8-device mesh."""
+    _require_cpu_spmd()
     results = _spawn_group(
         Path(__file__).parent / "dcn_train_child.py", 4
     )
@@ -140,6 +160,7 @@ class TestLocalClusterLauncher:
         the same recipe output as a single-process run of the same CLI."""
         import json
 
+        _require_cpu_spmd()
         from asyncframework_tpu.cluster import launch_local_cluster
 
         recipe = ["--quiet", "sgd-mllib", "synthetic", "synthetic",

@@ -1,0 +1,776 @@
+"""Hot-standby shard replication (ISSUE 13).
+
+The correctness spine:
+
+- a standby bootstrapped by REPL_SYNC and fed REPL_APPENDs is the
+  primary's state, exactly: model bytes, merge clock, accept/drop
+  ledgers, snapshot cadence, AND the dedup window -- so a promoted
+  standby answers replayed worker pushes from the REPLICATED window
+  (exactly-once across the failover), never by re-applying;
+- the stream's idempotence is the clock compare: duplicate appends
+  re-ACK, gaps refuse with resync (re-bootstrap), nothing applies twice
+  or out of order;
+- promotion is epoch-fenced: the deposed primary's post-promotion
+  stream appends are REJECT_FENCED, the bounce folds back into its
+  worker-facing admission (note_fenced_above), its clients heal onto
+  the minted epoch and RE-RESOLVE the moved endpoint from any live
+  member;
+- the acceptance runs (`repl` marker, ride every bin/chaos_sweep.py
+  seed): a real 3-shard group with warm standbys survives SIGKILL of a
+  primary mid-run by PROMOTION (restarts stay zero, no checkpoint
+  replay on the recovery path, availability gap bounded by suspicion
+  time), and a PARTITIONED (not killed) primary's healed zombie has its
+  stream appends counted REJECT_FENCED while accept accounting proves
+  exactly-once.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu import conf as conf_mod
+from asyncframework_tpu.conf import AsyncConf, set_global_conf
+from asyncframework_tpu.net import faults, reset_net_totals
+from asyncframework_tpu.net.retry import reset_breakers
+from asyncframework_tpu.parallel import ps_dcn
+from asyncframework_tpu.parallel import replication as repl_mod
+from asyncframework_tpu.parallel import shardgroup as sg
+from asyncframework_tpu.solvers import SolverConfig
+
+pytestmark = pytest.mark.repl
+
+CHILD = Path(__file__).parent / "ps_dcn_child.py"
+CHAOS_SEED = int(os.environ.get("ASYNC_CHAOS_SEED", "7"))
+
+
+def make_cfg(**kw):
+    defaults = dict(
+        num_workers=2, num_iterations=10**6, gamma=1.0, taw=2**31 - 1,
+        batch_rate=0.3, bucket_ratio=0.0, printer_freq=10, seed=42,
+        calibration_iters=10**9, run_timeout_s=120.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    reset_net_totals()
+    sg.reset_shard_totals()
+    repl_mod.reset_repl_totals()
+    reset_breakers()
+    faults.clear()
+    set_global_conf(AsyncConf({"async.fence.enabled": True}))
+    yield
+    faults.clear()
+    reset_net_totals()
+    sg.reset_shard_totals()
+    repl_mod.reset_repl_totals()
+    reset_breakers()
+    set_global_conf(None)
+
+
+def _mirrored_pair(cfg=None, d=8, n=64):
+    """One primary + one attached standby, both in-process."""
+    cfg = cfg or make_cfg()
+    prim = ps_dcn.ParameterServer(cfg, d, n, port=0).start()
+    sb = ps_dcn.ParameterServer(cfg, d, n, port=0, standby=True).start()
+    prim.attach_standby("127.0.0.1", sb.port)
+    return prim, sb
+
+
+def _wait_caught_up(prim, sb, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if sb._clock >= prim._clock and prim.repl.synced:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"standby never caught up: {sb._clock} < {prim._clock}")
+
+
+# ------------------------------------------------------------ mirror units
+class TestMirror:
+    def test_sync_then_appends_mirror_state_exactly(self):
+        prim, sb = _mirrored_pair()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            rng = np.random.default_rng(3)
+            for _ in range(25):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, rng.normal(size=8).astype(np.float32))
+            _wait_caught_up(prim, sb)
+            assert (sb._clock, sb._k, sb.accepted, sb.dropped) == (
+                prim._clock, prim._k, prim.accepted, prim.dropped)
+            # the model is the SAME bytes (same kernel, same order)
+            np.testing.assert_array_equal(np.asarray(prim._w),
+                                          np.asarray(sb._w))
+            # snapshot cadence mirrored: the promoted trajectory would
+            # continue seamlessly
+            assert len(sb._snapshots) == len(prim._snapshots)
+            # the dedup window is REPLICATED: the client's session is in
+            # the standby's window with every applied seq
+            state = sb._dedup.state()["sessions"]
+            assert cl.session.sid in state
+            assert len(state[cl.session.sid]) == 25
+            # per-wid ledgers mirrored
+            assert sb.accepted_by_wid == prim.accepted_by_wid
+            totals = repl_mod.repl_totals()
+            assert totals.get("syncs_sent", 0) >= 1
+            assert totals.get("appends_applied", 0) >= 1
+            cl.bye()
+        finally:
+            prim.stop()
+            sb.stop()
+
+    def test_standby_refuses_training_plane_serves_reads(self):
+        prim, sb = _mirrored_pair()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            for _ in range(5):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(8, np.float32))
+            _wait_caught_up(prim, sb)
+            # PULL/PUSH against the standby surface as a dead endpoint
+            # (ConnectionError), so loops pace and facades re-resolve
+            probe = ps_dcn.PSClient("127.0.0.1", sb.port)
+            with pytest.raises(ConnectionError):
+                probe.pull(1)
+            with pytest.raises(ConnectionError):
+                probe.push(1, 0, np.zeros(8, np.float32))
+            # ...but SUBSCRIBE is served from the mirrored snapshot,
+            # byte-identical to the primary's at the same version
+            sub = ps_dcn.PSClient("127.0.0.1", sb.port,
+                                  pull_mode="delta")
+            got = sub.subscribe(0)
+            assert got is not None
+            ts_sb, w_sb, clock_sb, _k, age_ms, _done = got
+            direct = ps_dcn.PSClient("127.0.0.1", prim.port,
+                                     pull_mode="delta").subscribe(0)
+            assert ts_sb == direct[0] and clock_sb == direct[2]
+            np.testing.assert_array_equal(w_sb, direct[1])
+            assert age_ms >= 0.0
+            sub.bye()
+        finally:
+            prim.stop()
+            sb.stop()
+
+    def test_append_gap_refuses_resync_duplicate_reacks(self):
+        prim, sb = _mirrored_pair()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            for _ in range(3):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(8, np.float32))
+            _wait_caught_up(prim, sb)
+            ep = sb.epoch
+            # a GAP (pre ahead of the applied clock) refuses with resync
+            rep = sg._oneshot(
+                "127.0.0.1", sb.port,
+                {"op": "REPL_APPEND", "ep": ep,
+                 "pre": sb._clock + 5,
+                 "items": [[0, 0, 0, None, None, {}, 0]],
+                 "cal": [0, 0, 0.0]}, 5.0)
+            assert rep["op"] == "ERR" and rep.get("resync") is True
+            # a DUPLICATE (entirely at-or-below the clock) re-ACKs and
+            # applies nothing
+            k_before = sb._k
+            rep = sg._oneshot(
+                "127.0.0.1", sb.port,
+                {"op": "REPL_APPEND", "ep": ep,
+                 "pre": sb._clock - 1,
+                 "items": [[0, 0, 0, None, None, {}, 0]],
+                 "cal": [0, 0, 0.0]}, 5.0)
+            assert rep["op"] == "ACK" and rep.get("dup") is True
+            assert sb._k == k_before
+            assert repl_mod.repl_totals().get("resyncs_requested", 0) >= 1
+        finally:
+            prim.stop()
+            sb.stop()
+
+    def test_stream_rebootstraps_after_standby_blip(self):
+        """Cut the stream mid-run (drop every connection to the standby
+        via a fault schedule): the sender reconnects, re-SYNCs, and the
+        standby converges again -- flapping costs bandwidth, never
+        correctness."""
+        prim, sb = _mirrored_pair()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            for _ in range(5):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(8, np.float32))
+            _wait_caught_up(prim, sb)
+            sched = faults.FaultSchedule(seed=CHAOS_SEED)
+            sched.add_partition([f"*:{sb.port}"], duration_s=1.0)
+            faults.install(faults.FaultInjector(sched))
+            for _ in range(10):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(8, np.float32))
+            time.sleep(1.2)  # partition heals on schedule
+            faults.clear()
+            for _ in range(5):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(8, np.float32))
+            _wait_caught_up(prim, sb, timeout_s=15.0)
+            np.testing.assert_array_equal(np.asarray(prim._w),
+                                          np.asarray(sb._w))
+            assert (sb.accepted, sb.dropped) == (prim.accepted,
+                                                 prim.dropped)
+            cl.bye()
+        finally:
+            faults.clear()
+            prim.stop()
+            sb.stop()
+
+
+# ------------------------------------------------------- promotion units
+class TestPromotion:
+    def test_promote_fences_zombie_and_serves_training_plane(self):
+        prim, sb = _mirrored_pair()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            for _ in range(10):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(8, np.float32))
+            _wait_caught_up(prim, sb)
+            rep = sg._oneshot("127.0.0.1", sb.port,
+                              {"op": "PROMOTE", "epoch": 2}, 5.0)
+            assert rep["op"] == "ACK" and rep["epoch"] == 2
+            assert sb.promoted and not sb._standby
+            # idempotent: re-delivery (same or older epoch) re-ACKs
+            rep = sg._oneshot("127.0.0.1", sb.port,
+                              {"op": "PROMOTE", "epoch": 2}, 5.0)
+            assert rep["op"] == "ACK" and rep["epoch"] == 2
+            # THE promotion-safety admission: the deposed primary's
+            # stream appends carry epoch 1 and bounce REJECT_FENCED
+            rep = sg._oneshot(
+                "127.0.0.1", sb.port,
+                {"op": "REPL_APPEND", "ep": 1, "pre": sb._clock,
+                 "items": [], "cal": [0, 0, 0.0]}, 5.0)
+            assert rep["op"] == "REJECT_FENCED" and rep["epoch"] == 2
+            # the zombie's OWN stream hits the same wall, parks, and
+            # folds the foreign epoch into its worker-facing admission
+            ts, _w, _a, _c = cl.pull(0)
+            cl.push(0, ts, np.ones(8, np.float32))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not prim.repl.fenced:
+                time.sleep(0.05)
+            assert prim.repl.fenced
+            assert prim._fenced_above == 2
+            with pytest.raises(ps_dcn.FencedError):
+                cl.pull(0)
+            assert cl.epoch == 2  # healed onto the minted epoch
+            # the promoted standby serves the training plane now
+            c2 = ps_dcn.PSClient("127.0.0.1", sb.port, epoch=2)
+            ts2, _w2, _a2, _c2 = c2.pull(0)
+            acc, _dn = c2.push(0, ts2, np.ones(8, np.float32))
+            assert acc
+            assert repl_mod.repl_totals().get("promotions", 0) == 1
+            assert repl_mod.repl_totals().get("fenced_streams", 0) == 1
+            c2.bye()
+        finally:
+            prim.stop()
+            sb.stop()
+
+    def test_stale_promote_refused_on_fresh_standby(self):
+        """Review regression: a STALE PROMOTE (late operator retry /
+        re-delivery after the standby was respawned) must not flip a
+        fresh mirror -- it would orphan it from its primary's stream.
+        The refusal is an ERR, which the controller's _promote treats
+        as a failed promotion (fallback to relaunch)."""
+        prim, sb = _mirrored_pair()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            ts, _w, _a, _c = cl.pull(0)
+            cl.push(0, ts, np.ones(8, np.float32))
+            _wait_caught_up(prim, sb)
+            # standby runs at epoch 1 (the stream's epoch): a promote
+            # at epoch <= 1 is stale and refused
+            rep = sg._oneshot("127.0.0.1", sb.port,
+                              {"op": "PROMOTE", "epoch": 1}, 5.0)
+            assert rep["op"] == "ERR" and "stale" in rep["msg"]
+            assert sb._standby and not sb.promoted
+            # the stream is still healthy: a further push mirrors
+            ts, _w, _a, _c = cl.pull(0)
+            cl.push(0, ts, np.ones(8, np.float32))
+            _wait_caught_up(prim, sb)
+            cl.bye()
+        finally:
+            prim.stop()
+            sb.stop()
+
+    def test_exactly_once_replay_against_replicated_window(self):
+        """An applied-but-unACKed windowed push replayed against the
+        PROMOTED standby is answered from the REPLICATED dedup window --
+        the accepted count does not move, the verdict is the cached
+        one."""
+        prim, sb = _mirrored_pair()
+        try:
+            wcl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            ts, _w, _a, _c = wcl.pull(1)
+            wcl.push_start(1, ts, np.ones(8, np.float32))
+            # the primary applies + streams; the ACK stays unreaped
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and sb.accepted < 1:
+                time.sleep(0.02)
+            assert sb.accepted == prim.accepted == 1
+            sg._oneshot("127.0.0.1", sb.port,
+                        {"op": "PROMOTE", "epoch": 2}, 5.0)
+            prim.stop()
+            # transplant the unacked window onto a same-session client
+            # of the promoted standby (what ShardedPSClient._rebuild_
+            # client does) and reap: dedup wins over fencing
+            nc = ps_dcn.PSClient("127.0.0.1", sb.port,
+                                 session=wcl.session, epoch=2)
+            with wcl._win_lock:
+                entries = list(wcl._push_window)
+                wcl._push_window.clear()
+            nc._push_window.extend(entries)
+            nc._drop_sock()  # reconnect REPLAYS the window
+            acc, _done = nc.push_finish()
+            assert acc is True          # the CACHED verdict
+            assert sb.dedup_hits >= 1   # answered from the window
+            assert sb.accepted == 1     # never re-applied
+        finally:
+            prim.stop()
+            sb.stop()
+
+    def test_facade_re_resolves_promoted_endpoint(self):
+        """ShardedPSClient follows a promotion: primary 1 dies, every
+        surviving member learns the new map via SETMAP, and the facade's
+        next faulting round rebuilds the moved sub-client (same session)
+        and keeps training."""
+        cfg = make_cfg()
+        d, n = 24, 256
+        ps_list, smap = sg.launch_inprocess_group(cfg, d, n, 3)
+        ranges = smap.ranges()
+        lo1, hi1 = ranges[1]
+        sb = ps_dcn.ParameterServer(
+            sg.secondary_cfg(cfg), hi1 - lo1, n, port=0,
+            standby=True).start()
+        try:
+            ps_list[1].attach_standby("127.0.0.1", sb.port)
+            cl = sg.ShardedPSClient(smap, epochs=[1, 1, 1], proc="w")
+            for _ in range(10):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(d, np.float32))
+            deadline = time.monotonic() + 10.0
+            while (time.monotonic() < deadline
+                   and sb._clock < ps_list[1]._clock):
+                time.sleep(0.02)
+            # the controller's moves, by hand: promote, install the new
+            # map on the surviving members, kill the old primary
+            new_entries = [list(e) for e in smap.entries]
+            new_entries[1] = ["127.0.0.1", sb.port, lo1, hi1]
+            epochs = [1, 2, 1]
+            sg._oneshot("127.0.0.1", sb.port,
+                        {"op": "PROMOTE", "epoch": 2, "index": 1,
+                         "shards": new_entries, "epochs": epochs}, 5.0)
+            for ps in (ps_list[0], ps_list[2]):
+                sg._oneshot("127.0.0.1", ps.port,
+                            {"op": "SETMAP", "index": ps.shard_index,
+                             "shards": new_entries,
+                             "epochs": epochs}, 5.0)
+            ps_list[1].stop()
+            # an in-process stop leaves lingering per-connection
+            # handlers that answer DONE during teardown; a real dead
+            # shard's sockets just die -- simulate that
+            cl.clients[1]._drop_sock()
+            # the next faulting rounds re-resolve and keep training
+            ok_rounds = 0
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and ok_rounds < 5:
+                try:
+                    ts, _w, _a, _c = cl.pull(0)
+                    cl.push(0, ts, np.ones(d, np.float32))
+                    ok_rounds += 1
+                except (ConnectionError, OSError):
+                    time.sleep(0.1)
+            assert ok_rounds >= 5
+            assert cl.clients[1].port == sb.port
+            assert cl.clients[1].epoch == 2
+            assert sg.shard_totals().get("map_re_resolves", 0) >= 1
+            cl.bye()
+        finally:
+            for ps in ps_list:
+                ps.stop()
+            sb.stop()
+
+
+    def test_subscriber_follows_simultaneous_promotions(self):
+        """Review regression: TWO ranges promoted before the subscriber
+        notices.  _maybe_re_resolve must rebuild EVERY moved range in
+        one sweep, judged against each CLIENT's endpoint -- adopting
+        the new map while rebuilding only the range that triggered it
+        used to strand the other one dark forever."""
+        cfg = make_cfg()
+        d, n = 24, 256
+        ps_list, smap = sg.launch_inprocess_group(cfg, d, n, 3)
+        ranges = smap.ranges()
+        sbs = []
+        for i in (0, 1):
+            lo, hi = ranges[i]
+            shard_cfg = cfg if i == 0 else sg.secondary_cfg(cfg)
+            sb = ps_dcn.ParameterServer(shard_cfg, hi - lo, n, port=0,
+                                        standby=True).start()
+            ps_list[i].attach_standby("127.0.0.1", sb.port)
+            sbs.append(sb)
+        try:
+            cl = sg.ShardedPSClient(smap, epochs=[1, 1, 1], proc="w")
+            for _ in range(5):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(d, np.float32))
+            cl.bye()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and any(
+                    sbs[i]._clock < ps_list[i]._clock for i in (0, 1)):
+                time.sleep(0.02)
+            sub = sg.ShardedSubscriber(smap, epochs=[1, 1, 1])
+            assert sub.subscribe()[1].shape == (d,)
+            # promote BOTH standbys; shard 2 (the only survivor) learns
+            # the new map
+            new_entries = [list(e) for e in smap.entries]
+            for i in (0, 1):
+                lo, hi = ranges[i]
+                new_entries[i] = ["127.0.0.1", sbs[i].port, lo, hi]
+            epochs = [2, 2, 1]
+            for i in (0, 1):
+                sg._oneshot("127.0.0.1", sbs[i].port,
+                            {"op": "PROMOTE", "epoch": 2, "index": i,
+                             "shards": new_entries,
+                             "epochs": epochs}, 5.0)
+            sg._oneshot("127.0.0.1", ps_list[2].port,
+                        {"op": "SETMAP", "index": 2,
+                         "shards": new_entries, "epochs": epochs}, 5.0)
+            for i in (0, 1):
+                ps_list[i].stop()
+                sub.clients[i]._drop_sock()
+            # drive refresh rounds until both dark ranges re-home (the
+            # 3rd consecutive dark round triggers the sweep)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                try:
+                    sub.subscribe()
+                except (ConnectionError, OSError):
+                    pass
+                if (sub.clients[0].port == sbs[0].port
+                        and sub.clients[1].port == sbs[1].port):
+                    break
+                time.sleep(0.05)
+            assert sub.clients[0].port == sbs[0].port
+            assert sub.clients[1].port == sbs[1].port
+            # and the next round serves a fresh assembled model again
+            got = sub.subscribe()
+            assert got[1].shape == (d,)
+            assert sub.stale_ranges(10_000.0) == []
+            sub.bye()
+        finally:
+            for ps in ps_list:
+                ps.stop()
+            for sb in sbs:
+                sb.stop()
+
+
+# --------------------------------------------- conf / SLO / k8s surfaces
+class TestSurfaces:
+    def test_protocol_rows_declare_obligations(self):
+        from asyncframework_tpu.net import protocol
+
+        tbl = protocol.table()
+        assert tbl["REPL_APPEND"].mutating
+        assert not tbl["REPL_APPEND"].dedup_gated  # clock-compare idem.
+        assert tbl["REPL_APPEND"].fence_stamped
+        assert tbl["REPL_SYNC"].fence_stamped
+        assert tbl["PROMOTE"].mutating
+        assert not tbl["PROMOTE"].fence_stamped  # it RAISES the epoch
+
+    def test_default_rules_include_standby_lag(self):
+        from asyncframework_tpu.metrics.slo import parse_rules
+
+        rules = parse_rules(AsyncConf().get(conf_mod.SLO_RULES))
+        byname = {r.name: r for r in rules}
+        assert "standby_lag" in byname
+        assert byname["standby_lag"].series == "ps.standby_lag"
+        assert byname["standby_lag"].unless_series == "ps.done"
+
+    def test_registry_has_replication_family(self):
+        from asyncframework_tpu.metrics import registry, reset_totals
+
+        assert "replication" in registry.families()
+        repl_mod.bump("batches_streamed")
+        reset_totals()
+        assert repl_mod.repl_totals() == {}
+
+    def test_primary_telemetry_reports_standby_lag(self):
+        prim, sb = _mirrored_pair()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            for _ in range(3):
+                ts, _w, _a, _c = cl.pull(0)
+                cl.push(0, ts, np.ones(8, np.float32))
+            _wait_caught_up(prim, sb)
+            # the lag series reads the ACKed clock (primary side),
+            # which trails the standby's apply by one ACK round trip:
+            # wait on the signal the assertion reads
+            deadline = time.monotonic() + 5.0
+            while (time.monotonic() < deadline
+                   and prim.repl.lag_versions() > 0):
+                time.sleep(0.02)
+            src = prim._telemetry_source()
+            assert src["standby_synced"] == 1.0
+            assert src["standby_lag"] == 0.0
+            assert sb._telemetry_source().get("standby") == 1.0
+        finally:
+            prim.stop()
+            sb.stop()
+
+    def test_k8s_renders_standby_pods(self):
+        from asyncframework_tpu.deploy.k8s import (
+            PS_SHARD_PORT,
+            render_ps_shards,
+        )
+
+        objs = render_ps_shards(3, 24, 2048, workers=8, standbys=1)
+        kinds = [o["kind"] for o in objs]
+        assert kinds.count("Deployment") == 6   # 3 primaries + 3 standbys
+        assert kinds.count("Service") == 6
+        assert kinds.count("PersistentVolumeClaim") == 3  # primaries only
+        deps = {o["metadata"]["name"]: o for o in objs
+                if o["kind"] == "Deployment"}
+        for i in range(3):
+            prim = deps[f"async-ps-shard-{i}"]
+            env = {e["name"]: e["value"] for e in
+                   prim["spec"]["template"]["spec"]["containers"][0]["env"]}
+            sbs = json.loads(env["ASYNC_SHARD_STANDBYS"])
+            assert sbs[i] == [f"async-ps-shard-{i}-standby",
+                              PS_SHARD_PORT]
+            sb = deps[f"async-ps-shard-{i}-standby"]
+            sb_env = {e["name"]: e["value"] for e in
+                      sb["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert sb_env["ASYNC_SHARD_ROLE"] == "standby"
+            assert sb_env["ASYNC_SHARD_CKPT"] == ""  # stream-synced
+            meta = sb["spec"]["template"]["metadata"]
+            assert meta["labels"]["role"] == "standby"
+        # default rendering is unchanged (9 objects, no standby names)
+        base = render_ps_shards(3, 24, 2048, workers=8)
+        assert len(base) == 9
+        assert not any("standby" in o["metadata"]["name"] for o in base)
+
+
+# ------------------------------------------- THE acceptance (real procs)
+class TestFailoverAcceptance:
+    """Real OS processes end to end: a 3-shard group with warm standbys
+    under the controller, two worker processes, and a primary taken out
+    mid-run -- by SIGKILL (promotion, availability gap bounded by
+    suspicion time) and by PARTITION (the healed zombie's stream appends
+    are REJECT_FENCED and nothing applies twice)."""
+
+    NW, N, D = 8, 4096, 24
+    ITERS = 900
+
+    def _worker(self, port, wpid, tmp):
+        env = dict(os.environ)
+        env.update({
+            "PS_ROLE": "worker", "PS_PORT": str(port),
+            "PS_WORKER_ID": str(wpid), "PS_NUM_WORKER_PROCS": "2",
+            "PS_NUM_ITER": str(self.ITERS),
+            "JAX_PLATFORMS": "cpu",
+        })
+        return subprocess.Popen(
+            [sys.executable, str(CHILD)], env=env,
+            stdout=subprocess.PIPE,
+            stderr=open(os.path.join(tmp, f"worker{wpid}.stderr.log"),
+                        "w"),
+            text=True,
+        )
+
+    def _group(self, tmp_path):
+        # cfg MUST mirror tests/ps_dcn_child.py::config()
+        cfg = SolverConfig(
+            num_workers=self.NW, num_iterations=self.ITERS, gamma=1.2,
+            taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5,
+            printer_freq=50, seed=42, calibration_iters=20,
+            run_timeout_s=120.0,
+        )
+        return sg.ShardGroup(
+            cfg, self.D, self.N, 3, checkpoint_dir=str(tmp_path),
+            worker_procs=2, dead_after_s=1.0, check_interval_s=0.2,
+            stderr_dir=str(tmp_path),
+            conf_overlays={"async.fence.enabled": True,
+                           "async.ps.standby": 1},
+        ).start()
+
+    def _wait_threshold(self, port, threshold, what):
+        watch = ps_dcn.PSClient("127.0.0.1", port)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            got = watch.subscribe(0)
+            if got is not None and got[2] >= threshold:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail(f"{what} never reached the threshold")
+        try:
+            watch.bye()
+        except (ConnectionError, OSError):
+            pass
+
+    def test_sigkill_primary_promotes_not_restarts(self, tmp_path):
+        group = self._group(tmp_path)
+        workers = []
+        try:
+            assert group.standbys_wire() and all(group.standbys_wire())
+            port0 = group.port_of(0)
+            workers = [self._worker(port0, 0, str(tmp_path)),
+                       self._worker(port0, 1, str(tmp_path))]
+            kill_after = 60 + (CHAOS_SEED % 50)
+            self._wait_threshold(group.port_of(1), kill_after, "shard 1")
+            os.kill(group.pid_of(1), signal.SIGKILL)
+            t_kill = time.monotonic()
+            # availability probe through the failover: time every read
+            # of range 1 at its CURRENT endpoint; the gap is bounded by
+            # suspicion (lease 1 s) + promotion RPC, NOT by a process
+            # relaunch + checkpoint replay
+            gap_end = None
+            latencies = []
+            probe_deadline = time.monotonic() + 45.0
+            while time.monotonic() < probe_deadline:
+                t0 = time.monotonic()
+                try:
+                    sg._oneshot("127.0.0.1", group.port_of(1),
+                                {"op": "SHARDMAP"}, timeout_s=1.0)
+                    latencies.append(time.monotonic() - t0)
+                    if group.promotions_of(1) >= 1:
+                        gap_end = time.monotonic()
+                        break
+                except (ConnectionError, OSError):
+                    pass
+                time.sleep(0.02)
+            assert gap_end is not None, "range 1 never came back"
+            gap_s = gap_end - t_kill
+            # THE acceptance: promotion, not restart -- no spawn, no
+            # checkpoint replay on the recovery path
+            assert group.promotions_of(1) >= 1
+            assert group.restarts_of(1) == 0
+            assert sg.shard_totals().get("shards_restarted", 0) == 0
+            assert sg.shard_totals().get("standby_promotions", 0) >= 1
+            # suspicion (1 s lease) + scan tick + one RPC, with wide
+            # scheduling headroom -- a relaunch would add process boot
+            # (jax import alone is several seconds) + checkpoint replay
+            assert gap_s < 20.0, f"availability gap {gap_s:.1f}s"
+            # the run completes through the failover with full coverage
+            result0 = group.result_of(0, timeout_s=90.0)
+            assert result0 is not None and result0["done"] is True
+            assert result0["accepted"] == self.ITERS
+            assert set(map(int, result0["accepted_by_wid"])) == set(
+                range(self.NW))
+            traj = result0.get("trajectory")
+            assert traj, "no trajectory (eval plane died?)"
+            assert traj[-1][1] < traj[0][1] * 0.2, traj
+            group.finish()
+            # the promoted member reports itself: promoted, never
+            # resumed from a checkpoint, exactly-once accounting intact
+            result1 = group.result_of(1, timeout_s=30.0)
+            assert result1 is not None, "promoted shard never reported"
+            assert result1.get("promoted") is True
+            assert result1.get("resumed_from") is None
+            assert (result1["accepted"] + result1["dropped"]
+                    == result1["clock"])
+            for w in workers:
+                rc = w.wait(timeout=60.0)
+                assert rc == 0, f"worker exited rc={rc}"
+            out = [json.loads(w.stdout.read().splitlines()[-1])
+                   for w in workers]
+            assert sum(o["gradients"] for o in out) >= self.ITERS
+        finally:
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            group.stop()
+
+    def test_partition_primary_zombie_stream_fenced(self, tmp_path):
+        """PARTITION (not SIGKILL) shard 1's primary away from the
+        controller past lease expiry: the standby promotes; the zombie
+        -- alive, still fed by workers until they heal -- has its
+        stream appends REJECT_FENCED by the promoted standby, folds the
+        bounce into its own admission, and its deposed clients
+        re-resolve.  No accepted push is applied twice (accept
+        accounting on the promoted member is exact)."""
+        group = self._group(tmp_path)
+        workers = []
+        try:
+            port0 = group.port_of(0)
+            port1 = group.port_of(1)
+            workers = [self._worker(port0, 0, str(tmp_path)),
+                       self._worker(port0, 1, str(tmp_path))]
+            cut_after = 60 + (CHAOS_SEED % 40)
+            self._wait_threshold(port1, cut_after, "shard 1")
+            # blackhole the CONTROLLER's view of shard 1's primary (the
+            # workers and the standby keep talking to it -- the zombie
+            # stays live and streaming).  wan profile overlays when the
+            # sweep asks for it.
+            sched = faults.FaultSchedule(seed=CHAOS_SEED)
+            sched.add_partition([f"*:{port1}"], duration_s=6.0)
+            sched = faults.merge_schedules(
+                sched, faults.profile_schedule_from_env(CHAOS_SEED))
+            faults.install(faults.FaultInjector(sched))
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                if group.promotions_of(1) >= 1:
+                    break
+                time.sleep(0.1)
+            assert group.promotions_of(1) >= 1, \
+                "partitioned primary was never promoted over"
+            assert group.sup.counters()["lease_expiries"] >= 1
+            assert group.restarts_of(1) == 0
+            faults.clear()  # heal: the zombie is reachable again
+            # the zombie keeps draining worker pushes and streaming
+            # them -- every post-promotion append bounces REJECT_FENCED
+            # at the promoted member (counted server-side)
+            deadline = time.monotonic() + 30.0
+            fenced = 0
+            while time.monotonic() < deadline:
+                try:
+                    hdr = sg._oneshot("127.0.0.1", group.port_of(1),
+                                      {"op": "SHARDMAP"}, timeout_s=2.0)
+                    fenced = int(hdr.get("fenced_rejects", 0))
+                    if fenced >= 1:
+                        break
+                except (ConnectionError, OSError):
+                    pass
+                time.sleep(0.2)
+            assert fenced >= 1, \
+                "zombie's post-promotion writes were never fenced"
+            # the run completes through the partition: full coverage,
+            # decreasing assembled trajectory
+            result0 = group.result_of(0, timeout_s=90.0)
+            assert result0 is not None and result0["done"] is True
+            assert result0["accepted"] == self.ITERS
+            assert set(map(int, result0["accepted_by_wid"])) == set(
+                range(self.NW))
+            traj = result0.get("trajectory")
+            assert traj and traj[-1][1] < traj[0][1] * 0.2, traj
+            group.finish()
+            # exactly-once across the failover: every item the promoted
+            # member ever counted ticked its clock exactly once
+            result1 = group.result_of(1, timeout_s=30.0)
+            assert result1 is not None
+            assert result1.get("promoted") is True
+            assert (result1["accepted"] + result1["dropped"]
+                    == result1["clock"])
+            for w in workers:
+                rc = w.wait(timeout=60.0)
+                assert rc == 0, f"worker exited rc={rc}"
+        finally:
+            faults.clear()
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            group.stop()

@@ -543,6 +543,81 @@ class TestTelemetryAndSLO:
         assert sg.shard_totals() == {}
 
 
+# ------------------------------------------- restart-loop double-spawn fix
+class TestRestartDoubleSpawnGuard:
+    def test_concurrent_scans_relaunch_exactly_once(self, tmp_path,
+                                                    monkeypatch):
+        """ISSUE 13 satellite: a relaunch registers its pid/pstart
+        under the supervisor lock the moment the child is Popen'd --
+        BEFORE the (possibly long) announce wait -- and _restart
+        re-checks the slot's membership state under the restart lock.
+        Racing scans (check_once is public: the monitor, tests, and
+        operators may overlap) therefore schedule exactly ONE relaunch:
+        before the fix, a second scan queued behind the lock would kill
+        the fresh child and spawn another.  The slow-exec stub widens
+        the pre-announce window the race needs."""
+        import threading
+
+        cfg = make_cfg(num_workers=2, num_iterations=10**6)
+        group = sg.ShardGroup(
+            cfg, 8, 64, 1, checkpoint_dir=str(tmp_path),
+            dead_after_s=1.0, check_interval_s=60.0,  # monitor parked
+            stderr_dir=str(tmp_path),
+        ).start()
+        spawns = []
+        real_popen = sg.subprocess.Popen
+
+        def slow_popen(*a, **kw):
+            spawns.append(time.monotonic())
+            time.sleep(1.0)  # slow exec: the pre-announce window
+            return real_popen(*a, **kw)
+
+        try:
+            os.kill(group.pid_of(0), signal.SIGKILL)
+            deadline = time.monotonic() + 10.0
+            while (group._procs[0].proc.poll() is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            monkeypatch.setattr(sg.subprocess, "Popen", slow_popen)
+            scans = [
+                threading.Thread(target=group.check_once,
+                                 name=f"race-scan-{i}", daemon=True)
+                for i in range(3)
+            ]
+            for t in scans:
+                t.start()
+            for t in scans:
+                t.join(timeout=120.0)
+            assert not any(t.is_alive() for t in scans)
+            assert len(spawns) == 1, \
+                f"{len(spawns)} relaunches for one death"
+            assert group.restarts_of(0) == 1
+            # the one relaunched child is ALIVE (no second scan killed
+            # it) and serving on its pinned port
+            proc = group._procs[0].proc
+            assert proc is not None and proc.poll() is None
+            hdr = _probe_shardmap(group, 0, timeout_s=15.0)
+            assert hdr["op"] == "SHARDMAP"
+            # and a LATER scan with the child healthy spawns nothing
+            group.check_once()
+            assert len(spawns) == 1
+        finally:
+            group.stop()
+
+
+def _probe_shardmap(group, index, timeout_s):
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return sg._oneshot("127.0.0.1", group.port_of(index),
+                               {"op": "SHARDMAP"}, timeout_s=2.0)
+        except (ConnectionError, OSError) as e:
+            last = e
+            time.sleep(0.2)
+    raise AssertionError(f"relaunched shard never served: {last}")
+
+
 # --------------------------------------------- the acceptance: kill a shard
 @pytest.mark.shard
 class TestKillShardMidRun:
