@@ -12,7 +12,14 @@ fires on every lint of every tree state:
   the "second run inherits counts" bug waiting to happen;
 - ``metrics-dangling-family``: a registry row whose (module, attr)
   provider does not exist in the tree (a rename that silently emptied a
-  dashboard section).
+  dashboard section);
+- ``metrics-series-family``: a time-series key written anywhere (a
+  ``register_source`` family, a ``record_flat`` prefix, a dotted
+  ``record`` literal) must parse as ``family.metric`` with the family
+  declared in ``metrics/registry.py`` (counter family or
+  ``DYNAMIC_SERIES_FAMILIES``).  An undeclared family is a series the
+  SLO grammar, the per-run dashboards, and the cluster observer's
+  scrape surface all silently cannot see.
 
 Aggregator functions that roll other families up (``registry.all_totals``
 itself, ``net/retry.retry_totals`` inside ``net_totals``) are suppressed
@@ -23,11 +30,24 @@ the runtime audit documents.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Set, Tuple
 
-from asyncframework_tpu.analysis.core import Finding, LintContext
+from asyncframework_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    const_str,
+    tail_name,
+)
 
 PKG_PREFIX = "asyncframework_tpu/"
+
+#: the series-key grammar the sampler's ``<family>.<key>`` naming
+#: produces: only literals shaped like this are series keys (other
+#: ``.record(...)`` APIs -- dedup windows, calibrators -- take dicts or
+#: numbers and never match)
+_SERIES_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[A-Za-z0-9_.]+)+$")
+_FAMILY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 
 def _module_name(path: str) -> str:
@@ -49,8 +69,51 @@ def _registered(ctx: LintContext) -> Set[Tuple[str, str]]:
     return out
 
 
-def check(ctx: LintContext) -> List[Finding]:
+def _declared_series_families() -> Set[str]:
+    from asyncframework_tpu.metrics import registry
+
+    return set(registry.series_families())
+
+
+def _check_series_keys(ctx: LintContext) -> List[Finding]:
+    """metrics-series-family: every literal series key written anywhere
+    must carry a declared family."""
+    declared = _declared_series_families()
     findings: List[Finding] = []
+    for path, sf in ctx.files.items():
+        if path == "asyncframework_tpu/metrics/registry.py":
+            continue  # the declaration table itself
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            callee = tail_name(node.func)
+            lit = const_str(node.args[0])
+            if lit is None:
+                continue
+            family = None
+            if callee == "register_source":
+                if _FAMILY_RE.match(lit):
+                    family = lit
+            elif callee == "record_flat":
+                if _FAMILY_RE.match(lit):
+                    family = lit
+            elif callee == "record":
+                if _SERIES_KEY_RE.match(lit):
+                    family = lit.split(".", 1)[0]
+            if family is None or family in declared:
+                continue
+            findings.append(Finding(
+                "metrics-series-family", path, node.lineno, family,
+                f"series key {lit!r} writes undeclared family "
+                f"{family!r} -- declare it in metrics/registry.py (a "
+                f"CounterFamily or DYNAMIC_SERIES_FAMILIES) so the SLO "
+                f"grammar, dashboards, and the cluster observer can "
+                f"see it"))
+    return findings
+
+
+def check(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = _check_series_keys(ctx)
     registered = _registered(ctx)
 
     providers: Dict[Tuple[str, str], Tuple[str, int]] = {}

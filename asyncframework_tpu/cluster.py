@@ -27,16 +27,15 @@ e.g. ``bin/async-cluster 2 -- sgd-mllib synthetic synthetic 64 4096 8 100
 from __future__ import annotations
 
 import os
-import socket
 import subprocess
 import sys
 from typing import List, Optional, Tuple
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from asyncframework_tpu.net.frame import free_port
+
+    return free_port()
 
 
 def launch_local_cluster(

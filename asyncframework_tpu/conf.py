@@ -531,7 +531,13 @@ SLO_RULES = ConfigEntry(
     "for 3s unless ps_shards.done; "
     "standby_lag: max(ps.standby_lag) < 512 over 15s for 5s "
     "unless ps.done; "
-    "fenced_writes: rate(recovery.fenced_rejects) < 1 over 30s for 10s",
+    "fenced_writes: rate(recovery.fenced_rejects) < 1 over 30s for 10s; "
+    "fleet_stragglers: max(observer.straggler_score) < 2.5 over 30s "
+    "for 10s unless observer.fleet_done; "
+    "fleet_freshness: max(observer.freshness_lag_ms) < 5000 over 30s "
+    "for 5s unless observer.fleet_done; "
+    "fleet_roles: max(observer.roles_down) < 1 over 30s for 10s "
+    "unless observer.fleet_done",
     str,
     "Declarative SLO rule set (metrics/slo.py grammar: '<name>: "
     "<agg>(<series>) <op> <threshold> [over Ns] [for Ns] "
@@ -544,3 +550,69 @@ SLO_RULES = ConfigEntry(
     "durations) surface as the /api/status 'health' section and the "
     "async_slo_state gauges on /metrics.  Rules whose series never "
     "produce samples report no_data and never fire.")
+# -------------------------------------------------------- cluster observer
+# Central collector (metrics/observer.py + bin/async-mon): discovers every
+# role, scrapes /api/status + /metrics over the net/ retry plane, persists
+# a durable per-run per-role history store, derives cross-role signals
+# (straggler scores, merge-queue pressure, fleet freshness) as the
+# ``observer.*`` series the fleet SLO rules watch, and harvests crash
+# flight-recorder dumps.
+OBSERVER_INTERVAL_S = ConfigEntry(
+    "async.observer.interval.s", 1.0, float,
+    "Collector scrape period: every tick fetches each discovered role's "
+    "/api/status, folds the numbers into the per-run history store, and "
+    "recomputes the derived observer.* signals.  <= 0 disables the "
+    "scrape loop (scrape_once() still works on demand).")
+OBSERVER_ENDPOINTS = ConfigEntry(
+    "async.observer.endpoints", "", str,
+    "Static scrape targets beside discovery, ';'-separated "
+    "'name=role@host:port' entries (role and name optional: "
+    "'host:port' scrapes as role 'process').  The k8s observer "
+    "Deployment passes the per-role Services here.")
+OBSERVER_HISTORY_DIR = ConfigEntry(
+    "async.observer.history.dir", "", str,
+    "Root directory of the durable run-history store (one run-<id>/ "
+    "subdir per observed run: meta.json + per-role compacted series + "
+    "harvested flight-recorder dumps; bin/async-history renders an "
+    "index over it).  Empty = in-memory only, nothing persisted.")
+OBSERVER_HISTORY_POINTS = ConfigEntry(
+    "async.observer.history.points", 512, int,
+    "Per-series capacity of the run-history store.  At capacity every "
+    "other point is dropped and the acceptance stride doubles "
+    "(ConvergenceHistory's compaction), so a persisted series spans "
+    "the WHOLE run at bounded disk/RAM instead of forgetting its "
+    "start.")
+OBSERVER_PERSIST_S = ConfigEntry(
+    "async.observer.persist.s", 5.0, float,
+    "How often the collector persists the run-history store to disk "
+    "(atomic per-role files via checkpoint.durable_replace; also "
+    "persisted once at stop).  <= 0 persists only at stop.")
+OBSERVER_STRAGGLER_FACTOR = ConfigEntry(
+    "async.observer.straggler.factor", 2.5, float,
+    "A worker whose straggler score (max over the compute / push-RTT / "
+    "push-interval / staleness dimensions of worker_value over "
+    "cohort_median) reaches this factor is flagged in the fleet view "
+    "and counted in observer.stragglers_flagged -- the input surface "
+    "for delay-adaptive control (ROADMAP item 2).")
+# --------------------------------------------------------- flight recorder
+FLIGHT_DIR = ConfigEntry(
+    "async.flight.dir", "", str,
+    "Crash flight recorder dump directory (metrics/flightrec.py): when "
+    "set, this process keeps a bounded in-memory ring of recent "
+    "events/spans/counter deltas and writes it to "
+    "flight-<role>-<pid>.json here -- atomically on a cadence, plus a "
+    "final dump on SIGTERM/SIGINT/atexit -- so even a SIGKILL leaves a "
+    "post-mortem at most one flush behind.  The cluster observer "
+    "harvests these into the run-history store.  Empty = off (the "
+    "default: zero hot-path work).")
+FLIGHT_EVENTS = ConfigEntry(
+    "async.flight.events", 256, int,
+    "Flight-recorder ring capacity in events (oldest evict first, "
+    "counted).  Bounds both RAM and the dump file size.")
+FLIGHT_FLUSH_S = ConfigEntry(
+    "async.flight.flush.s", 0.5, float,
+    "Flight-recorder flush cadence: how stale an uncatchable-kill "
+    "(SIGKILL) post-mortem can be.  Each flush also records one "
+    "counter-delta event (non-zero registry family deltas since the "
+    "previous flush).  <= 0 disables the flush thread (dumps only on "
+    "fatal signal / exit).")

@@ -585,6 +585,117 @@ def render_app_job(name: str, argv: List[str], num_processes: int,
     }]
 
 
+#: the observer's own fleet-view endpoint (bin/async-mon --port)
+OBSERVER_PORT = 9096
+
+#: the per-role apps whose pods carry the PR 7 scrape wiring
+#: (ASYNCTPU_ASYNC_METRICS_PORT env + prometheus.io/* annotations) --
+#: render_observer points a metrics Service at each so the collector
+#: has a stable DNS name per role
+OBSERVER_SCRAPE_APPS = (
+    ("master", "master", "async-master"),
+    ("worker", "worker", "async-worker"),
+    ("frontend", "frontend", "async-serve-frontend"),
+    ("replica", "replica", "async-serve-replica"),
+)
+
+
+def render_observer(namespace: str = "default",
+                    image: str = DEFAULT_IMAGE,
+                    scrape_apps: Optional[List] = None,
+                    extra_endpoints: str = "",
+                    history_pvc: str = "async-observer-history"
+                    ) -> List[dict]:
+    """Cluster-observer tier (metrics/observer.py + bin/async-mon): one
+    collector Deployment + its fleet-view Service + the durable
+    run-history PVC, plus one **metrics Service** per scraped role.
+
+    The metrics Services are how the collector consumes the PR 7 scrape
+    wiring without an API-server client (this adapter renders, it does
+    not watch): every daemon pod already listens on ``METRICS_PORT``
+    (the ``ASYNCTPU_ASYNC_METRICS_PORT`` env the pod templates ship)
+    and carries ``prometheus.io/*`` annotations; each metrics Service
+    selects one role's pod label and exposes that port under a stable
+    DNS name, and the collector's ``--endpoints`` points at them.
+    ``extra_endpoints`` appends operator-supplied
+    ``name=role@host:port`` entries (e.g. a PS shard group)."""
+    apps = list(scrape_apps if scrape_apps is not None
+                else OBSERVER_SCRAPE_APPS)
+    objs: List[dict] = []
+    endpoints = []
+    for (name, role, app) in apps:
+        svc = f"async-metrics-{name}"
+        objs.append({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": _meta(svc, "observer", namespace),
+            "spec": {
+                "selector": {"app": app},
+                "ports": [{"name": "metrics", "port": METRICS_PORT,
+                           "targetPort": METRICS_PORT}],
+            },
+        })
+        endpoints.append(f"{name}={role}@{svc}:{METRICS_PORT}")
+    if extra_endpoints:
+        endpoints.append(extra_endpoints)
+    cmd = ["python", "-m", "asyncframework_tpu.metrics.observer",
+           "--endpoints", ";".join(endpoints),
+           "--history-dir", "/history",
+           "--port", str(OBSERVER_PORT)]
+    objs.append({
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": _meta(history_pvc, "observer", namespace),
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "5Gi"}}},
+    })
+    # the collector's own scrape annotations point at its fleet-view
+    # port (it serves /metrics THERE, not on the per-role 9095 the
+    # stock pod meta advertises -- metrics=False below skips that env)
+    observer_pod_meta = {
+        "labels": {"app": "async-observer"},
+        "annotations": {
+            "prometheus.io/scrape": "true",
+            "prometheus.io/port": str(OBSERVER_PORT),
+            "prometheus.io/path": "/metrics",
+        },
+    }
+    objs.append({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta("async-observer", "observer", namespace),
+        "spec": {
+            "replicas": 1,  # ONE collector owns the run-history store
+            "selector": {"matchLabels": {"app": "async-observer"}},
+            "template": {
+                "metadata": observer_pod_meta,
+                "spec": {
+                    "containers": [_container(
+                        "observer", image, cmd,
+                        ports=[OBSERVER_PORT],
+                        volume_mounts=[{"name": "history",
+                                        "mountPath": "/history"}],
+                        # the collector's OWN telemetry rides the
+                        # --port fleet-view server; a second 9095
+                        # endpoint would just duplicate it
+                        metrics=False,
+                    )],
+                    "volumes": [{
+                        "name": "history",
+                        "persistentVolumeClaim": {
+                            "claimName": history_pvc},
+                    }],
+                },
+            },
+        },
+    })
+    objs.append({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": _meta("async-observer", "observer", namespace),
+        "spec": {"selector": {"app": "async-observer"},
+                 "ports": [{"name": "fleet", "port": OBSERVER_PORT,
+                            "targetPort": OBSERVER_PORT}]},
+    })
+    return objs
+
+
 def render_cluster(workers: int, namespace: str = "default",
                    image: str = DEFAULT_IMAGE, ha_replicas: int = 1,
                    cores: int = 1, topic_server: bool = False,
@@ -593,7 +704,8 @@ def render_cluster(workers: int, namespace: str = "default",
                    relay_fanout: int = 0,
                    ps_shards: int = 0, ps_d: int = 0, ps_n: int = 0,
                    ps_workers: int = 8,
-                   ps_standbys: int = 0) -> Dict[str, str]:
+                   ps_standbys: int = 0,
+                   observer: bool = False) -> Dict[str, str]:
     """The whole standalone topology as {filename: yaml} -- apply with
     ``kubectl apply -f <dir>``."""
     out = {
@@ -617,6 +729,15 @@ def render_cluster(workers: int, namespace: str = "default",
         out["ps-shards.yaml"] = to_yaml(render_ps_shards(
             ps_shards, ps_d, ps_n, workers=ps_workers,
             namespace=namespace, image=image, standbys=ps_standbys,
+        ))
+    if observer:
+        apps = list(OBSERVER_SCRAPE_APPS)
+        # shard pods carry the same scrape wiring; give each shard a
+        # metrics Service too so the collector sees every range's ps.*
+        for i in range(ps_shards):
+            apps.append((f"ps-shard-{i}", "ps", f"async-ps-shard-{i}"))
+        out["observer.yaml"] = to_yaml(render_observer(
+            namespace, image, scrape_apps=apps,
         ))
     return out
 
@@ -666,6 +787,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="dataset rows the shard group's run covers")
     r.add_argument("--ps-workers", type=int, default=8,
                    help="logical workers the shard group's primary gates")
+    r.add_argument("--observer", action="store_true",
+                   help="also render the cluster-observer tier "
+                        "(async-mon collector Deployment + run-history "
+                        "PVC + per-role metrics Services)")
     a = sub.add_parser("app", help="render one application Job")
     a.add_argument("--out", required=True)
     a.add_argument("--name", required=True)
@@ -685,6 +810,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             relay_fanout=args.relay_fanout,
             ps_shards=args.ps_shards, ps_d=args.ps_d, ps_n=args.ps_n,
             ps_workers=args.ps_workers,
+            observer=args.observer,
         )
     else:
         files = {f"app-{args.name}.yaml": to_yaml(render_app_job(

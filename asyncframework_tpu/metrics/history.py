@@ -107,6 +107,16 @@ def build_history(
             f"<tr><td>{link}</td><td>{mtime}</td><td>{status}</td></tr>"
         )
 
+    observer_rows = _observer_rows(log_dir)
+    observer_html = ""
+    if observer_rows:
+        observer_html = (
+            "<h1>Observer run history "
+            f"({len(observer_rows)} runs)</h1>"
+            "<table><thead><tr><th>run</th><th>roles</th>"
+            "<th>flight dumps</th><th>persisted</th></tr></thead>"
+            "<tbody>" + "".join(observer_rows) + "</tbody></table>"
+        )
     index = out_dir / "index.html"
     index.write_text(
         "<!doctype html><html><head><meta charset='utf-8'>"
@@ -117,9 +127,50 @@ def build_history(
         f"<h1>Run history ({len(logs)} logs)</h1>"
         "<table><thead><tr><th>run</th><th>modified</th><th>summary</th>"
         "</tr></thead><tbody>" + "".join(rows) + "</tbody></table>"
-        "</body></html>"
+        + observer_html
+        + "</body></html>"
     )
     return index
+
+
+def _observer_rows(log_dir: Path) -> List[str]:
+    """Index rows for cluster-observer run-history dirs under
+    ``log_dir`` (metrics/observer.py RunHistoryStore layout:
+    ``run-<id>/meta.json`` + per-role series + harvested flight
+    dumps) -- the same directory can hold event logs AND observer
+    history; both get indexed."""
+    from asyncframework_tpu.metrics import observer as observer_mod
+
+    rows: List[str] = []
+    for run_dir in observer_mod.list_runs(str(log_dir)):
+        try:
+            run = observer_mod.load_run(run_dir)
+        except (OSError, ValueError):
+            rows.append(
+                f"<tr><td>{html.escape(Path(run_dir).name)}</td>"
+                f"<td colspan='3'>unreadable</td></tr>"
+            )
+            continue
+        meta = run.get("meta") or {}
+        roles = run.get("roles") or {}
+        role_bits = ", ".join(
+            f"{html.escape(str(n))} ({len((r or {}).get('series') or {})} "
+            f"series)"
+            for n, r in sorted(roles.items())
+        ) or "-"
+        persisted = meta.get("persisted_s")
+        when = (
+            time.strftime("%Y-%m-%d %H:%M:%S",
+                          time.localtime(float(persisted)))
+            if persisted else "-"
+        )
+        rows.append(
+            f"<tr><td>{html.escape(str(meta.get('run_id', '?')))}</td>"
+            f"<td>{role_bits}</td>"
+            f"<td>{len(run.get('flight') or {})}</td>"
+            f"<td>{when}</td></tr>"
+        )
+    return rows
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -52,6 +52,39 @@ _ACTIVE_LOCK = threading.Lock()
 #: comparing two dashboards) can tell process restarts apart
 RUN_ID = f"{uuid.uuid4().hex[:8]}-{os.getpid()}"
 
+#: dynamically registered /api/status sections (name -> zero-arg dict
+#: provider): the generic hook subsystems use to surface themselves on
+#: every dashboard page -- the PS registers ``ps_workers`` (per-worker
+#: stats the observer's straggler scoring reads), the cluster observer
+#: registers ``observer`` (the fleet view async-top renders).  Last
+#: registration under a name wins; unregister is identity-gated like
+#: the time-series sources.
+_SECTIONS_LOCK = threading.Lock()
+_STATUS_SECTIONS: Dict[str, Callable[[], Dict]] = {}
+
+
+def register_status_section(name: str, fn: Callable[[], Dict]) -> None:
+    with _SECTIONS_LOCK:
+        _STATUS_SECTIONS[name] = fn
+
+
+def unregister_status_section(name: str, fn=None) -> None:
+    """Remove a section; with ``fn`` given, only if it is still the
+    registered one (a stopped subsystem must not unhook its
+    replacement)."""
+    with _SECTIONS_LOCK:
+        if fn is None or _STATUS_SECTIONS.get(name) is fn:
+            _STATUS_SECTIONS.pop(name, None)
+
+
+def telemetry_port() -> Optional[int]:
+    """The port of this process's most recent live/telemetry server
+    (None when nothing serves).  Workers advertise it on HELLO
+    (``mport``) so supervisors -- and through them the cluster
+    observer -- can discover per-role scrape endpoints."""
+    servers = active_servers()
+    return servers[0].port if servers else None
+
 
 def _family_totals() -> "Dict[str, Dict[str, int]]":
     from asyncframework_tpu.metrics import registry
@@ -115,6 +148,13 @@ def _telemetry_sections() -> Dict[str, object]:
             out["shards"] = group.status_section()
     except Exception:  # noqa: BLE001 - a half-torn-down group must not
         pass           # 500 every dashboard page
+    with _SECTIONS_LOCK:
+        sections = dict(_STATUS_SECTIONS)
+    for name, fn in sections.items():
+        try:
+            out[name] = fn()
+        except Exception:  # noqa: BLE001 - one subsystem's section must
+            pass           # not 500 every dashboard page
     return out
 
 
@@ -457,9 +497,16 @@ def start_telemetry_from_conf(role: str, host: str = "0.0.0.0",
     every daemon entry point (worker daemon, serving replica/frontend,
     master, cluster roles) calls this once at boot, so setting one conf
     key -- or the ``ASYNCTPU_ASYNC_METRICS_PORT`` env var the k8s
-    manifests ship -- lights up /metrics and /api/status fleet-wide."""
-    from asyncframework_tpu.conf import METRICS_PORT, global_conf
+    manifests ship -- lights up /metrics and /api/status fleet-wide.
 
+    The crash flight recorder rides the same choke point
+    (``async.flight.dir`` gates it independently of the port): every
+    role that can serve telemetry also keeps its post-mortem ring, and
+    a new daemon entry point cannot wire one without the other."""
+    from asyncframework_tpu.conf import METRICS_PORT, global_conf
+    from asyncframework_tpu.metrics import flightrec
+
+    flightrec.install_from_conf(role)
     port = int(global_conf().get(METRICS_PORT))
     if port < 0:
         return None

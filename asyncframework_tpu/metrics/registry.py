@@ -163,6 +163,20 @@ _register(CounterFamily(
         "(standby applier) (parallel/replication.py).",
 ))
 _register(CounterFamily(
+    "observer", "asyncframework_tpu.metrics.observer",
+    "observer_totals", "reset_observer_totals",
+    doc="Cluster observer: scrapes, scrape errors, roles discovered, "
+        "flight dumps harvested, history persists, stragglers flagged "
+        "(metrics/observer.py).",
+))
+_register(CounterFamily(
+    "flight", "asyncframework_tpu.metrics.flightrec",
+    "flight_totals", "reset_flight_totals",
+    baseline=False,
+    doc="Crash flight recorder meta-counters: events noted/dropped, "
+        "cadence flushes, dumps written (metrics/flightrec.py).",
+))
+_register(CounterFamily(
     "convergence", "asyncframework_tpu.metrics.timeseries",
     "convergence_totals", "reset_convergence",
     baseline=False,
@@ -176,3 +190,28 @@ _register(CounterFamily(
     doc="Time-series store meta-counters: samples recorded, series "
         "live, evictions (metrics/timeseries.py).",
 ))
+
+
+# --------------------------------------------------------------------------
+# Series-family declarations.  Every time-series key written anywhere
+# must parse as ``family.metric`` with the family declared here: either
+# a counter family above (the sampler records each one under its own
+# name) or one of the DYNAMIC source families below (register_source
+# callers).  ``bin/async-lint`` enforces this statically
+# (metrics-series-family, analysis/rules_metrics.py) -- the static twin
+# of the runtime registration audit in tests/test_telemetry.py.
+# --------------------------------------------------------------------------
+#: dynamic register_source() families beside the counter families: the
+#: PS core scalars, the shard-group controller, the always-on derived
+#: sources (timeseries._builtin_sources), the cluster observer's
+#: derived fleet signals, and the MetricsSystem queue-depth source.
+DYNAMIC_SERIES_FAMILIES = (
+    "ps", "ps_shards", "serving", "trace", "convergence", "observer",
+    "queue",
+)
+
+
+def series_families() -> tuple:
+    """Every declared series family name: counter families plus the
+    dynamic source families (the metrics-series-family lint's table)."""
+    return tuple(_FAMILIES) + DYNAMIC_SERIES_FAMILIES

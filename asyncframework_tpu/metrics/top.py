@@ -179,6 +179,77 @@ def render_status(status: Dict, plain: bool = True) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_fleet(observer_section: Dict, plain: bool = True) -> str:
+    """One fleet-dashboard frame from a collector's ``observer``
+    /api/status section (pure, like :func:`render_status`): every
+    discovered role's liveness + key numbers, the derived cross-role
+    signals, and the straggler board."""
+    lines: List[str] = []
+    roles = observer_section.get("roles") or {}
+    derived = observer_section.get("derived") or {}
+    up = int(derived.get("roles_up", sum(
+        1 for r in roles.values() if r.get("up"))))
+    lines.append(f"async-mon  fleet view  roles={len(roles)} up={up}")
+
+    if roles:
+        lines.append("")
+        lines.append(f"{'role':<22}{'kind':<10}{'up':<4}{'health':<9}"
+                     f"{'accepted':>10}{'stale':>7}{'qps':>8}{'lag ms':>8}")
+        for name in sorted(roles):
+            r = roles[name]
+            glyph, code = (("up", "32") if r.get("up")
+                           else ("DOWN", "31"))
+            lines.append(
+                f"{name:<22}{str(r.get('role') or '-'):<10}"
+                f"{_color(glyph, code, plain):<4} "
+                f"{str(r.get('health') or '-'):<8}"
+                f"{_fmt(r.get('accepted'), 0):>10}"
+                f"{_fmt(r.get('staleness'), 0):>7}"
+                f"{_fmt(r.get('qps')):>8}"
+                f"{_fmt(r.get('freshness_lag_ms'), 0):>8}"
+            )
+
+    if derived:
+        lines.append("")
+        lines.append(
+            "derived: "
+            f"push_rate={_fmt(derived.get('push_rate'))}/s "
+            f"merge_q={_fmt(derived.get('merge_queue_depth'), 0)} "
+            f"fleet_lag={_fmt(derived.get('freshness_lag_ms'), 0)}ms "
+            f"straggler_max={_fmt(derived.get('straggler_score'), 2)} "
+            f"done={int(derived.get('fleet_done', 0))}"
+        )
+
+    stragglers = observer_section.get("stragglers") or {}
+    shown = [(w, s) for w, s in sorted(stragglers.items())
+             if s.get("score") is not None]
+    if shown:
+        factor = observer_section.get("straggler_factor")
+        lines.append("")
+        lines.append(f"stragglers (score = worker/median, flag at "
+                     f">={_fmt(factor)}):")
+        for wid, s in shown:
+            mark = (_color("<<", "31", plain) if s.get("flagged")
+                    else "  ")
+            dims = " ".join(f"{d}={_fmt(r, 2)}"
+                            for d, r in sorted(
+                                (s.get("dims") or {}).items()))
+            lines.append(f"  w{wid:<4} score={_fmt(s['score'], 2):<7} "
+                         f"{mark} {dims}")
+
+    hist = observer_section.get("history") or {}
+    if hist:
+        nd = len(hist.get("flight_dumps") or [])
+        lines.append("")
+        lines.append(
+            f"history: run={hist.get('run_id')} "
+            f"roles={len(hist.get('roles') or {})} "
+            f"flight_dumps={nd} "
+            f"dir={hist.get('run_dir') or '(memory)'}"
+        )
+    return "\n".join(lines) + "\n"
+
+
 def fetch_status(url: str, timeout_s: float = 5.0) -> Dict:
     if not url.startswith("http"):
         url = "http://" + url
@@ -194,20 +265,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         "async-top", description="terminal dashboard over /api/status"
     )
-    p.add_argument("url", help="http://HOST:PORT (or HOST:PORT) of any "
-                               "process serving /api/status")
+    p.add_argument("url", nargs="?", default=None,
+                   help="http://HOST:PORT (or HOST:PORT) of any "
+                        "process serving /api/status")
+    p.add_argument("--observer", default=None, metavar="ENDPOINT",
+                   help="render the FLEET view from a cluster "
+                        "observer's /api/status (bin/async-mon): every "
+                        "worker/shard/replica in one dashboard instead "
+                        "of polling a single role")
     p.add_argument("--interval", type=float, default=1.0)
     p.add_argument("--once", action="store_true",
                    help="render one frame and exit")
     p.add_argument("--plain", action="store_true",
                    help="no ANSI colors / screen clears (pipe-friendly)")
     args = p.parse_args(argv)
+    url = args.observer if args.observer is not None else args.url
+    if url is None:
+        p.error("need a URL (or --observer ENDPOINT)")
     while True:
         try:
-            status = fetch_status(args.url)
-            frame = render_status(status, plain=args.plain)
+            status = fetch_status(url)
+            if args.observer is not None:
+                section = status.get("observer")
+                if not isinstance(section, dict):
+                    frame = (f"async-top: {url} serves no 'observer' "
+                             f"section (not an async-mon collector?)\n")
+                else:
+                    frame = render_fleet(section, plain=args.plain)
+            else:
+                frame = render_status(status, plain=args.plain)
         except (OSError, ValueError) as e:
-            frame = f"async-top: {args.url} unreachable ({e})\n"
+            frame = f"async-top: {url} unreachable ({e})\n"
         if not args.plain:
             sys.stdout.write("\x1b[2J\x1b[H")
         sys.stdout.write(frame)

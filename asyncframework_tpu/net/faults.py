@@ -56,6 +56,8 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from asyncframework_tpu.metrics import flightrec as _flight
+
 CONNECT_REFUSED = "connect_refused"
 CUT_MID_FRAME = "cut_mid_frame"
 STALL_READ = "stall_read"
@@ -269,6 +271,11 @@ class FaultInjector:
     def _journal(self, rec: Dict) -> None:
         if len(self.fired) < self.JOURNAL_MAX:
             self.fired.append(rec)
+        # flight-recorder breadcrumb (metrics/flightrec.py): a chaos
+        # post-mortem shows which scheduled faults fired right before
+        # the end (no-op when no recorder is installed; the record rides
+        # as one field -- its own "kind" key is the FAULT kind)
+        _flight.note("fault", event=dict(rec))
 
     # ------------------------------------------------------------- matching
     def _fire(self, endpoint: str, op: str) -> Optional[str]:

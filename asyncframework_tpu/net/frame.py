@@ -110,6 +110,18 @@ def last_recv_bytes() -> int:
     return getattr(_io_tls, "recv", 0) or 0
 
 
+def free_port(host: str = "127.0.0.1") -> int:
+    """Reserve-and-release one ephemeral port (the ONE copy of the
+    bind-port-0 idiom: the local cluster launcher and the shard-group
+    controller's telemetry-port pre-assignment both need a port known
+    BEFORE the owning process binds it).  The tiny close-to-bind race
+    is acceptable for local orchestration; k8s pins ports in the
+    manifests instead."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
 def endpoint_of(sock: socket.socket) -> str:
     """The remote peer as ``host:port`` (fault-schedule addressing)."""
     try:

@@ -112,6 +112,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from asyncframework_tpu.metrics import flightrec as _flight
 from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import ClientSession, DedupWindow, RetryPolicy
 from asyncframework_tpu.net import frame as _frame
@@ -638,6 +639,13 @@ class ParameterServer:
         self._last_contact: Dict[int, float] = {}
         self.pushes_by_wid: Dict[int, int] = {}
         self.accepted_by_wid: Dict[int, int] = {}
+        # per-worker straggler stats (cluster observer input surface):
+        # merge-time facts (staleness, push inter-arrival EWMA) land at
+        # drain, latency facts (compute / push.rtt EWMAs) land when this
+        # worker's piggybacked spans fold.  Own lock: span folds run on
+        # connection handler threads, outside the model lock by design.
+        self._wstats_lock = threading.Lock()
+        self._wstats: Dict[int, Dict[str, float]] = {}
         self.membership_rejects = 0  # pushes from deposed shard servers
         # exactly-once-applied PUSH: a retried (sid, seq) re-sends the
         # cached ACK instead of merging the gradient twice (net/session.py).
@@ -720,8 +728,71 @@ class ParameterServer:
 
         self._ts_source = self._telemetry_source
         _ts.register_source("ps", self._ts_source)
+        # per-worker stats on /api/status (``ps_workers`` section): the
+        # cluster observer's straggler scoring reads it -- same
+        # last-registration-wins + identity-gated-unregister discipline
+        # as the ``ps`` series source
+        from asyncframework_tpu.metrics import live as _live
+
+        self._workers_section = self.worker_stats
+        _live.register_status_section("ps_workers", self._workers_section)
         _ts.ensure_started()
         return self
+
+    def worker_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-worker straggler inputs (JSON-able; the ``ps_workers``
+        /api/status section): accepted/dropped counts, last observed
+        staleness, push inter-arrival EWMA, and -- when this worker's
+        spans fold here -- compute and push-RTT EWMAs."""
+        with self._wstats_lock:
+            return {str(w): dict(st) for w, st in self._wstats.items()}
+
+    _EWMA_A = 0.3  # per-worker EWMA weight (a few pushes to converge)
+
+    def _wstat_merge(self, wid: int, staleness: int,
+                     accepted: bool) -> None:
+        """Merge-time per-worker facts; called at drain (model lock
+        held) -- a dict update, same cost class as accepted_by_wid."""
+        now_ms = time.monotonic() * 1e3
+        with self._wstats_lock:
+            st = self._wstats.setdefault(int(wid), {})
+            st["accepted"] = st.get("accepted", 0) + int(accepted)
+            st["dropped"] = st.get("dropped", 0) + int(not accepted)
+            st["staleness"] = int(staleness)
+            last = st.get("last_seen_ms")
+            if last is not None and now_ms > last:
+                iv = now_ms - last
+                prev = st.get("interval_ms")
+                st["interval_ms"] = round(
+                    iv if prev is None
+                    else self._EWMA_A * iv + (1 - self._EWMA_A) * prev, 3)
+            st["last_seen_ms"] = now_ms
+
+    def _wstat_span(self, span: "_trace.Span") -> None:
+        """Latency facts from a folded span (compute / push.rtt).
+
+        Only updates entries :meth:`_wstat_merge` already created: spans
+        fold at PUSH receive (handler threads), merges at drain -- a
+        span-only entry would carry a one-sample EWMA with no
+        ``accepted`` count, bypassing the observer's warm-up guard and
+        flagging a booting worker on its very first sample."""
+        if span.worker_id is None or span.dur_ms is None:
+            return
+        if span.stage == _trace.COMPUTE:
+            key = "compute_ms"
+        elif span.stage == _trace.PUSH_RTT:
+            key = "rtt_ms"
+        else:
+            return
+        with self._wstats_lock:
+            st = self._wstats.get(int(span.worker_id))
+            if st is None:
+                return
+            prev = st.get(key)
+            st[key] = round(
+                span.dur_ms if prev is None
+                else self._EWMA_A * span.dur_ms
+                + (1 - self._EWMA_A) * prev, 3)
 
     def _telemetry_source(self) -> Dict[str, float]:
         """Flat scalars the time-series sampler records as ``ps.<key>``
@@ -734,6 +805,10 @@ class ParameterServer:
             "dropped": self.dropped,
             "push_bytes": self.push_bytes,
             "max_staleness": self.max_staleness,
+            # merge-queue backlog at this instant: the observer prices
+            # it against the push rate (queue growing faster than the
+            # drain = the apply plane is the bottleneck)
+            "queue_depth": len(self._merge_q),
             "done": int(self._done.is_set()),
         }
         repl = self.repl
@@ -963,6 +1038,7 @@ class ParameterServer:
         with self._trace_lock:
             self.trace_spans += 1
         self._trace_agg.add(span)
+        self._wstat_span(span)
         if self.bus is not None:
             self.bus.post(_trace.span_event(span, self._bus_time_ms()))
 
@@ -1045,6 +1121,7 @@ class ParameterServer:
                             pid=header.get("pid"),
                             host=header.get("host"),
                             pid_start=header.get("pstart"),
+                            mport=header.get("mport"),
                         )
                     welcome = {"op": "WELCOME",
                                "elastic": self.supervisor is not None}
@@ -2065,6 +2142,7 @@ class ParameterServer:
             item.task_ms = task_ms
             item.accepted = accepted
             item.k_at_merge = self._k
+            self._wstat_merge(item.wid, staleness, accepted)
             ack = {"op": "ACK", "accepted": bool(accepted),
                    "done": self._done.is_set()}
             # record INSIDE the lock, before any send: (1) a retry after a
@@ -2162,6 +2240,13 @@ class ParameterServer:
             self.repl.enqueue(pre_clock, items, grads,
                               [self._cal_ms, self._cal_n,
                                self.avg_delay_ms])
+        if drained:
+            # flight-recorder breadcrumb (metrics/flightrec.py): one
+            # event per drain so a SIGKILLed PS's dump ends with its
+            # last applied batch (no-op when no recorder is installed)
+            _flight.note("merge", clock=self._clock, k=self._k,
+                         batch=len(drained),
+                         accepted=self.accepted, dropped=self.dropped)
         for item in drained:
             if item.do_snapshot:
                 # host copy NOW: the snapshot must pin this version (the
@@ -2317,6 +2402,11 @@ class ParameterServer:
 
             # identity-gated: a stopped PS must not unhook its replacement
             _ts.unregister_source("ps", self._ts_source)
+        if getattr(self, "_workers_section", None) is not None:
+            from asyncframework_tpu.metrics import live as _live
+
+            _live.unregister_status_section("ps_workers",
+                                            self._workers_section)
         if self.supervisor is not None:
             self.supervisor.stop()
         with self._wave_cv:
@@ -2513,6 +2603,16 @@ class PSClient:
             pstart = supervisor_mod.proc_start_time(pid)
             if pstart is not None:
                 hdr["pstart"] = pstart
+        # advertise this process's telemetry endpoint (when one serves):
+        # the supervisor records it per member and the cluster observer
+        # discovers worker scrape targets from the membership instead of
+        # needing static endpoints.  Absent when telemetry is off -- the
+        # byte-identity suites' wire is unchanged.
+        from asyncframework_tpu.metrics import live as _live
+
+        mport = _live.telemetry_port()
+        if mport:
+            hdr["mport"] = int(mport)
         header, _ = self._call_raw(hdr)
         return header
 
@@ -3580,6 +3680,15 @@ def run_worker_process(
                                         else w_dev, ts, g_host)
                         _accepted, done = cl.push(wid, ts, g_host,
                                                   sparse=sparse, tr=tr)
+                    # flight-recorder breadcrumb: the last acked push
+                    # rides the dump, so a SIGKILLed worker's post-mortem
+                    # ends at (wid, basis version, cumulative count) the
+                    # PS-side ledgers can be checked against.  ``ts`` is
+                    # an int against a single PS and a per-shard vector
+                    # against a sharded group -- pass it through as-is
+                    # (the dump serializer stringifies anything exotic)
+                    _flight.note("push", wid=wid, ts=ts,
+                                 acc=bool(_accepted), n=counts[wid])
                     if done:
                         break
                 except (ConnectionError, OSError):
@@ -3666,6 +3775,8 @@ def run_worker_process(
             try:
                 accepted, acked_done = push_cl.push_finish()
                 pl_stats.bump("pushes_async")
+                _flight.note("push", wid=wid, acc=bool(accepted),
+                             n=counts[wid])
                 if acked_done:
                     done = True
                 elif not accepted:

@@ -78,6 +78,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from asyncframework_tpu.metrics import flightrec as _flight
 from asyncframework_tpu.net import frame as _frame
 from asyncframework_tpu.parallel import supervisor as supervisor_mod
 
@@ -192,6 +193,12 @@ class ShardMap:
 
     def __repr__(self) -> str:
         return f"ShardMap({self.entries})"
+
+
+#: telemetry-port pre-assignment uses the shared reserve-and-release
+#: helper (net/frame.py): the slot's scrape URL must be known BEFORE
+#: the child binds it, and must survive relaunches
+_free_port = _frame.free_port
 
 
 def _oneshot(host: str, port: int, header: dict,
@@ -877,7 +884,8 @@ class ShardGroup:
                  check_interval_s: float = 0.25,
                  max_restarts: int = 10,
                  spawn_timeout_s: float = 90.0,
-                 standbys: Optional[int] = None):
+                 standbys: Optional[int] = None,
+                 telemetry_ports: Optional[object] = None):
         if algo != "asgd":
             raise ValueError("sharded PS groups support algo='asgd' only "
                              "(ASAGA's PS-side sampling is range-global)")
@@ -991,6 +999,39 @@ class ShardGroup:
         self._monitor: Optional[threading.Thread] = None
         self._restart_lock = threading.Lock()
         self._ts_source = None
+        # per-slot telemetry endpoints (cluster-observer discovery):
+        # "auto" pre-assigns one free port per PRIMARY slot, a dict pins
+        # them explicitly.  The slot's port survives relaunches -- the
+        # same _child_env every (re)spawn sets it via
+        # ASYNCTPU_ASYNC_METRICS_PORT, so the observer's scrape URL for
+        # "ps-shard-i" stays valid across a failover.  Standbys get
+        # their OWN ports (two processes cannot share one bind), and a
+        # PROMOTION hands the standby's port to the slot -- the role
+        # name keeps resolving to whoever currently serves the range
+        # instead of pointing at a dead primary's port forever.
+        self.telemetry_ports: Dict[int, int] = {}
+        self._standby_tports: Dict[int, int] = {}
+        if telemetry_ports == "auto":
+            self.telemetry_ports = {
+                i: _free_port(self.host) for i in self.indices
+            }
+            if self.standbys:
+                self._standby_tports = {
+                    i: _free_port(self.host) for i in self.indices
+                }
+        elif isinstance(telemetry_ports, dict):
+            self.telemetry_ports = {
+                int(i): int(p) for i, p in telemetry_ports.items()
+            }
+
+    def telemetry_targets(self) -> List[Tuple[str, str, str]]:
+        """(name, role, url) scrape targets for the observer: one per
+        managed shard slot with an assigned telemetry port."""
+        return [
+            (f"ps-shard-{i}", "ps",
+             f"http://{self.host}:{port}")
+            for i, port in sorted(self.telemetry_ports.items())
+        ]
 
     # ------------------------------------------------------------ lifecycle
     def _ckpt_path(self, index: int) -> Optional[str]:
@@ -1076,6 +1117,15 @@ class ShardGroup:
         env["ASYNC_SHARD_STANDBYS"] = (
             json.dumps(sbs) if sbs and any(sbs) else ""
         )
+        mport = (self.telemetry_ports.get(index) if role == "primary"
+                 else self._standby_tports.get(index))
+        if mport:
+            # the slot's pinned telemetry endpoint (observer discovery):
+            # conf async.metrics.port's env spelling, same as the k8s
+            # manifests -- start_telemetry_from_conf in the child's main
+            # lights it up.  Standbys bind their own port; a promotion
+            # hands it to the slot (see _promote).
+            env["ASYNCTPU_ASYNC_METRICS_PORT"] = str(mport)
         return env
 
     def epoch_of(self, index: int) -> int:
@@ -1472,6 +1522,18 @@ class ShardGroup:
         self.promotions += 1
         self._promotions[index] = self._promotions.get(index, 0) + 1
         _bump("standby_promotions")
+        _flight.note("promote", shard=int(index),
+                     epoch=self.epoch_of(index))
+        # telemetry-port handoff: the promoted member serves its OWN
+        # (ex-standby) port; the dead primary's pre-assigned port would
+        # otherwise read DOWN forever in the fleet view.  The fresh
+        # standby spawned below gets a new port of its own.
+        sb_port = self._standby_tports.pop(index, None)
+        if sb_port is not None:
+            self.telemetry_ports[index] = sb_port
+            self._standby_tports[index] = _free_port(self.host)
+        else:
+            self.telemetry_ports.pop(index, None)
         # the minted epoch reaches the wire through the announce below
         # -- the same accounting point as the fenced relaunch path
         _bump("fence_epoch_bumps")
@@ -1556,6 +1618,8 @@ class ShardGroup:
                     rec.proc.kill()
                 return
             _bump("shards_restarted")
+            _flight.note("shard_restart", shard=int(index),
+                         restarts=rec.restarts)
             # the child announces what it recovered: resumed_from is the
             # checkpointed k it came back at (None = fresh model, e.g.
             # death before the first cadence checkpoint)

@@ -41,8 +41,10 @@ from __future__ import annotations
 
 import socket
 import threading
+import weakref
 from typing import Dict, List, Optional, Sequence, Set
 
+from asyncframework_tpu.metrics import flightrec as _flight
 from asyncframework_tpu.utils.clock import Clock, SystemClock
 
 # states a logical worker (shard slot) moves through
@@ -84,6 +86,20 @@ def bump_total(key: str, n: int = 1) -> None:
         _totals[key] = _totals.get(key, 0) + n
 
 
+#: weak registry of running supervisors in this process: the cluster
+#: observer (metrics/observer.py) walks it to discover worker-role
+#: scrape targets from membership (HELLO ``mport``) without holding any
+#: supervisor alive past its own stop()
+_active_lock = threading.Lock()
+_active_sups: "List[weakref.ref]" = []
+
+
+def active_supervisors() -> List["ElasticSupervisor"]:
+    with _active_lock:
+        out = [ref() for ref in _active_sups]
+        return [s for s in out if s is not None]
+
+
 def _pid_alive(pid) -> bool:
     """checkpoint.py's pid probe, hardened against junk pids from the
     wire (one probe implementation for the whole repo)."""
@@ -117,13 +133,19 @@ def proc_start_time(pid) -> Optional[float]:
 
 class _ProcRecord:
     __slots__ = ("token", "pid", "pid_is_local", "pid_start",
-                 "registered_ms", "last_contact_ms", "exited")
+                 "registered_ms", "last_contact_ms", "exited",
+                 "host", "mport")
 
     def __init__(self, token: str, now_ms: float, pid: Optional[int] = None,
                  host: Optional[str] = None,
-                 pid_start: Optional[float] = None):
+                 pid_start: Optional[float] = None,
+                 mport: Optional[int] = None):
         self.token = token
         self.pid = pid
+        self.host = host
+        # the member's telemetry endpoint (HELLO ``mport``): the cluster
+        # observer discovers per-worker scrape targets from membership
+        self.mport = int(mport) if mport else None
         # a pid is only probeable when the peer runs on THIS host; trusting
         # a remote pid would test an unrelated local process
         self.pid_is_local = (
@@ -281,6 +303,8 @@ class ElasticSupervisor:
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "ElasticSupervisor":
+        with _active_lock:
+            _active_sups.append(weakref.ref(self))
         self._thread = threading.Thread(
             target=self._run, name="elastic-supervisor", daemon=True
         )
@@ -288,6 +312,9 @@ class ElasticSupervisor:
         return self
 
     def stop(self) -> None:
+        with _active_lock:
+            _active_sups[:] = [r for r in _active_sups
+                               if r() is not None and r() is not self]
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -299,17 +326,20 @@ class ElasticSupervisor:
     # ------------------------------------------------------------ membership
     def register(self, proc: str, wids: Sequence[int],
                  pid: Optional[int] = None, host: Optional[str] = None,
-                 pid_start: Optional[float] = None) -> None:
+                 pid_start: Optional[float] = None,
+                 mport: Optional[int] = None) -> None:
         """HELLO: ``proc`` claims ``wids`` and is GRANTED a lease (renewed
         by any op via :meth:`touch`; expiry past ``lease_s`` of silence
         declares death).  A claim over a wid someone else currently
         serves is a REJOIN -- the old server's surrogate loop is deposed
         (it learns via RELEASED on its next pull).  ``pid_start`` is the
-        member's own /proc start time (pid-reuse protection)."""
+        member's own /proc start time (pid-reuse protection); ``mport``
+        its telemetry port (observer discovery)."""
         now = self._clock.now_ms()
         with self._lock:
             self._procs[proc] = _ProcRecord(proc, now, pid=pid, host=host,
-                                            pid_start=pid_start)
+                                            pid_start=pid_start,
+                                            mport=mport)
             self.leases_granted += 1
             for wid in wids:
                 wid = int(wid)
@@ -592,6 +622,12 @@ class ElasticSupervisor:
                     self._pending.setdefault(adopter, {})[wid] = now
                     self.shards_adopted += 1
                     bump_total("shards_adopted")
+        for wid in newly_dead:
+            # flight-recorder breadcrumb, outside the membership lock: a
+            # post-mortem dump shows WHO this process declared dead and
+            # when (no-op when no recorder is installed)
+            _flight.note("member_dead", wid=int(wid),
+                         adopt=bool(self._adopt))
         return newly_dead
 
     # ----------------------------------------------------------- diagnostics
@@ -606,6 +642,16 @@ class ElasticSupervisor:
                 "lease_expiries": self.lease_expiries,
                 "leases_granted": self.leases_granted,
             }
+
+    def proc_records(self) -> List[Dict]:
+        """Per-registered-process view (observer discovery): token, pid,
+        host, telemetry port, exit flag."""
+        with self._lock:
+            return [
+                {"proc": rec.token, "pid": rec.pid, "host": rec.host,
+                 "mport": rec.mport, "exited": rec.exited}
+                for rec in self._procs.values()
+            ]
 
     def membership(self) -> Dict[int, Dict]:
         """Per-worker view for the PS's wait_done diagnostic: effective

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from asyncframework_tpu.metrics import flightrec as _flight
 from asyncframework_tpu.net import RetryPolicy
 from asyncframework_tpu.net import frame as _frame
 from asyncframework_tpu.net.health import RttSuspector
@@ -319,6 +320,10 @@ class ServingFrontend(FramedServer):
                                              ok=False)
                     if not first_try or len(rotation) > 1:
                         smetrics.bump("failovers")
+                        # flight-recorder breadcrumb: a frontend dump
+                        # ends with which replica it last failed over
+                        # from (no-op when no recorder is installed)
+                        _flight.note("failover", endpoint=ch.endpoint)
                     first_try = False
                     continue
                 first_try = False

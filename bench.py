@@ -182,6 +182,79 @@ def telemetry_block(trajectory, updates_per_sec) -> dict:
     return out
 
 
+class ArmObserver:
+    """Per-arm cluster-observer harness for the DCN bench: a bare
+    telemetry server (role ``ps`` -- the in-process PS registers its
+    ``ps`` series source and ``ps_workers`` section there) scraped by a
+    real ClusterObserver over HTTP while the arm runs, so every
+    BENCH_*.json dcn arm carries the fleet series + derived signals the
+    observer would have seen (ISSUE 14).  Never-dark: any failure
+    becomes an ``{"error": ...}`` block, not a hole."""
+
+    SERIES_KEEP = ("ps.accepted", "ps.queue_depth", "ps.max_staleness",
+                   "observer.push_rate", "observer.merge_queue_depth",
+                   "observer.straggler_score")
+
+    def __init__(self):
+        self.err = None
+        self.srv = self.obs = None
+        self._scrapes0 = 0
+        try:
+            from asyncframework_tpu.metrics.live import LiveUIServer
+            from asyncframework_tpu.metrics.observer import (
+                ClusterObserver,
+                RoleTarget,
+                observer_totals,
+            )
+
+            # process-global counter: delta it so each arm reports its
+            # OWN scrape count, not the run's cumulative one
+            self._scrapes0 = observer_totals().get("scrapes", 0)
+            self.srv = LiveUIServer(None, port=0, role="ps").start()
+            self.obs = ClusterObserver(
+                targets=[RoleTarget(
+                    "ps", "ps", f"http://127.0.0.1:{self.srv.port}")],
+                interval_s=0.25, history_dir="", persist_s=0.0,
+            ).start()
+        except Exception as e:  # noqa: BLE001 - never-dark per arm
+            self.err = f"{type(e).__name__}: {str(e)[:120]}"
+
+    def finish(self) -> dict:
+        if self.err is not None or self.obs is None:
+            if self.srv is not None:
+                self.srv.stop()
+            return {"error": self.err or "observer harness unavailable"}
+        try:
+            self.obs.scrape_once()  # final fold before teardown
+            snap = self.obs.fleet_snapshot()
+            series = {}
+            for role in self.obs.history.roles():
+                per = self.obs.history.series_of(role)
+                for key in self.SERIES_KEEP:
+                    pts = per.get(key)
+                    if pts:
+                        series[f"{role}:{key}"] = {
+                            "points": len(pts),
+                            "first": pts[0][1], "last": pts[-1][1],
+                        }
+            return {
+                "derived": snap.get("derived"),
+                "stragglers": snap.get("stragglers"),
+                "roles_up": (snap.get("derived") or {}).get("roles_up"),
+                "scrapes": ((snap.get("totals") or {}).get("scrapes", 0)
+                            - self._scrapes0),
+                "series": series,
+            }
+        except Exception as e:  # noqa: BLE001 - never-dark per arm
+            return {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+        finally:
+            try:
+                self.obs.stop()
+                self.srv.stop()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+
 # --------------------------------------------------------------------- child
 def arm_watchdog(config_name: str) -> None:
     """Emit a parseable failure line and hard-exit if the process wedges
@@ -566,6 +639,7 @@ def run_dcn_child() -> None:
             ps = ps_dcn.ParameterServer(
                 cfg, c["d"], c["n"], device=devices[0], port=0
             ).start()
+            arm_obs = ArmObserver()  # fleet-series artifact per arm
             shards = {w: ds.shard(w) for w in range(c["nw"])}
             t0 = time.monotonic()
             ps_dcn.run_worker_process(
@@ -574,6 +648,7 @@ def run_dcn_child() -> None:
             )
             done = ps.wait_done(timeout_s=5.0)
             elapsed = time.monotonic() - t0
+            observer_block = arm_obs.finish()
             ps.stop()
             bt = frame.bytes_totals()
             pulls = max(sum(ps.pull_replies.values()), 1)
@@ -602,6 +677,11 @@ def run_dcn_child() -> None:
                 "trace_p50_ms": {
                     st: round(s["p50"], 3) for st, s in stages.items()
                 },
+                # per-arm cluster-observer artifact (ISSUE 14): the
+                # fleet series + derived signals a collector scraped
+                # off this arm's PS while it ran (never-dark: an error
+                # string on failure)
+                "observer": observer_block,
             }
             if depth > 0:
                 rec["pipeline"] = ps_dcn.pipeline_totals()

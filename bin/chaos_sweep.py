@@ -171,15 +171,19 @@ def run_seed(seed: int, args) -> dict:
     # timing) and PARTITION of a shard primary with a warm standby --
     # promotion instead of restart, zombie stream appends REJECT_FENCED,
     # exactly-once across the failover (tests/test_replication.py)
+    # flight-recorder harvest rides every seed too: a worker child is
+    # SIGKILLed mid-run (seeded timing) and the collector must harvest
+    # a dump whose last events straddle the kill and whose push ledger
+    # matches the PS-side accepted_by_wid view (tests/test_observer.py)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
         "tests/test_telemetry.py", "tests/test_shardgroup.py",
         "tests/test_fencing.py", "tests/test_relaycast.py",
-        "tests/test_replication.py",
+        "tests/test_replication.py", "tests/test_observer.py",
         "-q", "-m",
         f"({marker}) or serve or telemetry or shard or fence or relay"
-        f" or repl",
+        f" or repl or observer",
         "-p", "no:cacheprovider",
     ]
     if args.soak:

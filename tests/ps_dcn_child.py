@@ -139,6 +139,27 @@ def main() -> None:
         port = int(os.environ["PS_PORT"])
         pid = int(os.environ["PS_WORKER_ID"])
         nproc = int(os.environ["PS_NUM_WORKER_PROCS"])
+        # observability knobs (tests/test_observer.py): PS_METRICS=1
+        # starts this worker's telemetry endpoint (conf-driven port,
+        # ASYNCTPU_ASYNC_METRICS_PORT=0 for ephemeral) -- which also
+        # installs the crash flight recorder when ASYNCTPU_ASYNC_FLIGHT_DIR
+        # is set -- and announces the bound port as a first stdout line
+        # so the parent can hand it to a collector.
+        if os.environ.get("PS_METRICS") == "1":
+            from asyncframework_tpu.metrics.live import (
+                start_telemetry_from_conf,
+            )
+
+            srv = start_telemetry_from_conf(f"worker-{pid}",
+                                            labels={"proc": str(pid)})
+            print(json.dumps({
+                "metrics_port": srv.port if srv is not None else None,
+            }), flush=True)
+        # chaos fabric by env, like the daemons (no-op when the conf key
+        # is empty): lets a test DELAY-inject one worker child
+        from asyncframework_tpu.net import faults
+
+        faults.maybe_install_from_conf()
         devices = jax.devices()
         ds = dataset(devices)
         if os.environ.get("PS_WIDS"):

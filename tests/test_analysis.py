@@ -504,6 +504,40 @@ class TestMetricsRule:
         result = run_lint(REPO, rules=["metrics"])
         assert result.findings == [], [f.format() for f in result.findings]
 
+    def test_series_family_undeclared_fires(self, tmp_path):
+        """metrics-series-family (ISSUE 14): every literal series key --
+        a register_source family, a record_flat prefix, a dotted record
+        key -- must carry a family declared in metrics/registry.py."""
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/rogue_series.py":
+                'from asyncframework_tpu.metrics import timeseries\n'
+                'timeseries.register_source("roguefam", lambda: {})\n'
+                'def f(st):\n'
+                '    st.record_flat("rogueflat", {"a": 1})\n'
+                '    st.record("roguekey.metric", 1.0)\n'
+                '    st.record("ps.accepted", 1.0)\n'       # declared
+                '    dedup.record(header, reply)\n'          # not a key
+                '    cal.record(5, 1.0)\n',                  # not a str
+        })
+        toks = rule_tokens(rules_metrics.check(ctx),
+                           "metrics-series-family")
+        assert toks == ["roguefam", "rogueflat", "roguekey"]
+
+    def test_series_family_mutation_deleting_declaration_fails(
+            self, monkeypatch):
+        """Acceptance mutation: un-declare the ``ps`` dynamic family ->
+        the REAL tree's PS register_source site becomes a finding."""
+        from asyncframework_tpu.metrics import registry
+
+        full = registry.series_families()
+        mutated = tuple(f for f in full if f != "ps")
+        monkeypatch.setattr(registry, "series_families", lambda: mutated)
+        result = run_lint(REPO, rules=["metrics"])
+        toks = rule_tokens(result.findings, "metrics-series-family")
+        assert "ps" in toks, [f.format() for f in result.findings]
+        assert any("ps_dcn" in f.path for f in result.findings
+                   if f.rule == "metrics-series-family")
+
 
 # ------------------------------------------------- allowlist + whole tree
 class TestAllowlistPolicy:

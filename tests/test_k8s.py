@@ -215,3 +215,81 @@ class TestClusterBundle:
         assert out.returncode == 0, out.stderr
         (job,) = _load_all((tmp_path / "app-eps.yaml").read_text())
         assert job["kind"] == "Job"
+
+
+class TestObserverRendering:
+    """Cluster-observer tier (ISSUE 14): collector Deployment +
+    fleet-view Service + run-history PVC + one metrics Service per
+    scraped role, consuming the PR 7 scrape wiring (METRICS_PORT env +
+    annotations the pod templates already ship)."""
+
+    def test_render_observer_objects(self):
+        objs = k8s.render_observer()
+        kinds = [(o["kind"], o["metadata"]["name"]) for o in objs]
+        # one metrics Service per default scrape app
+        metric_svcs = [n for (kind, n) in kinds
+                       if kind == "Service"
+                       and n.startswith("async-metrics-")]
+        assert len(metric_svcs) == len(k8s.OBSERVER_SCRAPE_APPS)
+        assert ("PersistentVolumeClaim",
+                "async-observer-history") in kinds
+        assert ("Deployment", "async-observer") in kinds
+        assert ("Service", "async-observer") in kinds
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        assert dep["spec"]["replicas"] == 1  # ONE history-store writer
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        cmd = c["command"]
+        assert "asyncframework_tpu.metrics.observer" in cmd
+        ep = cmd[cmd.index("--endpoints") + 1]
+        # every metrics Service appears in the collector's target list
+        # at the telemetry port the pods actually listen on
+        for (name, role, _app) in k8s.OBSERVER_SCRAPE_APPS:
+            assert (f"{name}={role}@async-metrics-{name}:"
+                    f"{k8s.METRICS_PORT}") in ep
+        assert cmd[cmd.index("--history-dir") + 1] == "/history"
+        assert any(v["mountPath"] == "/history"
+                   for v in c["volumeMounts"])
+        # the metrics Services route the SAME port the pod wiring binds
+        for o in objs:
+            if o["kind"] == "Service" and \
+                    o["metadata"]["name"].startswith("async-metrics-"):
+                (port,) = o["spec"]["ports"]
+                assert port["port"] == k8s.METRICS_PORT
+                assert port["targetPort"] == k8s.METRICS_PORT
+
+    def test_metrics_services_select_the_annotated_pods(self):
+        """The consumed wiring is real: each metrics Service's selector
+        matches a pod template that carries the scrape annotations and
+        the telemetry-port env."""
+        rendered = (k8s.render_master() + k8s.render_workers(2)
+                    + k8s.render_serving(2, "ps:1"))
+        pods = {}
+        for o in rendered:
+            if o["kind"] in ("Deployment", "StatefulSet"):
+                tpl = o["spec"]["template"]
+                pods[tpl["metadata"]["labels"]["app"]] = tpl
+        for o in k8s.render_observer():
+            if o["kind"] != "Service" or not \
+                    o["metadata"]["name"].startswith("async-metrics-"):
+                continue
+            app = o["spec"]["selector"]["app"]
+            assert app in pods, f"metrics Service selects unknown {app}"
+            tpl = pods[app]
+            assert tpl["metadata"]["annotations"][
+                "prometheus.io/port"] == str(k8s.METRICS_PORT)
+            env = {e["name"]: e["value"] for c in
+                   tpl["spec"]["containers"] for e in c.get("env", [])}
+            assert env["ASYNCTPU_ASYNC_METRICS_PORT"] == \
+                str(k8s.METRICS_PORT)
+
+    def test_cluster_bundle_with_observer_and_shards(self):
+        files = k8s.render_cluster(2, observer=True, ps_shards=2,
+                                   ps_d=16, ps_n=1024)
+        assert "observer.yaml" in files
+        objs = _load_all(files["observer.yaml"])
+        names = {o["metadata"]["name"] for o in objs}
+        # per-shard metrics Services ride along when shards render
+        assert "async-metrics-ps-shard-0" in names
+        assert "async-metrics-ps-shard-1" in names
+        # and without the flag nothing observer-shaped renders
+        assert "observer.yaml" not in k8s.render_cluster(2)

@@ -1,0 +1,944 @@
+"""Cluster observer + crash flight recorder (ISSUE 14).
+
+The correctness spine:
+
+- the collector turns thirteen PRs of per-process telemetry into ONE
+  queryable system: discovery (static endpoints, the active ShardGroup's
+  pre-assigned telemetry ports, supervisor membership carrying HELLO
+  ``mport``), scrapes over the net/ retry plane, per-run per-role
+  compacted history that outlives processes, and cross-role derived
+  signals (straggler scores vs the peer median, merge-queue depth vs
+  push rate, fleet freshness);
+- the flight recorder's dump is at most one flush stale, so even an
+  uncatchable SIGKILL leaves a post-mortem whose last events straddle
+  the kill and whose push ledger checks out against the PS-side
+  accepted_by_wid view (the chaos rider: every ``bin/chaos_sweep.py``
+  seed SIGKILLs a worker child at a seeded point and harvests);
+- THE acceptance (real processes): 2 workers + a 2-shard PS group + a
+  serving replica under a seeded chaos schedule -- the run-history
+  store reconstructs per-role throughput/staleness series ACROSS a
+  shard failover, the straggler score flags the DELAY-injected worker,
+  and the SIGKILLed worker's flight dump is harvested non-empty.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu import conf as conf_mod
+from asyncframework_tpu.conf import AsyncConf, set_global_conf
+from asyncframework_tpu.metrics import flightrec, live, observer, timeseries
+from asyncframework_tpu.metrics.live import LiveUIServer
+from asyncframework_tpu.metrics.observer import (
+    ClusterObserver,
+    RoleTarget,
+    RunHistoryStore,
+    parse_endpoints,
+    straggler_scores,
+)
+from asyncframework_tpu.metrics.top import render_fleet
+from asyncframework_tpu.net import faults, reset_net_totals
+from asyncframework_tpu.net.retry import reset_breakers
+from asyncframework_tpu.parallel import ps_dcn
+from asyncframework_tpu.parallel import shardgroup as sg
+from asyncframework_tpu.parallel import supervisor as sup_mod
+from asyncframework_tpu.solvers import SolverConfig
+
+pytestmark = pytest.mark.observer
+
+CHILD = Path(__file__).parent / "ps_dcn_child.py"
+CHAOS_SEED = int(os.environ.get("ASYNC_CHAOS_SEED", "7"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    conf = AsyncConf()
+    conf.set("async.metrics.interval.s", 0.25)
+    set_global_conf(conf)
+    reset_net_totals()
+    reset_breakers()
+    observer.reset_observer_totals()
+    flightrec.reset_flight_totals()
+    flightrec.uninstall()
+    yield
+    flightrec.uninstall()
+    timeseries.stop_sampler()
+    set_global_conf(None)
+
+
+# ------------------------------------------------------------- pure units
+class TestParseEndpoints:
+    def test_grammar_forms(self):
+        ts = parse_endpoints(
+            "ps=ps@127.0.0.1:1234; w0=worker@h:2345, h:999 ;; "
+        )
+        assert [(t.name, t.role) for t in ts] == [
+            ("ps", "ps"), ("w0", "worker"), ("process", "process")]
+        assert ts[0].url == "http://127.0.0.1:1234"
+        assert ts[2].url == "http://h:999"
+
+    def test_role_without_name_names_the_target(self):
+        (t,) = parse_endpoints("worker@h:1")
+        assert t.name == "worker" and t.role == "worker"
+
+
+class TestStragglerScores:
+    def test_flags_outlier_vs_peer_median(self):
+        out = straggler_scores(
+            {"0": {"interval_ms": 10.0}, "1": {"interval_ms": 10.0},
+             "2": {"interval_ms": 100.0}}, factor=2.5)
+        assert out["2"]["flagged"] and out["2"]["score"] == 10.0
+        assert not out["0"]["flagged"] and not out["1"]["flagged"]
+
+    def test_two_worker_cohort_still_flags(self):
+        """Peer-median (excluding self): an inclusive median would cap
+        every 2-worker ratio below 2 -- a 10x straggler must flag."""
+        out = straggler_scores(
+            {"0": {"interval_ms": 10.0}, "1": {"interval_ms": 100.0}},
+            factor=2.5)
+        assert out["1"]["flagged"] and out["1"]["score"] == 10.0
+
+    def test_single_worker_and_junk_dims_score_none(self):
+        out = straggler_scores({"0": {"interval_ms": 10.0}})
+        assert out["0"]["score"] is None and not out["0"]["flagged"]
+        out = straggler_scores(
+            {"0": {"interval_ms": "x"}, "1": {"other": 1.0}})
+        assert all(v["score"] is None for v in out.values())
+
+    def test_max_over_dims_wins_and_staleness_is_smoothed(self):
+        out = straggler_scores(
+            {"0": {"interval_ms": 10.0, "staleness": 1.0},
+             "1": {"interval_ms": 10.0, "staleness": 28.0}}, factor=2.5)
+        # staleness rides +2 additive smoothing: (28+2)/(1+2) = 10
+        assert out["1"]["score"] == 10.0
+        assert out["1"]["dims"]["staleness"] == 10.0
+        # healthy small-integer staleness jitter (3 vs 1) stays calm:
+        # (3+2)/(1+2) < 2.5 -- the noise that must never flag
+        calm = straggler_scores(
+            {"0": {"staleness": 1.0}, "1": {"staleness": 1.0},
+             "2": {"staleness": 3.0}}, factor=2.5)
+        assert not calm["2"]["flagged"]
+
+
+class TestDefaultFleetRules:
+    def test_default_rules_include_observer_family(self):
+        from asyncframework_tpu.metrics.slo import parse_rules
+
+        rules = parse_rules(str(AsyncConf().get(conf_mod.SLO_RULES)))
+        by_name = {r.name: r for r in rules}
+        assert "fleet_stragglers" in by_name
+        assert by_name["fleet_stragglers"].series == \
+            "observer.straggler_score"
+        assert by_name["fleet_stragglers"].unless_series == \
+            "observer.fleet_done"
+        assert "fleet_freshness" in by_name and "fleet_roles" in by_name
+
+    def test_series_families_declares_observer_and_dynamics(self):
+        from asyncframework_tpu.metrics import registry
+
+        fams = registry.series_families()
+        for name in ("observer", "flight", "ps", "ps_shards", "serving",
+                     "trace", "convergence"):
+            assert name in fams, name
+
+
+# -------------------------------------------------------- run-history store
+class TestRunHistoryStore:
+    def test_compaction_spans_whole_run_at_bounded_size(self):
+        h = RunHistoryStore(None, "r", points=32)
+        for i in range(10_000):
+            h.record("ps", "ps.accepted", float(i), float(2 * i))
+        pts = h.series_of("ps")["ps.accepted"]
+        assert len(pts) < 64  # bounded
+        assert pts[0][0] == 0.0  # the start survives compaction
+        assert pts[-1][0] > 9000.0  # and the tail is recent
+
+    def test_persist_load_roundtrip_and_index(self, tmp_path):
+        root = tmp_path / "hist"
+        h = RunHistoryStore(str(root), "runA", points=32)
+        h.note_role("ps", "ps", "http://x:1")
+        for i in range(50):
+            h.record("ps", "ps.accepted", float(i), float(i))
+        dump = {"role": "worker-0", "dumped_s": 1.0,
+                "events": [{"t": 1.0, "kind": "push"}]}
+        assert h.harvest(dump, source="flight-worker-0-1.json")
+        # same dumped_s = stale copy: not re-harvested
+        assert not h.harvest(dict(dump), source="flight-worker-0-1.json")
+        # fresher overwrite of the same file IS re-harvested
+        assert h.harvest(dict(dump, dumped_s=2.0),
+                         source="flight-worker-0-1.json")
+        rd = h.persist()
+        run = observer.load_run(rd)
+        assert run["meta"]["run_id"] == "runA"
+        assert run["roles"]["ps"]["series"]["ps.accepted"]
+        assert list(run["flight"]) == ["flight-worker-0-1.json"]
+        assert run["flight"]["flight-worker-0-1.json"]["dumped_s"] == 2.0
+        assert observer.list_runs(str(root)) == [rd]
+        # bin/async-history renders an index section over observer runs
+        from asyncframework_tpu.metrics.history import build_history
+
+        index = build_history(root)
+        text = index.read_text()
+        assert "Observer run history" in text and "runA" in text
+
+    def test_memory_only_mode_never_writes(self):
+        h = RunHistoryStore(None, "r")
+        h.record("ps", "ps.accepted", 0.0, 1.0)
+        assert h.persist() is None and h.run_dir is None
+
+    def test_persist_skips_unchanged_flight_dumps(self, tmp_path):
+        """Dirty tracking: an unchanged dump is not re-serialized on the
+        next persist cycle (steady-state persist cost on a long run)."""
+        h = RunHistoryStore(str(tmp_path), "r", points=32)
+        h.harvest({"role": "w", "dumped_s": 1.0, "events": [{}]},
+                  source="flight-w-1.json")
+        rd = h.persist()
+        dump_path = Path(rd) / "flight" / "flight-w-1.json"
+        first_stat = dump_path.stat()
+        time.sleep(0.05)
+        h.record("ps", "ps.accepted", 0.0, 1.0)  # other state moves
+        h.persist()
+        assert dump_path.stat().st_mtime_ns == first_stat.st_mtime_ns
+        # meta still lists the dump even on a no-rewrite cycle
+        run = observer.load_run(rd)
+        assert run["meta"]["flight_dumps"] == ["flight-w-1.json"]
+        # a FRESHER harvest is re-written
+        h.harvest({"role": "w", "dumped_s": 2.0, "events": [{}]},
+                  source="flight-w-1.json")
+        h.persist()
+        assert dump_path.stat().st_mtime_ns != first_stat.st_mtime_ns
+
+    def test_series_cap_counts_drops(self):
+        h = RunHistoryStore(None, "r", points=16)
+        h.MAX_SERIES_PER_ROLE = 4
+        for i in range(10):
+            h.record("ps", f"ps.k{i}", 0.0, 1.0)
+        assert len(h.series_of("ps")) == 4
+        assert h.series_dropped == 6
+
+
+# ------------------------------------------------------------ flight recorder
+class TestFlightRecorder:
+    def test_ring_bounds_and_dump_roundtrip(self, tmp_path):
+        rec = flightrec.FlightRecorder("w", str(tmp_path), capacity=16,
+                                       flush_s=0.0)
+        for i in range(40):
+            rec.note("push", wid=0, n=i)
+        path = rec.dump("manual")
+        data = flightrec.load_dump(path)
+        assert data["role"] == "w" and data["reason"] == "manual"
+        assert len(data["events"]) == 16  # bounded
+        assert data["dropped"] == 24 and data["seq"] == 40
+        assert data["events"][-1]["n"] == 39  # newest survive
+
+    def test_periodic_flush_and_counter_deltas(self, tmp_path):
+        rec = flightrec.install("w", str(tmp_path), capacity=64,
+                                flush_s=0.1)
+        flightrec.note("push", wid=1, n=1)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if flightrec.scan_dumps(str(tmp_path)):
+                break
+            time.sleep(0.1)
+        paths = flightrec.scan_dumps(str(tmp_path))
+        assert paths, "periodic flush never wrote a dump"
+        data = flightrec.load_dump(paths[0])
+        kinds = {e["kind"] for e in data["events"]}
+        assert "push" in kinds
+        # our own flight meta-counters never feed the delta event (each
+        # flush would otherwise generate the next flush's delta forever)
+        for ev in data["events"]:
+            if ev["kind"] == "counters":
+                assert not any(k.startswith("flight.")
+                               for k in ev["delta"])
+
+    def test_install_from_conf_gating(self, tmp_path):
+        conf = AsyncConf()
+        set_global_conf(conf)
+        assert flightrec.install_from_conf("w") is None  # dir empty = off
+        conf.set("async.flight.dir", str(tmp_path))
+        conf.set("async.flight.flush.s", 0.0)
+        rec = flightrec.install_from_conf("w")
+        assert rec is not None
+        # idempotent: one process, one recorder identity
+        assert flightrec.install_from_conf("other") is rec
+
+    def test_note_is_noop_when_uninstalled(self):
+        assert flightrec.recorder() is None
+        flightrec.note("push", wid=0)  # must not raise
+        assert flightrec.flight_totals()["notes"] == 0
+
+    def test_harvest_skips_previous_runs_stale_dumps(self, tmp_path):
+        """A collector restarted against yesterday's flight dir must not
+        attribute yesterday's crashes to today's run: dumps last written
+        long before the collector started are skipped (counted)."""
+        stale = {"schema": 1, "role": "w", "pid": 1,
+                 "dumped_s": time.time() - 3600.0,
+                 "events": [{"t": 1.0, "kind": "push"}]}
+        (tmp_path / "flight-w-1.json").write_text(json.dumps(stale))
+        fresh = dict(stale, dumped_s=time.time(), pid=2)
+        (tmp_path / "flight-w-2.json").write_text(json.dumps(fresh))
+        obs = ClusterObserver(interval_s=0.0, history_dir="",
+                              flight_dirs=[str(tmp_path)])
+        assert obs.harvest_flight() == 1
+        assert list(obs.history.flight_dumps()) == ["flight-w-2.json"]
+        assert observer.observer_totals()["harvest_stale_skipped"] == 1
+
+    def test_scan_ignores_foreign_files(self, tmp_path):
+        (tmp_path / "flight-w-1.json").write_text("{}")  # no events key
+        (tmp_path / "other.json").write_text("{}")
+        paths = flightrec.scan_dumps(str(tmp_path))
+        assert [os.path.basename(p) for p in paths] == ["flight-w-1.json"]
+        with pytest.raises(ValueError):
+            flightrec.load_dump(paths[0])
+
+
+# -------------------------------------------------- status-section plumbing
+class TestStatusSections:
+    def test_register_appears_and_unregister_is_identity_gated(self):
+        fn_a = lambda: {"a": 1}  # noqa: E731
+        fn_b = lambda: {"b": 2}  # noqa: E731
+        live.register_status_section("obs_test", fn_a)
+        try:
+            assert live.process_status()["obs_test"] == {"a": 1}
+            live.register_status_section("obs_test", fn_b)  # last wins
+            assert live.process_status()["obs_test"] == {"b": 2}
+            live.unregister_status_section("obs_test", fn_a)  # stale: no-op
+            assert live.process_status()["obs_test"] == {"b": 2}
+        finally:
+            live.unregister_status_section("obs_test")
+        assert "obs_test" not in live.process_status()
+
+    def test_raising_section_does_not_500_status(self):
+        def bad():
+            raise RuntimeError("boom")
+
+        live.register_status_section("obs_bad", bad)
+        try:
+            status = live.process_status()
+            assert "obs_bad" not in status and "counters" in status
+        finally:
+            live.unregister_status_section("obs_bad")
+
+
+class TestDiscovery:
+    def test_supervisor_membership_carries_mport(self):
+        sup = sup_mod.ElasticSupervisor(2, dead_after_s=5.0).start()
+        try:
+            sup.register("proc-a", [0], pid=os.getpid(),
+                         host="127.0.0.1", mport=12345)
+            assert sup in sup_mod.active_supervisors()
+            recs = {r["proc"]: r for r in sup.proc_records()}
+            assert recs["proc-a"]["mport"] == 12345
+            obs = ClusterObserver(interval_s=0.0, history_dir="")
+            names = {t.name: t for t in obs.targets()}
+            assert "worker-proc-a" in names
+            assert names["worker-proc-a"].url == "http://127.0.0.1:12345"
+            # "discovered" counts roles, not ticks: a second discovery
+            # pass over the same membership bumps nothing
+            n0 = observer.observer_totals()["discovered"]
+            obs.targets()
+            assert observer.observer_totals()["discovered"] == n0
+        finally:
+            sup.stop()
+        assert sup not in sup_mod.active_supervisors()
+
+    def test_span_only_worker_never_enters_wstats(self, devices8):
+        """A booting worker's first piggybacked span must not mint a
+        span-only stats entry (no accepted count -> it would bypass the
+        straggler warm-up guard and flag on one EWMA sample)."""
+        from asyncframework_tpu.metrics import trace as trace_mod
+
+        cfg = _small_cfg(num_iterations=10)
+        ps = ps_dcn.ParameterServer(cfg, 4, 32, device=devices8[0],
+                                    port=0).start()
+        try:
+            span = trace_mod.Span(
+                stage=trace_mod.COMPUTE, trace_id="t", span_id="s",
+                parent_id=None, worker_id=3, model_version=0,
+                start_ms=0.0, dur_ms=3000.0)
+            ps._wstat_span(span)
+            assert ps.worker_stats() == {}
+            # once the drain created the entry, spans fold into it
+            ps._wstat_merge(3, staleness=1, accepted=True)
+            ps._wstat_span(span)
+            assert "compute_ms" in ps.worker_stats()["3"]
+        finally:
+            ps.stop()
+
+    def test_hello_advertises_local_telemetry_port(self, devices8):
+        """End-to-end: a worker process serving telemetry HELLOs its
+        mport; the PS supervisor records it."""
+        cfg = SolverConfig(num_workers=2, num_iterations=10, gamma=0.5,
+                           taw=2**31 - 1, batch_rate=0.5,
+                           bucket_ratio=0.0, printer_freq=5, seed=42,
+                           calibration_iters=10**9, run_timeout_s=30.0)
+        sup = sup_mod.ElasticSupervisor(2, dead_after_s=30.0)
+        ps = ps_dcn.ParameterServer(cfg, 4, 32, device=devices8[0],
+                                    port=0, supervisor=sup).start()
+        srv = LiveUIServer(None, port=0, role="worker").start()
+        try:
+            assert live.telemetry_port() == srv.port
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port)
+            cl.hello("tele-proc", [0], pid=os.getpid())
+            cl.bye()
+            recs = {r["proc"]: r for r in sup.proc_records()}
+            assert recs["tele-proc"]["mport"] == srv.port
+        finally:
+            srv.stop()
+            ps.stop()
+
+    def test_shardgroup_preassigns_telemetry_ports(self, tmp_path):
+        cfg = SolverConfig(num_workers=2, num_iterations=10, gamma=0.5,
+                           taw=2**31 - 1, batch_rate=0.5,
+                           bucket_ratio=0.5, printer_freq=5, seed=42)
+        group = sg.ShardGroup(cfg, 8, 64, 2, telemetry_ports="auto")
+        targets = group.telemetry_targets()
+        assert [t[0] for t in targets] == ["ps-shard-0", "ps-shard-1"]
+        assert all(r == "ps" for (_n, r, _u) in targets)
+        ports = {int(u.rsplit(":", 1)[1]) for (_n, _r, u) in targets}
+        assert len(ports) == 2 and all(p > 0 for p in ports)
+        env = group._child_env(0, 0)
+        assert env["ASYNCTPU_ASYNC_METRICS_PORT"] == str(
+            group.telemetry_ports[0])
+        # a default group pins nothing and injects nothing
+        plain = sg.ShardGroup(cfg, 8, 64, 2)
+        assert plain.telemetry_targets() == []
+
+    def test_standby_gets_own_port_for_promotion_handoff(self):
+        """With standbys on, auto mode assigns each slot a SECOND port
+        for its standby (two processes cannot share one bind) and
+        injects it into standby spawns -- the port _promote() hands to
+        the slot so the role's scrape URL follows the serving member
+        instead of pointing at the dead primary forever."""
+        cfg = SolverConfig(num_workers=2, num_iterations=10, gamma=0.5,
+                           taw=2**31 - 1, batch_rate=0.5,
+                           bucket_ratio=0.5, printer_freq=5, seed=42)
+        group = sg.ShardGroup(cfg, 8, 64, 2, standbys=1,
+                              telemetry_ports="auto",
+                              conf_overlays={"async.fence.enabled": True,
+                                             "async.ps.standby": 1})
+        prim = set(group.telemetry_ports.values())
+        sbs = set(group._standby_tports.values())
+        assert len(prim) == 2 and len(sbs) == 2 and not (prim & sbs)
+        env = group._child_env(1, 0, role="standby")
+        assert env["ASYNCTPU_ASYNC_METRICS_PORT"] == str(
+            group._standby_tports[1])
+
+
+class TestRenderFleet:
+    def test_render_fleet_pure(self):
+        snap = {
+            "roles": {
+                "ps-shard-0": {"role": "ps", "up": True, "health": "ok",
+                               "accepted": 120, "staleness": 3},
+                "worker-w1": {"role": "worker", "up": False,
+                              "errors": 4},
+            },
+            "derived": {"roles_up": 1, "roles_down": 1,
+                        "push_rate": 42.5, "merge_queue_depth": 2,
+                        "straggler_score": 3.2, "fleet_done": 0},
+            "stragglers": {"1": {"score": 3.2,
+                                 "dims": {"interval_ms": 3.2},
+                                 "flagged": True}},
+            "straggler_factor": 2.5,
+            "history": {"run_id": "r1", "roles": {"ps-shard-0": {}},
+                        "flight_dumps": ["flight-w.json"],
+                        "run_dir": None},
+        }
+        text = render_fleet(snap, plain=True)
+        assert "ps-shard-0" in text and "DOWN" in text
+        assert "push_rate=42.5" in text and "straggler_max=3.20" in text
+        assert "w1" in text and "<<" in text  # the flagged marker
+        assert "flight_dumps=1" in text
+
+    def test_async_top_observer_flag_renders_fleet(self):
+        """--observer against a live collector's /api/status."""
+        from asyncframework_tpu.metrics import top as top_mod
+
+        obs = ClusterObserver(interval_s=0.0, history_dir="")
+        obs.start()
+        srv = LiveUIServer(None, port=0, role="observer").start()
+        try:
+            import io
+            from contextlib import redirect_stdout
+
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                rc = top_mod.main([f"--observer",
+                                   f"127.0.0.1:{srv.port}",
+                                   "--once", "--plain"])
+            assert rc == 0
+            assert "fleet view" in buf.getvalue()
+        finally:
+            srv.stop()
+            obs.stop()
+
+
+# ------------------------------------------------------ in-process collector
+def _small_cfg(**kw):
+    defaults = dict(
+        num_workers=4, num_iterations=300, gamma=0.5, taw=2**31 - 1,
+        batch_rate=0.3, bucket_ratio=0.5, printer_freq=50, seed=42,
+        calibration_iters=10, run_timeout_s=60.0, trace_sample=1.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+class TestCollectorInProcess:
+    def test_scrape_folds_series_and_derives_signals(self, devices8):
+        """One real PS run scraped over real HTTP: history series,
+        per-worker stats, derived signals."""
+        from asyncframework_tpu.data.sharded import ShardedDataset
+
+        conf_mod.global_conf().set("async.trace.sample", 1.0)
+        cfg = _small_cfg()
+        d, n = 8, 256
+        ds = ShardedDataset.generate_on_device(
+            n, d, cfg.num_workers, devices=devices8, seed=11, noise=0.01)
+        ps = ps_dcn.ParameterServer(cfg, d, n, device=devices8[0],
+                                    port=0).start()
+        srv = LiveUIServer(None, port=0, role="ps").start()
+        obs = ClusterObserver(
+            targets=[RoleTarget("ps", "ps",
+                                f"http://127.0.0.1:{srv.port}")],
+            interval_s=0.2, history_dir="", persist_s=0.0,
+        ).start()
+        try:
+            shards = {w: ds.shard(w) for w in range(cfg.num_workers)}
+            ps_dcn.run_worker_process(
+                "127.0.0.1", ps.port, list(range(cfg.num_workers)),
+                shards, cfg, d, n, deadline_s=60.0)
+            assert ps.wait_done(timeout_s=10.0)
+            time.sleep(0.6)  # one sampler tick lands the final counters
+            obs.scrape_once()
+            snap = obs.fleet_snapshot()
+            assert snap["roles"]["ps"]["up"]
+            assert snap["roles"]["ps"]["accepted"] == cfg.num_iterations
+            assert snap["derived"]["fleet_done"] == 1.0
+            assert snap["derived"]["roles_up"] == 1.0
+            # per-worker stats flowed PS -> /api/status -> scoring
+            assert len(snap["stragglers"]) == cfg.num_workers
+            wstats = ps.worker_stats()
+            assert sum(st["accepted"] for st in wstats.values()) == \
+                cfg.num_iterations
+            for st in wstats.values():  # spans folded latency dims
+                assert "compute_ms" in st and "rtt_ms" in st
+            hist = obs.history.series_of("ps")
+            assert "ps.accepted" in hist and "ps.queue_depth" in hist
+            assert hist["up"][-1][1] == 1.0
+            # the derived signals are recorded as a role too
+            oh = obs.history.series_of("observer")
+            assert "observer.roles_up" in oh
+            # and the observer source feeds the process-global store
+            timeseries.sample_once()
+            assert timeseries.store().last("observer.roles_up") == 1.0
+        finally:
+            obs.stop()
+            srv.stop()
+            ps.stop()
+
+    def test_derived_signals_follow_the_living_not_the_corpse(self):
+        """A dead role's final status must not keep owning primary
+        selection / fleet_done after a failover (white-box: inject a
+        corpse with the largest ps.accepted next to a live primary)."""
+        obs = ClusterObserver(interval_s=0.0, history_dir="")
+        dead = {"timeseries": {"last": {"ps.accepted": 9999.0,
+                                        "ps.done": 0.0,
+                                        "ps.queue_depth": 50.0}}}
+        live_st = {"timeseries": {"last": {"ps.accepted": 100.0,
+                                           "ps.done": 1.0,
+                                           "ps.queue_depth": 0.0}}}
+        with obs._lock:
+            obs._last_status = {"old-ps": dead, "new-ps": live_st}
+            obs._target_state = {
+                "old-ps": {"role": "ps", "up": False},
+                "new-ps": {"role": "ps", "up": True},
+            }
+        obs._recompute_derived(time.time())
+        d = obs.derived()
+        # the LIVE primary's view wins: done=1, its queue depth
+        assert d["fleet_done"] == 1.0
+        assert d["merge_queue_depth"] == 0.0
+        assert d["roles_down"] == 1.0
+
+    def test_vanished_discovered_target_is_pruned(self):
+        """A discovered target that discovery stops returning (e.g. a
+        promotion moved the role to a new port) drops out of the fleet
+        state instead of reading DOWN forever."""
+        sup = sup_mod.ElasticSupervisor(1, dead_after_s=5.0).start()
+        try:
+            sup.register("p1", [0], pid=os.getpid(), host="127.0.0.1",
+                         mport=19)
+            obs = ClusterObserver(interval_s=0.0, history_dir="")
+            obs.scrape_once()  # discovers worker-p1 (scrape fails; fine)
+            assert "worker-p1" in obs.fleet_snapshot()["roles"]
+        finally:
+            sup.stop()
+        obs.scrape_once()  # supervisor gone: target pruned
+        assert "worker-p1" not in obs.fleet_snapshot()["roles"]
+
+    def test_dead_target_counts_down_and_keeps_scraping(self):
+        obs = ClusterObserver(
+            targets=[RoleTarget("ghost", "worker",
+                                "http://127.0.0.1:9")],
+            interval_s=0.0, history_dir="")
+        res = obs.scrape_once()
+        assert res["ghost"]["ok"] is False
+        snap = obs.fleet_snapshot()
+        assert snap["roles"]["ghost"]["up"] is False
+        assert snap["derived"]["roles_down"] == 1.0
+        pts = obs.history.series_of("ghost")["up"]
+        assert pts[-1][1] == 0.0
+        assert observer.observer_totals()["scrape_errors"] >= 1
+
+
+# ------------------------------------- chaos rider: flight harvest on kill
+class TestFlightHarvestChaos:
+    """Rides every bin/chaos_sweep.py seed: SIGKILL a worker child
+    mid-run at a seeded point; the collector must harvest a dump whose
+    last events straddle the kill and whose push ledger matches the
+    PS-side accepted_by_wid view."""
+
+    NW, D, N = 8, 24, 4096
+    FLUSH_S = 0.2
+
+    def _worker(self, port, wpid, tmp, flight_dir):
+        env = dict(os.environ)
+        env.update({
+            "PS_ROLE": "worker", "PS_PORT": str(port),
+            "PS_WORKER_ID": str(wpid), "PS_NUM_WORKER_PROCS": "2",
+            "PS_NUM_ITER": "1000000", "PS_EVAL": "0",
+            "JAX_PLATFORMS": "cpu",
+            "ASYNCTPU_ASYNC_FLIGHT_DIR": flight_dir,
+            "ASYNCTPU_ASYNC_FLIGHT_FLUSH_S": str(self.FLUSH_S),
+            "PS_METRICS": "1",
+            "ASYNCTPU_ASYNC_METRICS_PORT": "0",
+        })
+        return subprocess.Popen(
+            [sys.executable, str(CHILD)], env=env,
+            stdout=subprocess.PIPE,
+            stderr=open(os.path.join(tmp, f"w{wpid}.stderr.log"), "w"),
+            text=True,
+        )
+
+    def test_sigkill_worker_harvests_straddling_dump(self, tmp_path,
+                                                     devices8):
+        flight_dir = str(tmp_path / "flight")
+        cfg = SolverConfig(
+            num_workers=self.NW, num_iterations=10**6, gamma=1.2,
+            taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.0,
+            printer_freq=50, seed=42, calibration_iters=20,
+            run_timeout_s=120.0,
+        )
+        ps = ps_dcn.ParameterServer(cfg, self.D, self.N,
+                                    device=devices8[0], port=0).start()
+        obs = ClusterObserver(interval_s=0.0, history_dir="",
+                              flight_dirs=[flight_dir])
+        workers = []
+        try:
+            workers = [
+                self._worker(ps.port, 0, str(tmp_path), flight_dir),
+                self._worker(ps.port, 1, str(tmp_path), flight_dir),
+            ]
+            # seeded kill point, gated on the VICTIM's own wids (the
+            # even ones): the other child booting faster must not let
+            # the kill land before the victim pushed anything -- the
+            # dump needs a ledger to check
+            kill_after = 40 + (CHAOS_SEED % 30)
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                victim_acc = sum(c for w, c in
+                                 ps.accepted_by_wid.items()
+                                 if w % 2 == 0)
+                if victim_acc >= kill_after:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("run never reached the seeded kill point")
+            # one flush cadence so the ledger reaches disk pre-kill
+            time.sleep(2 * self.FLUSH_S)
+            victim = workers[0]
+            os.kill(victim.pid, signal.SIGKILL)
+            t_kill = time.time()
+            victim.wait(timeout=30.0)
+            # let the survivors push on: the PS-side view must move PAST
+            # the victim's frozen ledger without the dump moving with it
+            time.sleep(1.0)
+            assert obs.harvest_flight() >= 1, (
+                f"no dump harvested from {flight_dir}: "
+                f"{os.listdir(flight_dir) if os.path.isdir(flight_dir) else 'missing'}"
+            )
+            dumps = obs.history.flight_dumps()
+            victim_dumps = [d for d in dumps.values()
+                            if d.get("pid") == victim.pid]
+            assert victim_dumps, f"harvested dumps: {list(dumps)}"
+            dump = victim_dumps[0]
+            events = dump["events"]
+            assert events, "flight dump has no events"
+            pushes = [e for e in events if e["kind"] == "push"]
+            assert pushes, "no push breadcrumbs in the dump"
+            # the dump STRADDLES the kill: its events end at most one
+            # flush (+ scheduling slack) before the SIGKILL landed, and
+            # span real time before it
+            last_t = max(e["t"] for e in events)
+            first_t = min(e["t"] for e in events)
+            assert last_t <= t_kill + 0.5
+            assert t_kill - last_t < 10 * self.FLUSH_S + 3.0, (
+                f"dump went stale {t_kill - last_t:.2f}s before the kill"
+            )
+            assert first_t < last_t
+            # the push ledger matches the PS-side view: the victim owned
+            # the EVEN wids; for each, its last cumulative count must
+            # not exceed what the PS accepted from that wid, and must be
+            # within one flush window's worth of pushes of it
+            by_wid = {}
+            for e in pushes:
+                by_wid[e["wid"]] = max(by_wid.get(e["wid"], 0), e["n"])
+            assert by_wid, "push events carry no wids"
+            assert all(w % 2 == 0 for w in by_wid)
+            acc = ps.accepted_by_wid
+            checked = 0
+            for wid, n_dump in by_wid.items():
+                ps_n = int(acc.get(wid, 0))
+                assert n_dump <= ps_n + 1, (wid, n_dump, ps_n)
+                assert ps_n - n_dump <= 200, (wid, n_dump, ps_n)
+                checked += 1
+            assert checked >= 1
+        finally:
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            for w in workers:
+                try:
+                    w.wait(timeout=20.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            ps.stop()
+
+
+# ----------------------------------------------- THE acceptance (real procs)
+class TestObserverAcceptance:
+    """Real OS processes end to end: a 2-shard PS group with
+    pre-assigned telemetry ports, two worker processes (one
+    DELAY-injected), an in-process serving replica, and one collector
+    -- through a seeded SIGKILL of a shard child AND of a worker."""
+
+    NW, D, N = 8, 24, 4096
+    FLUSH_S = 0.2
+
+    def _worker(self, port, wpid, tmp, flight_dir, delay_ms=0.0):
+        env = dict(os.environ)
+        env.update({
+            "PS_ROLE": "worker", "PS_PORT": str(port),
+            "PS_WORKER_ID": str(wpid), "PS_NUM_WORKER_PROCS": "2",
+            "PS_NUM_ITER": "1000000", "PS_EVAL": "0",
+            "JAX_PLATFORMS": "cpu",
+            "PS_METRICS": "1",
+            "ASYNCTPU_ASYNC_METRICS_PORT": "0",
+            "ASYNCTPU_ASYNC_METRICS_INTERVAL_S": "0.25",
+            "ASYNCTPU_ASYNC_TRACE_SAMPLE": "1",
+            "ASYNCTPU_ASYNC_FLIGHT_DIR": flight_dir,
+            "ASYNCTPU_ASYNC_FLIGHT_FLUSH_S": str(self.FLUSH_S),
+        })
+        if delay_ms > 0:
+            sched = faults.FaultSchedule(seed=CHAOS_SEED)
+            sched.add_delay("*", "PUSH", delay_ms, count=0)
+            env["ASYNCTPU_ASYNC_NET_FAULT_SCHEDULE"] = sched.to_json()
+        return subprocess.Popen(
+            [sys.executable, str(CHILD)], env=env,
+            stdout=subprocess.PIPE,
+            stderr=open(os.path.join(tmp, f"aw{wpid}.stderr.log"), "w"),
+            text=True,
+        )
+
+    def _await_series(self, obs, role, key, pred, timeout_s, what):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            pts = obs.history.series_of(role).get(key) or []
+            if pts and pred(pts):
+                return pts
+            time.sleep(0.1)
+        pytest.fail(f"{what} (role={role} key={key})")
+
+    def test_acceptance_failover_straggler_flight(self, tmp_path):
+        flight_dir = str(tmp_path / "flight")
+        hist_root = str(tmp_path / "history")
+        cfg = SolverConfig(
+            num_workers=self.NW, num_iterations=10**6, gamma=1.2,
+            taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5,
+            printer_freq=50, seed=42, calibration_iters=20,
+            run_timeout_s=240.0,
+        )
+        group = sg.ShardGroup(
+            cfg, self.D, self.N, 2, checkpoint_dir=str(tmp_path),
+            worker_procs=2, dead_after_s=1.0, check_interval_s=0.2,
+            stderr_dir=str(tmp_path),
+            conf_overlays={"async.metrics.interval.s": 0.25},
+            telemetry_ports="auto",
+        ).start()
+        workers = []
+        rep = None
+        rep_srv = None
+        obs = None
+        try:
+            port0 = group.port_of(0)
+            # the group is the ACTIVE group in this process: the
+            # collector discovers its telemetry targets by itself
+            obs = ClusterObserver(
+                interval_s=0.25, history_dir=hist_root,
+                persist_s=1.0, flight_dirs=[flight_dir],
+            )
+            names = {t.name for t in obs.targets()}
+            assert {"ps-shard-0", "ps-shard-1"} <= names
+            # serving replica (in-process) + its scrape endpoint
+            from asyncframework_tpu.serving.replica import ModelReplica
+
+            rep = ModelReplica("127.0.0.1", port0, rid=0,
+                               host="127.0.0.1",
+                               refresh_interval_s=0.2).start()
+            rep_srv = LiveUIServer(None, port=0, role="replica").start()
+            obs.add_targets([RoleTarget(
+                "replica-0", "replica",
+                f"http://127.0.0.1:{rep_srv.port}")])
+            obs.start()
+            # workers: child 1 is the DELAY-injected straggler (every
+            # PUSH pays the seeded extra latency)
+            workers = [
+                self._worker(port0, 0, str(tmp_path), flight_dir),
+                self._worker(port0, 1, str(tmp_path), flight_dir,
+                             delay_ms=150.0),
+            ]
+            for w in workers:
+                hello = json.loads(w.stdout.readline())
+                assert hello.get("metrics_port"), hello
+                obs.add_targets([RoleTarget(
+                    f"worker-{w.pid}", "worker",
+                    f"http://127.0.0.1:{hello['metrics_port']}")])
+
+            # phase 1: training flows -- the history store sees shard
+            # throughput series from BOTH shards
+            kill_after = 60 + (CHAOS_SEED % 50)
+            for shard_role in ("ps-shard-0", "ps-shard-1"):
+                self._await_series(
+                    obs, shard_role, "ps.accepted",
+                    lambda pts: pts[-1][1] >= kill_after, 120.0,
+                    "shard never reached the seeded kill threshold")
+
+            # phase 2: straggler scoring flags the DELAY-injected
+            # worker's wids (child 1 serves the ODD wids).  The window
+            # bound: once per-worker stats exist, one scrape recomputes
+            # the scores -- so the flag lands within seconds, not a
+            # convergence horizon.
+            deadline = time.monotonic() + 60.0
+            flagged = set()
+            stable = False
+            while time.monotonic() < deadline:
+                snap = obs.fleet_snapshot()
+                flagged = {int(w) for w, s in snap["stragglers"].items()
+                           if s.get("flagged")}
+                # accept the verdict once it points at the injected
+                # cohort only (a single EWMA spike can transiently flag
+                # a healthy worker during boot; the steady state must
+                # name the DELAYed one) -- once eligible stats exist,
+                # each scrape recomputes the scores, so this lands
+                # within one scrape window of the cohort warming up
+                if flagged and flagged <= {1, 3, 5, 7}:
+                    stable = True
+                    break
+                time.sleep(0.25)
+            assert stable, (
+                f"straggler verdict never settled on the DELAY-injected "
+                f"workers; last flagged={flagged} "
+                f"stragglers={snap['stragglers']}")
+            assert snap["derived"]["straggler_score"] >= 2.5
+            assert snap["derived"].get("push_rate") is not None
+
+            # phase 3: SIGKILL shard 1 -> the controller relaunches it
+            # from its checkpoint on the SAME wire + telemetry ports;
+            # the history store reconstructs the series ACROSS the
+            # failover
+            os.kill(group.pid_of(1), signal.SIGKILL)
+            t_kill_shard = time.time()
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline:
+                if group.restarts_of(1) >= 1:
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("shard 1 was never relaunched")
+            # scrapes resume on the same URL: post-restart samples land
+            self._await_series(
+                obs, "ps-shard-1", "ps.accepted",
+                lambda pts: pts[-1][0] > t_kill_shard + 1.0
+                and pts[-1][1] > 0, 120.0,
+                "shard 1 series never resumed after the failover")
+            acc_pts = obs.history.series_of("ps-shard-1")["ps.accepted"]
+            up_pts = obs.history.series_of("ps-shard-1")["up"]
+            assert acc_pts[0][0] < t_kill_shard, \
+                "history lost the pre-failover samples"
+            assert any(t > t_kill_shard for (t, _v) in acc_pts)
+            assert any(v == 0.0 for (_t, v) in up_pts), \
+                "the down window never registered"
+            stale_pts = obs.history.series_of(
+                "ps-shard-1").get("ps.max_staleness")
+            assert stale_pts, "no staleness series for the shard"
+
+            # phase 4: SIGKILL worker 0 -> its flight dump is harvested
+            # non-empty with push breadcrumbs
+            victim = workers[0]
+            os.kill(victim.pid, signal.SIGKILL)
+            t_kill_w = time.time()
+            victim.wait(timeout=30.0)
+            deadline = time.monotonic() + 30.0
+            dump = None
+            while time.monotonic() < deadline:
+                obs.harvest_flight()
+                for d in obs.history.flight_dumps().values():
+                    if d.get("pid") == victim.pid:
+                        dump = d
+                        break
+                if dump is not None:
+                    break
+                time.sleep(0.2)
+            assert dump is not None, "victim's flight dump not harvested"
+            pushes = [e for e in dump["events"] if e["kind"] == "push"]
+            assert pushes, "harvested dump carries no push breadcrumbs"
+            last_t = max(e["t"] for e in dump["events"])
+            assert t_kill_w - last_t < 10 * self.FLUSH_S + 3.0
+
+            # teardown-time durability: everything above survives on disk
+            obs.stop()  # final persist + harvest
+            runs = observer.list_runs(hist_root)
+            assert runs, "nothing persisted under the history root"
+            run = observer.load_run(runs[0])
+            role_names = set(run["roles"])
+            assert {"ps-shard-0", "ps-shard-1"} <= role_names
+            assert any(n.startswith("worker-") for n in role_names)
+            s1 = run["roles"]["ps-shard-1"]["series"]
+            assert "ps.accepted" in s1 and len(s1["ps.accepted"]) >= 2
+            assert run["flight"], "no flight dumps in the persisted run"
+        finally:
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            for w in workers:
+                try:
+                    w.wait(timeout=20.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            if obs is not None:
+                obs.stop()
+            if rep is not None:
+                rep.stop()
+            if rep_srv is not None:
+                rep_srv.stop()
+            group.stop()
