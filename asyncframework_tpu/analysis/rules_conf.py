@@ -19,7 +19,15 @@ ConfigEntry registry:
 - ``conf-env-alias``: an ``ASYNCTPU_ASYNC*`` env-var literal that does
   not round-trip to a registered key (the alias grammar is mechanical:
   ``ASYNCTPU_`` + key upper-cased, dots to underscores -- a typo'd env
-  literal silently configures nothing).
+  literal silently configures nothing);
+- ``conf-tunable``: the adaptive-controller actuation surface
+  (``parallel/controller.py``).  Every knob the controller actuates --
+  a ``CONTROLLER_TUNABLES`` key or an ``_actuate("<key>", ...)``
+  literal -- must be a registered ConfigEntry carrying ``tunable=True``
+  WITH declared ``floor``/``ceiling`` bounds, and every declared
+  tunable must carry both bounds.  Undeclaring a tunable (or actuating
+  an undeclared key) therefore fails the lint -- a controller may only
+  move knobs whose hard bounds an operator can read off conf.py.
 """
 
 from __future__ import annotations
@@ -38,6 +46,7 @@ from asyncframework_tpu.analysis.core import (
 CONF_PATH = "asyncframework_tpu/conf.py"
 CLI_PATH = "asyncframework_tpu/cli.py"
 SOLVER_BASE_PATH = "asyncframework_tpu/solvers/base.py"
+CONTROLLER_PATH = "asyncframework_tpu/parallel/controller.py"
 
 # key segments are dot-separated and underscore-FREE: the ASYNCTPU_ env
 # alias maps dots to underscores, so an underscore inside a segment
@@ -67,6 +76,63 @@ def declared_entries(ctx: LintContext) -> Dict[str, str]:
         if node.targets and isinstance(node.targets[0], ast.Name):
             name = node.targets[0].id
         out[key] = name
+    return out
+
+
+def declared_tunables(ctx: LintContext) -> Dict[str, "tuple[bool, bool, int]"]:
+    """key -> (has_floor, has_ceiling, line) for every ConfigEntry
+    declared with ``tunable=True`` (constant keyword) in conf.py."""
+    sf = ctx.get(CONF_PATH)
+    out: Dict[str, tuple] = {}
+    if sf is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call) and
+                tail_name(node.func) == "ConfigEntry" and node.args):
+            continue
+        key = const_str(node.args[0])
+        if key is None:
+            continue
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        tn = kw.get("tunable")
+        if not (isinstance(tn, ast.Constant) and tn.value is True):
+            continue
+
+        def has_bound(name):
+            v = kw.get(name)
+            return (isinstance(v, ast.Constant)
+                    and isinstance(v.value, (int, float))
+                    and not isinstance(v.value, bool))
+
+        out[key] = (has_bound("floor"), has_bound("ceiling"), node.lineno)
+    return out
+
+
+def _actuated_keys(ctx: LintContext) -> List["tuple[str, int]"]:
+    """(key, line) for every knob the controller actuates: the
+    ``CONTROLLER_TUNABLES`` table's literal keys plus the first-arg
+    string literal of every ``_actuate(...)`` call in controller.py."""
+    sf = ctx.get(CONTROLLER_PATH)
+    out: List[tuple] = []
+    if sf is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            target = (node.targets[0] if isinstance(node, ast.Assign)
+                      and node.targets else getattr(node, "target", None))
+            value = node.value
+            if (target is not None and value is not None
+                    and tail_name(target) == "CONTROLLER_TUNABLES"
+                    and isinstance(value, ast.Dict)):
+                for k in value.keys:
+                    key = const_str(k)
+                    if key is not None:
+                        out.append((key, k.lineno))
+        elif isinstance(node, ast.Call) and \
+                tail_name(node.func) == "_actuate" and node.args:
+            key = const_str(node.args[0])
+            if key is not None:
+                out.append((key, node.lineno))
     return out
 
 
@@ -187,6 +253,25 @@ def check(ctx: LintContext) -> List[Finding]:
                 "conf-field-map", CLI_PATH, line, key,
                 f"CONF_TO_FIELD maps {key!r} to SolverConfig.{fld}, "
                 f"which does not exist"))
+
+    # tunable discipline: every declared tunable carries both bounds,
+    # and the controller actuates ONLY declared tunables
+    tunables = declared_tunables(ctx)
+    for key, (has_floor, has_ceiling, line) in sorted(tunables.items()):
+        if not (has_floor and has_ceiling):
+            findings.append(Finding(
+                "conf-tunable", CONF_PATH, line, key,
+                f"tunable knob {key!r} must declare numeric floor AND "
+                f"ceiling bounds (the controller clamps every decision "
+                f"to them; a boundless tunable is unactuatable)"))
+    for key, line in _actuated_keys(ctx):
+        if key not in tunables:
+            findings.append(Finding(
+                "conf-tunable", CONTROLLER_PATH, line, key,
+                f"controller actuates {key!r}, which is not declared "
+                f"tunable=True in conf.py -- the controller may only "
+                f"move declared tunables (add the marker + bounds or "
+                f"drop the actuation)"))
 
     # env-alias grammar: ASYNCTPU_ASYNC* literals must round-trip
     for path, sf in ctx.files.items():

@@ -518,6 +518,16 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
     # --conf async.fence.enabled=false restores the legacy wire
     if not conf.contains("async.fence.enabled"):
         conf.set("async.fence.enabled", True)
+    # the adaptive asynchrony controller likewise defaults ON for the
+    # cluster path: the primary PS closes the loop from the observed
+    # signals (per-worker staleness/RTT/compute EWMAs, merge-queue
+    # depth, prefetch stalls) to the declared tunables -- delay-adaptive
+    # step damping, cohort size, pipeline depth, push-merge budget
+    # (parallel/controller.py; tests/test_controller.py guards the
+    # control-off byte identity) -- an explicit
+    # --conf async.control.enabled=false restores the static knobs
+    if not conf.contains("async.control.enabled"):
+        conf.set("async.control.enabled", True)
 
     cfg = SolverConfig(
         num_workers=args.num_partitions,
@@ -611,6 +621,7 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
                 ui = LiveUIServer(live_state, port=ui_port).start()
             bus.start()
         group = None
+        controller = None
         try:
             ps_d = args.d
             shard_map_wire = None
@@ -642,7 +653,22 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
                 bus=bus, shard_map=shard_map_wire, shard_index=0,
                 shard_epochs=(group.epochs_wire()
                               if group is not None else None),
-            ).start()
+            )
+            if conf.get("async.control.enabled"):
+                # adaptive asynchrony controller on the primary PS:
+                # telemetry -> decisions -> CTRL over WELCOME/PULL (and
+                # SETMAP to the shard group, surviving promotions).
+                # Started BEFORE ps.start(): the first WELCOME served
+                # must already carry the CTRL payload, or a worker that
+                # HELLOs in the gap never builds a ControlSink and
+                # ignores every decision for the whole run.
+                from asyncframework_tpu.parallel.controller import (
+                    AsyncController,
+                )
+
+                controller = AsyncController(ps, conf=conf,
+                                             group=group).start()
+            ps.start()
             ok = ps.wait_done(timeout_s=cfg.run_timeout_s)
             if not ok:
                 # progress-aware diagnostic: who went silent, who
@@ -690,6 +716,8 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
             # summary must still seal the event log (a .gz without its end
             # marker forces every later read through the torn-tail path)
             # and stop the UI/bus threads
+            if controller is not None:
+                controller.stop()
             if group is not None:
                 group.stop()
             if ui is not None:

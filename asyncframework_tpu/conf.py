@@ -24,12 +24,25 @@ _REGISTRY: Dict[str, "ConfigEntry"] = {}
 
 @dataclass(frozen=True)
 class ConfigEntry(Generic[T]):
-    """A typed, registered configuration key."""
+    """A typed, registered configuration key.
+
+    ``tunable=True`` marks a knob the adaptive controller
+    (``parallel/controller.py``) is allowed to actuate at runtime; a
+    tunable MUST declare ``floor`` and ``ceiling`` -- the hard bounds
+    every controller decision is clamped to (async-lint's
+    ``conf-tunable`` rule enforces both directions: a tunable without
+    bounds, or a controller actuation of a non-tunable key, fails the
+    lint).  For ``async.step.size`` the bounds apply to the step-DAMP
+    multiplier (the controller scales the effective step, never the
+    configured gamma itself)."""
 
     key: str
     default: T
     value_type: Callable[[str], T]
     doc: str = ""
+    tunable: bool = False
+    floor: Optional[float] = None
+    ceiling: Optional[float] = None
 
     def __post_init__(self):
         _REGISTRY[self.key] = self
@@ -134,10 +147,20 @@ class AsyncConf:
 # --------------------------------------------------------------------------
 NUM_WORKERS = ConfigEntry("async.num.workers", 8, int, "Logical workers (device slots).")
 NUM_ITERATIONS = ConfigEntry("async.num.iterations", 1000, int, "Total accepted updates.")
-STEP_SIZE = ConfigEntry("async.step.size", 0.1, float, "Base step size gamma.")
+STEP_SIZE = ConfigEntry("async.step.size", 0.1, float, "Base step size gamma.",
+                        # tunable: the controller's per-push delay-adaptive
+                        # DAMP multiplier is clamped to [floor, ceiling] --
+                        # it scales the effective step, never gamma itself
+                        tunable=True, floor=0.05, ceiling=1.0)
 TAW = ConfigEntry("async.taw", 2**31 - 1, int, "Staleness bound tau.")
 BATCH_RATE = ConfigEntry("async.batch.rate", 0.1, float, "Per-round Bernoulli sample rate b.")
-BUCKET_RATIO = ConfigEntry("async.bucket.ratio", 0.5, float, "Cohort availability threshold.")
+BUCKET_RATIO = ConfigEntry("async.bucket.ratio", 0.5, float,
+                           "Cohort availability threshold.",
+                           # tunable: the controller re-clamps the partial-
+                           # barrier cohort between floor*P (never solo
+                           # unless P=1) and ceiling*P (the configured b is
+                           # its own upper bound when smaller)
+                           tunable=True, floor=0.125, ceiling=1.0)
 PRINTER_FREQ = ConfigEntry("async.printer.freq", 100, int, "Trajectory snapshot period.")
 DELAY_COEFF = ConfigEntry("async.delay.coeff", 0.0, float,
                           "Straggler delay intensity; -1 = cloud long-tail model.")
@@ -293,7 +316,12 @@ PUSH_MERGE = ConfigEntry(
     "async.push.merge", 8, int,
     "Upper bound on PUSHes the PS coalesces into one fused device apply "
     "when the model lock is contended (bit-identical to the serial apply "
-    "order; 1 = classic one-dispatch-per-push path).")
+    "order; 1 = classic one-dispatch-per-push path).",
+    # tunable: the controller resizes the EFFECTIVE budget within
+    # [floor, min(ceiling, configured value)] -- the fused kernel
+    # compiles once at the configured bound, so the ceiling can never
+    # grow a compiled shape
+    tunable=True, floor=1, ceiling=64)
 PIPELINE_DEPTH = ConfigEntry(
     "async.pipeline.depth", 0, int,
     "DCN worker update-loop pipelining: 0 = the classic serial "
@@ -306,7 +334,12 @@ PIPELINE_DEPTH = ConfigEntry(
     "in-flight steps, and a taw rejection makes the worker discard its "
     "prefetched model and re-pull fresh.  ASAGA ignores this (its "
     "PS-side sampling requires strict pull->push alternation per "
-    "worker).")
+    "worker).",
+    # tunable: with pipelining ON the controller auto-sizes the live
+    # in-flight window within [floor, min(ceiling, configured depth)]
+    # from measured pull RTT vs compute time; it never flips 0 <-> >=1
+    # (the loop SHAPE is chosen at worker start)
+    tunable=True, floor=1, ceiling=8)
 MESH_DEVICES = ConfigEntry(
     "async.mesh.devices", 0, int,
     "Devices in each DCN worker's LOCAL compute mesh (parallel/mesh.py): "
@@ -532,6 +565,8 @@ SLO_RULES = ConfigEntry(
     "standby_lag: max(ps.standby_lag) < 512 over 15s for 5s "
     "unless ps.done; "
     "fenced_writes: rate(recovery.fenced_rejects) < 1 over 30s for 10s; "
+    "controller_converged: rate(control.changes) < 0.5 over 20s for 5s "
+    "unless observer.fleet_done; "
     "fleet_stragglers: max(observer.straggler_score) < 2.5 over 30s "
     "for 10s unless observer.fleet_done; "
     "fleet_freshness: max(observer.freshness_lag_ms) < 5000 over 30s "
@@ -550,6 +585,58 @@ SLO_RULES = ConfigEntry(
     "durations) surface as the /api/status 'health' section and the "
     "async_slo_state gauges on /metrics.  Rules whose series never "
     "produce samples report no_data and never fire.")
+# -------------------------------------------------------- adaptive control
+# The closed loop from cluster telemetry to the async knobs
+# (parallel/controller.py): an AsyncController on the primary PS
+# periodically reads the observed signals (PS-local per-worker
+# staleness/RTT/compute EWMAs; observer.* straggler scores and fleet
+# freshness when a collector is attached) and actuates the declared
+# tunables -- per-push delay-adaptive step damping, partial-barrier
+# cohort size, pipeline depth, push-merge budget.  Decisions propagate
+# through the existing SETMAP/WELCOME control path as a CTRL payload
+# next to the shard map and epoch vector.
+CONTROL_ENABLED = ConfigEntry(
+    "async.control.enabled", False, bool,
+    "Run the adaptive asynchrony controller on the primary PS.  Off "
+    "(the default) the wire is byte-identical legacy -- no CTRL "
+    "payloads anywhere; async-cluster flips it on (straggler-heavy "
+    "runs stop needing hand-tuned b/depth/merge/step conf).")
+CONTROL_INTERVAL_S = ConfigEntry(
+    "async.control.interval.s", 0.5, float,
+    "Controller decision period: every tick reads the observed "
+    "signals and re-evaluates every knob target.  <= 0 disables the "
+    "loop thread (tick() still works on demand -- the ManualClock "
+    "test surface).")
+CONTROL_HYSTERESIS = ConfigEntry(
+    "async.control.hysteresis", 0.25, float,
+    "Relative dead-band per knob: a recomputed target actuates only "
+    "when it differs from the current value by more than this "
+    "fraction (and by >= 1 for integer knobs).  The first defense "
+    "against knob flapping; the oscillation guard is the second.")
+CONTROL_COOLDOWN_S = ConfigEntry(
+    "async.control.cooldown.s", 2.0, float,
+    "Minimum seconds between successive changes of the SAME knob -- "
+    "a decision needs time to show up in the signals it was made "
+    "from (staleness EWMAs, queue depth) before being revised.")
+CONTROL_OSC_REVERSALS = ConfigEntry(
+    "async.control.osc.reversals", 3, int,
+    "Oscillation guard: this many direction REVERSALS of one knob "
+    "within the freeze window trips the guard -- the knob freezes at "
+    "its current value for async.control.osc.freeze.s and the trip "
+    "is counted (control.osc_trips) and surfaced in /api/status.")
+CONTROL_OSC_FREEZE_S = ConfigEntry(
+    "async.control.osc.freeze.s", 10.0, float,
+    "How long an oscillation-tripped knob stays frozen before the "
+    "controller may move it again (reversal history cleared).")
+CONTROL_DAMP_FREE = ConfigEntry(
+    "async.control.damp.free", -1.0, float,
+    "Staleness slack before delay-adaptive step damping engages: a "
+    "push at staleness tau is damped by 1/(1 + tau - free) only past "
+    "this threshold (floored at the async.step.size tunable floor).  "
+    "-1 (the default) auto-sizes to num_workers + pipeline depth + 2: "
+    "with P workers and a depth-D in-flight window the steady-state "
+    "staleness is ~P-1+D, so only ABNORMAL delay damps -- damping the "
+    "healthy steady state just slows convergence at a fixed budget.")
 # -------------------------------------------------------- cluster observer
 # Central collector (metrics/observer.py + bin/async-mon): discovers every
 # role, scrapes /api/status + /metrics over the net/ retry plane, persists

@@ -452,6 +452,13 @@ def render_ps_shards(shards: int, d: int, n: int,
             # (silence bound) is the ONLY honest signal up here
             {"name": "ASYNCTPU_ASYNC_LEASE_S", "value": "5"},
         ]
+        if i == 0:
+            # adaptive asynchrony controller on the primary shard pod:
+            # telemetry -> knob decisions, fanned to the other shards
+            # via SETMAP (shardgroup.CtrlFanout -- no ShardGroup owns
+            # Deployment-managed children)
+            env.append({"name": "ASYNCTPU_ASYNC_CONTROL_ENABLED",
+                        "value": "1"})
         if standby_map is not None:
             env.append({"name": "ASYNC_SHARD_STANDBYS",
                         "value": _json.dumps(standby_map)})

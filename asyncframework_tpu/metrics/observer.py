@@ -720,6 +720,13 @@ class ClusterObserver:
         with self._lock:
             return dict(self._derived)
 
+    def stragglers(self) -> Dict[str, dict]:
+        """The per-worker straggler table from the last recompute
+        (wid -> score/flagged/dims) -- the adaptive controller's
+        per-worker damp input (parallel/controller.py)."""
+        with self._lock:
+            return {w: dict(s) for w, s in self._stragglers.items()}
+
     # ---------------------------------------------------------------- flight
     #: how far before this collector's start a dump may have been
     #: written and still belong to ITS run: roles often boot (and flush)
@@ -787,7 +794,23 @@ class ClusterObserver:
                 "freshness_lag_ms": series_last(
                     status, "serving.freshness_lag_ms"),
             }
-        return {
+        # adaptive control plane: whichever LIVE role serves a
+        # ``control`` status section (the primary PS running the
+        # AsyncController) contributes it to the fleet view, so
+        # async-mon renders the current knob values next to the
+        # stragglers that drive them.  Live roles only -- a SIGKILLed
+        # primary's cached final status must not shadow its
+        # replacement's board (the corpse-owns-the-fleet-view class
+        # the derived signals were already hardened against)
+        control = None
+        for name, st in sorted(states.items()):
+            if not st.get("up"):
+                continue
+            sec = (statuses.get(name) or {}).get("control")
+            if isinstance(sec, dict) and sec:
+                control = {"role": name, **sec}
+                break
+        out = {
             "interval_s": self.interval_s,
             "roles": roles,
             "derived": derived,
@@ -796,6 +819,9 @@ class ClusterObserver:
             "history": self.history.summary(),
             "totals": observer_totals(),
         }
+        if control is not None:
+            out["control"] = control
+        return out
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "ClusterObserver":

@@ -163,6 +163,14 @@ _register(CounterFamily(
         "(standby applier) (parallel/replication.py).",
 ))
 _register(CounterFamily(
+    "control", "asyncframework_tpu.parallel.controller",
+    "control_totals", "reset_control_totals",
+    doc="Adaptive asynchrony controller: decision ticks, knob changes "
+        "(the controller_converged SLO watches their rate), bound "
+        "clamps, oscillation-guard trips, stale CTRL installs refused "
+        "(parallel/controller.py).",
+))
+_register(CounterFamily(
     "observer", "asyncframework_tpu.metrics.observer",
     "observer_totals", "reset_observer_totals",
     doc="Cluster observer: scrapes, scrape errors, roles discovered, "

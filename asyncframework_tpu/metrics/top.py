@@ -170,12 +170,63 @@ def render_status(status: Dict, plain: bool = True) -> str:
             f"failovers={detail.get('failovers', 0)}"
         )
 
+    # ---- adaptive control plane
+    control = status.get("control") or {}
+    if control.get("knobs"):
+        lines.append("")
+        lines.append(render_control(control, plain=plain).rstrip("\n"))
+
     ts = status.get("timeseries") or {}
     if ts.get("series"):
         lines.append("")
         lines.append(f"timeseries: {ts['series']} series, "
                      f"{ts.get('samples', 0)} samples "
                      f"({ts.get('evicted', 0)} evicted)")
+    return "\n".join(lines) + "\n"
+
+
+def render_control(section: Dict, plain: bool = True) -> str:
+    """The adaptive-control board from a ``control`` /api/status section
+    (pure, like :func:`render_status`): current knob values vs their
+    configured baselines, the last decision and its reason, and the
+    oscillation-guard state.  Shared by async-top's per-role view and
+    async-mon's fleet view."""
+    lines: List[str] = []
+    totals = section.get("totals") or {}
+    head = (f"control: seq={section.get('seq', 0)} "
+            f"changes={totals.get('changes', 0)} "
+            f"clamps={totals.get('clamps', 0)} "
+            f"osc_trips={totals.get('osc_trips', 0)}")
+    if section.get("role"):
+        head += f" via={section['role']}"
+    lines.append(head)
+    knobs = section.get("knobs") or {}
+    if knobs:
+        lines.append(f"  {'knob':<8}{'value':>8}{'conf':>8}"
+                     f"{'changes':>9}  guard")
+        for name in sorted(knobs):
+            k = knobs[name]
+            frozen = bool(k.get("frozen"))
+            guard = (_color("FROZEN", "31", plain) if frozen else "ok")
+            lines.append(
+                f"  {name:<8}{_fmt(k.get('value'), 0):>8}"
+                f"{_fmt(k.get('configured'), 0):>8}"
+                f"{k.get('changes', 0):>9}  {guard}"
+            )
+    damp = section.get("damp") or {}
+    if damp:
+        wdamp = damp.get("wdamp") or {}
+        extra = ("  wdamp " + " ".join(
+            f"w{w}={_fmt(f, 2)}" for w, f in sorted(wdamp.items()))
+            if wdamp else "")
+        lines.append(f"  damp: floor={_fmt(damp.get('floor'), 2)} "
+                     f"free={_fmt(damp.get('free'), 1)}{extra}")
+    last = section.get("last_decision")
+    if last:
+        lines.append(
+            f"  last: {last.get('knob')} "
+            f"{_fmt(last.get('from'), 0)} -> {_fmt(last.get('to'), 0)} "
+            f"({last.get('reason')}) at t={_fmt(last.get('t'))}s")
     return "\n".join(lines) + "\n"
 
 
@@ -236,6 +287,11 @@ def render_fleet(observer_section: Dict, plain: bool = True) -> str:
                                 (s.get("dims") or {}).items()))
             lines.append(f"  w{wid:<4} score={_fmt(s['score'], 2):<7} "
                          f"{mark} {dims}")
+
+    control = observer_section.get("control") or {}
+    if control.get("knobs"):
+        lines.append("")
+        lines.append(render_control(control, plain=plain).rstrip("\n"))
 
     hist = observer_section.get("history") or {}
     if hist:

@@ -293,6 +293,17 @@ def make_asgd_apply_merge(
     old device handle dies at dispatch -- see ``ParameterServer``'s
     drain, which only routes a drain through the donated kernel when the
     outgoing version is already host-published.
+
+    Delay-adaptive damping (``parallel/controller.py``): a mask slot is
+    the per-item step-DAMP factor, not just a keep bit -- 0 skips the
+    slot exactly as before, 1.0 is the undamped apply (``1.0 * x`` is
+    exact in f32, so the legacy path stays bit-identical), and a
+    controller-damped push carries its bounded ``1/(1+tau)``-family
+    factor here, scaling that item's effective step with no change to
+    the clock/accept semantics (``k`` still advances by 1 per kept
+    slot).  :func:`make_asgd_apply_damped` is the serial twin with the
+    SAME expression, so the fused and serial paths agree bit for bit at
+    every damp value.
     """
     par_recs = batch_rate * n / num_workers
 
@@ -304,7 +315,7 @@ def make_asgd_apply_merge(
             w, k = carry
             g, a = xs
             lr = gamma / jnp.sqrt(k / num_workers + 1.0)
-            w2 = w - (lr / par_recs) * g
+            w2 = w - (a * (lr / par_recs)) * g
             keep = a > 0
             return (jnp.where(keep, w2, w), jnp.where(keep, k + 1.0, k)), None
 
@@ -312,6 +323,30 @@ def make_asgd_apply_merge(
         return w, k
 
     return apply_merge
+
+
+def make_asgd_apply_damped(gamma: float, batch_rate: float, n: int,
+                           num_workers: int):
+    """jit (w, g, k, a) -> (w', k+1): :func:`make_asgd_apply` with a
+    per-call step-DAMP scalar ``a`` (delay-adaptive step sizes per
+    arXiv:1601.04033, actuated by ``parallel/controller.py``).
+
+    The expression is VERBATIM the damped merge-kernel body
+    (``w - (a * (lr/par_recs)) * g``), so the serial one-dispatch path
+    and the fused drain produce bit-identical models at every damp
+    value -- and at ``a == 1.0`` bit-identical to the undamped
+    :func:`make_asgd_apply` (multiplication by 1.0 is exact in f32).
+    Same donation discipline: ``g`` and ``k`` die here, ``w`` is a live
+    model version.
+    """
+    par_recs = batch_rate * n / num_workers
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def apply(w, g, k, a):
+        lr = gamma / jnp.sqrt(k / num_workers + 1.0)
+        return w - (a * (lr / par_recs)) * g, k + 1.0
+
+    return apply
 
 
 def make_saga_apply_merge(

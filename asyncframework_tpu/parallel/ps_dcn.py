@@ -310,7 +310,7 @@ class _PendingPush:
     __slots__ = ("wid", "ts", "g_host", "diff", "header", "payload_len",
                  "tc", "t_queue0", "done", "ack", "accepted", "staleness",
                  "task_ms", "t_apply0", "t_done", "k_at_merge",
-                 "do_snapshot")
+                 "do_snapshot", "damp")
 
     def __init__(self, wid: int, ts: int, g_host, diff, header: dict,
                  payload_len: int, tc, t_queue0: float):
@@ -327,6 +327,10 @@ class _PendingPush:
         self.t_done = 0.0
         self.k_at_merge = 0
         self.do_snapshot = False
+        # delay-adaptive step-DAMP factor, decided per item at drain
+        # time from the installed CTRL law (1.0 = undamped, the only
+        # value with control off -- bit-identical legacy apply)
+        self.damp = 1.0
 
 
 # ----------------------------------------------------------------- PS side
@@ -655,6 +659,24 @@ class ParameterServer:
         from asyncframework_tpu.conf import NET_DEDUP_WINDOW, global_conf
 
         self._dedup = DedupWindow(window=global_conf().get(NET_DEDUP_WINDOW))
+
+        # adaptive control plane (parallel/controller.py): the installed
+        # CTRL payload (None = control off, byte-identical legacy wire
+        # everywhere) + its parsed effective values.  Installed by the
+        # local AsyncController (primary), by SETMAP (shard secondaries
+        # and promoted standbys -- decisions SURVIVE promotion because
+        # the group re-announces its stored ctrl), and served to workers
+        # on WELCOME and on PULL replies whose ``cs`` stamp is stale.
+        # _ctrl_lock guards the swap; the drain reads the parsed fields
+        # via one attribute read each (GIL-atomic reference swaps).
+        self._ctrl_lock = threading.Lock()
+        self.ctrl: Optional[dict] = None
+        self._ctrl_b = 0            # cohort override (0 = conf value)
+        self._ctrl_merge = 0        # effective merge budget (0 = conf)
+        self._ctrl_damp: Optional[Tuple[float, float, float]] = None
+        self._ctrl_wdamp: Dict[int, float] = {}
+        self.ctrl_stale_rejects = 0  # stale (ep, seq) installs refused
+        self._apply_damped = None    # built on first damped install
 
         # distributed tracing (metrics/trace.py): server-side spans for
         # traced updates (the frame carried a ``tc`` header) plus spans
@@ -1135,6 +1157,12 @@ class ParameterServer:
                             welcome["epochs"] = self.shard_epochs
                     if self.epoch:
                         welcome["epoch"] = self.epoch
+                    if self.ctrl is not None:
+                        # adaptive control plane: a joining worker gets
+                        # the current CTRL payload next to the map and
+                        # epoch vector (absent with control off --
+                        # byte-identical legacy wire)
+                        welcome["ctrl"] = self.ctrl
                     _send_msg(conn, welcome)
                 elif op == "SHARDMAP":
                     # shard-map query (group members, liveness probes,
@@ -1154,6 +1182,8 @@ class ParameterServer:
                         reply["standbys"] = self.standby_map
                     if self._standby:
                         reply["standby"] = True
+                    if self.ctrl is not None:
+                        reply["ctrl"] = self.ctrl
                     _send_msg(conn, reply)
                 elif op == "SETMAP":
                     # group controller installing the assembled map on a
@@ -1177,6 +1207,15 @@ class ParameterServer:
                         # a NEW standby behind the promoted primary via
                         # the same install
                         self.set_standby_map(header.get("standbys"))
+                    if "ctrl" in header:
+                        # adaptive-control decisions ride SETMAP next to
+                        # the map/epochs/standbys: shard secondaries
+                        # damp/serve under the SAME decision the primary
+                        # applies, and a promoted standby re-learns the
+                        # current CTRL from the group's re-announce
+                        # (monotone install; a deposed controller's
+                        # stale stamp is refused)
+                        self.set_control(header.get("ctrl"))
                     _send_msg(conn, {"op": "ACK"})
                 elif op in ("REPL_APPEND", "REPL_SYNC"):
                     # primary->standby replication stream (parallel/
@@ -1324,6 +1363,124 @@ class ParameterServer:
         if ep > self._fenced_above:
             self._fenced_above = ep
 
+    # ------------------------------------------------- adaptive control
+    def set_control(self, wire: Optional[dict]) -> bool:
+        """Install a CTRL payload (parallel/controller.py decisions).
+
+        Monotone by (epoch, seq) -- fence-stamped: a deposed
+        controller's decision (stamped with a pre-promotion epoch below
+        an already-installed one) is refused and counted, exactly like
+        a zombie's write.  ``None`` clears control entirely (back to
+        the byte-identical legacy path).  Returns True when installed.
+        """
+        from asyncframework_tpu.parallel.controller import ctrl_seq
+
+        if wire is not None and self.algo == "asgd":
+            damp = wire.get("damp")
+            if damp and float(damp[0]) > 0:
+                # build + warm the damped serial kernel BEFORE the law
+                # is published: a single-item drain between install and
+                # compile would otherwise fall through to the undamped
+                # kernel while a contended (fused) drain damps -- the
+                # applied step must never depend on queue contention
+                self._ensure_apply_damped()
+        with self._ctrl_lock:
+            if wire is None:
+                self.ctrl = None
+                self._ctrl_b = 0
+                self._ctrl_merge = 0
+                self._ctrl_damp = None
+                self._ctrl_wdamp = {}
+                return True
+            new, cur = ctrl_seq(wire), ctrl_seq(self.ctrl)
+            if new == cur:
+                # idempotent re-delivery (the group re-announces its
+                # stored ctrl on every SETMAP sweep): not a fence event
+                return False
+            if new < cur:
+                self.ctrl_stale_rejects += 1
+                return False
+            self.ctrl = dict(wire)
+            self._ctrl_b = max(0, int(wire.get("b", 0) or 0))
+            self._ctrl_merge = max(0, int(wire.get("merge", 0) or 0))
+            damp = wire.get("damp")
+            if damp and self.algo == "asgd":
+                # [coeff, floor, free]: the bounded 1/(1+tau)-family
+                # law the drain applies per accepted push.  ASAGA is
+                # excluded by design: damping the gradient term alone
+                # would break its alpha_bar == mean(table) invariant
+                # (same exactness stance as the codec exclusion).
+                c, fl, fr = (float(damp[0]), float(damp[1]),
+                             float(damp[2]))
+                self._ctrl_damp = (c, fl, fr) if c > 0 else None
+            else:
+                self._ctrl_damp = None
+            wd = wire.get("wdamp") or {}
+            try:
+                self._ctrl_wdamp = {int(w): float(f)
+                                    for w, f in wd.items()}
+            except (TypeError, ValueError):
+                self._ctrl_wdamp = {}
+        return True
+
+    def _ensure_apply_damped(self) -> None:
+        """Build + warm the damped serial apply kernel once (ASGD only;
+        called OFF the model lock -- from set_control before the law
+        publishes, and from the replication receive path before a
+        damped append takes the lock).  A benign double-build under a
+        race compiles the identical function twice."""
+        if self._apply_damped is not None or self.algo != "asgd":
+            return
+        from asyncframework_tpu.ops import steps as _steps
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        apply_damped = _steps.make_asgd_apply_damped(
+            self.cfg.gamma, self.cfg.batch_rate, self.n,
+            self.cfg.num_workers)
+        zw = _jax.device_put(_jnp.zeros(self.d, _jnp.float32),
+                             self.device)
+        zg = _jax.device_put(_jnp.zeros(self.d, _jnp.float32),
+                             self.device)
+        zk = _jax.device_put(_jnp.float32(0.0), self.device)
+        apply_damped(zw, zg, zk, np.float32(1.0))
+        self._apply_damped = apply_damped
+
+    def _item_damp(self, wid: int, staleness: int) -> float:
+        """The per-item step-DAMP factor under the installed CTRL law:
+        1/(1 + c*(tau - free)) past the free slack, floored, times the
+        per-worker extra factor for observer-flagged stragglers.  1.0
+        (exact) whenever control is off or the push is fresh enough."""
+        law = self._ctrl_damp
+        if law is None:
+            return 1.0
+        c, floor_, free = law
+        damp = 1.0
+        over = float(staleness) - free
+        if over > 0.0:
+            damp = max(floor_, 1.0 / (1.0 + c * over))
+        wd = self._ctrl_wdamp.get(wid)
+        if wd is not None:
+            damp = max(floor_, damp * wd)
+        # an ACCEPTED item's damp must stay strictly positive: the merge
+        # kernel's keep bit is ``mask > 0``, and a zero factor (possible
+        # only with a hand-crafted CTRL floor of 0) would silently turn
+        # an accepted push into a dropped one
+        return float(max(damp, 1e-6))
+
+    def control_signals(self) -> Dict[str, float]:
+        """PS-local scalars the adaptive controller reads each tick
+        (lock-free int reads, same stance as ``_telemetry_source``)."""
+        return {
+            "clock": float(self._clock),
+            "accepted": float(self.accepted),
+            "dropped": float(self.dropped),
+            "queue_depth": float(len(self._merge_q)),
+            "max_staleness": float(self.max_staleness),
+            "avg_delay_ms": float(self.avg_delay_ms),
+            "done": float(self._done.is_set()),
+        }
+
     # ----------------------------------------------- hot-standby replication
     def attach_standby(self, host: str, port: int) -> None:
         """(Re)point this PRIMARY's replication stream at its warm
@@ -1420,6 +1577,10 @@ class ParameterServer:
         items = header.get("items") or []
         pre = int(header.get("pre", -1))
         cal = header.get("cal")
+        if any(len(it) > 7 and float(it[7]) != 1.0 for it in items):
+            # delay-adaptive damped items in this batch: compile the
+            # damped kernel BEFORE taking the model lock (one-time)
+            self._ensure_apply_damped()
         with self._lock:
             if pre + len(items) <= self._clock:
                 reply = {"op": "ACK", "clock": self._clock, "dup": True}
@@ -1434,6 +1595,9 @@ class ParameterServer:
                     acc = bool(it[2])
                     sid, seq, ack = it[3], it[4], it[5]
                     st = int(it[6])
+                    # per-item step-DAMP (absent on a pre-damping
+                    # primary's stream: 1.0 = the exact legacy apply)
+                    damp = float(it[7]) if len(it) > 7 else 1.0
                     if sid is not None:
                         self._dedup.record({"sid": sid, "seq": seq},
                                            dict(ack))
@@ -1451,8 +1615,17 @@ class ParameterServer:
                         self._model_gen += 1
                         self._snap = None
                         g_dev = jax.device_put(g, self.device)
-                        self._w, self._k_dev = self._apply(
-                            self._w, g_dev, self._k_dev)
+                        if damp != 1.0 and self._apply_damped is not None:
+                            # the primary damped this push: the mirror
+                            # applies the IDENTICAL expression (serial
+                            # damped kernel == damped merge body, bit
+                            # for bit) so its state stays the primary's
+                            self._w, self._k_dev = self._apply_damped(
+                                self._w, g_dev, self._k_dev,
+                                np.float32(damp))
+                        else:
+                            self._w, self._k_dev = self._apply(
+                                self._w, g_dev, self._k_dev)
                         self._k += 1
                         self.accepted += 1
                         self.accepted_by_wid[wid] = (
@@ -1556,8 +1729,17 @@ class ParameterServer:
         """Partial-barrier ``b``, clamped to live membership: when the
         supervisor knows only L workers are alive, a wave of min(b, L)
         keeps flowing immediately instead of leaning on the starvation
-        fallback every round (ASAP's membership-as-staleness stance)."""
-        threshold = max(self.cfg.bucket_threshold, 1)
+        fallback every round (ASAP's membership-as-staleness stance).
+
+        The adaptive controller's cohort override (CTRL ``b``) takes
+        precedence over the configured ``bucket_threshold`` -- its
+        decision already respects the declared tunable bounds, and a
+        re-clamped wave is how one DELAYed worker stops gating every
+        round -- but live membership still caps it."""
+        b_ctrl = self._ctrl_b
+        threshold = (b_ctrl if b_ctrl > 0
+                     else max(self.cfg.bucket_threshold, 1))
+        threshold = max(threshold, 1)
         if self.supervisor is not None:
             threshold = max(1, min(threshold,
                                    self.supervisor.live_worker_count()))
@@ -1794,6 +1976,27 @@ class ParameterServer:
             orders = sup.orders_for(proc)
             if orders:
                 extra_hdr["adopt"] = orders
+        ctrl = self.ctrl
+        if ctrl is not None:
+            # adaptive-control decisions ride PULL replies the same way
+            # adoption orders do: re-delivered until the client's ``cs``
+            # stamp catches up with the decision's FULL (epoch, seq)
+            # stamp -- a restarted controller under a minted epoch
+            # starts seq over, and a bare-seq compare would strand
+            # every surviving worker on the deposed decisions.  A lost
+            # reply cannot lose a decision and a settled cluster pays
+            # zero extra bytes per pull.  Absent with control off.
+            cs = header.get("cs")
+            if cs is None:
+                stamp = (0, -1)
+            elif isinstance(cs, (list, tuple)) and len(cs) == 2:
+                stamp = (int(cs[0]), int(cs[1]))
+            else:  # legacy bare-seq stamp: pair it with OUR epoch
+                stamp = (int(ctrl.get("ep", 0) or 0), int(cs))
+            from asyncframework_tpu.parallel.controller import ctrl_seq
+
+            if stamp < ctrl_seq(ctrl):
+                extra_hdr["ctrl"] = ctrl
         # vectored zero-copy framing: the cached model bytes and the ASAGA
         # extra payload go out as one kernel-gathered iovec -- the payload
         # is never copied into a fresh frame buffer
@@ -2074,7 +2277,12 @@ class ParameterServer:
         # makes this the overwhelmingly common case.
         prev_snap = self._snap
         prev_gen = self._model_gen
-        while self._merge_q and len(drained) < self._merge_max:
+        # adaptive control: the EFFECTIVE merge budget moves within
+        # [1, _merge_max] (the compiled kernel bound; padding makes any
+        # smaller batch exact).  0 = no override = the configured bound.
+        budget = self._ctrl_merge or self._merge_max
+        budget = max(1, min(budget, self._merge_max))
+        while self._merge_q and len(drained) < budget:
             item = self._merge_q.popleft()
             drained.append(item)
             item.t_apply0 = _trace.now_ms() if item.tc is not None else 0.0
@@ -2141,6 +2349,13 @@ class ParameterServer:
             item.staleness = staleness
             item.task_ms = task_ms
             item.accepted = accepted
+            if accepted:
+                # delay-adaptive step damping (CTRL law; 1.0 = exact
+                # undamped legacy whenever control is off): decided per
+                # item at drain time from ITS observed staleness, so a
+                # dedup-replayed stamp -- which never reaches a second
+                # drain -- keeps exactly the factor it was applied with
+                item.damp = self._item_damp(item.wid, staleness)
             item.k_at_merge = self._k
             self._wstat_merge(item.wid, staleness, accepted)
             ack = {"op": "ACK", "accepted": bool(accepted),
@@ -2188,7 +2403,9 @@ class ParameterServer:
                 G, mask = self._merge_G, self._merge_mask
                 for j, (it, _idx) in enumerate(batch):
                     G[j] = it.g_host
-                mask[: len(batch)] = 1.0
+                    # a mask slot carries the per-item step-DAMP factor
+                    # (1.0 = classic keep bit, exact; 0 below = skip)
+                    mask[j] = it.damp
                 mask[len(batch):] = 0.0
                 G_dev = jax.device_put(G, self.device)
                 m_dev = jax.device_put(mask, self.device)
@@ -2232,9 +2449,14 @@ class ParameterServer:
             items = []
             grads = []
             for it in drained:
+                # the per-item step-DAMP factor rides the stream: the
+                # mirror must apply EXACTLY the step the primary did or
+                # its model silently diverges (and a promotion would
+                # serve the divergent copy)
                 items.append([it.wid, it.ts, 1 if it.accepted else 0,
                               it.header.get("sid"), it.header.get("seq"),
-                              it.ack, int(it.staleness)])
+                              it.ack, int(it.staleness),
+                              float(it.damp)])
                 if it.accepted:
                     grads.append(it.g_host)
             self.repl.enqueue(pre_clock, items, grads,
@@ -2274,6 +2496,12 @@ class ParameterServer:
             self._w, self._ab = self._apply(self._w, self._ab, g_dev, g_dev)
             with self._saga_lock:  # vs checkpoint table copies
                 self._table[item.wid][idx] = item.diff[: idx.size]
+        elif item.damp != 1.0 and self._apply_damped is not None:
+            # delay-adaptive damped apply: the SAME expression as the
+            # damped merge-kernel body, so serial and fused drains stay
+            # bit-identical at every damp value
+            self._w, self._k_dev = self._apply_damped(
+                self._w, g_dev, self._k_dev, np.float32(item.damp))
         else:
             self._w, self._k_dev = self._apply(self._w, g_dev, self._k_dev)
 
@@ -2450,8 +2678,15 @@ class PSClient:
                  pull_mode: Optional[str] = None,
                  pl_stats: Optional[_PipelineStats] = None,
                  cv_buf=None, epoch: int = 0,
-                 push_codec: Optional[str] = None):
+                 push_codec: Optional[str] = None, ctrl_sink=None):
         self.host, self.port = host, int(port)
+        # adaptive control plane: a ControlSink (parallel/controller.py)
+        # shared by this worker process's clients.  PULL requests stamp
+        # the sink's decision seq (``cs``) and PULL replies carrying a
+        # newer CTRL payload install into it (monotone by (ep, seq)).
+        # None (every non-controlled client) = no header field,
+        # byte-identical wire.
+        self.ctrl_sink = ctrl_sink
         self.endpoint = f"{host}:{self.port}"
         # fencing epoch this client stamps on every PULL/PUSH/SUBSCRIBE
         # (``ep`` header key; 0 = fencing off, no key, byte-identical
@@ -2580,6 +2815,10 @@ class PSClient:
     def _note_orders(self, header: dict) -> None:
         if "adopt" in header:
             self._orders.extend(int(w) for w in header["adopt"])
+        if self.ctrl_sink is not None and "ctrl" in header:
+            # adaptive-control decisions ride replies like adoption
+            # orders; the sink's monotone install discards stale ones
+            self.ctrl_sink.install(header["ctrl"])
 
     def take_orders(self) -> List[int]:
         """Adoption orders received so far (drained)."""
@@ -2637,11 +2876,16 @@ class PSClient:
         return out
 
     def _have_hdr(self, wid: int, hdr: dict) -> dict:
-        """Advertise this wid's basis version on a PULL (delta mode)."""
+        """Advertise this wid's basis version on a PULL (delta mode),
+        and the installed CTRL decision seq (``cs``) when this client
+        rides a control sink -- the PS re-delivers the CTRL payload
+        only while the stamp lags its newest decision."""
         if self.pull_mode == "delta":
             basis = self._basis.get(wid)
             if basis is not None:
                 hdr["have"] = basis[0]
+        if self.ctrl_sink is not None:
+            hdr["cs"] = self.ctrl_sink.stamp
         return hdr
 
     def _decode_model(self, wid: int, header: dict, payload: bytes,
@@ -3472,6 +3716,12 @@ def run_worker_process(
     smap = None
     smap_epochs: Optional[List[int]] = None
     ps_epoch = 0
+    # adaptive control plane: built from the WELCOME's CTRL payload when
+    # the PS runs a controller (async.control.enabled); every client of
+    # this process shares it, and the pipelined loops read the live
+    # depth target off it each iteration.  None = control off -- no
+    # ``cs`` stamps, byte-identical wire.
+    ctrl_sink = None
 
     def make_client(recorder=None, pl_stats=None, cv_buf=None):
         """One PS-facing client: a ShardedPSClient fan-out facade when
@@ -3486,11 +3736,13 @@ def run_worker_process(
                 smap, proc=proc_token, recorder=recorder,
                 pull_mode=getattr(cfg, "pull_mode", None),
                 pl_stats=pl_stats, cv_buf=cv_buf, epochs=smap_epochs,
+                ctrl_sink=ctrl_sink,
             )
         return PSClient(host, port, proc=proc_token, recorder=recorder,
                         pull_mode=getattr(cfg, "pull_mode", None),
                         pl_stats=pl_stats, cv_buf=cv_buf, epoch=ps_epoch,
-                        push_codec=getattr(cfg, "push_codec", None))
+                        push_codec=getattr(cfg, "push_codec", None),
+                        ctrl_sink=ctrl_sink)
 
     # elastic adoption bookkeeping: which wids this process serves (own +
     # adopted), and every loop thread ever started (joined at the end)
@@ -3866,12 +4118,18 @@ def run_worker_process(
                     conv_sample(shard,
                                 w_host if placed is not None else w_dev,
                                 ts, g_host)
-                # depth cap: at most pipe_depth unACKed pushes in flight
-                # -- THE staleness bound the taw admission prices.  Reap
-                # lazily: ACKs usually sit in the buffer already.
+                # depth cap: at most depth_now unACKed pushes in flight
+                # -- THE staleness bound the taw admission prices.  The
+                # adaptive controller moves the live window within
+                # [1, configured depth] (CTRL rides the pull replies
+                # this very loop prefetches); without control the cap
+                # IS the configured depth.  Reap lazily: ACKs usually
+                # sit in the buffer already.
+                depth_now = (ctrl_sink.depth(pipe_depth)
+                             if ctrl_sink is not None else pipe_depth)
                 t_q0 = _trace.now_ms() if cur_tr is not None else 0.0
                 blocked = False
-                while (push_cl.inflight_pushes() >= pipe_depth
+                while (push_cl.inflight_pushes() >= depth_now
                        and not done):
                     blocked = True
                     reap_one()
@@ -3943,6 +4201,12 @@ def run_worker_process(
                 if wire_epochs:
                     smap_epochs = [int(e) for e in wire_epochs]
             ps_epoch = int(welcome.get("epoch", 0) or 0)
+            if welcome.get("ctrl"):
+                from asyncframework_tpu.parallel.controller import (
+                    ControlSink,
+                )
+
+                ctrl_sink = ControlSink(welcome["ctrl"])
             hello_ok = True
             break
         except (ConnectionError, OSError):

@@ -141,8 +141,10 @@ class ReplicationStream:
     def enqueue(self, pre_clock: int, items: List[list],
                 grads: List[np.ndarray], cal: List[float]) -> None:
         """One drained merge batch (caller holds the PS model lock).
-        ``items`` = ``[wid, ts, accepted, sid, seq, ack, staleness]``
-        per drained push in FIFO order; ``grads`` = the accepted items'
+        ``items`` = ``[wid, ts, accepted, sid, seq, ack, staleness,
+        damp]`` per drained push in FIFO order (``damp`` = the
+        delay-adaptive step factor the primary applied; the mirror must
+        apply the identical one); ``grads`` = the accepted items'
         dense host gradients in the same order; ``cal`` = the primary's
         calibration triple.  A full queue (standby slow or dark) drops
         everything and schedules a re-sync -- bounded memory, and the

@@ -294,7 +294,8 @@ class ShardedPSClient:
     def __init__(self, smap: ShardMap, timeout_s: float = 120.0,
                  proc: Optional[str] = None, recorder=None,
                  pull_mode: Optional[str] = None, pl_stats=None,
-                 cv_buf=None, epochs: Optional[Sequence[int]] = None):
+                 cv_buf=None, epochs: Optional[Sequence[int]] = None,
+                 ctrl_sink=None):
         from asyncframework_tpu.parallel.ps_dcn import PSClient
 
         self.smap = smap
@@ -308,6 +309,11 @@ class ShardedPSClient:
         self._pull_mode = pull_mode
         self._pl_stats = pl_stats
         self._cv_buf = cv_buf
+        # adaptive control (parallel/controller.py): EVERY sub-client
+        # shares the sink -- any shard may deliver a newer CTRL payload
+        # (SETMAP reached it first) and the monotone install keeps the
+        # newest decision regardless of which range answered first
+        self._ctrl_sink = ctrl_sink
         # piggybacked telemetry (trace spans, pipeline counters,
         # convergence samples) rides the PRIMARY connection only: the
         # primary folds it into the process that serves the dashboard;
@@ -322,7 +328,8 @@ class ShardedPSClient:
                      pl_stats=pl_stats if i == 0 else None,
                      cv_buf=cv_buf if i == 0 else None,
                      epoch=(int(epochs[i])
-                            if epochs and i < len(epochs) else 0))
+                            if epochs and i < len(epochs) else 0),
+                     ctrl_sink=ctrl_sink)
             for i, (h, p, _lo, _hi) in enumerate(smap.entries)
         ]
         self._saw_done = False
@@ -364,7 +371,8 @@ class ShardedPSClient:
                       pull_mode=self._pull_mode,
                       pl_stats=self._pl_stats if i == 0 else None,
                       cv_buf=self._cv_buf if i == 0 else None,
-                      session=old.session, epoch=int(epoch))
+                      session=old.session, epoch=int(epoch),
+                      ctrl_sink=self._ctrl_sink)
         with old._win_lock:
             entries = list(old._push_window)
             old._push_window.clear()
@@ -924,6 +932,15 @@ class ShardGroup:
             i: _ShardProc(i) for i in self.indices
         }
         self.smap: Optional[ShardMap] = None
+        # adaptive control (parallel/controller.py): the group's stored
+        # CTRL payload, re-announced with every SETMAP so decisions
+        # reach every shard and survive relaunches/promotions.  None =
+        # control off -- SETMAPs carry no ctrl key.  The coalescing
+        # announcer thread (lazily started by install_ctrl) keeps dark-
+        # member connect timeouts off the controller's decision loop.
+        self._ctrl: Optional[dict] = None
+        self._ctrl_announce_evt = threading.Event()
+        self._ctrl_announce_thread: Optional[threading.Thread] = None
         # epoch fencing (async.fence.enabled, read through the overlays
         # the children will see so controller and children agree): the
         # controller is the epoch minter for its managed shards -- a
@@ -1274,6 +1291,40 @@ class ShardGroup:
             out.append([self.host, rec.port] if alive else None)
         return out
 
+    def install_ctrl(self, wire: dict) -> None:
+        """Adaptive-control decision fan-out (parallel/controller.py):
+        store the CTRL payload and re-SETMAP it to every member next to
+        the map/epochs/standbys.  The STORE is what makes decisions
+        survive failover -- a relaunched shard's boot SETMAP and a
+        promoted standby's re-announce both carry the group's current
+        ctrl, and each member's monotone (ep, seq) install refuses
+        anything stale.
+
+        The announce runs on a lazily-started coalescing thread (the
+        relaycast offer-thread discipline): a dark/partitioned member's
+        per-target connect timeout must burn the announcer, never the
+        controller's decision loop -- which is busiest exactly when a
+        member is dark.  Back-to-back decisions coalesce into one sweep
+        carrying the newest ctrl."""
+        self._ctrl = dict(wire)
+        if self._ctrl_announce_thread is None:
+            import threading as _threading
+
+            from asyncframework_tpu.utils.threads import guarded
+
+            def _announce_loop() -> None:
+                while not self._stop.is_set():
+                    if not self._ctrl_announce_evt.wait(timeout=0.5):
+                        continue
+                    self._ctrl_announce_evt.clear()
+                    self._announce_group()
+
+            self._ctrl_announce_thread = _threading.Thread(
+                target=guarded(_announce_loop),
+                name="shardgroup-ctrl-announce", daemon=True)
+            self._ctrl_announce_thread.start()
+        self._ctrl_announce_evt.set()
+
     def _setmap(self, index: int) -> None:
         hdr = {"op": "SETMAP", "index": index,
                "shards": (self.smap.to_wire()
@@ -1284,6 +1335,8 @@ class ShardGroup:
         sbs = self.standbys_wire()
         if sbs is not None:
             hdr["standbys"] = sbs
+        if self._ctrl is not None:
+            hdr["ctrl"] = self._ctrl
         _oneshot(self.host, self._procs[index].port, hdr, timeout_s=10.0)
 
     def _announce_group(self, timeout_s: float = 3.0) -> None:
@@ -1314,6 +1367,11 @@ class ShardGroup:
                 hdr["epochs"] = epochs
             if sbs is not None:
                 hdr["standbys"] = sbs
+            if self._ctrl is not None:
+                # adaptive-control decisions survive relaunch AND
+                # promotion: every re-announce re-installs the group's
+                # current CTRL next to the map and epoch vector
+                hdr["ctrl"] = self._ctrl
             try:
                 _oneshot(h, p, hdr, timeout_s=timeout_s)
             except (ConnectionError, OSError):
@@ -1808,6 +1866,71 @@ def launch_inprocess_group(cfg, d: int, n: int, shards: int,
 
 
 # ------------------------------------------------------------- shard child
+class CtrlFanout:
+    """Adaptive-control decision fan-out, controller-less edition (the
+    k8s shard manifests): no :class:`ShardGroup` owns the children --
+    the Deployment controller restarts pods -- so the primary's
+    AsyncController hands decisions here and every OTHER map entry gets
+    a SETMAP re-announcing the static map + the CTRL payload.  Same
+    duck type as ShardGroup.install_ctrl; receivers' monotone (ep, seq)
+    install makes re-delivery harmless.
+
+    The sends run on a lazily-started coalescing thread (the same
+    discipline ShardGroup.install_ctrl uses): a dark member's connect
+    timeout burns the announcer, never the controller's decision loop.
+    Back-to-back decisions coalesce into one sweep of the newest wire."""
+
+    def __init__(self, ps):
+        self.ps = ps
+        self._wire: Optional[dict] = None
+        self._evt = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def install_ctrl(self, wire: dict) -> None:
+        self._wire = dict(wire)
+        if self._thread is None:
+            from asyncframework_tpu.utils.threads import guarded
+
+            self._thread = threading.Thread(
+                target=guarded(self._loop), name="ctrl-fanout",
+                daemon=True)
+            self._thread.start()
+        self._evt.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._evt.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._evt.wait(timeout=0.5):
+                continue
+            self._evt.clear()
+            if self._stop.is_set():
+                return
+            self._sweep()
+
+    def _sweep(self) -> None:
+        wire = self._wire
+        if wire is None:
+            return
+        smap = self.ps.shard_map or []
+        epochs = self.ps.shard_epochs
+        for j, entry in enumerate(smap):
+            if j == self.ps.shard_index:
+                continue
+            hdr = {"op": "SETMAP", "index": j, "shards": smap,
+                   "ctrl": wire}
+            if epochs:
+                hdr["epochs"] = epochs
+            try:
+                _oneshot(str(entry[0]), int(entry[1]), hdr,
+                         timeout_s=3.0)
+            except (ConnectionError, OSError):
+                pass  # a dark shard re-learns ctrl from the next send
+
+
 def _child_main() -> int:
     """Env-driven shard process entry (``python -m
     asyncframework_tpu.parallel.shardgroup``): the role both
@@ -1889,7 +2012,25 @@ def _child_main() -> int:
         shard_map=smap_wire, shard_index=index,
         epoch=epoch_env or None, shard_epochs=shard_epochs or None,
         standby=standby,
-    ).start()
+    )
+    # adaptive asynchrony controller on the PRIMARY shard
+    # (async.control.enabled, e.g. the k8s shard-0 pod's env): closes
+    # the telemetry->knobs loop with decisions fanned to the other map
+    # entries via CtrlFanout (no ShardGroup owns k8s children).
+    # Started BEFORE ps.start() so the very first WELCOME served
+    # already carries the CTRL payload -- a worker that HELLOs in the
+    # gap would never build a ControlSink.
+    controller = None
+    ctrl_fanout = None
+    from asyncframework_tpu.conf import CONTROL_ENABLED, global_conf
+
+    if index == 0 and not standby and global_conf().get(CONTROL_ENABLED):
+        from asyncframework_tpu.parallel.controller import AsyncController
+
+        if smap_wire:
+            ctrl_fanout = CtrlFanout(ps)
+        controller = AsyncController(ps, group=ctrl_fanout).start()
+    ps.start()
     sbs_env = os.environ.get("ASYNC_SHARD_STANDBYS") or ""
     if sbs_env and not standby:
         # launcher-known standby endpoints (the k8s path, where SETMAP
@@ -1943,6 +2084,10 @@ def _child_main() -> int:
     # (bounded so a controller that died without SIGTERM cannot strand
     # an orphan serving forever)
     term.wait(timeout=float(os.environ.get("ASYNC_SHARD_LINGER_S", "600")))
+    if controller is not None:
+        controller.stop()
+    if ctrl_fanout is not None:
+        ctrl_fanout.stop()
     ps.stop()
     return 0
 

@@ -785,7 +785,154 @@ def run_dcn_child() -> None:
         out["failover"]["gap_ratio_restart_over_promote"] = (
             round(r / p, 2) if r and p else None
         )
+    # adaptive arm (ISSUE 15): static conf vs controller-on under the
+    # wan/DELAY deterministic heterogeneous cluster (the SAME seeded
+    # wan wire schedule + the cloud long-tail DelayModel in both arms),
+    # reporting time-to-target, updates/s, staleness p95, and the
+    # controller's decision trace.  Per-arm never-dark: a wedged or
+    # erroring arm records its error string, not a hole.
+    # BENCH_DCN_ADAPTIVE=0 drops the arm.
+    if os.environ.get("BENCH_DCN_ADAPTIVE", "1") != "0":
+        out["adaptive"] = {}
+        for label, on in (("static", False), ("controller", True)):
+            try:
+                out["adaptive"][label] = _dcn_adaptive_arm(on)
+            except Exception as e:  # noqa: BLE001 - never-dark per arm
+                out["adaptive"][label] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+        s = out["adaptive"].get("static", {})
+        a = out["adaptive"].get("controller", {})
+        tts, tta = s.get("time_to_target_s"), a.get("time_to_target_s")
+        out["adaptive"]["time_to_target_ratio_static_over_controller"] = (
+            round(tts / tta, 3) if tts and tta else None
+        )
+        us, ua = s.get("updates_per_sec"), a.get("updates_per_sec")
+        out["adaptive"]["updates_ratio_controller_over_static"] = (
+            round(ua / us, 3) if us and ua else None
+        )
     emit({"dcn": out})
+
+
+def _dcn_adaptive_arm(control_on: bool) -> dict:
+    """One adaptive-control measurement: the dense config on a
+    deterministic heterogeneous cluster -- every op pays the seeded wan
+    profile's delay/jitter/loss, and the cloud long-tail DelayModel
+    (``coeff=-1``) makes some logical workers persistently slow -- with
+    the knobs static vs closed-loop (AsyncController on the PS).  The
+    A-B shares the wire schedule and data seed, so the only difference
+    is who tunes the knobs."""
+    import jax
+
+    import numpy as np
+
+    from asyncframework_tpu.conf import AsyncConf, set_global_conf
+    from asyncframework_tpu.data.sharded import ShardedDataset
+    from asyncframework_tpu.metrics import trace as trace_mod
+    from asyncframework_tpu.net import faults, reset_net_totals
+    from asyncframework_tpu.parallel import controller as ctrl_mod
+    from asyncframework_tpu.parallel import ps_dcn
+    from asyncframework_tpu.parallel.controller import AsyncController
+    from asyncframework_tpu.solvers import SolverConfig
+
+    devices = jax.devices()
+    c = DCN_CONFIGS["dense"]
+    seed = int(os.environ.get("BENCH_ADAPTIVE_SEED", "7"))
+    conf = AsyncConf()
+    conf.set("async.pull.mode", "delta")
+    conf.set("async.pipeline.depth", 0)
+    conf.set("async.trace.sample", 1.0 / 8.0)
+    # fast decision cadence: bench arms run tens of seconds, not hours
+    conf.set("async.control.interval.s", 0.25)
+    conf.set("async.control.cooldown.s", 0.5)
+    set_global_conf(conf)
+    reset_net_totals()
+    ps_dcn.reset_pipeline_totals()
+    trace_mod.reset_aggregator()
+    ctrl_mod.reset_control_totals()
+    cfg = SolverConfig(
+        num_workers=c["nw"], num_iterations=c["iters"],
+        gamma=c["gamma"], taw=2**31 - 1, batch_rate=c["batch_rate"],
+        bucket_ratio=0.75, printer_freq=50, coeff=-1.0, seed=42,
+        calibration_iters=20, run_timeout_s=180.0,
+    )
+    ds = ShardedDataset.generate_on_device(
+        c["n"], c["d"], c["nw"], devices=devices, seed=7, noise=0.01,
+    )
+    inj = faults.FaultInjector(faults.wan_profile_schedule(seed))
+    ps = None
+    ctl = None
+    try:
+        # inside the try: a startup failure must still clear the global
+        # injector and stop the PS, or the OTHER adaptive arm (and any
+        # later dcn measurement in this child) runs with a stacked wan
+        # schedule -- corrupting the very A/B this arm exists for
+        faults.install(inj)
+        ps = ps_dcn.ParameterServer(
+            cfg, c["d"], c["n"], device=devices[0], port=0
+        ).start()
+        if control_on:
+            ctl = AsyncController(ps, conf=conf).start()
+        shards = {w: ds.shard(w) for w in range(c["nw"])}
+        t0 = time.monotonic()
+        ps_dcn.run_worker_process(
+            "127.0.0.1", ps.port, list(range(c["nw"])), shards, cfg,
+            c["d"], c["n"], deadline_s=180.0,
+        )
+        done = ps.wait_done(timeout_s=5.0)
+        elapsed = time.monotonic() - t0
+        times, W = ps.snapshot_stack()
+        losses = (ps_dcn.evaluate_snapshots_on_shards(
+            shards, times, W) / c["n"])
+        target = float(losses[0]) * 0.05
+        t_target = None
+        for t_ms, loss in zip(times, losses):
+            if float(loss) <= target:
+                t_target = round(float(t_ms) / 1e3, 3)
+                break
+        stal = trace_mod.aggregator().snapshot().get(
+            "staleness_versions", {})
+        rec = {
+            "ok": bool(done),
+            "control": bool(control_on),
+            "accepted": ps.accepted,
+            "dropped": ps.dropped,
+            "updates_per_sec": round(ps.accepted / elapsed, 1)
+            if elapsed > 0 else None,
+            "time_to_target_s": t_target,
+            "target_loss": round(target, 6),
+            "final_loss": round(float(losses[-1]), 6),
+            "staleness_p95": stal.get("p95"),
+            "max_staleness": ps.max_staleness,
+            "wan_faults_fired": len(inj.fired),
+        }
+        if ctl is not None:
+            decisions = ctl.decision_log()
+            rec["decisions"] = decisions
+            rec["control_totals"] = ctrl_mod.control_totals()
+            rec["knobs"] = ctl.status()["knobs"]
+            # controller_converged verdict on the REAL decision trace:
+            # cumulative change count as a synthesized control.changes
+            # series (flat tail = converged), judged by the conf rule
+            changes = [[d["t"] * 1e3, i + 1]
+                       for i, d in enumerate(decisions)]
+            changes.append([elapsed * 1e3, float(len(decisions))])
+            from asyncframework_tpu.metrics.slo import bench_verdicts
+
+            verdicts = bench_verdicts(
+                rec["updates_per_sec"],
+                [[t, float(l)] for t, l in zip(times, losses)],
+                extra_series={"control.changes": changes},
+            )
+            rec["slo"] = {"controller_converged":
+                          verdicts.get("controller_converged")}
+        return rec
+    finally:
+        if ctl is not None:
+            ctl.stop()
+        if ps is not None:
+            ps.stop()
+        faults.clear()
 
 
 def _dcn_failover_arm(standbys: int) -> dict:

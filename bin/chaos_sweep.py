@@ -134,6 +134,93 @@ def lockorder_sanity(seed: int) -> str:
         lockwatch.set_cycle_history(prior_history)
 
 
+def controller_sanity(seed: int) -> str:
+    """Per-seed adaptive-controller sanity (ISSUE 15): drive an
+    AsyncController on a ManualClock through a seeded straggler phase, a
+    steady phase, and an adversarial oscillating signal, and assert (1)
+    the cohort drops under straggler spread but NEVER below its declared
+    floor, (2) on the steady cluster the knob-change rate falls below
+    the ``controller_converged`` SLO threshold within its burn window,
+    and (3) the oscillation guard trips (and freezes the knob) on the
+    flapping signal.  Deterministic per seed; returns "" on pass."""
+    import random
+
+    sys.path.insert(0, REPO)
+    from asyncframework_tpu.conf import AsyncConf, set_global_conf
+    from asyncframework_tpu.metrics.slo import OK, SLOEngine, parse_rules
+    from asyncframework_tpu.metrics.timeseries import TimeSeriesStore
+    from asyncframework_tpu.parallel import controller as ctrl_mod
+    from asyncframework_tpu.utils.clock import ManualClock
+    from tests.test_controller import FakePS
+
+    rng = random.Random(seed)
+    set_global_conf(AsyncConf())
+    ctrl_mod.reset_control_totals()
+    clk = ManualClock()
+    ps = FakePS(num_workers=8, bucket_ratio=1.0)
+    ctl = ctrl_mod.AsyncController(ps, conf=AsyncConf(),
+                                   now_fn=lambda: clk.now_ms() / 1e3)
+    try:
+        store = TimeSeriesStore(capacity=512, clock=clk)
+        eng = SLOEngine(parse_rules(
+            "controller_converged: rate(control.changes) < 0.5 "
+            "over 20s for 5s"), store=store,
+            now_fn=lambda: clk.now_ms() / 1e3)
+
+        def run(n, stats_fn):
+            for _ in range(n):
+                clk.advance(1000)
+                ps.wstats = stats_fn()
+                ctl.tick()
+                store.record("control.changes",
+                             float(ctrl_mod.control_totals()["changes"]))
+                eng.evaluate()
+
+        def steady():
+            return {str(w): {"accepted": 50, "interval_ms":
+                             10.0 * (1 + rng.uniform(-0.05, 0.05))}
+                    for w in range(8)}
+
+        def straggler():
+            st = steady()
+            st["3"]["interval_ms"] = 200.0  # one DELAYed worker
+            return st
+
+        run(10, straggler)
+        b_low = ctl.status()["knobs"]["b"]["value"]
+        if not (1 <= b_low < 8):
+            return f"straggler phase left b={b_low}, want < conf 8"
+        floor = ctl._bounds["async.bucket.ratio"][0] * 8
+        if b_low < max(1, floor):
+            return f"b={b_low} actuated below declared floor {floor}"
+        run(30, steady)
+        view = eng.evaluate()["controller_converged"]
+        if view["state"] != OK:
+            return (f"controller_converged={view['state']!r} on a "
+                    f"steady cluster (value={view['value']})")
+        # adversarial flapping: alternate straggler on/off every tick
+        # faster than the cooldown can settle -- the guard must trip
+        flip = [False]
+
+        def flapping():
+            flip[0] = not flip[0]
+            return straggler() if flip[0] else steady()
+
+        before = ctrl_mod.control_totals()["osc_trips"]
+        # cooldown is 2s; tick every 3s so changes are admitted and the
+        # reversals accumulate
+        for _ in range(20):
+            clk.advance(3000)
+            ps.wstats = flapping()
+            ctl.tick()
+        if ctrl_mod.control_totals()["osc_trips"] <= before:
+            return "flapping signal never tripped the oscillation guard"
+        return ""
+    finally:
+        ctrl_mod.reset_control_totals()
+        set_global_conf(None)
+
+
 def run_seed(seed: int, args) -> dict:
     env = dict(os.environ)
     env["ASYNC_CHAOS_SEED"] = str(seed)
@@ -175,15 +262,21 @@ def run_seed(seed: int, args) -> dict:
     # SIGKILLed mid-run (seeded timing) and the collector must harvest
     # a dump whose last events straddle the kill and whose push ledger
     # matches the PS-side accepted_by_wid view (tests/test_observer.py)
+    # adaptive-controller chaos rides every seed: the wan/DELAY
+    # acceptance (controller-on run with an injected straggler converges
+    # without hand-tuning, decisions recorded, exactly-once + fencing
+    # hold across a mid-run promotion) plus the decision-logic units
+    # (tests/test_controller.py)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
         "tests/test_telemetry.py", "tests/test_shardgroup.py",
         "tests/test_fencing.py", "tests/test_relaycast.py",
         "tests/test_replication.py", "tests/test_observer.py",
+        "tests/test_controller.py",
         "-q", "-m",
         f"({marker}) or serve or telemetry or shard or fence or relay"
-        f" or repl or observer",
+        f" or repl or observer or ctrl",
         "-p", "no:cacheprovider",
     ]
     if args.soak:
@@ -215,6 +308,14 @@ def run_seed(seed: int, args) -> dict:
     if lock_err:
         ok = False
         summary = f"lock-order sanity: {lock_err} | {summary}"
+    # adaptive-controller sanity each seed: the cohort never actuates
+    # below its declared floor, the controller_converged SLO passes on a
+    # steady cluster, and the oscillation guard trips on a flapping
+    # signal (deterministic, seeded)
+    ctrl_err = controller_sanity(seed)
+    if ctrl_err:
+        ok = False
+        summary = f"controller sanity: {ctrl_err} | {summary}"
     return {
         "seed": seed,
         "ok": ok,

@@ -149,6 +149,81 @@ class TestConfRule:
         f = rules_conf.check(ctx)
         assert rule_tokens(f, "conf-key-grammar") == ["async.win_max.knob"]
 
+    # -------------------------------------- tunable discipline (ISSUE 15)
+    TUNABLE_CONF = '''
+class ConfigEntry:
+    def __init__(self, *a, **k):
+        pass
+
+STEP = ConfigEntry("async.step.size", 0.1, float, "gamma",
+                   tunable=True, floor=0.05, ceiling=1.0)
+OTHER = ConfigEntry("async.other.knob", 1, int, "not tunable")
+'''
+
+    def test_tunable_without_bounds_fires(self, tmp_path):
+        """Un-declaring a bound (or the whole marker, below) is the
+        mutation the rule exists for: a tunable the controller cannot be
+        clamped against must fail the lint."""
+        mutated = self.TUNABLE_CONF.replace(", floor=0.05", "")
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": mutated,
+            "asyncframework_tpu/user.py":
+                'x = conf.get("async.step.size")\n'
+                'y = conf.get("async.other.knob")\n',
+        })
+        f = rules_conf.check(ctx)
+        assert rule_tokens(f, "conf-tunable") == ["async.step.size"]
+
+    def test_actuating_undeclared_tunable_fires(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": self.TUNABLE_CONF,
+            "asyncframework_tpu/parallel/controller.py":
+                'CONTROLLER_TUNABLES = {"async.step.size": "damp"}\n'
+                'class C:\n'
+                '    def go(self, knob, now):\n'
+                '        self._actuate("async.step.size", knob, 1.0,\n'
+                '                      now, "ok", 0.05, 1.0)\n'
+                '        self._actuate("async.other.knob", knob, 2.0,\n'
+                '                      now, "bad", 1.0, 8.0)\n',
+        })
+        f = rules_conf.check(ctx)
+        assert rule_tokens(f, "conf-tunable") == ["async.other.knob"]
+
+    def test_undeclaring_a_tunable_fails_lint(self, tmp_path):
+        """The other mutation direction: the controller's declared
+        surface (CONTROLLER_TUNABLES) names a key whose ConfigEntry
+        lost its tunable=True marker."""
+        mutated = self.TUNABLE_CONF.replace("tunable=True, ", "")
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/conf.py": mutated,
+            "asyncframework_tpu/parallel/controller.py":
+                'CONTROLLER_TUNABLES = {"async.step.size": "damp"}\n',
+        })
+        f = rules_conf.check(ctx)
+        assert rule_tokens(f, "conf-tunable") == ["async.step.size"]
+
+    def test_real_controller_surface_is_declared(self):
+        """Every tunable the REAL controller actuates is a registered
+        tunable ConfigEntry with bounds (the runtime twin lives in
+        AsyncController.__init__)."""
+        from asyncframework_tpu.analysis.rules_conf import (
+            _actuated_keys,
+            declared_tunables,
+        )
+        from asyncframework_tpu.parallel.controller import (
+            CONTROLLER_TUNABLES,
+        )
+
+        ctx = LintContext(REPO)
+        tunables = declared_tunables(ctx)
+        actuated = {k for k, _line in _actuated_keys(ctx)}
+        assert actuated, "controller actuation surface not parsed"
+        assert set(CONTROLLER_TUNABLES) <= actuated
+        for key in actuated:
+            assert key in tunables, key
+            has_floor, has_ceiling, _line = tunables[key]
+            assert has_floor and has_ceiling, key
+
     def test_clean_tree_is_silent_for_conf(self):
         result = run_lint(REPO, rules=["conf"])
         assert result.findings == [], [f.format() for f in result.findings]

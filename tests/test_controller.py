@@ -1,0 +1,1013 @@
+"""Adaptive asynchrony controller (ISSUE 15).
+
+The correctness spine:
+
+- the delay-adaptive damping law is EXACT and per-item: monotone
+  non-increasing in staleness, bounded in [floor, 1], free slack before
+  it engages, and the damped merge kernel is bit-identical to the
+  damped serial kernel at every factor (1.0 included, where both match
+  the legacy undamped kernel bit for bit) -- so dedup/replay semantics
+  are untouched;
+- decisions are guarded: hysteresis dead-band, per-knob cooldown, and
+  an oscillation guard that freezes a flapping knob; the cohort never
+  actuates below its declared floor, pipeline depth never exceeds the
+  configured depth, the merge budget never exceeds the compiled bound;
+- CTRL propagation is monotone and fence-stamped: WELCOME/PULL deliver
+  it to workers (re-delivered only while the ``cs`` stamp lags), SETMAP
+  carries it to shard members, and a stale (ep, seq) install is refused
+  -- decisions survive relaunches and promotions;
+- ``async.control.enabled=0`` is byte- and step-identical to the knob
+  being absent (per-op frame-byte totals under a fixed seed);
+- THE acceptance (`ctrl` marker, rides every bin/chaos_sweep.py seed):
+  a real heterogeneous cluster -- 3-shard group with warm standbys, two
+  worker processes, one DELAY-injected straggler, the wan net profile
+  when the sweep asks for it -- converges WITHOUT hand-tuning under the
+  controller, decisions are recorded, and exactly-once + fencing
+  invariants hold across a mid-run shard promotion.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu import conf as conf_mod
+from asyncframework_tpu.conf import AsyncConf, set_global_conf
+from asyncframework_tpu.metrics.top import (
+    render_control,
+    render_fleet,
+    render_status,
+)
+from asyncframework_tpu.net import faults, frame, reset_net_totals
+from asyncframework_tpu.net.retry import reset_breakers
+from asyncframework_tpu.parallel import controller as ctrl_mod
+from asyncframework_tpu.parallel import ps_dcn
+from asyncframework_tpu.parallel import shardgroup as sg
+from asyncframework_tpu.parallel.controller import (
+    CONTROLLER_TUNABLES,
+    AsyncController,
+    ControlSink,
+    ctrl_seq,
+)
+from asyncframework_tpu.solvers import SolverConfig
+from asyncframework_tpu.utils.clock import ManualClock
+
+pytestmark = pytest.mark.ctrl
+
+CHILD = Path(__file__).parent / "ps_dcn_child.py"
+CHAOS_SEED = int(os.environ.get("ASYNC_CHAOS_SEED", "7"))
+
+
+def make_cfg(**kw):
+    defaults = dict(
+        num_workers=4, num_iterations=60, gamma=1.2, taw=2**31 - 1,
+        batch_rate=0.3, bucket_ratio=0.5, printer_freq=20, seed=42,
+        calibration_iters=10**9, run_timeout_s=120.0,
+    )
+    defaults.update(kw)
+    return SolverConfig(**defaults)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    reset_net_totals()
+    reset_breakers()
+    ctrl_mod.reset_control_totals()
+    set_global_conf(AsyncConf())
+    yield
+    reset_net_totals()
+    reset_breakers()
+    ctrl_mod.reset_control_totals()
+    set_global_conf(None)
+
+
+class FakePS:
+    """Controller test double: just the surface AsyncController reads
+    (also imported by bin/chaos_sweep.py's per-seed controller_sanity)."""
+
+    def __init__(self, num_workers=8, bucket_ratio=1.0, pipeline_depth=0,
+                 merge_max=8, epoch=0):
+        self.cfg = make_cfg(num_workers=num_workers,
+                            bucket_ratio=bucket_ratio,
+                            pipeline_depth=pipeline_depth)
+        self._merge_max = merge_max
+        self.epoch = epoch
+        self.wstats = {}
+        self.signals = {"queue_depth": 0.0, "accepted": 0.0,
+                        "done": 0.0}
+        self.installed = []
+
+    def worker_stats(self):
+        return {w: dict(st) for w, st in self.wstats.items()}
+
+    def control_signals(self):
+        return dict(self.signals)
+
+    def set_control(self, wire):
+        self.installed.append(dict(wire))
+        return True
+
+
+def manual_controller(ps, **kw):
+    clk = ManualClock()
+    ctl = AsyncController(ps, conf=AsyncConf(),
+                          now_fn=lambda: clk.now_ms() / 1e3, **kw)
+    return ctl, clk
+
+
+def steady_stats(nw=8, iv=10.0):
+    return {str(w): {"accepted": 50, "interval_ms": iv}
+            for w in range(nw)}
+
+
+# ------------------------------------------------------------ damping law
+class TestDampLaw:
+    def _ps(self, algo="asgd"):
+        import jax
+
+        cfg = make_cfg(num_workers=4)
+        return ps_dcn.ParameterServer(cfg, 8, 64,
+                                      device=jax.devices()[0], port=0,
+                                      algo=algo)
+
+    def test_monotone_bounded_with_free_slack(self):
+        ps = self._ps()
+        try:
+            assert ps._item_damp(0, 10**6) == 1.0  # control off: exact
+            ps.set_control({"seq": 1, "ep": 0,
+                            "damp": [1.0, 0.1, 4.0]})
+            # within the free slack: exactly 1.0 (undamped, bit-exact)
+            for tau in (0, 1, 4):
+                assert ps._item_damp(0, tau) == 1.0
+            vals = [ps._item_damp(0, tau) for tau in range(5, 200)]
+            assert all(v2 <= v1 for v1, v2 in zip(vals, vals[1:]))
+            assert all(0.1 <= v < 1.0 for v in vals)
+            # deep staleness hits the floor, never below
+            assert ps._item_damp(0, 10**6) == 0.1
+            # the 1/(1+tau-free) family, exactly
+            assert ps._item_damp(0, 6) == pytest.approx(1.0 / 3.0)
+        finally:
+            ps.stop()
+
+    def test_wdamp_scales_and_floors(self):
+        ps = self._ps()
+        try:
+            ps.set_control({"seq": 1, "ep": 0,
+                            "damp": [1.0, 0.2, 100.0],
+                            "wdamp": {"2": 0.5}})
+            assert ps._item_damp(0, 0) == 1.0      # not in the table
+            assert ps._item_damp(2, 0) == 0.5      # extra per-worker damp
+            ps.set_control({"seq": 2, "ep": 0,
+                            "damp": [1.0, 0.2, 100.0],
+                            "wdamp": {"2": 0.01}})
+            assert ps._item_damp(2, 0) == 0.2      # floored
+        finally:
+            ps.stop()
+
+    def test_asaga_excluded_from_damping(self):
+        ps = self._ps(algo="asaga")
+        try:
+            ps.set_control({"seq": 1, "ep": 0, "damp": [1.0, 0.1, 0.0]})
+            assert ps._ctrl_damp is None
+            assert ps._item_damp(0, 10**6) == 1.0
+        finally:
+            ps.stop()
+
+    def test_never_exactly_zero(self):
+        ps = self._ps()
+        try:
+            # adversarial wire: floor 0 -- an accepted item's factor must
+            # stay strictly positive (the kernel keep bit is mask > 0)
+            ps.set_control({"seq": 1, "ep": 0, "damp": [1.0, 0.0, 0.0],
+                            "wdamp": {"0": 0.0}})
+            assert ps._item_damp(0, 10**9) > 0.0
+        finally:
+            ps.stop()
+
+
+class TestKernelExactness:
+    D, M = 16, 4
+
+    def _mats(self, seed):
+        rng = np.random.default_rng(seed)
+        w0 = rng.standard_normal(self.D).astype(np.float32)
+        G = rng.standard_normal((self.M, self.D)).astype(np.float32)
+        return w0, G
+
+    def test_damped_merge_bit_identical_to_damped_serial(self):
+        import jax
+        import jax.numpy as jnp
+
+        from asyncframework_tpu.ops import steps
+
+        w0, G = self._mats(CHAOS_SEED)
+        damps = np.array([1.0, 0.37, 0.1, 0.85], np.float32)
+        merge = steps.make_asgd_apply_merge(1.2, 0.3, 64, 4)
+        serial = steps.make_asgd_apply_damped(1.2, 0.3, 64, 4)
+        wm, km = merge(jnp.asarray(w0), jnp.asarray(G),
+                       jnp.asarray(damps), jnp.float32(0.0))
+        ws, ks = jnp.asarray(w0), jnp.float32(0.0)
+        for j in range(self.M):
+            ws, ks = serial(ws, jnp.asarray(G[j]), ks,
+                            np.float32(damps[j]))
+        assert np.asarray(wm).tobytes() == np.asarray(ws).tobytes()
+        assert float(km) == float(ks) == 4.0
+
+    def test_damp_one_bit_identical_to_legacy_kernel(self):
+        import jax.numpy as jnp
+
+        from asyncframework_tpu.ops import steps
+
+        w0, G = self._mats(CHAOS_SEED + 1)
+        merge = steps.make_asgd_apply_merge(1.2, 0.3, 64, 4)
+        legacy = steps.make_asgd_apply(1.2, 0.3, 64, 4)
+        wm, _ = merge(jnp.asarray(w0), jnp.asarray(G),
+                      jnp.ones(self.M, jnp.float32), jnp.float32(0.0))
+        wl, kl = jnp.asarray(w0), jnp.float32(0.0)
+        for j in range(self.M):
+            wl, kl = legacy(wl, jnp.asarray(G[j]), kl)
+        assert np.asarray(wm).tobytes() == np.asarray(wl).tobytes()
+
+
+# -------------------------------------------------------- decision units
+class TestDecisionUnits:
+    def test_b_drops_per_straggler(self):
+        ps = FakePS(num_workers=8, bucket_ratio=1.0)  # conf b = 8
+        ctl, clk = manual_controller(ps)
+        stats = steady_stats()
+        stats["3"]["interval_ms"] = 500.0
+        stats["5"]["interval_ms"] = 400.0
+        for _ in range(4):
+            clk.advance(3000)
+            ps.wstats = stats
+            ctl.tick()
+        assert ctl.status()["knobs"]["b"]["value"] == 6  # 8 - 2 flagged
+
+    def test_b_never_below_declared_floor(self):
+        class AllFlagged:
+            def derived(self):
+                return {}
+
+            def stragglers(self):
+                return {str(w): {"score": 9.0, "flagged": True}
+                        for w in range(8)}
+
+        ps = FakePS(num_workers=8, bucket_ratio=1.0)
+        ctl, clk = manual_controller(ps, observer=AllFlagged())
+        floor = max(1, ctl._bounds["async.bucket.ratio"][0] * 8)
+        before = ctrl_mod.control_totals()["clamps"]
+        for _ in range(8):
+            clk.advance(3000)
+            ps.wstats = steady_stats()
+            ctl.tick()
+        # every worker flagged: the raw target (0) is clamped at the
+        # declared floor, never below
+        assert ctl.status()["knobs"]["b"]["value"] == floor
+        assert ctrl_mod.control_totals()["clamps"] > before
+
+    def test_two_worker_cohort_still_flags(self):
+        # peer-median-excluding-self: the observer's stance, so a
+        # 2-worker cohort can flag its 10x member
+        ps = FakePS(num_workers=2, bucket_ratio=1.0)
+        ctl, clk = manual_controller(ps)
+        stats = steady_stats(nw=2)
+        stats["1"]["interval_ms"] = 500.0
+        for _ in range(4):
+            clk.advance(3000)
+            ps.wstats = stats
+            ctl.tick()
+        assert ctl.status()["knobs"]["b"]["value"] == 1
+
+    def test_b_restores_when_spread_closes(self):
+        ps = FakePS(num_workers=8, bucket_ratio=1.0)
+        ctl, clk = manual_controller(ps)
+        slow = steady_stats()
+        slow["3"]["interval_ms"] = 400.0
+        for _ in range(4):
+            clk.advance(3000)
+            ps.wstats = slow
+            ctl.tick()
+        assert ctl.status()["knobs"]["b"]["value"] == 7
+        for _ in range(4):
+            clk.advance(3000)
+            ps.wstats = steady_stats()
+            ctl.tick()
+        assert ctl.status()["knobs"]["b"]["value"] == 8
+
+    def test_hysteresis_blocks_sub_step_changes(self):
+        ps = FakePS()
+        ctl, clk = manual_controller(ps)
+        knob = ctl._knobs["merge"]
+        # within the dead-band (< max(1, 25%)): no actuation
+        got = ctl._actuate("async.push.merge", knob, knob.value + 0.5,
+                           clk.now_ms() / 1e3, "test", 1.0, 64.0)
+        assert got == [] and knob.changes == 0
+
+    def test_cooldown_blocks_rapid_changes(self):
+        ps = FakePS()
+        ctl, clk = manual_controller(ps)
+        knob = ctl._knobs["merge"]
+        now = lambda: clk.now_ms() / 1e3  # noqa: E731
+        assert ctl._actuate("async.push.merge", knob, 4.0, now(),
+                            "t", 1.0, 64.0)
+        clk.advance(500)  # < cooldown 2s
+        assert ctl._actuate("async.push.merge", knob, 16.0, now(),
+                            "t", 1.0, 64.0) == []
+        clk.advance(5000)
+        assert ctl._actuate("async.push.merge", knob, 16.0, now(),
+                            "t", 1.0, 64.0)
+
+    def test_oscillation_guard_trips_and_freezes(self):
+        ps = FakePS()
+        ctl, clk = manual_controller(ps)
+        knob = ctl._knobs["merge"]
+        now = lambda: clk.now_ms() / 1e3  # noqa: E731
+        before = ctrl_mod.control_totals()["osc_trips"]
+        targets = [2.0, 8.0, 2.0, 8.0, 2.0, 8.0]
+        for t in targets:
+            clk.advance(3000)
+            ctl._actuate("async.push.merge", knob, t, now(), "flap",
+                         1.0, 64.0)
+        assert ctrl_mod.control_totals()["osc_trips"] > before
+        assert ctl.status()["knobs"]["merge"]["frozen"] is True
+        frozen_at = knob.value
+        clk.advance(2000)  # still inside the freeze window
+        ctl._actuate("async.push.merge", knob, frozen_at + 30, now(),
+                     "t", 1.0, 64.0)
+        assert knob.value == frozen_at
+        clk.advance(60_000)  # freeze expires, history cleared
+        assert ctl._actuate("async.push.merge", knob, frozen_at + 30,
+                            now(), "t", 1.0, 64.0)
+
+    def test_depth_sized_from_rtt_vs_compute_and_capped(self):
+        ps = FakePS(pipeline_depth=4)
+        ctl, clk = manual_controller(ps)
+        stats = steady_stats()
+        for st in stats.values():
+            st["rtt_ms"], st["compute_ms"] = 20.0, 10.0
+        for _ in range(3):
+            clk.advance(3000)
+            ps.wstats = stats
+            ctl.tick()
+        # 1 + 20/10 = 3, within [1, configured 4]
+        assert ctl.status()["knobs"]["depth"]["value"] == 3
+        for st in stats.values():
+            st["rtt_ms"] = 500.0  # formula says 51 -- cap at configured
+        for _ in range(3):
+            clk.advance(3000)
+            ps.wstats = stats
+            ctl.tick()
+        assert ctl.status()["knobs"]["depth"]["value"] == 4
+        assert ctrl_mod.control_totals()["clamps"] >= 1
+
+    def test_depth_untouched_on_serial_loops(self):
+        ps = FakePS(pipeline_depth=0)
+        ctl, clk = manual_controller(ps)
+        stats = steady_stats()
+        for st in stats.values():
+            st["rtt_ms"], st["compute_ms"] = 50.0, 1.0
+        clk.advance(3000)
+        ps.wstats = stats
+        ctl.tick()
+        assert ctl.status()["knobs"]["depth"]["value"] == 0
+        assert ctl.ctrl_wire()["depth"] == 0
+
+    def test_merge_budget_tracks_queue_pressure(self):
+        ps = FakePS(merge_max=8)
+        ctl, clk = manual_controller(ps)
+        ps.signals["queue_depth"] = 20.0
+        # budget starts at the conf 8 (= compiled bound): pressure can
+        # never grow it past the bound
+        for _ in range(4):
+            clk.advance(3000)
+            ctl.tick()
+        assert ctl.status()["knobs"]["merge"]["value"] == 8
+        ps.signals["queue_depth"] = 0.0
+        for _ in range(14):  # the queue EWMA must decay below the
+            clk.advance(3000)  # shrink threshold (0.125 * budget) first
+            ctl.tick()
+        assert ctl.status()["knobs"]["merge"]["value"] < 8
+
+    def test_supervisor_suspects_count_as_stragglers(self):
+        from asyncframework_tpu.parallel import supervisor as sup_mod
+
+        class FakeSup:
+            def membership(self):
+                return {2: {"state": sup_mod.SUSPECT},
+                        3: {"state": "live"}}
+
+        ps = FakePS(num_workers=8, bucket_ratio=1.0)
+        ps.supervisor = FakeSup()
+        ctl, clk = manual_controller(ps)
+        for _ in range(4):
+            clk.advance(3000)
+            ps.wstats = steady_stats()  # intervals all even: only the
+            ctl.tick()                  # SUSPECT membership flags w2
+        assert ctl.status()["knobs"]["b"]["value"] == 7
+
+    def test_wdamp_follows_observer_straggler_flags(self):
+        class FakeObserver:
+            table = {}
+
+            def derived(self):
+                return {}
+
+            def stragglers(self):
+                return dict(self.table)
+
+        obs = FakeObserver()
+        ps = FakePS()
+        ctl, clk = manual_controller(ps, observer=obs)
+        obs.table = {"5": {"score": 4.0, "flagged": True},
+                     "1": {"score": 1.1, "flagged": False}}
+        clk.advance(3000)
+        ctl.tick()
+        wire = ctl.ctrl_wire()
+        assert wire["wdamp"] == {"5": 0.25}
+        obs.table = {}
+        clk.advance(1000)  # inside the cooldown: the clear must WAIT
+        ctl.tick()         # (wdamp rides the same guards as the knobs)
+        assert ctl.ctrl_wire()["wdamp"] == {"5": 0.25}
+        clk.advance(3000)
+        ctl.tick()
+        assert "wdamp" not in ctl.ctrl_wire()
+        assert ctrl_mod.control_totals()["wdamp_set"] == 2
+
+    def test_actuating_undeclared_key_raises(self):
+        ps = FakePS()
+        ctl, clk = manual_controller(ps)
+        with pytest.raises(ValueError, match="undeclared tunable"):
+            ctl._actuate("async.pull.mode", ctl._knobs["merge"], 2.0,
+                         0.0, "t", 1.0, 8.0)
+
+    def test_wire_seq_monotone_and_fence_stamped(self):
+        ps = FakePS(epoch=3)
+        ctl, _clk = manual_controller(ps)
+        ctl._install("r1")
+        ctl._install("r2")
+        w1, w2 = ps.installed[-2:]
+        assert w2["seq"] == w1["seq"] + 1
+        assert w1["ep"] == 3
+        assert ctrl_seq(w2) > ctrl_seq(w1)
+
+
+# ----------------------------------------------------- CTRL propagation
+class TestCtrlPropagation:
+    def test_sink_monotone_install_and_depth_clamp(self):
+        sink = ControlSink({"seq": 4, "ep": 1, "depth": 3})
+        assert sink.seq == 4
+        assert sink.depth(configured=8) == 3
+        assert sink.depth(configured=2) == 2      # never past configured
+        assert not sink.install({"seq": 3, "ep": 1, "depth": 9})
+        assert sink.depth(configured=8) == 3      # stale install refused
+        assert sink.install({"seq": 1, "ep": 2, "depth": 9})  # newer ep
+        assert sink.depth(configured=8) == 8
+        sink2 = ControlSink({"seq": 1, "ep": 0})
+        assert sink2.depth(configured=5) == 5     # 0/absent = configured
+
+    def test_ps_install_is_monotone_and_fence_stamped(self):
+        import jax
+
+        ps = ps_dcn.ParameterServer(make_cfg(), 8, 64,
+                                    device=jax.devices()[0], port=0)
+        try:
+            assert ps.set_control({"seq": 2, "ep": 1, "b": 2})
+            assert not ps.set_control({"seq": 1, "ep": 1, "b": 3})
+            # a deposed controller's stamp (older epoch) is refused even
+            # at a higher seq -- promotion safety for decisions
+            assert not ps.set_control({"seq": 9, "ep": 0, "b": 3})
+            assert ps.ctrl["b"] == 2 and ps.ctrl_stale_rejects == 2
+            assert ps.set_control({"seq": 1, "ep": 2, "b": 4})
+            assert ps._ctrl_b == 4
+        finally:
+            ps.stop()
+
+    def test_cohort_threshold_uses_ctrl_b(self):
+        import jax
+
+        ps = ps_dcn.ParameterServer(make_cfg(num_workers=8,
+                                             bucket_ratio=1.0),
+                                    8, 64, device=jax.devices()[0],
+                                    port=0)
+        try:
+            assert ps._cohort_threshold() == 8
+            ps.set_control({"seq": 1, "ep": 0, "b": 3})
+            assert ps._cohort_threshold() == 3
+            ps.set_control({"seq": 2, "ep": 0, "b": 0})  # override off
+            assert ps._cohort_threshold() == 8
+        finally:
+            ps.stop()
+
+    def test_welcome_and_pull_deliver_then_stop_redelivering(self):
+        import jax
+
+        cfg = make_cfg(num_workers=1, bucket_ratio=0.0)
+        ps = ps_dcn.ParameterServer(cfg, 8, 64,
+                                    device=jax.devices()[0],
+                                    port=0).start()
+        cl = None
+        try:
+            ps.set_control({"seq": 5, "ep": 0, "b": 1,
+                            "damp": [1.0, 0.1, 1.0]})
+            hello_cl = ps_dcn.PSClient("127.0.0.1", ps.port)
+            welcome = hello_cl.hello("t-proc", [0], pid=os.getpid())
+            hello_cl.bye()
+            assert welcome["ctrl"]["seq"] == 5  # WELCOME carries CTRL
+            sink = ControlSink(welcome["ctrl"])
+            installs = []
+            orig = sink.install
+            sink.install = lambda w: installs.append(w) or orig(w)
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, ctrl_sink=sink)
+            got = cl.pull(0)
+            assert got is not None
+            # the request's cs stamp (5) is current: NOT re-delivered
+            assert installs == []
+            ps.set_control({"seq": 6, "ep": 0, "b": 1,
+                            "damp": [1.0, 0.1, 1.0]})
+            got = cl.pull(0)
+            assert got is not None
+            assert [w["seq"] for w in installs] == [6]
+            assert sink.seq == 6
+            got = cl.pull(0)  # acked: no third delivery
+            assert [w["seq"] for w in installs] == [6]
+        finally:
+            if cl is not None:
+                cl.bye()
+            ps.stop()
+
+    def test_restarted_controller_epoch_redelivers_over_pull(self):
+        """A relaunched controller under a minted HIGHER epoch restarts
+        seq near 1: the PULL re-delivery gate must compare the full
+        (epoch, seq) stamp -- a bare-seq compare would strand every
+        surviving worker on the deposed controller's decisions."""
+        import jax
+
+        cfg = make_cfg(num_workers=1, bucket_ratio=0.0)
+        ps = ps_dcn.ParameterServer(cfg, 8, 64,
+                                    device=jax.devices()[0],
+                                    port=0).start()
+        cl = None
+        try:
+            ps.set_control({"seq": 57, "ep": 1, "b": 1})
+            sink = ControlSink({"seq": 57, "ep": 1, "b": 1})
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port, ctrl_sink=sink)
+            # the restarted controller's first decision: higher epoch,
+            # tiny seq
+            assert ps.set_control({"seq": 1, "ep": 2, "b": 1})
+            assert cl.pull(0) is not None
+            assert sink.wire()["ep"] == 2 and sink.seq == 1
+        finally:
+            if cl is not None:
+                cl.bye()
+            ps.stop()
+
+    def test_setmap_carries_ctrl_and_stale_refused(self):
+        import jax
+
+        ps = ps_dcn.ParameterServer(make_cfg(), 8, 64,
+                                    device=jax.devices()[0],
+                                    port=0).start()
+        try:
+            wire_map = [["127.0.0.1", ps.port, 0, 8]]
+            sg._oneshot("127.0.0.1", ps.port,
+                        {"op": "SETMAP", "index": 0, "shards": wire_map,
+                         "ctrl": {"seq": 3, "ep": 0, "merge": 2}},
+                        timeout_s=5.0)
+            assert ps.ctrl["seq"] == 3 and ps._ctrl_merge == 2
+            # SHARDMAP advertises the installed ctrl (observability +
+            # promotion-following clients)
+            hdr = sg._oneshot("127.0.0.1", ps.port, {"op": "SHARDMAP"},
+                              timeout_s=5.0)
+            assert hdr["ctrl"]["seq"] == 3
+            sg._oneshot("127.0.0.1", ps.port,
+                        {"op": "SETMAP", "index": 0, "shards": wire_map,
+                         "ctrl": {"seq": 1, "ep": 0, "merge": 7}},
+                        timeout_s=5.0)
+            assert ps.ctrl["seq"] == 3 and ps._ctrl_merge == 2
+        finally:
+            ps.stop()
+
+    def test_damped_pushes_mirror_to_standby_exactly(self):
+        """The replication stream ships each item's damp factor: a hot
+        standby must apply EXACTLY the step the primary did, or its
+        model silently diverges and a promotion serves the divergent
+        copy (the regression class PR 13's _k_dev fix closed)."""
+        set_global_conf(AsyncConf({"async.fence.enabled": True}))
+        cfg = make_cfg(num_workers=2, num_iterations=10**6,
+                       bucket_ratio=0.0, printer_freq=10)
+        prim = ps_dcn.ParameterServer(cfg, 8, 64, port=0).start()
+        sb = ps_dcn.ParameterServer(cfg, 8, 64, port=0,
+                                    standby=True).start()
+        prim.attach_standby("127.0.0.1", sb.port)
+        cl = None
+        try:
+            # free slack 0: every push at staleness >= 1 is damped
+            prim.set_control({"seq": 1, "ep": prim.epoch or 0,
+                              "damp": [1.0, 0.1, 0.0]})
+            assert prim._ctrl_damp is not None
+            cl = ps_dcn.PSClient("127.0.0.1", prim.port)
+            rng = np.random.default_rng(CHAOS_SEED)
+            ts0, _w, _a, _c = cl.pull(0)
+            for _ in range(20):
+                # re-push against the ORIGINAL basis: staleness climbs
+                # 0,1,2,... so most applies run the damped kernel
+                cl.push(0, ts0, rng.normal(size=8).astype(np.float32))
+            assert prim.max_staleness >= 1  # damping definitely engaged
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if sb._clock >= prim._clock and prim.repl.synced:
+                    break
+                time.sleep(0.02)
+            assert sb._clock == prim._clock
+            np.testing.assert_array_equal(np.asarray(prim._w),
+                                          np.asarray(sb._w))
+            cl.bye()
+            cl = None
+        finally:
+            if cl is not None:
+                cl.bye()
+            prim.stop()
+            sb.stop()
+
+    def test_equal_stamp_redelivery_not_counted_stale(self):
+        import jax
+
+        ps = ps_dcn.ParameterServer(make_cfg(), 8, 64,
+                                    device=jax.devices()[0], port=0)
+        try:
+            wire = {"seq": 2, "ep": 1, "b": 2}
+            assert ps.set_control(wire)
+            # the group re-announces its stored ctrl on every SETMAP
+            # sweep: an identical re-delivery is idempotent, NOT a
+            # deposed-controller fence event
+            assert not ps.set_control(dict(wire))
+            assert ps.ctrl_stale_rejects == 0
+            assert not ps.set_control({"seq": 1, "ep": 1, "b": 9})
+            assert ps.ctrl_stale_rejects == 1
+        finally:
+            ps.stop()
+
+    def test_drain_budget_resized_by_ctrl(self):
+        import jax
+
+        ps = ps_dcn.ParameterServer(make_cfg(num_workers=1,
+                                             bucket_ratio=0.0),
+                                    8, 64, device=jax.devices()[0],
+                                    port=0)
+        try:
+            assert ps._merge_max == 8  # conf default = compiled bound
+            ps.set_control({"seq": 1, "ep": 0, "merge": 2})
+            assert ps._ctrl_merge == 2
+            # a hostile/overshooting decision can never exceed the
+            # compiled bound
+            ps.set_control({"seq": 2, "ep": 0, "merge": 512})
+            assert min(ps._ctrl_merge, ps._merge_max) == 8
+        finally:
+            ps.stop()
+
+
+# --------------------------------------------------------- byte identity
+class TestControlOffIsClassic:
+    def test_enabled0_conf_set_matches_unset_byte_identical(self):
+        """`async.control.enabled=0` must leave the wire byte-identical
+        and the run step-identical to the knob being absent (the
+        shards=1 / depth=0 / devices=0 discipline): per-op frame-byte
+        totals must match EXACTLY under a fixed seed."""
+        import jax
+
+        from asyncframework_tpu.data.sharded import ShardedDataset
+
+        results = []
+        for control_conf in (None, "0"):
+            conf = (AsyncConf().set("async.pull.mode", "full")
+                    .set("async.trace.sample", 0.0))
+            if control_conf is not None:
+                conf.set("async.control.enabled", control_conf)
+            set_global_conf(conf)
+            reset_net_totals()
+            cfg = make_cfg(num_workers=1, num_iterations=40,
+                           bucket_ratio=0.0)
+            dev = jax.devices()[0]
+            ds = ShardedDataset.generate_on_device(
+                512, 16, 1, devices=[dev], seed=11, noise=0.01)
+            ps = ps_dcn.ParameterServer(cfg, 16, 512, device=dev,
+                                        port=0).start()
+            try:
+                counts = ps_dcn.run_worker_process(
+                    "127.0.0.1", ps.port, [0], {0: ds.shard(0)}, cfg,
+                    16, 512, deadline_s=120.0)
+                assert ps.wait_done(timeout_s=10.0)
+            finally:
+                ps.stop()
+            results.append({
+                "accepted": ps.accepted, "dropped": ps.dropped,
+                "max_staleness": ps.max_staleness, "clock": ps._clock,
+                "counts": dict(counts),
+                "bytes": frame.bytes_totals(),
+            })
+        unset, off = results
+        assert unset["accepted"] == off["accepted"] == 40
+        assert unset == off, (unset, off)
+
+    def test_control_off_ps_serves_no_ctrl_keys(self):
+        import jax
+
+        ps = ps_dcn.ParameterServer(make_cfg(num_workers=1,
+                                             bucket_ratio=0.0),
+                                    8, 64, device=jax.devices()[0],
+                                    port=0).start()
+        try:
+            cl = ps_dcn.PSClient("127.0.0.1", ps.port)
+            welcome = cl.hello("t", [0], pid=os.getpid())
+            assert "ctrl" not in welcome
+            hdr = sg._oneshot("127.0.0.1", ps.port, {"op": "SHARDMAP"},
+                              timeout_s=5.0)
+            assert "ctrl" not in hdr
+            cl.bye()
+        finally:
+            ps.stop()
+
+
+# -------------------------------------------------------------- surfaces
+class TestSurfaces:
+    def test_tunables_declared_with_bounds(self):
+        reg = conf_mod.registry()
+        for key in CONTROLLER_TUNABLES:
+            entry = reg[key]
+            assert entry.tunable is True
+            assert entry.floor is not None and entry.ceiling is not None
+            assert entry.floor < entry.ceiling
+
+    def test_registry_has_control_family(self):
+        from asyncframework_tpu.metrics import registry
+
+        fams = registry.families()
+        assert "control" in fams
+        tot = fams["control"].totals()
+        assert "changes" in tot and "osc_trips" in tot
+        assert "control" in registry.series_families()
+
+    def test_default_rules_include_controller_converged(self):
+        from asyncframework_tpu.metrics.slo import parse_rules
+
+        rules = {r.name: r for r in parse_rules(
+            conf_mod.SLO_RULES.default)}
+        rule = rules["controller_converged"]
+        assert rule.series == "control.changes" and rule.agg == "rate"
+        assert rule.unless_series == "observer.fleet_done"
+
+    def test_render_control_pure_and_embedded(self):
+        ps = FakePS()
+        ctl, clk = manual_controller(ps)
+        ps.signals["queue_depth"] = 0.0
+        for _ in range(4):
+            clk.advance(3000)
+            ctl.tick()
+        section = ctl.status()
+        out = render_control(section, plain=True)
+        assert "control: seq=" in out and "merge" in out
+        assert "last:" in out  # the merge shrink decision + reason
+        assert "FROZEN" not in out
+        # embedded in the async-top role view ...
+        framed = render_status({"role": "driver", "control": section})
+        assert "control: seq=" in framed
+        # ... and in the async-mon fleet view
+        fleet = render_fleet({"roles": {}, "derived": {},
+                              "control": {"role": "ps", **section}})
+        assert "control: seq=" in fleet and "via=ps" in fleet
+
+    def test_k8s_primary_shard_pod_enables_control(self):
+        from asyncframework_tpu.deploy import k8s
+
+        objs = k8s.render_ps_shards(3, 48, 1024)
+        by_name = {o["metadata"]["name"]: o for o in objs
+                   if o["kind"] == "Deployment"}
+
+        def envs(dep):
+            c = dep["spec"]["template"]["spec"]["containers"][0]
+            return {e["name"]: e.get("value") for e in c["env"]}
+
+        assert envs(by_name["async-ps-shard-0"]).get(
+            "ASYNCTPU_ASYNC_CONTROL_ENABLED") == "1"
+        # secondaries follow the primary's SETMAP fan-out, they do not
+        # run their own control loop
+        assert "ASYNCTPU_ASYNC_CONTROL_ENABLED" not in envs(
+            by_name["async-ps-shard-1"])
+
+    def test_ctrl_fanout_setmaps_other_map_entries(self):
+        import jax
+
+        primary = ps_dcn.ParameterServer(make_cfg(), 4, 64,
+                                         device=jax.devices()[0],
+                                         port=0)
+        secondary = ps_dcn.ParameterServer(make_cfg(), 4, 64,
+                                           device=jax.devices()[0],
+                                           port=0).start()
+        try:
+            wire_map = [["127.0.0.1", 65000, 0, 4],
+                        ["127.0.0.1", secondary.port, 4, 8]]
+            primary.shard_map = wire_map
+            primary.shard_index = 0
+            fanout = sg.CtrlFanout(primary)
+            fanout.install_ctrl({"seq": 2, "ep": 0, "b": 3})
+            # the fan-out runs on the coalescing announcer thread (a
+            # dark member must never stall the decision loop): poll
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and secondary.ctrl is None:
+                time.sleep(0.02)
+            fanout.stop()
+            assert secondary.ctrl is not None
+            assert secondary.ctrl["seq"] == 2 and secondary._ctrl_b == 3
+        finally:
+            secondary.stop()
+            primary.stop()
+
+    def test_controller_status_section_registered(self):
+        import jax
+
+        from asyncframework_tpu.metrics import live as live_mod
+
+        ps = ps_dcn.ParameterServer(make_cfg(), 8, 64,
+                                    device=jax.devices()[0], port=0)
+        ctl = AsyncController(ps, conf=AsyncConf()).start()
+        try:
+            status = live_mod.process_status("driver")
+            assert status["control"]["enabled"] is True
+            assert status["control"]["seq"] >= 1
+            assert ps.ctrl is not None  # start() installed the law
+        finally:
+            ctl.stop()
+            ps.stop()
+            status = live_mod.process_status("driver")
+            assert "control" not in status
+
+
+# ------------------------------------------------------------ acceptance
+class TestWanDelayAcceptance:
+    """Real processes end to end, the heterogeneous cluster the ISSUE
+    names: a 3-shard group (in-process primary + 2 child shards with
+    warm standbys), two worker processes -- one DELAY-injected -- under
+    the controller, with the wan net profile merged in when the sweep
+    exports ASYNC_CHAOS_NET_PROFILE.  Converges without hand-tuning,
+    decisions recorded, exactly-once + fencing hold across a mid-run
+    promotion."""
+
+    NW, N, D = 8, 4096, 24
+    ITERS = 900
+
+    def _worker(self, port, wpid, tmp, wids, delay_ms=0.0):
+        env = dict(os.environ)
+        env.update({
+            "PS_ROLE": "worker", "PS_PORT": str(port),
+            "PS_WORKER_ID": str(wpid), "PS_NUM_WORKER_PROCS": "2",
+            "PS_NUM_ITER": str(self.ITERS),
+            "PS_WIDS": ",".join(str(w) for w in wids),
+            "PS_EVAL": "1" if wpid == 0 else "1",
+            "JAX_PLATFORMS": "cpu",
+        })
+        sched = faults.FaultSchedule()
+        if delay_ms > 0:
+            # the deterministic slow-but-alive member: every PUSH of
+            # this child pays delay_ms (count=0 = forever)
+            sched.add_delay("*", "PUSH", delay_ms, count=0)
+        profile = faults.profile_schedule_from_env(CHAOS_SEED)
+        if profile is not None:
+            sched = faults.merge_schedules(sched, profile)
+        if sched.events:
+            env["ASYNCTPU_ASYNC_NET_FAULT_SCHEDULE"] = sched.to_json()
+        return subprocess.Popen(
+            [sys.executable, str(CHILD)], env=env,
+            stdout=subprocess.PIPE,
+            stderr=open(os.path.join(tmp, f"worker{wpid}.stderr.log"),
+                        "w"),
+            text=True,
+        )
+
+    def test_controller_on_heterogeneous_cluster_with_promotion(
+            self, tmp_path):
+        import jax
+
+        # cfg MUST mirror tests/ps_dcn_child.py::config()
+        cfg = SolverConfig(
+            num_workers=self.NW, num_iterations=self.ITERS, gamma=1.2,
+            taw=2**31 - 1, batch_rate=0.3, bucket_ratio=0.5,
+            printer_freq=50, seed=42, calibration_iters=20,
+            run_timeout_s=120.0,
+        )
+        overlays = {"async.fence.enabled": True, "async.ps.standby": 1}
+        conf = AsyncConf(dict(overlays))
+        set_global_conf(conf)
+        port0 = frame.free_port()
+        group = sg.ShardGroup(
+            cfg, self.D, self.N, 3, checkpoint_dir=str(tmp_path),
+            indices=range(1, 3), fixed_entries={0: ("127.0.0.1", port0)},
+            worker_procs=2, dead_after_s=1.0, check_interval_s=0.2,
+            stderr_dir=str(tmp_path), conf_overlays=dict(overlays),
+        ).start()
+        from asyncframework_tpu.parallel.supervisor import (
+            ElasticSupervisor,
+        )
+
+        sup = ElasticSupervisor(self.NW, dead_after_s=5.0,
+                                check_interval_s=0.2)
+        ps = ps_dcn.ParameterServer(
+            cfg, sg.shard_ranges(self.D, 3)[0][1], self.N,
+            port=port0, device=jax.devices()[0], supervisor=sup,
+            shard_map=group.smap.to_wire(), shard_index=0,
+            shard_epochs=group.epochs_wire(),
+        ).start()
+        ctl = AsyncController(ps, conf=conf, group=group).start()
+        workers = []
+        try:
+            # heterogeneous by construction: child 1 (wids 6,7) pays
+            # 150 ms per PUSH -- the deterministic DELAYed straggler
+            workers = [
+                self._worker(port0, 0, str(tmp_path),
+                             wids=range(0, 6)),
+                self._worker(port0, 1, str(tmp_path), wids=(6, 7),
+                             delay_ms=150.0),
+            ]
+            # the controller detects the spread and re-clamps the wave
+            # threshold below the configured b=4 -- one DELAYed worker
+            # stops gating every wave
+            deadline = time.monotonic() + 60.0
+            b_seen = None
+            while time.monotonic() < deadline:
+                b_seen = ctl.status()["knobs"]["b"]["value"]
+                if b_seen < 4:
+                    break
+                time.sleep(0.2)
+            assert b_seen is not None and b_seen < 4, \
+                f"controller never re-clamped b (still {b_seen})"
+            floor = ctl._bounds["async.bucket.ratio"][0] * self.NW
+            assert b_seen >= max(1, floor)
+            # mid-run shard promotion: SIGKILL shard 1's primary once it
+            # has applied a seeded threshold of merges
+            kill_after = 60 + (CHAOS_SEED % 50)
+            watch = ps_dcn.PSClient("127.0.0.1", group.port_of(1))
+            wait_deadline = time.monotonic() + 60.0
+            while time.monotonic() < wait_deadline:
+                got = watch.subscribe(0)
+                if got is not None and got[2] >= kill_after:
+                    break
+                time.sleep(0.02)
+            try:
+                watch.bye()
+            except (ConnectionError, OSError):
+                pass
+            os.kill(group.pid_of(1), signal.SIGKILL)
+            # run completes through the failover, no hand-tuned knobs
+            assert ps.wait_done(timeout_s=120.0)
+            group.finish()
+            assert ps.accepted == self.ITERS
+            assert set(ps.accepted_by_wid) == set(range(self.NW))
+            # exactly-once at the primary: every clock tick is exactly
+            # one accept-or-drop verdict
+            assert ps.accepted + ps.dropped == ps._clock
+            # fencing: the failover was a PROMOTION under a minted
+            # epoch, not a restart-with-replay
+            assert group.promotions_of(1) >= 1
+            assert group.restarts_of(1) == 0
+            # decisions were recorded -- counters, status, and the CTRL
+            # payload that reached the wire
+            totals = ctrl_mod.control_totals()
+            assert totals["changes"] >= 1 and totals["ticks"] >= 1
+            assert ctl.status()["last_decision"] is not None
+            assert ps.ctrl["seq"] >= 2
+            # ... and SURVIVED the promotion: the promoted member serves
+            # the group's current ctrl
+            hdr = sg._oneshot("127.0.0.1", group.port_of(1),
+                              {"op": "SHARDMAP"}, timeout_s=5.0)
+            assert hdr.get("ctrl"), "promoted member lost the CTRL state"
+            assert hdr["ctrl"]["seq"] >= 1
+            # the promoted member's own exactly-once accounting
+            result1 = group.result_of(1, timeout_s=30.0)
+            assert result1 is not None
+            assert result1.get("promoted") is True
+            assert (result1["accepted"] + result1["dropped"]
+                    == result1["clock"])
+            # convergence without hand-tuning: the assembled trajectory
+            # decreases through straggler + promotion + damping
+            total = ps.collect_eval(num_worker_procs=2, timeout_s=60.0)
+            assert total is not None, "eval plane died"
+            traj = total / self.N
+            assert traj[-1] < traj[0] * 0.2, traj
+            for w in workers:
+                rc = w.wait(timeout=60.0)
+                assert rc == 0, f"worker exited rc={rc}"
+            out = [json.loads(w.stdout.read().splitlines()[-1])
+                   for w in workers]
+            assert sum(o["gradients"] for o in out) >= self.ITERS
+        finally:
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            ctl.stop()
+            ps.stop()
+            group.stop()
