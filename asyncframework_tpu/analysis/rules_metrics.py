@@ -20,6 +20,15 @@ fires on every lint of every tree state:
   ``DYNAMIC_SERIES_FAMILIES``).  An undeclared family is a series the
   SLO grammar, the per-run dashboards, and the cluster observer's
   scrape surface all silently cannot see.
+- ``prof-zone``: the same discipline for the continuous-profiling
+  plane's zone table (``metrics/profiler.py`` ``ZONES``): every zone
+  literal an accumulator or classifier uses (``zone(...)``,
+  ``zone_ns(...)``, ``zoned(...)``, ``wrap_dispatch(fn, zone)``, a
+  ``_zrule(...)`` classifier row) must be declared there, and every
+  declared zone must be attributed by at least one such site -- an
+  undeclared literal is a zone no table/flamegraph/diff will ever
+  show; an unattributed declaration is a dashboard row that can never
+  light up.
 
 Aggregator functions that roll other families up (``registry.all_totals``
 itself, ``net/retry.retry_totals`` inside ``net_totals``) are suppressed
@@ -112,8 +121,86 @@ def _check_series_keys(ctx: LintContext) -> List[Finding]:
     return findings
 
 
+PROF_PATH = PKG_PREFIX + "metrics/profiler.py"
+
+#: callee tail -> index of the positional arg holding the zone literal
+_ZONE_CALLS = {"zone": 0, "zone_ns": 0, "zoned": 0, "wrap_dispatch": 1}
+
+
+def _declared_zones(ctx: LintContext) -> Tuple[Set[str], int]:
+    """The ``ZONES`` tuple from metrics/profiler.py's AST (static, like
+    every other declaration-table read here) + its line number."""
+    sf = ctx.get(PROF_PATH)
+    if sf is None:
+        return set(), 0
+    for node in sf.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == "ZONES"
+                   for t in targets):
+            continue
+        value = node.value
+        if isinstance(value, (ast.Tuple, ast.List)):
+            zones = {z for z in (const_str(e) for e in value.elts)
+                     if z is not None}
+            return zones, node.lineno
+    return set(), 0
+
+
+def _check_prof_zones(ctx: LintContext) -> List[Finding]:
+    """prof-zone, both directions: undeclared literal at an attribution
+    site / declared zone with no attribution site anywhere."""
+    declared, zones_line = _declared_zones(ctx)
+    if not declared:
+        return []  # no zone table in this tree (fixture snippets)
+    findings: List[Finding] = []
+    attributed: Set[str] = set()
+    for path, sf in ctx.files.items():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = tail_name(node.func)
+            if callee in _ZONE_CALLS:
+                idx = _ZONE_CALLS[callee]
+                if len(node.args) <= idx:
+                    continue
+                lit = const_str(node.args[idx])
+            elif callee == "_zrule":
+                lit = const_str(node.args[-1]) if node.args else None
+            else:
+                continue
+            if lit is None:
+                continue
+            if callee in ("zone", "zone_ns") and "." not in lit \
+                    and lit not in declared:
+                # ``zone()`` is a common name; a dotless literal that is
+                # not a declared zone is some other API's first arg
+                # (e.g. a k8s zone selector), not a profiler site
+                continue
+            if lit not in declared:
+                findings.append(Finding(
+                    "prof-zone", path, node.lineno, lit,
+                    f"zone literal {lit!r} at a profiler attribution "
+                    f"site ({callee}) is not declared in the ZONES "
+                    f"table ({PROF_PATH}) -- no table, flamegraph, or "
+                    f"diff will ever show it"))
+            else:
+                attributed.add(lit)
+    for z in sorted(declared - attributed):
+        findings.append(Finding(
+            "prof-zone", PROF_PATH, zones_line, z,
+            f"declared zone {z!r} has no attribution site (zone/"
+            f"zone_ns/zoned/wrap_dispatch/_zrule) anywhere in the "
+            f"tree -- a dashboard row that can never light up"))
+    return findings
+
+
 def check(ctx: LintContext) -> List[Finding]:
     findings: List[Finding] = _check_series_keys(ctx)
+    findings.extend(_check_prof_zones(ctx))
     registered = _registered(ctx)
 
     providers: Dict[Tuple[str, str], Tuple[str, int]] = {}

@@ -703,3 +703,26 @@ FLIGHT_FLUSH_S = ConfigEntry(
     "counter-delta event (non-zero registry family deltas since the "
     "previous flush).  <= 0 disables the flush thread (dumps only on "
     "fatal signal / exit).")
+# --------------------------------------------------- continuous profiling
+PROF_ENABLED = ConfigEntry(
+    "async.prof.enabled", 0, int,
+    "Continuous profiling plane (metrics/profiler.py): 1 starts the "
+    "stack sampler and arms the exact zone accumulators at the wire/"
+    "merge/dispatch choke points; snapshots ride /api/status, the "
+    "observer run history, and every flight-recorder dump.  0 (the "
+    "default) is asserted byte-identical on the wire and zero-overhead "
+    "on the hot path: zone() returns the shared no-op context manager "
+    "and wrap_dispatch() returns the step callable unchanged.")
+PROF_HZ = ConfigEntry(
+    "async.prof.hz", 97.0, float,
+    "Sampling-profiler frequency in Hz (prime, to avoid lockstep with "
+    "periodic work).  Sampling error for a zone with true share p "
+    "after N samples is ~sqrt(p(1-p)/N): 97 Hz resolves a 10% zone to "
+    "+-0.4% over a 60 s window.  <= 0 keeps the exact zone "
+    "accumulators but starts no sampler thread.")
+PROF_STACKS = ConfigEntry(
+    "async.prof.stacks", 256, int,
+    "Bound on DISTINCT collapsed stacks the sampler keeps (bounds RAM "
+    "and snapshot size).  Beyond it, new stacks are dropped and "
+    "counted in profile.stack_overflow -- never evicted, which would "
+    "bias long-running hot stacks out of the flamegraph.")

@@ -44,6 +44,10 @@ UI_PORT = 8080
 #: every daemon pod sets async.metrics.port to this via env and carries
 #: Prometheus scrape annotations pointing at it
 METRICS_PORT = 9095
+#: fleet-wide sampling-profiler rate (async.prof.hz): lower than the
+#: 97 Hz single-process default -- across hundreds of pods the samples
+#: aggregate anyway, and a prime avoids lockstep with periodic work
+PROF_FLEET_HZ = 29
 
 
 def _meta(name: str, app: str, namespace: str) -> dict:
@@ -78,9 +82,17 @@ def _container(name: str, image: str, command: List[str],
     if metrics:
         # ASYNCTPU_ASYNC_METRICS_PORT is conf async.metrics.port's env
         # spelling: the daemon boots its /metrics + /api/status endpoint
-        # without any manifest-side CLI flag plumbing
+        # without any manifest-side CLI flag plumbing.  The continuous
+        # profiler (async.prof.*) rides the same env surface: every
+        # telemetry-serving pod also exposes its zone decomposition on
+        # /api/status, at a fleet-gentle sampling rate (PROF_FLEET_HZ,
+        # below the 97 Hz single-process default)
         c["env"] = [{"name": "ASYNCTPU_ASYNC_METRICS_PORT",
-                     "value": str(METRICS_PORT)}]
+                     "value": str(METRICS_PORT)},
+                    {"name": "ASYNCTPU_ASYNC_PROF_ENABLED",
+                     "value": "1"},
+                    {"name": "ASYNCTPU_ASYNC_PROF_HZ",
+                     "value": str(PROF_FLEET_HZ)}]
         ports = list(ports or []) + [METRICS_PORT]
     if ports:
         c["ports"] = [{"containerPort": p} for p in ports]

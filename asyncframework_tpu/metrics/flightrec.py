@@ -168,7 +168,16 @@ class FlightRecorder:
         with self._ring_lock:
             events = list(self._ring)
             seq, dropped = self._seq, self._dropped
-        return {
+        # the profile post-mortem: one fresh snapshot per dump, so even
+        # a SIGKILL leaves the zone decomposition at most one flush
+        # stale (None while async.prof never ran -- key omitted, old
+        # dump shape preserved)
+        try:
+            from asyncframework_tpu.metrics import profiler as _profiler
+            prof = _profiler.last_snapshot()
+        except Exception:
+            prof = None
+        out = {
             "schema": self.SCHEMA,
             "role": self.role,
             "pid": os.getpid(),
@@ -182,6 +191,9 @@ class FlightRecorder:
             "events": events,
             "counters": dict(self._last_counters),
         }
+        if prof is not None:
+            out["profile"] = prof
+        return out
 
     def dump(self, reason: str = "periodic") -> Optional[str]:
         """Write the ring to disk atomically; returns the path (None on

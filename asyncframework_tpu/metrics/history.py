@@ -114,7 +114,8 @@ def build_history(
             "<h1>Observer run history "
             f"({len(observer_rows)} runs)</h1>"
             "<table><thead><tr><th>run</th><th>roles</th>"
-            "<th>flight dumps</th><th>persisted</th></tr></thead>"
+            "<th>flight dumps</th><th>profiles</th>"
+            "<th>persisted</th></tr></thead>"
             "<tbody>" + "".join(observer_rows) + "</tbody></table>"
         )
     index = out_dir / "index.html"
@@ -148,7 +149,7 @@ def _observer_rows(log_dir: Path) -> List[str]:
         except (OSError, ValueError):
             rows.append(
                 f"<tr><td>{html.escape(Path(run_dir).name)}</td>"
-                f"<td colspan='3'>unreadable</td></tr>"
+                f"<td colspan='4'>unreadable</td></tr>"
             )
             continue
         meta = run.get("meta") or {}
@@ -168,9 +169,28 @@ def _observer_rows(log_dir: Path) -> List[str]:
             f"<tr><td>{html.escape(str(meta.get('run_id', '?')))}</td>"
             f"<td>{role_bits}</td>"
             f"<td>{len(run.get('flight') or {})}</td>"
+            f"<td>{_profile_cell(run)}</td>"
             f"<td>{when}</td></tr>"
         )
     return rows
+
+
+def _profile_cell(run: dict) -> str:
+    """Profile-snapshot column: count plus each snapshot's top zone
+    (``bin/async-prof <run_dir>`` renders the full table)."""
+    profile = run.get("profile") or {}
+    if not profile:
+        return "-"
+    bits = []
+    for key, snap in sorted(profile.items()):
+        zones = (snap or {}).get("zones") or {}
+        top = max(zones.items(),
+                  key=lambda kv: float((kv[1] or {}).get("share", 0.0)),
+                  default=None)
+        bits.append(
+            html.escape(key)
+            + (f" ({html.escape(top[0])})" if top else ""))
+    return f"{len(profile)}: " + ", ".join(bits)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

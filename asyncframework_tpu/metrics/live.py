@@ -499,14 +499,17 @@ def start_telemetry_from_conf(role: str, host: str = "0.0.0.0",
     key -- or the ``ASYNCTPU_ASYNC_METRICS_PORT`` env var the k8s
     manifests ship -- lights up /metrics and /api/status fleet-wide.
 
-    The crash flight recorder rides the same choke point
-    (``async.flight.dir`` gates it independently of the port): every
-    role that can serve telemetry also keeps its post-mortem ring, and
-    a new daemon entry point cannot wire one without the other."""
+    The crash flight recorder and the continuous profiler ride the same
+    choke point (``async.flight.dir`` / ``async.prof.enabled`` gate them
+    independently of the port): every role that can serve telemetry also
+    keeps its post-mortem ring and its profile plane, and a new daemon
+    entry point cannot wire one without the others."""
     from asyncframework_tpu.conf import METRICS_PORT, global_conf
     from asyncframework_tpu.metrics import flightrec
+    from asyncframework_tpu.metrics import profiler as _profiler
 
     flightrec.install_from_conf(role)
+    _profiler.install_from_conf(role)
     port = int(global_conf().get(METRICS_PORT))
     if port < 0:
         return None

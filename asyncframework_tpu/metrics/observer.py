@@ -153,6 +153,12 @@ class RunHistoryStore:
         self._series: Dict[str, Dict[str, _CompactSeries]] = {}
         self._flight: Dict[str, dict] = {}  # dump filename -> dump dict
         self._flight_persisted: Dict[str, object] = {}  # fname -> dumped_s
+        # profile snapshots harvested next to the flight dumps (from a
+        # dump's embedded "profile" key OR straight off a scraped
+        # /api/status), keyed role-pid; same fresher-dumped_s re-harvest
+        # and dirty-tracked persist discipline as the dumps themselves
+        self._profile: Dict[str, dict] = {}
+        self._profile_persisted: Dict[str, object] = {}
         self.started_s = time.time()
         self.series_dropped = 0
         self.persists = 0
@@ -195,6 +201,25 @@ class RunHistoryStore:
                     prev.get("dumped_s") == dump.get("dumped_s"):
                 return False
             self._flight[key] = dump
+        prof = dump.get("profile")
+        if isinstance(prof, dict) and prof.get("zones"):
+            self.harvest_profile(prof, source)
+        return True
+
+    def harvest_profile(self, snap: dict, source: str) -> bool:
+        """Fold one profile snapshot in (from a flight dump's embedded
+        ``profile`` key or a scraped ``/api/status`` section); keyed
+        role-pid so a role's periodic snapshots overwrite in place;
+        returns True when new or fresher (``dumped_s``)."""
+        if not isinstance(snap, dict):
+            return False
+        key = f"{snap.get('role', '_')}-{snap.get('pid', 0)}"
+        with self._lock:
+            prev = self._profile.get(key)
+            if prev is not None and \
+                    prev.get("dumped_s") == snap.get("dumped_s"):
+                return False
+            self._profile[key] = snap
         return True
 
     # --------------------------------------------------------------- queries
@@ -211,6 +236,10 @@ class RunHistoryStore:
         with self._lock:
             return dict(self._flight)
 
+    def profile_snapshots(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._profile)
+
     def summary(self) -> dict:
         with self._lock:
             return {
@@ -225,6 +254,7 @@ class RunHistoryStore:
                                     | self._roles.keys())
                 },
                 "flight_dumps": sorted(self._flight),
+                "profile_snapshots": sorted(self._profile),
                 "series_dropped": self.series_dropped,
                 "persists": self.persists,
             }
@@ -258,8 +288,14 @@ class RunHistoryStore:
                 if self._flight_persisted.get(f) != d.get("dumped_s")
             }
             all_flight = sorted(self._flight)
+            profile = {
+                k: s for k, s in self._profile.items()
+                if self._profile_persisted.get(k) != s.get("dumped_s")
+            }
+            all_profile = sorted(self._profile)
         os.makedirs(os.path.join(rd, "roles"), exist_ok=True)
         os.makedirs(os.path.join(rd, "flight"), exist_ok=True)
+        os.makedirs(os.path.join(rd, "profile"), exist_ok=True)
         for name, per in series.items():
             self._write_json(
                 os.path.join(rd, "roles", f"{_safe_name(name)}.json"),
@@ -273,6 +309,12 @@ class RunHistoryStore:
             # retry this dump next time, not skip it as persisted
             with self._lock:
                 self._flight_persisted[fname] = dump.get("dumped_s")
+        for key, snap in profile.items():
+            self._write_json(
+                os.path.join(rd, "profile", f"{_safe_name(key)}.json"),
+                snap)
+            with self._lock:
+                self._profile_persisted[key] = snap.get("dumped_s")
         self._write_json(os.path.join(rd, "meta.json"), {
             "schema": self.SCHEMA,
             "run_id": self.run_id,
@@ -280,6 +322,7 @@ class RunHistoryStore:
             "persisted_s": time.time(),
             "roles": roles,
             "flight_dumps": all_flight,
+            "profile_snapshots": all_profile,
             "series_dropped": self.series_dropped,
         })
         with self._lock:
@@ -312,7 +355,19 @@ def load_run(run_dir: str) -> dict:
                     flight[fn] = json.load(f)
             except (OSError, ValueError):
                 continue  # a torn harvest must not hide the rest
-    return {"meta": meta, "roles": roles, "flight": flight}
+    profile: Dict[str, dict] = {}
+    pdir = os.path.join(run_dir, "profile")
+    if os.path.isdir(pdir):
+        for fn in sorted(os.listdir(pdir)):
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(pdir, fn), encoding="utf-8") as f:
+                    profile[fn[:-5]] = json.load(f)
+            except (OSError, ValueError):
+                continue
+    return {"meta": meta, "roles": roles, "flight": flight,
+            "profile": profile}
 
 
 def list_runs(root: str) -> List[str]:
@@ -578,6 +633,12 @@ class ClusterObserver:
             v = status.get(key)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 hist.record(target.name, f"run.{key}", t_s, v)
+        # continuous-profiling section (async.prof.enabled roles):
+        # harvest the snapshot next to the flight dumps so the zone
+        # decomposition outlives the process even without a crash
+        prof = status.get("profile")
+        if isinstance(prof, dict) and prof.get("zones"):
+            hist.harvest_profile(prof, f"scrape:{target.name}")
 
     def scrape_once(self) -> dict:
         """One pass over every target; returns per-target ok/error (the
@@ -794,6 +855,20 @@ class ClusterObserver:
                 "freshness_lag_ms": series_last(
                     status, "serving.freshness_lag_ms"),
             }
+            # compact zone-share row (async.prof.enabled roles): the
+            # top sampled zones, enough for async-mon's fleet table
+            # without dragging whole stack maps through every snapshot
+            prof = status.get("profile")
+            if isinstance(prof, dict) and isinstance(prof.get("zones"),
+                                                     dict):
+                top = sorted(
+                    ((z, float((d or {}).get("share", 0.0)))
+                     for z, d in prof["zones"].items()),
+                    key=lambda kv: -kv[1])[:4]
+                roles[name]["profile"] = {
+                    "samples": prof.get("samples", 0),
+                    "zones": {z: round(s, 4) for z, s in top if s > 0},
+                }
         # adaptive control plane: whichever LIVE role serves a
         # ``control`` status section (the primary PS running the
         # AsyncController) contributes it to the fleet view, so

@@ -185,6 +185,16 @@ _register(CounterFamily(
         "cadence flushes, dumps written (metrics/flightrec.py).",
 ))
 _register(CounterFamily(
+    "profile", "asyncframework_tpu.metrics.profiler",
+    "profile_totals", "reset_profile_totals",
+    baseline=False,
+    doc="Continuous profiling plane: stack samples total and per zone "
+        "(samples.<zone>), exact zone nanoseconds/calls "
+        "(zone_ns.<zone>/zone_calls.<zone>), jit compile/dispatch "
+        "count+ns, dropped distinct stacks, sampler errors "
+        "(metrics/profiler.py).  Empty while async.prof.enabled=0.",
+))
+_register(CounterFamily(
     "convergence", "asyncframework_tpu.metrics.timeseries",
     "convergence_totals", "reset_convergence",
     baseline=False,

@@ -170,6 +170,12 @@ def render_status(status: Dict, plain: bool = True) -> str:
             f"failovers={detail.get('failovers', 0)}"
         )
 
+    # ---- continuous profiling plane (compact zone-share row)
+    prof = status.get("profile") or {}
+    if prof.get("zones"):
+        lines.append("")
+        lines.append(render_profile_row(prof))
+
     # ---- adaptive control plane
     control = status.get("control") or {}
     if control.get("knobs"):
@@ -183,6 +189,34 @@ def render_status(status: Dict, plain: bool = True) -> str:
                      f"{ts.get('samples', 0)} samples "
                      f"({ts.get('evicted', 0)} evicted)")
     return "\n".join(lines) + "\n"
+
+
+def render_profile_row(section: Dict) -> str:
+    """One compact zone-share line from a ``profile`` /api/status
+    section (or the observer's per-role compact block): the top sampled
+    zones by share, plus compile/dispatch accounting when present.
+    Shared by async-top's per-role view and async-mon's fleet table."""
+    zones = section.get("zones") or {}
+    shares = []
+    for z, d in zones.items():
+        # a full snapshot carries {"share": ...} dicts; the observer's
+        # compact per-role block carries bare share floats
+        try:
+            s = float(d.get("share", 0.0)) if isinstance(d, dict) \
+                else float(d)
+        except (TypeError, ValueError):
+            continue
+        if s > 0:
+            shares.append((z, s))
+    shares.sort(key=lambda kv: -kv[1])
+    parts = [f"{z} {s * 100:.0f}%" for z, s in shares[:5]]
+    head = (f"profile: samples={section.get('samples', 0)} "
+            + ("  ".join(parts) if parts else "(no sampled zones)"))
+    comp = section.get("compile") or {}
+    if comp.get("count"):
+        head += (f"  compile={comp['count']}"
+                 f"/{float(comp.get('ns', 0)) / 1e6:.0f}ms")
+    return head
 
 
 def render_control(section: Dict, plain: bool = True) -> str:
@@ -259,6 +293,10 @@ def render_fleet(observer_section: Dict, plain: bool = True) -> str:
                 f"{_fmt(r.get('qps')):>8}"
                 f"{_fmt(r.get('freshness_lag_ms'), 0):>8}"
             )
+            # compact zone-share row under profiling-enabled roles
+            prof = r.get("profile") or {}
+            if prof.get("zones"):
+                lines.append("  " + render_profile_row(prof))
 
     if derived:
         lines.append("")
@@ -296,11 +334,13 @@ def render_fleet(observer_section: Dict, plain: bool = True) -> str:
     hist = observer_section.get("history") or {}
     if hist:
         nd = len(hist.get("flight_dumps") or [])
+        np_ = len(hist.get("profile_snapshots") or [])
         lines.append("")
         lines.append(
             f"history: run={hist.get('run_id')} "
             f"roles={len(hist.get('roles') or {})} "
             f"flight_dumps={nd} "
+            f"profiles={np_} "
             f"dir={hist.get('run_dir') or '(memory)'}"
         )
     return "\n".join(lines) + "\n"

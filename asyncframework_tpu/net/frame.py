@@ -49,6 +49,7 @@ import threading
 import weakref
 from typing import Dict, Optional, Sequence, Tuple
 
+from asyncframework_tpu.metrics import profiler as _prof
 from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import faults, lockwatch
 from asyncframework_tpu.net import retry as _retry
@@ -228,66 +229,74 @@ def _send_frame(sock: socket.socket, header: dict, parts: Sequence) -> None:
     # holds a watched lock (the PS model lock) is exactly the contention
     # the lock-free pull path removes -- fail loudly in debug runs
     lockwatch.check_io("send")
-    header = _stamped(header)
-    head = json.dumps(header).encode()
-    plen = sum(len(p) for p in parts)
-    op = str(header.get("op", ""))
-    total = 2 * _HDR.size + len(head) + plen
-    _deadline_cap(sock)  # a spent retry deadline fails the write outright
-    inj = faults.active()
-    if inj is not None:
-        endpoint = endpoint_of(sock)
-        if inj.partition_active(endpoint):
-            # blackholed: nothing leaves this host, the connection is
-            # poisoned (the peer sees silence, exactly like a real cut)
-            inj.note_partition_drop(endpoint, op)
-            try:
-                sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            raise ConnectionError(
-                f"fault-injected: partitioned from {endpoint}"
-            )
-        # chaos path: materialize the frame so mid-frame cuts slice the
-        # exact same byte stream the plain path would have sent
-        data = (_HDR.pack(len(head)) + head + _HDR.pack(plen)
-                + b"".join(bytes(memoryview(p)) for p in parts))
-        kind = inj.check_send(endpoint, op)
-        if kind == faults.CUT_MID_FRAME:
-            # a prefix of the frame goes out, then the connection dies: the
-            # peer sees a short frame + EOF, the sender sees a reset.  The
-            # request was NOT applied.
-            sock.sendall(data[: max(1, len(data) // 3)])
-            try:
-                sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            raise ConnectionError(
-                f"fault-injected: mid-frame cut to {endpoint_of(sock)}"
-            )
-        if kind in (faults.STALL_READ, faults.DROP_REPLY):
-            # the request itself goes through (the peer WILL apply it); the
-            # fault fires on this socket's next recv.  Arm only AFTER the
-            # send succeeds -- a failed send never reaches the peer, and a
-            # stale armed entry could fire on an unrelated future socket
+    with _prof.zone("serde"):
+        header = _stamped(header)
+        head = json.dumps(header).encode()
+    # zone scope (profiler exact accumulator): everything past header
+    # serialization is the frame pump proper -- byte accounting, fault
+    # consult, and the kernel write(s).  Wall time, so a slow peer shows
+    # up here (the sampler separates CPU from blocked time).
+    with _prof.zone("wire.encode"):
+        plen = sum(len(p) for p in parts)
+        op = str(header.get("op", ""))
+        total = 2 * _HDR.size + len(head) + plen
+        _deadline_cap(sock)  # a spent deadline fails the write outright
+        inj = faults.active()
+        if inj is not None:
+            endpoint = endpoint_of(sock)
+            if inj.partition_active(endpoint):
+                # blackholed: nothing leaves this host, the connection is
+                # poisoned (the peer sees silence, exactly like a real cut)
+                inj.note_partition_drop(endpoint, op)
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                raise ConnectionError(
+                    f"fault-injected: partitioned from {endpoint}"
+                )
+            # chaos path: materialize the frame so mid-frame cuts slice the
+            # exact same byte stream the plain path would have sent
+            data = (_HDR.pack(len(head)) + head + _HDR.pack(plen)
+                    + b"".join(bytes(memoryview(p)) for p in parts))
+            kind = inj.check_send(endpoint, op)
+            if kind == faults.CUT_MID_FRAME:
+                # a prefix of the frame goes out, then the connection dies:
+                # the peer sees a short frame + EOF, the sender sees a
+                # reset.  The request was NOT applied.
+                sock.sendall(data[: max(1, len(data) // 3)])
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                raise ConnectionError(
+                    f"fault-injected: mid-frame cut to {endpoint_of(sock)}"
+                )
+            if kind in (faults.STALL_READ, faults.DROP_REPLY):
+                # the request itself goes through (the peer WILL apply it);
+                # the fault fires on this socket's next recv.  Arm only
+                # AFTER the send succeeds -- a failed send never reaches
+                # the peer, and a stale armed entry could fire on an
+                # unrelated future socket
+                sock.sendall(data)
+                inj.arm(sock, kind)
+                _io_tls.sent = total
+                _count("sent", op, total)
+                return
             sock.sendall(data)
-            inj.arm(sock, kind)
             _io_tls.sent = total
             _count("sent", op, total)
             return
-        sock.sendall(data)
+        prefix = _HDR.pack(len(head)) + head + _HDR.pack(plen)
+        if not plen:
+            sock.sendall(prefix)
+        elif _HAVE_SENDMSG:
+            _sendmsg_all(sock, [prefix, *parts])
+        else:  # pragma: no cover - platforms without sendmsg
+            sock.sendall(
+                prefix + b"".join(bytes(memoryview(p)) for p in parts))
         _io_tls.sent = total
         _count("sent", op, total)
-        return
-    prefix = _HDR.pack(len(head)) + head + _HDR.pack(plen)
-    if not plen:
-        sock.sendall(prefix)
-    elif _HAVE_SENDMSG:
-        _sendmsg_all(sock, [prefix, *parts])
-    else:  # pragma: no cover - platforms without sendmsg
-        sock.sendall(prefix + b"".join(bytes(memoryview(p)) for p in parts))
-    _io_tls.sent = total
-    _count("sent", op, total)
 
 
 def send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
@@ -323,10 +332,18 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_msg_raw(sock: socket.socket) -> Tuple[dict, bytes]:
     lockwatch.check_io("recv")
     _deadline_cap(sock)  # cap the blocking read to the retry deadline
+    # zone boundary: the 4-byte length read carries the IDLE wait for
+    # the next frame (a server handler parks here between requests) --
+    # it stays outside wire.decode so the zone measures frame pumping,
+    # not time spent waiting for a peer to speak
     (hlen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
-    header = json.loads(recv_exact(sock, hlen))
-    (plen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
-    payload = recv_exact(sock, plen) if plen else b""
+    with _prof.zone("wire.decode"):
+        hbytes = recv_exact(sock, hlen)
+    with _prof.zone("serde"):
+        header = json.loads(hbytes)
+    with _prof.zone("wire.decode"):
+        (plen,) = _HDR.unpack(recv_exact(sock, _HDR.size))
+        payload = recv_exact(sock, plen) if plen else b""
     total = 2 * _HDR.size + hlen + plen
     _io_tls.recv = total
     _count("recv", str(header.get("op", "")), total)

@@ -41,6 +41,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from asyncframework_tpu.metrics import profiler as _prof
+
 #: gradient-codec names (``async.codec.push`` values)
 OFF = "off"
 FP16 = "fp16"
@@ -93,6 +95,7 @@ def grad_error_bound(codec: str, absmax: float) -> float:
     return 0.0
 
 
+@_prof.zoned("wire.quantize")
 def encode_grad(g: np.ndarray, codec: str, err: Optional[np.ndarray]
                 ) -> Optional[Tuple[dict, bytes, np.ndarray]]:
     """Quantize ``g`` (float32) with error feedback.
@@ -140,6 +143,7 @@ def encode_grad(g: np.ndarray, codec: str, err: Optional[np.ndarray]
     return hdr, payload, new_err
 
 
+@_prof.zoned("wire.quantize")
 def decode_grad(header: dict, payload, d: int) -> np.ndarray:
     """Server-side decode of a quantized PUSH payload back to float32.
     Raises ``ValueError`` on a malformed frame (wrong codec tag or
@@ -189,6 +193,7 @@ def _unshuffle4(payload: bytes) -> bytes:
     return np.ascontiguousarray(a).tobytes()
 
 
+@_prof.zoned("wire.compress")
 def compress_model_part(wenc: str, payload: bytes, nnz: int = 0
                         ) -> Tuple[dict, bytes]:
     """LOSSLESS compression of a model-part payload for the relay wire.
@@ -236,6 +241,7 @@ def compress_model_part(wenc: str, payload: bytes, nnz: int = 0
     return best
 
 
+@_prof.zoned("wire.compress")
 def decompress_model_part(header: dict, payload) -> bytes:
     """Undo :func:`compress_model_part` (no-op for an uncompressed
     reply).  Raises ``ValueError`` on corrupt/length-mismatched data --

@@ -34,6 +34,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from asyncframework_tpu.metrics import profiler as _prof
+
 #: wire-encoding tags carried in the MODEL header's ``wenc`` field
 FULL = "full"
 NOT_MODIFIED = "nm"
@@ -47,6 +49,7 @@ XDELTA = "xdelta"
 XFULL = "xfull"
 
 
+@_prof.zoned("wire.crc")
 def crc(model_buf) -> int:
     """CRC32 of a model payload (the integrity check on every delta/NM
     reply).  Accepts any buffer-protocol object -- pass the contiguous
@@ -55,6 +58,7 @@ def crc(model_buf) -> int:
     return zlib.crc32(model_buf) & 0xFFFFFFFF
 
 
+@_prof.zoned("wire.xor")
 def encode(cur: np.ndarray, basis: Optional[np.ndarray],
            cur_bytes: Optional[bytes] = None) -> Tuple[str, bytes, int]:
     """Encode ``cur`` (float32) against ``basis`` (float32 or None).
@@ -82,6 +86,7 @@ def encode(cur: np.ndarray, basis: Optional[np.ndarray],
     return full()
 
 
+@_prof.zoned("wire.xor")
 def encode_xfull(cur: np.ndarray, basis: np.ndarray) -> bytes:
     """The dense XOR payload (``XFULL``): exact by construction, FULL-
     sized on the wire but built for the wirecodec shuffle+deflate
@@ -89,6 +94,7 @@ def encode_xfull(cur: np.ndarray, basis: np.ndarray) -> bytes:
     return (cur.view(np.uint32) ^ basis.view(np.uint32)).tobytes()
 
 
+@_prof.zoned("wire.xor")
 def decode(wenc: str, payload, nnz: int, basis: Optional[np.ndarray],
            want_crc: Optional[int], basis_crc: Optional[int] = None
            ) -> Optional[np.ndarray]:

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncframework_tpu.metrics import profiler as _prof
 from asyncframework_tpu.ops.gradients import (
     least_squares_grad_sum,
     least_squares_residual,
@@ -124,7 +125,7 @@ def make_asgd_worker_step(batch_rate: float, loss: str = "least_squares"):
         Xs = X[idx]
         return grad_sum(Xs, y[idx], w, valid), key
 
-    return step
+    return _prof.wrap_dispatch(step, "kernel.dispatch", "asgd_worker_step")
 
 
 def make_asgd_apply(gamma: float, batch_rate: float, n: int, num_workers: int):
@@ -143,7 +144,7 @@ def make_asgd_apply(gamma: float, batch_rate: float, n: int, num_workers: int):
         lr = gamma / jnp.sqrt(k / num_workers + 1.0)
         return w - (lr / par_recs) * g, k + 1.0
 
-    return apply
+    return _prof.wrap_dispatch(apply, "kernel.dispatch", "asgd_apply")
 
 
 def make_sync_apply(gamma: float, batch_rate: float, n: int):
@@ -158,7 +159,7 @@ def make_sync_apply(gamma: float, batch_rate: float, n: int):
         lr = gamma / jnp.sqrt(k + 1.0)
         return w - (lr / (batch_rate * n)) * acc_g, k + 1.0
 
-    return apply
+    return _prof.wrap_dispatch(apply, "kernel.dispatch", "sync_apply")
 
 
 def make_saga_worker_step(batch_rate: float):
@@ -178,7 +179,7 @@ def make_saga_worker_step(batch_rate: float):
         g = mm_f32(X.T, mask * (diff - alpha))
         return g, diff, mask, key
 
-    return step
+    return _prof.wrap_dispatch(step, "kernel.dispatch", "saga_worker_step")
 
 
 def make_saga_apply(
@@ -211,7 +212,7 @@ def make_saga_apply(
         ab2 = alpha_bar + delta / n
         return w2, ab2
 
-    return apply
+    return _prof.wrap_dispatch(apply, "kernel.dispatch", "saga_apply")
 
 
 def make_saga_table_delta():
@@ -264,7 +265,7 @@ def make_asgd_apply_batch(
         return w - coeff @ G, k + jnp.sum(mask)
 
     del m  # shape is carried by G itself; kept in the signature for intent
-    return apply_batch
+    return _prof.wrap_dispatch(apply_batch, "kernel.dispatch", "asgd_apply_batch")
 
 
 def make_asgd_apply_merge(
@@ -322,7 +323,7 @@ def make_asgd_apply_merge(
         (w, k), _ = jax.lax.scan(body, (w, k), (G, mask))
         return w, k
 
-    return apply_merge
+    return _prof.wrap_dispatch(apply_merge, "kernel.dispatch", "asgd_apply_merge")
 
 
 def make_asgd_apply_damped(gamma: float, batch_rate: float, n: int,
@@ -346,7 +347,7 @@ def make_asgd_apply_damped(gamma: float, batch_rate: float, n: int,
         lr = gamma / jnp.sqrt(k / num_workers + 1.0)
         return w - (a * (lr / par_recs)) * g, k + 1.0
 
-    return apply
+    return _prof.wrap_dispatch(apply, "kernel.dispatch", "asgd_apply_damped")
 
 
 def make_saga_apply_merge(
@@ -380,7 +381,7 @@ def make_saga_apply_merge(
         (w, alpha_bar), _ = jax.lax.scan(body, (w, alpha_bar), (G, mask))
         return w, alpha_bar
 
-    return apply_merge
+    return _prof.wrap_dispatch(apply_merge, "kernel.dispatch", "saga_apply_merge")
 
 
 # ------------------------------------------------------------- mesh steps
@@ -876,7 +877,7 @@ def make_saga_dcn_worker_step():
         g = mm_f32(Xs.T, (diff - alpha_sel) * valid)
         return g, diff
 
-    return step
+    return _prof.wrap_dispatch(step, "kernel.dispatch", "saga_dcn_worker_step")
 
 
 def make_saga_dcn_sparse_worker_step(d: int):
@@ -932,7 +933,7 @@ def make_trajectory_loss_eval(loss: str = "least_squares"):
         else:
             raise ValueError(f"unknown loss {loss!r}")
 
-    return eval_shard
+    return _prof.wrap_dispatch(eval_shard, "kernel.dispatch", "trajectory_loss_eval")
 
 
 def make_predict_step(loss: str = "least_squares"):
@@ -955,4 +956,4 @@ def make_predict_step(loss: str = "least_squares"):
             return jax.nn.sigmoid(z)
         return z
 
-    return predict
+    return _prof.wrap_dispatch(predict, "kernel.dispatch", "predict_step")

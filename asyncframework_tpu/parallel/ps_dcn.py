@@ -113,6 +113,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from asyncframework_tpu.metrics import flightrec as _flight
+from asyncframework_tpu.metrics import profiler as _prof
 from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import ClientSession, DedupWindow, RetryPolicy
 from asyncframework_tpu.net import frame as _frame
@@ -2251,6 +2252,7 @@ class ParameterServer:
             # nobody's next message waits behind the disk write
             self._ckpt_trigger.set()
 
+    @_prof.zoned("merge.drain")
     def _drain_merge_locked(self) -> None:
         """Caller holds ``_lock``.  Drain up to ``_merge_max`` pending
         pushes in FIFO order -- per-push accept/reject, dedup, clock, and

@@ -255,6 +255,65 @@ class ArmObserver:
                 pass
 
 
+#: stated tolerance for the profile-vs-trace consistency cross-check:
+#: exact wire-zone milliseconds must not exceed this factor times the
+#: traced stage total (p50 x count).  Loose by design -- zones count
+#: BOTH sides of the loopback wire while traces are client-side, and
+#: p50 x count underestimates a skewed stage -- but it catches the
+#: failure class that matters: a zone accumulator whose clock math is
+#: off by orders of magnitude.
+PROFILE_TRACE_TOLERANCE = 3.0
+
+
+def profile_block(prof_mod, stages: dict) -> dict:
+    """Per-arm ``profile`` block (never-dark): zone shares + exact zone
+    ms, samples collected, compile count/time, and the consistency
+    cross-check of exact zone nanoseconds against the PR 3 trace-stage
+    p50s (tolerance stated above)."""
+    try:
+        snap = prof_mod.last_snapshot()
+        if not snap:
+            return {"error": "ProfileUnavailable: profiler not installed"}
+        zones = snap.get("zones") or {}
+        zone_ms = {z: round(float(d.get("ns", 0)) / 1e6, 3)
+                   for z, d in zones.items()}
+        comp = snap.get("compile") or {}
+        disp = snap.get("dispatch") or {}
+        block = {
+            "samples": snap.get("samples", 0),
+            "zone_share": {z: round(float(d.get("share", 0.0)), 4)
+                           for z, d in zones.items() if d.get("samples")},
+            "zone_ms": zone_ms,
+            "compile_count": comp.get("count", 0),
+            "compile_ms": round(float(comp.get("ns", 0)) / 1e6, 1),
+            "dispatch_count": disp.get("count", 0),
+            "dispatch_ms": round(float(disp.get("ns", 0)) / 1e6, 1),
+        }
+        wire_ms = sum(v for z, v in zone_ms.items()
+                      if z.startswith("wire."))
+        traced_ms = sum(
+            float(d.get("p50", 0.0)) * int(d.get("count", 0))
+            for d in (stages or {}).values())
+        tol = PROFILE_TRACE_TOLERANCE
+        if traced_ms <= 0:
+            block["trace_xcheck"] = {
+                "ok": None, "tolerance": tol,
+                "detail": "no trace stages to check against"}
+        else:
+            ok = wire_ms <= tol * traced_ms
+            block["trace_xcheck"] = {
+                "ok": ok, "tolerance": tol,
+                "wire_zone_ms": round(wire_ms, 1),
+                "trace_total_ms": round(traced_ms, 1),
+                "detail": (f"exact wire-zone ms within {tol}x traced "
+                           f"p50*count" if ok else
+                           f"wire zones {wire_ms:.0f}ms exceed {tol}x "
+                           f"traced {traced_ms:.0f}ms")}
+        return block
+    except Exception as e:  # noqa: BLE001 - never-dark discipline
+        return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+
 # --------------------------------------------------------------------- child
 def arm_watchdog(config_name: str) -> None:
     """Emit a parseable failure line and hard-exit if the process wedges
@@ -593,12 +652,16 @@ def run_dcn_child() -> None:
     from asyncframework_tpu.conf import AsyncConf, set_global_conf
     from asyncframework_tpu.data.sharded import ShardedDataset
     from asyncframework_tpu.data.sparse import SparseShardedDataset
+    from asyncframework_tpu.metrics import profiler as prof_mod
     from asyncframework_tpu.metrics import trace as trace_mod
     from asyncframework_tpu.net import frame, reset_net_totals
     from asyncframework_tpu.parallel import ps_dcn
     from asyncframework_tpu.solvers import SolverConfig
 
     devices = jax.devices()
+    # continuous-profiling plane, once per child process; each arm
+    # resets the accumulators so its profile block is arm-local
+    prof_mod.install("bench-dcn", hz=197.0)
     # BENCH_DCN_PIPELINE=0 drops the pipelined arms entirely
     pipe_depth = max(0, int(os.environ.get("BENCH_DCN_PIPELINE", "2")))
     out = {}
@@ -629,6 +692,7 @@ def run_dcn_child() -> None:
             reset_net_totals()
             ps_dcn.reset_pipeline_totals()
             trace_mod.reset_aggregator()
+            prof_mod.reset_profile_totals()
             cfg = SolverConfig(
                 num_workers=c["nw"], num_iterations=c["iters"],
                 gamma=c["gamma"], taw=2**31 - 1,
@@ -682,6 +746,9 @@ def run_dcn_child() -> None:
                 # off this arm's PS while it ran (never-dark: an error
                 # string on failure)
                 "observer": observer_block,
+                # per-arm continuous-profiling artifact (ISSUE 18):
+                # zone decomposition + the trace consistency cross-check
+                "profile": profile_block(prof_mod, stages),
             }
             if depth > 0:
                 rec["pipeline"] = ps_dcn.pipeline_totals()
@@ -1443,6 +1510,7 @@ def run_relay_child() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    from asyncframework_tpu.metrics import profiler as prof_mod
     from asyncframework_tpu.metrics import reset_totals
     from asyncframework_tpu.net import wirecodec
     from asyncframework_tpu.parallel import ps_dcn
@@ -1525,6 +1593,11 @@ def run_relay_child() -> None:
             ps.stop()
 
     def codec_arm(codec: str) -> dict:
+        # reset_totals() clears every registry family, including the
+        # profiler's -- so this arm's profile block is arm-local, and
+        # `bin/async-prof --diff` between the codec-on and codec-off
+        # arms shows wire.quantize only where encode_grad actually ran
+        prof_mod.install("bench-relay", hz=197.0)
         reset_totals()
         ps = make_ps()
         try:
@@ -1538,6 +1611,7 @@ def run_relay_child() -> None:
                 "push_payload_bytes_per_update":
                     round(ps.push_bytes / K),
                 "accepted": ps.accepted,
+                "profile": profile_block(prof_mod, {}),
             }
         finally:
             ps.stop()

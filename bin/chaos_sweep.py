@@ -267,16 +267,20 @@ def run_seed(seed: int, args) -> dict:
     # without hand-tuning, decisions recorded, exactly-once + fencing
     # hold across a mid-run promotion) plus the decision-logic units
     # (tests/test_controller.py)
+    # continuous-profiling crash path rides every seed: a profiling-
+    # enabled worker child is SIGKILLed mid-run (seeded timing) and its
+    # harvested flight dump must carry a non-empty profile snapshot
+    # with the wire zones attributed (tests/test_profiler.py)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
         "tests/test_telemetry.py", "tests/test_shardgroup.py",
         "tests/test_fencing.py", "tests/test_relaycast.py",
         "tests/test_replication.py", "tests/test_observer.py",
-        "tests/test_controller.py",
+        "tests/test_controller.py", "tests/test_profiler.py",
         "-q", "-m",
         f"({marker}) or serve or telemetry or shard or fence or relay"
-        f" or repl or observer or ctrl",
+        f" or repl or observer or ctrl or prof",
         "-p", "no:cacheprovider",
     ]
     if args.soak:

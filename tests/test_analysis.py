@@ -614,6 +614,95 @@ class TestMetricsRule:
                    if f.rule == "metrics-series-family")
 
 
+# ------------------------------------------------------------ prof-zone rule
+#: mini zone table: the rule reads ZONES from metrics/profiler.py's AST
+PROF_FIXTURE = '''
+ZONES = (
+    "wire.live",
+    "wire.dead",
+)
+'''
+
+
+class TestProfZoneRule:
+    def test_undeclared_and_unattributed_both_fire(self, tmp_path):
+        """prof-zone, both directions on one fixture tree: an undeclared
+        literal at an attribution site (zone() and the wrap_dispatch
+        zone arg) fires, and a declared zone with no attribution site
+        anywhere fires at the ZONES table."""
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/metrics/profiler.py": PROF_FIXTURE,
+            "asyncframework_tpu/rogue_prof.py":
+                'from asyncframework_tpu.metrics import profiler as _prof\n'
+                'def f(fn):\n'
+                '    with _prof.zone("wire.live"):\n'
+                '        pass\n'
+                '    with _prof.zone("wire.bogus"):\n'
+                '        pass\n'
+                '    return _prof.wrap_dispatch(fn, "bad.zone", "lbl")\n',
+        })
+        findings = [f for f in rules_metrics.check(ctx)
+                    if f.rule == "prof-zone"]
+        assert rule_tokens(findings, "prof-zone") == \
+            ["bad.zone", "wire.bogus", "wire.dead"]
+        dead = next(f for f in findings if f.token == "wire.dead")
+        assert dead.path.endswith("metrics/profiler.py")
+
+    def test_dotless_literal_on_generic_zone_callee_is_skipped(
+            self, tmp_path):
+        """``zone`` is a common method name: a dotless literal that is
+        not a declared zone (a k8s zone selector, say) must not fire."""
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/metrics/profiler.py": PROF_FIXTURE,
+            "asyncframework_tpu/uses.py":
+                'from asyncframework_tpu.metrics import profiler as _prof\n'
+                'def f(client):\n'
+                '    client.zone("us-east1")\n'
+                '    _prof.zone_ns("zone9", 5)\n'
+                '    with _prof.zone("wire.live"):\n'
+                '        pass\n'
+                '    _prof.zone_ns("wire.dead", 1)\n',
+        })
+        assert rule_tokens(rules_metrics.check(ctx), "prof-zone") == []
+
+    def test_tree_without_zone_table_skips_the_rule(self, tmp_path):
+        ctx = ctx_of(tmp_path, {
+            "asyncframework_tpu/uses.py":
+                'from asyncframework_tpu.metrics import profiler as _prof\n'
+                'def f():\n'
+                '    with _prof.zone("wire.bogus"):\n'
+                '        pass\n',
+        })
+        assert rule_tokens(rules_metrics.check(ctx), "prof-zone") == []
+
+    def test_mutation_both_directions_on_the_real_tree(self, monkeypatch):
+        """Acceptance mutations against the REAL repo: un-declare a zone
+        the tree attributes -> its wirecodec sites become findings;
+        declare a zone nothing attributes -> a finding at the table."""
+        orig = rules_metrics._declared_zones
+
+        def without_quantize(ctx):
+            zones, line = orig(ctx)
+            return zones - {"wire.quantize"}, line
+
+        monkeypatch.setattr(rules_metrics, "_declared_zones",
+                            without_quantize)
+        result = run_lint(REPO, rules=["metrics"])
+        toks = rule_tokens(result.findings, "prof-zone")
+        assert "wire.quantize" in toks, toks
+        assert any("wirecodec" in f.path for f in result.findings
+                   if f.rule == "prof-zone")
+
+        def with_phantom(ctx):
+            zones, line = orig(ctx)
+            return zones | {"wire.phantom"}, line
+
+        monkeypatch.setattr(rules_metrics, "_declared_zones", with_phantom)
+        result = run_lint(REPO, rules=["metrics"])
+        toks = rule_tokens(result.findings, "prof-zone")
+        assert toks == ["wire.phantom"], toks
+
+
 # ------------------------------------------------- allowlist + whole tree
 class TestAllowlistPolicy:
     def test_empty_reason_is_refused(self):

@@ -282,6 +282,33 @@ class TestObserverRendering:
             assert env["ASYNCTPU_ASYNC_METRICS_PORT"] == \
                 str(k8s.METRICS_PORT)
 
+    def test_prof_env_rides_every_metrics_pod(self):
+        """async.prof.* plumbs through the one metrics-env choke point:
+        every telemetry-serving pod boots with profiling enabled at the
+        fleet-gentle rate (ISSUE 18), and the env spellings match the
+        registered ConfigEntries."""
+        from asyncframework_tpu.conf import AsyncConf, registry
+
+        assert "async.prof.enabled" in registry()
+        assert "async.prof.hz" in registry()
+        prefix = AsyncConf.ENV_PREFIX
+        rendered = (k8s.render_master() + k8s.render_workers(2)
+                    + k8s.render_serving(2, "ps:1")
+                    + k8s.render_ps_shards(2, 16, 1024))
+        seen = 0
+        for o in rendered:
+            if o["kind"] not in ("Deployment", "StatefulSet"):
+                continue
+            tpl = o["spec"]["template"]
+            env = {e["name"]: e["value"] for c in
+                   tpl["spec"]["containers"] for e in c.get("env", [])}
+            if "ASYNCTPU_ASYNC_METRICS_PORT" not in env:
+                continue
+            seen += 1
+            assert env[prefix + "ASYNC_PROF_ENABLED"] == "1"
+            assert env[prefix + "ASYNC_PROF_HZ"] == str(k8s.PROF_FLEET_HZ)
+        assert seen >= 4  # master, workers, serving, shards all covered
+
     def test_cluster_bundle_with_observer_and_shards(self):
         files = k8s.render_cluster(2, observer=True, ps_shards=2,
                                    ps_d=16, ps_n=1024)
