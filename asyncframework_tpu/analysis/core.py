@@ -147,6 +147,7 @@ def _rules() -> Dict[str, RuleFn]:
         rules_conf,
         rules_locks,
         rules_metrics,
+        rules_native,
         rules_protocol,
         rules_threads,
     )
@@ -157,6 +158,7 @@ def _rules() -> Dict[str, RuleFn]:
         "locks": rules_locks.check,
         "threads": rules_threads.check,
         "metrics": rules_metrics.check,
+        "native": rules_native.check,
     }
 
 

@@ -528,6 +528,18 @@ def run_async_cluster(args, conf, algo: str = "asgd"):
     # --conf async.control.enabled=false restores the static knobs
     if not conf.contains("async.control.enabled"):
         conf.set("async.control.enabled", True)
+    # the native data plane likewise defaults ON for the cluster path:
+    # GIL-free wire codecs (XOR delta, CRC, quantize, byte-shuffle --
+    # native/*.cc, bit-identical to the pure-Python oracles, which
+    # remain the no-toolchain fallback) and the shared-memory ring
+    # transport for colocated role pairs (net/shmring.py; same framed
+    # bytes, opportunistic upgrade, TCP degrade).  Explicit
+    # --conf async.native.enabled=false / async.shm.enabled=false
+    # restore the pure-Python/loopback paths
+    if not conf.contains("async.native.enabled"):
+        conf.set("async.native.enabled", True)
+    if not conf.contains("async.shm.enabled"):
+        conf.set("async.shm.enabled", True)
 
     cfg = SolverConfig(
         num_workers=args.num_partitions,

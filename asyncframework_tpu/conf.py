@@ -377,6 +377,43 @@ CODEC_PUSH = ConfigEntry(
     "quantization error.  Non-finite gradients, fp16-overflowing "
     "magnitudes, sparse-encoded pushes, and ASAGA (exact history "
     "scalars) always fall back to the raw wire.")
+# ------------------------------------------------------------ native plane
+# Native hot-path data plane (native/wiredelta.cc, native/wirecodec.cc,
+# native/shmring.cc behind native_build.py): GIL-free C++ twins of the
+# pure-Python wire codecs, plus a shared-memory ring transport for
+# colocated roles.  Both default OFF = byte-identical legacy wire; the
+# async-cluster launcher flips them on.
+NATIVE_ENABLED = ConfigEntry(
+    "async.native.enabled", False, bool,
+    "Route the wire hot paths (XOR delta encode/decode + CRC32 in "
+    "net/wiredelta.py, int8/fp16 quantize + byte-shuffle + delta-index "
+    "transform in net/wirecodec.py, the frame pump's gather copy in "
+    "net/frame.py) through the ctypes-loaded C++ extensions, releasing "
+    "the GIL for the whole pass.  Every native entry point has a "
+    "registered pure-Python bit-identity oracle (the pre-native "
+    "implementation) and silently degrades to it when no toolchain is "
+    "present -- the wire is byte-identical either way, only the "
+    "interpreter time changes (metrics family 'native' says which path "
+    "actually ran).  Off by default.")
+SHM_ENABLED = ConfigEntry(
+    "async.shm.enabled", False, bool,
+    "Shared-memory ring transport for COLOCATED roles (net/shmring.py): "
+    "after the normal TCP dial, a loopback connection is upgraded via "
+    "an SHM_OPEN handshake to a pair of lock-free SPSC rings in "
+    "/dev/shm, and REPL_APPEND / SUBSCRIBE frames move through them "
+    "instead of the loopback socket.  The framed BYTES are identical "
+    "and still pass the net/frame.py choke point (CRC, fencing, dedup, "
+    "byte counters, fault injection all unchanged); only the kernel "
+    "socket hop is bypassed.  Any ring failure (peer death, handshake "
+    "refusal) degrades to the plain socket path.  Off by default = "
+    "byte-identical legacy transport.")
+SHM_RING_KB = ConfigEntry(
+    "async.shm.ring.kb", 4096, int,
+    "Per-direction shared-memory ring capacity in KiB (net/shmring.py). "
+    "A frame larger than the ring falls back to chunked writes; sizing "
+    "the ring to a few model payloads keeps the writer from ever "
+    "spinning on a healthy reader.",
+    tunable=True, floor=64, ceiling=262144)
 # ------------------------------------------------------------- relay plane
 # Relaycast (asyncframework_tpu/relaycast/): peer-relayed versioned model
 # distribution -- replicas form a k-ary tree rooted at the PS, the root's

@@ -26,6 +26,14 @@ import numpy as np
 
 _NATIVE = None
 
+#: native symbol -> pure-Python twin (native-oracle lint contract).
+#: Both native symbols serve one dense-load fast path whose single
+#: fallback is the line parser.
+NATIVE_ORACLES = {
+    "parse_libsvm_dense": "parse_libsvm_lines",
+    "count_lines": "parse_libsvm_lines",
+}
+
 
 def _native_lib():
     """Load (building on demand) the C++ parser; None when unavailable."""

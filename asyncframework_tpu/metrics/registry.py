@@ -147,6 +147,16 @@ _register(CounterFamily(
         "compression hits (net/wirecodec.py).",
 ))
 _register(CounterFamily(
+    "native", "asyncframework_tpu.native_build",
+    "native_totals", "reset_native_totals",
+    doc="Native data plane: native vs Python codec dispatches per unit "
+        "(native_calls.<unit>/python_calls.<unit>), wanted-but-missing "
+        "fallbacks (python_fallbacks -- nonzero means the box is "
+        "silently running the slow path), and the shm-ring transport's "
+        "upgrades/refusals/degrades plus frame/byte flow "
+        "(native_build.py, net/shmring.py).",
+))
+_register(CounterFamily(
     "shardgroup", "asyncframework_tpu.parallel.shardgroup",
     "shard_totals", "reset_shard_totals",
     doc="Sharded PS group: shard deaths/restarts, standby promotions/"

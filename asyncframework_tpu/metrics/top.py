@@ -176,6 +176,24 @@ def render_status(status: Dict, plain: bool = True) -> str:
         lines.append("")
         lines.append(render_profile_row(prof))
 
+    # ---- native data plane (codec dispatches + shm transport)
+    native = status.get("native") or {}
+    if native:
+        nc = sum(int(v) for k, v in native.items()
+                 if k.startswith("native_calls."))
+        pc = sum(int(v) for k, v in native.items()
+                 if k.startswith("python_calls."))
+        row = (f"native: calls={nc} python={pc} "
+               f"fallbacks={native.get('python_fallbacks', 0)}")
+        if native.get("shm_upgrades") or native.get("shm_upgrade_refused"):
+            row += (f"  shm: up={native.get('shm_upgrades', 0)} "
+                    f"refused={native.get('shm_upgrade_refused', 0)} "
+                    f"degraded={native.get('shm_degrades', 0)} "
+                    f"tx={_fmt(native.get('shm_bytes_sent', 0) / 1e6, 2)}MB "
+                    f"rx={_fmt(native.get('shm_bytes_recv', 0) / 1e6, 2)}MB")
+        lines.append("")
+        lines.append(row)
+
     # ---- adaptive control plane
     control = status.get("control") or {}
     if control.get("knobs"):

@@ -4,22 +4,77 @@
 shared libraries next to their sources (the ctypes loaders look there).
 Library code calls :func:`ensure_built` lazily and degrades to the
 pure-Python fallbacks when no toolchain is available.
+
+Staleness is judged against the SOURCE mtime **and** the build recipe: a
+``<name>.flags`` stamp next to each ``.so`` records the exact compile
+command that produced it, so changing ``CXX``/``CXXFLAGS`` (or editing
+``native/Makefile``, whose mtime is also considered) triggers a rebuild
+instead of silently running old code under new flags.
+
+``python -m asyncframework_tpu.native_build --check`` prints per-source
+status (built / stale / missing-toolchain / no-source) without building
+anything -- the operator's answer to "is this box actually running the
+native data plane?".
+
+This module also hosts the ``native`` counter family
+(:func:`native_totals` / :func:`reset_native_totals`, registered in
+``metrics/registry.py``): every native-vs-Python dispatch decision in the
+wire hot paths bumps a counter here, so a silent fallback to the Python
+oracle is *visible* in /api/status, /metrics, and async-top, not inferred
+from speed.  It lives in this dependency-light module because both the
+``net/`` loaders and ``net/shmring.py`` bump it and neither may import
+the other.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 import subprocess
 import sys
-from typing import Optional
+import threading
+from typing import Dict, Optional
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
 )
 
-SOURCES = ("libsvm_parser", "kvstore", "codec")
+SOURCES = ("libsvm_parser", "kvstore", "codec", "wiredelta", "wirecodec",
+           "shmring")
+
+# ------------------------------------------------------------ native totals
+# Process-global counters (metrics/registry.py family "native"):
+# native_calls.<unit> / python_calls.<unit> per dispatch site, plus
+# python_fallbacks (conf WANTED native but the library is unavailable --
+# the silent-degrade case this family exists to surface) and the shm-ring
+# transport's frame/byte/upgrade/degrade counts (net/shmring.py).
+_totals_lock = threading.Lock()
+_totals: Dict[str, int] = {}
 
 
+def bump_native(key: str, n: int = 1) -> None:
+    with _totals_lock:
+        _totals[key] = _totals.get(key, 0) + n
+
+
+def native_totals() -> Dict[str, int]:
+    """Flat monotone counters: native_calls.<unit> / python_calls.<unit>
+    (which implementation actually ran, per codec unit),
+    python_fallbacks (native was enabled but unavailable), shm_upgrades /
+    shm_upgrade_refused / shm_degrades, shm_frames_sent, shm_bytes_sent /
+    shm_bytes_recv."""
+    with _totals_lock:
+        return dict(_totals)
+
+
+def reset_native_totals() -> None:
+    """Zero the native-plane counters (per-run isolation; see
+    ``asyncframework_tpu.metrics.reset_totals``)."""
+    with _totals_lock:
+        _totals.clear()
+
+
+# ------------------------------------------------------------------- build
 def native_dir() -> str:
     return _NATIVE_DIR
 
@@ -28,13 +83,55 @@ def lib_path(name: str) -> str:
     return os.path.join(_NATIVE_DIR, f"{name}.so")
 
 
+def _flags_path(name: str) -> str:
+    return os.path.join(_NATIVE_DIR, f"{name}.flags")
+
+
+def _compile_cmd(name: str) -> list:
+    cxx = os.environ.get("CXX", "g++")
+    flags = os.environ.get(
+        "CXXFLAGS", "-O3 -fPIC -shared -std=c++17 -Wall"
+    ).split()
+    return [cxx, *flags, "-o", lib_path(name),
+            os.path.join(_NATIVE_DIR, f"{name}.cc")]
+
+
+def _src_mtime(name: str) -> Optional[float]:
+    """Newest mtime of the inputs that define the build: the source file
+    and the Makefile (a flag edit there must rebuild too).  None when the
+    source itself is absent (an installed tree shipping only ``.so``s --
+    nothing to be stale against)."""
+    src = os.path.join(_NATIVE_DIR, f"{name}.cc")
+    if not os.path.exists(src):
+        return None
+    newest = os.path.getmtime(src)
+    mk = os.path.join(_NATIVE_DIR, "Makefile")
+    if os.path.exists(mk):
+        newest = max(newest, os.path.getmtime(mk))
+    return newest
+
+
 def is_built(name: str) -> bool:
     so = lib_path(name)
-    src = os.path.join(_NATIVE_DIR, f"{name}.cc")
-    return os.path.exists(so) and (
-        not os.path.exists(src)
-        or os.path.getmtime(so) >= os.path.getmtime(src)
-    )
+    if not os.path.exists(so):
+        return False
+    newest = _src_mtime(name)
+    if newest is None:
+        return True
+    if os.path.getmtime(so) < newest:
+        return False
+    # recipe stamp: a CXX/CXXFLAGS change invalidates the artifact even
+    # with identical mtimes.  A missing stamp (pre-stamp .so, or one
+    # built by `make` directly) is accepted when the mtimes pass -- the
+    # stamp only ever ADDS rebuild triggers, it never blocks loading.
+    fp = _flags_path(name)
+    if os.path.exists(fp):
+        try:
+            with open(fp, "r", encoding="utf-8") as f:
+                return f.read() == " ".join(_compile_cmd(name))
+        except OSError:
+            return False
+    return True
 
 
 def ensure_built(name: str, quiet: bool = True) -> Optional[str]:
@@ -45,9 +142,7 @@ def ensure_built(name: str, quiet: bool = True) -> Optional[str]:
     src = os.path.join(_NATIVE_DIR, f"{name}.cc")
     if not os.path.exists(src):
         return None
-    cxx = os.environ.get("CXX", "g++")
-    cmd = [cxx, "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall",
-           "-o", lib_path(name), src]
+    cmd = _compile_cmd(name)
     try:
         res = subprocess.run(
             cmd, capture_output=True, text=True, cwd=_NATIVE_DIR, timeout=120
@@ -58,10 +153,46 @@ def ensure_built(name: str, quiet: bool = True) -> Optional[str]:
         if not quiet:
             sys.stderr.write(res.stderr)
         return None
+    try:
+        with open(_flags_path(name), "w", encoding="utf-8") as f:
+            f.write(" ".join(cmd))
+    except OSError:
+        pass  # a read-only tree still serves the fresh .so
     return lib_path(name)
 
 
-def main() -> int:
+def check_status(name: str) -> str:
+    """One source's build state WITHOUT building: ``built`` / ``stale``
+    (source or recipe newer than the artifact) / ``missing`` (never
+    built) / ``no-source`` -- each suffixed ``, no-toolchain`` when a
+    (re)build could not run anyway."""
+    src = os.path.join(_NATIVE_DIR, f"{name}.cc")
+    so = lib_path(name)
+    if not os.path.exists(src):
+        state = "no-source" if not os.path.exists(so) else "built"
+        return state
+    if not os.path.exists(so):
+        state = "missing"
+    elif is_built(name):
+        return "built"
+    else:
+        state = "stale"
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which(cxx) is None:
+        state += ", no-toolchain"
+    return state
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--check" in argv:
+        worst = 0
+        for name in SOURCES:
+            state = check_status(name)
+            print(f"{name}: {state}")
+            if state != "built":
+                worst = 1
+        return worst
     ok = True
     for name in SOURCES:
         path = ensure_built(name, quiet=False)

@@ -42,6 +42,7 @@ client attach this RPC's wire cost to its pull.rtt/push.rtt trace span.
 
 from __future__ import annotations
 
+import ctypes
 import json
 import socket
 import struct
@@ -49,12 +50,87 @@ import threading
 import weakref
 from typing import Dict, Optional, Sequence, Tuple
 
+import numpy as np
+
 from asyncframework_tpu.metrics import profiler as _prof
 from asyncframework_tpu.metrics import trace as _trace
+from asyncframework_tpu.native_build import bump_native as _bump_native
 from asyncframework_tpu.net import faults, lockwatch
 from asyncframework_tpu.net import retry as _retry
 
 _HDR = struct.Struct("!I")  # 4-byte big-endian frame length
+
+# ---------------------------------------------------------- native gather
+#: native symbol -> same-module pure-Python oracle (``native-oracle``
+#: lint); wd_gather is the iovec-style memcpy loop of native/wiredelta.cc
+NATIVE_ORACLES = {"wd_gather": "_py_gather"}
+
+_NATIVE = None
+
+
+def _native_lib():
+    global _NATIVE
+    if _NATIVE is not None:
+        return _NATIVE or None
+    lib = None
+    try:
+        from asyncframework_tpu.native_build import ensure_built
+
+        built = ensure_built("wiredelta")
+        if built:
+            lib = ctypes.CDLL(built)
+            lib.wd_gather.restype = ctypes.c_longlong
+            lib.wd_gather.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_void_p, ctypes.c_longlong]
+    except Exception:  # noqa: BLE001 - fall back to Python
+        lib = None
+    _NATIVE = lib or False
+    return lib
+
+
+def _use_native():
+    from asyncframework_tpu.conf import NATIVE_ENABLED, global_conf
+
+    if not global_conf().get(NATIVE_ENABLED):
+        return None
+    lib = _native_lib()
+    if lib is None:
+        _bump_native("python_fallbacks")
+    return lib
+
+
+def _py_gather(parts) -> bytes:
+    return b"".join(bytes(memoryview(p)) for p in parts)
+
+
+def gather(parts) -> bytes:
+    """Materialize a frame from its buffer parts: ``b"".join`` semantics,
+    but through the native iovec-memcpy helper when enabled, which
+    releases the GIL for the copy of a multi-megabyte payload.  Used by
+    the non-vectored send paths (fault-injection materialization, the
+    no-``sendmsg`` fallback) and the shm-ring transport's frame staging
+    (``net/shmring.py``); byte-identical to the join by construction and
+    property-tested in tests/test_native.py."""
+    lib = _use_native()
+    if lib is not None and len(parts) > 1:
+        arrs = [np.frombuffer(memoryview(p).cast("B"), np.uint8)
+                for p in parts]
+        arrs = [a for a in arrs if a.size]
+        if len(arrs) > 1:
+            total = int(sum(a.size for a in arrs))
+            out = np.empty(total, np.uint8)
+            n = len(arrs)
+            srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])
+            lens = (ctypes.c_longlong * n)(*[int(a.size) for a in arrs])
+            got = lib.wd_gather(
+                ctypes.c_void_p(out.ctypes.data),
+                ctypes.cast(srcs, ctypes.c_void_p),
+                ctypes.cast(lens, ctypes.c_void_p), n)
+            if got == total:
+                _bump_native("native_calls.gather")
+                return out.tobytes()
+    _bump_native("python_calls.gather")
+    return _py_gather(parts)
 
 # ------------------------------------------------------------ wire bytes
 # Per-op frame byte counters (process-global, lock-guarded like every other
@@ -257,8 +333,8 @@ def _send_frame(sock: socket.socket, header: dict, parts: Sequence) -> None:
                 )
             # chaos path: materialize the frame so mid-frame cuts slice the
             # exact same byte stream the plain path would have sent
-            data = (_HDR.pack(len(head)) + head + _HDR.pack(plen)
-                    + b"".join(bytes(memoryview(p)) for p in parts))
+            data = gather(
+                [_HDR.pack(len(head)), head, _HDR.pack(plen), *parts])
             kind = inj.check_send(endpoint, op)
             if kind == faults.CUT_MID_FRAME:
                 # a prefix of the frame goes out, then the connection dies:
@@ -293,8 +369,7 @@ def _send_frame(sock: socket.socket, header: dict, parts: Sequence) -> None:
         elif _HAVE_SENDMSG:
             _sendmsg_all(sock, [prefix, *parts])
         else:  # pragma: no cover - platforms without sendmsg
-            sock.sendall(
-                prefix + b"".join(bytes(memoryview(p)) for p in parts))
+            sock.sendall(gather([prefix, *parts]))
         _io_tls.sent = total
         _count("sent", op, total)
 

@@ -127,6 +127,16 @@ _op("EVAL_RESULT", PS, mutating=True,
 _op("BYE", PS, mutating=True,
     doc="Departing client's final piggybacks (spans/pl/cv).  Sent once "
         "per connection, never retried; span folds dedup by span_id.")
+_op("SHM_OPEN", PS, fault_schedulable=True,
+    doc="Transport upgrade handshake (net/shmring.py): a colocated "
+        "client offers two shared-memory ring segments; the server "
+        "attaches and ACKs, after which the SAME framed protocol "
+        "continues over the rings instead of the TCP socket (which is "
+        "retained for identity/liveness).  Non-mutating and trivially "
+        "idempotent: it changes the TRANSPORT of a connection, never "
+        "server state -- a refused or lost upgrade leaves the TCP "
+        "conversation exactly where it was, and admission checks "
+        "(dedup, fencing) run unchanged over either transport.")
 _op("REPL_APPEND", PS, mutating=True, fence_stamped=True,
     fault_schedulable=True,
     doc="Primary->standby replication of one accepted merge batch "
@@ -331,6 +341,7 @@ SERVER_DISPATCH: Dict[str, Tuple[str, ...]] = {
     "SHARDMAP": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "SETMAP": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "FINISH": ("asyncframework_tpu/parallel/ps_dcn.py",),
+    "SHM_OPEN": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "REPL_APPEND": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "REPL_SYNC": ("asyncframework_tpu/parallel/ps_dcn.py",),
     "PROMOTE": ("asyncframework_tpu/parallel/ps_dcn.py",),

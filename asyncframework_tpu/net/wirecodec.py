@@ -31,10 +31,20 @@ knob is absent):
   the index half is ascending u32), and losslessness means the
   CRC-gating contract is untouched: decompress, then the stock decode
   verifies the version CRC exactly as before.
+
+Native fast path (``async.native.enabled``, native/wirecodec.cc): the
+quantize/dequantize passes (error-feedback fold included) and the
+byte-shuffle / delta-index transforms dispatch to GIL-free C twins; the
+numpy implementations (``_py_*``) stay the registered bit-identity
+oracles (``NATIVE_ORACLES``, ``native-oracle`` lint) and the fallback
+without a toolchain.  zlib itself already runs in C with the GIL
+released, so deflate stays on the stdlib.  Bit-identical either way --
+property-tested in tests/test_native.py incl. NaN/inf/-0.
 """
 
 from __future__ import annotations
 
+import ctypes
 import threading
 import zlib
 from typing import Dict, Optional, Tuple
@@ -42,6 +52,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from asyncframework_tpu.metrics import profiler as _prof
+from asyncframework_tpu.native_build import bump_native as _bump_native
 
 #: gradient-codec names (``async.codec.push`` values)
 OFF = "off"
@@ -79,6 +90,72 @@ def reset_codec_totals() -> None:
         _totals.clear()
 
 
+# --------------------------------------------------------- native loading
+#: native symbol -> same-module pure-Python oracle (``native-oracle``
+#: lint table; every pair is property-tested for bit identity)
+NATIVE_ORACLES = {
+    "wc_enc_fp16": "_py_enc_fp16",
+    "wc_enc_int8": "_py_enc_int8",
+    "wc_dec_fp16": "_py_dec_fp16",
+    "wc_dec_int8": "_py_dec_int8",
+    "wc_shuffle4": "_py_shuffle4",
+    "wc_unshuffle4": "_py_unshuffle4",
+    "wc_delta_idx": "_py_delta_idx",
+    "wc_cumsum_idx": "_py_cumsum_idx",
+}
+
+_NATIVE = None
+
+
+def _native_lib():
+    global _NATIVE
+    if _NATIVE is not None:
+        return _NATIVE or None
+    lib = None
+    try:
+        from asyncframework_tpu.native_build import ensure_built
+
+        built = ensure_built("wirecodec")
+        if built:
+            lib = ctypes.CDLL(built)
+            P, LL = ctypes.c_void_p, ctypes.c_longlong
+            lib.wc_enc_fp16.restype = ctypes.c_int
+            lib.wc_enc_fp16.argtypes = [P, P, LL, P, P, ctypes.c_double]
+            lib.wc_enc_int8.restype = ctypes.c_int
+            lib.wc_enc_int8.argtypes = [P, P, LL, P, P, P]
+            lib.wc_dec_fp16.restype = None
+            lib.wc_dec_fp16.argtypes = [P, LL, P]
+            lib.wc_dec_int8.restype = None
+            lib.wc_dec_int8.argtypes = [P, LL, ctypes.c_float, P]
+            lib.wc_shuffle4.restype = None
+            lib.wc_shuffle4.argtypes = [P, LL, P]
+            lib.wc_unshuffle4.restype = None
+            lib.wc_unshuffle4.argtypes = [P, LL, P]
+            lib.wc_delta_idx.restype = None
+            lib.wc_delta_idx.argtypes = [P, LL, P]
+            lib.wc_cumsum_idx.restype = None
+            lib.wc_cumsum_idx.argtypes = [P, LL, P]
+    except Exception:  # noqa: BLE001 - fall back to Python
+        lib = None
+    _NATIVE = lib or False
+    return lib
+
+
+def _use_native():
+    from asyncframework_tpu.conf import NATIVE_ENABLED, global_conf
+
+    if not global_conf().get(NATIVE_ENABLED):
+        return None
+    lib = _native_lib()
+    if lib is None:
+        _bump_native("python_fallbacks")
+    return lib
+
+
+def _addr(a: np.ndarray) -> ctypes.c_void_p:
+    return ctypes.c_void_p(a.ctypes.data)
+
+
 # ------------------------------------------------------------ gradient path
 def grad_error_bound(codec: str, absmax: float) -> float:
     """Per-coordinate quantization error bound of ONE encode whose input
@@ -93,6 +170,28 @@ def grad_error_bound(codec: str, absmax: float) -> float:
     if codec == FP16:
         return absmax * _FP16_REL + _FP16_ABS
     return 0.0
+
+
+def _py_enc_fp16(x: np.ndarray, absmax: float):
+    """fp16 oracle: returns (hdr, payload, new_err) or None (overflow).
+    ``x`` is the residual-folded f32 input, known finite."""
+    if absmax > _FP16_SAFE_MAX:
+        return None
+    q = x.astype(np.float16)
+    applied = q.astype(np.float32)
+    return {"gq": FP16}, q.tobytes(), x - applied
+
+
+def _py_enc_int8(x: np.ndarray, absmax: float):
+    """int8 oracle: returns (hdr, payload, new_err); never refuses."""
+    scale = absmax / 127.0
+    if scale > 0.0:
+        q = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+        applied = q.astype(np.float32) * np.float32(scale)
+    else:
+        q = np.zeros(x.shape, np.int8)
+        applied = np.zeros(x.shape, np.float32)
+    return {"gq": INT8, "gs": float(scale)}, q.tobytes(), x - applied
 
 
 @_prof.zoned("wire.quantize")
@@ -112,35 +211,67 @@ def encode_grad(g: np.ndarray, codec: str, err: Optional[np.ndarray]
         return None
     if codec not in GRAD_CODECS:
         raise ValueError(f"unknown gradient codec {codec!r}")
+    lib = _use_native()
+    if (lib is not None and g.flags.c_contiguous
+            and (err is None
+                 or (err.flags.c_contiguous and err.size == g.size))):
+        # the C twin folds the residual, scans finiteness, and
+        # quantizes in ONE GIL-free pass; refusal statuses mirror the
+        # oracle's None paths exactly
+        n = int(g.size)
+        new_err = np.empty(n, np.float32).reshape(g.shape)
+        earg = _addr(err) if err is not None else None
+        _bump_native("native_calls.quantize")
+        if codec == FP16:
+            q16 = np.empty(n, np.uint16)
+            st = lib.wc_enc_fp16(_addr(g), earg, n, _addr(q16),
+                                 _addr(new_err), _FP16_SAFE_MAX)
+            if st != 0:  # 1 = non-finite, 2 = overflow
+                _bump("grad_enc_raw_fallback")
+                return None
+            hdr, payload = {"gq": FP16}, q16.tobytes()
+            _bump("grad_enc_fp16")
+        else:  # INT8
+            q8 = np.empty(n, np.int8)
+            sc = ctypes.c_double()
+            st = lib.wc_enc_int8(_addr(g), earg, n, _addr(q8),
+                                 _addr(new_err), ctypes.byref(sc))
+            if st != 0:
+                _bump("grad_enc_raw_fallback")
+                return None
+            hdr, payload = {"gq": INT8, "gs": float(sc.value)}, q8.tobytes()
+            _bump("grad_enc_int8")
+        _bump("grad_bytes_raw", int(g.nbytes))
+        _bump("grad_bytes_wire", len(payload))
+        return hdr, payload, new_err
+    _bump_native("python_calls.quantize")
     x = g + err if err is not None else np.array(g, np.float32)
     if not np.isfinite(x).all():
         _bump("grad_enc_raw_fallback")
         return None
     absmax = float(np.max(np.abs(x))) if x.size else 0.0
     if codec == FP16:
-        if absmax > _FP16_SAFE_MAX:
+        enc = _py_enc_fp16(x, absmax)
+        if enc is None:
             _bump("grad_enc_raw_fallback")
             return None
-        q = x.astype(np.float16)
-        applied = q.astype(np.float32)
-        hdr = {"gq": FP16}
-        payload = q.tobytes()
         _bump("grad_enc_fp16")
     else:  # INT8
-        scale = absmax / 127.0
-        if scale > 0.0:
-            q = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
-            applied = q.astype(np.float32) * np.float32(scale)
-        else:
-            q = np.zeros(x.shape, np.int8)
-            applied = np.zeros(x.shape, np.float32)
-        hdr = {"gq": INT8, "gs": float(scale)}
-        payload = q.tobytes()
+        enc = _py_enc_int8(x, absmax)
         _bump("grad_enc_int8")
-    new_err = x - applied
+    hdr, payload, new_err = enc
     _bump("grad_bytes_raw", int(g.nbytes))
     _bump("grad_bytes_wire", len(payload))
     return hdr, payload, new_err
+
+
+def _py_dec_fp16(payload) -> np.ndarray:
+    return np.frombuffer(payload, np.float16).astype(np.float32)
+
+
+def _py_dec_int8(payload, gs: float) -> np.ndarray:
+    return (np.frombuffer(payload, np.int8).astype(np.float32)
+            * np.float32(gs))
 
 
 @_prof.zoned("wire.quantize")
@@ -149,11 +280,19 @@ def decode_grad(header: dict, payload, d: int) -> np.ndarray:
     Raises ``ValueError`` on a malformed frame (wrong codec tag or
     payload length) -- the server answers ERR instead of applying."""
     gq = header.get("gq")
+    lib = _use_native()
     if gq == FP16:
         if len(payload) != 2 * d:
             raise ValueError(f"fp16 push wants {2 * d} bytes, "
                              f"got {len(payload)}")
-        g = np.frombuffer(payload, np.float16).astype(np.float32)
+        if lib is not None:
+            q = np.frombuffer(payload, np.uint16)
+            g = np.empty(d, np.float32)
+            lib.wc_dec_fp16(_addr(q), d, _addr(g))
+            _bump_native("native_calls.quantize")
+        else:
+            _bump_native("python_calls.quantize")
+            g = _py_dec_fp16(payload)
     elif gq == INT8:
         if len(payload) != d:
             raise ValueError(f"int8 push wants {d} bytes, "
@@ -163,8 +302,15 @@ def decode_grad(header: dict, payload, d: int) -> np.ndarray:
             # a missing/garbage scale must answer ERR, not silently
             # apply an all-zero (or poisoned) gradient
             raise ValueError(f"int8 push with bad scale {gs!r}")
-        g = (np.frombuffer(payload, np.int8).astype(np.float32)
-             * np.float32(gs))
+        if lib is not None:
+            q = np.frombuffer(payload, np.int8)
+            g = np.empty(d, np.float32)
+            lib.wc_dec_int8(_addr(q), d,
+                            ctypes.c_float(np.float32(gs)), _addr(g))
+            _bump_native("native_calls.quantize")
+        else:
+            _bump_native("python_calls.quantize")
+            g = _py_dec_int8(payload, gs)
     else:
         raise ValueError(f"unknown gradient codec tag {gq!r}")
     _bump("grad_dec")
@@ -179,18 +325,72 @@ _SNAP_MIN_BYTES = 64
 _SNAP_LEVEL = 6
 
 
+def _py_shuffle4(payload: bytes) -> bytes:
+    return np.frombuffer(payload, np.uint8).reshape(-1, 4).T.tobytes()
+
+
+def _py_unshuffle4(payload: bytes) -> bytes:
+    a = np.frombuffer(payload, np.uint8).reshape(4, -1).T
+    return np.ascontiguousarray(a).tobytes()
+
+
+def _py_delta_idx(idx: np.ndarray) -> np.ndarray:
+    return np.diff(idx, prepend=np.uint32(0)).astype(np.uint32)
+
+
+def _py_cumsum_idx(idxd: np.ndarray) -> np.ndarray:
+    return np.cumsum(idxd.astype(np.uint64)).astype(np.uint32)
+
+
 def _shuffle4(payload: bytes) -> bytes:
     """Byte-plane transposition over 4-byte words (the Blosc/HDF5
     shuffle filter): all byte-0s, then all byte-1s, ...  XOR words of
     consecutive training versions agree in their high bytes, so the
     transposed planes are runs deflate actually crunches.  Exact
     inverse in :func:`_unshuffle4`; requires word alignment."""
-    return np.frombuffer(payload, np.uint8).reshape(-1, 4).T.tobytes()
+    lib = _use_native()
+    if lib is not None:
+        src = np.frombuffer(payload, np.uint8)
+        dst = np.empty(src.size, np.uint8)
+        lib.wc_shuffle4(_addr(src), src.size, _addr(dst))
+        _bump_native("native_calls.shuffle")
+        return dst.tobytes()
+    _bump_native("python_calls.shuffle")
+    return _py_shuffle4(payload)
 
 
 def _unshuffle4(payload: bytes) -> bytes:
-    a = np.frombuffer(payload, np.uint8).reshape(4, -1).T
-    return np.ascontiguousarray(a).tobytes()
+    lib = _use_native()
+    if lib is not None:
+        src = np.frombuffer(payload, np.uint8)
+        dst = np.empty(src.size, np.uint8)
+        lib.wc_unshuffle4(_addr(src), src.size, _addr(dst))
+        _bump_native("native_calls.shuffle")
+        return dst.tobytes()
+    _bump_native("python_calls.shuffle")
+    return _py_unshuffle4(payload)
+
+
+def _delta_idx(idx: np.ndarray) -> np.ndarray:
+    lib = _use_native()
+    if lib is not None:
+        out = np.empty(idx.size, np.uint32)
+        lib.wc_delta_idx(_addr(idx), int(idx.size), _addr(out))
+        _bump_native("native_calls.shuffle")
+        return out
+    _bump_native("python_calls.shuffle")
+    return _py_delta_idx(idx)
+
+
+def _cumsum_idx(idxd: np.ndarray) -> np.ndarray:
+    lib = _use_native()
+    if lib is not None:
+        out = np.empty(idxd.size, np.uint32)
+        lib.wc_cumsum_idx(_addr(idxd), int(idxd.size), _addr(out))
+        _bump_native("native_calls.shuffle")
+        return out
+    _bump_native("python_calls.shuffle")
+    return _py_cumsum_idx(idxd)
 
 
 @_prof.zoned("wire.compress")
@@ -219,7 +419,7 @@ def compress_model_part(wenc: str, payload: bytes, nnz: int = 0
     best = ({}, payload)
     if wenc == "xdelta" and nnz > 0 and n == 8 * nnz:
         idx = np.frombuffer(payload[: 4 * nnz], np.uint32)
-        idxd = np.diff(idx, prepend=np.uint32(0)).astype(np.uint32)
+        idxd = _delta_idx(idx)
         z = zlib.compress(_shuffle4(idxd.tobytes())
                           + _shuffle4(payload[4 * nnz:]), _SNAP_LEVEL)
         if len(z) < len(best[1]):
@@ -265,7 +465,7 @@ def decompress_model_part(header: dict, payload) -> bytes:
             raise ValueError(f"zd payload: ulen={ulen} vs nnz={nnz}")
         idxd = np.frombuffer(_unshuffle4(out[: 4 * nnz]), np.uint32)
         xorw = _unshuffle4(out[4 * nnz:])
-        idx = np.cumsum(idxd.astype(np.uint64)).astype(np.uint32)
+        idx = _cumsum_idx(idxd)
         out = idx.tobytes() + xorw
     elif cz == "zs":
         if ulen % 4 != 0:

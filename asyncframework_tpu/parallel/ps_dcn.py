@@ -117,6 +117,7 @@ from asyncframework_tpu.metrics import profiler as _prof
 from asyncframework_tpu.metrics import trace as _trace
 from asyncframework_tpu.net import ClientSession, DedupWindow, RetryPolicy
 from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.net import shmring as _shmring
 from asyncframework_tpu.net import wirecodec, wiredelta
 from asyncframework_tpu.parallel import supervisor as supervisor_mod
 from asyncframework_tpu.parallel.supervisor import ElasticSupervisor
@@ -1289,6 +1290,17 @@ class ParameterServer:
                              wall_ms=self._bus_time_ms())
                     _send_msg(conn, {"op": "ACK"})
                     return
+                elif op == "SHM_OPEN":
+                    # transport upgrade (net/shmring.py): attach to the
+                    # colocated client's ring segments and keep serving
+                    # the SAME framed protocol over them.  Everything
+                    # above the transport -- dedup, fencing, CRC fields
+                    # -- runs unchanged; only the byte path underneath
+                    # _recv_msg/_send_msg moves.  A refused attach
+                    # answered ERR and this TCP conversation continues.
+                    upgraded = _shmring.serve_attach(conn, header)
+                    if upgraded is not None:
+                        conn = upgraded
                 else:
                     _send_msg(conn, {"op": "ERR", "msg": f"bad op {op}"})
         except (ConnectionError, OSError):
@@ -2680,7 +2692,8 @@ class PSClient:
                  pull_mode: Optional[str] = None,
                  pl_stats: Optional[_PipelineStats] = None,
                  cv_buf=None, epoch: int = 0,
-                 push_codec: Optional[str] = None, ctrl_sink=None):
+                 push_codec: Optional[str] = None, ctrl_sink=None,
+                 shm: Optional[bool] = None):
         self.host, self.port = host, int(port)
         # adaptive control plane: a ControlSink (parallel/controller.py)
         # shared by this worker process's clients.  PULL requests stamp
@@ -2764,6 +2777,18 @@ class PSClient:
         self._win_lock = threading.Lock()
         # the one in-flight prefetched PULL (pull_start/pull_finish)
         self._pending_pull: Optional[tuple] = None
+        # shared-memory transport (net/shmring.py): when enabled AND the
+        # PS is colocated (loopback peer), each (re)dial opportunistically
+        # upgrades the fresh TCP connection to a ring pair -- same framed
+        # protocol, fewer copies, no GIL on the byte path.  A ring-level
+        # failure latches _shm_failed so the NEXT dial stays on plain
+        # TCP: the degrade is one reconnect away and never loops.
+        if shm is None:
+            from asyncframework_tpu.conf import SHM_ENABLED, global_conf
+
+            shm = bool(global_conf().get(SHM_ENABLED))
+        self.shm = bool(shm)
+        self._shm_failed = False
         self._sock: Optional[socket.socket] = None
         self.bytes_pushed = 0  # payload bytes shipped by push/push_saga
         # eager first dial (historical behavior: constructing a client to a
@@ -2777,11 +2802,28 @@ class PSClient:
 
     def _drop_sock(self) -> None:
         if self._sock is not None:
+            if isinstance(self._sock, _shmring.ShmSocket):
+                # a dropped ring transport is never resurrected blind:
+                # the next dial stays on plain TCP (the upgrade is
+                # opportunistic, the degrade is sticky per client --
+                # reconnect-and-retry loops must converge, not oscillate
+                # between a wedged ring and the socket)
+                self._shm_failed = True
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
+
+    def _dial(self):
+        """Fresh connection under this client's transport policy: the
+        TCP dial, then the opportunistic shm-ring upgrade (colocated
+        peer + conf gate + not previously degraded)."""
+        sock = _frame.connect((self.host, self.port),
+                              timeout=self.retry.attempt_timeout_s)
+        if self.shm and not self._shm_failed:
+            sock, _ = _shmring.maybe_upgrade(sock)
+        return sock
 
     def _call_raw(self, header: Optional[dict] = None, payload: bytes = b"",
                   connect_only: bool = False) -> Tuple[dict, bytes]:
@@ -2792,16 +2834,14 @@ class PSClient:
         def attempt() -> Tuple[dict, bytes]:
             try:
                 if self._sock is None:
-                    self._sock = _frame.connect(
-                        (self.host, self.port),
-                        timeout=self.retry.attempt_timeout_s,
-                    )
+                    self._sock = self._dial()
                 if connect_only:
                     return {}, b""
                 _send_msg(self._sock, header, payload)
                 return _recv_msg(self._sock)
             except OSError:
                 # dead/poisoned connection: never reuse it for the retry
+                # (and _drop_sock pins a failed ring transport to TCP)
                 self._drop_sock()
                 raise
 
@@ -3038,10 +3078,7 @@ class PSClient:
         self._pending_pull = pending
         try:
             if self._sock is None:
-                self._sock = _frame.connect(
-                    (self.host, self.port),
-                    timeout=self.retry.attempt_timeout_s,
-                )
+                self._sock = self._dial()
             if tr is not None:
                 _trace.set_current(tr.ctx)
             try:
@@ -3058,6 +3095,10 @@ class PSClient:
         the kernel buffer (the prefetch fully hid the pull)."""
         if self._sock is None:
             return False
+        if isinstance(self._sock, _shmring.ShmSocket):
+            # ring bytes never show on the retained TCP fd; ask the
+            # ring's counters instead (same zero-wait semantics)
+            return self._sock.readable()
         import select
 
         try:
@@ -3078,10 +3119,7 @@ class PSClient:
         def attempt() -> Tuple[dict, bytes]:
             try:
                 if self._sock is None:
-                    self._sock = _frame.connect(
-                        (self.host, self.port),
-                        timeout=self.retry.attempt_timeout_s,
-                    )
+                    self._sock = self._dial()
                     if tr is not None:
                         _trace.set_current(tr.ctx)
                     try:
@@ -3371,10 +3409,7 @@ class PSClient:
                 with self._win_lock:
                     sock = self._sock
                     if sock is None:
-                        sock = self._sock = _frame.connect(
-                            (self.host, self.port),
-                            timeout=self.retry.attempt_timeout_s,
-                        )
+                        sock = self._sock = self._dial()
                         self._replay_window()
                 # recv OUTSIDE the window lock: the sender keeps sending
                 # while this blocks (full duplex)

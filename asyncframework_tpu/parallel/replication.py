@@ -59,6 +59,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from asyncframework_tpu.net import frame as _frame
+from asyncframework_tpu.net import shmring as _shmring
 
 # ------------------------------------------------------------ repl totals
 # Process-global replication counters (metrics/registry.py family
@@ -122,6 +123,12 @@ class ReplicationStream:
         self._cv = threading.Condition()
         self._need_sync = True
         self._sock = None
+        # shm-ring transport (net/shmring.py): a colocated standby's
+        # REPL stream is the highest-rate colocated flow in the system,
+        # so each fresh dial attempts the upgrade; any ring failure pins
+        # this stream back to TCP (the existing reconnect machinery IS
+        # the degrade path -- drop, resync, re-dial plain)
+        self._shm_failed = False
         self.synced = False
         self.fenced = False
         #: the standby's last ACKed applied clock -- primary_clock minus
@@ -178,6 +185,10 @@ class ReplicationStream:
         sock = self._sock
         self._sock = None
         if sock is not None:
+            if isinstance(sock, _shmring.ShmSocket):
+                # never resurrect a dropped ring blind: the next dial
+                # stays on TCP so the reconnect loop converges
+                self._shm_failed = True
             try:
                 sock.close()
             except OSError:
@@ -187,6 +198,8 @@ class ReplicationStream:
         if self._sock is None:
             sock = _frame.connect((self.host, self.port), timeout=5.0)
             sock.settimeout(15.0)
+            if not self._shm_failed:
+                sock, _ = _shmring.maybe_upgrade(sock)
             self._sock = sock
         return self._sock
 

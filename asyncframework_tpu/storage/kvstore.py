@@ -46,6 +46,24 @@ def string_hash_code(s: Bytes) -> int:
 
 _LIB = None
 
+#: native symbol -> pure-Python twin (native-oracle lint contract).
+#: The twin here is class-shaped: ``_PyBackend`` speaks the identical
+#: AKV1 file format and backend selection happens once, in
+#: ``KVStore.__init__``.
+NATIVE_ORACLES = {
+    "kv_open": "_PyBackend.__init__",
+    "kv_put": "_PyBackend.put",
+    "kv_get": "_PyBackend.get",
+    "kv_get_len": "_PyBackend.get",
+    "kv_delete": "_PyBackend.delete",
+    "kv_count": "_PyBackend.count",
+    "kv_compact": "_PyBackend.compact",
+    "kv_keys_size": "_PyBackend.keys",
+    "kv_keys_fill": "_PyBackend.keys",
+    "kv_close": "_PyBackend.close",
+    "string_hash_code": "string_hash_code",
+}
+
 
 def _native_lib():
     global _LIB

@@ -27,6 +27,14 @@ _HASH_MUL = 2654435761
 
 _NATIVE = None
 
+#: native symbol -> pure-Python twin (native-oracle lint contract:
+#: both backends produce interchangeable blocks, tests/test_codec.py)
+NATIVE_ORACLES = {
+    "az1_compress": "_py_compress",
+    "az1_decompress": "_py_decompress",
+    "az1_max_compressed_size": "max_compressed_size",
+}
+
 
 def _native_lib():
     global _NATIVE

@@ -271,6 +271,11 @@ def run_seed(seed: int, args) -> dict:
     # enabled worker child is SIGKILLed mid-run (seeded timing) and its
     # harvested flight dump must carry a non-empty profile snapshot
     # with the wire zones attributed (tests/test_profiler.py)
+    # native data plane rides every seed: bit-identity of the native
+    # wire codecs, the SHM_OPEN upgrade round-trip, and the shm-ring
+    # kill -9 rider -- a SIGKILLed ring peer must degrade the survivor
+    # with ConnectionError inside the liveness window, never a hang
+    # (tests/test_wire_native.py)
     cmd = [
         sys.executable, "-m", "pytest", "tests/test_chaos.py",
         "tests/test_net_retry.py", "tests/test_serving.py",
@@ -278,9 +283,10 @@ def run_seed(seed: int, args) -> dict:
         "tests/test_fencing.py", "tests/test_relaycast.py",
         "tests/test_replication.py", "tests/test_observer.py",
         "tests/test_controller.py", "tests/test_profiler.py",
+        "tests/test_wire_native.py",
         "-q", "-m",
         f"({marker}) or serve or telemetry or shard or fence or relay"
-        f" or repl or observer or ctrl or prof",
+        f" or repl or observer or ctrl or prof or native",
         "-p", "no:cacheprovider",
     ]
     if args.soak:

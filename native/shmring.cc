@@ -1,0 +1,136 @@
+// shmring: lock-free SPSC byte ring over a shared-memory segment
+// (net/shmring.py).
+//
+// One ring = one 192-byte header + a capacity-byte data region inside an
+// mmap'd file; one writer process, one reader process.  head (bytes
+// consumed, reader-owned) and tail (bytes produced, writer-owned) are
+// monotone u64s -- tail-head is the readable span, capacity-(tail-head)
+// the writable one.  Release/acquire atomics order the data copies
+// against the counter publishes, which is the entire correctness story
+// of an SPSC ring.  net/shmring.py carries a layout-identical pure-
+// Python twin (struct.pack_into on the same mmap) as the registered
+// oracle; tests cross-drive native-write/python-read and the reverse.
+//
+// Header layout (all little-endian on every platform this runs on):
+//   0   u32 magic 'SRNG'     32 u32 writer_pid     64  u64 head
+//   4   u32 version (2)      36 u32 reader_pid     128 u64 tail
+//   8   u64 capacity         40 u32 flags          192.. data
+// flags: bit0 = writer closed, bit1 = reader closed.
+//
+// head and tail each own a full cache line (v2; v1 packed them 8 bytes
+// apart): the writer's tail publishes and the reader's head publishes
+// no longer invalidate each OTHER's hot line, which under concurrent
+// streaming turned every counter read into a cross-core miss.  The
+// cold first line (magic/capacity/pids/flags) is read-mostly and stays
+// Shared in both caches.
+//
+// On an empty read / full write the call spins briefly IN HERE (pause
+// loop, GIL already released by ctypes) before returning 0: during
+// active streaming the matching publish usually lands within
+// microseconds, and catching it here saves a round-trip through the
+// Python pacing loop per chunk.  The Python twin returns immediately
+// instead -- spinning while holding the GIL would starve the very
+// thread it is waiting on; semantics (bytes moved, 0 = try again) are
+// identical either way.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static const uint32_t MAGIC = 0x53524E47u;  // 'SRNG'
+static const uint64_t HDR = 192;
+
+#define HEAD(base) ((uint64_t*)((base) + 64))
+#define TAIL(base) ((uint64_t*)((base) + 128))
+#define FLAGS(base) ((uint32_t*)((base) + 40))
+
+// ~a few microseconds of in-call waiting: SPIN_ROUNDS re-checks of the
+// peer's counter, PAUSES_PER_ROUND pause instructions apart
+static const int SPIN_ROUNDS = 64;
+static const int PAUSES_PER_ROUND = 64;
+
+static inline void cpu_pause() {
+#if defined(__x86_64__) || defined(__i386__)
+    __asm__ __volatile__("pause");
+#elif defined(__aarch64__)
+    __asm__ __volatile__("yield");
+#endif
+}
+
+int shm_ring_init(uint8_t* base, unsigned long long capacity) {
+    if (capacity == 0) return -1;
+    memset(base, 0, HDR);
+    *(uint32_t*)(base + 0) = MAGIC;
+    *(uint32_t*)(base + 4) = 2;
+    *(uint64_t*)(base + 8) = capacity;
+    __atomic_thread_fence(__ATOMIC_SEQ_CST);
+    return 0;
+}
+
+int shm_ring_ok(const uint8_t* base) {
+    return *(const uint32_t*)(base + 0) == MAGIC &&
+           *(const uint32_t*)(base + 4) == 2;
+}
+
+void shm_ring_close(uint8_t* base, int writer) {
+    __atomic_fetch_or(FLAGS(base), writer ? 1u : 2u, __ATOMIC_SEQ_CST);
+}
+
+// Bytes written (0..n; 0 = ring full, caller paces).  -1 = the reader
+// side is closed: nothing will ever drain the ring again.
+long long shm_ring_write(uint8_t* base, const uint8_t* data,
+                         long long n) {
+    uint32_t flags = __atomic_load_n(FLAGS(base), __ATOMIC_ACQUIRE);
+    if (flags & 2u) return -1;
+    uint64_t cap = *(uint64_t*)(base + 8);
+    uint64_t tail = __atomic_load_n(TAIL(base), __ATOMIC_RELAXED);
+    uint64_t head = __atomic_load_n(HEAD(base), __ATOMIC_ACQUIRE);
+    if (cap - (tail - head) == 0) {
+        for (int r = 0; r < SPIN_ROUNDS; ++r) {
+            for (int i = 0; i < PAUSES_PER_ROUND; ++i) cpu_pause();
+            head = __atomic_load_n(HEAD(base), __ATOMIC_ACQUIRE);
+            if (cap - (tail - head) != 0) break;
+        }
+    }
+    uint64_t free_b = cap - (tail - head);
+    uint64_t take = (uint64_t)n < free_b ? (uint64_t)n : free_b;
+    if (!take) return 0;
+    uint64_t pos = tail % cap;
+    uint64_t first = take < cap - pos ? take : cap - pos;
+    memcpy(base + HDR + pos, data, (size_t)first);
+    if (take > first) memcpy(base + HDR, data + first,
+                             (size_t)(take - first));
+    __atomic_store_n(TAIL(base), tail + take, __ATOMIC_RELEASE);
+    return (long long)take;
+}
+
+// Bytes read (0..maxn; 0 = ring empty).  -1 = empty AND writer closed:
+// clean EOF, no more bytes are coming.
+long long shm_ring_read(uint8_t* base, uint8_t* out, long long maxn) {
+    uint64_t cap = *(uint64_t*)(base + 8);
+    uint64_t head = __atomic_load_n(HEAD(base), __ATOMIC_RELAXED);
+    uint64_t tail = __atomic_load_n(TAIL(base), __ATOMIC_ACQUIRE);
+    if (tail == head) {
+        for (int r = 0; r < SPIN_ROUNDS; ++r) {
+            for (int i = 0; i < PAUSES_PER_ROUND; ++i) cpu_pause();
+            tail = __atomic_load_n(TAIL(base), __ATOMIC_ACQUIRE);
+            if (tail != head) break;
+        }
+    }
+    uint64_t avail = tail - head;
+    if (!avail) {
+        uint32_t flags = __atomic_load_n(FLAGS(base), __ATOMIC_ACQUIRE);
+        return (flags & 1u) ? -1 : 0;
+    }
+    uint64_t take = (uint64_t)maxn < avail ? (uint64_t)maxn : avail;
+    uint64_t pos = head % cap;
+    uint64_t first = take < cap - pos ? take : cap - pos;
+    memcpy(out, base + HDR + pos, (size_t)first);
+    if (take > first) memcpy(out + first, base + HDR,
+                             (size_t)(take - first));
+    __atomic_store_n(HEAD(base), head + take, __ATOMIC_RELEASE);
+    return (long long)take;
+}
+
+}  // extern "C"

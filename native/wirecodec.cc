@@ -1,0 +1,198 @@
+// wirecodec: GIL-free quantize/shuffle/index-transform hot paths
+// (net/wirecodec.py).
+//
+// Bit-exact twins of the numpy reference implementations -- the Python
+// functions stay the registered oracles and tests/test_native.py
+// property-tests equality over random inputs (NaN/inf/-0 included on
+// the paths that admit them).  The contracts that make bitwise equality
+// hold:
+//
+// - fp16 conversion is IEEE binary16 round-to-nearest-even, the same
+//   rule numpy's astype(float16) applies (hand-rolled below so no
+//   FP16C/F16C ISA assumption leaks in);
+// - int8 uses scale = double(absmax)/127.0, the DIVISION x/scale runs
+//   in float32 against float(scale) (NEP 50: a python-float scalar is
+//   demoted to the array dtype), rounding is rint = round-half-to-even
+//   (nearbyintf under the default FE_TONEAREST mode), and the applied
+//   value is float(q) * float(scale);
+// - the error-feedback residual is x - applied in float32.
+//
+// C ABI, ctypes-loaded, caller-owned buffers, long long sizes.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ----------------------------------------------------- fp16 conversions
+// float32 -> IEEE binary16 bits, round-to-nearest-even (numpy's rule).
+static uint16_t f32_to_f16(float f) {
+    uint32_t x;
+    memcpy(&x, &f, 4);
+    uint32_t sign = (x >> 16) & 0x8000u;
+    uint32_t mant = x & 0x007FFFFFu;
+    int32_t exp = (int32_t)((x >> 23) & 0xFF) - 127;
+    if (exp == 128) {  // inf / NaN
+        if (mant) return (uint16_t)(sign | 0x7E00u | (mant >> 13));
+        return (uint16_t)(sign | 0x7C00u);
+    }
+    if (exp > 15) return (uint16_t)(sign | 0x7C00u);  // overflow -> inf
+    if (exp >= -14) {  // normal half
+        uint32_t m = mant >> 13;
+        uint32_t rem = mant & 0x1FFFu;
+        uint16_t h = (uint16_t)(sign | ((uint32_t)(exp + 15) << 10) | m);
+        if (rem > 0x1000u || (rem == 0x1000u && (m & 1))) h++;
+        return h;  // mantissa carry rolls into the exponent correctly
+    }
+    if (exp < -25) return (uint16_t)sign;  // underflow -> signed zero
+    // subnormal half: value = M * 2^(exp-23) with the implicit bit set;
+    // the half-subnormal unit is 2^-24, so the kept mantissa is
+    // M >> (-exp-1), rounded half-to-even on the dropped bits
+    uint32_t m = mant | 0x00800000u;
+    int shift = -exp - 1;  // 14..24
+    uint32_t kept = m >> shift;
+    uint32_t rem = m & ((1u << shift) - 1u);
+    uint32_t half = 1u << (shift - 1);
+    uint16_t h = (uint16_t)(sign | kept);
+    if (rem > half || (rem == half && (kept & 1))) h++;
+    return h;
+}
+
+static float f16_to_f32(uint16_t h) {
+    uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1F;
+    uint32_t mant = h & 0x3FFu;
+    uint32_t x;
+    if (exp == 0x1F) {
+        x = sign | 0x7F800000u | (mant << 13);
+    } else if (exp == 0) {
+        if (mant == 0) {
+            x = sign;
+        } else {  // subnormal half -> normal float
+            int e = -1;
+            do {
+                mant <<= 1;
+                e++;
+            } while (!(mant & 0x400u));
+            x = sign | ((uint32_t)(127 - 15 - e) << 23)
+                | ((mant & 0x3FFu) << 13);
+        }
+    } else {
+        x = sign | ((exp + 112u) << 23) | (mant << 13);
+    }
+    float f;
+    memcpy(&f, &x, 4);
+    return f;
+}
+
+// ------------------------------------------------------- gradient encode
+// x = g + err (f32), reject non-finite (status 1) / fp16 overflow
+// (status 2, absmax compared as double against safe_max like the Python
+// float compare), else quantize with error feedback.  err may be NULL
+// (first push).  q_out is u16 half bits; newerr_out the next residual.
+int wc_enc_fp16(const float* g, const float* err, long long n,
+                uint16_t* q_out, float* newerr_out, double safe_max) {
+    double absmax = 0.0;
+    for (long long i = 0; i < n; i++) {
+        float x = err ? g[i] + err[i] : g[i];
+        if (!std::isfinite(x)) return 1;
+        double a = std::fabs((double)x);
+        if (a > absmax) absmax = a;
+    }
+    if (absmax > safe_max) return 2;
+    for (long long i = 0; i < n; i++) {
+        float x = err ? g[i] + err[i] : g[i];
+        uint16_t q = f32_to_f16(x);
+        q_out[i] = q;
+        newerr_out[i] = x - f16_to_f32(q);
+    }
+    return 0;
+}
+
+// int8: scale = double(absmax)/127 reported via scale_out for the wire
+// header; quantization itself runs in f32 against float(scale).
+int wc_enc_int8(const float* g, const float* err, long long n,
+                int8_t* q_out, float* newerr_out, double* scale_out) {
+    float absmax = 0.0f;
+    for (long long i = 0; i < n; i++) {
+        float x = err ? g[i] + err[i] : g[i];
+        if (!std::isfinite(x)) return 1;
+        float a = std::fabs(x);
+        if (a > absmax) absmax = a;
+    }
+    double scale = (double)absmax / 127.0;
+    *scale_out = scale;
+    float fs = (float)scale;
+    for (long long i = 0; i < n; i++) {
+        float x = err ? g[i] + err[i] : g[i];
+        float applied;
+        if (scale > 0.0) {
+            float r = nearbyintf(x / fs);  // rint: round-half-to-even
+            if (r > 127.0f) r = 127.0f;
+            if (r < -127.0f) r = -127.0f;
+            int8_t q = (int8_t)r;
+            q_out[i] = q;
+            applied = (float)q * fs;
+        } else {
+            q_out[i] = 0;
+            applied = 0.0f;
+        }
+        newerr_out[i] = x - applied;
+    }
+    return 0;
+}
+
+// ------------------------------------------------------- gradient decode
+void wc_dec_fp16(const uint16_t* q, long long n, float* out) {
+    for (long long i = 0; i < n; i++) out[i] = f16_to_f32(q[i]);
+}
+
+void wc_dec_int8(const int8_t* q, long long n, float gs, float* out) {
+    for (long long i = 0; i < n; i++) out[i] = (float)q[i] * gs;
+}
+
+// ------------------------------------------------- shuffle + index paths
+// Byte-plane transposition over 4-byte words (the Blosc/HDF5 shuffle):
+// n is the BYTE length, a multiple of 4.  dst[plane*words + w] =
+// src[w*4 + plane].
+void wc_shuffle4(const uint8_t* src, long long n, uint8_t* dst) {
+    long long words = n / 4;
+    for (long long w = 0; w < words; w++) {
+        dst[w] = src[w * 4];
+        dst[words + w] = src[w * 4 + 1];
+        dst[2 * words + w] = src[w * 4 + 2];
+        dst[3 * words + w] = src[w * 4 + 3];
+    }
+}
+
+void wc_unshuffle4(const uint8_t* src, long long n, uint8_t* dst) {
+    long long words = n / 4;
+    for (long long w = 0; w < words; w++) {
+        dst[w * 4] = src[w];
+        dst[w * 4 + 1] = src[words + w];
+        dst[w * 4 + 2] = src[2 * words + w];
+        dst[w * 4 + 3] = src[3 * words + w];
+    }
+}
+
+// Delta-encode an ascending u32 index list (np.diff with prepend=0) and
+// its inverse (u32 wrapping cumulative sum -- numpy's u64 cumsum cast
+// back to u32 is exactly mod-2^32 accumulation).
+void wc_delta_idx(const uint32_t* idx, long long n, uint32_t* out) {
+    uint32_t prev = 0;
+    for (long long i = 0; i < n; i++) {
+        out[i] = idx[i] - prev;
+        prev = idx[i];
+    }
+}
+
+void wc_cumsum_idx(const uint32_t* d, long long n, uint32_t* out) {
+    uint32_t acc = 0;
+    for (long long i = 0; i < n; i++) {
+        acc += d[i];
+        out[i] = acc;
+    }
+}
+
+}  // extern "C"

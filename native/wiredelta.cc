@@ -1,0 +1,116 @@
+// wiredelta: GIL-free XOR-delta + CRC32 hot paths (net/wiredelta.py).
+//
+// Exact-bit twins of the numpy implementations in
+// asyncframework_tpu/net/wiredelta.py -- the Python side stays the
+// registered oracle and every function here must match it byte-for-byte
+// (tests/test_native.py property-tests the pair over random sequences
+// including NaN/inf/-0 bit patterns).  C ABI, ctypes-loaded; all sizes
+// are long long, all buffers caller-owned.  Called through ctypes these
+// run with the GIL released for the whole pass.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ------------------------------------------------------------------ crc32
+// Standard CRC-32 (IEEE 802.3, reflected, poly 0xEDB88320) -- the same
+// function zlib.crc32 computes.  Slice-by-8 table kept build-free by
+// generating it on first use (cheap, done once per process).
+static uint32_t g_crc_tab[8][256];
+static int g_crc_ready = 0;
+
+static void crc_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        g_crc_tab[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+        for (int t = 1; t < 8; t++)
+            g_crc_tab[t][i] =
+                (g_crc_tab[t - 1][i] >> 8) ^
+                g_crc_tab[0][g_crc_tab[t - 1][i] & 0xFF];
+    g_crc_ready = 1;
+}
+
+uint32_t wd_crc32(const uint8_t* buf, long long n) {
+    if (!g_crc_ready) crc_init();
+    uint32_t c = 0xFFFFFFFFu;
+    long long i = 0;
+    // slice-by-8 over the aligned middle
+    while (i + 8 <= n) {
+        c ^= (uint32_t)buf[i] | ((uint32_t)buf[i + 1] << 8) |
+             ((uint32_t)buf[i + 2] << 16) | ((uint32_t)buf[i + 3] << 24);
+        uint32_t hi = (uint32_t)buf[i + 4] | ((uint32_t)buf[i + 5] << 8) |
+                      ((uint32_t)buf[i + 6] << 16) |
+                      ((uint32_t)buf[i + 7] << 24);
+        c = g_crc_tab[7][c & 0xFF] ^ g_crc_tab[6][(c >> 8) & 0xFF] ^
+            g_crc_tab[5][(c >> 16) & 0xFF] ^ g_crc_tab[4][c >> 24] ^
+            g_crc_tab[3][hi & 0xFF] ^ g_crc_tab[2][(hi >> 8) & 0xFF] ^
+            g_crc_tab[1][(hi >> 16) & 0xFF] ^ g_crc_tab[0][hi >> 24];
+        i += 8;
+    }
+    for (; i < n; i++)
+        c = g_crc_tab[0][(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------- XOR deltas
+// Sparse encode: write the changed-word indices and xor words of
+// cur^basis into idx_out/xor_out.  Returns nnz, or -1 the moment nnz
+// would exceed max_nnz -- the caller ships FULL then, exactly like the
+// numpy path's `nz.size * 8 < cur.nbytes` cutoff (max_nnz is that
+// threshold minus one word, supplied by the Python wrapper so the two
+// implementations share one cutoff).
+long long wd_encode(const uint32_t* cur, const uint32_t* basis,
+                    long long n, uint32_t* idx_out, uint32_t* xor_out,
+                    long long max_nnz) {
+    long long nnz = 0;
+    for (long long i = 0; i < n; i++) {
+        uint32_t x = cur[i] ^ basis[i];
+        if (x) {
+            if (nnz >= max_nnz) return -1;
+            idx_out[nnz] = (uint32_t)i;
+            xor_out[nnz] = x;
+            nnz++;
+        }
+    }
+    return nnz;
+}
+
+// Dense xor (XFULL encode, and the XFULL decode's basis^payload pass).
+void wd_xor_dense(const uint32_t* a, const uint32_t* b, uint32_t* out,
+                  long long n) {
+    for (long long i = 0; i < n; i++) out[i] = a[i] ^ b[i];
+}
+
+// XDELTA decode: bits[idx[k]] ^= words[k], bounds-checked against n.
+// Returns 0, or -1 on any out-of-range index (caller -> full-pull
+// fallback, the numpy path's idx.max() >= basis.size check).
+int wd_apply_xdelta(uint32_t* bits, long long n, const uint32_t* idx,
+                    const uint32_t* words, long long nnz) {
+    for (long long k = 0; k < nnz; k++)
+        if ((long long)idx[k] >= n) return -1;
+    for (long long k = 0; k < nnz; k++) bits[idx[k]] ^= words[k];
+    return 0;
+}
+
+// ------------------------------------------------------------ frame pump
+// Gather copy: concatenate count buffers into dst (the frame pump's
+// b"".join twin; also the shm-ring socket's vectored send path).
+// Returns total bytes copied.
+long long wd_gather(uint8_t* dst, const uint8_t** srcs,
+                    const long long* lens, long long count) {
+    long long off = 0;
+    for (long long i = 0; i < count; i++) {
+        if (lens[i] > 0) {
+            memcpy(dst + off, srcs[i], (size_t)lens[i]);
+            off += lens[i];
+        }
+    }
+    return off;
+}
+
+}  // extern "C"

@@ -1,0 +1,542 @@
+"""Native wire data plane (ISSUE 19): property suites proving every
+native fast path is a pure *optimization*.
+
+- bit-identity: each ctypes entry point in ``net/wiredelta.py``,
+  ``net/wirecodec.py``, and ``net/frame.py`` produces byte-identical
+  output to its registered pure-Python oracle, including the unfriendly
+  floats (NaN payload bits, +/-inf, -0.0, subnormals) and degenerate
+  shapes (empty, single element, odd lengths);
+- cross-backend ring: the shm ring layout is the contract, not the
+  code -- every writer-backend x reader-backend combination moves the
+  same bytes through the same segment, EOF flags included;
+- transport integration: a real SHM_OPEN handshake upgrades a loopback
+  TCP connection and frames round-trip over the rings; a SIGKILL'd
+  peer degrades with ``ConnectionError`` (never a hang) and is counted;
+- toolchain-absent: no compiler means probed skips for the identity
+  suites, a ``no-toolchain`` --check report, and a visible
+  ``python_fallbacks`` bump when native was wanted but unavailable;
+- ``native-oracle`` lint: each direction of the rule fires on a minimal
+  mutated fixture and the real tree lints clean.
+
+The native-requiring tests skip as a unit when ``ensure_built`` cannot
+produce the libraries (the PR 12 probed-skip discipline: the skip names
+the missing capability, and boxes with a toolchain run everything).
+"""
+
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from asyncframework_tpu import conf as conf_mod
+from asyncframework_tpu import native_build
+from asyncframework_tpu.analysis import rules_native
+from asyncframework_tpu.analysis.core import LintContext, run_lint
+from asyncframework_tpu.native_build import ensure_built, native_totals
+from asyncframework_tpu.net import frame, shmring, wirecodec, wiredelta
+from asyncframework_tpu.net.shmring import ShmRing, ShmSocket
+
+pytestmark = pytest.mark.native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NATIVE_OK = all(
+    ensure_built(n) is not None
+    for n in ("wiredelta", "wirecodec", "shmring"))
+needs_native = pytest.mark.skipif(
+    not NATIVE_OK, reason="no C++ toolchain (wire natives not built)")
+
+
+@pytest.fixture()
+def cf():
+    """The global conf with full store save/restore (these tests flip
+    the native/shm knobs; nothing may leak into later suites)."""
+    c = conf_mod.global_conf()
+    saved = dict(c._store)
+    yield c
+    c._store.clear()
+    c._store.update(saved)
+
+
+def both(cf, fn):
+    """Run ``fn`` once per backend; returns (python_result, native_result)."""
+    cf.set("async.native.enabled", False)
+    py = fn()
+    cf.set("async.native.enabled", True)
+    nat = fn()
+    return py, nat
+
+
+# ------------------------------------------------------- model vectors
+def _vectors():
+    """(cur, basis) float32 pairs spanning every wire form and the
+    unfriendly bit patterns."""
+    rng = np.random.default_rng(19)
+    out = []
+    base = rng.standard_normal(513).astype(np.float32)
+    out.append(("nm", base, base.copy()))
+    sparse = base.copy()
+    sparse[[0, 7, 500]] += np.float32(1.0)
+    out.append(("xdelta", sparse, base))
+    dense = (base + rng.standard_normal(513).astype(np.float32))
+    out.append(("full", dense, base))
+    nasty = base.copy()
+    nasty[1] = np.nan
+    nasty[2] = np.inf
+    nasty[3] = -np.inf
+    nasty[4] = np.float32(-0.0)
+    nasty[5] = np.float32(1e-42)  # subnormal
+    out.append(("xdelta", nasty, base))
+    out.append(("nm", np.empty(0, np.float32), np.empty(0, np.float32)))
+    # single-element change: an 8-byte xdelta can never beat 4 raw bytes
+    one = np.array([np.float32(-0.0)], np.float32)
+    out.append(("full", np.array([np.float32(0.0)], np.float32), one))
+    odd = rng.standard_normal(7).astype(np.float32)
+    out.append(("full", rng.standard_normal(7).astype(np.float32), odd))
+    return out
+
+
+@needs_native
+class TestWireDeltaIdentity:
+    def test_crc_bit_identity(self, cf):
+        for _, cur, _ in _vectors():
+            py, nat = both(cf, lambda c=cur: wiredelta.crc(c))
+            assert py == nat == (zlib.crc32(cur.tobytes()) & 0xFFFFFFFF)
+
+    def test_encode_bit_identity(self, cf):
+        for want, cur, basis in _vectors():
+            py, nat = both(cf, lambda c=cur, b=basis: wiredelta.encode(c, b))
+            assert py == nat, (want, py[0], nat[0])
+            assert py[0] == want
+
+    def test_encode_xfull_bit_identity(self, cf):
+        for _, cur, basis in _vectors():
+            py, nat = both(
+                cf, lambda c=cur, b=basis: wiredelta.encode_xfull(c, b))
+            assert py == nat
+
+    def test_cross_backend_decode(self, cf):
+        """python-encoded deltas decode natively and vice versa -- the
+        wire never knows which side ran which implementation."""
+        for _, cur, basis in _vectors():
+            want_crc = wiredelta.crc(cur)
+            for enc_native in (False, True):
+                cf.set("async.native.enabled", enc_native)
+                wenc, payload, nnz = wiredelta.encode(cur, basis)
+                cf.set("async.native.enabled", not enc_native)
+                out = wiredelta.decode(wenc, payload, nnz, basis, want_crc,
+                                       basis_crc=wiredelta.crc(basis))
+                assert out is not None
+                assert out.tobytes() == cur.tobytes()
+
+    def test_xfull_decode_cross_backend(self, cf):
+        for _, cur, basis in _vectors():
+            if cur.size == 0:
+                continue
+            want_crc = wiredelta.crc(cur)
+            for enc_native in (False, True):
+                cf.set("async.native.enabled", enc_native)
+                payload = wiredelta.encode_xfull(cur, basis)
+                cf.set("async.native.enabled", not enc_native)
+                out = wiredelta.decode(wiredelta.XFULL, payload, 0,
+                                       basis, want_crc)
+                assert out is not None and out.tobytes() == cur.tobytes()
+
+
+# --------------------------------------------------------- grad codecs
+def _grads():
+    rng = np.random.default_rng(7)
+    g = rng.standard_normal(777).astype(np.float32)
+    g[3] = np.float32(-0.0)
+    g[4] = np.float32(1e-42)
+    err = (rng.standard_normal(777).astype(np.float32)
+           * np.float32(1e-3))
+    return g, err
+
+
+@needs_native
+class TestWireCodecIdentity:
+    @pytest.mark.parametrize("codec", [wirecodec.FP16, wirecodec.INT8])
+    @pytest.mark.parametrize("with_err", [False, True])
+    def test_encode_grad_bit_identity(self, cf, codec, with_err):
+        g, err = _grads()
+        py, nat = both(cf, lambda: wirecodec.encode_grad(
+            g, codec, err.copy() if with_err else None))
+        assert (py is None) == (nat is None)
+        assert py[0] == nat[0]              # header incl. int8 scale
+        assert py[1] == nat[1]              # quantized payload bytes
+        assert py[2].tobytes() == nat[2].tobytes()  # residual, bitwise
+
+    @pytest.mark.parametrize("codec", [wirecodec.FP16, wirecodec.INT8])
+    def test_nonfinite_refuses_both_backends(self, cf, codec):
+        g, err = _grads()
+        for bad in (np.nan, np.inf, -np.inf):
+            g2 = g.copy()
+            g2[11] = np.float32(bad)
+            py, nat = both(cf, lambda x=g2: wirecodec.encode_grad(
+                x, codec, err.copy()))
+            assert py is None and nat is None
+
+    def test_fp16_overflow_refuses_both_backends(self, cf):
+        g, _ = _grads()
+        g2 = g.copy()
+        g2[0] = np.float32(1e5)
+        py, nat = both(cf, lambda: wirecodec.encode_grad(
+            g2, wirecodec.FP16, None))
+        assert py is None and nat is None
+        # int8 has no overflow refusal: both encode, identically
+        py, nat = both(cf, lambda: wirecodec.encode_grad(
+            g2, wirecodec.INT8, None))
+        assert py[1] == nat[1] and py[0] == nat[0]
+
+    @pytest.mark.parametrize("codec", [wirecodec.FP16, wirecodec.INT8])
+    def test_decode_grad_cross_backend(self, cf, codec):
+        g, err = _grads()
+        cf.set("async.native.enabled", False)
+        hdr, payload, _ = wirecodec.encode_grad(g, codec, err.copy())
+        py, nat = both(cf, lambda: wirecodec.decode_grad(
+            hdr, payload, g.size))
+        assert py.tobytes() == nat.tobytes()
+
+    def test_transform_bit_identity(self, cf):
+        rng = np.random.default_rng(3)
+        for n in (0, 4, 4096):
+            payload = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            py, nat = both(cf, lambda p=payload: wirecodec._shuffle4(p))
+            assert py == nat
+            py, nat = both(cf, lambda p=payload: wirecodec._unshuffle4(p))
+            assert py == nat
+            assert wirecodec._unshuffle4(wirecodec._shuffle4(payload)) \
+                == payload
+        for m in (0, 1, 513):
+            idx = np.sort(rng.choice(1 << 20, m, replace=False)
+                          ).astype(np.uint32)
+            py, nat = both(cf, lambda i=idx: wirecodec._delta_idx(i))
+            assert py.tobytes() == nat.tobytes()
+            py, nat = both(cf, lambda d=py: wirecodec._cumsum_idx(d))
+            assert py.tobytes() == nat.tobytes()
+            assert py.tobytes() == idx.tobytes()
+
+    def test_compress_model_part_identical_wire(self, cf):
+        """Compression output (transform + deflate) is byte-identical
+        across backends: flipping the knob never changes the wire."""
+        rng = np.random.default_rng(5)
+        basis = rng.standard_normal(4096).astype(np.float32)
+        cur = basis.copy()
+        cur[rng.choice(4096, 200, replace=False)] += np.float32(1e-3)
+        wenc, payload, nnz = wiredelta.encode(cur, basis)
+        assert wenc == wiredelta.XDELTA
+        py, nat = both(cf, lambda: wirecodec.compress_model_part(
+            wenc, payload, nnz))
+        assert py[0] == nat[0] and py[1] == nat[1]
+        hdr, wire = py
+        rt_py, rt_nat = both(cf, lambda: wirecodec.decompress_model_part(
+            {**hdr, "nnz": nnz}, wire))
+        assert rt_py == rt_nat == payload
+
+
+@needs_native
+class TestFrameGather:
+    def test_gather_bit_identity(self, cf):
+        rng = np.random.default_rng(11)
+        parts = [
+            rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            for n in (0, 1, 63, 4096)
+        ]
+        cases = [parts, [b""], [], [memoryview(parts[3]),
+                                    bytearray(parts[2]), parts[1]]]
+        for case in cases:
+            py, nat = both(cf, lambda c=case: frame.gather(c))
+            assert py == nat == b"".join(bytes(p) for p in case)
+
+
+# ------------------------------------------------------ ring transport
+@needs_native
+class TestRingCrossBackend:
+    @pytest.mark.parametrize("w_native", [False, True])
+    @pytest.mark.parametrize("r_native", [False, True])
+    def test_stream_and_eof(self, cf, w_native, r_native):
+        """Every backend combination streams the same bytes through the
+        same segment (incl. wraparound) and agrees on the EOF flag."""
+        cf.set("async.native.enabled", w_native)
+        wr = ShmRing.create(4096)
+        cf.set("async.native.enabled", r_native)
+        rd = ShmRing.attach(wr.path)
+        try:
+            data = np.random.default_rng(13).integers(
+                0, 256, 3 * 4096 + 123, dtype=np.uint8).tobytes()
+            got = bytearray()
+            buf = bytearray(1024)
+            off = 0
+            while off < len(data) or len(got) < len(data):
+                if off < len(data):
+                    w = wr.write(memoryview(data)[off:off + 1024])
+                    assert w >= 0
+                    off += w
+                r = rd.read_into(memoryview(buf))
+                assert r >= 0
+                got += buf[:r]
+            assert bytes(got) == data
+            wr.latch_closed(as_writer=True)
+            assert rd.read_into(memoryview(buf)) == -1  # clean EOF
+        finally:
+            rd.close()
+            wr.close()
+            os.unlink(wr.path)
+
+
+class TestShmSocketIntegration:
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_upgrade_and_roundtrip(self, cf, use_native):
+        """A real SHM_OPEN handshake over loopback TCP: frames round-trip
+        through the rings, the segments are unlinked before the first
+        data frame, and both sides count the upgrade."""
+        if use_native and not NATIVE_OK:
+            pytest.skip("no C++ toolchain (wire natives not built)")
+        cf.set("async.shm.enabled", True)
+        cf.set("async.native.enabled", use_native)
+        base = dict(native_totals())
+        srv_err = []
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+
+        def serve():
+            try:
+                conn, _ = lsock.accept()
+                conn.settimeout(15)
+                header, _ = frame.recv_msg(conn)
+                assert header.get("op") == "SHM_OPEN"
+                sh = shmring.serve_attach(conn, header)
+                assert sh is not None
+                h, payload = frame.recv_msg(sh)
+                frame.send_msg(sh, {"op": "PONG", "tag": h["tag"]},
+                               payload[::-1])
+                sh.close()
+            except Exception as e:  # pragma: no cover - surfaced below
+                srv_err.append(e)
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        sock = socket.create_connection(("127.0.0.1", port), timeout=15)
+        sock.settimeout(15)
+        tr, upgraded = shmring.maybe_upgrade(sock)
+        assert upgraded and isinstance(tr, ShmSocket)
+        # segment names are already unlinked: kill -9 can't leak them
+        assert not os.path.exists(tr._rd.path)
+        assert not os.path.exists(tr._wr.path)
+        payload = os.urandom(65536 + 17)  # bigger than one ring pass
+        frame.send_msg(tr, {"op": "PING", "tag": 42}, payload)
+        h, back = frame.recv_msg(tr)
+        assert h["op"] == "PONG" and h["tag"] == 42
+        assert back == payload[::-1]
+        tr.close()
+        t.join(timeout=15)
+        lsock.close()
+        assert not srv_err, srv_err
+        totals = native_totals()
+        assert totals.get("shm_upgrades", 0) - base.get("shm_upgrades", 0) \
+            == 2  # client + server, same process
+        assert totals.get("shm_frames_sent", 0) \
+            > base.get("shm_frames_sent", 0)
+
+    def test_conf_off_refuses(self, cf):
+        cf.set("async.shm.enabled", False)
+        a, b = socket.socketpair()
+        try:
+            tr, upgraded = shmring.maybe_upgrade(a)
+            assert tr is a and not upgraded
+        finally:
+            a.close()
+            b.close()
+
+
+_KILL_CHILD = """\
+import sys
+import time
+
+sys.path.insert(0, {repo!r})
+from asyncframework_tpu.net.shmring import ShmRing
+
+ring = ShmRing.attach(sys.argv[1])
+ring.stamp_pid(as_writer=True)
+mv = memoryview(b"HELLOSHM")
+off = 0
+while off < len(mv):
+    w = ring.write(mv[off:])
+    if w > 0:
+        off += w
+time.sleep(120)
+"""
+
+
+@needs_native
+@pytest.mark.chaos
+class TestShmKillChaos:
+    def test_sigkill_peer_degrades_not_hangs(self, cf, tmp_path):
+        """kill -9 of the ring peer mid-stream: the survivor's next read
+        raises ConnectionError within the liveness window (never waits
+        out the full timeout) and the degrade is counted."""
+        cf.set("async.native.enabled", True)
+        base = dict(native_totals())
+        rd = ShmRing.create(65536)
+        wr = ShmRing.create(65536)
+        rd.stamp_pid(as_writer=False)
+        script = tmp_path / "shm_kill_child.py"
+        script.write_text(_KILL_CHILD.format(repo=REPO))
+        env = dict(os.environ, PYTHONPATH=REPO)
+        child = subprocess.Popen([sys.executable, str(script), rd.path],
+                                 env=env)
+        a, b = socket.socketpair()
+        sock = ShmSocket(rd=rd, wr=wr, tcp=a)
+        sock.settimeout(30)
+        try:
+            buf = bytearray(8)
+            got = 0
+            while got < 8:
+                got += sock.recv_into(memoryview(buf)[got:])
+            assert bytes(buf) == b"HELLOSHM"
+            child.send_signal(signal.SIGKILL)
+            child.wait(timeout=15)
+            t0 = time.monotonic()
+            with pytest.raises(ConnectionError):
+                sock.recv_into(buf)
+            assert time.monotonic() - t0 < 10  # liveness, not timeout
+            assert native_totals().get("shm_degrades", 0) \
+                > base.get("shm_degrades", 0)
+        finally:
+            if child.poll() is None:  # pragma: no cover - assert failed
+                child.kill()
+                child.wait()
+            sock.close()
+            b.close()
+            for ring in (rd, wr):
+                try:
+                    os.unlink(ring.path)
+                except OSError:
+                    pass
+
+
+# --------------------------------------------------- toolchain-absent
+class TestToolchainAbsent:
+    def test_check_status_reports_no_toolchain(self, tmp_path, monkeypatch):
+        src = os.path.join(native_build.native_dir(), "wiredelta.cc")
+        if not os.path.exists(src):
+            pytest.skip("source tree ships no native/*.cc")
+        with open(src, "rb") as f:
+            (tmp_path / "wiredelta.cc").write_bytes(f.read())
+        monkeypatch.setattr(native_build, "_NATIVE_DIR", str(tmp_path))
+        monkeypatch.setenv("CXX", "/definitely/not/a/compiler")
+        assert native_build.check_status("wiredelta") \
+            == "missing, no-toolchain"
+        assert native_build.ensure_built("wiredelta") is None
+
+    def test_wanted_but_unavailable_degrades_visibly(self, cf, monkeypatch):
+        """native on + no library: correct answers from the oracle AND a
+        python_fallbacks bump -- the silent degrade is never silent."""
+        monkeypatch.setattr(wiredelta, "_NATIVE", False)
+        cf.set("async.native.enabled", True)
+        base = native_totals().get("python_fallbacks", 0)
+        buf = np.arange(16, dtype=np.float32)
+        assert wiredelta.crc(buf) \
+            == (zlib.crc32(buf.tobytes()) & 0xFFFFFFFF)
+        assert native_totals().get("python_fallbacks", 0) > base
+
+
+# ------------------------------------------------- native-oracle lint
+GOOD_DISPATCH = '''
+import ctypes
+from asyncframework_tpu.native_build import ensure_built
+
+NATIVE_ORACLES = {"fx_add": "_py_add"}
+_LIB = None
+
+
+def _native_lib():
+    global _LIB
+    if _LIB is None:
+        path = ensure_built("fx")
+        _LIB = ctypes.CDLL(path)
+        _LIB.fx_add.restype = ctypes.c_int
+    return _LIB
+
+
+def _py_add(a, b):
+    return a + b
+
+
+def add(a, b):
+    lib = _native_lib()
+    if lib is not None:
+        return lib.fx_add(a, b)
+    return _py_add(a, b)
+'''
+
+
+@pytest.mark.lint
+class TestNativeOracleRule:
+    def _findings(self, tmp_path, src):
+        rel = "asyncframework_tpu/net/fx.py"
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        ctx = LintContext(str(tmp_path), paths=[rel])
+        return rules_native.check(ctx)
+
+    def test_good_module_is_clean(self, tmp_path):
+        assert self._findings(tmp_path, GOOD_DISPATCH) == []
+
+    def test_deleted_entry_fires_missing(self, tmp_path):
+        src = GOOD_DISPATCH.replace(
+            'NATIVE_ORACLES = {"fx_add": "_py_add"}', "NATIVE_ORACLES = {}")
+        f = self._findings(tmp_path, src)
+        assert [x.rule for x in f] == ["native-oracle-missing"]
+        assert f[0].token == "fx_add"
+
+    def test_deleted_table_fires_missing(self, tmp_path):
+        src = GOOD_DISPATCH.replace(
+            'NATIVE_ORACLES = {"fx_add": "_py_add"}\n', "")
+        f = self._findings(tmp_path, src)
+        assert [x.rule for x in f] == ["native-oracle-missing"]
+
+    def test_deleted_fallback_fires(self, tmp_path):
+        src = GOOD_DISPATCH.replace(
+            "    return _py_add(a, b)\n", "    return 0\n")
+        f = self._findings(tmp_path, src)
+        assert [x.rule for x in f] == ["native-fallback-missing"]
+        assert f[0].token == "fx_add"
+
+    def test_renamed_oracle_fires_undefined(self, tmp_path):
+        src = GOOD_DISPATCH.replace("def _py_add", "def _py_sum")
+        rules = {x.rule for x in self._findings(tmp_path, src)}
+        assert "native-oracle-undefined" in rules
+
+    def test_stale_entry_fires(self, tmp_path):
+        src = GOOD_DISPATCH.replace(
+            '{"fx_add": "_py_add"}',
+            '{"fx_add": "_py_add", "fx_gone": "_py_add"}')
+        f = self._findings(tmp_path, src)
+        assert [x.rule for x in f] == ["native-oracle-stale"]
+        assert f[0].token == "fx_gone"
+
+    def test_class_shaped_twin_needs_instantiation(self, tmp_path):
+        src = GOOD_DISPATCH.replace(
+            '{"fx_add": "_py_add"}', '{"fx_add": "_Py.add"}') + (
+            "\n\nclass _Py:\n    def add(self, a, b):\n        return a + b\n")
+        rules = [x.rule for x in self._findings(tmp_path, src)]
+        assert rules == ["native-fallback-missing"]
+        fixed = src + "\n_INSTANCE = _Py()\n"
+        assert self._findings(tmp_path, fixed) == []
+
+    def test_real_tree_is_clean(self):
+        result = run_lint(REPO, rules=["native"])
+        assert result.findings == [], [f.format() for f in result.findings]
